@@ -1,0 +1,44 @@
+"""Test harness setup: force JAX onto CPU with 8 virtual devices so the whole
+suite (sharding, mesh, collectives, e2e) runs without TPU hardware — the
+TPU-native analogue of the reference's in-process MiniCluster test strategy
+(tony-mini/.../MiniCluster.java:43-65, TestTonyE2E.java:90-109)."""
+
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_job_dirs(tmp_path):
+    """Staging + history dirs for orchestration tests."""
+    staging = tmp_path / "staging"
+    history = tmp_path / "history"
+    staging.mkdir()
+    history.mkdir()
+    return {"staging": str(staging), "history": str(history)}
+
+
+FIXTURE_SCRIPTS = REPO_ROOT / "tests" / "fixtures" / "scripts"
+
+
+@pytest.fixture
+def fixture_script():
+    def _get(name: str) -> str:
+        path = FIXTURE_SCRIPTS / name
+        assert path.exists(), f"missing fixture script {name}"
+        return str(path)
+
+    return _get

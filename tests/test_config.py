@@ -1,0 +1,92 @@
+"""Config system tests — includes the defaults<->constants cross-check the
+reference enforces in TestTonyConfigurationFields.java."""
+
+import json
+
+import pytest
+
+from tony_tpu.conf import TonyConf, keys, load_defaults
+
+
+def test_defaults_and_constants_cross_check():
+    """Every global key constant appears in defaults.json and vice versa
+    (reference TestTonyConfigurationFields, TonyConfigurationKeys.java:80-81)."""
+    defaults = load_defaults()
+    constants = {
+        v for k, v in vars(keys).items()
+        if k.isupper() and isinstance(v, str) and v.startswith("tony.")
+        and k not in ("PREFIX",)
+    }
+    missing_in_defaults = constants - set(defaults)
+    assert not missing_in_defaults, f"constants missing defaults: {missing_in_defaults}"
+    missing_constants = set(defaults) - constants
+    assert not missing_constants, f"defaults missing constants: {missing_constants}"
+
+
+def test_layering_order(tmp_path, monkeypatch):
+    f1 = tmp_path / "a.json"
+    f1.write_text(json.dumps({"tony.application.name": "from-file", "x.custom": 1}))
+    site_dir = tmp_path / "site"
+    site_dir.mkdir()
+    (site_dir / "tony-site.json").write_text(
+        json.dumps({"tony.application.name": "from-site"})
+    )
+    monkeypatch.setenv("TONY_CONF_DIR", str(site_dir))
+    conf = TonyConf.resolve(
+        conf_files=[f1], overrides=["tony.am.retry-count=3", "y.z=true"]
+    )
+    # site wins over file; overrides applied; defaults still present
+    assert conf["tony.application.name"] == "from-site"
+    assert conf.get_int(keys.AM_RETRY_COUNT) == 3
+    assert conf["y.z"] is True
+    assert conf["x.custom"] == 1
+    assert conf.get_int(keys.TASK_MAX_MISSED_HEARTBEATS) == 25
+
+
+def test_role_discovery_and_specs():
+    conf = TonyConf({
+        "tony.worker.instances": 4,
+        "tony.worker.chips": 1,
+        "tony.worker.command": "python train.py",
+        "tony.ps.instances": 2,
+        "tony.ps.depends-on": "",
+        "tony.evaluator.instances": 1,
+        # reserved prefixes must not become roles:
+        "tony.task.instances": 99,
+    })
+    assert conf.roles() == ["evaluator", "ps", "worker"]
+    specs = {s.name: s for s in conf.role_specs()}
+    assert specs["worker"].instances == 4
+    assert specs["worker"].chips == 1
+    assert specs["worker"].command == "python train.py"
+    priorities = [s.priority for s in conf.role_specs()]
+    assert len(priorities) == len(set(priorities)), "priorities must be unique"
+
+
+def test_validation_caps():
+    conf = TonyConf({
+        "tony.worker.instances": 4,
+        "tony.task.max-total-instances": 2,
+    })
+    with pytest.raises(ValueError, match="exceeds"):
+        conf.validate()
+    conf2 = TonyConf({"tony.worker.instances": 0})
+    with pytest.raises(ValueError):
+        conf2.validate()
+    conf3 = TonyConf({
+        "tony.worker.instances": 2,
+        "tony.worker.memory-mb": 1000,
+        "tony.task.max-total-memory-mb": 1500,
+    })
+    with pytest.raises(ValueError, match="memory"):
+        conf3.validate()
+    ok = TonyConf({"tony.worker.instances": 2})
+    ok.validate()
+
+
+def test_final_conf_roundtrip(tmp_path):
+    conf = TonyConf({"tony.worker.instances": 2, "custom.key": [1, 2]})
+    conf.write_final(tmp_path)
+    loaded = TonyConf.from_final(tmp_path)
+    assert loaded["tony.worker.instances"] == 2
+    assert loaded["custom.key"] == [1, 2]

@@ -1,0 +1,71 @@
+"""DAG scheduler tests — reference TestTaskScheduler (cycle detection,
+staged release of dependents)."""
+
+import pytest
+
+from tony_tpu.conf import TonyConf
+from tony_tpu.scheduler import DependencyCycleError, TaskScheduler, build_dependency_graph, check_dag
+
+
+def test_cycle_rejected():
+    conf = TonyConf({
+        "tony.a.instances": 1, "tony.a.depends-on": "b",
+        "tony.b.instances": 1, "tony.b.depends-on": "a",
+    })
+    with pytest.raises(DependencyCycleError):
+        TaskScheduler(conf, conf.role_specs(), lambda s: None)
+
+
+def test_unknown_dependency_rejected():
+    conf = TonyConf({"tony.a.instances": 1, "tony.a.depends-on": "ghost"})
+    with pytest.raises(ValueError, match="unknown"):
+        build_dependency_graph(conf, conf.role_specs())
+
+
+def test_topological_order():
+    deps = {"c": {"b"}, "b": {"a"}, "a": set()}
+    assert check_dag(deps) == ["a", "b", "c"]
+
+
+def test_staged_release():
+    conf = TonyConf({
+        "tony.prep.instances": 2,
+        "tony.worker.instances": 2, "tony.worker.depends-on": "prep",
+        "tony.eval.instances": 1, "tony.eval.depends-on": "worker",
+    })
+    requested = []
+    sched = TaskScheduler(conf, conf.role_specs(), lambda s: requested.append(s.name))
+    sched.schedule()
+    assert requested == ["prep"]
+    assert sched.dependency_pending("worker")
+    sched.on_task_completed("prep", succeeded=True)
+    assert requested == ["prep"], "only 1 of 2 prep instances done"
+    sched.on_task_completed("prep", succeeded=True)
+    assert requested == ["prep", "worker"]
+    sched.on_task_completed("worker", succeeded=True)
+    sched.on_task_completed("worker", succeeded=True)
+    assert requested == ["prep", "worker", "eval"]
+
+
+def test_failed_dependency_blocks_dependents():
+    conf = TonyConf({
+        "tony.prep.instances": 1,
+        "tony.worker.instances": 1, "tony.worker.depends-on": "prep",
+    })
+    requested = []
+    sched = TaskScheduler(conf, conf.role_specs(), lambda s: requested.append(s.name))
+    sched.schedule()
+    sched.on_task_completed("prep", succeeded=False)
+    assert requested == ["prep"], "failed dependency must not release dependents"
+    assert sched.unscheduled_roles() == ["worker"]
+
+
+def test_prepare_training_stage_convenience():
+    conf = TonyConf({
+        "tony.etl.instances": 1,
+        "tony.worker.instances": 2,
+        "tony.application.prepare-stage": "etl",
+        "tony.application.training-stage": "worker",
+    })
+    deps = build_dependency_graph(conf, conf.role_specs())
+    assert deps["worker"] == {"etl"}

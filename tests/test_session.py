@@ -1,0 +1,96 @@
+"""Session state-machine tests — completion/failure policy per reference
+TonySession.onTaskCompleted / updateSessionStatus (TonySession.java:260-347)."""
+
+from tony_tpu.api import JobStatus, TaskStatus
+from tony_tpu.conf import TonyConf
+from tony_tpu.session import Session
+
+
+def make_session(extra=None):
+    conf = TonyConf({"tony.worker.instances": 2, "tony.ps.instances": 1, **(extra or {})})
+    return Session(conf)
+
+
+def test_registration_and_cluster_spec():
+    s = make_session()
+    assert not s.all_registered()
+    s.register_task("worker:0", "h1", 1000)
+    s.register_task("worker:1", "h2", 1001)
+    assert not s.all_registered()
+    s.register_task("ps:0", "h3", 1002)
+    assert s.all_registered()
+    spec = s.cluster_spec()
+    assert spec == {"worker": ["h1:1000", "h2:1001"], "ps": ["h3:1002"]}
+
+
+def test_chief_failure_kills_job():
+    s = make_session()
+    # no 'chief' role -> worker:0 is chief (TonySession.java:381-384)
+    s.on_task_completed("worker", 0, exit_code=1)
+    assert s.status == JobStatus.FAILED
+    assert "chief" in s.failure_message
+
+
+def test_non_chief_worker_failure_tolerated():
+    s = make_session()
+    s.on_task_completed("worker", 1, exit_code=1)
+    assert s.status != JobStatus.FAILED
+    s.on_task_completed("worker", 0, exit_code=0)
+    s.on_task_completed("ps", 0, exit_code=0)
+    assert s.update_status() == JobStatus.SUCCEEDED
+
+
+def test_fail_on_worker_failure_flag():
+    s = make_session({"tony.application.fail-on-worker-failure-enabled": True})
+    s.on_task_completed("worker", 1, exit_code=1)
+    assert s.status == JobStatus.FAILED
+
+
+def test_stop_on_failure_roles():
+    s = make_session({"tony.application.stop-on-failure-jobtypes": "ps"})
+    s.on_task_completed("ps", 0, exit_code=1)
+    assert s.status == JobStatus.FAILED
+
+
+def test_all_tracked_failed():
+    s = make_session()
+    s.on_task_completed("worker", 1, exit_code=1)
+    s.on_task_completed("ps", 0, exit_code=1)
+    # worker:0 (chief) failing fails the job outright
+    s.tasks["worker"][0].status = TaskStatus.KILLED
+    s.tasks["worker"][0].exit_code = 137
+    assert s.update_status() == JobStatus.FAILED
+
+
+def test_untracked_roles_excluded_from_completion():
+    s = make_session({
+        "tony.tensorboard.instances": 1,
+        "tony.application.untracked.jobtypes": "tensorboard",
+    })
+    assert s.total_tracked() == 3
+    s.on_task_completed("worker", 0, exit_code=0)
+    s.on_task_completed("worker", 1, exit_code=0)
+    s.on_task_completed("ps", 0, exit_code=0)
+    # tensorboard still running, but job is done
+    assert s.update_status() == JobStatus.SUCCEEDED
+
+
+def test_untracked_failure_fails_fast():
+    """Reference ApplicationMaster.java:1265-1269 — untracked crash fails the job."""
+    s = make_session({
+        "tony.tensorboard.instances": 1,
+        "tony.application.untracked.jobtypes": "tensorboard",
+    })
+    s.on_task_completed("tensorboard", 0, exit_code=2)
+    assert s.status == JobStatus.FAILED
+
+
+def test_allocation_matching_by_priority():
+    s = make_session()
+    specs = {sp.name: sp for sp in s.conf.role_specs()}
+    t1 = s.get_and_init_matching_task(specs["worker"].priority, "c1")
+    t2 = s.get_and_init_matching_task(specs["worker"].priority, "c2")
+    t3 = s.get_and_init_matching_task(specs["worker"].priority, "c3")
+    assert t1.task_id == "worker:0" and t2.task_id == "worker:1"
+    assert t3 is None, "no more worker slots"
+    assert t1.status == TaskStatus.ALLOCATED

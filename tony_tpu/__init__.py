@@ -1,0 +1,28 @@
+"""tony_tpu — a TPU-native distributed-training orchestrator and parallelism library.
+
+Re-imagining of the capability set of LinkedIn's TonY (reference:
+/root/reference, a YARN-based orchestrator for TF/PyTorch/Horovod/MXNet jobs)
+as a TPU-first framework:
+
+- orchestration: submission client -> driver (session + DAG scheduler +
+  heartbeat liveness + event history + retry) -> per-host executor agent ->
+  user training process, bootstrapped for ``jax.distributed`` instead of
+  TF_CONFIG / Gloo / DMLC env matrices.
+- parallelism: first-class JAX library (mesh builder over ICI/DCN topology,
+  DP/FSDP/TP/PP/EP sharding rules, ring attention for long context) — the
+  reference delegates all of this to external frameworks, here it is native.
+
+Layer map (mirrors reference layer map, SURVEY.md section 1):
+  client.py    <- TonyClient        (tony-core/.../TonyClient.java)
+  driver.py    <- ApplicationMaster (tony-core/.../ApplicationMaster.java)
+  session.py   <- TonySession       (tony-core/.../TonySession.java)
+  scheduler.py <- TaskScheduler     (tony-core/.../TaskScheduler.java)
+  executor.py  <- TaskExecutor      (tony-core/.../TaskExecutor.java)
+  rpc/         <- rpc/ApplicationRpc + MetricsRpc
+  runtimes/    <- runtime/ SPI (TF/PyTorch/Horovod/MXNet/Standalone) + JAX
+  events/      <- events/EventHandler + avro schemas
+  cluster/     <- YARN RM/NM interface -> local/TPU-slice provisioners
+  parallel/, ops/, models/, train/ <- new TPU-native capability layer
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,102 @@
+"""Shared data model for the control plane.
+
+Mirrors the reference's rpc/TaskInfo + TaskStatus + models/ POJOs
+(tony-core/.../rpc/TaskInfo.java, TonySession.TonyTask, models/JobMetadata.java)
+as plain dataclasses serializable to JSON for the wire and the event log.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field, asdict
+from typing import Any
+
+
+class TaskStatus(str, enum.Enum):
+    """Task lifecycle — reference TaskStatus enum (TonySession.java:434-601)."""
+
+    NEW = "NEW"
+    REQUESTED = "REQUESTED"
+    ALLOCATED = "ALLOCATED"
+    RUNNING = "RUNNING"        # registered with driver, user process live
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+    def is_terminal(self) -> bool:
+        return self in (TaskStatus.SUCCEEDED, TaskStatus.FAILED, TaskStatus.KILLED)
+
+
+class JobStatus(str, enum.Enum):
+    """Whole-application status — reference FinalApplicationStatus usage."""
+
+    NEW = "NEW"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.KILLED)
+
+
+class DistributedMode(str, enum.Enum):
+    """GANG: no task starts before all register. FCFS: start as they come.
+    Reference TonyConfigurationKeys.DistributedMode (TonyConfigurationKeys.java:22-25)."""
+
+    GANG = "GANG"
+    FCFS = "FCFS"
+
+
+@dataclass
+class TaskInfo:
+    """Wire-visible task state — reference rpc/TaskInfo.java."""
+
+    name: str            # role, e.g. "worker"
+    index: int
+    status: str = TaskStatus.NEW.value
+    host: str = ""
+    port: int = -1
+    url: str = ""        # log/monitor URL
+    exit_code: int | None = None
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.name}:{self.index}"
+
+    def to_dict(self) -> dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TaskInfo":
+        return cls(**d)
+
+
+@dataclass
+class MetricSample:
+    """One metric observation — reference rpc/MetricWritable.java."""
+
+    name: str
+    value: float
+
+    def to_dict(self) -> dict[str, Any]:
+        return asdict(self)
+
+
+@dataclass
+class JobMetadata:
+    """History metadata — reference models/JobMetadata.java:35-45."""
+
+    app_id: str
+    user: str = ""
+    started_ms: int = field(default_factory=lambda: int(time.time() * 1000))
+    completed_ms: int = -1
+    status: str = JobStatus.RUNNING.value
+
+    def to_dict(self) -> dict[str, Any]:
+        return asdict(self)
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)

@@ -1,0 +1,126 @@
+"""Configuration key constants and per-role key accessors.
+
+Mirrors the reference's key registry (tony-core/.../TonyConfigurationKeys.java:1-339):
+every global key has an entry in conf/defaults.json (cross-checked by
+tests/test_config.py, the way TestTonyConfigurationFields.java cross-checks
+tony-default.xml), and per-role keys are generated from templates so that new
+roles (ps/worker/chief/evaluator/scheduler/head/driver/...) need no code change
+(reference discovers roles by regex, TonyConfigurationKeys.java:189-191).
+"""
+
+from __future__ import annotations
+
+import re
+
+PREFIX = "tony."
+
+# ---------------------------------------------------------------- application
+APPLICATION_NAME = "tony.application.name"
+APPLICATION_FRAMEWORK = "tony.application.framework"  # jax|tensorflow|pytorch|mxnet|horovod|standalone
+APPLICATION_DISTRIBUTED_MODE = "tony.application.distributed-mode"  # GANG|FCFS
+APPLICATION_TIMEOUT_MS = "tony.application.timeout-ms"  # 0 = no timeout
+APPLICATION_TAGS = "tony.application.tags"
+APPLICATION_PREPARE_STAGE = "tony.application.prepare-stage"
+APPLICATION_TRAINING_STAGE = "tony.application.training-stage"
+APPLICATION_UNTRACKED_JOBTYPES = "tony.application.untracked.jobtypes"
+APPLICATION_STOP_ON_FAILURE_JOBTYPES = "tony.application.stop-on-failure-jobtypes"
+APPLICATION_FAIL_ON_WORKER_FAILURE = "tony.application.fail-on-worker-failure-enabled"
+APPLICATION_ENABLE_PREPROCESS = "tony.application.enable-preprocess"
+APPLICATION_NODE_LABEL = "tony.application.node-label"
+
+# --------------------------------------------------------------------- driver
+AM_RETRY_COUNT = "tony.am.retry-count"
+AM_MONITOR_INTERVAL_MS = "tony.am.monitor-interval-ms"
+AM_RPC_HOST = "tony.am.rpc-host"
+AM_REGISTRATION_TIMEOUT_MS = "tony.am.registration-timeout-ms"
+AM_ALLOCATION_TIMEOUT_MS = "tony.am.allocation-timeout-ms"  # gang-deadlock breaker
+
+# ---------------------------------------------------------------------- tasks
+TASK_HEARTBEAT_INTERVAL_MS = "tony.task.heartbeat-interval-ms"
+TASK_MAX_MISSED_HEARTBEATS = "tony.task.max-missed-heartbeats"
+TASK_METRICS_INTERVAL_MS = "tony.task.metrics-interval-ms"
+TASK_REGISTRATION_POLL_MS = "tony.task.registration-poll-interval-ms"
+TASK_EXECUTOR_EXECUTION_TIMEOUT_MS = "tony.task.executor.execution-timeout-ms"
+TASK_MAX_TOTAL_INSTANCES = "tony.task.max-total-instances"
+TASK_MAX_TOTAL_MEMORY_MB = "tony.task.max-total-memory-mb"
+TASK_MAX_TOTAL_CHIPS = "tony.task.max-total-chips"
+
+# -------------------------------------------------------------------- staging
+STAGING_DIR = "tony.staging.dir"
+HISTORY_DIR = "tony.history.location"
+HISTORY_INTERMEDIATE = "tony.history.intermediate"
+HISTORY_FINISHED = "tony.history.finished"
+HISTORY_RETENTION_SEC = "tony.history.retention-sec"
+HISTORY_MOVER_INTERVAL_MS = "tony.history.mover-interval-ms"
+SRC_DIR = "tony.application.src-dir"
+PYTHON_VENV = "tony.application.python-venv"
+PYTHON_BINARY_PATH = "tony.application.python-binary-path"
+EXECUTION_ENV = "tony.execution.env"  # list of K=V propagated to every task
+
+# -------------------------------------------------------------------- secrets
+SECURITY_TOKEN_ENABLED = "tony.security.token-enabled"
+
+# ------------------------------------------------------------------- cluster
+CLUSTER_PROVISIONER = "tony.cluster.provisioner"  # local|tpu-pod|static
+CLUSTER_STATIC_HOSTS = "tony.cluster.static-hosts"
+TPU_TOPOLOGY = "tony.tpu.topology"  # e.g. v5e-8; "" = discover
+TPU_ACCELERATOR_TYPE = "tony.tpu.accelerator-type"
+
+# ------------------------------------------------------------------ notebook
+NOTEBOOK_TIMEOUT_MS = "tony.notebook.timeout-ms"
+
+# ----------------------------------------------------------- per-role templates
+# reference: tony.<job>.{instances,memory,vcores,gpus,command,resources,
+# node-label,depends-on,max-instances} (TonyConfigurationKeys.java getInstancesKey etc.)
+ROLE_KEY_TEMPLATES = (
+    "instances",
+    "memory-mb",
+    "vcores",
+    "chips",       # replaces reference 'gpus' with TPU chips per task
+    "command",
+    "resources",
+    "node-label",
+    "depends-on",
+    "max-instances",
+    "env",
+)
+
+_ROLE_KEY_RE = re.compile(r"^tony\.([A-Za-z][A-Za-z0-9_\-]*)\.instances$")
+_RESERVED_NON_ROLES = frozenset(
+    {"application", "am", "task", "staging", "history", "cluster", "tpu",
+     "notebook", "security", "execution"}
+)
+
+
+def role_key(role: str, template: str) -> str:
+    """tony.<role>.<template> — e.g. role_key('worker', 'instances')."""
+    if template not in ROLE_KEY_TEMPLATES:
+        raise KeyError(f"unknown role key template: {template}")
+    return f"tony.{role}.{template}"
+
+
+def instances_key(role: str) -> str:
+    return role_key(role, "instances")
+
+
+def command_key(role: str) -> str:
+    return role_key(role, "command")
+
+
+def depends_on_key(role: str) -> str:
+    return role_key(role, "depends-on")
+
+
+def discover_roles(conf_dict: dict) -> list[str]:
+    """Find roles by scanning for tony.<role>.instances keys.
+
+    Mirrors the reference's regex discovery (util/Utils.java:451-460) so
+    arbitrary role names (ps, worker, chief, evaluator, scheduler, head,
+    driver, tensorboard, notebook, ...) work without code changes.
+    """
+    roles = []
+    for key in conf_dict:
+        m = _ROLE_KEY_RE.match(key)
+        if m and m.group(1) not in _RESERVED_NON_ROLES:
+            roles.append(m.group(1))
+    return sorted(roles)
