@@ -1,0 +1,103 @@
+"""RPC transport tests: dispatch, auth, retry, concurrency."""
+
+import threading
+
+import pytest
+
+from tony_tpu.rpc import RpcClient, RpcError, RpcServer
+
+
+def make_server(token=""):
+    server = RpcServer(token=token)
+    server.register("echo", lambda **kw: kw)
+    server.register("add", lambda a, b: a + b)
+    def boom():
+        raise ValueError("kaboom")
+    server.register("boom", boom)
+    server.start()
+    return server
+
+
+def test_roundtrip_and_error():
+    server = make_server()
+    try:
+        client = RpcClient("127.0.0.1", server.port)
+        assert client.call("add", a=2, b=3) == 5
+        assert client.call("echo", x=[1, 2], y={"k": "v"}) == {"x": [1, 2], "y": {"k": "v"}}
+        with pytest.raises(RpcError, match="kaboom"):
+            client.call("boom")
+        with pytest.raises(RpcError, match="unknown method"):
+            client.call("nope")
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_hmac_auth():
+    server = make_server(token="s3cret")
+    try:
+        good = RpcClient("127.0.0.1", server.port, token="s3cret")
+        assert good.call("add", a=1, b=1) == 2
+        bad = RpcClient("127.0.0.1", server.port, token="wrong")
+        with pytest.raises(RpcError, match="authentication"):
+            bad.call("add", a=1, b=1)
+        good.close(); bad.close()
+    finally:
+        server.stop()
+
+
+def test_reconnect_after_server_restart():
+    server = make_server()
+    port = server.port
+    client = RpcClient("127.0.0.1", port, max_retries=20)
+    assert client.call("add", a=1, b=2) == 3
+    server.stop()
+    server2 = RpcServer(port=port)
+    server2.register("add", lambda a, b: a + b)
+    server2.start()
+    try:
+        assert client.call("add", a=5, b=5) == 10
+    finally:
+        client.close()
+        server2.stop()
+
+
+def test_concurrent_clients():
+    server = make_server()
+    results, errors = [], []
+
+    def worker(i):
+        try:
+            c = RpcClient("127.0.0.1", server.port)
+            for j in range(20):
+                results.append(c.call("add", a=i, b=j))
+            c.close()
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    server.stop()
+    assert not errors
+    assert len(results) == 160
+
+
+def test_service_object_registration():
+    class Svc:
+        def hello(self, name):
+            return f"hi {name}"
+        def _private(self):
+            return "no"
+
+    server = RpcServer()
+    server.register_service(Svc())
+    server.start()
+    try:
+        c = RpcClient("127.0.0.1", server.port)
+        assert c.call("hello", name="x") == "hi x"
+        with pytest.raises(RpcError):
+            c.call("_private")
+        c.close()
+    finally:
+        server.stop()

@@ -1,0 +1,29 @@
+"""Job event history.
+
+Mirrors the reference's Avro "jhist" pipeline (events/EventHandler.java:38-156,
+src/main/avro/*.avsc, util/HistoryFileUtils.java:12-32): a dedicated writer
+thread drains a queue of typed events into
+``<hist>/intermediate/<app_id>/<app_id>-<start>[-<end>]-<user>[-STATUS].jhist.inprogress``
+renamed to ``.jhist`` on stop; a mover relocates finished jobs into
+``finished/yyyy/MM/dd`` and a purger deletes expired history. Events are JSON
+lines instead of Avro — same information, greppable, no codegen.
+"""
+
+from .types import Event, EventType
+from .handler import EventHandler
+from .history import (
+    history_file_name,
+    parse_history_file_name,
+    HistoryFileMover,
+    HistoryFilePurger,
+)
+
+__all__ = [
+    "Event",
+    "EventType",
+    "EventHandler",
+    "history_file_name",
+    "parse_history_file_name",
+    "HistoryFileMover",
+    "HistoryFilePurger",
+]

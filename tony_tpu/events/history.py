@@ -1,0 +1,160 @@
+"""History file naming + housekeeping.
+
+Filename codec mirrors util/HistoryFileUtils.java:12-32:
+``<app_id>-<start_ms>[-<end_ms>]-<user>[-<STATUS>].jhist``.
+The mover (tony-portal/app/history/HistoryFileMover.java:74-169) relocates
+finished jobs from ``intermediate/<app_id>/`` to ``finished/yyyy/MM/dd/<app_id>/``
+and finalizes orphaned ``.inprogress`` files from killed drivers; the purger
+(HistoryFilePurger) deletes history older than the retention window.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import shutil
+import threading
+import time
+from dataclasses import dataclass
+from datetime import datetime, timezone
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+SUFFIX = ".jhist"
+INPROGRESS = ".jhist.inprogress"
+
+_NAME_RE = re.compile(
+    r"^(?P<app>.+?)-(?P<start>\d+)(?:-(?P<end>\d+))?-(?P<user>[^-]*)"
+    r"(?:-(?P<status>[A-Z]+))?\.jhist$"
+)
+
+
+def history_file_name(
+    app_id: str,
+    start_ms: int,
+    end_ms: int | None = None,
+    user: str = "",
+    status: str = "",
+) -> str:
+    parts = [app_id, str(start_ms)]
+    if end_ms is not None:
+        parts.append(str(end_ms))
+    parts.append(user or "anonymous")
+    if status:
+        parts.append(status.upper())
+    return "-".join(parts) + SUFFIX
+
+
+@dataclass
+class HistoryFileMeta:
+    app_id: str
+    start_ms: int
+    end_ms: int | None
+    user: str
+    status: str
+
+
+def parse_history_file_name(name: str) -> HistoryFileMeta | None:
+    m = _NAME_RE.match(name)
+    if not m:
+        return None
+    return HistoryFileMeta(
+        app_id=m.group("app"),
+        start_ms=int(m.group("start")),
+        end_ms=int(m.group("end")) if m.group("end") else None,
+        user=m.group("user"),
+        status=m.group("status") or "",
+    )
+
+
+class HistoryFileMover:
+    """intermediate/<app>/ -> finished/yyyy/MM/dd/<app>/ for completed jobs."""
+
+    def __init__(self, intermediate: str, finished: str, interval_s: float = 30.0):
+        self.intermediate = Path(intermediate)
+        self.finished = Path(finished)
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def move_once(self) -> list[Path]:
+        moved = []
+        if not self.intermediate.exists():
+            return moved
+        for job_dir in sorted(self.intermediate.iterdir()):
+            if not job_dir.is_dir():
+                continue
+            jhists = list(job_dir.glob("*" + SUFFIX))
+            inprog = list(job_dir.glob("*" + INPROGRESS))
+            if not jhists and inprog:
+                # driver died without finalizing: rename as KILLED
+                # (reference HistoryFileMover.java killed-app handling)
+                for p in inprog:
+                    meta = parse_history_file_name(p.name[: -len(".inprogress")])
+                    if meta is None:
+                        continue
+                    final = p.with_name(
+                        history_file_name(
+                            meta.app_id, meta.start_ms,
+                            end_ms=int(time.time() * 1000),
+                            user=meta.user, status="KILLED",
+                        )
+                    )
+                    p.rename(final)
+                    jhists = [final]
+            if not jhists:
+                continue  # still in progress
+            meta = parse_history_file_name(jhists[0].name)
+            end = meta.end_ms if meta and meta.end_ms else int(time.time() * 1000)
+            day = datetime.fromtimestamp(end / 1000, tz=timezone.utc)
+            dest = (
+                self.finished
+                / f"{day.year:04d}" / f"{day.month:02d}" / f"{day.day:02d}"
+                / job_dir.name
+            )
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            if dest.exists():
+                shutil.rmtree(str(job_dir))
+            else:
+                shutil.move(str(job_dir), str(dest))
+                moved.append(dest)
+        return moved
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self._interval):
+                try:
+                    self.move_once()
+                except Exception:
+                    log.exception("history mover pass failed")
+
+        self._thread = threading.Thread(target=loop, name="history-mover", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class HistoryFilePurger:
+    """Delete finished history older than retention_sec."""
+
+    def __init__(self, finished: str, retention_sec: float):
+        self.finished = Path(finished)
+        self.retention_sec = retention_sec
+
+    def purge_once(self, now_s: float | None = None) -> list[Path]:
+        now_s = time.time() if now_s is None else now_s
+        purged = []
+        if not self.finished.exists():
+            return purged
+        for jhist in self.finished.rglob("*" + SUFFIX):
+            meta = parse_history_file_name(jhist.name)
+            end_ms = (meta.end_ms or meta.start_ms) if meta else None
+            if end_ms is None:
+                continue
+            if now_s - end_ms / 1000 > self.retention_sec:
+                job_dir = jhist.parent
+                shutil.rmtree(str(job_dir), ignore_errors=True)
+                purged.append(job_dir)
+        return purged

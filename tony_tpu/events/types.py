@@ -1,0 +1,63 @@
+"""Event schema — reference avro/Event.avsc + EventType.avsc + payload schemas
+(ApplicationInited, ApplicationFinished, TaskStarted, TaskFinished with
+per-task metrics array)."""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..api import now_ms
+
+
+class EventType(str, enum.Enum):
+    APPLICATION_INITED = "APPLICATION_INITED"
+    APPLICATION_FINISHED = "APPLICATION_FINISHED"
+    TASK_STARTED = "TASK_STARTED"
+    TASK_FINISHED = "TASK_FINISHED"
+
+
+@dataclass
+class Event:
+    type: EventType
+    payload: dict[str, Any] = field(default_factory=dict)
+    timestamp: int = field(default_factory=now_ms)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"type": self.type.value, "payload": self.payload, "timestamp": self.timestamp}
+        )
+
+    @classmethod
+    def from_json(cls, line: str) -> "Event":
+        d = json.loads(line)
+        return cls(
+            type=EventType(d["type"]),
+            payload=d.get("payload", {}),
+            timestamp=d.get("timestamp", 0),
+        )
+
+
+def application_inited(app_id: str, num_tasks: int, host: str) -> Event:
+    return Event(EventType.APPLICATION_INITED,
+                 {"app_id": app_id, "num_tasks": num_tasks, "host": host})
+
+
+def application_finished(app_id: str, status: str, failed_tasks: int,
+                         message: str = "") -> Event:
+    return Event(EventType.APPLICATION_FINISHED,
+                 {"app_id": app_id, "status": status,
+                  "num_failed_tasks": failed_tasks, "message": message})
+
+
+def task_started(task_id: str, host: str) -> Event:
+    return Event(EventType.TASK_STARTED, {"task_id": task_id, "host": host})
+
+
+def task_finished(task_id: str, status: str, exit_code: int,
+                  metrics: list[dict[str, Any]] | None = None) -> Event:
+    return Event(EventType.TASK_FINISHED,
+                 {"task_id": task_id, "status": status, "exit_code": exit_code,
+                  "metrics": metrics or []})

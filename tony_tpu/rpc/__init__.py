@@ -1,0 +1,23 @@
+"""Control-plane RPC.
+
+The service contract mirrors the reference's 8-method TonyClusterService
+(src/main/proto/tony_cluster_service_protos.proto:11-20) plus the MetricsRpc
+service (rpc/MetricsRpc.java), carried as framed JSON over TCP:
+
+  register_worker(task_id, host, port) -> cluster_spec | None   (gang barrier)
+  get_cluster_spec(task_id)            -> cluster_spec | None
+  get_task_infos()                     -> [TaskInfo]
+  heartbeat(task_id)                   -> bool
+  register_execution_result(task_id, exit_code) -> str
+  register_tensorboard_url(url)        -> bool
+  register_callback_info(task_id, payload) -> bool   (runtime rendezvous data)
+  finish_application()                 -> bool       (client lets driver exit)
+  update_metrics(task_id, metrics)     -> bool
+  get_metrics(task_id)                 -> [MetricSample]
+"""
+
+from .client import RpcClient
+from .protocol import RpcError
+from .server import RpcServer
+
+__all__ = ["RpcClient", "RpcServer", "RpcError"]
