@@ -1,0 +1,8 @@
+import os, sys
+assert os.environ["HOROVOD_CONTROLLER"] == "gloo"
+assert os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"]
+assert int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]) > 0
+rank = int(os.environ["HOROVOD_RANK"]); size = int(os.environ["HOROVOD_SIZE"])
+assert 0 <= rank < size, (rank, size)
+assert int(os.environ["HOROVOD_LOCAL_RANK"]) < int(os.environ["HOROVOD_LOCAL_SIZE"])
+sys.exit(0)

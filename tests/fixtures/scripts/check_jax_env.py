@@ -1,0 +1,19 @@
+"""Asserts the JAX runtime env contract (coordinator/process_id/num_processes
++ CLUSTER_SPEC) is present and coherent."""
+import json, os, sys
+
+spec = json.loads(os.environ["CLUSTER_SPEC"])
+assert "worker" in spec, spec
+coord = os.environ["TONY_COORDINATOR_ADDRESS"]
+pid = int(os.environ["TONY_PROCESS_ID"])
+nproc = int(os.environ["TONY_NUM_PROCESSES"])
+total = sum(len(v) for v in spec.values())
+assert nproc == total, (nproc, total)
+assert 0 <= pid < nproc, (pid, nproc)
+assert ":" in coord, coord
+# rank 0's advertised address must be the coordinator
+ranked = []
+for role in sorted(spec):
+    ranked.extend(spec[role])
+assert coord == ranked[0], (coord, ranked)
+sys.exit(0)

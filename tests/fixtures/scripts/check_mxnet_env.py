@@ -1,0 +1,7 @@
+import os, sys
+assert os.environ["DMLC_ROLE"] in ("scheduler", "server", "worker")
+assert os.environ["DMLC_PS_ROOT_URI"]
+assert int(os.environ["DMLC_PS_ROOT_PORT"]) > 0
+assert int(os.environ["DMLC_NUM_SERVER"]) == 1
+assert int(os.environ["DMLC_NUM_WORKER"]) == 2
+sys.exit(0)

@@ -1,0 +1,7 @@
+import os, sys
+assert os.environ["INIT_METHOD"].startswith("tcp://"), os.environ["INIT_METHOD"]
+rank, world = int(os.environ["RANK"]), int(os.environ["WORLD"])
+assert 0 <= rank < world, (rank, world)
+assert os.environ["MASTER_ADDR"]
+assert int(os.environ["MASTER_PORT"]) > 0
+sys.exit(0)

@@ -1,0 +1,8 @@
+import json, os, sys
+tf_config = json.loads(os.environ["TF_CONFIG"])
+assert os.environ["JOB_NAME"] in ("worker", "ps"), os.environ["JOB_NAME"]
+assert tf_config["task"]["type"] == os.environ["JOB_NAME"]
+assert tf_config["task"]["index"] == int(os.environ["TASK_INDEX"])
+assert "worker" in tf_config["cluster"] and "ps" in tf_config["cluster"]
+assert "tensorboard" not in tf_config["cluster"]
+sys.exit(0)

@@ -1,0 +1,7 @@
+"""Writes its rank to a file so tests can verify all tasks ran with distinct ranks."""
+import os, sys
+out_dir = os.environ["RANK_OUT_DIR"]
+pid = os.environ["TONY_PROCESS_ID"]
+with open(os.path.join(out_dir, f"rank_{pid}"), "w") as f:
+    f.write(os.environ["TONY_JOB_NAME"] + ":" + os.environ["TONY_TASK_INDEX"])
+sys.exit(0)

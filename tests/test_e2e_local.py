@@ -1,0 +1,306 @@
+"""End-to-end mini-cluster tests: real client -> driver subprocess -> executor
+subprocesses -> fixture python scripts.
+
+This is the TPU-native analogue of the reference's centerpiece suite
+TestTonyE2E.java (696 LoC, 28 scenarios against an in-process MiniCluster):
+same shape — trivial python fixtures as "training scripts", env-var fault
+injection, assertions on final job status and task states.
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tony_tpu.api import JobStatus, TaskStatus
+from tony_tpu.client import TonyClient
+from tony_tpu.conf import TonyConf
+
+PY = sys.executable
+
+
+def base_conf(dirs, **extra):
+    conf = TonyConf({
+        "tony.staging.dir": dirs["staging"],
+        "tony.history.location": dirs["history"],
+        "tony.history.intermediate": dirs["history"] + "/intermediate",
+        "tony.history.finished": dirs["history"] + "/finished",
+        "tony.am.monitor-interval-ms": 100,
+        "tony.task.registration-poll-interval-ms": 100,
+        **extra,
+    })
+    return conf
+
+
+def run_job(dirs, **extra) -> tuple[JobStatus, TonyClient]:
+    client = TonyClient(base_conf(dirs, **extra), poll_interval_s=0.1)
+    client.submit()
+    status = client.monitor()
+    return status, client
+
+
+def dump_logs(client):
+    """Best-effort log dump on failure for debuggability."""
+    out = []
+    for p in sorted(Path(client.job_dir).rglob("*.log")) + sorted(
+        Path(client.job_dir).rglob("*.std*")
+    ):
+        out.append(f"==== {p} ====\n{p.read_text()[-3000:]}")
+    return "\n".join(out)
+
+
+# --------------------------------------------------------------- happy paths
+
+def test_single_worker_passes(tmp_job_dirs, fixture_script):
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    assert client.task_infos and client.task_infos[0].status == "SUCCEEDED"
+
+
+def test_multi_worker_gang_passes(tmp_job_dirs, fixture_script):
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 3,
+           "tony.worker.command": f"{PY} {fixture_script('check_jax_env.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_jax_ranks_are_distinct(tmp_job_dirs, fixture_script, tmp_path):
+    rank_dir = tmp_path / "ranks"
+    rank_dir.mkdir()
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 3,
+           "tony.worker.command": f"{PY} {fixture_script('write_rank_file.py')}",
+           "tony.execution.env": f"RANK_OUT_DIR={rank_dir}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    ranks = sorted(p.name for p in rank_dir.iterdir())
+    assert ranks == ["rank_0", "rank_1", "rank_2"]
+
+
+def test_worker_failure_fails_job(tmp_job_dirs, fixture_script):
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.worker.command": f"{PY} {fixture_script('exit_1.py')}"},
+    )
+    assert status == JobStatus.FAILED
+
+
+def test_non_chief_failure_tolerated(tmp_job_dirs, fixture_script):
+    """worker:0 (chief) passes, worker:1 fails -> job still succeeds
+    (reference testAMNotStopJobAfterNonChiefWorkerFailed, TestTonyE2E.java:323)."""
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.chief.instances": 1,
+           "tony.chief.command": f"{PY} {fixture_script('exit_0.py')}",
+           "tony.worker.instances": 2,
+           "tony.worker.command": (
+               f"bash -c 'if [ \"$TONY_TASK_INDEX\" = 1 ]; then exit 1; else exit 0; fi'"
+           )},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    by_id = {t.task_id: t for t in client.task_infos}
+    assert by_id["worker:1"].status == "FAILED"
+
+
+def test_chief_failure_fails_job(tmp_job_dirs, fixture_script):
+    """Reference testAMStopsJobAfterWorker0Killed (TestTonyE2E.java:298)."""
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 2,
+           "tony.worker.command": (
+               f"bash -c 'if [ \"$TONY_TASK_INDEX\" = 0 ]; then exit 1; else sleep 60; fi'"
+           )},
+    )
+    assert status == JobStatus.FAILED
+    assert "chief" in client.final_state.get("message", "")
+
+
+# ----------------------------------------------------------- runtime adapters
+
+def test_tensorflow_ps_worker_env(tmp_job_dirs, fixture_script):
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.application.framework": "tensorflow",
+           "tony.ps.instances": 1,
+           "tony.ps.command": f"{PY} {fixture_script('check_tf_env.py')}",
+           "tony.worker.instances": 2,
+           "tony.worker.command": f"{PY} {fixture_script('check_tf_env.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_pytorch_env(tmp_job_dirs, fixture_script):
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.application.framework": "pytorch",
+           "tony.worker.instances": 2,
+           "tony.worker.command": f"{PY} {fixture_script('check_pytorch_env.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_mxnet_env(tmp_job_dirs, fixture_script):
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.application.framework": "mxnet",
+           "tony.scheduler.instances": 1,
+           "tony.scheduler.command": f"{PY} {fixture_script('check_mxnet_env.py')}",
+           "tony.server.instances": 1,
+           "tony.server.command": f"{PY} {fixture_script('check_mxnet_env.py')}",
+           "tony.worker.instances": 2,
+           "tony.worker.command": f"{PY} {fixture_script('check_mxnet_env.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_horovod_two_phase_rendezvous(tmp_job_dirs, fixture_script):
+    """Driver role injected + slot table distributed (reference
+    testHorovodModeShouldPass, TestTonyE2E.java:531)."""
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.application.framework": "horovod",
+           "tony.horovod.mode.test": True,
+           "tony.worker.instances": 2,
+           "tony.worker.command": f"{PY} {fixture_script('check_horovod_env.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    roles = {t.name for t in client.task_infos}
+    assert roles == {"worker", "driver"}, "driver role must be injected"
+
+
+def test_standalone_mode(tmp_job_dirs, fixture_script):
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.application.framework": "standalone",
+           "tony.worker.instances": 1,
+           "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_standalone_rejects_multiple_instances(tmp_job_dirs, fixture_script):
+    """Reference StandaloneRuntime.java:69-75."""
+    client = TonyClient(
+        base_conf(
+            tmp_job_dirs,
+            **{"tony.application.framework": "standalone",
+               "tony.worker.instances": 2,
+               "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}"},
+        ),
+        poll_interval_s=0.1,
+    )
+    client.submit()
+    with pytest.raises((RuntimeError, TimeoutError)):
+        client.monitor()
+
+
+# -------------------------------------------------------------- dag + events
+
+def test_dag_scheduling_end_to_end(tmp_job_dirs, fixture_script, tmp_path):
+    """prep runs before worker (reference testTonyAMSchedulerShouldPass:271)."""
+    marker = tmp_path / "order.txt"
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.prep.instances": 1,
+           "tony.prep.command": f"bash -c 'echo prep >> {marker}'",
+           "tony.worker.instances": 1,
+           "tony.worker.command": f"bash -c 'echo worker >> {marker}'",
+           "tony.worker.depends-on": "prep",
+           # staged start means the gang barrier must not wait for worker
+           "tony.application.distributed-mode": "FCFS"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    assert marker.read_text().splitlines() == ["prep", "worker"]
+
+
+def test_history_events_written(tmp_job_dirs, fixture_script):
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED
+    inter = Path(tmp_job_dirs["history"]) / "intermediate" / client.app_id
+    jhists = list(inter.glob("*.jhist"))
+    assert len(jhists) == 1 and "SUCCEEDED" in jhists[0].name
+    lines = [json.loads(l) for l in jhists[0].read_text().splitlines()]
+    types = [l["type"] for l in lines]
+    assert types[0] == "APPLICATION_INITED"
+    assert "TASK_STARTED" in types and "TASK_FINISHED" in types
+    assert types[-1] == "APPLICATION_FINISHED"
+
+
+# ------------------------------------------------------------ fault injection
+
+def test_executor_crash_before_register_fails_job(tmp_job_dirs, fixture_script):
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}",
+           "tony.worker.env": "TONY_TEST_TASK_EXECUTOR_CRASH=1"},
+    )
+    assert status == JobStatus.FAILED
+
+
+def test_missed_heartbeats_fail_job(tmp_job_dirs, fixture_script):
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.worker.command": f"{PY} {fixture_script('sleep_long.py')}",
+           "tony.task.heartbeat-interval-ms": 100,
+           "tony.task.max-missed-heartbeats": 3,
+           # executor skips enough heartbeats to be deemed dead
+           "tony.worker.env": "TONY_TEST_EXECUTOR_NUM_HB_MISS=1000"},
+    )
+    assert status == JobStatus.FAILED
+    assert "heartbeat" in client.final_state.get("message", "")
+
+
+def test_straggler_skew_still_passes(tmp_job_dirs, fixture_script):
+    """Gang barrier holds through a 2s straggler (reference
+    TEST_TASK_EXECUTOR_SKEW, TaskExecutor.java:366-386)."""
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 2,
+           "tony.worker.command": f"{PY} {fixture_script('check_jax_env.py')}",
+           "tony.worker.env": "TONY_TEST_EXECUTOR_SKEW=worker#1#2000"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_execution_timeout_kills_user_process(tmp_job_dirs, fixture_script):
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.worker.command": f"{PY} {fixture_script('sleep_long.py')}",
+           "tony.task.executor.execution-timeout-ms": 1500},
+    )
+    assert status == JobStatus.FAILED
+
+
+def test_driver_retry_after_failure(tmp_job_dirs, fixture_script, tmp_path):
+    """First session fails (worker exits 1 on attempt 0), retry succeeds —
+    reference AM-retry semantics (ApplicationMaster.reset:611-627): the
+    command succeeds only once a marker file exists, which attempt 0 creates."""
+    marker = tmp_path / "attempted"
+    cmd = (
+        f"bash -c 'if [ -f {marker} ]; then exit 0; else touch {marker}; exit 1; fi'"
+    )
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.worker.command": cmd,
+           "tony.am.retry-count": 1},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)

@@ -1,0 +1,17 @@
+"""Cluster capacity layer: provisioners + TPU topology discovery."""
+
+from .provisioner import (
+    ContainerHandle,
+    LocalProvisioner,
+    Provisioner,
+    StaticHostProvisioner,
+    create_provisioner,
+)
+
+__all__ = [
+    "ContainerHandle",
+    "LocalProvisioner",
+    "Provisioner",
+    "StaticHostProvisioner",
+    "create_provisioner",
+]

@@ -1,0 +1,208 @@
+"""Capacity provisioners: how the driver obtains processes on hosts.
+
+The reference's equivalent layer is YARN: the AM asks the RM for containers
+(TaskScheduler.java:100-102) and launches them through NodeManagers
+(ApplicationMaster.ContainerLauncher:1158-1227). A TPU pod slice is inherently
+gang-allocated — all hosts of a slice appear at once — which removes per
+-container allocation races but makes "re-acquire the whole slice" the retry
+unit (SURVEY.md §7 hard parts).
+
+Provisioners implemented:
+- LocalProvisioner: subprocesses on this host — the mini-cluster backend used
+  by tests and `tony-tpu local` (reference tony-mini MiniCluster role).
+- StaticHostProvisioner: a fixed host list (one TPU host per worker), launch
+  via a configurable command template (ssh/agent); models a pre-created TPU
+  pod slice where host i runs task i.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable
+
+from ..conf import RoleSpec, TonyConf, keys
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ContainerHandle:
+    """An allocated unit of capacity running one executor."""
+
+    container_id: str
+    host: str
+    role: str
+    index: int
+    process: subprocess.Popen | None = None
+    extra: dict = field(default_factory=dict)
+
+
+class Provisioner:
+    """SPI. `on_completion(handle, exit_code)` is invoked from a watcher
+    thread when a container exits — the analogue of the RM completion
+    callback (ApplicationMaster.processFinishedContainer:1238-1274)."""
+
+    def __init__(self) -> None:
+        self.on_completion: Callable[[ContainerHandle, int], None] | None = None
+
+    def launch(
+        self, spec: RoleSpec, index: int, env: dict[str, str], log_dir: Path
+    ) -> ContainerHandle:
+        raise NotImplementedError
+
+    def stop_container(self, handle: ContainerHandle) -> None:
+        raise NotImplementedError
+
+    def stop_all(self) -> None:
+        raise NotImplementedError
+
+
+class LocalProvisioner(Provisioner):
+    """Executors as local subprocesses; per-task stdout/stderr files mirror
+    YARN container log dirs."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._handles: dict[str, ContainerHandle] = {}
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def launch(
+        self, spec: RoleSpec, index: int, env: dict[str, str], log_dir: Path
+    ) -> ContainerHandle:
+        with self._lock:
+            cid = f"container_{self._next_id:06d}"
+            self._next_id += 1
+        log_dir.mkdir(parents=True, exist_ok=True)
+        stdout = open(log_dir / f"{spec.name}_{index}.stdout", "ab")
+        stderr = open(log_dir / f"{spec.name}_{index}.stderr", "ab")
+        full_env = {**os.environ, **env}
+        # -S skips site hooks (this environment's sitecustomize imports jax,
+        # ~1.8s); the executor agent is pure stdlib, and the user process it
+        # forks gets a normal interpreter
+        proc = subprocess.Popen(
+            [sys.executable, "-S", "-m", "tony_tpu.executor"],
+            env=full_env,
+            stdout=stdout,
+            stderr=stderr,
+            start_new_session=True,  # own process group => clean kill of user children
+        )
+        handle = ContainerHandle(
+            container_id=cid, host="127.0.0.1", role=spec.name, index=index, process=proc
+        )
+        with self._lock:
+            self._handles[cid] = handle
+        threading.Thread(
+            target=self._watch, args=(handle, stdout, stderr),
+            name=f"watch-{cid}", daemon=True,
+        ).start()
+        return handle
+
+    def _watch(self, handle: ContainerHandle, *files) -> None:
+        code = handle.process.wait()
+        for f in files:
+            try:
+                f.close()
+            except Exception:
+                pass
+        cb = self.on_completion
+        if cb is not None:
+            try:
+                cb(handle, code)
+            except Exception:
+                log.exception("completion callback failed for %s", handle.container_id)
+
+    def stop_container(self, handle: ContainerHandle) -> None:
+        proc = handle.process
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def stop_all(self) -> None:
+        with self._lock:
+            handles = list(self._handles.values())
+        for h in handles:
+            self.stop_container(h)
+
+
+class StaticHostProvisioner(Provisioner):
+    """Fixed host list; each task launched by substituting into a command
+    template (default: ssh). Round-robins tasks over hosts, so a v5e-16
+    (4 hosts) with tony.worker.instances=4 puts one executor per TPU host."""
+
+    def __init__(self, hosts: list[str], launch_template: str | None = None) -> None:
+        super().__init__()
+        if not hosts:
+            raise ValueError("StaticHostProvisioner needs at least one host")
+        self.hosts = hosts
+        self.launch_template = launch_template or (
+            "ssh -o BatchMode=yes {host} {env} " + sys.executable + " -m tony_tpu.executor"
+        )
+        self._local = LocalProvisioner()
+        self._count = 0
+        self._lock = threading.Lock()
+
+    @property
+    def on_completion(self):  # delegate watcher callback to inner provisioner
+        return self._local.on_completion
+
+    @on_completion.setter
+    def on_completion(self, cb):
+        self._local.on_completion = cb
+
+    def launch(
+        self, spec: RoleSpec, index: int, env: dict[str, str], log_dir: Path
+    ) -> ContainerHandle:
+        with self._lock:
+            host = self.hosts[self._count % len(self.hosts)]
+            self._count += 1
+        env_str = " ".join(f"{k}={shlex.quote(str(v))}" for k, v in env.items())
+        cmd = self.launch_template.format(host=host, env=env_str)
+        log_dir.mkdir(parents=True, exist_ok=True)
+        stdout = open(log_dir / f"{spec.name}_{index}.stdout", "ab")
+        stderr = open(log_dir / f"{spec.name}_{index}.stderr", "ab")
+        proc = subprocess.Popen(
+            cmd, shell=True, stdout=stdout, stderr=stderr, start_new_session=True
+        )
+        handle = ContainerHandle(
+            container_id=f"static_{host}_{spec.name}_{index}",
+            host=host, role=spec.name, index=index, process=proc,
+        )
+        threading.Thread(
+            target=self._local._watch, args=(handle, stdout, stderr), daemon=True
+        ).start()
+        return handle
+
+    def stop_container(self, handle: ContainerHandle) -> None:
+        self._local.stop_container(handle)
+
+    def stop_all(self) -> None:
+        self._local.stop_all()
+
+
+def create_provisioner(conf: TonyConf) -> Provisioner:
+    kind = str(conf.get(keys.CLUSTER_PROVISIONER, "local")).lower()
+    if kind == "local":
+        return LocalProvisioner()
+    if kind == "static":
+        hosts = conf.get_list(keys.CLUSTER_STATIC_HOSTS)
+        return StaticHostProvisioner(hosts)
+    raise ValueError(f"unknown provisioner: {kind}")

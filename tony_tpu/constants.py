@@ -1,0 +1,46 @@
+"""Process-boundary contracts: environment variables and well-known files.
+
+Mirrors the reference's Constants.java env contract (Constants.java:48-68 —
+JOB_NAME, TASK_INDEX, TASK_NUM, IS_CHIEF, SESSION_ID, DISTRIBUTED_MODE,
+AM_HOST, AM_PORT) plus its test fault-injection hooks (Constants.java:124-130).
+"""
+
+# ---- driver -> executor env contract (reference TaskExecutor.initConfigs:239-283)
+ENV_JOB_NAME = "TONY_JOB_NAME"            # role, e.g. "worker"
+ENV_TASK_INDEX = "TONY_TASK_INDEX"
+ENV_TASK_NUM = "TONY_TASK_NUM"            # instances of this role
+ENV_NUM_TOTAL_TASKS = "TONY_NUM_TOTAL_TASKS"
+ENV_IS_CHIEF = "TONY_IS_CHIEF"
+ENV_SESSION_ID = "TONY_SESSION_ID"
+ENV_DISTRIBUTED_MODE = "TONY_DISTRIBUTED_MODE"
+ENV_DRIVER_HOST = "TONY_DRIVER_HOST"
+ENV_DRIVER_PORT = "TONY_DRIVER_PORT"
+ENV_APP_ID = "TONY_APP_ID"
+ENV_JOB_DIR = "TONY_JOB_DIR"              # holds tony-final.json
+ENV_TOKEN = "TONY_SECRET_TOKEN"           # HMAC session token (ClientToAM-token role)
+ENV_TASK_COMMAND = "TONY_TASK_COMMAND"    # user command for this role
+
+# ---- executor -> user-process env (consumed by training scripts)
+ENV_CLUSTER_SPEC = "CLUSTER_SPEC"         # JSON role -> [host:port]
+ENV_TB_PORT = "TB_PORT"
+
+# JAX runtime contract (replaces TF_CONFIG/Gloo/DMLC matrix — SURVEY.md §5):
+ENV_COORDINATOR_ADDRESS = "TONY_COORDINATOR_ADDRESS"
+ENV_PROCESS_ID = "TONY_PROCESS_ID"
+ENV_NUM_PROCESSES = "TONY_NUM_PROCESSES"
+
+# ---- well-known files in the job dir
+DRIVER_INFO_FILE = "driver.json"          # driver's rpc endpoint, written at prepare
+                                          # (plays the YARN app-report role for the client)
+
+# ---- fault-injection hooks (production code paths, keyed off env like
+# reference Constants.java:124-130 TEST_* hooks)
+TEST_DRIVER_CRASH = "TONY_TEST_DRIVER_CRASH"                # driver exits mid-run
+TEST_EXECUTOR_NUM_HB_MISS = "TONY_TEST_EXECUTOR_NUM_HB_MISS"  # skip N heartbeats
+TEST_EXECUTOR_SKEW = "TONY_TEST_EXECUTOR_SKEW"              # "job#idx#ms" straggler
+TEST_TASK_EXECUTOR_CRASH = "TONY_TEST_TASK_EXECUTOR_CRASH"  # executor dies pre-register
+
+# ---- exit codes
+EXIT_SUCCESS = 0
+EXIT_FAILURE = 1
+EXIT_KILLED = 137

@@ -1,0 +1,435 @@
+"""The job driver: session owner, capacity requester, liveness monitor.
+
+Mirrors the reference ApplicationMaster (tony-core/.../ApplicationMaster.java):
+lifecycle init -> prepare -> start -> monitor -> (reset/retry) -> stop
+(:326-437), an RPC server for client+executors (:858-974), heartbeat liveness
+(:201-221, onTaskDeemedDead:1229-1236), container-completion handling
+(processFinishedContainer:1238-1274), registration-timeout and
+startup-failure detection (:1276-1334), and whole-job retry that rebuilds the
+session with session_id+1 (reset:611-627).
+
+Capacity comes from a Provisioner instead of YARN; the "container" is an
+executor process on a (TPU) host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+from . import constants as c
+from .api import DistributedMode, JobStatus, TaskStatus, now_ms
+from .cluster import ContainerHandle, Provisioner, create_provisioner
+from .conf import RoleSpec, TonyConf, keys
+from .events import EventHandler
+from .events.types import (
+    application_finished,
+    application_inited,
+    task_finished,
+    task_started,
+)
+from .rpc import RpcServer
+from .scheduler import TaskScheduler
+from .session import Session
+
+log = logging.getLogger(__name__)
+
+
+class DriverService:
+    """RPC-facing service — reference RpcForClient (ApplicationMaster.java:858-974).
+    Public methods = wire methods."""
+
+    def __init__(self, driver: "Driver"):
+        self._d = driver
+
+    # ------------------------------------------------------------- executors
+    def register_worker(self, task_id: str, host: str, port: int):
+        d = self._d
+        task = d.session.register_task(task_id, host, port)
+        if task is None:
+            raise ValueError(f"unknown task {task_id}")
+        d.heartbeats[task_id] = time.time()
+        log.info("registered %s at %s:%s (%d/%d)", task_id, host, port,
+                 d.session.registered_count(), len(d.session.all_tasks()))
+        return self.get_cluster_spec(task_id)
+
+    def get_cluster_spec(self, task_id: str):
+        """None until the runtime's gang barrier opens — the executor polls
+        (reference 'return null until ready', TaskExecutor.java:296-298)."""
+        d = self._d
+        if not d.runtime_driver.can_start_task(d.mode, task_id):
+            return None
+        return d.runtime_driver.cluster_spec_payload(task_id)
+
+    def taskExecutorHeartbeat(self, task_id: str):  # wire name kept short below
+        return self.heartbeat(task_id)
+
+    def heartbeat(self, task_id: str) -> bool:
+        self._d.heartbeats[task_id] = time.time()
+        return True
+
+    def register_execution_result(self, task_id: str, exit_code: int) -> str:
+        log.info("%s reported exit code %d", task_id, exit_code)
+        self._d.on_task_result(task_id, exit_code, source="executor")
+        return "RECEIVED"
+
+    def register_callback_info(self, task_id: str, payload: dict[str, Any]) -> bool:
+        self._d.runtime_driver.receive_callback_info(task_id, payload)
+        return True
+
+    def register_tensorboard_url(self, url: str) -> bool:
+        self._d.tensorboard_url = url
+        log.info("tensorboard at %s", url)
+        return True
+
+    def update_metrics(self, task_id: str, metrics: list[dict[str, Any]]) -> bool:
+        self._d.metrics[task_id] = metrics
+        return True
+
+    def get_metrics(self, task_id: str):
+        return self._d.metrics.get(task_id, [])
+
+    # ---------------------------------------------------------------- client
+    def get_task_infos(self):
+        return [t.to_dict() for t in self._d.session.task_infos()]
+
+    def get_application_state(self):
+        d = self._d
+        return {
+            "app_id": d.app_id,
+            "status": d.session.status.value,
+            "message": d.session.failure_message,
+            "session_id": d.session.session_id,
+            "tensorboard_url": d.tensorboard_url,
+        }
+
+    def finish_application(self) -> bool:
+        """Client acknowledges the terminal state so the driver may exit
+        (reference signalAMToFinish / FinishApplication RPC)."""
+        self._d.client_signal.set()
+        return True
+
+
+class Driver:
+    def __init__(
+        self,
+        conf: TonyConf,
+        app_id: str,
+        job_dir: str,
+        token: str = "",
+        user: str = "",
+        provisioner: Provisioner | None = None,
+    ):
+        self.conf = conf
+        self.app_id = app_id
+        self.job_dir = Path(job_dir)
+        self.token = token
+        self.user = user or os.environ.get("USER", "")
+        self.tensorboard_url = ""
+        self.metrics: dict[str, list[dict[str, Any]]] = {}
+        self.heartbeats: dict[str, float] = {}
+        self.client_signal = threading.Event()
+        self._stop_requested = threading.Event()
+        self.mode = DistributedMode(
+            str(conf.get(keys.APPLICATION_DISTRIBUTED_MODE, "GANG")).upper()
+        )
+
+        from .runtimes import get_runtime
+
+        self._runtime = get_runtime(str(conf.get(keys.APPLICATION_FRAMEWORK, "jax")))
+        self.runtime_driver = self._runtime.driver_adapter()
+        # runtime may inject roles (horovod driver) before the session exists
+        self.runtime_driver.validate_and_update_config(conf)
+        conf.validate()
+
+        self.provisioner = provisioner or create_provisioner(conf)
+        self.provisioner.on_completion = self._on_container_completed
+
+        self.session = Session(conf, session_id=0)
+        self.runtime_driver.set_session(self.session)
+        self.scheduler: TaskScheduler | None = None
+
+        self.rpc_server = RpcServer(
+            host=str(conf.get(keys.AM_RPC_HOST, "127.0.0.1")), token=token
+        )
+        self.rpc_server.register_service(DriverService(self))
+        self.events: EventHandler | None = None
+        self._handles: dict[str, ContainerHandle] = {}  # task_id -> handle
+        self._launch_ms: dict[str, int] = {}            # task_id -> launch time
+        self._retries_left = conf.get_int(keys.AM_RETRY_COUNT, 0)
+        self._start_ms = now_ms()
+
+    # ------------------------------------------------------------- lifecycle
+    def run(self) -> JobStatus:
+        self.prepare()
+        try:
+            while True:
+                self.start_session()
+                status = self.monitor()
+                if status == JobStatus.FAILED and self._retries_left > 0:
+                    self._retries_left -= 1
+                    log.warning(
+                        "job failed (%s); retrying (%d attempts left)",
+                        self.session.failure_message, self._retries_left,
+                    )
+                    self.reset()
+                    continue
+                return status
+        finally:
+            self.stop()
+
+    def prepare(self) -> None:
+        """RPC up, events up, endpoint advertised — reference prepare:442-526."""
+        self.rpc_server.start()
+        hist_inter = str(self.conf.get(keys.HISTORY_INTERMEDIATE))
+        self.events = EventHandler(hist_inter, self.app_id, user=self.user)
+        self.events.start()
+        self.events.emit(
+            application_inited(
+                self.app_id, len(self.session.all_tasks()), self.rpc_server.address[0]
+            )
+        )
+        self.conf.write_final(self.job_dir)
+        # Advertise the RPC endpoint for the client (plays the role of the
+        # YARN application report carrying the AM host:port).
+        import json
+
+        info = {"host": self.rpc_server.address[0], "port": self.rpc_server.port,
+                "app_id": self.app_id, "pid": os.getpid()}
+        tmp = self.job_dir / (c.DRIVER_INFO_FILE + ".tmp")
+        tmp.write_text(json.dumps(info))
+        tmp.rename(self.job_dir / c.DRIVER_INFO_FILE)
+
+    def start_session(self) -> None:
+        """Build scheduler and request capacity — reference start:577-608."""
+        self.scheduler = TaskScheduler(
+            self.conf, list(self.session.role_specs.values()), self._request_role
+        )
+        self.scheduler.schedule()
+
+    def _request_role(self, spec: RoleSpec) -> None:
+        """Launch all instances of a role — the local/TPU analogue of
+        addContainerRequest + ContainerLauncher (allocation is immediate for
+        a provisioner that owns its capacity; a TPU slice is gang-allocated)."""
+        from .runtimes.generic import GenericDriverAdapter
+
+        if isinstance(self.runtime_driver, GenericDriverAdapter):
+            self.runtime_driver.note_requests_submitted()
+        for index in range(spec.instances):
+            task = self.session.get_task(spec.name, index)
+            if task is None or task.status.is_terminal():
+                continue
+            task.status = TaskStatus.REQUESTED
+            env = self._task_env(spec, index)
+            handle = self.provisioner.launch(
+                spec, index, env, self.job_dir / "logs"
+            )
+            task.status = TaskStatus.ALLOCATED
+            task.container_id = handle.container_id
+            task.host = handle.host
+            self._handles[task.task_id] = handle
+            self._launch_ms[task.task_id] = now_ms()
+            if self.events:
+                self.events.emit(task_started(task.task_id, handle.host))
+            log.info("launched %s as %s on %s", task.task_id,
+                     handle.container_id, handle.host)
+
+    def _task_env(self, spec: RoleSpec, index: int) -> dict[str, str]:
+        """The driver->executor env contract — reference ContainerLauncher
+        env assembly (ApplicationMaster.java:1179-1192)."""
+        env = {
+            c.ENV_JOB_NAME: spec.name,
+            c.ENV_TASK_INDEX: str(index),
+            c.ENV_TASK_NUM: str(spec.instances),
+            c.ENV_NUM_TOTAL_TASKS: str(len(self.session.all_tasks())),
+            c.ENV_IS_CHIEF: str(self.session.is_chief(spec.name, index)).lower(),
+            c.ENV_SESSION_ID: str(self.session.session_id),
+            c.ENV_DISTRIBUTED_MODE: self.mode.value,
+            c.ENV_DRIVER_HOST: self.rpc_server.address[0],
+            c.ENV_DRIVER_PORT: str(self.rpc_server.port),
+            c.ENV_APP_ID: self.app_id,
+            c.ENV_JOB_DIR: str(self.job_dir),
+            c.ENV_TOKEN: self.token,
+            c.ENV_TASK_COMMAND: spec.command,
+        }
+        for kv in self.conf.get_list(keys.EXECUTION_ENV):
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                env[k] = v
+        env.update(spec.env)
+        return env
+
+    # ------------------------------------------------------------ completion
+    def _on_container_completed(self, handle: ContainerHandle, exit_code: int) -> None:
+        """Provisioner watcher callback — reference
+        processFinishedContainer:1238-1274."""
+        task_id = f"{handle.role}:{handle.index}"
+        self.on_task_result(task_id, exit_code, source="container")
+
+    def on_task_result(self, task_id: str, exit_code: int, source: str) -> None:
+        task = self.session.get_task_by_id(task_id)
+        if task is None:
+            return
+        already_terminal = task.status.is_terminal()
+        name, _, idx = task_id.partition(":")
+        self.session.on_task_completed(name, int(idx), exit_code)
+        if not already_terminal:
+            if self.events:
+                self.events.emit(
+                    task_finished(
+                        task_id, task.status.value, exit_code,
+                        metrics=self.metrics.get(task_id, []),
+                    )
+                )
+            if self.scheduler:
+                self.scheduler.on_task_completed(name, exit_code == 0)
+
+    # --------------------------------------------------------------- monitor
+    def monitor(self) -> JobStatus:
+        """The driver hot loop — reference monitor:633-728 and its exit
+        conditions: timeout, client signal, heartbeat expiry, registration
+        timeout, startup failure, runtime unhealthy, DAG stall, completion."""
+        interval = self.conf.get_int(keys.AM_MONITOR_INTERVAL_MS, 200) / 1000
+        timeout_ms = self.conf.get_int(keys.APPLICATION_TIMEOUT_MS, 0)
+        reg_timeout_ms = self.conf.get_int(keys.AM_REGISTRATION_TIMEOUT_MS, 900000)
+        hb_interval_ms = self.conf.get_int(keys.TASK_HEARTBEAT_INTERVAL_MS, 1000)
+        max_missed = max(3, self.conf.get_int(keys.TASK_MAX_MISSED_HEARTBEATS, 25))
+        hb_expiry_s = hb_interval_ms * max_missed / 1000
+
+        while not self._stop_requested.is_set():
+            now = time.time()
+
+            # 1. application timeout
+            if timeout_ms > 0 and now_ms() - self._start_ms > timeout_ms:
+                self.session.kill_all(f"application timed out after {timeout_ms}ms")
+                return JobStatus.KILLED
+
+            # 2. heartbeat expiry (reference onTaskDeemedDead:1229-1236)
+            for task_id, last in list(self.heartbeats.items()):
+                task = self.session.get_task_by_id(task_id)
+                if task is None or task.status.is_terminal():
+                    continue
+                if now - last > hb_expiry_s:
+                    msg = f"task {task_id} missed {max_missed} heartbeats; deemed dead"
+                    log.error(msg)
+                    # record the heartbeat reason before the kill cascades into
+                    # completion callbacks with a generic exit-code message
+                    self.session._fail(msg)
+                    self._kill_task(task_id)
+                    self.session.on_task_completed(task.name, task.index, c.EXIT_KILLED)
+
+            # 3. registration timeout (reference :1314-1334)
+            for task_id, launched in list(self._launch_ms.items()):
+                task = self.session.get_task_by_id(task_id)
+                if task is None or task.status.is_terminal():
+                    continue
+                if (
+                    task.status == TaskStatus.ALLOCATED
+                    and now_ms() - launched > reg_timeout_ms
+                ):
+                    self.session._fail(
+                        f"task {task_id} failed to register within {reg_timeout_ms}ms"
+                    )
+
+            # 4. runtime health (gang allocation deadlock breaker)
+            if not self.runtime_driver.is_healthy(self.conf):
+                self.session._fail("runtime reported unhealthy (allocation timeout)")
+
+            # 5. DAG stall: dependencies failed, dependents can never run
+            if self.scheduler and self.scheduler.unscheduled_roles():
+                tracked_done = all(
+                    t.status.is_terminal()
+                    for t in self.session.tracked_tasks()
+                    if t.name not in self.scheduler.unscheduled_roles()
+                )
+                if tracked_done and self.session.status != JobStatus.FAILED:
+                    self.session._fail(
+                        "roles "
+                        + ",".join(self.scheduler.unscheduled_roles())
+                        + " blocked by failed dependencies"
+                    )
+
+            status = self.session.update_status()
+            if status.is_terminal():
+                return status
+            time.sleep(interval)
+        return self.session.update_status()
+
+    def _kill_task(self, task_id: str) -> None:
+        handle = self._handles.get(task_id)
+        if handle is not None:
+            self.provisioner.stop_container(handle)
+
+    # ----------------------------------------------------------------- retry
+    def reset(self) -> None:
+        """Stop everything, rebuild the session with session_id+1 —
+        reference reset:611-627."""
+        self.provisioner.stop_all()
+        old = self.session
+        self.session = Session(self.conf, session_id=old.session_id + 1)
+        self.runtime_driver = self._runtime.driver_adapter()
+        self.runtime_driver.set_session(self.session)
+        self.heartbeats.clear()
+        self._handles.clear()
+        self._launch_ms.clear()
+        self.metrics.clear()
+
+    # ------------------------------------------------------------------ stop
+    def stop(self) -> None:
+        """Reference stop:739-781: stop containers, wait briefly for the
+        client's finish signal so it can read terminal state, then tear down."""
+        status = self.session.status
+        self.provisioner.stop_all()
+        if self.events:
+            failed = sum(
+                1 for t in self.session.all_tasks()
+                if t.status in (TaskStatus.FAILED, TaskStatus.KILLED)
+            )
+            self.events.emit(
+                application_finished(
+                    self.app_id, status.value, failed, self.session.failure_message
+                )
+            )
+        # grace window for the client to pull final state + ack
+        self.client_signal.wait(timeout=10)
+        if self.events:
+            self.events.stop(status.value)
+        self.rpc_server.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s driver %(name)s: %(message)s",
+    )
+    parser = argparse.ArgumentParser(description="tony-tpu job driver")
+    parser.add_argument("--job-dir", required=True)
+    parser.add_argument("--app-id", required=True)
+    args = parser.parse_args(argv)
+
+    # fault injection: driver crash mid-run (reference TEST_AM_CRASH,
+    # ApplicationMaster.java:382-393) — handled after first task launch via env
+    conf = TonyConf.from_final(args.job_dir)
+    token = os.environ.get(c.ENV_TOKEN, "")
+    driver = Driver(conf, app_id=args.app_id, job_dir=args.job_dir, token=token)
+
+    if os.environ.get(c.TEST_DRIVER_CRASH):
+        def _crash_later():
+            time.sleep(float(os.environ[c.TEST_DRIVER_CRASH]))
+            log.error("TEST_DRIVER_CRASH: exiting now")
+            os._exit(3)
+        threading.Thread(target=_crash_later, daemon=True).start()
+
+    status = driver.run()
+    log.info("driver exiting with job status %s", status.value)
+    return 0 if status == JobStatus.SUCCEEDED else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,239 @@
+"""Per-container executor agent.
+
+Mirrors the reference TaskExecutor (tony-core/.../TaskExecutor.java): reads
+the env contract (initConfigs:239-283), allocates its rendezvous port
+(setupPorts:88-100 — plain ephemeral bind; the SO_REUSEPORT dance of
+ReusablePort.java exists only because TF's gRPC server re-binds a published
+port, which has no JAX/TPU equivalent since libtpu owns device wiring),
+registers with the driver and blocks on the gang barrier
+(registerAndGetClusterSpec:285-299), heartbeats (Heartbeater:324-364), samples
+metrics (TaskMonitor), delegates env construction + user-process exec to the
+runtime task adapter (main:188-237), and reports the exit code
+(registerExecutionResult:315-322).
+
+Fault-injection hooks are production code paths keyed off env vars, like the
+reference's TEST_* hooks (Constants.java:124-130, TaskExecutor.java:328-386).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+
+from . import constants as c
+from .api import DistributedMode
+from .conf import TonyConf, keys
+from .metrics import TaskMonitor
+from .rpc import RpcClient
+
+log = logging.getLogger(__name__)
+
+
+class Heartbeater(threading.Thread):
+    """Reference TaskExecutor.Heartbeater:324-364, including the
+    skip-N-heartbeats fault hook."""
+
+    def __init__(self, client: RpcClient, task_id: str, interval_s: float):
+        super().__init__(name="heartbeater", daemon=True)
+        self._client = client
+        self._task_id = task_id
+        self._interval = interval_s
+        self._skip = int(os.environ.get(c.TEST_EXECUTOR_NUM_HB_MISS, "0"))
+        self.stop_event = threading.Event()
+
+    def run(self) -> None:
+        while not self.stop_event.wait(self._interval):
+            if self._skip > 0:
+                self._skip -= 1
+                log.warning("fault injection: skipping heartbeat (%d left)", self._skip)
+                continue
+            try:
+                self._client.call("heartbeat", task_id=self._task_id)
+            except Exception as e:
+                log.warning("heartbeat failed: %s", e)
+
+
+class Executor:
+    def __init__(self) -> None:
+        env = os.environ
+        self.job_name = env[c.ENV_JOB_NAME]
+        self.task_index = int(env[c.ENV_TASK_INDEX])
+        self.task_num = int(env.get(c.ENV_TASK_NUM, "1"))
+        self.num_total_tasks = int(env.get(c.ENV_NUM_TOTAL_TASKS, "1"))
+        self.is_chief = env.get(c.ENV_IS_CHIEF, "false") == "true"
+        self.session_id = int(env.get(c.ENV_SESSION_ID, "0"))
+        self.mode = DistributedMode(env.get(c.ENV_DISTRIBUTED_MODE, "GANG"))
+        self.driver_host = env[c.ENV_DRIVER_HOST]
+        self.driver_port = int(env[c.ENV_DRIVER_PORT])
+        self.app_id = env.get(c.ENV_APP_ID, "")
+        self.job_dir = env.get(c.ENV_JOB_DIR, "")
+        self.command = env.get(c.ENV_TASK_COMMAND, "")
+        self.task_id = f"{self.job_name}:{self.task_index}"
+        self.conf = TonyConf.from_final(self.job_dir) if self.job_dir else TonyConf()
+
+        token = env.get(c.ENV_TOKEN, "")
+        self.rpc = RpcClient(self.driver_host, self.driver_port, token=token,
+                             max_retries=30)
+
+        from .runtimes import get_runtime
+
+        framework = str(self.conf.get(keys.APPLICATION_FRAMEWORK, "jax"))
+        self.adapter = get_runtime(framework).task_adapter()
+
+        # the port this task advertises for its framework's rendezvous: a real
+        # bound socket released just before exec (coordination port for jax,
+        # TF server port for tensorflow, c10d port for worker-0 pytorch)
+        self._port_sock = socket.socket()
+        self._port_sock.bind(("", 0))
+        self.port = self._port_sock.getsockname()[1]
+        self.host = self._my_host()
+
+        self.tb_port: int | None = None
+        self._tb_sock: socket.socket | None = None
+        if self.adapter.need_tb_port() and self.is_chief:
+            self._tb_sock = socket.socket()
+            self._tb_sock.bind(("", 0))
+            self.tb_port = self._tb_sock.getsockname()[1]
+
+    def _my_host(self) -> str:
+        # route-based local address discovery; falls back to loopback for the
+        # single-host mini-cluster
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.connect((self.driver_host, self.driver_port))
+            host = s.getsockname()[0]
+            s.close()
+            return host
+        except OSError:
+            return "127.0.0.1"
+
+    # ---------------------------------------------------------------- barrier
+    def register_and_get_cluster_spec(self) -> dict:
+        """Register, then poll until the gang barrier opens — reference
+        registerAndGetClusterSpec:285-299 (pollTillNonNull on the RPC that
+        returns null until runtime.canStartTask passes)."""
+        self._maybe_skew()
+        poll_s = self.conf.get_int(keys.TASK_REGISTRATION_POLL_MS, 250) / 1000
+        payload = self.rpc.call(
+            "register_worker", task_id=self.task_id, host=self.host, port=self.port
+        )
+        while payload is None:
+            time.sleep(poll_s)
+            payload = self.rpc.call("get_cluster_spec", task_id=self.task_id)
+        return payload
+
+    def _maybe_skew(self) -> None:
+        """TONY_TEST_EXECUTOR_SKEW=job#idx#ms — straggler simulation
+        (reference skewAndHangIfTesting:366-386)."""
+        spec = os.environ.get(c.TEST_EXECUTOR_SKEW, "")
+        if not spec:
+            return
+        try:
+            job, idx, ms = spec.split("#")
+            if job == self.job_name and int(idx) == self.task_index:
+                log.warning("fault injection: skewing registration by %sms", ms)
+                time.sleep(int(ms) / 1000)
+        except ValueError:
+            log.error("bad skew spec: %s", spec)
+
+    # -------------------------------------------------------------------- run
+    def run(self) -> int:
+        if os.environ.get(c.TEST_TASK_EXECUTOR_CRASH):
+            log.error("fault injection: executor crashing before registration")
+            return 3
+
+        hb_interval = self.conf.get_int(keys.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000
+        payload = self.register_and_get_cluster_spec()
+
+        heartbeater = Heartbeater(self.rpc, self.task_id, hb_interval)
+        heartbeater.start()
+        monitor = TaskMonitor(
+            self.rpc, self.task_id,
+            interval_s=self.conf.get_int(keys.TASK_METRICS_INTERVAL_MS, 5000) / 1000,
+        )
+        monitor.start()
+
+        from .runtimes.base import TaskContext
+
+        ctx = TaskContext(
+            job_name=self.job_name,
+            task_index=self.task_index,
+            task_num=self.task_num,
+            num_total_tasks=self.num_total_tasks,
+            is_chief=self.is_chief,
+            command=self.command,
+            cluster_payload=payload,
+            base_child_env=self._base_child_env(),
+            rpc_client=self.rpc,
+            conf=self.conf,
+            tb_port=self.tb_port,
+        )
+        monitor.set_context(ctx)
+
+        # release the advertised port(s) just before the user process starts,
+        # so the framework can bind them (reference release-before-exec dance,
+        # TaskExecutor.java:201-233)
+        self._port_sock.close()
+        if self._tb_sock is not None:
+            self._tb_sock.close()
+
+        timeout_ms = self.conf.get_int(keys.TASK_EXECUTOR_EXECUTION_TIMEOUT_MS, 0)
+        if timeout_ms > 0:
+            killer = threading.Timer(timeout_ms / 1000, self._kill_child, [ctx])
+            killer.daemon = True
+            killer.start()
+
+        try:
+            exit_code = self.adapter.run(ctx)
+        except Exception:
+            log.exception("runtime adapter failed")
+            exit_code = 1
+        finally:
+            heartbeater.stop_event.set()
+            monitor.stop()
+
+        try:
+            self.rpc.call(
+                "register_execution_result", task_id=self.task_id, exit_code=exit_code
+            )
+        except Exception as e:
+            log.warning("could not report result: %s", e)
+        return exit_code
+
+    def _kill_child(self, ctx) -> None:
+        proc = getattr(ctx, "child_process", None)
+        if proc is not None and proc.poll() is None:
+            log.error("execution timeout: killing user process")
+            proc.kill()
+
+    def _base_child_env(self) -> dict[str, str]:
+        return {
+            c.ENV_JOB_NAME: self.job_name,
+            c.ENV_TASK_INDEX: str(self.task_index),
+            c.ENV_TASK_NUM: str(self.task_num),
+            c.ENV_IS_CHIEF: str(self.is_chief).lower(),
+            c.ENV_APP_ID: self.app_id,
+            c.ENV_JOB_DIR: self.job_dir,
+        }
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s executor %(name)s: %(message)s",
+    )
+    # die with the driver: local provisioner kills our process group
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(c.EXIT_KILLED))
+    executor = Executor()
+    code = executor.run()
+    log.info("executor %s exiting with %d", executor.task_id, code)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

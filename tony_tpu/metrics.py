@@ -1,0 +1,153 @@
+"""Executor-side metrics sampler.
+
+Mirrors the reference TaskMonitor (tony-core/.../TaskMonitor.java:34-170):
+a scheduled sampler keeping max + running-average of per-task resource
+metrics, pushed to the driver over the metrics RPC. The reference samples
+process-tree RSS (YARN ResourceCalculatorProcessTree) and GPU
+util/FB-mem/BAR1-mem via nvidia-smi (util/gpu/GpuDiscoverer.java); here we
+sample the user-process-tree RSS from /proc and TPU duty cycle / HBM from
+libtpu metrics when available (cluster/tpu_metrics.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from pathlib import Path
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+MEMORY_RSS = "memory_rss_mb"
+TPU_DUTY_CYCLE = "tpu_duty_cycle_pct"
+TPU_HBM_USED = "tpu_hbm_used_mb"
+
+
+def _proc_tree_rss_mb(root_pid: int) -> float:
+    """Sum RSS over root_pid and its descendants via /proc (the reference uses
+    YARN's ResourceCalculatorProcessTree for the same walk)."""
+    children: dict[int, list[int]] = {}
+    pids = []
+    try:
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            pid = int(entry)
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    fields = f.read().split()
+                ppid = int(fields[3])
+            except (OSError, IndexError, ValueError):
+                continue
+            pids.append(pid)
+            children.setdefault(ppid, []).append(pid)
+    except OSError:
+        return 0.0
+    tree, stack = set(), [root_pid]
+    while stack:
+        pid = stack.pop()
+        if pid in tree:
+            continue
+        tree.add(pid)
+        stack.extend(children.get(pid, []))
+    total_kb = 0
+    for pid in tree:
+        try:
+            with open(f"/proc/{pid}/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        total_kb += int(line.split()[1])
+                        break
+        except (OSError, ValueError):
+            continue
+    return total_kb / 1024.0
+
+
+class MetricsAccumulator:
+    """max + running average per metric — reference
+    TaskMonitor.setAvgMetrics/setMaxMetrics (TaskMonitor.java:101-170)."""
+
+    def __init__(self) -> None:
+        self._count: dict[str, int] = {}
+        self._avg: dict[str, float] = {}
+        self._max: dict[str, float] = {}
+
+    def observe(self, name: str, value: float) -> None:
+        n = self._count.get(name, 0)
+        self._avg[name] = (self._avg.get(name, 0.0) * n + value) / (n + 1)
+        self._count[name] = n + 1
+        self._max[name] = max(self._max.get(name, float("-inf")), value)
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        out = []
+        for name in sorted(self._count):
+            out.append({"name": f"max_{name}", "value": self._max[name]})
+            out.append({"name": f"avg_{name}", "value": round(self._avg[name], 3)})
+        return out
+
+
+class TaskMonitor:
+    def __init__(self, rpc_client, task_id: str, interval_s: float = 5.0):
+        self._rpc = rpc_client
+        self._task_id = task_id
+        self._interval = interval_s
+        self._acc = MetricsAccumulator()
+        self._ctx = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def set_context(self, ctx) -> None:
+        self._ctx = ctx
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="task-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.refresh()
+            except Exception:
+                log.exception("metrics refresh failed")
+
+    def refresh(self) -> None:
+        proc = getattr(self._ctx, "child_process", None) if self._ctx else None
+        root = proc.pid if proc is not None and proc.poll() is None else os.getpid()
+        self._acc.observe(MEMORY_RSS, _proc_tree_rss_mb(root))
+        for name, value in sample_tpu_metrics().items():
+            self._acc.observe(name, value)
+        try:
+            self._rpc.call(
+                "update_metrics", task_id=self._task_id, metrics=self._acc.snapshot()
+            )
+        except Exception as e:
+            log.warning("metrics push failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        # final flush so short tasks still report
+        try:
+            self.refresh()
+        except Exception:
+            pass
+
+
+def sample_tpu_metrics() -> dict[str, float]:
+    """TPU counters via libtpu's monitoring API when the executor host has
+    TPUs attached; {} otherwise. Plays the role of the reference's
+    nvidia-smi XML sampling (util/gpu/GpuDiscoverer.java:41-59) — but reads
+    an in-process API instead of forking a subprocess."""
+    try:
+        from tpu_info import metrics as tpu_metrics  # optional, TPU VMs only
+
+        out = {}
+        usage = tpu_metrics.get_chip_usage()
+        if usage:
+            out[TPU_HBM_USED] = sum(u.memory_usage for u in usage) / 1e6
+            out[TPU_DUTY_CYCLE] = sum(u.duty_cycle_pct for u in usage) / len(usage)
+        return out
+    except Exception:
+        return {}

@@ -1,0 +1,74 @@
+"""Runtime registry.
+
+Reference equivalent: java.util.ServiceLoader over
+META-INF/services/com.linkedin.tony.AbstractFrameworkRuntime keyed by
+``tony.application.framework`` (FrameworkRuntimeProvider.java:30-67,
+TonyConfigurationKeys.FrameworkType). Python entry-point-style registration:
+a dict, extensible at runtime via register_runtime().
+"""
+
+from __future__ import annotations
+
+from .base import DriverAdapter, Runtime, TaskAdapter, TaskContext
+from .generic import (
+    GenericDriverAdapter,
+    GenericTaskAdapter,
+    StandaloneDriverAdapter,
+    StandaloneTaskAdapter,
+)
+from .horovod import HorovodDriverAdapter, HorovodTaskAdapter
+from .jax_runtime import JaxDriverAdapter, JaxTaskAdapter
+from .mxnet import MXNetDriverAdapter, MXNetTaskAdapter
+from .pytorch import PyTorchDriverAdapter, PyTorchTaskAdapter
+from .tensorflow import TFDriverAdapter, TFTaskAdapter
+
+
+class _SimpleRuntime(Runtime):
+    def __init__(self, name: str, driver_cls, task_cls):
+        self.name = name
+        self._driver_cls = driver_cls
+        self._task_cls = task_cls
+
+    def driver_adapter(self) -> DriverAdapter:
+        return self._driver_cls()
+
+    def task_adapter(self) -> TaskAdapter:
+        return self._task_cls()
+
+
+_REGISTRY: dict[str, Runtime] = {}
+
+
+def register_runtime(runtime: Runtime) -> None:
+    _REGISTRY[runtime.name] = runtime
+
+
+for _name, _d, _t in (
+    ("jax", JaxDriverAdapter, JaxTaskAdapter),
+    ("tensorflow", TFDriverAdapter, TFTaskAdapter),
+    ("pytorch", PyTorchDriverAdapter, PyTorchTaskAdapter),
+    ("mxnet", MXNetDriverAdapter, MXNetTaskAdapter),
+    ("horovod", HorovodDriverAdapter, HorovodTaskAdapter),
+    ("standalone", StandaloneDriverAdapter, StandaloneTaskAdapter),
+    ("generic", GenericDriverAdapter, GenericTaskAdapter),
+):
+    register_runtime(_SimpleRuntime(_name, _d, _t))
+
+
+def get_runtime(name: str) -> Runtime:
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown framework runtime {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+__all__ = [
+    "DriverAdapter",
+    "TaskAdapter",
+    "TaskContext",
+    "Runtime",
+    "get_runtime",
+    "register_runtime",
+]

@@ -1,0 +1,234 @@
+"""Horovod runtime adapter: two-phase gang with an injected rendezvous driver.
+
+Mirrors HorovodRuntime.java:87-350 + HorovodDriver.java + horovod_driver.py:
+1. config validation injects an untracked ``driver`` role (validateAndUpdateConfig:210-232)
+2. the driver task starts once all tasks registered; its payload is the worker
+   host list (constructClusterSpec:87-120)
+3. the driver task computes the slot table (rank/local_rank/cross_rank/sizes
+   — the reference delegates to horovod's get_host_assignments; here the same
+   assignment is computed natively, see compute_slot_assignments), starts a
+   Gloo rendezvous server (horovod's if importable, else a stub in test mode),
+   and reports {addr, port, slots} back over register_callback_info
+   (receiveTaskCallbackInfo:161-178)
+4. workers block in can_start_task until the callback lands, then get
+   HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT + per-slot HOROVOD_* env
+   (setHorovodRunEnv:312-350).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Any
+
+from ..api import DistributedMode
+from ..conf import TonyConf, keys
+from .base import TaskContext
+from .generic import GenericDriverAdapter, GenericTaskAdapter
+
+log = logging.getLogger(__name__)
+
+DRIVER_ROLE = "driver"
+HOROVOD_TEST_MODE_KEY = "tony.horovod.mode.test"  # reference HorovodRuntime.java:298-310
+
+
+@dataclass
+class SlotInfo:
+    """Reference horovod/SlotInfo.java."""
+
+    hostname: str
+    rank: int
+    local_rank: int
+    cross_rank: int
+    size: int
+    local_size: int
+    cross_size: int
+
+
+def compute_slot_assignments(host_slots: list[tuple[str, int]]) -> list[SlotInfo]:
+    """Host-major rank assignment identical to horovod's get_host_assignments:
+    rank increments host by host; local_rank is the slot index on its host;
+    cross_rank is the host's position among hosts owning that local_rank."""
+    total = sum(n for _, n in host_slots)
+    slots: list[SlotInfo] = []
+    rank = 0
+    for host_idx, (host, n) in enumerate(host_slots):
+        for local_rank in range(n):
+            cross_hosts = [h for h, m in host_slots if m > local_rank]
+            slots.append(
+                SlotInfo(
+                    hostname=host,
+                    rank=rank,
+                    local_rank=local_rank,
+                    cross_rank=cross_hosts.index(host),
+                    size=total,
+                    local_size=n,
+                    cross_size=len(cross_hosts),
+                )
+            )
+            rank += 1
+    return slots
+
+
+class HorovodDriverAdapter(GenericDriverAdapter):
+    def __init__(self) -> None:
+        super().__init__()
+        self._callback: dict[str, Any] | None = None
+        self._lock = threading.Lock()
+
+    def validate_and_update_config(self, conf: TonyConf) -> None:
+        if conf.get_int(keys.instances_key(DRIVER_ROLE), 0) == 0:
+            conf.set(keys.instances_key(DRIVER_ROLE), 1)
+        untracked = set(conf.get_list(keys.APPLICATION_UNTRACKED_JOBTYPES))
+        untracked.add(DRIVER_ROLE)
+        conf.set(keys.APPLICATION_UNTRACKED_JOBTYPES, ",".join(sorted(untracked)))
+
+    def can_start_task(self, mode: DistributedMode, task_id: str) -> bool:
+        assert self.session is not None
+        if task_id.startswith(DRIVER_ROLE + ":"):
+            # phase 1: rendezvous driver starts when everyone registered
+            return self.session.all_registered()
+        # phase 2: workers wait for the driver's callback
+        with self._lock:
+            return self._callback is not None
+
+    def receive_callback_info(self, task_id: str, payload: dict[str, Any]) -> None:
+        with self._lock:
+            self._callback = payload
+
+    def cluster_spec_payload(self, task_id: str) -> dict[str, Any]:
+        assert self.session is not None
+        payload = super().cluster_spec_payload(task_id)
+        if task_id.startswith(DRIVER_ROLE + ":"):
+            # worker host list with slot counts, e.g. [["h1", 2], ["h2", 1]]
+            counts: dict[str, int] = {}
+            for addr in payload["cluster"].get("worker", []):
+                host = addr.rsplit(":", 1)[0]
+                counts[host] = counts.get(host, 0) + 1
+            payload["worker_hosts"] = sorted(counts.items())
+        else:
+            with self._lock:
+                payload["rendezvous"] = dict(self._callback or {})
+        return payload
+
+
+class _StubRendezvousServer:
+    """Accept-and-hold TCP server standing in for horovod's RendezvousServer
+    when horovod isn't installed (reference test mode,
+    horovod_driver.py:44-65)."""
+
+    def __init__(self) -> None:
+        self._sock = socket.socket()
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+                conn.close()
+            except OSError:
+                return
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+class HorovodTaskAdapter(GenericTaskAdapter):
+    def run(self, ctx: TaskContext) -> int:
+        if ctx.job_name == DRIVER_ROLE:
+            return self._run_rendezvous_driver(ctx)
+        return super().run(ctx)
+
+    # ------------------------------------------------------- driver task path
+    def _run_rendezvous_driver(self, ctx: TaskContext) -> int:
+        host_slots = [tuple(x) for x in ctx.cluster_payload.get("worker_hosts", [])]
+        if not host_slots:
+            log.error("horovod driver got empty worker host list")
+            return 1
+        slots = compute_slot_assignments(host_slots)
+        test_mode = bool(ctx.conf and ctx.conf.get_bool(HOROVOD_TEST_MODE_KEY))
+        port = self._start_rendezvous(host_slots, slots, test_mode)
+        if port < 0:
+            return 1
+        ctx.rpc_client.call(
+            "register_callback_info",
+            task_id=f"{ctx.job_name}:{ctx.task_index}",
+            payload={
+                "addr": socket.gethostbyname(socket.gethostname()),
+                "port": port,
+                "slots": [asdict(s) for s in slots],
+            },
+        )
+        # stay alive while training runs; the driver is untracked so the job
+        # completes without it (reference: driver waitFor ends with rendezvous)
+        while True:
+            time.sleep(3600)
+
+    def _start_rendezvous(self, host_slots, slots, test_mode: bool) -> int:
+        if not test_mode:
+            try:
+                from horovod.runner.common.util.hosts import (
+                    parse_hosts, get_host_assignments,
+                )
+                from horovod.runner.http.http_server import RendezvousServer
+
+                host_str = ",".join(f"{h}:{n}" for h, n in host_slots)
+                hosts = parse_hosts(host_str)
+                assignments = get_host_assignments(hosts, 1)
+                server = RendezvousServer()
+                return server.start()
+            except ImportError:
+                log.warning("horovod not installed; using stub rendezvous server")
+        self._stub = _StubRendezvousServer()
+        return self._stub.port
+
+    # ------------------------------------------------------ worker task path
+    def build_env(self, ctx: TaskContext) -> dict[str, str]:
+        env = super().build_env(ctx)
+        if ctx.job_name == DRIVER_ROLE:
+            return env
+        rdv = ctx.cluster_payload.get("rendezvous", {})
+        slots = [SlotInfo(**s) for s in rdv.get("slots", [])]
+        my_addr = ctx.cluster_spec.get(ctx.job_name, [])
+        my_host = (
+            my_addr[ctx.task_index].rsplit(":", 1)[0]
+            if ctx.task_index < len(my_addr) else ""
+        )
+        slot = self._pick_slot(slots, my_host, ctx)
+        env.update({
+            "HOROVOD_CONTROLLER": "gloo",
+            "HOROVOD_CPU_OPERATIONS": "gloo",
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": str(rdv.get("addr", "")),
+            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rdv.get("port", "")),
+            "HOROVOD_RANK": str(slot.rank),
+            "HOROVOD_SIZE": str(slot.size),
+            "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+            "HOROVOD_LOCAL_SIZE": str(slot.local_size),
+            "HOROVOD_CROSS_RANK": str(slot.cross_rank),
+            "HOROVOD_CROSS_SIZE": str(slot.cross_size),
+            "HOROVOD_HOSTNAME": slot.hostname,
+        })
+        return env
+
+    @staticmethod
+    def _pick_slot(slots: list[SlotInfo], my_host: str, ctx: TaskContext) -> SlotInfo:
+        """Assign this worker a slot on its own host: workers on a host are
+        ordered by task index, slots by local_rank (reference
+        setHorovodRunEnv:312-350)."""
+        if not slots:
+            raise RuntimeError("no horovod slots in rendezvous payload")
+        on_host = [s for s in slots if s.hostname == my_host] or slots
+        peers_before = 0
+        for i, addr in enumerate(ctx.cluster_spec.get(ctx.job_name, [])):
+            if i >= ctx.task_index:
+                break
+            if addr.rsplit(":", 1)[0] == my_host:
+                peers_before += 1
+        return on_host[peers_before % len(on_host)]

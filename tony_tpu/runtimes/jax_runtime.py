@@ -1,0 +1,64 @@
+"""JAX runtime — the primary, TPU-native path.
+
+Replaces the reference's entire TF_CONFIG/Gloo/c10d/DMLC bootstrap matrix with
+one contract (SURVEY.md §5 "distributed communication backend"): the driver
+collects worker registrations, elects the process with global rank 0 as the
+coordinator, and every executor exports
+
+    TONY_COORDINATOR_ADDRESS  host:port of rank 0's pre-bound coordinator port
+    TONY_PROCESS_ID           this process's global rank
+    TONY_NUM_PROCESSES        world size
+
+User code calls ``tony_tpu.init()`` (train/bootstrap.py) which reads these and
+invokes ``jax.distributed.initialize``; collectives then ride ICI within the
+slice and DCN across slices inside XLA. The registered host:port plays the
+role the reference's registerWorkerSpec host:port plays for TF
+(TonySession.getClusterSpec:235-255) — except here the port is a real
+pre-reserved TCP port the coordinator service will bind.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from .. import constants as c
+from .base import TaskContext
+from .generic import GenericDriverAdapter, GenericTaskAdapter
+
+
+class JaxDriverAdapter(GenericDriverAdapter):
+    def cluster_spec_payload(self, task_id: str) -> dict[str, Any]:
+        assert self.session is not None
+        spec = self.session.cluster_spec()
+        payload: dict[str, Any] = {"cluster": spec}
+        ranks: dict[str, int] = {}
+        rank = 0
+        coordinator = None
+        for role in sorted(spec):
+            for i, addr in enumerate(spec[role]):
+                ranks[f"{role}:{i}"] = rank
+                if rank == 0:
+                    coordinator = addr
+                rank += 1
+        payload["ranks"] = ranks
+        payload["num_processes"] = rank
+        payload["coordinator_address"] = coordinator
+        return payload
+
+
+class JaxTaskAdapter(GenericTaskAdapter):
+    def need_tb_port(self) -> bool:
+        return False
+
+    def build_env(self, ctx: TaskContext) -> dict[str, str]:
+        env = super().build_env(ctx)
+        payload = ctx.cluster_payload
+        task_id = f"{ctx.job_name}:{ctx.task_index}"
+        rank = payload.get("ranks", {}).get(task_id, ctx.global_rank())
+        env.update({
+            c.ENV_COORDINATOR_ADDRESS: str(payload.get("coordinator_address") or ""),
+            c.ENV_PROCESS_ID: str(rank),
+            c.ENV_NUM_PROCESSES: str(payload.get("num_processes", ctx.world_size())),
+        })
+        return env

@@ -1,0 +1,41 @@
+"""MXNet runtime adapter: DMLC PS-Lite env.
+
+Mirrors MXNetRuntime.java:43-66 + Utils.parseClusterSpecForMXNet
+(util/Utils.java:618-640): the 'scheduler' task's address becomes
+DMLC_PS_ROOT_URI/PORT for every task; DMLC_ROLE is the task's own role;
+server/worker counts are taken from the cluster spec.
+"""
+
+from __future__ import annotations
+
+import socket
+
+from .base import TaskContext
+from .generic import GenericDriverAdapter, GenericTaskAdapter
+
+
+class MXNetDriverAdapter(GenericDriverAdapter):
+    pass
+
+
+class MXNetTaskAdapter(GenericTaskAdapter):
+    def build_env(self, ctx: TaskContext) -> dict[str, str]:
+        env = super().build_env(ctx)
+        spec = ctx.cluster_spec
+        scheduler = spec.get("scheduler", [])
+        if not scheduler:
+            raise RuntimeError("mxnet runtime requires a 'scheduler' role")
+        host, port = scheduler[0].rsplit(":", 1)
+        try:
+            # reference resolves hostname -> IP (Utils.java:618-640)
+            host_ip = socket.gethostbyname(host)
+        except OSError:
+            host_ip = host
+        env.update({
+            "DMLC_ROLE": ctx.job_name,
+            "DMLC_PS_ROOT_URI": host_ip,
+            "DMLC_PS_ROOT_PORT": port,
+            "DMLC_NUM_SERVER": str(len(spec.get("server", []))),
+            "DMLC_NUM_WORKER": str(len(spec.get("worker", []))),
+        })
+        return env

@@ -1,0 +1,33 @@
+"""PyTorch runtime adapter: c10d TCP rendezvous env.
+
+Mirrors PyTorchRuntime.java:44-56 + Utils.parseClusterSpecForPytorch
+(util/Utils.java:606-616): worker 0 is the rendezvous host; every task gets
+INIT_METHOD=tcp://<worker0>, RANK, WORLD. Gradient allreduce stays inside
+torch.distributed (Gloo on CPU hosts — NCCL has no TPU role).
+"""
+
+from __future__ import annotations
+
+from .base import TaskContext
+from .generic import GenericDriverAdapter, GenericTaskAdapter
+
+
+class PyTorchDriverAdapter(GenericDriverAdapter):
+    pass
+
+
+class PyTorchTaskAdapter(GenericTaskAdapter):
+    def build_env(self, ctx: TaskContext) -> dict[str, str]:
+        env = super().build_env(ctx)
+        workers = ctx.cluster_spec.get("worker", [])
+        if not workers:
+            raise RuntimeError("pytorch runtime requires a 'worker' role")
+        env["INIT_METHOD"] = f"tcp://{workers[0]}"
+        env["RANK"] = str(ctx.global_rank())
+        env["WORLD"] = str(ctx.world_size())
+        # torchrun-style aliases for modern scripts
+        master_host, master_port = workers[0].rsplit(":", 1)
+        env["MASTER_ADDR"] = master_host
+        env["MASTER_PORT"] = master_port
+        env["WORLD_SIZE"] = env["WORLD"]
+        return env

@@ -1,0 +1,43 @@
+"""TensorFlow runtime adapter: CLUSTER_SPEC + TF_CONFIG env.
+
+Mirrors TFRuntime.java:45-58 and Utils.constructTFConfig (util/Utils.java:
+503-520): TF_CONFIG = {"cluster": {role: [addrs]}, "task": {"type", "index"}}
+with the sidecar/eval roles (tensorboard) excluded from the cluster dict so
+estimator-style code doesn't wait on them.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .base import TaskContext
+from .generic import GenericDriverAdapter, GenericTaskAdapter
+
+# roles never included in TF cluster spec (reference filters evaluator/
+# tensorboard when building TF_CONFIG's cluster dict)
+_EXCLUDED_FROM_CLUSTER = ("tensorboard",)
+
+
+class TFDriverAdapter(GenericDriverAdapter):
+    pass
+
+
+class TFTaskAdapter(GenericTaskAdapter):
+    def need_tb_port(self) -> bool:
+        return True
+
+    def build_env(self, ctx: TaskContext) -> dict[str, str]:
+        env = super().build_env(ctx)
+        cluster = {
+            role: addrs
+            for role, addrs in ctx.cluster_spec.items()
+            if role not in _EXCLUDED_FROM_CLUSTER
+        }
+        tf_config = {
+            "cluster": cluster,
+            "task": {"type": ctx.job_name, "index": ctx.task_index},
+        }
+        env["TF_CONFIG"] = json.dumps(tf_config)
+        env["JOB_NAME"] = ctx.job_name
+        env["TASK_INDEX"] = str(ctx.task_index)
+        return env
