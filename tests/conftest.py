@@ -7,7 +7,7 @@ import os
 import sys
 from pathlib import Path
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,6 +17,13 @@ if "xla_force_host_platform_device_count" not in flags:
 REPO_ROOT = Path(__file__).resolve().parent.parent
 if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
+
+import jax  # noqa: E402
+
+# this environment's sitecustomize registers the axon TPU plugin and forces
+# jax_platforms="axon,cpu" via jax.config, which overrides the env var —
+# override it back before any backend initialization
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,43 @@
+"""First-class parallelism library: mesh, shardings, ring attention,
+pipelining, expert parallelism.
+
+This layer has no reference counterpart — TonY orchestrates external
+frameworks' data parallelism only (SURVEY.md §2.3); here every strategy is a
+mesh axis + sharding rules + (where needed) a shard_map program, and XLA
+emits the collectives over ICI/DCN.
+"""
+
+from .mesh import (
+    AXIS_ORDER,
+    MeshSpec,
+    build_mesh,
+    mesh_from_string,
+    slice_topology,
+)
+from .sharding import (
+    DP_RULES,
+    EP_RULES,
+    FSDP_RULES,
+    FSDP_TP_RULES,
+    SP_RULES,
+    TP_RULES,
+    batch_sharding,
+    logical_to_spec,
+    merge_rules,
+    shard_params,
+    sharding_for,
+    tree_shardings,
+)
+from .ring_attention import make_ring_attention, reference_attention, ring_attention
+from .pipeline import make_pipeline, stack_stage_params
+from .expert import load_balancing_loss, moe_ffn, top_k_routing
+
+__all__ = [
+    "AXIS_ORDER", "MeshSpec", "build_mesh", "mesh_from_string", "slice_topology",
+    "DP_RULES", "FSDP_RULES", "TP_RULES", "FSDP_TP_RULES", "SP_RULES", "EP_RULES",
+    "merge_rules", "logical_to_spec", "sharding_for", "tree_shardings",
+    "shard_params", "batch_sharding",
+    "make_ring_attention", "reference_attention", "ring_attention",
+    "make_pipeline", "stack_stage_params",
+    "moe_ffn", "top_k_routing", "load_balancing_loss",
+]

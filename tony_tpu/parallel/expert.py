@@ -1,0 +1,94 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` mesh axis.
+
+Einsum-dispatch MoE (Switch/GShard style): top-k router produces dispatch and
+combine tensors; expert FFN weights carry a leading expert dim sharded over
+the ``expert`` axis, so XLA lowers the dispatch/combine einsums to all_to_all
+over ICI. No manual collectives — the sharding annotations are the program.
+
+Capacity-factor token dropping keeps shapes static for the compiler (a
+data-dependent gather would break XLA tiling); dropped tokens pass through on
+the residual stream as usual.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_routing(
+    router_logits: jax.Array,  # [tokens, n_experts]
+    k: int,
+    capacity: int,
+):
+    """Returns (dispatch [T, E, C] bool-ish float, combine [T, E, C] float).
+
+    Greedy position assignment: tokens claim expert capacity slots in order;
+    tokens over capacity are dropped (combine weight 0).
+    """
+    t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)          # [T, k]
+
+    # normalize the k gates per token
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    dispatch = jnp.zeros((t, e, capacity), dtype=probs.dtype)
+    combine = jnp.zeros((t, e, capacity), dtype=probs.dtype)
+
+    # a token's position in its expert's queue = claims on that expert from
+    # earlier slot-rounds + earlier tokens within this round
+    for slot in range(k):
+        idx = gate_idx[:, slot]                              # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=probs.dtype)   # [T, E]
+        prior_per_expert = dispatch.sum(axis=(0, 2))         # [E]
+        pos_within = jnp.cumsum(onehot, axis=0) - onehot     # [T, E]
+        my_pos = jnp.einsum(
+            "te,te->t", pos_within + prior_per_expert[None, :], onehot
+        ).astype(jnp.int32)                                  # [T]
+        keep = my_pos < capacity
+        pos_oh = jax.nn.one_hot(
+            jnp.where(keep, my_pos, capacity), capacity, dtype=probs.dtype
+        )                                                    # [T, C]; dropped -> zero row
+        claim = onehot[:, :, None] * pos_oh[:, None, :]      # [T, E, C]
+        dispatch = dispatch + claim
+        combine = combine + claim * gate_vals[:, slot][:, None, None]
+    return dispatch, combine
+
+
+def moe_ffn(
+    x: jax.Array,            # [tokens, d_model]
+    router_w: jax.Array,     # [d_model, n_experts]
+    w_in: jax.Array,         # [n_experts, d_model, d_ff]
+    w_out: jax.Array,        # [n_experts, d_ff, d_model]
+    k: int = 2,
+    capacity_factor: float = 1.25,
+    activation: Callable = jax.nn.gelu,
+):
+    """Dense-dispatch MoE FFN. With w_in/w_out sharded P('expert', ...) and x
+    batch-sharded, XLA inserts the token all_to_all automatically."""
+    t, d = x.shape
+    e = router_w.shape[1]
+    capacity = max(1, int(capacity_factor * t * k / e))
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    dispatch, combine = top_k_routing(logits, k, capacity)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+
+    xs = jnp.einsum("td,tec->ecd", x, dispatch)            # [E, C, d]
+    h = activation(jnp.einsum("ecd,edf->ecf", xs, w_in))
+    ys = jnp.einsum("ecf,efd->ecd", h, w_out)              # [E, C, d]
+    return jnp.einsum("ecd,tec->td", ys, combine)
+
+
+def load_balancing_loss(router_logits: jax.Array, k: int = 2) -> jax.Array:
+    """Switch-transformer aux loss: E * dot(fraction_tokens, fraction_probs)."""
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    e = probs.shape[-1]
+    _, idx = jax.lax.top_k(probs, k)
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32).sum(axis=-2)  # [T, E]
+    tokens_frac = onehot.mean(axis=0) / k
+    probs_frac = probs.mean(axis=0)
+    return e * jnp.sum(tokens_frac * probs_frac)

@@ -1,0 +1,127 @@
+"""Device-mesh construction from TPU topology.
+
+The reference has no analogue — TonY delegates all parallelism to the user's
+framework (SURVEY.md §2.3: TP/PP/SP/EP "ABSENT from the reference"). Here the
+mesh is the framework's core abstraction: every parallelism strategy is an
+axis of one `jax.sharding.Mesh`, and XLA inserts the collectives (psum /
+all_gather / reduce_scatter / ppermute) that ride ICI within a slice and DCN
+across slices.
+
+Axis convention (outer -> inner, slowest -> fastest varying):
+    pipe   pipeline stages          (ppermute activations)
+    data   pure data parallel       (gradient psum, across slices / DCN-safe)
+    fsdp   data parallel + sharded params (all_gather params, reduce_scatter grads)
+    seq    sequence/context parallel (ring attention ppermute — wants ICI ring)
+    expert MoE expert parallel      (all_to_all token dispatch)
+    tensor tensor/model parallel    (activation psum — innermost: highest
+                                      bandwidth need, maps to the minor ICI axis)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("pipe", "data", "fsdp", "seq", "expert", "tensor")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Requested parallelism degrees. -1 on at most one axis means 'absorb
+    all remaining devices'. Unspecified axes default to 1."""
+
+    pipe: int = 1
+    data: int = 1
+    fsdp: int = -1
+    seq: int = 1
+    expert: int = 1
+    tensor: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = self.sizes()
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"axis product {fixed} != device count {n_devices}"
+            )
+        return sizes
+
+
+def build_mesh(
+    spec: MeshSpec | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a Mesh whose minor axes map to physically-close devices.
+
+    ``jax.devices()`` orders a TPU slice so that consecutive devices are
+    ICI neighbors (row-major over the physical torus); keeping `tensor` as
+    the fastest-varying mesh axis therefore places tensor-parallel groups on
+    directly-wired chips, `seq` ring neighbors adjacent, and `data`/`pipe`
+    groups across the slower dimensions — the layout the scaling playbook
+    prescribes (collectives ride ICI, not DCN).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    spec = spec or MeshSpec()
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    arr = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def mesh_from_string(desc: str, devices: list | None = None) -> Mesh:
+    """Parse 'data=2,tensor=4' / 'fsdp=-1,tensor=2' into a mesh."""
+    kwargs: dict[str, int] = {}
+    for part in desc.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        if k not in AXIS_ORDER:
+            raise ValueError(f"unknown mesh axis {k!r}; valid: {AXIS_ORDER}")
+        kwargs[k] = int(v)
+    # default fsdp to 1 unless caller asked for something
+    if "fsdp" not in kwargs:
+        kwargs["fsdp"] = 1
+    wilds = [k for k, v in kwargs.items() if v == -1]
+    if not wilds and "data" not in kwargs:
+        kwargs["data"] = -1  # absorb the remainder into data parallelism
+    return build_mesh(MeshSpec(**kwargs), devices)
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh(MeshSpec(fsdp=1), devices=jax.devices()[:1])
+
+
+def slice_topology() -> dict:
+    """Discover TPU slice topology — the analogue of the reference's GPU
+    discovery (util/gpu/GpuDiscoverer.java:41-59), reading JAX/libtpu device
+    attributes instead of forking nvidia-smi."""
+    devs = jax.devices()
+    info: dict = {
+        "num_devices": len(devs),
+        "num_local_devices": jax.local_device_count(),
+        "num_hosts": jax.process_count(),
+        "platform": devs[0].platform if devs else "none",
+        "device_kind": devs[0].device_kind if devs else "none",
+    }
+    coords = [getattr(d, "coords", None) for d in devs]
+    if all(c is not None for c in coords) and coords:
+        dims = [max(c[i] for c in coords) + 1 for i in range(len(coords[0]))]
+        info["physical_topology"] = "x".join(str(d) for d in dims)
+    return info

@@ -1,0 +1,118 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis.
+
+GPipe-style microbatch schedule expressed as a single shard_map program:
+layer parameters are stacked [n_stages, ...] and sharded over ``pipe``; each
+device applies its stage and passes activations to the next stage with
+``lax.ppermute`` each tick. The whole schedule is one `lax.scan`, so XLA sees
+static control flow (no data-dependent Python) and can overlap the ppermute
+with stage compute. Bubble fraction is (S-1)/(M+S-1) for S stages and M
+microbatches, as usual for GPipe.
+
+The reference cannot express any of this (SURVEY.md §2.3) — pipelining here
+is a first-class library feature, not an orchestration concern.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+StageFn = Callable[[Any, jax.Array], jax.Array]  # (stage_params, x) -> y
+
+
+def _pipeline_local(
+    stage_fn: StageFn,
+    stage_params: Any,
+    microbatches: jax.Array,  # [M, mb, ...] identical on every device
+    axis_name: str,
+) -> jax.Array:
+    """Runs on one device inside shard_map; stage_params is this device's
+    stage slice (leading stage dim of size 1, squeezed)."""
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    total = m + n - 1
+    mb_shape = microbatches.shape[1:]
+
+    params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), stage_params)
+
+    def tick(carry, t):
+        inbox, outputs = carry
+        # stage 0 feeds itself from the microbatch stream; other stages read
+        # their inbox (written by the previous stage last tick)
+        feed = microbatches[jnp.minimum(t, m - 1)]
+        x = jnp.where(me == 0, feed, inbox)
+        y = stage_fn(params, x)
+        # last stage records its result at slot t - (n - 1)
+        slot = t - (n - 1)
+        valid = (slot >= 0) & (me == n - 1)
+        outputs = lax.cond(
+            valid,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(slot, 0), axis=0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        # pass activations forward around the ring (stage i -> i+1; the wrap
+        # edge n-1 -> 0 carries garbage that stage 0 ignores)
+        inbox_next = lax.ppermute(
+            y, axis_name, [(i, (i + 1) % n) for i in range(n)]
+        )
+        return (inbox_next, outputs), None
+
+    inbox0 = jnp.zeros(mb_shape, microbatches.dtype)
+    outputs0 = jnp.zeros((m,) + mb_shape, microbatches.dtype)
+    (_, outputs), _ = lax.scan(tick, (inbox0, outputs0), jnp.arange(total))
+    # only stage n-1 holds real outputs; broadcast via masked psum so the
+    # shard_map output is replicated across the pipe axis
+    outputs = lax.psum(
+        jnp.where(me == n - 1, outputs, jnp.zeros_like(outputs)), axis_name
+    )
+    return outputs
+
+
+def make_pipeline(
+    mesh: Mesh,
+    stage_fn: StageFn,
+    num_microbatches: int,
+    axis_name: str = "pipe",
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Returns pipeline_apply(stacked_params, batch) -> batch.
+
+    stacked_params: pytree with leading dim n_stages on every leaf, sharded
+    over `axis_name`. batch: [B, ...] replicated w.r.t. `axis_name`; B must
+    divide into num_microbatches.
+    """
+    n_stages = mesh.shape[axis_name]
+
+    def apply(stacked_params: Any, batch: jax.Array) -> jax.Array:
+        b = batch.shape[0]
+        if b % num_microbatches:
+            raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
+        mb = b // num_microbatches
+        micro = batch.reshape((num_microbatches, mb) + batch.shape[1:])
+
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+        fn = shard_map(
+            functools.partial(_pipeline_local, stage_fn, axis_name=axis_name),
+            mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        out = fn(stacked_params, micro)
+        return out.reshape((b,) + out.shape[2:])
+
+    return apply
+
+
+def stack_stage_params(per_stage_params: list[Any]) -> Any:
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)

@@ -1,0 +1,139 @@
+"""Ring attention: exact attention over sequence-sharded activations.
+
+Long-context capability the reference cannot express (SURVEY.md §5
+"long-context/sequence parallelism: absent"). Q/K/V are sharded along the
+sequence over the ``seq`` mesh axis; K/V blocks circulate the ring via
+``lax.ppermute`` (neighbor exchange -> rides ICI) while each device folds
+every block into its local queries with streaming flash-style softmax
+accumulation, so the full L x L score matrix never materializes and per-device
+memory stays O(L/n). Compute for step t overlaps with the ppermute of step
+t+1 under XLA's async collectives.
+
+Shapes follow the JAX attention convention: [batch, seq, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """Scores + masked stable partial softmax for one (q-block, kv-block)
+    pair; returns (m, l, o) partials in f32."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                      # [b,h,q]
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        # fully-masked rows: keep exp at 0, m at NEG_INF handled by caller
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)                      # [b,h,q]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Call inside shard_map with q/k/v sequence-sharded over `axis_name`.
+
+    Every device runs `n` steps; at step t it holds the K/V block that
+    started on device (me - t) mod n, so global causal masking reduces to a
+    comparison of block indices plus an intra-block triangular mask when the
+    block is its own.
+    """
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    scale = (d ** -0.5) if scale is None else scale
+    q32 = q.astype(jnp.float32)
+
+    # intra-block causal mask (positions are block-local; global offsets equal
+    # for q and kv when the block is the device's own)
+    tri = jnp.tril(jnp.ones((lq, k.shape[1]), dtype=bool))[None, None]
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, o = carry
+        src = (me - t) % n  # original owner of the circulating block
+
+        if causal:
+            # src <  me: fully visible;  src == me: triangular;  src > me: hidden
+            full = jnp.broadcast_to(src < me, tri.shape)
+            diag = jnp.broadcast_to(src == me, tri.shape) & tri
+            mask = full | diag
+        else:
+            mask = None
+
+        bm, bl, bo = _block_attn(q32, k_blk, v_blk, scale, mask)
+        m_new = jnp.maximum(m, bm)
+        corr = jnp.exp(m - m_new)
+        bcorr = jnp.exp(bm - m_new)
+        l_new = l * corr + bl * bcorr
+        o_new = o * corr[..., None].transpose(0, 2, 1, 3) \
+            + bo * bcorr[..., None].transpose(0, 2, 1, 3)
+        # rotate K/V to the next neighbor (ring over ICI)
+        k_nxt = lax.ppermute(k_blk, axis_name, [(i, (i + 1) % n) for i in range(n)])
+        v_nxt = lax.ppermute(v_blk, axis_name, [(i, (i + 1) % n) for i in range(n)])
+        return (k_nxt, v_nxt, m_new, l_new, o_new), None
+
+    m0 = jnp.full((b, h, lq), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, lq), dtype=jnp.float32)
+    o0 = jnp.zeros((b, lq, h, d), dtype=jnp.float32)
+    (_, _, m, l, o), _ = lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(n)
+    )
+    # normalize; fully-masked rows (l==0) return 0
+    l_safe = jnp.where(l > 0, l, 1.0)
+    out = o / l_safe[..., None].transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    axis_name: str = "seq",
+    causal: bool = True,
+) -> Callable:
+    """shard_map-wrapped ring attention: takes globally-shaped [B,L,H,D]
+    arrays sequence-sharded over `axis_name`, returns same."""
+    spec = P(None, axis_name, None, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def _fn(q, k, v):
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+    return _fn
+
+
+def reference_attention(q, k, v, causal: bool = True, scale: float | None = None):
+    """Plain full attention (for tests and the no-SP path)."""
+    d = q.shape[-1]
+    scale = (d ** -0.5) if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((lq, lk), dtype=bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)

@@ -1,0 +1,106 @@
+"""Logical-axis sharding rules: name model dimensions once, map them to mesh
+axes per parallelism strategy.
+
+Model code annotates arrays with logical axis names ("batch", "embed",
+"mlp", "heads", "kv", "vocab", "layers", "expert", "seq"); a rule table maps
+logical -> mesh axes. Switching DP -> FSDP -> TP -> combinations is a rule
+-table change, not a model change — the pjit recipe from the scaling book.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical dim -> mesh axis (or tuple of axes, or None=replicated)
+Rules = dict[str, Any]
+
+# Baseline rule tables. "batch" over (data, fsdp): pure-DP and FSDP groups
+# both consume the batch; params sharded over fsdp (ZeRO-3-style) and/or
+# tensor (Megatron-style).
+DP_RULES: Rules = {
+    "batch": ("data", "fsdp"),
+    "seq": None, "embed": None, "mlp": None, "heads": None,
+    "kv": None, "vocab": None, "layers": None, "expert": None,
+    "expert_group": None,
+}
+
+FSDP_RULES: Rules = {
+    **DP_RULES,
+    "embed": "fsdp",      # params sharded along embed over the fsdp axis
+}
+
+TP_RULES: Rules = {
+    **DP_RULES,
+    "mlp": "tensor",      # MLP hidden dim
+    "heads": "tensor",    # attention heads
+    "vocab": "tensor",    # embedding/unembedding vocab dim
+}
+
+FSDP_TP_RULES: Rules = {
+    **TP_RULES,
+    "embed": "fsdp",
+}
+
+SP_RULES: Rules = {
+    # context parallelism: activations sharded along sequence; used with
+    # ring attention (parallel/ring_attention.py)
+    "seq": "seq",
+}
+
+EP_RULES: Rules = {
+    "expert": "expert",
+}
+
+
+def merge_rules(*tables: Rules) -> Rules:
+    out: Rules = {}
+    for t in tables:
+        out.update(t)
+    return out
+
+
+def logical_to_spec(logical_axes: Sequence[str | None], rules: Rules) -> P:
+    """('batch','seq','embed') + rules -> PartitionSpec."""
+    parts = []
+    for name in logical_axes:
+        if name is None:
+            parts.append(None)
+        else:
+            parts.append(rules.get(name))
+    # trailing Nones are implicit
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
+
+
+def sharding_for(
+    mesh: Mesh, logical_axes: Sequence[str | None], rules: Rules
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+
+
+def tree_shardings(mesh: Mesh, logical_tree: Any, rules: Rules) -> Any:
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    return jax.tree.map(
+        lambda axes: sharding_for(mesh, axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def shard_params(mesh: Mesh, params: Any, logical_tree: Any, rules: Rules) -> Any:
+    """Device_put a parameter pytree according to its logical axes."""
+    shardings = tree_shardings(mesh, logical_tree, rules)
+    return jax.device_put(params, shardings)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, rules: Rules) -> NamedSharding:
+    """Sharding for (batch, ...) input arrays."""
+    return NamedSharding(mesh, logical_to_spec(("batch",), rules))
