@@ -1,0 +1,5 @@
+"""TPU kernels and fused ops (Pallas where it pays, XLA fusion elsewhere)."""
+
+from .attention import attention_blhd, flash_attention
+
+__all__ = ["flash_attention", "attention_blhd"]
