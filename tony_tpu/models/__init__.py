@@ -1,0 +1,15 @@
+"""Model zoo: flagship transformer (dense + MoE) and the mnist parity model."""
+
+from .transformer import (
+    TransformerConfig,
+    apply,
+    init,
+    loss_fn,
+    num_params,
+    param_logical_axes,
+)
+
+__all__ = [
+    "TransformerConfig", "init", "apply", "loss_fn", "param_logical_axes",
+    "num_params",
+]

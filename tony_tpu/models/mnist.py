@@ -1,0 +1,55 @@
+"""MNIST models + synthetic data — the parity workload.
+
+The reference's flagship examples are mnist-tensorflow / mnist-pytorch
+(tony-examples/mnist-tensorflow/mnist_distributed.py, BASELINE.md configs);
+here the same workload is a JAX model trained data-parallel through the
+tony_tpu orchestrator + parallelism library. Synthetic data keeps the bench
+hermetic (zero-egress environment — no dataset download).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(key, sizes=(784, 512, 512, 10), dtype=jnp.float32):
+    params = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        k1, key = jax.random.split(key)
+        params.append({
+            "w": (jax.random.normal(k1, (fan_in, fan_out)) * fan_in ** -0.5).astype(dtype),
+            "b": jnp.zeros((fan_out,), dtype),
+        })
+    return params
+
+
+def mlp_apply(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_logical_axes(params):
+    return [{"w": ("embed", "mlp"), "b": ("mlp",)} for _ in params]
+
+
+def loss_fn(params, x, y):
+    logits = mlp_apply(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+
+
+def accuracy(params, x, y):
+    return jnp.mean(jnp.argmax(mlp_apply(params, x), axis=-1) == y)
+
+
+def synthetic_mnist(key, n=60000):
+    """Class-conditional Gaussian blobs in 784-d: learnable, hermetic."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    y = jax.random.randint(k1, (n,), 0, 10)
+    centers = jax.random.normal(k2, (10, 784)) * 2.0
+    x = centers[y] + jax.random.normal(k3, (n, 784))
+    return x.astype(jnp.float32), y.astype(jnp.int32)

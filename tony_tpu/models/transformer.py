@@ -1,0 +1,254 @@
+"""Flagship decoder-only transformer, TPU-first.
+
+Design choices driven by the hardware (SURVEY.md §7):
+- all heavy math is batched matmuls in bf16 -> MXU; params kept in f32
+- layers are stacked and iterated with `lax.scan` (one trace, fast compile,
+  params carry a leading "layers" logical axis)
+- attention is pluggable: fused Pallas flash kernel (ops/attention.py) on a
+  single device's sequence, or ring attention (parallel/ring_attention.py)
+  when the sequence is sharded over the `seq` mesh axis
+- optional MoE MLP (parallel/expert.py) with experts sharded over `expert`
+- every parameter carries logical axes so DP/FSDP/TP/EP placement is a
+  rule-table choice (parallel/sharding.py), not a model edit
+- `jax.checkpoint` on the layer body trades FLOPs for HBM when remat=True
+
+Plain functional style: params are a pytree, `init` builds them,
+`param_logical_axes` mirrors the tree with logical-axis tuples, `apply` is a
+pure function ready for jit/grad.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.expert import load_balancing_loss, moe_ffn
+from ..parallel.ring_attention import reference_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8           # < n_heads => GQA
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16     # activation dtype
+    param_dtype: Any = jnp.float32
+    # MoE: n_experts=0 => dense SwiGLU MLP everywhere
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    # attention implementation: "flash" (pallas), "ref" (XLA), "ring"
+    # (sequence-parallel over the `seq` mesh axis), or "auto"
+    attn_impl: str = "auto"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# ------------------------------------------------------------------ building
+
+def _dense_init(key, shape, in_axis_size, dtype):
+    scale = in_axis_size ** -0.5
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def init(key: jax.Array, cfg: TransformerConfig) -> dict:
+    """Build the parameter pytree. Layer params are stacked [n_layers, ...]."""
+    pd = cfg.param_dtype
+    hd = cfg.head_dim
+    keys = iter(jax.random.split(key, 16))
+
+    def layer_stack(shape, in_size):
+        k = next(keys)
+        return _dense_init(k, (cfg.n_layers,) + shape, in_size, pd)
+
+    params: dict = {
+        "embed": _dense_init(next(keys), (cfg.vocab_size, cfg.d_model), cfg.d_model, pd),
+        "layers": {
+            "attn_norm": jnp.ones((cfg.n_layers, cfg.d_model), pd),
+            "wq": layer_stack((cfg.d_model, cfg.n_heads, hd), cfg.d_model),
+            "wk": layer_stack((cfg.d_model, cfg.n_kv_heads, hd), cfg.d_model),
+            "wv": layer_stack((cfg.d_model, cfg.n_kv_heads, hd), cfg.d_model),
+            "wo": layer_stack((cfg.n_heads, hd, cfg.d_model), cfg.n_heads * hd),
+            "mlp_norm": jnp.ones((cfg.n_layers, cfg.d_model), pd),
+        },
+        "final_norm": jnp.ones((cfg.d_model,), pd),
+        "unembed": _dense_init(next(keys), (cfg.d_model, cfg.vocab_size), cfg.d_model, pd),
+    }
+    if cfg.n_experts > 0:
+        params["layers"].update({
+            "router": layer_stack((cfg.d_model, cfg.n_experts), cfg.d_model),
+            "w_in": layer_stack((cfg.n_experts, cfg.d_model, cfg.d_ff), cfg.d_model),
+            "w_out": layer_stack((cfg.n_experts, cfg.d_ff, cfg.d_model), cfg.d_ff),
+        })
+    else:
+        params["layers"].update({
+            "w_gate": layer_stack((cfg.d_model, cfg.d_ff), cfg.d_model),
+            "w_up": layer_stack((cfg.d_model, cfg.d_ff), cfg.d_model),
+            "w_down": layer_stack((cfg.d_ff, cfg.d_model), cfg.d_ff),
+        })
+    return params
+
+
+def param_logical_axes(cfg: TransformerConfig) -> dict:
+    """Mirror of init()'s tree with logical-axis tuples for
+    parallel/sharding.py rule tables."""
+    layers: dict = {
+        "attn_norm": ("layers", None),
+        "wq": ("layers", "embed", "heads", None),
+        "wk": ("layers", "embed", "kv", None),
+        "wv": ("layers", "embed", "kv", None),
+        "wo": ("layers", "heads", None, "embed"),
+        "mlp_norm": ("layers", None),
+    }
+    if cfg.n_experts > 0:
+        layers.update({
+            "router": ("layers", "embed", None),
+            "w_in": ("layers", "expert", "embed", "mlp"),
+            "w_out": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        layers.update({
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        })
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": (None,),
+        "unembed": ("embed", "vocab"),
+    }
+
+
+# ------------------------------------------------------------------- pieces
+
+def rms_norm(x, weight, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
+
+
+def rope(x, positions, theta):
+    """Rotary position embedding; x: [B, L, H, D]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, L, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: TransformerConfig, mesh):
+    """[B, L, H, D] in/out; dispatch on attn_impl."""
+    impl = cfg.attn_impl
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() in ("tpu", "axon") else "ref"
+    if impl == "flash":
+        from ..ops.attention import attention_blhd
+
+        return attention_blhd(q, k, v, causal=True)
+    if impl == "ring":
+        if mesh is None:
+            raise ValueError("attn_impl='ring' requires a mesh")
+        from ..parallel.ring_attention import make_ring_attention
+
+        return make_ring_attention(mesh, causal=True)(q, k, v)
+    return reference_attention(q, k, v, causal=True)
+
+
+def _layer(cfg: TransformerConfig, mesh, x, positions, lp):
+    """One decoder block; lp = this layer's params (stack dim removed)."""
+    dt = cfg.dtype
+    h = rms_norm(x, lp["attn_norm"])
+    q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
+    k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
+    v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    if cfg.n_kv_heads != cfg.n_heads:
+        rep = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    attn = _attention(q, k, v, cfg, mesh)
+    x = x + jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
+
+    h = rms_norm(x, lp["mlp_norm"])
+    aux = jnp.float32(0)
+    if cfg.n_experts > 0:
+        b, l, d = h.shape
+        flat = h.reshape(b * l, d)
+        router_logits = flat.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
+        out = moe_ffn(
+            flat, lp["router"].astype(dt), lp["w_in"].astype(dt),
+            lp["w_out"].astype(dt), k=cfg.expert_top_k,
+            capacity_factor=cfg.capacity_factor, activation=jax.nn.silu,
+        )
+        aux = load_balancing_loss(router_logits, cfg.expert_top_k)
+        mlp_out = out.reshape(b, l, d)
+    else:
+        gate = jax.nn.silu(jnp.einsum("bld,df->blf", h, lp["w_gate"].astype(dt)))
+        up = jnp.einsum("bld,df->blf", h, lp["w_up"].astype(dt))
+        mlp_out = jnp.einsum("blf,fd->bld", gate * up, lp["w_down"].astype(dt))
+    return x + mlp_out, aux
+
+
+def apply(
+    params: dict,
+    tokens: jax.Array,          # [B, L] int32
+    cfg: TransformerConfig,
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Forward pass -> (logits [B, L, V] f32, aux_loss scalar)."""
+    dt = cfg.dtype
+    b, l = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(l), (b, l))
+    x = params["embed"].astype(dt)[tokens]
+
+    layer_fn = functools.partial(_layer, cfg, mesh)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(carry, lp):
+        x = carry
+        x, aux = layer_fn(x, positions, lp)
+        return x, aux
+
+    x, auxes = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "bld,dv->blv", x, params["unembed"].astype(dt)
+    ).astype(jnp.float32)
+    return logits, jnp.sum(auxes) * cfg.aux_loss_weight
+
+
+def loss_fn(params, tokens, targets, cfg: TransformerConfig, mesh=None):
+    """Next-token cross entropy (+ MoE aux); targets [B, L] with -1 = pad."""
+    logits, aux = apply(params, tokens, cfg, mesh)
+    valid = targets >= 0
+    safe_targets = jnp.where(valid, targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(valid.sum(), 1)
+    return (nll * valid).sum() / denom + aux
+
+
+def num_params(params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

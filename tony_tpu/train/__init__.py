@@ -1,0 +1,16 @@
+"""Training layer: distributed bootstrap, sharded train step, checkpointing."""
+
+from .bootstrap import init, task_info
+from .step import (
+    TrainStepBundle,
+    create_train_step,
+    make_forward,
+    make_optimizer,
+    synthetic_lm_batch,
+)
+
+__all__ = [
+    "init", "task_info",
+    "TrainStepBundle", "create_train_step", "make_forward", "make_optimizer",
+    "synthetic_lm_batch",
+]

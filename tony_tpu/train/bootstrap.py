@@ -1,0 +1,64 @@
+"""Worker-side distributed bootstrap.
+
+The user-facing half of the JAX runtime contract
+(runtimes/jax_runtime.py): the executor exports TONY_COORDINATOR_ADDRESS /
+TONY_PROCESS_ID / TONY_NUM_PROCESSES, and training code calls
+``tony_tpu.train.init()`` to join the job. This one call replaces the entire
+per-framework bootstrap matrix of the reference (TF_CONFIG parsing, c10d
+init_process_group, DMLC env, Horovod slot env — SURVEY.md §2.3): after it,
+``jax.devices()`` spans every chip of every host and collectives ride
+ICI/DCN inside XLA.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from .. import constants as c
+
+log = logging.getLogger(__name__)
+
+
+def init(timeout_s: int = 300) -> dict:
+    """Join the distributed job described by the tony env contract.
+
+    No-op (single-process) when the contract env vars are absent, so the same
+    training script runs under the orchestrator and standalone.
+    Returns a summary dict {process_id, num_processes, coordinator}.
+    """
+    import jax
+
+    coordinator = os.environ.get(c.ENV_COORDINATOR_ADDRESS, "")
+    num_processes = int(os.environ.get(c.ENV_NUM_PROCESSES, "1"))
+    process_id = int(os.environ.get(c.ENV_PROCESS_ID, "0"))
+
+    if coordinator and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+            initialization_timeout=timeout_s,
+        )
+        log.info(
+            "joined distributed job: process %d/%d, coordinator %s, %d devices",
+            process_id, num_processes, coordinator, jax.device_count(),
+        )
+    return {
+        "process_id": process_id,
+        "num_processes": num_processes,
+        "coordinator": coordinator,
+        "num_devices": jax.device_count(),
+    }
+
+
+def task_info() -> dict:
+    """This task's identity from the executor env contract."""
+    env = os.environ
+    return {
+        "job_name": env.get(c.ENV_JOB_NAME, ""),
+        "task_index": int(env.get(c.ENV_TASK_INDEX, "0")),
+        "is_chief": env.get(c.ENV_IS_CHIEF, "false") == "true",
+        "app_id": env.get(c.ENV_APP_ID, ""),
+        "job_dir": env.get(c.ENV_JOB_DIR, ""),
+    }

@@ -1,0 +1,59 @@
+"""Checkpoint/resume via orbax.
+
+Exceeds the reference bar on purpose: TonY has no framework-level
+checkpointing at all (SURVEY.md §5 — "delegated entirely to user code";
+AM retry restarts from the user's own checkpoints). Here driver retry +
+``latest_step`` + async orbax saves give resumable training out of the box.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """Thin orbax wrapper: async save every N steps, restore-latest."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3, save_interval: int = 1):
+        import orbax.checkpoint as ocp
+
+        self._dir = Path(directory).resolve()
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> bool:
+        import orbax.checkpoint as ocp
+
+        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def restore(self, step: int | None = None, template: Any = None) -> Any:
+        import orbax.checkpoint as ocp
+
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            return None
+        if template is not None:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+        return self._mgr.restore(step)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
