@@ -1,0 +1,106 @@
+"""Benchmark: orchestrated mnist training throughput vs plain jax-on-TPU.
+
+BASELINE.md metric: "mnist steps/sec/chip submitted via the ClusterSubmitter
+-equivalent, target >= 90% of plain jax-on-TPU step throughput"
+(BASELINE.json north star). This script measures
+
+  1. plain JAX: the mnist train loop of tony_tpu/examples/mnist_jax.py run
+     directly in this process on the local accelerator(s)
+  2. orchestrated: the SAME script submitted as a 1-worker job through
+     TonyClient -> driver -> executor (the ClusterSubmitter path)
+
+and reports orchestrated steps/sec with vs_baseline = orchestrated / plain.
+Orchestration happens off the training path (heartbeats + metrics RPC only),
+so the ratio should be ~1.0; it also prints job-launch-to-first-step latency
+as a secondary line on stderr.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+STEPS = 300
+BATCH = 512
+
+
+def run_plain(tmp: Path) -> dict:
+    out = tmp / "plain.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tony_tpu.examples.mnist_jax",
+         "--steps", str(STEPS), "--batch-size", str(BATCH),
+         "--metrics-out", str(out)],
+        cwd=REPO, capture_output=True, text=True, timeout=900,
+    )
+    if proc.returncode != 0:
+        print(proc.stdout, proc.stderr, file=sys.stderr)
+        raise RuntimeError("plain jax run failed")
+    return json.loads(out.read_text())
+
+
+def run_orchestrated(tmp: Path) -> tuple[dict, float]:
+    sys.path.insert(0, str(REPO))
+    from tony_tpu.client import TonyClient
+    from tony_tpu.conf import TonyConf
+
+    out = tmp / "orch.json"
+    conf = TonyConf({
+        "tony.staging.dir": str(tmp / "staging"),
+        "tony.history.intermediate": str(tmp / "hist/intermediate"),
+        "tony.worker.instances": 1,
+        "tony.worker.command": (
+            f"{sys.executable} -m tony_tpu.examples.mnist_jax "
+            f"--steps {STEPS} --batch-size {BATCH} --metrics-out {out}"
+        ),
+        "tony.am.monitor-interval-ms": 100,
+    })
+    client = TonyClient(conf, poll_interval_s=0.1)
+    t_submit = time.time()
+    client.submit()
+    status = client.monitor()
+    if status.value != "SUCCEEDED":
+        log_dir = Path(client.job_dir)
+        for p in sorted(log_dir.rglob("*.std*")) + sorted(log_dir.rglob("*.log")):
+            print(f"==== {p} ====\n{p.read_text()[-2000:]}", file=sys.stderr)
+        raise RuntimeError(f"orchestrated job finished {status}")
+    metrics = json.loads(out.read_text())
+    launch_latency = metrics["time_to_first_step_s"] + 0.0
+    # end-to-end: submit -> first step = executor spawn + script start + compile
+    e2e_first_step = launch_latency  # in-process portion; add client-side below
+    return metrics, time.time() - t_submit
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="tony-bench-") as td:
+        tmp = Path(td)
+        plain = run_plain(tmp)
+        orch, wall = run_orchestrated(tmp)
+
+    plain_sps = plain["steps_per_sec"]
+    orch_sps = orch["steps_per_sec"]
+    print(
+        f"# plain: {plain_sps:.1f} steps/s | orchestrated: {orch_sps:.1f} steps/s | "
+        f"launch-to-first-step: {orch['time_to_first_step_s']:.2f}s | "
+        f"job wall: {wall:.1f}s | devices: {orch['num_devices']} | "
+        f"acc: {orch['accuracy']:.3f}",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "mnist_steps_per_sec_per_chip_orchestrated",
+        "value": round(orch_sps, 2),
+        "unit": "steps/s",
+        "vs_baseline": round(orch_sps / plain_sps, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,4 @@
+"""Runnable example jobs — parity with the reference's tony-examples/
+(mnist-tensorflow, mnist-pytorch, horovod-on-tony, linearregression-mxnet),
+re-based on JAX: one runtime, one bootstrap call, every parallelism via mesh.
+"""
