@@ -1,0 +1,123 @@
+"""CLI, proxy, and portal tests — reference tony-cli tests + portal
+controller/BrowserTest round-trips."""
+
+import json
+import socket
+import sys
+import threading
+import urllib.request
+
+from tony_tpu.cli.main import main as cli_main
+from tony_tpu.cli.proxy import ProxyServer
+from tony_tpu.conf import TonyConf
+from tony_tpu.portal.server import serve_portal
+
+PY = sys.executable
+
+
+def test_cli_local_submit(tmp_job_dirs, fixture_script, capsys):
+    rc = cli_main([
+        "local",
+        "--command", f"{PY} {fixture_script('exit_0.py')}",
+        "--instances", "2",
+        "-D", f"tony.staging.dir={tmp_job_dirs['staging']}",
+        "-D", f"tony.history.intermediate={tmp_job_dirs['history']}/intermediate",
+        "-D", "tony.am.monitor-interval-ms=100",
+    ])
+    assert rc == 0
+
+
+def test_cli_local_failure_exit_code(tmp_job_dirs, fixture_script):
+    rc = cli_main([
+        "local",
+        "--command", f"{PY} {fixture_script('exit_1.py')}",
+        "-D", f"tony.staging.dir={tmp_job_dirs['staging']}",
+        "-D", f"tony.history.intermediate={tmp_job_dirs['history']}/intermediate",
+        "-D", "tony.am.monitor-interval-ms=100",
+    ])
+    assert rc == 1
+
+
+def test_proxy_tunnels_bytes():
+    # echo server
+    upstream = socket.socket()
+    upstream.bind(("127.0.0.1", 0))
+    upstream.listen(1)
+    up_port = upstream.getsockname()[1]
+
+    def echo():
+        conn, _ = upstream.accept()
+        while True:
+            data = conn.recv(4096)
+            if not data:
+                return
+            conn.sendall(data.upper())
+
+    threading.Thread(target=echo, daemon=True).start()
+
+    proxy = ProxyServer("127.0.0.1", up_port)
+    proxy.start()
+    try:
+        client = socket.create_connection(("127.0.0.1", proxy.local_port), timeout=5)
+        client.sendall(b"hello tunnel")
+        assert client.recv(4096) == b"HELLO TUNNEL"
+        client.close()
+    finally:
+        proxy.stop()
+        upstream.close()
+
+
+def test_portal_serves_history(tmp_job_dirs, fixture_script):
+    # run a real job to generate history
+    from tony_tpu.client import TonyClient
+
+    conf = TonyConf({
+        "tony.staging.dir": tmp_job_dirs["staging"],
+        "tony.history.intermediate": tmp_job_dirs["history"] + "/intermediate",
+        "tony.history.finished": tmp_job_dirs["history"] + "/finished",
+        "tony.worker.instances": 1,
+        "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}",
+        "tony.am.monitor-interval-ms": 100,
+    })
+    client = TonyClient(conf, poll_interval_s=0.1)
+    client.submit()
+    assert client.monitor().value == "SUCCEEDED"
+    app_id = client.app_id
+
+    server = serve_portal(conf, port=0, block=False)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        def get(path, accept="application/json"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", headers={"Accept": accept}
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, resp.read().decode()
+
+        status, body = get("/")
+        jobs = json.loads(body)
+        assert status == 200
+        assert any(j["app_id"] == app_id for j in jobs)
+        assert jobs[0]["status"] in ("SUCCEEDED", "RUNNING")
+
+        status, body = get(f"/jobs/{app_id}")
+        events = json.loads(body)
+        assert status == 200
+        assert events[0]["type"] == "APPLICATION_INITED"
+        assert events[-1]["type"] == "APPLICATION_FINISHED"
+
+        status, body = get(f"/config/{app_id}")
+        assert status == 200
+        assert json.loads(body)["tony.worker.instances"] == 1
+
+        status, body = get(f"/logs/{app_id}")
+        assert status == 200
+
+        # html index renders
+        status, body = get("/", accept="text/html")
+        assert status == 200 and app_id in body
+    finally:
+        server.shutdown()
+        server.server_close()

@@ -1,0 +1,6 @@
+"""CLI submitters (reference: tony-cli) + TCP proxy (reference: tony-proxy)."""
+
+from .main import main
+from .proxy import ProxyServer
+
+__all__ = ["main", "ProxyServer"]

@@ -1,0 +1,154 @@
+"""Command-line submitters.
+
+Mirrors tony-cli: ClusterSubmitter (ClusterSubmitter.java:86 — submit against
+real capacity), LocalSubmitter (LocalSubmitter.java:39 — one-command dev loop
+against the local mini-cluster), NotebookSubmitter (NotebookSubmitter.java:139
+— single-node app + local proxy tunnel). One binary, subcommands:
+
+    tony-tpu submit   --conf job.json [--conf-override k=v ...]
+    tony-tpu local    --command "python train.py" [--instances N]
+    tony-tpu notebook --command "jupyter lab --port {port}"
+    tony-tpu history  [--port P]      # portal over the history dir
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import time
+
+from ..api import JobStatus, TaskStatus
+from ..conf import TonyConf, keys
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--conf", action="append", default=[],
+                   help="config file (json), repeatable; later wins")
+    p.add_argument("--conf-override", "-D", action="append", default=[],
+                   metavar="K=V", help="config override, repeatable")
+
+
+def _build_client(args, extra: dict | None = None):
+    from ..client import TonyClient
+
+    conf = TonyConf.resolve(conf_files=args.conf, overrides=args.conf_override)
+    for k, v in (extra or {}).items():
+        conf.set(k, v)
+    client = TonyClient(conf)
+    # shutdown hook force-kills the app, like ClusterSubmitter.java:49-84
+    def _on_sigint(signum, frame):
+        print("interrupt: killing application", file=sys.stderr)
+        client.stop()
+        sys.exit(130)
+
+    signal.signal(signal.SIGINT, _on_sigint)
+    return client
+
+
+def cmd_submit(args) -> int:
+    client = _build_client(args)
+    client.add_listener(_print_task_updates)
+    return client.run()
+
+
+def cmd_local(args) -> int:
+    extra = {
+        keys.CLUSTER_PROVISIONER: "local",
+        keys.instances_key("worker"): args.instances,
+        keys.command_key("worker"): args.command,
+    }
+    client = _build_client(args, extra)
+    client.add_listener(_print_task_updates)
+    return client.run()
+
+
+def cmd_notebook(args) -> int:
+    from .proxy import ProxyServer
+
+    extra = {
+        keys.CLUSTER_PROVISIONER: "local",
+        keys.APPLICATION_FRAMEWORK: "standalone",
+        keys.instances_key("notebook"): 1,
+        keys.command_key("notebook"): args.command,
+        keys.APPLICATION_TIMEOUT_MS: args.timeout_ms,
+    }
+    client = _build_client(args, extra)
+    proxy_holder = {}
+
+    def on_update(infos):
+        _print_task_updates(infos)
+        for info in infos:
+            if (
+                info.name == "notebook"
+                and info.status == TaskStatus.RUNNING.value
+                and info.port > 0
+                and "proxy" not in proxy_holder
+            ):
+                proxy = ProxyServer(info.host, info.port, args.local_port)
+                proxy.start()
+                proxy_holder["proxy"] = proxy
+                print(
+                    f"notebook reachable at http://127.0.0.1:{proxy.local_port}",
+                    file=sys.stderr,
+                )
+
+    client.add_listener(on_update)
+    return client.run()
+
+
+def cmd_history(args) -> int:
+    from ..portal.server import serve_portal
+
+    conf = TonyConf.resolve(conf_files=args.conf, overrides=args.conf_override)
+    serve_portal(conf, port=args.port)
+    return 0
+
+
+_last_printed: dict[str, str] = {}
+
+
+def _print_task_updates(infos) -> None:
+    for info in infos:
+        prev = _last_printed.get(info.task_id)
+        if prev != info.status:
+            _last_printed[info.task_id] = info.status
+            print(f"[{time.strftime('%H:%M:%S')}] {info.task_id}: {info.status}"
+                  + (f" @ {info.host}:{info.port}" if info.port > 0 else ""),
+                  file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.WARNING)
+    parser = argparse.ArgumentParser(prog="tony-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("submit", help="submit a configured job")
+    _add_common(p)
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("local", help="run a command on the local mini-cluster")
+    _add_common(p)
+    p.add_argument("--command", required=True)
+    p.add_argument("--instances", type=int, default=1)
+    p.set_defaults(fn=cmd_local)
+
+    p = sub.add_parser("notebook", help="run a notebook and tunnel to it")
+    _add_common(p)
+    p.add_argument("--command", required=True)
+    p.add_argument("--local-port", type=int, default=0)
+    p.add_argument("--timeout-ms", type=int, default=24 * 3600 * 1000)
+    p.set_defaults(fn=cmd_notebook)
+
+    p = sub.add_parser("history", help="serve the history portal")
+    _add_common(p)
+    p.add_argument("--port", type=int, default=19886)
+    p.set_defaults(fn=cmd_history)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+"""TCP proxy: expose a port on a job host (e.g. a notebook) locally.
+
+Mirrors tony-proxy's byte pump (tony-proxy/.../ProxyServer.java:41-90 — one
+thread per direction per connection). Used by the notebook submitter the way
+the reference's NotebookSubmitter starts a ProxyServer tunnel
+(tony-cli/.../NotebookSubmitter.java:71-133). A C++ implementation with the
+same interface lives in native/; this is the portable fallback.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+
+log = logging.getLogger(__name__)
+
+
+def _pump(src: socket.socket, dst: socket.socket) -> None:
+    try:
+        while True:
+            data = src.recv(65536)
+            if not data:
+                break
+            dst.sendall(data)
+    except OSError:
+        pass
+    finally:
+        for s in (src, dst):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
+class ProxyServer:
+    def __init__(self, remote_host: str, remote_port: int, local_port: int = 0):
+        self.remote = (remote_host, remote_port)
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", local_port))
+        self._listener.listen(16)
+        self.local_port = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        log.info("proxy 127.0.0.1:%d -> %s:%d", self.local_port, *self.remote)
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(self.remote, timeout=10)
+            except OSError as e:
+                log.warning("proxy: cannot reach %s: %s", self.remote, e)
+                client.close()
+                continue
+            threading.Thread(target=_pump, args=(client, upstream), daemon=True).start()
+            threading.Thread(target=_pump, args=(upstream, client), daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._listener.close()

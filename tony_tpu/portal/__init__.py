@@ -1,0 +1,5 @@
+"""History portal (reference: tony-portal Play app)."""
+
+from .server import HistoryIndex, serve_portal
+
+__all__ = ["HistoryIndex", "serve_portal"]

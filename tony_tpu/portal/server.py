@@ -1,0 +1,224 @@
+"""History portal: web UI + JSON API over the job-history directory.
+
+Mirrors tony-portal (Play app): routes `/`, `/jobs/<id>`, `/config/<id>`,
+`/logs/<id>` (tony-portal/conf/routes:1-5), metadata/config/event caches
+(tony-portal/app/cache/CacheWrapper.java:28-76 — here a TTL dict), and the
+mover/purger housekeeping threads (HistoryFileMover/HistoryFilePurger) run
+in-process. Stdlib http.server: no web-framework dependency.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import logging
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from ..conf import TonyConf, keys
+from ..events.handler import read_events
+from ..events.history import (
+    SUFFIX,
+    HistoryFileMover,
+    HistoryFilePurger,
+    parse_history_file_name,
+)
+
+log = logging.getLogger(__name__)
+
+
+class _TTLCache:
+    """Guava-cache stand-in: bounded TTL memo (CacheWrapper.java:28-76)."""
+
+    def __init__(self, ttl_s: float = 30.0, max_items: int = 256):
+        self._ttl = ttl_s
+        self._max = max_items
+        self._data: dict = {}
+
+    def get(self, key, loader):
+        now = time.time()
+        hit = self._data.get(key)
+        if hit and now - hit[0] < self._ttl:
+            return hit[1]
+        value = loader()
+        if len(self._data) >= self._max:
+            oldest = min(self._data, key=lambda k: self._data[k][0])
+            del self._data[oldest]
+        self._data[key] = (now, value)
+        return value
+
+
+class HistoryIndex:
+    def __init__(self, conf: TonyConf):
+        self.intermediate = Path(str(conf.get(keys.HISTORY_INTERMEDIATE)))
+        self.finished = Path(str(conf.get(keys.HISTORY_FINISHED)))
+        self.staging = Path(str(conf.get(keys.STAGING_DIR)))
+        self._meta_cache = _TTLCache(ttl_s=10)
+        self._events_cache = _TTLCache(ttl_s=30)
+
+    def _job_dirs(self):
+        for root in (self.intermediate, self.finished):
+            if not root.exists():
+                continue
+            for jhist in root.rglob("*" + SUFFIX):
+                yield jhist.parent, jhist
+
+    def jobs(self) -> list[dict]:
+        def load():
+            out = []
+            for job_dir, jhist in self._job_dirs():
+                meta = parse_history_file_name(jhist.name)
+                if meta is None:
+                    continue
+                out.append({
+                    "app_id": meta.app_id,
+                    "user": meta.user,
+                    "started_ms": meta.start_ms,
+                    "completed_ms": meta.end_ms,
+                    "status": meta.status or "RUNNING",
+                })
+            out.sort(key=lambda j: -j["started_ms"])
+            return out
+
+        return self._meta_cache.get("jobs", load)
+
+    def _find_job_dir(self, app_id: str):
+        for job_dir, jhist in self._job_dirs():
+            if job_dir.name == app_id:
+                return job_dir, jhist
+        return None, None
+
+    def events(self, app_id: str) -> list[dict] | None:
+        def load():
+            _, jhist = self._find_job_dir(app_id)
+            if jhist is None:
+                return None
+            return [
+                {"type": e.type.value, "timestamp": e.timestamp, **e.payload}
+                for e in read_events(jhist)
+            ]
+
+        return self._events_cache.get(("events", app_id), load)
+
+    def config(self, app_id: str) -> dict | None:
+        for root in (self.staging,):
+            path = root / app_id / "tony-final.json"
+            if path.exists():
+                return json.loads(path.read_text())
+        job_dir, _ = self._find_job_dir(app_id)
+        if job_dir is not None and (job_dir / "tony-final.json").exists():
+            return json.loads((job_dir / "tony-final.json").read_text())
+        return None
+
+    def logs(self, app_id: str) -> dict[str, str] | None:
+        log_dir = self.staging / app_id / "logs"
+        if not log_dir.exists():
+            return None
+        out = {}
+        for p in sorted(log_dir.iterdir()):
+            try:
+                out[p.name] = p.read_text()[-20000:]
+            except OSError:
+                continue
+        return out
+
+
+_PAGE = """<!doctype html><html><head><title>tony-tpu history</title>
+<style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #999;padding:4px 10px;text-align:left}}
+.SUCCEEDED{{color:green}}.FAILED{{color:red}}.KILLED{{color:orange}}</style>
+</head><body><h2>tony-tpu job history</h2>{body}</body></html>"""
+
+
+def _jobs_html(jobs: list[dict]) -> str:
+    rows = "".join(
+        f"<tr><td><a href='/jobs/{html.escape(j['app_id'])}'>{html.escape(j['app_id'])}</a></td>"
+        f"<td>{html.escape(j['user'])}</td>"
+        f"<td>{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(j['started_ms']/1000))}</td>"
+        f"<td class='{j['status']}'>{j['status']}</td>"
+        f"<td><a href='/config/{j['app_id']}'>config</a> "
+        f"<a href='/logs/{j['app_id']}'>logs</a></td></tr>"
+        for j in jobs
+    )
+    return _PAGE.format(
+        body="<table><tr><th>job</th><th>user</th><th>started</th>"
+             f"<th>status</th><th></th></tr>{rows}</table>"
+    )
+
+
+def make_handler(index: HistoryIndex):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            log.debug("portal: " + fmt, *args)
+
+        def _send(self, code: int, body: str, ctype="text/html"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype + "; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _json(self, obj):
+            self._send(200 if obj is not None else 404,
+                       json.dumps(obj, indent=2), "application/json")
+
+        def do_GET(self):
+            parts = [p for p in self.path.split("?")[0].split("/") if p]
+            want_json = "application/json" in self.headers.get("Accept", "") \
+                or self.path.startswith("/api/")
+            if parts and parts[0] == "api":
+                parts = parts[1:]
+            try:
+                if not parts:
+                    jobs = index.jobs()
+                    return self._json(jobs) if want_json else self._send(
+                        200, _jobs_html(jobs))
+                kind, app_id = parts[0], parts[1] if len(parts) > 1 else ""
+                if kind == "jobs":
+                    return self._json(index.events(app_id))
+                if kind == "config":
+                    return self._json(index.config(app_id))
+                if kind == "logs":
+                    logs = index.logs(app_id)
+                    if logs is None:
+                        return self._send(404, "not found", "text/plain")
+                    if want_json:
+                        return self._json(logs)
+                    body = "".join(
+                        f"<h3>{html.escape(n)}</h3><pre>{html.escape(t)}</pre>"
+                        for n, t in logs.items()
+                    )
+                    return self._send(200, _PAGE.format(body=body))
+                return self._send(404, "not found", "text/plain")
+            except Exception as e:
+                log.exception("portal request failed")
+                return self._send(500, f"error: {e}", "text/plain")
+
+    return Handler
+
+
+def serve_portal(conf: TonyConf, port: int = 19886, block: bool = True):
+    index = HistoryIndex(conf)
+    mover = HistoryFileMover(
+        str(conf.get(keys.HISTORY_INTERMEDIATE)),
+        str(conf.get(keys.HISTORY_FINISHED)),
+        interval_s=conf.get_int(keys.HISTORY_MOVER_INTERVAL_MS, 30000) / 1000,
+    )
+    purger = HistoryFilePurger(
+        str(conf.get(keys.HISTORY_FINISHED)),
+        retention_sec=conf.get_int(keys.HISTORY_RETENTION_SEC, 2592000),
+    )
+    mover.start()
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), make_handler(index))
+    log.info("portal on :%d", server.server_address[1])
+    if block:
+        try:
+            purger.purge_once()
+            server.serve_forever()
+        finally:
+            mover.stop()
+            server.server_close()
+    return server
