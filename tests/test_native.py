@@ -1,0 +1,74 @@
+"""Native library tests: build, procstats vs Python walk, epoll proxy."""
+
+import os
+import socket
+import threading
+
+import pytest
+
+from tony_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return lib
+
+
+def test_native_rss_close_to_python(lib):
+    from tony_tpu.metrics import _proc_tree_rss_mb
+
+    native_val = native.proc_tree_rss_mb(os.getpid())
+    assert native_val is not None and native_val > 1.0
+    py_val = _proc_tree_rss_mb(os.getpid())
+    # both walk the same /proc tree moments apart
+    assert abs(native_val - py_val) / max(py_val, 1) < 0.2, (native_val, py_val)
+
+
+def test_native_rss_unknown_pid(lib):
+    assert native.proc_tree_rss_mb(99999999) is None
+
+
+def test_native_proxy_tunnels(lib):
+    upstream = socket.socket()
+    upstream.bind(("127.0.0.1", 0))
+    upstream.listen(4)
+    up_port = upstream.getsockname()[1]
+
+    def echo():
+        while True:
+            try:
+                conn, _ = upstream.accept()
+            except OSError:
+                return
+            def serve(c):
+                while True:
+                    data = c.recv(4096)
+                    if not data:
+                        return
+                    c.sendall(data[::-1])
+            threading.Thread(target=serve, args=(conn,), daemon=True).start()
+
+    threading.Thread(target=echo, daemon=True).start()
+
+    proxy = native.NativeProxy("127.0.0.1", up_port)
+    proxy.start()
+    try:
+        assert proxy.local_port > 0
+        # multiple concurrent connections through one epoll loop
+        for payload in (b"abc", b"x" * 100000, b"hello"):
+            c = socket.create_connection(("127.0.0.1", proxy.local_port), timeout=5)
+            c.sendall(payload)
+            got = b""
+            while len(got) < len(payload):
+                chunk = c.recv(65536)
+                if not chunk:
+                    break
+                got += chunk
+            assert got == payload[::-1]
+            c.close()
+    finally:
+        proxy.stop()
+        upstream.close()

@@ -26,7 +26,16 @@ TPU_HBM_USED = "tpu_hbm_used_mb"
 
 def _proc_tree_rss_mb(root_pid: int) -> float:
     """Sum RSS over root_pid and its descendants via /proc (the reference uses
-    YARN's ResourceCalculatorProcessTree for the same walk)."""
+    YARN's ResourceCalculatorProcessTree for the same walk). Uses the C++
+    sampler (native/src/procstats.cc) when built; Python walk otherwise."""
+    try:
+        from .native import proc_tree_rss_mb as native_rss
+
+        value = native_rss(root_pid)
+        if value is not None:
+            return value
+    except Exception:
+        pass
     children: dict[int, list[int]] = {}
     pids = []
     try:

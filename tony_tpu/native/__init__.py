@@ -1,0 +1,97 @@
+"""ctypes loader for the native runtime library (libtonytpu.so).
+
+Builds lazily with `make` on first use if the toolchain is present; every
+caller has a pure-Python fallback (metrics.py's /proc walk, cli/proxy.py's
+threaded pump), so the framework works with or without the .so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+import threading
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+_DIR = Path(__file__).parent
+_LIB_PATH = _DIR / "libtonytpu.so"
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_attempted = False
+
+
+def _try_build() -> bool:
+    global _build_attempted
+    if _build_attempted:
+        return _LIB_PATH.exists()
+    _build_attempted = True
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=_DIR, check=True, capture_output=True, timeout=120
+        )
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.debug("native build unavailable: %s", e)
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded library, or None when unavailable."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not _LIB_PATH.exists() and not _try_build():
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except OSError as e:
+            log.warning("could not load %s: %s", _LIB_PATH, e)
+            return None
+        lib.tony_proc_tree_rss_mb.argtypes = [ctypes.c_int]
+        lib.tony_proc_tree_rss_mb.restype = ctypes.c_double
+        lib.tony_proxy_start.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.tony_proxy_start.restype = ctypes.c_int
+        lib.tony_proxy_stop.argtypes = [ctypes.c_int]
+        lib.tony_proxy_stop.restype = None
+        _lib = lib
+        return _lib
+
+
+def proc_tree_rss_mb(root_pid: int) -> float | None:
+    """Native process-tree RSS; None if the library is unavailable or the
+    walk failed (caller falls back to the Python /proc walk)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    value = lib.tony_proc_tree_rss_mb(root_pid)
+    return value if value >= 0 else None
+
+
+class NativeProxy:
+    """Epoll-based TCP proxy; same surface as cli.proxy.ProxyServer."""
+
+    def __init__(self, remote_host: str, remote_port: int, local_port: int = 0):
+        self._args = (remote_host, remote_port, local_port)
+        self.local_port = -1
+
+    @staticmethod
+    def available() -> bool:
+        return get_lib() is not None
+
+    def start(self) -> None:
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        host, port, local = self._args
+        self.local_port = lib.tony_proxy_start(host.encode(), port, local)
+        if self.local_port < 0:
+            raise OSError("native proxy failed to start")
+
+    def stop(self) -> None:
+        lib = get_lib()
+        if lib is not None and self.local_port > 0:
+            lib.tony_proxy_stop(self.local_port)
+            self.local_port = -1

@@ -1,0 +1,112 @@
+// Process-tree resource sampling for the executor metrics loop.
+//
+// Plays the role of the reference's YARN ResourceCalculatorProcessTree walk
+// (used by TaskMonitor.java:101-170) — implemented natively so the 5s metrics
+// tick costs microseconds instead of a Python directory walk over /proc.
+//
+// Exposed via ctypes from tony_tpu/native/__init__.py.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dirent.h>
+#include <vector>
+
+namespace {
+
+struct ProcInfo {
+  int pid;
+  int ppid;
+  int64_t rss_kb;
+};
+
+// Parse /proc/<pid>/stat for ppid and /proc/<pid>/status for VmRSS.
+// stat field 4 is ppid, but comm (field 2) may contain spaces/parens —
+// scan from the last ')'.
+bool read_proc(int pid, ProcInfo *out) {
+  char path[64];
+  std::snprintf(path, sizeof(path), "/proc/%d/stat", pid);
+  FILE *f = std::fopen(path, "r");
+  if (!f) return false;
+  char buf[1024];
+  size_t n = std::fread(buf, 1, sizeof(buf) - 1, f);
+  std::fclose(f);
+  if (n == 0) return false;
+  buf[n] = '\0';
+  const char *close_paren = std::strrchr(buf, ')');
+  if (!close_paren) return false;
+  int ppid = -1;
+  char state;
+  if (std::sscanf(close_paren + 1, " %c %d", &state, &ppid) != 2) return false;
+
+  int64_t rss_kb = 0;
+  std::snprintf(path, sizeof(path), "/proc/%d/status", pid);
+  f = std::fopen(path, "r");
+  if (f) {
+    char line[256];
+    while (std::fgets(line, sizeof(line), f)) {
+      if (std::strncmp(line, "VmRSS:", 6) == 0) {
+        rss_kb = std::atoll(line + 6);
+        break;
+      }
+    }
+    std::fclose(f);
+  }
+  out->pid = pid;
+  out->ppid = ppid;
+  out->rss_kb = rss_kb;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Sum of VmRSS over root_pid and all its descendants, in MiB.
+// Returns -1.0 on error.
+double tony_proc_tree_rss_mb(int root_pid) {
+  DIR *proc = opendir("/proc");
+  if (!proc) return -1.0;
+  std::vector<ProcInfo> procs;
+  procs.reserve(512);
+  struct dirent *ent;
+  while ((ent = readdir(proc)) != nullptr) {
+    const char *name = ent->d_name;
+    bool numeric = name[0] != '\0';
+    for (const char *c = name; *c; ++c) {
+      if (*c < '0' || *c > '9') { numeric = false; break; }
+    }
+    if (!numeric) continue;
+    ProcInfo info;
+    if (read_proc(std::atoi(name), &info)) procs.push_back(info);
+  }
+  closedir(proc);
+
+  // BFS from root over the ppid edges; O(n^2) worst case on a few hundred
+  // pids is well under a millisecond.
+  std::vector<int> frontier{root_pid};
+  std::vector<char> in_tree(procs.size(), 0);
+  int64_t total_kb = 0;
+  bool found_root = false;
+  while (!frontier.empty()) {
+    int pid = frontier.back();
+    frontier.pop_back();
+    for (size_t i = 0; i < procs.size(); ++i) {
+      if (in_tree[i]) continue;
+      if (procs[i].pid == pid) {
+        in_tree[i] = 1;
+        total_kb += procs[i].rss_kb;
+        if (pid == root_pid) found_root = true;
+      } else if (procs[i].ppid == pid) {
+        in_tree[i] = 1;
+        total_kb += procs[i].rss_kb;
+        frontier.push_back(procs[i].pid);
+      }
+    }
+  }
+  if (!found_root) return -1.0;
+  return static_cast<double>(total_kb) / 1024.0;
+}
+
+}  // extern "C"

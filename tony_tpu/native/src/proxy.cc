@@ -1,0 +1,269 @@
+// Epoll-based TCP proxy — the native version of tony_tpu/cli/proxy.py.
+//
+// The reference's tony-proxy is a thread-per-connection Java byte pump
+// (tony-proxy/.../ProxyServer.java:41-90). This one multiplexes every
+// connection pair on a single epoll loop: O(1) threads, no GIL, suitable for
+// fronting a notebook or TensorBoard from a TPU host.
+//
+// C API (ctypes):
+//   int  tony_proxy_start(const char* remote_host, int remote_port,
+//                         int local_port);   // returns bound local port, <0 on error
+//   void tony_proxy_stop(int local_port);
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <map>
+#include <mutex>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr size_t kBuf = 1 << 16;
+
+int set_nonblock(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+struct Conn {
+  int peer = -1;
+  std::vector<uint8_t> pending;  // bytes to write to THIS fd
+  bool peer_closed = false;
+};
+
+class Proxy {
+ public:
+  Proxy(std::string rhost, int rport) : rhost_(std::move(rhost)), rport_(rport) {}
+
+  int start(int local_port) {
+    listener_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listener_ < 0) return -1;
+    int one = 1;
+    setsockopt(listener_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(static_cast<uint16_t>(local_port));
+    if (bind(listener_, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) < 0 ||
+        listen(listener_, 64) < 0) {
+      close(listener_);
+      return -1;
+    }
+    socklen_t len = sizeof(addr);
+    getsockname(listener_, reinterpret_cast<sockaddr *>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+
+    stop_fd_ = eventfd(0, EFD_NONBLOCK);
+    epfd_ = epoll_create1(0);
+    set_nonblock(listener_);
+    add_fd(listener_, EPOLLIN);
+    add_fd(stop_fd_, EPOLLIN);
+    thread_ = std::thread([this] { loop(); });
+    return port_;
+  }
+
+  void stop() {
+    uint64_t one = 1;
+    ssize_t ignored = write(stop_fd_, &one, sizeof(one));
+    (void)ignored;
+    if (thread_.joinable()) thread_.join();
+  }
+
+  int port() const { return port_; }
+
+ private:
+  void add_fd(int fd, uint32_t events) {
+    epoll_event ev{};
+    ev.events = events;
+    ev.data.fd = fd;
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+  }
+
+  void mod_fd(int fd, uint32_t events) {
+    epoll_event ev{};
+    ev.events = events;
+    ev.data.fd = fd;
+    epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &ev);
+  }
+
+  int connect_upstream() {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(rhost_.c_str(), std::to_string(rport_).c_str(), &hints,
+                    &res) != 0 || res == nullptr) {
+      return -1;
+    }
+    int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd >= 0 && connect(fd, res->ai_addr, res->ai_addrlen) < 0) {
+      close(fd);
+      fd = -1;
+    }
+    freeaddrinfo(res);
+    if (fd >= 0) {
+      set_nonblock(fd);
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
+    return fd;
+  }
+
+  void close_pair(int fd) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;
+    int peer = it->second.peer;
+    epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+    conns_.erase(it);
+    auto pit = conns_.find(peer);
+    if (pit != conns_.end()) {
+      epoll_ctl(epfd_, EPOLL_CTL_DEL, peer, nullptr);
+      close(peer);
+      conns_.erase(pit);
+    }
+  }
+
+  void pump(int src) {
+    auto sit = conns_.find(src);
+    if (sit == conns_.end()) return;
+    int dst = sit->second.peer;
+    auto dit = conns_.find(dst);
+    if (dit == conns_.end()) { close_pair(src); return; }
+
+    uint8_t buf[kBuf];
+    for (;;) {
+      ssize_t n = recv(src, buf, sizeof(buf), 0);
+      if (n > 0) {
+        size_t off = 0;
+        if (dit->second.pending.empty()) {
+          ssize_t w = send(dst, buf, static_cast<size_t>(n), MSG_NOSIGNAL);
+          if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+            close_pair(src);
+            return;
+          }
+          off = w > 0 ? static_cast<size_t>(w) : 0;
+        }
+        if (off < static_cast<size_t>(n)) {
+          auto &p = dit->second.pending;
+          p.insert(p.end(), buf + off, buf + n);
+          mod_fd(dst, EPOLLIN | EPOLLOUT);
+        }
+      } else if (n == 0) {
+        close_pair(src);
+        return;
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        close_pair(src);
+        return;
+      }
+    }
+  }
+
+  void flush(int fd) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;
+    auto &p = it->second.pending;
+    while (!p.empty()) {
+      ssize_t w = send(fd, p.data(), p.size(), MSG_NOSIGNAL);
+      if (w > 0) {
+        p.erase(p.begin(), p.begin() + w);
+      } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        return;
+      } else {
+        close_pair(fd);
+        return;
+      }
+    }
+    mod_fd(fd, EPOLLIN);
+  }
+
+  void loop() {
+    epoll_event events[64];
+    for (;;) {
+      int n = epoll_wait(epfd_, events, 64, 1000);
+      for (int i = 0; i < n; ++i) {
+        int fd = events[i].data.fd;
+        if (fd == stop_fd_) goto done;
+        if (fd == listener_) {
+          for (;;) {
+            int client = accept(listener_, nullptr, nullptr);
+            if (client < 0) break;
+            int upstream = connect_upstream();
+            if (upstream < 0) { close(client); continue; }
+            set_nonblock(client);
+            int one = 1;
+            setsockopt(client, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            conns_[client] = Conn{upstream, {}, false};
+            conns_[upstream] = Conn{client, {}, false};
+            add_fd(client, EPOLLIN);
+            add_fd(upstream, EPOLLIN);
+          }
+          continue;
+        }
+        if (events[i].events & EPOLLOUT) flush(fd);
+        if (events[i].events & (EPOLLIN | EPOLLHUP | EPOLLERR)) pump(fd);
+      }
+    }
+  done:
+    for (auto &kv : conns_) close(kv.first);
+    conns_.clear();
+    close(listener_);
+    close(epfd_);
+    close(stop_fd_);
+  }
+
+  std::string rhost_;
+  int rport_;
+  int listener_ = -1;
+  int epfd_ = -1;
+  int stop_fd_ = -1;
+  int port_ = -1;
+  std::thread thread_;
+  std::map<int, Conn> conns_;
+};
+
+std::mutex g_mu;
+std::map<int, Proxy *> g_proxies;
+
+}  // namespace
+
+extern "C" {
+
+int tony_proxy_start(const char *remote_host, int remote_port, int local_port) {
+  auto *p = new Proxy(remote_host, remote_port);
+  int port = p->start(local_port);
+  if (port < 0) {
+    delete p;
+    return -1;
+  }
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_proxies[port] = p;
+  return port;
+}
+
+void tony_proxy_stop(int local_port) {
+  Proxy *p = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_proxies.find(local_port);
+    if (it == g_proxies.end()) return;
+    p = it->second;
+    g_proxies.erase(it);
+  }
+  p->stop();
+  delete p;
+}
+
+}  // extern "C"
