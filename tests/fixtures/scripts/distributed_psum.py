@@ -1,0 +1,34 @@
+"""True multi-process JAX job: joins via tony_tpu.train.init() (the env
+contract emitted by the jax runtime adapter) and verifies a cross-process
+collective — the TPU-native replacement for the reference's
+TF-gRPC/c10d/Gloo data-plane checks."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.environ["TONY_REPO_ROOT"])
+from tony_tpu import train
+
+info = train.init(timeout_s=60)
+n = info["num_processes"]
+assert n >= 2, f"expected a real multi-process job, got {n}"
+local_dev = jax.local_device_count()
+assert jax.device_count() == n * local_dev, (jax.device_count(), n, local_dev)
+
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+# one row per local device, valued by process id + 1
+local = np.full((local_dev, 4), info["process_id"] + 1, np.float32)
+x = jax.make_array_from_process_local_data(NamedSharding(mesh, P("data")), local)
+total = float(jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, P()))(x))
+expected = sum(4.0 * local_dev * (i + 1) for i in range(n))
+assert abs(total - expected) < 1e-5, (total, expected)
+print(f"process {info['process_id']}/{n}: collective OK ({total})")
+sys.exit(0)

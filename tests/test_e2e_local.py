@@ -304,3 +304,21 @@ def test_driver_retry_after_failure(tmp_job_dirs, fixture_script, tmp_path):
            "tony.am.retry-count": 1},
     )
     assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_real_jax_distributed_collective(tmp_job_dirs, fixture_script):
+    """2-worker job where the user processes actually join jax.distributed
+    via the coordinator address the runtime emitted, and run a psum. This is
+    the end-to-end proof the bootstrap contract works (SURVEY.md §7 step 6)."""
+    import tony_tpu
+
+    repo_root = str(Path(tony_tpu.__file__).resolve().parent.parent)
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 2,
+           "tony.worker.command": f"{PY} {fixture_script('distributed_psum.py')}",
+           "tony.execution.env": f"TONY_REPO_ROOT={repo_root}",
+           # jax.distributed gloo bootstrap can take a few seconds
+           "tony.task.heartbeat-interval-ms": 1000},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
