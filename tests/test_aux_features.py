@@ -1,0 +1,146 @@
+"""Tests for auxiliary capabilities: resource localization, workflow shim,
+in-driver preprocess mode, TB sidecar URL, metrics accumulator."""
+
+import json
+import sys
+import zipfile
+from pathlib import Path
+
+from tony_tpu.api import JobStatus
+from tony_tpu.client import TonyClient
+from tony_tpu.conf import TonyConf
+from tony_tpu.integrations import WorkflowJob, props_to_conf
+from tony_tpu.integrations.workflow import load_properties
+from tony_tpu.metrics import MetricsAccumulator
+from tony_tpu.utils import localization as loc
+
+PY = sys.executable
+
+
+def base_conf(dirs, **extra):
+    return TonyConf({
+        "tony.staging.dir": dirs["staging"],
+        "tony.history.intermediate": dirs["history"] + "/intermediate",
+        "tony.am.monitor-interval-ms": 100,
+        **extra,
+    })
+
+
+# ------------------------------------------------------------- localization
+
+def test_resource_spec_parsing():
+    s = loc.ResourceSpec.parse("/a/b/data.txt#mydata")
+    assert s.path == "/a/b/data.txt" and s.alias == "mydata" and not s.archive
+    s2 = loc.ResourceSpec.parse("/a/venv.zip::archive")
+    assert s2.archive and s2.alias == "venv.zip"
+    s3 = loc.ResourceSpec.parse("/a/plain.bin")
+    assert s3.alias == "plain.bin"
+
+
+def test_stage_and_localize_roundtrip(tmp_path):
+    src = tmp_path / "data.txt"
+    src.write_text("payload")
+    zpath = tmp_path / "bundle.zip"
+    with zipfile.ZipFile(zpath, "w") as zf:
+        zf.writestr("inner/file.txt", "zipped")
+
+    specs = loc.parse_resources([f"{src}#renamed.txt", f"{zpath}#bundle::archive"])
+    staged = loc.stage_resources(specs, tmp_path / "staging")
+    work = tmp_path / "work"
+    loc.localize_resources(staged, work)
+    assert (work / "renamed.txt").read_text() == "payload"
+    assert (work / "bundle" / "inner" / "file.txt").read_text() == "zipped"
+
+
+def test_e2e_resource_localization(tmp_job_dirs, tmp_path):
+    data = tmp_path / "asset.txt"
+    data.write_text("hello-resource")
+    conf = base_conf(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.worker.resources": f"{data}#input.txt",
+           # cwd of the user process is the task work dir with the resource
+           "tony.worker.command": "bash -c 'grep -q hello-resource input.txt'"},
+    )
+    client = TonyClient(conf, poll_interval_s=0.1)
+    client.submit()
+    assert client.monitor() == JobStatus.SUCCEEDED
+
+
+# ----------------------------------------------------------------- workflow
+
+def test_props_to_conf_and_tags():
+    conf = props_to_conf(
+        {"tony.worker.instances": "3", "unrelated.key": "x",
+         "tony.application.name": "wf-job"},
+        tags={"flow": "f1", "project": "p1"},
+    )
+    assert conf["tony.worker.instances"] == 3
+    assert "unrelated.key" not in conf
+    assert "flow=f1" in conf["tony.application.tags"]
+
+
+def test_properties_file_roundtrip(tmp_path):
+    p = tmp_path / "job.properties"
+    p.write_text("# comment\ntony.worker.instances=2\ntony.x.y: value with spaces\n")
+    props = load_properties(p)
+    assert props["tony.worker.instances"] == "2"
+    assert props["tony.x.y"] == "value with spaces"
+
+
+def test_workflow_job_runs(tmp_job_dirs, fixture_script):
+    job = WorkflowJob({
+        "tony.staging.dir": tmp_job_dirs["staging"],
+        "tony.history.intermediate": tmp_job_dirs["history"] + "/intermediate",
+        "tony.worker.instances": "1",
+        "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}",
+        "tony.am.monitor-interval-ms": "100",
+    }, tags={"flow": "test-flow"})
+    assert job.run() == 0
+
+
+# ------------------------------------------------------ preprocess + sidecar
+
+def test_preprocess_runs_in_driver(tmp_job_dirs, tmp_path):
+    """enable-preprocess + single task -> no container, driver forks the
+    command itself (reference doPreprocessingJob:784-836)."""
+    marker = tmp_path / "ran_in_driver"
+    conf = base_conf(
+        tmp_job_dirs,
+        **{"tony.application.enable-preprocess": True,
+           "tony.worker.instances": 1,
+           "tony.worker.command": f"bash -c 'echo $PPID > {marker}'"},
+    )
+    client = TonyClient(conf, poll_interval_s=0.1)
+    client.submit()
+    assert client.monitor() == JobStatus.SUCCEEDED
+    assert marker.exists()
+    # no executor containers were launched
+    assert not (Path(client.job_dir) / "logs" / "worker_0.stderr").exists()
+
+
+def test_tensorboard_sidecar_registers_url(tmp_job_dirs):
+    conf = base_conf(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.worker.command": "bash -c 'sleep 1'",
+           "tony.tensorboard.instances": 1,
+           "tony.tensorboard.command": "bash -c 'test -n \"$TB_PORT\" && sleep 1'",
+           "tony.application.untracked.jobtypes": "tensorboard"},
+    )
+    client = TonyClient(conf, poll_interval_s=0.1)
+    client.submit()
+    status = client.monitor()
+    assert status == JobStatus.SUCCEEDED
+    assert client.final_state.get("tensorboard_url", "").startswith("http://")
+
+
+# -------------------------------------------------------------------- metrics
+
+def test_metrics_accumulator_avg_max():
+    acc = MetricsAccumulator()
+    for v in (1.0, 3.0, 2.0):
+        acc.observe("rss", v)
+    snap = {m["name"]: m["value"] for m in acc.snapshot()}
+    assert snap["max_rss"] == 3.0
+    assert abs(snap["avg_rss"] - 2.0) < 1e-9

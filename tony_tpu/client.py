@@ -121,6 +121,19 @@ class TonyClient:
             if not dest.exists():
                 shutil.copytree(src, dest)
             self.conf.set(keys.SRC_DIR, str(dest))
+        # per-role resources: path[#alias][::archive] (reference
+        # LocalizableResource.java)
+        from .utils import localization as loc
+
+        for spec in self.conf.role_specs():
+            if not spec.resources:
+                continue
+            staged = loc.stage_resources(
+                loc.parse_resources(spec.resources), self.job_dir
+            )
+            self.conf.set(
+                keys.role_key(spec.name, "resources"), loc.serialize(staged)
+            )
 
     # ------------------------------------------------------------ monitoring
     def _connect(self, timeout_s: float = 60.0) -> RpcClient:

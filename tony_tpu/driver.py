@@ -207,11 +207,41 @@ class Driver:
         tmp.rename(self.job_dir / c.DRIVER_INFO_FILE)
 
     def start_session(self) -> None:
-        """Build scheduler and request capacity — reference start:577-608."""
+        """Build scheduler and request capacity — reference start:577-608.
+        With enable-preprocess and a single-instance job, the driver runs the
+        command itself instead of launching a container (reference
+        doPreprocessingJob:784-836, the notebook/preprocess path)."""
+        if self.conf.get_bool(keys.APPLICATION_ENABLE_PREPROCESS, False):
+            specs = list(self.session.role_specs.values())
+            if len(specs) == 1 and specs[0].instances == 1:
+                threading.Thread(
+                    target=self._run_in_driver, args=(specs[0],), daemon=True
+                ).start()
+                return
+            log.warning("enable-preprocess needs a single-instance job; "
+                        "falling back to container launch")
         self.scheduler = TaskScheduler(
             self.conf, list(self.session.role_specs.values()), self._request_role
         )
         self.scheduler.schedule()
+
+    def _run_in_driver(self, spec: RoleSpec) -> None:
+        import subprocess
+
+        task = self.session.get_task(spec.name, 0)
+        self.session.register_task(task.task_id, self.rpc_server.address[0], -1)
+        if self.events:
+            self.events.emit(task_started(task.task_id, self.rpc_server.address[0]))
+        env = {**os.environ, **self._task_env(spec, 0)}
+        log_dir = self.job_dir / "logs"
+        log_dir.mkdir(parents=True, exist_ok=True)
+        with open(log_dir / f"{spec.name}_0.stdout", "ab") as out:
+            proc = subprocess.Popen(
+                ["bash", "-c", spec.command], env=env,
+                stdout=out, stderr=subprocess.STDOUT,
+            )
+            code = proc.wait()
+        self.on_task_result(task.task_id, code, source="driver")
 
     def _request_role(self, spec: RoleSpec) -> None:
         """Launch all instances of a role — the local/TPU analogue of

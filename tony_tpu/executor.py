@@ -93,9 +93,12 @@ class Executor:
         self.port = self._port_sock.getsockname()[1]
         self.host = self._my_host()
 
+        # TB port: chief of a TB-aware runtime, or a dedicated `tensorboard`
+        # sidecar role (reference TaskExecutor.java:92-99 + sidecar TB,
+        # TonyClient.java:580-609)
         self.tb_port: int | None = None
         self._tb_sock: socket.socket | None = None
-        if self.adapter.need_tb_port() and self.is_chief:
+        if (self.adapter.need_tb_port() and self.is_chief) or self.job_name == "tensorboard":
             self._tb_sock = socket.socket()
             self._tb_sock.bind(("", 0))
             self.tb_port = self._tb_sock.getsockname()[1]
@@ -158,6 +161,8 @@ class Executor:
         )
         monitor.start()
 
+        work_dir = self._prepare_work_dir()
+
         from .runtimes.base import TaskContext
 
         ctx = TaskContext(
@@ -173,7 +178,19 @@ class Executor:
             conf=self.conf,
             tb_port=self.tb_port,
         )
+        ctx.work_dir = work_dir
         monitor.set_context(ctx)
+
+        if self.tb_port is not None:
+            # advertise the TB URL as the job's tracking URL (reference
+            # registerTensorBoardUrl -> YARN tracking URL, AM:976-992)
+            try:
+                self.rpc.call(
+                    "register_tensorboard_url",
+                    url=f"http://{self.host}:{self.tb_port}",
+                )
+            except Exception as e:
+                log.warning("could not register tensorboard url: %s", e)
 
         # release the advertised port(s) just before the user process starts,
         # so the framework can bind them (reference release-before-exec dance,
@@ -210,6 +227,31 @@ class Executor:
         if proc is not None and proc.poll() is None:
             log.error("execution timeout: killing user process")
             proc.kill()
+
+    def _prepare_work_dir(self) -> str | None:
+        """Materialize this role's resources (path[#alias][::archive]) and the
+        staged src dir into a per-task working directory — reference
+        Utils.extractResources (util/Utils.java:758-771)."""
+        if not self.job_dir:
+            return None
+        work = os.path.join(self.job_dir, "workdir", f"{self.job_name}_{self.task_index}")
+        os.makedirs(work, exist_ok=True)
+        from .utils import localization as loc
+
+        raw = str(self.conf.get(keys.role_key(self.job_name, "resources"), "") or "")
+        try:
+            specs = loc.parse_resources(raw.split(",")) if raw else []
+            loc.localize_resources(specs, work)
+        except (OSError, ValueError) as e:
+            log.error("resource localization failed: %s", e)
+        src = str(self.conf.get(keys.SRC_DIR, "") or "")
+        if src and os.path.isdir(src):
+            dest = os.path.join(work, "src")
+            if not os.path.isdir(dest):
+                import shutil
+
+                shutil.copytree(src, dest)
+        return work
 
     def _base_child_env(self) -> dict[str, str]:
         return {

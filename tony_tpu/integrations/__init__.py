@@ -1,0 +1,5 @@
+"""Workflow-system integrations (reference: tony-azkaban)."""
+
+from .workflow import WorkflowJob, props_to_conf
+
+__all__ = ["WorkflowJob", "props_to_conf"]

@@ -1,0 +1,63 @@
+"""Generic workflow-engine shim: job properties -> tony config -> run.
+
+Mirrors tony-azkaban's TonyJob (tony-azkaban/.../TonyJob.java:45-100): a
+workflow engine hands over a flat props map; every ``tony.*`` prop becomes
+config (the reference writes them into a generated tony.xml), workflow
+metadata is attached as application tags, and the job runs through the
+ordinary client. Engine-agnostic: Airflow/Luigi/Azkaban-style callers all
+reduce to a props dict or a .properties file.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Mapping
+
+from ..conf import TonyConf, keys
+
+
+def props_to_conf(props: Mapping[str, str], tags: Mapping[str, str] | None = None) -> TonyConf:
+    """tony.* props become config keys (values coerced like CLI overrides);
+    workflow metadata becomes application tags (reference TonyJob tags the
+    app with flow/project/execution ids)."""
+    from ..conf import _coerce
+
+    conf = TonyConf()
+    for k, v in props.items():
+        if k.startswith("tony."):
+            conf.set(k, _coerce(str(v)))
+    if tags:
+        tag_str = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+        existing = str(conf.get(keys.APPLICATION_TAGS, "") or "")
+        conf.set(keys.APPLICATION_TAGS, ",".join(filter(None, [existing, tag_str])))
+    return conf
+
+
+def load_properties(path: str | Path) -> dict[str, str]:
+    """Parse a java-style .properties file (the azkaban job format)."""
+    props: dict[str, str] = {}
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith(("#", "!")):
+            continue
+        m = re.match(r"^([^=:\s]+)\s*[=:]\s*(.*)$", line)
+        if m:
+            props[m.group(1)] = m.group(2)
+    return props
+
+
+class WorkflowJob:
+    """Programmatic entry for workflow engines: build from props, run()."""
+
+    def __init__(self, props: Mapping[str, str], tags: Mapping[str, str] | None = None):
+        self.conf = props_to_conf(props, tags)
+
+    @classmethod
+    def from_properties_file(cls, path: str | Path, **tags: str) -> "WorkflowJob":
+        return cls(load_properties(path), tags or None)
+
+    def run(self) -> int:
+        from ..client import TonyClient
+
+        return TonyClient(self.conf).run()

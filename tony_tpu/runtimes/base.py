@@ -72,7 +72,9 @@ class TaskAdapter:
         Utils.executeShell:299-328 — minus the hadoop-classpath preamble,
         which has no TPU equivalent)."""
         env = {**os.environ, **ctx.base_child_env, **self.build_env(ctx)}
-        proc = subprocess.Popen(["bash", "-c", ctx.command], env=env)
+        proc = subprocess.Popen(
+            ["bash", "-c", ctx.command], env=env, cwd=ctx.work_dir or None
+        )
         ctx.child_process = proc
         return proc.wait()
 
@@ -106,6 +108,7 @@ class TaskContext:
         self.rpc_client = rpc_client
         self.conf = conf
         self.tb_port = tb_port
+        self.work_dir: str | None = None
         self.child_process: subprocess.Popen | None = None
 
     @property

@@ -1,0 +1,12 @@
+"""Shared utilities."""
+
+from .localization import (
+    ResourceSpec,
+    localize_resources,
+    parse_resources,
+    stage_resources,
+)
+
+__all__ = [
+    "ResourceSpec", "parse_resources", "stage_resources", "localize_resources",
+]
