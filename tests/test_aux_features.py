@@ -144,3 +144,53 @@ def test_metrics_accumulator_avg_max():
     snap = {m["name"]: m["value"] for m in acc.snapshot()}
     assert snap["max_rss"] == 3.0
     assert abs(snap["avg_rss"] - 2.0) < 1e-9
+
+
+# ------------------------------------------------------------ tpu provisioner
+
+def test_tpu_provisioner_discovery_and_geometry():
+    from tony_tpu.cluster.tpu import TpuPodProvisioner, slice_num_hosts
+
+    assert slice_num_hosts("v5litepod-16") == 4
+    assert slice_num_hosts("v5litepod-8") == 1
+    conf = TonyConf({
+        "tony.tpu.discover-command": "printf 'host-a\\nhost-b\\nhost-c\\nhost-d\\n'",
+        "tony.tpu.accelerator-type": "v5litepod-16",
+        "tony.worker.instances": 4,
+        "tony.worker.chips": 4,
+    })
+    prov = TpuPodProvisioner(conf)
+    assert prov.hosts == ["host-a", "host-b", "host-c", "host-d"]
+    prov.validate_layout(conf)  # 4 tpu tasks on 4 hosts: ok
+
+    over = TonyConf({
+        "tony.cluster.static-hosts": "h1,h2",
+        "tony.worker.instances": 3,
+        "tony.worker.chips": 4,
+    })
+    prov2 = TpuPodProvisioner(over)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="slice hosts"):
+        prov2.validate_layout(over)
+
+
+def test_tpu_provisioner_host_count_mismatch():
+    import pytest as _pytest
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    conf = TonyConf({
+        "tony.cluster.static-hosts": "h1,h2,h3",
+        "tony.tpu.accelerator-type": "v5litepod-16",  # expects 4 hosts
+        "tony.worker.instances": 1,
+    })
+    with _pytest.raises(ValueError, match="hosts"):
+        TpuPodProvisioner(conf)
+
+
+def test_step_timer():
+    from tony_tpu.train.profiling import StepTimer
+
+    t = StepTimer(window=5)
+    for _ in range(6):
+        t.tick()
+    assert t.steps_per_sec > 0

@@ -322,3 +322,24 @@ def test_real_jax_distributed_collective(tmp_job_dirs, fixture_script):
            "tony.task.heartbeat-interval-ms": 1000},
     )
     assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_per_task_restart_within_session(tmp_job_dirs, fixture_script, tmp_path):
+    """A non-chief task with a restart budget recovers in-place without a
+    whole-job retry — capability beyond the reference (SURVEY.md §5: no
+    per-task restart in TonY)."""
+    marker = tmp_path / "attempt"
+    # worker:1 fails on its first attempt only; worker:0 (chief) waits briefly
+    cmd = (
+        f"bash -c 'if [ \"$TONY_TASK_INDEX\" = 1 ] && [ ! -f {marker} ]; "
+        f"then touch {marker}; exit 7; fi; exit 0'"
+    )
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 2,
+           "tony.worker.command": cmd,
+           "tony.worker.max-restarts": 2,
+           "tony.application.fail-on-worker-failure-enabled": True},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    assert marker.exists()

@@ -149,6 +149,9 @@ class StaticHostProvisioner(Provisioner):
     (4 hosts) with tony.worker.instances=4 puts one executor per TPU host."""
 
     def __init__(self, hosts: list[str], launch_template: str | None = None) -> None:
+        # _local must exist before super().__init__ touches the
+        # on_completion property this class redirects to it
+        self._local = LocalProvisioner()
         super().__init__()
         if not hosts:
             raise ValueError("StaticHostProvisioner needs at least one host")
@@ -156,7 +159,6 @@ class StaticHostProvisioner(Provisioner):
         self.launch_template = launch_template or (
             "ssh -o BatchMode=yes {host} {env} " + sys.executable + " -m tony_tpu.executor"
         )
-        self._local = LocalProvisioner()
         self._count = 0
         self._lock = threading.Lock()
 
@@ -205,4 +207,10 @@ def create_provisioner(conf: TonyConf) -> Provisioner:
     if kind == "static":
         hosts = conf.get_list(keys.CLUSTER_STATIC_HOSTS)
         return StaticHostProvisioner(hosts)
+    if kind in ("tpu-pod", "tpu"):
+        from .tpu import TpuPodProvisioner
+
+        prov = TpuPodProvisioner(conf)
+        prov.validate_layout(conf)
+        return prov
     raise ValueError(f"unknown provisioner: {kind}")

@@ -1,0 +1,95 @@
+"""TPU pod-slice provisioner.
+
+The capacity model that replaces YARN in the rebuild (SURVEY.md §7): a TPU
+slice is inherently gang-allocated — all hosts of a v5e-16/v5p-... slice
+appear and disappear together — so per-container allocation races vanish and
+the retry unit becomes "re-acquire the slice". One executor process runs per
+TPU host (the reference's one-container-per-host shape,
+TaskExecutor.java:188); `jax.distributed` then spans the slice's chips.
+
+Host discovery options:
+- tony.cluster.static-hosts: explicit host list (pre-created slice)
+- tony.tpu.discover-command: a command printing one worker host per line
+  (e.g. `gcloud compute tpus tpu-vm describe $NAME --format=...`), run at
+  driver start — keeps cloud specifics out of the core.
+
+Slice geometry (chips/host, hosts/slice) for common accelerator types is
+tabulated so validation can reject role layouts that don't fit the slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+
+from ..conf import TonyConf, keys
+from .provisioner import StaticHostProvisioner
+
+log = logging.getLogger(__name__)
+
+# accelerator type -> (chips per host, total chips) for common slices
+SLICE_GEOMETRY: dict[str, tuple[int, int]] = {
+    "v4-8": (4, 4), "v4-16": (4, 8), "v4-32": (4, 16),
+    "v5litepod-1": (1, 1), "v5litepod-4": (4, 4), "v5litepod-8": (8, 8),
+    "v5litepod-16": (4, 16), "v5litepod-32": (4, 32), "v5litepod-64": (4, 64),
+    "v5litepod-128": (4, 128), "v5litepod-256": (4, 256),
+    "v5p-8": (4, 4), "v5p-16": (4, 8), "v5p-32": (4, 16),
+    "v6e-1": (1, 1), "v6e-4": (4, 4), "v6e-8": (8, 8), "v6e-16": (4, 16),
+    "v6e-32": (4, 32), "v6e-64": (4, 64), "v6e-128": (4, 128),
+    "v6e-256": (4, 256),
+}
+
+
+def slice_num_hosts(accelerator_type: str) -> int | None:
+    geom = SLICE_GEOMETRY.get(accelerator_type)
+    if geom is None:
+        return None
+    chips_per_host, total = geom
+    return max(1, total // chips_per_host)
+
+
+def discover_hosts(conf: TonyConf) -> list[str]:
+    hosts = conf.get_list(keys.CLUSTER_STATIC_HOSTS)
+    if hosts:
+        return hosts
+    cmd = str(conf.get(keys.TPU_DISCOVER_COMMAND, "") or "")
+    if cmd:
+        out = subprocess.run(
+            cmd, shell=True, capture_output=True, text=True, timeout=120
+        )
+        if out.returncode != 0:
+            raise RuntimeError(f"tpu host discovery failed: {out.stderr.strip()}")
+        hosts = [h.strip() for h in out.stdout.splitlines() if h.strip()]
+    if not hosts:
+        raise ValueError(
+            "no TPU hosts: set tony.cluster.static-hosts or "
+            + keys.TPU_DISCOVER_COMMAND
+        )
+    return hosts
+
+
+class TpuPodProvisioner(StaticHostProvisioner):
+    """Gang launch over the hosts of one slice."""
+
+    def __init__(self, conf: TonyConf):
+        hosts = discover_hosts(conf)
+        accel = str(conf.get(keys.TPU_ACCELERATOR_TYPE, "") or "")
+        expected = slice_num_hosts(accel) if accel else None
+        if expected is not None and len(hosts) != expected:
+            raise ValueError(
+                f"accelerator {accel} has {expected} hosts, got {len(hosts)}"
+            )
+        super().__init__(hosts)
+        self.accelerator_type = accel
+        log.info("tpu slice: %d hosts (%s)", len(hosts), accel or "unknown type")
+
+    def validate_layout(self, conf: TonyConf) -> None:
+        """Every TPU-holding task needs its own host (libtpu is exclusive
+        per host — the analogue of the reference's GPU isolation)."""
+        total = sum(
+            s.instances for s in conf.role_specs() if s.chips > 0
+        )
+        if total > len(self.hosts):
+            raise ValueError(
+                f"{total} TPU tasks > {len(self.hosts)} slice hosts"
+            )

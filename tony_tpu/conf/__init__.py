@@ -58,6 +58,7 @@ class RoleSpec:
     node_label: str = ""
     depends_on: list[str] = field(default_factory=list)
     max_instances: int = -1
+    max_restarts: int = 0
     env: dict[str, str] = field(default_factory=dict)
     priority: int = 0  # unique per role, like reference YARN priorities
 
@@ -164,6 +165,7 @@ class TonyConf:
                         if s.strip()
                     ],
                     max_instances=int(get("max-instances", -1)),
+                    max_restarts=int(get("max-restarts", 0)),
                     env=env,
                     priority=prio,
                 )

@@ -65,6 +65,7 @@ CLUSTER_PROVISIONER = "tony.cluster.provisioner"  # local|tpu-pod|static
 CLUSTER_STATIC_HOSTS = "tony.cluster.static-hosts"
 TPU_TOPOLOGY = "tony.tpu.topology"  # e.g. v5e-8; "" = discover
 TPU_ACCELERATOR_TYPE = "tony.tpu.accelerator-type"
+TPU_DISCOVER_COMMAND = "tony.tpu.discover-command"  # prints one worker host per line
 
 # ------------------------------------------------------------------ notebook
 NOTEBOOK_TIMEOUT_MS = "tony.notebook.timeout-ms"
@@ -83,6 +84,8 @@ ROLE_KEY_TEMPLATES = (
     "depends-on",
     "max-instances",
     "env",
+    "max-restarts",  # per-task restart budget — exceeds the reference, which
+                     # only supports whole-job AM retry (SURVEY.md §5)
 )
 
 _ROLE_KEY_RE = re.compile(r"^tony\.([A-Za-z][A-Za-z0-9_\-]*)\.instances$")

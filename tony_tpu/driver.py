@@ -162,6 +162,7 @@ class Driver:
         self.events: EventHandler | None = None
         self._handles: dict[str, ContainerHandle] = {}  # task_id -> handle
         self._launch_ms: dict[str, int] = {}            # task_id -> launch time
+        self._restarts: dict[str, int] = {}             # task_id -> restarts used
         self._retries_left = conf.get_int(keys.AM_RETRY_COUNT, 0)
         self._start_ms = now_ms()
 
@@ -306,6 +307,20 @@ class Driver:
         task = self.session.get_task_by_id(task_id)
         if task is None:
             return
+        if source == "executor":
+            # informational: the authoritative completion is the container
+            # exit (reference records registerExecutionResult but completes
+            # tasks from the RM callback, processFinishedContainer:1238-1274)
+            task.exit_code = exit_code
+            return
+        if (
+            exit_code != 0
+            and source == "container"
+            and not task.status.is_terminal()
+            and not self._stop_requested.is_set()
+            and self._try_restart_task(task_id, exit_code)
+        ):
+            return
         already_terminal = task.status.is_terminal()
         name, _, idx = task_id.partition(":")
         self.session.on_task_completed(name, int(idx), exit_code)
@@ -319,6 +334,35 @@ class Driver:
                 )
             if self.scheduler:
                 self.scheduler.on_task_completed(name, exit_code == 0)
+
+    def _try_restart_task(self, task_id: str, exit_code: int) -> bool:
+        """Per-task restart within the same session — a recovery capability
+        the reference lacks (it only supports whole-job AM retry,
+        SURVEY.md §5). Budgeted by tony.<role>.max-restarts."""
+        name, _, idx = task_id.partition(":")
+        spec = self.session.role_specs.get(name)
+        if spec is None or spec.max_restarts <= 0:
+            return False
+        used = self._restarts.get(task_id, 0)
+        if used >= spec.max_restarts:
+            return False
+        self._restarts[task_id] = used + 1
+        log.warning(
+            "task %s exited %d; restarting (%d/%d)",
+            task_id, exit_code, used + 1, spec.max_restarts,
+        )
+        task = self.session.get_task_by_id(task_id)
+        task.status = TaskStatus.REQUESTED
+        env = self._task_env(spec, int(idx))
+        handle = self.provisioner.launch(spec, int(idx), env, self.job_dir / "logs")
+        task.status = TaskStatus.ALLOCATED
+        task.container_id = handle.container_id
+        self._handles[task_id] = handle
+        self._launch_ms[task_id] = now_ms()
+        self.heartbeats.pop(task_id, None)
+        if self.events:
+            self.events.emit(task_started(task_id, handle.host))
+        return True
 
     # --------------------------------------------------------------- monitor
     def monitor(self) -> JobStatus:
@@ -408,6 +452,7 @@ class Driver:
         self.heartbeats.clear()
         self._handles.clear()
         self._launch_ms.clear()
+        self._restarts.clear()
         self.metrics.clear()
 
     # ------------------------------------------------------------------ stop

@@ -1,0 +1,76 @@
+"""Profiling/tracing hooks.
+
+The reference has NO tracing subsystem (SURVEY.md §5: "Tracing/profiling:
+none"); its closest asset is TensorBoard wiring. Here the slot is filled
+properly: JAX profiler traces (xplane protos viewable in TensorBoard's
+profile plugin or Perfetto) captured per-step-window, plus a lightweight
+step-timing log the portal can serve alongside job history.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | Path, enabled: bool = True):
+    """Capture a JAX profiler trace (xplane) into log_dir/plugins/profile."""
+    if not enabled:
+        yield
+        return
+    import jax
+
+    Path(log_dir).mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Rolling step-time stats written as JSONL next to the job's history
+    events — cheap always-on tracing for launch-latency and throughput
+    regressions."""
+
+    def __init__(self, out_path: str | Path | None = None, window: int = 50):
+        self._out = Path(out_path) if out_path else None
+        self._window = window
+        self._t_last: float | None = None
+        self._times: list[float] = []
+        self.step = 0
+
+    def tick(self, **extra) -> float | None:
+        """Call once per training step; returns the last step's duration."""
+        now = time.time()
+        dt = None
+        if self._t_last is not None:
+            dt = now - self._t_last
+            self._times.append(dt)
+            if len(self._times) > self._window:
+                self._times.pop(0)
+        self._t_last = now
+        self.step += 1
+        if self._out and dt is not None and self.step % self._window == 0:
+            rec = {
+                "step": self.step,
+                "mean_step_s": sum(self._times) / len(self._times),
+                "steps_per_sec": len(self._times) / sum(self._times),
+                "ts": now,
+                **extra,
+            }
+            with open(self._out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return dt
+
+    @property
+    def steps_per_sec(self) -> float:
+        if not self._times:
+            return 0.0
+        return len(self._times) / sum(self._times)
