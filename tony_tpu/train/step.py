@@ -32,6 +32,8 @@ class TrainStepBundle:
     rules: shlib.Rules
     config: transformer.TransformerConfig
     optimizer: optax.GradientTransformation
+    param_shardings: Any = None
+    opt_shardings: Any = None
 
 
 def make_optimizer(
@@ -70,10 +72,10 @@ def create_train_step(
         functools.partial(transformer.init, cfg=cfg), out_shardings=param_shardings
     )
     params = init_fn(key)
-    opt_state = jax.jit(
-        optimizer.init,
-        out_shardings=None,  # inherit from params via propagation
-    )(params)
+    opt_shardings = _opt_state_shardings(
+        jax.eval_shape(optimizer.init, params), params, param_shardings, mesh
+    )
+    opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
 
     seq_axis = rules.get("act_seq") if use_ring_attention else None
     tok_sharding = NamedSharding(mesh, P(rules.get("batch"), seq_axis))
@@ -89,14 +91,40 @@ def create_train_step(
 
     step_fn = jax.jit(
         step,
-        in_shardings=(param_shardings, None, tok_sharding, tok_sharding),
-        out_shardings=(param_shardings, None, None),
+        in_shardings=(param_shardings, opt_shardings, tok_sharding, tok_sharding),
+        out_shardings=(param_shardings, opt_shardings, None),
         donate_argnums=(0, 1),
     )
-    return TrainStepBundle(
+    bundle = TrainStepBundle(
         step_fn=step_fn, params=params, opt_state=opt_state, mesh=mesh,
         rules=rules, config=cfg, optimizer=optimizer,
     )
+    bundle.param_shardings = param_shardings
+    bundle.opt_shardings = opt_shardings
+    return bundle
+
+
+def _opt_state_shardings(opt_state_shape, params, param_shardings, mesh):
+    """Shardings for an optax state: subtrees that mirror the param tree
+    (adam mu/nu etc.) take the param shardings — FSDP shards optimizer
+    moments ZeRO-style — and everything else (step counts) is replicated."""
+    params_treedef = jax.tree.structure(params)
+    replicated = NamedSharding(mesh, P())
+
+    def rec(node):
+        if jax.tree.structure(node) == params_treedef and not isinstance(
+            node, jax.ShapeDtypeStruct
+        ):
+            return param_shardings
+        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*(rec(c) for c in node))
+        if isinstance(node, (tuple, list)):
+            return type(node)(rec(c) for c in node)
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return replicated
+
+    return rec(opt_state_shape)
 
 
 def make_forward(
