@@ -1,0 +1,5 @@
+import os, sys
+assert os.environ["RAY_ADDRESS"] == os.environ["RAY_HEAD_ADDRESS"]
+host, port = os.environ["RAY_HEAD_IP"], int(os.environ["RAY_HEAD_PORT"])
+assert host and port > 0
+sys.exit(0)

@@ -343,3 +343,49 @@ def test_per_task_restart_within_session(tmp_job_dirs, fixture_script, tmp_path)
     )
     assert status == JobStatus.SUCCEEDED, dump_logs(client)
     assert marker.exists()
+
+
+def test_driver_crash_reported_to_client(tmp_job_dirs, fixture_script):
+    """Driver dies mid-run (reference TEST_AM_CRASH,
+    ApplicationMaster.java:382-393); the client must detect and not hang."""
+    import os
+
+    os.environ["TONY_TEST_DRIVER_CRASH"] = "1.5"
+    try:
+        status, client = run_job(
+            tmp_job_dirs,
+            **{"tony.worker.instances": 1,
+               "tony.worker.command": f"{PY} {fixture_script('sleep_long.py')}"},
+        )
+    finally:
+        del os.environ["TONY_TEST_DRIVER_CRASH"]
+    assert status in (JobStatus.FAILED, JobStatus.KILLED)
+
+
+def test_registration_timeout(tmp_job_dirs, fixture_script):
+    """A task that launches but never registers fails the job after
+    tony.am.registration-timeout-ms (reference ApplicationMaster.java:1314-1334)."""
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 2,
+           "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}",
+           # worker:1 skews its registration far beyond the timeout
+           "tony.worker.env": "TONY_TEST_EXECUTOR_SKEW=worker#1#600000",
+           "tony.am.registration-timeout-ms": 1500},
+    )
+    assert status == JobStatus.FAILED
+    assert "register" in client.final_state.get("message", "")
+
+
+def test_ray_head_worker_env(tmp_job_dirs, fixture_script):
+    """Ray runtime: head address exported to all tasks (reference
+    ray-on-tony example flow, done natively)."""
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.application.framework": "ray",
+           "tony.head.instances": 1,
+           "tony.head.command": f"{PY} {fixture_script('check_ray_env.py')}",
+           "tony.worker.instances": 2,
+           "tony.worker.command": f"{PY} {fixture_script('check_ray_env.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)

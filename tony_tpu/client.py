@@ -165,12 +165,21 @@ class TonyClient:
                 infos = self._rpc.call("get_task_infos")
             except (ConnectionError, OSError):
                 if self._driver_proc is not None and self._driver_proc.poll() is not None:
-                    # driver died; state is whatever we last saw
+                    # driver died; a non-terminal last-seen state means the
+                    # job did not finish — report failure (reference: client
+                    # keeps polling RM across AM attempts; with no external
+                    # RM, a dead driver IS the terminal signal)
                     log.error("driver process exited (code %s)",
                               self._driver_proc.returncode)
-                    status = JobStatus(self.final_state.get("status", "FAILED")) \
-                        if self.final_state.get("status", "").strip() in JobStatus.__members__ \
+                    last = self.final_state.get("status", "")
+                    status = (
+                        JobStatus(last)
+                        if last in JobStatus.__members__ and JobStatus(last).is_terminal()
                         else JobStatus.FAILED
+                    )
+                    self.final_state.setdefault(
+                        "message", f"driver exited (code {self._driver_proc.returncode})"
+                    )
                     return status
                 time.sleep(self.poll_interval_s)
                 continue

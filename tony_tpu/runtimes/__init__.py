@@ -20,6 +20,7 @@ from .horovod import HorovodDriverAdapter, HorovodTaskAdapter
 from .jax_runtime import JaxDriverAdapter, JaxTaskAdapter
 from .mxnet import MXNetDriverAdapter, MXNetTaskAdapter
 from .pytorch import PyTorchDriverAdapter, PyTorchTaskAdapter
+from .ray import RayDriverAdapter, RayTaskAdapter
 from .tensorflow import TFDriverAdapter, TFTaskAdapter
 
 
@@ -49,6 +50,7 @@ for _name, _d, _t in (
     ("pytorch", PyTorchDriverAdapter, PyTorchTaskAdapter),
     ("mxnet", MXNetDriverAdapter, MXNetTaskAdapter),
     ("horovod", HorovodDriverAdapter, HorovodTaskAdapter),
+    ("ray", RayDriverAdapter, RayTaskAdapter),
     ("standalone", StandaloneDriverAdapter, StandaloneTaskAdapter),
     ("generic", GenericDriverAdapter, GenericTaskAdapter),
 ):

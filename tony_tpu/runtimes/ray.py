@@ -1,0 +1,47 @@
+"""Ray runtime adapter: head/worker roles with head-address bootstrap.
+
+The reference runs Ray through generic roles + a user-side discovery script
+(tony-examples/ray-on-tony: tony.head.command / tony.worker.command, README
+config block). Here it is a first-class runtime: the ``head`` role's
+registered address is exported to every task as RAY_HEAD_ADDRESS /
+RAY_ADDRESS, so worker commands can be plain ``ray start
+--address=$RAY_ADDRESS --block`` with no discovery sidecar.
+"""
+
+from __future__ import annotations
+
+from ..api import DistributedMode
+from .base import TaskContext
+from .generic import GenericDriverAdapter, GenericTaskAdapter
+
+HEAD_ROLE = "head"
+
+
+class RayDriverAdapter(GenericDriverAdapter):
+    def validate_and_update_config(self, conf) -> None:
+        from ..conf import keys
+
+        if conf.get_int(keys.instances_key(HEAD_ROLE), 0) != 1:
+            raise ValueError("ray runtime requires exactly one 'head' instance")
+
+    def can_start_task(self, mode: DistributedMode, task_id: str) -> bool:
+        assert self.session is not None
+        if task_id.startswith(HEAD_ROLE + ":"):
+            return True  # head starts immediately; it IS the rendezvous
+        if mode == DistributedMode.GANG:
+            return self.session.all_registered()
+        # FCFS workers still need the head's address
+        return bool(self.session.cluster_spec().get(HEAD_ROLE))
+
+
+class RayTaskAdapter(GenericTaskAdapter):
+    def build_env(self, ctx: TaskContext) -> dict[str, str]:
+        env = super().build_env(ctx)
+        head = ctx.cluster_spec.get(HEAD_ROLE, [])
+        if head:
+            env["RAY_HEAD_ADDRESS"] = head[0]
+            env["RAY_ADDRESS"] = head[0]
+            host, port = head[0].rsplit(":", 1)
+            env["RAY_HEAD_IP"] = host
+            env["RAY_HEAD_PORT"] = port
+        return env
