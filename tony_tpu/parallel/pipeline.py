@@ -31,16 +31,21 @@ def _pipeline_local(
     stage_params: Any,
     microbatches: jax.Array,  # [M, mb, ...] identical on every device
     axis_name: str,
+    squeeze_stage_dim: bool = True,
 ) -> jax.Array:
     """Runs on one device inside shard_map; stage_params is this device's
-    stage slice (leading stage dim of size 1, squeezed)."""
+    stage slice (leading dim squeezed when it is a single stage; kept when
+    the stage holds a stack of layers — see make_pipeline_stacked)."""
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     m = microbatches.shape[0]
     total = m + n - 1
     mb_shape = microbatches.shape[1:]
 
-    params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), stage_params)
+    if squeeze_stage_dim:
+        params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), stage_params)
+    else:
+        params = stage_params
 
     def tick(carry, t):
         inbox, outputs = carry
@@ -116,3 +121,41 @@ def make_pipeline(
 def stack_stage_params(per_stage_params: list[Any]) -> Any:
     """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim."""
     return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def make_pipeline_stacked(
+    mesh: Mesh,
+    stage_fn: StageFn,
+    num_microbatches: int,
+    axis_name: str = "pipe",
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Pipeline over params whose leading dim is a LAYER stack (n_layers,
+    divisible by the pipe-axis size): sharding that dim over `axis_name`
+    hands each stage its contiguous run of layers, and `stage_fn(local_stack,
+    x)` applies them (typically with lax.scan). This is how the flagship
+    transformer pipelines without re-packing its [n_layers, ...] params."""
+
+    def apply(stacked_params: Any, batch: jax.Array) -> jax.Array:
+        b = batch.shape[0]
+        if b % num_microbatches:
+            raise ValueError(
+                f"batch {b} not divisible by {num_microbatches} microbatches"
+            )
+        mb = b // num_microbatches
+        micro = batch.reshape((num_microbatches, mb) + batch.shape[1:])
+
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+        fn = shard_map(
+            functools.partial(
+                _pipeline_local, stage_fn, axis_name=axis_name,
+                squeeze_stage_dim=False,
+            ),
+            mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        out = fn(stacked_params, micro)
+        return out.reshape((b,) + out.shape[2:])
+
+    return apply

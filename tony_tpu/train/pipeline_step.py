@@ -1,0 +1,115 @@
+"""Pipeline-parallel training step for the flagship transformer.
+
+The `pipe` mesh axis carries contiguous runs of decoder layers: the
+[n_layers, ...] parameter stack is sharded over `pipe` (each stage gets
+n_layers/S layers), embed/unembed stay replicated across the pipe axis, and
+microbatches flow stage-to-stage via the GPipe schedule in
+parallel/pipeline.make_pipeline_stacked. The backward schedule falls out of
+autodiff (ppermute transposes to ppermute, scan reverses).
+
+No reference counterpart (SURVEY.md §2.3: pipeline parallelism absent from
+TonY) — this is a TPU-native capability.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import transformer
+from ..parallel.pipeline import make_pipeline_stacked
+from .step import make_optimizer
+
+
+@dataclass
+class PipelineBundle:
+    step_fn: Callable
+    loss_fn: Callable
+    params: Any
+    opt_state: Any
+    mesh: Mesh
+    config: transformer.TransformerConfig
+
+
+def create_pipeline_train_step(
+    cfg: transformer.TransformerConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    key: jax.Array | None = None,
+    optimizer: optax.GradientTransformation | None = None,
+) -> PipelineBundle:
+    n_stages = mesh.shape["pipe"]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by pipe={n_stages}"
+        )
+    if cfg.n_experts:
+        raise NotImplementedError("pipeline step currently supports dense MLP only")
+    key = jax.random.PRNGKey(0) if key is None else key
+    optimizer = optimizer or make_optimizer()
+
+    params = transformer.init(key, cfg)
+    # layer stack sharded over pipe; everything else replicated
+    layer_shardings = jax.tree.map(
+        lambda _: NamedSharding(mesh, P("pipe")), params["layers"]
+    )
+    repl = NamedSharding(mesh, P())
+    param_shardings = {
+        "embed": repl,
+        "layers": layer_shardings,
+        "final_norm": repl,
+        "unembed": repl,
+    }
+    params = jax.device_put(params, param_shardings)
+    from .step import _opt_state_shardings
+
+    opt_shardings = _opt_state_shardings(
+        jax.eval_shape(optimizer.init, params), params, param_shardings, mesh
+    )
+    opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
+
+    def stage_fn(local_stack, x):
+        """Apply this stage's run of layers; x: [mb, L, d_model]."""
+        b, l, _ = x.shape
+        positions = jnp.broadcast_to(jnp.arange(l), (b, l))
+
+        def body(carry, lp):
+            y, _ = transformer._layer(cfg, None, carry, positions, lp)
+            return y, None
+
+        out, _ = lax.scan(body, x, local_stack)
+        return out
+
+    pipeline = make_pipeline_stacked(mesh, stage_fn, num_microbatches)
+
+    def loss_fn(params, tokens, targets):
+        dt = cfg.dtype
+        x = params["embed"].astype(dt)[tokens]
+        x = pipeline(params["layers"], x)
+        x = transformer.rms_norm(x, params["final_norm"])
+        logits = jnp.einsum(
+            "bld,dv->blv", x, params["unembed"].astype(dt)
+        ).astype(jnp.float32)
+        valid = targets >= 0
+        safe = jnp.where(valid, targets, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss}
+
+    step_fn = jax.jit(step, donate_argnums=(0, 1))
+    return PipelineBundle(
+        step_fn=step_fn, loss_fn=jax.jit(loss_fn), params=params,
+        opt_state=opt_state, mesh=mesh, config=cfg,
+    )
