@@ -389,3 +389,30 @@ def test_ray_head_worker_env(tmp_job_dirs, fixture_script):
            "tony.worker.command": f"{PY} {fixture_script('check_ray_env.py')}"},
     )
     assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_client_callback_api(tmp_job_dirs, fixture_script):
+    """Programmatic embedding API: CallbackHandler.on_application_id_received
+    + TaskUpdateListener (reference client/CallbackHandler.java,
+    TestTonyE2E.java:430)."""
+    seen = {"app_id": None, "updates": 0}
+
+    class Handler:
+        def on_application_id_received(self, app_id):
+            seen["app_id"] = app_id
+
+    client = TonyClient(
+        base_conf(
+            tmp_job_dirs,
+            **{"tony.worker.instances": 1,
+               "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}"},
+        ),
+        callback_handler=Handler(),
+        poll_interval_s=0.1,
+    )
+    client.add_listener(lambda infos: seen.__setitem__("updates", seen["updates"] + 1))
+    client.submit()
+    status = client.monitor()
+    assert status == JobStatus.SUCCEEDED
+    assert seen["app_id"] == client.app_id
+    assert seen["updates"] >= 1

@@ -101,9 +101,15 @@ class DriverService:
 
     def get_application_state(self):
         d = self._d
+        status = d.session.status
+        # a failure with retry budget left is not terminal for the client —
+        # the reference client polls through AM attempts (the app report
+        # stays RUNNING until the last attempt gives up)
+        if status == JobStatus.FAILED and d._retries_left > 0 and not d.finalized:
+            status = JobStatus.RUNNING
         return {
             "app_id": d.app_id,
-            "status": d.session.status.value,
+            "status": status.value,
             "message": d.session.failure_message,
             "session_id": d.session.session_id,
             "tensorboard_url": d.tensorboard_url,
@@ -135,6 +141,7 @@ class Driver:
         self.metrics: dict[str, list[dict[str, Any]]] = {}
         self.heartbeats: dict[str, float] = {}
         self.client_signal = threading.Event()
+        self.finalized = False
         self._stop_requested = threading.Event()
         self.mode = DistributedMode(
             str(conf.get(keys.APPLICATION_DISTRIBUTED_MODE, "GANG")).upper()
@@ -181,6 +188,7 @@ class Driver:
                     )
                     self.reset()
                     continue
+                self.finalized = True
                 return status
         finally:
             self.stop()
