@@ -1,0 +1,62 @@
+"""Port reservation + version stamping (reference TestPortAllocation.java,
+VersionInfo)."""
+
+import socket
+
+import pytest
+
+from tony_tpu.conf import TonyConf
+from tony_tpu.utils import ports, version
+
+
+def test_ephemeral_port_release_then_rebind():
+    res = ports.EphemeralPort.create()
+    assert res.port > 0 and res.held
+    # held: a plain bind to the same port collides
+    with pytest.raises(OSError):
+        s = socket.socket()
+        try:
+            s.bind(("", res.port))
+        finally:
+            s.close()
+    res.release()
+    assert not res.held
+    # released: the child can now bind it (the reference's race window)
+    s = socket.socket()
+    s.bind(("", res.port))
+    s.close()
+
+
+@pytest.mark.skipif(not ports.reuse_port_supported(), reason="no SO_REUSEPORT")
+def test_reusable_port_binds_while_held():
+    with ports.ReusablePort.create() as res:
+        # a child that sets SO_REUSEPORT binds the same port with NO release
+        child = socket.socket()
+        child.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        child.bind(("", res.port))
+        child.close()
+        # a child that does NOT set it still collides -> reservation is real
+        plain = socket.socket()
+        with pytest.raises(OSError):
+            plain.bind(("", res.port))
+        plain.close()
+
+
+def test_allocate_strategy_selection():
+    eph = ports.allocate(reuse=False)
+    assert isinstance(eph, ports.EphemeralPort)
+    eph.release()
+    want = ports.ReusablePort if ports.reuse_port_supported() else ports.EphemeralPort
+    r = ports.allocate(reuse=True)
+    assert isinstance(r, want)
+    r.release()
+
+
+def test_version_info_stamped_into_conf():
+    info = version.version_info()
+    assert info[version.VERSION_KEY] == version.VERSION
+    assert info[version.REVISION_KEY]
+    conf = TonyConf({"tony.worker.instances": 1, "tony.worker.command": "true"})
+    version.inject(conf)
+    assert conf.get(version.VERSION_KEY) == version.VERSION
+    assert conf.get(version.BRANCH_KEY)

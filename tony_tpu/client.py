@@ -82,6 +82,11 @@ class TonyClient:
             secrets.token_hex(16)
             if self.conf.get_bool(keys.SECURITY_TOKEN_ENABLED, True) else ""
         )
+        # stamp framework build identity into the frozen config (reference
+        # VersionInfo injection, TonyClient.java:195)
+        from .utils import version
+
+        version.inject(self.conf)
         self.conf.write_final(self.job_dir)
 
         env = {**os.environ, c.ENV_TOKEN: self.token}

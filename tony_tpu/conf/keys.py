@@ -41,6 +41,8 @@ TASK_MAX_MISSED_HEARTBEATS = "tony.task.max-missed-heartbeats"
 TASK_METRICS_INTERVAL_MS = "tony.task.metrics-interval-ms"
 TASK_REGISTRATION_POLL_MS = "tony.task.registration-poll-interval-ms"
 TASK_EXECUTOR_EXECUTION_TIMEOUT_MS = "tony.task.executor.execution-timeout-ms"
+TASK_PORT_REUSE_ENABLED = "tony.task.port-reuse-enabled"      # SO_REUSEPORT rendezvous port
+TASK_TB_PORT_REUSE_ENABLED = "tony.task.tb-port-reuse-enabled"  # SO_REUSEPORT TB port
 TASK_MAX_TOTAL_INSTANCES = "tony.task.max-total-instances"
 TASK_MAX_TOTAL_MEMORY_MB = "tony.task.max-total-memory-mb"
 TASK_MAX_TOTAL_CHIPS = "tony.task.max-total-chips"

@@ -102,10 +102,12 @@ class DriverService:
     def get_application_state(self):
         d = self._d
         status = d.session.status
-        # a failure with retry budget left is not terminal for the client —
-        # the reference client polls through AM attempts (the app report
-        # stays RUNNING until the last attempt gives up)
-        if status == JobStatus.FAILED and d._retries_left > 0 and not d.finalized:
+        # a failure before the driver finalizes is not terminal for the client
+        # — the reference client polls through AM attempts (the app report
+        # stays RUNNING until the last attempt gives up). run() flips
+        # `finalized` before returning, so gating on it alone is race-free
+        # even in the window between the last attempt's failure and its reset.
+        if status == JobStatus.FAILED and not d.finalized:
             status = JobStatus.RUNNING
         return {
             "app_id": d.app_id,
@@ -191,6 +193,9 @@ class Driver:
                 self.finalized = True
                 return status
         finally:
+            # also reached via exceptions out of start_session/monitor/reset:
+            # the state the client reads must go terminal either way
+            self.finalized = True
             self.stop()
 
     def prepare(self) -> None:

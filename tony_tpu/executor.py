@@ -85,23 +85,29 @@ class Executor:
         framework = str(self.conf.get(keys.APPLICATION_FRAMEWORK, "jax"))
         self.adapter = get_runtime(framework).task_adapter()
 
-        # the port this task advertises for its framework's rendezvous: a real
-        # bound socket released just before exec (coordination port for jax,
-        # TF server port for tensorflow, c10d port for worker-0 pytorch)
-        self._port_sock = socket.socket()
-        self._port_sock.bind(("", 0))
-        self.port = self._port_sock.getsockname()[1]
+        # the port this task advertises for its framework's rendezvous
+        # (coordination port for jax, TF server port for tensorflow, c10d port
+        # for worker-0 pytorch). Ephemeral reservations are released just
+        # before exec; SO_REUSEPORT reservations are held across it
+        # (reference setupPorts:88-100 + ReusablePort opt-in :119-152)
+        from .utils import ports
+
+        self._port_res = ports.allocate(
+            self.conf.get_bool(keys.TASK_PORT_REUSE_ENABLED, False)
+        )
+        self.port = self._port_res.port
         self.host = self._my_host()
 
         # TB port: chief of a TB-aware runtime, or a dedicated `tensorboard`
         # sidecar role (reference TaskExecutor.java:92-99 + sidecar TB,
         # TonyClient.java:580-609)
         self.tb_port: int | None = None
-        self._tb_sock: socket.socket | None = None
+        self._tb_res: ports.ServerPort | None = None
         if (self.adapter.need_tb_port() and self.is_chief) or self.job_name == "tensorboard":
-            self._tb_sock = socket.socket()
-            self._tb_sock.bind(("", 0))
-            self.tb_port = self._tb_sock.getsockname()[1]
+            self._tb_res = ports.allocate(
+                self.conf.get_bool(keys.TASK_TB_PORT_REUSE_ENABLED, False)
+            )
+            self.tb_port = self._tb_res.port
 
     def _my_host(self) -> str:
         # route-based local address discovery; falls back to loopback for the
@@ -192,12 +198,13 @@ class Executor:
             except Exception as e:
                 log.warning("could not register tensorboard url: %s", e)
 
-        # release the advertised port(s) just before the user process starts,
-        # so the framework can bind them (reference release-before-exec dance,
-        # TaskExecutor.java:201-233)
-        self._port_sock.close()
-        if self._tb_sock is not None:
-            self._tb_sock.close()
+        # release ephemeral reservations just before the user process starts,
+        # so the framework can bind them; SO_REUSEPORT reservations stay held
+        # through the exec — the child rebinds with no race window (reference
+        # release-before-exec dance, TaskExecutor.java:201-233)
+        self._port_res.release_before_exec()
+        if self._tb_res is not None:
+            self._tb_res.release_before_exec()
 
         timeout_ms = self.conf.get_int(keys.TASK_EXECUTOR_EXECUTION_TIMEOUT_MS, 0)
         if timeout_ms > 0:
@@ -213,6 +220,9 @@ class Executor:
         finally:
             heartbeater.stop_event.set()
             monitor.stop()
+            self._port_res.release()
+            if self._tb_res is not None:
+                self._tb_res.release()
 
         try:
             self.rpc.call(
