@@ -267,6 +267,43 @@ def test_missed_heartbeats_fail_job(tmp_job_dirs, fixture_script):
     assert "heartbeat" in client.final_state.get("message", "")
 
 
+def test_delayed_completion_does_not_fail_finished_task(tmp_job_dirs, fixture_script):
+    """The container-completion callback is delayed far beyond heartbeat
+    expiry; a task that already reported success must NOT be deemed dead
+    (the HB-unregister race, reference
+    TEST_TASK_COMPLETION_NOTIFICATION_DELAYED, ApplicationMaster.java:1075-1087)."""
+    os.environ["TONY_TEST_COMPLETION_NOTIFICATION_DELAY_MS"] = "3000"
+    try:
+        status, client = run_job(
+            tmp_job_dirs,
+            **{"tony.worker.instances": 1,
+               "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}",
+               "tony.task.heartbeat-interval-ms": 100,
+               "tony.task.max-missed-heartbeats": 3},
+        )
+    finally:
+        del os.environ["TONY_TEST_COMPLETION_NOTIFICATION_DELAY_MS"]
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_worker_termination_on_chief_registration(tmp_job_dirs, fixture_script):
+    """The driver kills a listed worker once the chief registers (reference
+    TEST_WORKER_TERMINATION, ApplicationMaster.java:1338-1349 +
+    testAMStopsJobAfterWorker0Killed)."""
+    os.environ["TONY_TEST_WORKER_TERMINATION"] = "worker:1"
+    try:
+        status, client = run_job(
+            tmp_job_dirs,
+            **{"tony.worker.instances": 2,
+               "tony.worker.command": f"{PY} {fixture_script('sleep_long.py')}",
+               "tony.application.fail-on-worker-failure-enabled": True},
+        )
+    finally:
+        del os.environ["TONY_TEST_WORKER_TERMINATION"]
+    assert status == JobStatus.FAILED, dump_logs(client)
+    assert "worker:1 failed" in client.final_state.get("message", "")
+
+
 def test_straggler_skew_still_passes(tmp_job_dirs, fixture_script):
     """Gang barrier holds through a 2s straggler (reference
     TEST_TASK_EXECUTOR_SKEW, TaskExecutor.java:366-386)."""

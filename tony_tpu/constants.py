@@ -39,6 +39,15 @@ TEST_DRIVER_CRASH = "TONY_TEST_DRIVER_CRASH"                # driver exits mid-r
 TEST_EXECUTOR_NUM_HB_MISS = "TONY_TEST_EXECUTOR_NUM_HB_MISS"  # skip N heartbeats
 TEST_EXECUTOR_SKEW = "TONY_TEST_EXECUTOR_SKEW"              # "job#idx#ms" straggler
 TEST_TASK_EXECUTOR_CRASH = "TONY_TEST_TASK_EXECUTOR_CRASH"  # executor dies pre-register
+TEST_WORKER_TERMINATION = "TONY_TEST_WORKER_TERMINATION"    # comma list of task_ids the
+                                                            # driver kills once the chief
+                                                            # registers (reference
+                                                            # AM:1338-1349)
+TEST_COMPLETION_DELAY_MS = "TONY_TEST_COMPLETION_NOTIFICATION_DELAY_MS"
+                                                            # delay the container-completion
+                                                            # callback to exercise the
+                                                            # HB-expiry/completion race
+                                                            # (reference AM:1075-1087)
 
 # ---- exit codes
 EXIT_SUCCESS = 0

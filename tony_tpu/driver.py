@@ -57,6 +57,15 @@ class DriverService:
         d.heartbeats[task_id] = time.time()
         log.info("registered %s at %s:%s (%d/%d)", task_id, host, port,
                  d.session.registered_count(), len(d.session.all_tasks()))
+        # fault injection: kill listed tasks once the chief registers
+        # (reference TEST_WORKER_TERMINATION, ApplicationMaster.java:1338-1349)
+        victims = os.environ.get(c.TEST_WORKER_TERMINATION, "")
+        if victims and d.session.is_chief(task.name, task.index):
+            def _terminate():
+                for victim in victims.split(","):
+                    log.warning("fault injection: terminating %s", victim)
+                    d._kill_task(victim.strip())
+            threading.Thread(target=_terminate, daemon=True).start()
         return self.get_cluster_spec(task_id)
 
     def get_cluster_spec(self, task_id: str):
@@ -314,6 +323,21 @@ class Driver:
         """Provisioner watcher callback — reference
         processFinishedContainer:1238-1274."""
         task_id = f"{handle.role}:{handle.index}"
+        # fault injection: hold back the completion notification so heartbeat
+        # expiry races it (reference TEST_TASK_COMPLETION_NOTIFICATION_DELAYED,
+        # ApplicationMaster.java:1075-1087); runs on the per-container watcher
+        # thread, so sleeping stalls only this callback
+        try:
+            delay_ms = int(os.environ.get(c.TEST_COMPLETION_DELAY_MS, "0"))
+        except ValueError:
+            # a bad test knob must degrade to no-delay, not swallow the
+            # completion callback and hang the job
+            log.error("bad %s value; ignoring", c.TEST_COMPLETION_DELAY_MS)
+            delay_ms = 0
+        if delay_ms:
+            log.warning("fault injection: delaying completion of %s by %dms",
+                        task_id, delay_ms)
+            time.sleep(delay_ms / 1000)
         self.on_task_result(task_id, exit_code, source="container")
 
     def on_task_result(self, task_id: str, exit_code: int, source: str) -> None:
@@ -323,8 +347,13 @@ class Driver:
         if source == "executor":
             # informational: the authoritative completion is the container
             # exit (reference records registerExecutionResult but completes
-            # tasks from the RM callback, processFinishedContainer:1238-1274)
+            # tasks from the RM callback, processFinishedContainer:1238-1274).
+            # The task stops heartbeating now, so unregister it from liveness
+            # — otherwise a delayed completion notification lets heartbeat
+            # expiry declare a finished task dead and fail the job (the race
+            # the reference's HB-unregister handling covers, AM:1075-1087)
             task.exit_code = exit_code
+            self.heartbeats.pop(task_id, None)
             return
         if (
             exit_code != 0
@@ -366,6 +395,7 @@ class Driver:
         )
         task = self.session.get_task_by_id(task_id)
         task.status = TaskStatus.REQUESTED
+        task.exit_code = None  # re-arm heartbeat liveness for the new attempt
         env = self._task_env(spec, int(idx))
         handle = self.provisioner.launch(spec, int(idx), env, self.job_dir / "logs")
         task.status = TaskStatus.ALLOCATED
@@ -397,10 +427,14 @@ class Driver:
                 self.session.kill_all(f"application timed out after {timeout_ms}ms")
                 return JobStatus.KILLED
 
-            # 2. heartbeat expiry (reference onTaskDeemedDead:1229-1236)
+            # 2. heartbeat expiry (reference onTaskDeemedDead:1229-1236).
+            # A task whose executor already reported its result has stopped
+            # heartbeating legitimately — skip it even if an in-flight
+            # heartbeat RPC re-inserted it after the unregister (the
+            # completion-notification race, AM:1075-1087)
             for task_id, last in list(self.heartbeats.items()):
                 task = self.session.get_task_by_id(task_id)
-                if task is None or task.status.is_terminal():
+                if task is None or task.status.is_terminal() or task.exit_code is not None:
                     continue
                 if now - last > hb_expiry_s:
                     msg = f"task {task_id} missed {max_missed} heartbeats; deemed dead"
