@@ -1,0 +1,20 @@
+"""Debug rendezvous driver fixture (reference horovod_debug_driver.py): bind
+a real TCP server, publish its port via the marker file, then serve forever."""
+import json
+import os
+import socket
+import time
+
+sock = socket.socket()
+sock.bind(("0.0.0.0", 0))
+sock.listen(8)
+port = sock.getsockname()[1]
+
+marker = os.environ["HOROVOD_RDV_INFO_FILE"]
+tmp = marker + ".tmp"
+with open(tmp, "w") as f:
+    json.dump({"port": port}, f)
+os.rename(tmp, marker)
+
+while True:
+    time.sleep(3600)

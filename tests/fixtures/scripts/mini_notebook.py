@@ -1,0 +1,20 @@
+"""Notebook stand-in: an HTTP server on the task's advertised port."""
+import http.server
+import os
+
+port = int(os.environ["TONY_TASK_PORT"])
+
+
+class H(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = b"mini-notebook-ok"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+http.server.HTTPServer(("0.0.0.0", port), H).serve_forever()

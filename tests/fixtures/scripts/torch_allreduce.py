@@ -1,0 +1,19 @@
+"""Real torch.distributed (gloo) allreduce via the env contract the pytorch
+runtime adapter exports — proves the rendezvous bootstrap end-to-end."""
+import os
+import sys
+
+import torch
+import torch.distributed as dist
+
+rank = int(os.environ["RANK"])
+world = int(os.environ["WORLD"])
+dist.init_process_group(
+    "gloo", init_method=os.environ["INIT_METHOD"], rank=rank, world_size=world,
+)
+t = torch.tensor([float(rank + 1)])
+dist.all_reduce(t)
+expected = world * (world + 1) / 2
+assert t.item() == expected, (t.item(), expected)
+dist.destroy_process_group()
+sys.exit(0)

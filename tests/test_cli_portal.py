@@ -27,6 +27,45 @@ def test_cli_local_submit(tmp_job_dirs, fixture_script, capsys):
     assert rc == 0
 
 
+def test_cli_notebook_proxy_fetch(tmp_job_dirs, fixture_script):
+    """Notebook submitter end-to-end: single-node app + local tunnel, HTTP
+    round-trip through the proxy (reference NotebookSubmitter.java:71-133)."""
+    import re
+    import subprocess
+
+    proc = subprocess.Popen(
+        [PY, "-m", "tony_tpu.cli.main", "notebook",
+         "--command", f"{PY} {fixture_script('mini_notebook.py')}",
+         "--timeout-ms", "120000",
+         "-D", f"tony.staging.dir={tmp_job_dirs['staging']}",
+         "-D", f"tony.history.intermediate={tmp_job_dirs['history']}/intermediate",
+         "-D", "tony.am.monitor-interval-ms=100"],
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        url = None
+        for line in proc.stderr:
+            m = re.search(r"notebook reachable at (http://\S+)", line)
+            if m:
+                url = m.group(1)
+                break
+        assert url, "notebook tunnel URL never printed"
+        # the notebook server may take a beat to bind after RUNNING
+        body = b""
+        for _ in range(50):
+            try:
+                body = urllib.request.urlopen(url, timeout=2).read()
+                break
+            except OSError:
+                import time
+
+                time.sleep(0.2)
+        assert body == b"mini-notebook-ok"
+    finally:
+        proc.terminate()  # CLI's SIGTERM hook kills the whole app tree
+        proc.wait(timeout=10)
+
+
 def test_cli_local_failure_exit_code(tmp_job_dirs, fixture_script):
     rc = cli_main([
         "local",

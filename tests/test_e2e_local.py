@@ -179,6 +179,47 @@ def test_horovod_two_phase_rendezvous(tmp_job_dirs, fixture_script):
     assert roles == {"worker", "driver"}, "driver role must be injected"
 
 
+def test_real_torch_distributed_allreduce(tmp_job_dirs, fixture_script):
+    """2 workers join a real c10d gloo group from the emitted INIT_METHOD
+    contract and allreduce — the pytorch analogue of the jax.distributed
+    collective e2e (reference mnist-pytorch example contract)."""
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.application.framework": "pytorch",
+           "tony.worker.instances": 2,
+           "tony.worker.command": f"{PY} {fixture_script('torch_allreduce.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_horovod_driver_fast_fail(tmp_job_dirs, fixture_script):
+    """Rendezvous driver crash fails the whole job fast via untracked-task
+    fast-fail (reference testHorovodDriverCrash / horovod_driver.py -f)."""
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.application.framework": "horovod",
+           "tony.horovod.driver.fast-fail": True,
+           "tony.worker.instances": 2,
+           "tony.worker.command": f"{PY} {fixture_script('sleep_long.py')}"},
+    )
+    assert status == JobStatus.FAILED, dump_logs(client)
+    assert "driver" in client.final_state.get("message", "")
+
+
+def test_horovod_debug_driver(tmp_job_dirs, fixture_script):
+    """User-supplied rendezvous driver published via the marker file
+    (reference testHorovodDebugModeShouldPass, TestTonyE2E.java:531-589)."""
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.application.framework": "horovod",
+           "tony.horovod.driver.debug-command":
+               f"{PY} {fixture_script('horovod_debug_driver.py')}",
+           "tony.worker.instances": 2,
+           "tony.worker.command": f"{PY} {fixture_script('check_horovod_env.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
 def test_standalone_mode(tmp_job_dirs, fixture_script):
     status, client = run_job(
         tmp_job_dirs,
@@ -397,6 +438,66 @@ def test_driver_crash_reported_to_client(tmp_job_dirs, fixture_script):
     finally:
         del os.environ["TONY_TEST_DRIVER_CRASH"]
     assert status in (JobStatus.FAILED, JobStatus.KILLED)
+
+
+def test_executor_dies_with_driver(tmp_job_dirs, fixture_script):
+    """Executors must not outlive a hard-killed driver: the heartbeater's
+    driver-loss watchdog kills the user process and exits (the role YARN
+    plays in the reference by reaping a dead AM's containers)."""
+    import signal as _signal
+    import subprocess
+
+    client = TonyClient(
+        base_conf(
+            tmp_job_dirs,
+            **{"tony.worker.instances": 1,
+               "tony.worker.command": f"{PY} {fixture_script('sleep_long.py')}",
+               "tony.task.heartbeat-interval-ms": 100,
+               "tony.task.max-missed-heartbeats": 5},
+        ),
+        poll_interval_s=0.1,
+    )
+    client.submit()
+    # wait for the worker to be RUNNING, then SIGKILL the driver process
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if client._driver_proc.poll() is not None:
+            raise AssertionError("driver died early:\n" + dump_logs(client))
+        infos = {t.task_id: t.status for t in client._poll_task_infos()} \
+            if hasattr(client, "_poll_task_infos") else {}
+        if _job_executors(client.app_id):
+            break
+        time.sleep(0.2)
+    executors = _job_executors(client.app_id)
+    assert executors, "no executor process found"
+    os.kill(client._driver_proc.pid, _signal.SIGKILL)
+    # watchdog: 5 missed beats at 100ms + fast-fail rpc -> seconds, not minutes
+    deadline = time.time() + 20
+    while time.time() < deadline and _job_executors(client.app_id):
+        time.sleep(0.5)
+    leftover = _job_executors(client.app_id)
+    for pid in leftover:
+        os.kill(pid, _signal.SIGKILL)
+    assert not leftover, f"executors outlived the driver: {leftover}"
+
+
+def _job_executors(app_id: str) -> list[int]:
+    """Pids of tony_tpu.executor processes belonging to this job (matched by
+    the TONY_APP_ID in their environment, so concurrent jobs don't collide)."""
+    import subprocess
+
+    out = subprocess.run(
+        ["pgrep", "-f", "tony_tpu.executor"], capture_output=True, text=True
+    )
+    pids = []
+    for p in out.stdout.split():
+        try:
+            environ = Path(f"/proc/{p}/environ").read_bytes()
+            if app_id.encode() in environ:
+                pids.append(int(p))
+        except OSError:
+            continue
+    return pids
 
 
 def test_registration_timeout(tmp_job_dirs, fixture_script):

@@ -37,13 +37,16 @@ def _build_client(args, extra: dict | None = None):
     for k, v in (extra or {}).items():
         conf.set(k, v)
     client = TonyClient(conf)
-    # shutdown hook force-kills the app, like ClusterSubmitter.java:49-84
-    def _on_sigint(signum, frame):
+    # shutdown hook force-kills the app, like ClusterSubmitter.java:49-84 —
+    # on SIGTERM too, or a terminated CLI leaks the whole job tree (the
+    # driver/executors are in their own session and survive us)
+    def _on_signal(signum, frame):
         print("interrupt: killing application", file=sys.stderr)
         client.stop()
-        sys.exit(130)
+        sys.exit(128 + signum)
 
-    signal.signal(signal.SIGINT, _on_sigint)
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
     return client
 
 

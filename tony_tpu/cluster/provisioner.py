@@ -188,6 +188,11 @@ class StaticHostProvisioner(Provisioner):
             container_id=f"static_{host}_{spec.name}_{index}",
             host=host, role=spec.name, index=index, process=proc,
         )
+        # register with the inner provisioner so stop_all() reaps the ssh
+        # client processes (sshd then tears down the remote session, taking
+        # the remote executor with it)
+        with self._local._lock:
+            self._local._handles[handle.container_id] = handle
         threading.Thread(
             target=self._local._watch, args=(handle, stdout, stderr), daemon=True
         ).start()

@@ -69,8 +69,11 @@ TPU_TOPOLOGY = "tony.tpu.topology"  # e.g. v5e-8; "" = discover
 TPU_ACCELERATOR_TYPE = "tony.tpu.accelerator-type"
 TPU_DISCOVER_COMMAND = "tony.tpu.discover-command"  # prints one worker host per line
 
-# ------------------------------------------------------------------ notebook
-NOTEBOOK_TIMEOUT_MS = "tony.notebook.timeout-ms"
+# ------------------------------------------------------------------ horovod
+HOROVOD_TEST_MODE = "tony.horovod.mode.test"              # stub rendezvous server
+HOROVOD_FAST_FAIL = "tony.horovod.driver.fast-fail"       # driver exits 1 at once
+HOROVOD_DEBUG_COMMAND = "tony.horovod.driver.debug-command"  # user-supplied driver
+HOROVOD_DRIVER_START_TIMEOUT_MS = "tony.horovod.driver.start-timeout-ms"
 
 # ----------------------------------------------------------- per-role templates
 # reference: tony.<job>.{instances,memory,vcores,gpus,command,resources,
@@ -93,7 +96,7 @@ ROLE_KEY_TEMPLATES = (
 _ROLE_KEY_RE = re.compile(r"^tony\.([A-Za-z][A-Za-z0-9_\-]*)\.instances$")
 _RESERVED_NON_ROLES = frozenset(
     {"application", "am", "task", "staging", "history", "cluster", "tpu",
-     "notebook", "security", "execution"}
+     "security", "execution", "horovod", "version"}
 )
 
 

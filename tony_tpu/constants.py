@@ -23,6 +23,9 @@ ENV_TASK_COMMAND = "TONY_TASK_COMMAND"    # user command for this role
 # ---- executor -> user-process env (consumed by training scripts)
 ENV_CLUSTER_SPEC = "CLUSTER_SPEC"         # JSON role -> [host:port]
 ENV_TB_PORT = "TB_PORT"
+ENV_TASK_PORT = "TONY_TASK_PORT"  # the port this task advertised to the driver
+                                  # (what clients/proxies will connect to — a
+                                  # notebook server must bind it)
 
 # JAX runtime contract (replaces TF_CONFIG/Gloo/DMLC matrix — SURVEY.md §5):
 ENV_COORDINATOR_ADDRESS = "TONY_COORDINATOR_ADDRESS"

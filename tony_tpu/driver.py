@@ -541,6 +541,28 @@ def main(argv: list[str] | None = None) -> int:
     token = os.environ.get(c.ENV_TOKEN, "")
     driver = Driver(conf, app_id=args.app_id, job_dir=args.job_dir, token=token)
 
+    # a killed driver must take its containers with it: executors run in
+    # their own process groups (so the driver's own group kill can't reach
+    # them) — mirror the reference AM shutdown hook stopping containers
+    import signal as _signal
+
+    def _teardown(signum):
+        try:
+            driver.provisioner.stop_all()
+        finally:
+            os._exit(128 + signum)
+
+    def _on_term(signum, frame):
+        # do the actual teardown on a helper thread: stop_all takes the
+        # provisioner lock, which the interrupted main thread may hold —
+        # blocking inside the handler would self-deadlock; returning lets
+        # the main thread release it
+        log.warning("signal %d: stopping all containers and exiting", signum)
+        threading.Thread(target=_teardown, args=(signum,), daemon=True).start()
+
+    _signal.signal(_signal.SIGTERM, _on_term)
+    _signal.signal(_signal.SIGINT, _on_term)
+
     if os.environ.get(c.TEST_DRIVER_CRASH):
         def _crash_later():
             time.sleep(float(os.environ[c.TEST_DRIVER_CRASH]))

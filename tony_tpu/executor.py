@@ -36,17 +36,24 @@ log = logging.getLogger(__name__)
 
 class Heartbeater(threading.Thread):
     """Reference TaskExecutor.Heartbeater:324-364, including the
-    skip-N-heartbeats fault hook."""
+    skip-N-heartbeats fault hook. Doubles as the driver-death watchdog: when
+    heartbeats fail `max_failures` times in a row the driver is gone, and the
+    executor must not outlive it (the role YARN plays in the reference by
+    reaping containers of a dead AM)."""
 
-    def __init__(self, client: RpcClient, task_id: str, interval_s: float):
+    def __init__(self, client: RpcClient, task_id: str, interval_s: float,
+                 max_failures: int = 30, on_driver_lost=None):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
         self._interval = interval_s
         self._skip = int(os.environ.get(c.TEST_EXECUTOR_NUM_HB_MISS, "0"))
+        self._max_failures = max_failures
+        self._on_driver_lost = on_driver_lost
         self.stop_event = threading.Event()
 
     def run(self) -> None:
+        failures = 0
         while not self.stop_event.wait(self._interval):
             if self._skip > 0:
                 self._skip -= 1
@@ -54,8 +61,16 @@ class Heartbeater(threading.Thread):
                 continue
             try:
                 self._client.call("heartbeat", task_id=self._task_id)
+                failures = 0
             except Exception as e:
-                log.warning("heartbeat failed: %s", e)
+                failures += 1
+                log.warning("heartbeat failed (%d/%d): %s",
+                            failures, self._max_failures, e)
+                if failures >= self._max_failures and self._on_driver_lost:
+                    log.error("driver unreachable for %d heartbeats; giving up",
+                              failures)
+                    self._on_driver_lost()
+                    return
 
 
 class Executor:
@@ -157,10 +172,34 @@ class Executor:
             return 3
 
         hb_interval = self.conf.get_int(keys.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000
-        payload = self.register_and_get_cluster_spec()
+        ctx_holder: dict = {}
 
-        heartbeater = Heartbeater(self.rpc, self.task_id, hb_interval)
+        def _die_with_driver() -> None:
+            proc = getattr(ctx_holder.get("ctx"), "child_process", None)
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+            os._exit(c.EXIT_KILLED)
+
+        # dedicated fast-fail client: the shared client retries each call for
+        # ~a minute (and serializes with the metrics monitor on its lock),
+        # which would stretch the watchdog by orders of magnitude — here one
+        # failed call must count as exactly one missed heartbeat. Started
+        # BEFORE the gang barrier so a driver that dies mid-registration
+        # still takes this executor down promptly.
+        hb_rpc = RpcClient(
+            self.driver_host, self.driver_port,
+            token=os.environ.get(c.ENV_TOKEN, ""), max_retries=1,
+        )
+        heartbeater = Heartbeater(
+            hb_rpc, self.task_id, hb_interval,
+            max_failures=max(
+                3, self.conf.get_int(keys.TASK_MAX_MISSED_HEARTBEATS, 25)
+            ),
+            on_driver_lost=_die_with_driver,
+        )
         heartbeater.start()
+
+        payload = self.register_and_get_cluster_spec()
         monitor = TaskMonitor(
             self.rpc, self.task_id,
             interval_s=self.conf.get_int(keys.TASK_METRICS_INTERVAL_MS, 5000) / 1000,
@@ -185,6 +224,7 @@ class Executor:
             tb_port=self.tb_port,
         )
         ctx.work_dir = work_dir
+        ctx_holder["ctx"] = ctx
         monitor.set_context(ctx)
 
         if self.tb_port is not None:
@@ -266,6 +306,7 @@ class Executor:
     def _base_child_env(self) -> dict[str, str]:
         return {
             c.ENV_JOB_NAME: self.job_name,
+            c.ENV_TASK_PORT: str(self.port),
             c.ENV_TASK_INDEX: str(self.task_index),
             c.ENV_TASK_NUM: str(self.task_num),
             c.ENV_IS_CHIEF: str(self.is_chief).lower(),

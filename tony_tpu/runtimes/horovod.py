@@ -33,7 +33,7 @@ from .generic import GenericDriverAdapter, GenericTaskAdapter
 log = logging.getLogger(__name__)
 
 DRIVER_ROLE = "driver"
-HOROVOD_TEST_MODE_KEY = "tony.horovod.mode.test"  # reference HorovodRuntime.java:298-310
+HOROVOD_TEST_MODE_KEY = keys.HOROVOD_TEST_MODE  # reference HorovodRuntime.java:298-310
 
 
 @dataclass
@@ -148,20 +148,31 @@ class HorovodTaskAdapter(GenericTaskAdapter):
 
     # ------------------------------------------------------- driver task path
     def _run_rendezvous_driver(self, ctx: TaskContext) -> int:
+        if ctx.conf and ctx.conf.get_bool(keys.HOROVOD_FAST_FAIL):
+            # reference horovod_driver.py's -f flag: simulate the rendezvous
+            # server crashing before any callback — exercises untracked-task
+            # fast-fail in the driver monitor
+            log.error("horovod driver fast-fail requested; exiting")
+            return 1
         host_slots = [tuple(x) for x in ctx.cluster_payload.get("worker_hosts", [])]
         if not host_slots:
             log.error("horovod driver got empty worker host list")
             return 1
         slots = compute_slot_assignments(host_slots)
-        test_mode = bool(ctx.conf and ctx.conf.get_bool(HOROVOD_TEST_MODE_KEY))
-        port = self._start_rendezvous(host_slots, slots, test_mode)
+        debug_cmd = str(ctx.conf.get(keys.HOROVOD_DEBUG_COMMAND, "") or "") if ctx.conf else ""
+        addr = ""
+        if debug_cmd:
+            addr, port = self._start_debug_rendezvous(ctx, debug_cmd)
+        else:
+            test_mode = bool(ctx.conf and ctx.conf.get_bool(HOROVOD_TEST_MODE_KEY))
+            port = self._start_rendezvous(host_slots, slots, test_mode)
         if port < 0:
             return 1
         ctx.rpc_client.call(
             "register_callback_info",
             task_id=f"{ctx.job_name}:{ctx.task_index}",
             payload={
-                "addr": socket.gethostbyname(socket.gethostname()),
+                "addr": addr or socket.gethostbyname(socket.gethostname()),
                 "port": port,
                 "slots": [asdict(s) for s in slots],
             },
@@ -170,6 +181,49 @@ class HorovodTaskAdapter(GenericTaskAdapter):
         # completes without it (reference: driver waitFor ends with rendezvous)
         while True:
             time.sleep(3600)
+
+    def _start_debug_rendezvous(self, ctx: TaskContext, debug_cmd: str) -> tuple[str, int]:
+        """User-supplied rendezvous driver (reference debug driver command,
+        HorovodDriver.java:189-216): fork the command with
+        HOROVOD_RDV_INFO_FILE pointing at a marker path, then poll that file
+        for the {"port": N[, "addr": host]} JSON the command writes once its
+        server is up — the same marker-file dance as the reference's
+        '<port>____HOROVOD_RENDEZVOUS_SERVER____' poll (HorovodDriver.java:128-183).
+        Returns ("" | published addr, port); port < 0 on failure."""
+        import os
+        import subprocess
+        import tempfile
+
+        marker = os.path.join(
+            ctx.work_dir or tempfile.mkdtemp(prefix="tony-hvd-"),
+            f"rendezvous_{ctx.task_index}.json",
+        )
+        try:
+            os.remove(marker)  # a stale marker would publish a dead port
+        except OSError:
+            pass
+        env = {**os.environ, **ctx.base_child_env, "HOROVOD_RDV_INFO_FILE": marker}
+        self._debug_proc = subprocess.Popen(["bash", "-c", debug_cmd], env=env)
+        timeout_s = (
+            ctx.conf.get_int(keys.HOROVOD_DRIVER_START_TIMEOUT_MS, 60000) / 1000
+            if ctx.conf else 60.0
+        )
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if os.path.exists(marker):
+                try:
+                    info = json.loads(open(marker).read())
+                    return str(info.get("addr", "")), int(info["port"])
+                except (ValueError, KeyError, TypeError):
+                    pass  # partially written; keep polling
+            if self._debug_proc.poll() is not None:
+                log.error("debug rendezvous driver exited %d before publishing",
+                          self._debug_proc.returncode)
+                return "", -1
+            time.sleep(0.2)
+        log.error("debug rendezvous driver did not publish within %.0fs", timeout_s)
+        self._debug_proc.kill()
+        return "", -1
 
     def _start_rendezvous(self, host_slots, slots, test_mode: bool) -> int:
         if not test_mode:
