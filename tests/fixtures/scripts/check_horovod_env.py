@@ -5,4 +5,9 @@ assert int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]) > 0
 rank = int(os.environ["HOROVOD_RANK"]); size = int(os.environ["HOROVOD_SIZE"])
 assert 0 <= rank < size, (rank, size)
 assert int(os.environ["HOROVOD_LOCAL_RANK"]) < int(os.environ["HOROVOD_LOCAL_SIZE"])
+# optionally record our rank so the test can assert cross-task distinctness
+out_dir = os.environ.get("RANK_OUT_DIR")
+if out_dir:
+    with open(os.path.join(out_dir, f"hvd_rank_{rank}"), "w") as f:
+        f.write(os.environ["TONY_JOB_NAME"] + ":" + os.environ["TONY_TASK_INDEX"])
 sys.exit(0)

@@ -1,8 +1,10 @@
 import json, os, sys
 tf_config = json.loads(os.environ["TF_CONFIG"])
-assert os.environ["JOB_NAME"] in ("worker", "ps"), os.environ["JOB_NAME"]
+assert os.environ["JOB_NAME"] in ("worker", "ps", "chief", "evaluator"), os.environ["JOB_NAME"]
 assert tf_config["task"]["type"] == os.environ["JOB_NAME"]
 assert tf_config["task"]["index"] == int(os.environ["TASK_INDEX"])
 assert "worker" in tf_config["cluster"] and "ps" in tf_config["cluster"]
+# sidecar/eval roles are filtered from the cluster dict (estimator semantics)
 assert "tensorboard" not in tf_config["cluster"]
+assert "evaluator" not in tf_config["cluster"]
 sys.exit(0)

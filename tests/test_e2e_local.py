@@ -129,13 +129,17 @@ def test_chief_failure_fails_job(tmp_job_dirs, fixture_script):
 # ----------------------------------------------------------- runtime adapters
 
 def test_tensorflow_ps_worker_env(tmp_job_dirs, fixture_script):
+    """The BASELINE.md PS-strategy topology: 2 ps + 4 workers + chief +
+    evaluator, with the evaluator excluded from the cluster dict the way the
+    reference's constructTFConfig filters it (util/Utils.java:503-520)."""
+    cmd = f"{PY} {fixture_script('check_tf_env.py')}"
     status, client = run_job(
         tmp_job_dirs,
         **{"tony.application.framework": "tensorflow",
-           "tony.ps.instances": 1,
-           "tony.ps.command": f"{PY} {fixture_script('check_tf_env.py')}",
-           "tony.worker.instances": 2,
-           "tony.worker.command": f"{PY} {fixture_script('check_tf_env.py')}"},
+           "tony.ps.instances": 2, "tony.ps.command": cmd,
+           "tony.worker.instances": 4, "tony.worker.command": cmd,
+           "tony.chief.instances": 1, "tony.chief.command": cmd,
+           "tony.evaluator.instances": 1, "tony.evaluator.command": cmd},
     )
     assert status == JobStatus.SUCCEEDED, dump_logs(client)
 
@@ -180,16 +184,35 @@ def test_horovod_two_phase_rendezvous(tmp_job_dirs, fixture_script):
 
 
 def test_real_torch_distributed_allreduce(tmp_job_dirs, fixture_script):
-    """2 workers join a real c10d gloo group from the emitted INIT_METHOD
-    contract and allreduce — the pytorch analogue of the jax.distributed
-    collective e2e (reference mnist-pytorch example contract)."""
+    """4 workers (the BASELINE.md DDP topology) join a real c10d gloo group
+    from the emitted INIT_METHOD contract and allreduce — the pytorch
+    analogue of the jax.distributed collective e2e (reference mnist-pytorch
+    example contract)."""
     status, client = run_job(
         tmp_job_dirs,
         **{"tony.application.framework": "pytorch",
-           "tony.worker.instances": 2,
+           "tony.worker.instances": 4,
            "tony.worker.command": f"{PY} {fixture_script('torch_allreduce.py')}"},
     )
     assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_horovod_eight_worker_slot_table(tmp_job_dirs, fixture_script, tmp_path):
+    """The BASELINE.md ring-allreduce topology: 8 workers, every one handed a
+    distinct rank from the driver's slot table."""
+    rank_dir = tmp_path / "hvd_ranks"
+    rank_dir.mkdir()
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.application.framework": "horovod",
+           "tony.horovod.mode.test": True,
+           "tony.worker.instances": 8,
+           "tony.worker.command": f"{PY} {fixture_script('check_horovod_env.py')}",
+           "tony.execution.env": f"RANK_OUT_DIR={rank_dir}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    ranks = sorted(p.name for p in rank_dir.iterdir())
+    assert ranks == [f"hvd_rank_{i}" for i in range(8)], ranks
 
 
 def test_horovod_driver_fast_fail(tmp_job_dirs, fixture_script):

@@ -1,0 +1,79 @@
+"""Distributed MNIST-style training with PyTorch DDP under tony_tpu.
+
+The rebuild's answer to the reference's mnist-pytorch example
+(tony-examples/mnist-pytorch/mnist_distributed.py: c10d
+``init_process_group`` from env vars the PyTorchRuntime exports —
+INIT_METHOD/RANK/WORLD, PyTorchRuntime.java:44-56). CPU/gloo — torch has no
+TPU role in this framework; this example exists for capability parity with
+jobs that bring their own torch code.
+
+Run as a 4-worker job (BASELINE.md DDP topology):
+
+    python -m tony_tpu.cli.main submit --conf tony_tpu/examples/configs/mnist_torch_ddp.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    args = parser.parse_args(argv)
+
+    import torch
+    import torch.distributed as dist
+    from torch import nn
+    from torch.nn.parallel import DistributedDataParallel
+
+    rank = int(os.environ.get("RANK", "0"))
+    world = int(os.environ.get("WORLD", "1"))
+    if world > 1:
+        dist.init_process_group(
+            "gloo",
+            init_method=os.environ["INIT_METHOD"],
+            rank=rank,
+            world_size=world,
+        )
+
+    torch.manual_seed(0)
+    model = nn.Sequential(
+        nn.Flatten(), nn.Linear(784, 256), nn.ReLU(), nn.Linear(256, 10)
+    )
+    if world > 1:
+        model = DistributedDataParallel(model)
+    opt = torch.optim.Adam(model.parameters(), lr=args.lr)
+    loss_fn = nn.CrossEntropyLoss()
+
+    # synthetic mnist-shaped data, seeded per rank (no dataset download)
+    n = max(8192, 2 * args.batch_size)
+    gen = torch.Generator().manual_seed(rank)
+    x = torch.randn(n, 1, 28, 28, generator=gen)
+    y = torch.randint(0, 10, (n,), generator=gen)
+
+    t0 = time.time()
+    for i in range(args.steps):
+        lo = (i * args.batch_size) % (len(x) - args.batch_size)
+        xb, yb = x[lo:lo + args.batch_size], y[lo:lo + args.batch_size]
+        opt.zero_grad()
+        loss = loss_fn(model(xb), yb)
+        loss.backward()  # DDP allreduces gradients here
+        opt.step()
+    dt = time.time() - t0
+    if rank == 0:
+        print(f"rank0: {args.steps} steps in {dt:.1f}s "
+              f"({args.steps / dt:.1f} steps/s, world={world}, "
+              f"final loss {loss.item():.3f})")
+
+    if world > 1:
+        dist.destroy_process_group()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

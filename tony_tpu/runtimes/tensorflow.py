@@ -14,8 +14,10 @@ from .base import TaskContext
 from .generic import GenericDriverAdapter, GenericTaskAdapter
 
 # roles never included in TF cluster spec (reference filters evaluator/
-# tensorboard when building TF_CONFIG's cluster dict)
-_EXCLUDED_FROM_CLUSTER = ("tensorboard",)
+# tensorboard when building TF_CONFIG's cluster dict, util/Utils.java:503-520
+# — the evaluator still gets TF_CONFIG with its own task type, it just isn't
+# part of the cluster the other tasks wait on)
+_EXCLUDED_FROM_CLUSTER = ("tensorboard", "evaluator")
 
 
 class TFDriverAdapter(GenericDriverAdapter):
