@@ -47,8 +47,8 @@ class TransformerConfig:
     expert_top_k: int = 2
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
-    # attention implementation: "flash" (pallas), "ref" (XLA), "ring"
-    # (sequence-parallel over the `seq` mesh axis), or "auto"
+    # attention implementation: "flash" (pallas), "ref" (XLA), "ring" /
+    # "ulysses" (sequence-parallel over the `seq` mesh axis), or "auto"
     attn_impl: str = "auto"
     remat: bool = False
 
@@ -171,6 +171,12 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
         from ..parallel.ring_attention import make_ring_attention
 
         return make_ring_attention(mesh, causal=True)(q, k, v)
+    if impl == "ulysses":
+        if mesh is None:
+            raise ValueError("attn_impl='ulysses' requires a mesh")
+        from ..parallel.ulysses import make_ulysses_attention
+
+        return make_ulysses_attention(mesh, causal=True)(q, k, v)
     return reference_attention(q, k, v, causal=True)
 
 

@@ -23,6 +23,7 @@ this is the TPU-native capability layer of the rebuild.
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -417,6 +418,22 @@ def _vjp_bwd(causal, scale, res, g):
 _flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 
+def flash_supported(q: jax.Array, k: jax.Array | None = None,
+                    causal: bool = True) -> bool:
+    """Support envelope of the Pallas kernels, [B, H, L, D] layout: the
+    streamer DMAs [block, D] slices and Mosaic requires the lane (last)
+    dimension of a sliced ref to be a multiple of the 128-wide tiling; the
+    non-causal forward additionally needs L_k to tile evenly into KV blocks
+    (the causal path masks the ragged tail, the non-causal one does not)."""
+    if q.shape[-1] % 128 != 0:
+        return False
+    if not causal and k is not None:
+        lk = k.shape[2]
+        if lk % min(BLOCK_K, max(8, lk)) != 0:
+            return False
+    return True
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -425,7 +442,27 @@ def flash_attention(
     scale: float | None = None,
 ) -> jax.Array:
     """Fused attention, [B, H, L, D] layout. Pallas-compiled on TPU,
-    interpreted elsewhere; flash backward (O(block) memory both ways)."""
+    interpreted elsewhere; flash backward (O(block) memory both ways).
+
+    Shapes outside the kernel envelope (see flash_supported) fall back to
+    naive XLA attention — full L x L scores, O(L^2) memory — with a one-time
+    warning, since at long context that is a real memory cliff."""
+    tiling_ok = not _on_tpu() or q.shape[-1] % 128 == 0  # interpret: no tiling
+    lk = k.shape[2]
+    blocks_ok = causal or lk % min(BLOCK_K, max(8, lk)) == 0
+    if not (tiling_ok and blocks_ok):
+        warnings.warn(
+            f"flash_attention: shape q={q.shape} causal={causal} is outside "
+            "the Pallas kernel envelope (head_dim % 128, non-causal KV block "
+            "tiling); falling back to naive XLA attention with full L x L "
+            "scores — expect O(L^2) memory",
+            stacklevel=2,
+        )
+        out = reference_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=causal, scale=scale,
+        )
+        return out.transpose(0, 2, 1, 3)
     return _flash_attention(q, k, v, causal, scale)
 
 
@@ -441,4 +478,6 @@ def attention_blhd(
     return out.transpose(0, 2, 1, 3)
 
 
-__all__ = ["flash_attention", "attention_blhd", "reference_attention"]
+__all__ = [
+    "flash_attention", "flash_supported", "attention_blhd", "reference_attention",
+]

@@ -29,6 +29,7 @@ from .sharding import (
     tree_shardings,
 )
 from .ring_attention import make_ring_attention, reference_attention, ring_attention
+from .ulysses import make_ulysses_attention, ulysses_attention
 from .pipeline import make_pipeline, stack_stage_params
 from .expert import load_balancing_loss, moe_ffn, top_k_routing
 
@@ -38,6 +39,7 @@ __all__ = [
     "merge_rules", "logical_to_spec", "sharding_for", "tree_shardings",
     "shard_params", "batch_sharding",
     "make_ring_attention", "reference_attention", "ring_attention",
+    "make_ulysses_attention", "ulysses_attention",
     "make_pipeline", "stack_stage_params",
     "moe_ffn", "top_k_routing", "load_balancing_loss",
 ]

@@ -52,14 +52,28 @@ def create_train_step(
     key: jax.Array | None = None,
     optimizer: optax.GradientTransformation | None = None,
     use_ring_attention: bool | None = None,
+    sp_impl: str | None = None,
 ) -> TrainStepBundle:
-    """Initialize sharded params + optimizer state and build the jitted step."""
+    """Initialize sharded params + optimizer state and build the jitted step.
+
+    `sp_impl` picks the sequence-parallel attention when the mesh has a
+    nontrivial `seq` axis: "ring" (K/V ppermute ring) or "ulysses" (all-to-all
+    head sharding). Defaults to "ring"; `use_ring_attention` is the older
+    boolean form of the same switch.
+    """
     rules = dict(rules if rules is not None else shlib.FSDP_TP_RULES)
-    if use_ring_attention is None:
-        use_ring_attention = mesh.shape.get("seq", 1) > 1
-    if use_ring_attention:
+    if sp_impl is None:
+        want_sp = (
+            use_ring_attention
+            if use_ring_attention is not None
+            else mesh.shape.get("seq", 1) > 1
+        )
+        sp_impl = "ring" if want_sp else None
+    if sp_impl is not None and sp_impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sp_impl {sp_impl!r}")
+    if sp_impl:
         cfg = transformer.TransformerConfig(
-            **{**cfg.__dict__, "attn_impl": "ring"}
+            **{**cfg.__dict__, "attn_impl": sp_impl}
         )
         rules.setdefault("act_seq", "seq")
     key = jax.random.PRNGKey(0) if key is None else key
@@ -77,7 +91,7 @@ def create_train_step(
     )
     opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
 
-    seq_axis = rules.get("act_seq") if use_ring_attention else None
+    seq_axis = rules.get("act_seq") if sp_impl else None
     tok_sharding = NamedSharding(mesh, P(rules.get("batch"), seq_axis))
 
     def step(params, opt_state, tokens, targets):
