@@ -1,0 +1,12 @@
+"""Fixture: asserts it was launched through the docker shim with the task
+env contract forwarded via -e flags (reference exit_0_check_env.py pattern)."""
+import os
+
+assert os.environ.get("DOCKER_SHIM_USED") == "1", "not launched via docker shim"
+assert os.environ.get("TONY_JOB_NAME") == "worker", os.environ.get("TONY_JOB_NAME")
+assert "TONY_TASK_INDEX" in os.environ
+# tony.execution.env vars must be forwarded into the container explicitly
+assert os.environ.get("TONY_E2E_PASSTHRU") == "yes", "execution.env not forwarded"
+# the job dir contract must resolve inside the container (bind-mounted)
+assert os.path.isdir(os.environ["TONY_JOB_DIR"]), "TONY_JOB_DIR not mounted"
+print("docker-launched task env OK")

@@ -2,6 +2,7 @@
 in-driver preprocess mode, TB sidecar URL, metrics accumulator."""
 
 import json
+import os
 import sys
 import zipfile
 from pathlib import Path
@@ -194,3 +195,45 @@ def test_step_timer():
     for _ in range(6):
         t.tick()
     assert t.steps_per_sec > 0
+
+
+# ------------------------------------------------------------ container launch
+
+def test_build_container_command():
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.utils.containers import build_container_command, container_enabled
+
+    conf = TonyConf({
+        "tony.docker.enabled": True,
+        "tony.docker.containers.image": "img:1",
+        "tony.docker.containers.mount": "/data:/data:ro,/ckpt:/ckpt",
+        "tony.docker.extra-args": "--device,/dev/accel0",
+    })
+    assert container_enabled(conf)
+    argv = build_container_command(
+        "python t.py", {"TONY_JOB_NAME": "worker"}, conf, work_dir="/wd"
+    )
+    assert argv[:5] == ["docker", "run", "--rm", "--network", "host"]
+    assert argv[-4:] == ["img:1", "bash", "-c", "python t.py"]
+    pairs = set(zip(argv, argv[1:]))
+    assert {("--user", f"{os.getuid()}:{os.getgid()}"), ("-v", "/wd:/wd"),
+            ("-w", "/wd"), ("-v", "/data:/data:ro"), ("-v", "/ckpt:/ckpt"),
+            ("-e", "TONY_JOB_NAME=worker"),
+            ("--device", "/dev/accel0")} <= pairs, argv
+
+
+def test_container_per_role_image_and_missing_image():
+    import pytest as _pytest
+
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.utils.containers import build_container_command
+
+    conf = TonyConf({
+        "tony.docker.enabled": True,
+        "tony.docker.containers.image": "base:1",
+        "tony.docker.evaluator.image": "eval:2",
+    })
+    assert "eval:2" in build_container_command("c", {}, conf, role="evaluator")
+    assert "base:1" in build_container_command("c", {}, conf, role="worker")
+    with _pytest.raises(ValueError, match="image"):
+        build_container_command("c", {}, TonyConf({"tony.docker.enabled": True}))

@@ -577,3 +577,49 @@ def test_client_callback_api(tmp_job_dirs, fixture_script):
     assert status == JobStatus.SUCCEEDED
     assert seen["app_id"] == client.app_id
     assert seen["updates"] >= 1
+
+
+# ---------------------------------------------------------- containerized run
+
+def test_docker_containerized_task(tmp_job_dirs, fixture_script, tmp_path,
+                                   monkeypatch):
+    """With tony.docker.enabled the executor wraps the user command in
+    `docker run` (reference Docker-on-YARN, HadoopCompatibleAdapter.java:
+    45-159). A shim `docker` on PATH verifies the wrapping: it applies the
+    -e contract env, injects a marker, and execs the inner command."""
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    shim = shim_dir / "docker"
+    shim.write_text(f"""#!{PY}
+import os, sys
+args = sys.argv[1:]
+assert args[0] == "run", args
+env = dict(os.environ)
+env["DOCKER_SHIM_USED"] = "1"
+i = 1
+while i < len(args):
+    a = args[i]
+    if a in ("--rm",):
+        i += 1
+    elif a in ("--network", "-v", "-w", "--user", "--name"):
+        i += 2
+    elif a == "-e":
+        k, _, v = args[i + 1].partition("=")
+        env[k] = v
+        i += 2
+    else:
+        break  # image
+inner = args[i + 1:]          # ["bash", "-c", command]
+os.execvpe(inner[0], inner, env)
+""")
+    shim.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{shim_dir}:{os.environ['PATH']}")
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.docker.enabled": True,
+           "tony.docker.containers.image": "tony-test-image:latest",
+           "tony.execution.env": "TONY_E2E_PASSTHRU=yes",
+           "tony.worker.command": f"{PY} {fixture_script('check_docker_env.py')}"},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)

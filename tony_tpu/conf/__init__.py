@@ -209,6 +209,16 @@ class TonyConf:
         mode = str(self.get(keys.APPLICATION_DISTRIBUTED_MODE, "GANG")).upper()
         if mode not in ("GANG", "FCFS"):
             raise ValueError(f"distributed-mode must be GANG or FCFS, got {mode}")
+        if self.get_bool(keys.DOCKER_ENABLED, False):
+            # fail at submit, not per-executor at runtime
+            for s in specs:
+                if not (self.get(keys.docker_image_key(s.name))
+                        or self.get(keys.DOCKER_IMAGE)):
+                    raise ValueError(
+                        f"{keys.DOCKER_ENABLED} is set but no image for role "
+                        f"{s.name!r}: set {keys.DOCKER_IMAGE} or "
+                        f"{keys.docker_image_key(s.name)}"
+                    )
 
     # ------------------------------------------------------------- freezing
     def write_final(self, job_dir: str | os.PathLike) -> Path:

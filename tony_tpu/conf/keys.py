@@ -59,6 +59,19 @@ PYTHON_VENV = "tony.application.python-venv"
 PYTHON_BINARY_PATH = "tony.application.python-binary-path"
 EXECUTION_ENV = "tony.execution.env"  # list of K=V propagated to every task
 
+# containerized task launch (reference Docker-on-YARN support: key names from
+# TonyConfigurationKeys.java:245-290, wrapping from HadoopCompatibleAdapter
+# .java:45-159; here the executor wraps the command itself)
+DOCKER_ENABLED = "tony.docker.enabled"
+DOCKER_IMAGE = "tony.docker.containers.image"   # image for all task processes
+DOCKER_MOUNTS = "tony.docker.containers.mount"  # list of src:dst[:ro]
+DOCKER_RUN_ARGS = "tony.docker.extra-args"      # list of extra docker-run flags
+
+
+def docker_image_key(role: str) -> str:
+    """Per-role image override (reference getDockerImageKey)."""
+    return f"tony.docker.{role}.image"
+
 # -------------------------------------------------------------------- secrets
 SECURITY_TOKEN_ENABLED = "tony.security.token-enabled"
 

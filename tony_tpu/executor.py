@@ -273,6 +273,14 @@ class Executor:
         return exit_code
 
     def _kill_child(self, ctx) -> None:
+        name = getattr(ctx, "container_name", None)
+        if name:
+            # the docker CLI process does not forward SIGKILL to the
+            # container; remove the container first, then reap the CLI
+            from .utils.containers import remove_container
+
+            log.error("execution timeout: removing container %s", name)
+            remove_container(name)
         proc = getattr(ctx, "child_process", None)
         if proc is not None and proc.poll() is None:
             log.error("execution timeout: killing user process")

@@ -70,13 +70,45 @@ class TaskAdapter:
         """Default: fork the user command through a shell with the built env,
         stream output, return its exit code (reference
         Utils.executeShell:299-328 — minus the hadoop-classpath preamble,
-        which has no TPU equivalent)."""
-        env = {**os.environ, **ctx.base_child_env, **self.build_env(ctx)}
-        proc = subprocess.Popen(
-            ["bash", "-c", ctx.command], env=env, cwd=ctx.work_dir or None
-        )
+        which has no TPU equivalent). With `tony.docker.enabled` the command
+        runs inside the configured image instead (reference Docker-on-YARN,
+        HadoopCompatibleAdapter.java:45-159)."""
+        from .. import constants as c
+        from ..utils import containers
+
+        contract_env = {**ctx.base_child_env, **self.build_env(ctx)}
+        if containers.container_enabled(ctx.conf):
+            # execution-env / role-env vars reach bare tasks via os.environ
+            # inheritance; containers need them forwarded explicitly
+            contract_env = {
+                **containers.passthrough_env(ctx.conf, ctx.job_name),
+                **contract_env,
+            }
+            name = containers.container_name(
+                ctx.base_child_env.get(c.ENV_APP_ID, "app"),
+                ctx.job_name, ctx.task_index,
+            )
+            argv = containers.build_container_command(
+                ctx.command, contract_env, ctx.conf,
+                work_dir=ctx.work_dir, role=ctx.job_name,
+                job_dir=ctx.base_child_env.get(c.ENV_JOB_DIR) or None,
+                name=name,
+            )
+            ctx.container_name = name
+            env = dict(os.environ)
+        else:
+            argv = ["bash", "-c", ctx.command]
+            env = {**os.environ, **contract_env}
+        proc = subprocess.Popen(argv, env=env, cwd=ctx.work_dir or None)
         ctx.child_process = proc
-        return proc.wait()
+        try:
+            return proc.wait()
+        finally:
+            if ctx.container_name:
+                # normal exit: --rm already removed it (no-op); kill paths
+                # (timeout, SIGTERM teardown): the docker CLI cannot forward
+                # SIGKILL, so reap the container itself
+                containers.remove_container(ctx.container_name)
 
 
 class TaskContext:
@@ -110,6 +142,7 @@ class TaskContext:
         self.tb_port = tb_port
         self.work_dir: str | None = None
         self.child_process: subprocess.Popen | None = None
+        self.container_name: str | None = None
 
     @property
     def cluster_spec(self) -> dict[str, list[str]]:
