@@ -10,7 +10,9 @@ emits the collectives over ICI/DCN.
 from .mesh import (
     AXIS_ORDER,
     MeshSpec,
+    build_hybrid_mesh,
     build_mesh,
+    detect_num_slices,
     mesh_from_string,
     slice_topology,
 )
@@ -34,7 +36,8 @@ from .pipeline import make_pipeline, stack_stage_params
 from .expert import load_balancing_loss, moe_ffn, top_k_routing
 
 __all__ = [
-    "AXIS_ORDER", "MeshSpec", "build_mesh", "mesh_from_string", "slice_topology",
+    "AXIS_ORDER", "MeshSpec", "build_hybrid_mesh", "build_mesh",
+    "detect_num_slices", "mesh_from_string", "slice_topology",
     "DP_RULES", "FSDP_RULES", "TP_RULES", "FSDP_TP_RULES", "SP_RULES", "EP_RULES",
     "merge_rules", "logical_to_spec", "sharding_for", "tree_shardings",
     "shard_params", "batch_sharding",

@@ -108,6 +108,81 @@ def single_device_mesh() -> Mesh:
     return build_mesh(MeshSpec(fsdp=1), devices=jax.devices()[:1])
 
 
+def detect_num_slices(devices: list | None = None) -> int:
+    """Number of ICI-connected slices (multislice jobs expose
+    `device.slice_index`; single-slice and CPU devices do not)."""
+    devices = list(devices if devices is not None else jax.devices())
+    idx = {getattr(d, "slice_index", 0) for d in devices}
+    return len(idx)
+
+
+def build_hybrid_mesh(
+    ici: MeshSpec | None = None,
+    dcn: MeshSpec | None = None,
+    devices: list | None = None,
+    num_slices: int | None = None,
+) -> Mesh:
+    """Multi-slice mesh: `dcn` axes span slices (traffic crosses the
+    data-center network), `ici` axes stay within one slice (traffic rides
+    the torus). The scaling-book recipe: put `data` (gradient psum once per
+    step, latency-tolerant) and optionally `pipe` on DCN; keep
+    fsdp/seq/expert/tensor — the bandwidth-hungry axes — on ICI.
+
+    Devices are grouped by `slice_index` when the runtime exposes it. An
+    explicitly passed `num_slices` overrides that with even grouping in
+    device order — virtual slices for tests and the driver's CPU dry run
+    (on real hardware `jax.devices()` orders slices contiguously, so when
+    the counts agree the two groupings coincide). Same global axis
+    names/order as build_mesh, so shardings and rule tables apply unchanged.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    ici = ici or MeshSpec()
+    explicit = num_slices is not None
+    if num_slices is None:
+        num_slices = detect_num_slices(devices)
+    if num_slices <= 1 and dcn is None:
+        return build_mesh(ici, devices)
+    dcn = dcn or MeshSpec(data=num_slices, fsdp=1)
+
+    if explicit:
+        if len(devices) % num_slices:
+            raise ValueError(
+                f"cannot group {len(devices)} devices into {num_slices} equal slices"
+            )
+        per = len(devices) // num_slices
+        groups = [devices[i * per:(i + 1) * per] for i in range(num_slices)]
+    else:
+        by_slice: dict[int, list] = {}
+        for d in devices:
+            by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+        groups = [by_slice[k] for k in sorted(by_slice)]
+        if len(groups) != num_slices or len({len(g) for g in groups}) != 1:
+            raise ValueError(
+                f"cannot group {len(devices)} devices into {num_slices} equal slices"
+            )
+    per_slice = len(groups[0])
+
+    dcn_sizes = dcn.resolve(num_slices)
+    ici_sizes = ici.resolve(per_slice)
+    overlap = [a for a in AXIS_ORDER if dcn_sizes[a] > 1 and ici_sizes[a] > 1]
+    if overlap:
+        raise ValueError(
+            f"axes {overlap} span both DCN and ICI; give each axis to one network"
+        )
+    dcn_shape = tuple(dcn_sizes[a] for a in AXIS_ORDER)
+    ici_shape = tuple(ici_sizes[a] for a in AXIS_ORDER)
+    shape = tuple(d * s for d, s in zip(dcn_shape, ici_shape))
+
+    arr = np.empty(shape, dtype=object)
+    for idx in np.ndindex(shape):
+        d = tuple(i // s for i, s in zip(idx, ici_shape))
+        s = tuple(i % s for i, s in zip(idx, ici_shape))
+        arr[idx] = groups[int(np.ravel_multi_index(d, dcn_shape))][
+            int(np.ravel_multi_index(s, ici_shape))
+        ]
+    return Mesh(arr, AXIS_ORDER)
+
+
 def slice_topology() -> dict:
     """Discover TPU slice topology — the analogue of the reference's GPU
     discovery (util/gpu/GpuDiscoverer.java:41-59), reading JAX/libtpu device
