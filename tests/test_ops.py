@@ -40,6 +40,39 @@ def test_flash_ragged_length_causal():
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_ragged_padded_blocks(causal):
+    """L=300 > BLOCK_Q forces real padding: padded KV columns must be masked
+    in-kernel and padded Q rows zeroed via the lse residual (regression: the
+    old lse=-inf padding made p=exp(s+1e30)=inf -> NaN dK/dV)."""
+    keys = jax.random.split(jax.random.PRNGKey(7), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 300, 16)) for kk in keys)
+    out = flash_attention(q, k, v, causal=causal)
+    expected = _ref_bhld(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+    def loss_flash(args):
+        return jnp.sum(flash_attention(*args, causal=causal) ** 2)
+
+    def loss_ref(args):
+        return jnp.sum(_ref_bhld(*args, causal) ** 2)
+
+    g1 = jax.grad(loss_flash)((q, k, v))
+    g2 = jax.grad(loss_ref)((q, k, v))
+    for a, b in zip(g1, g2):
+        assert bool(jnp.all(jnp.isfinite(a)))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_cross_attention_ragged_kv():
+    """L_q != L_k with ragged L_k (non-causal cross attention)."""
+    q = jax.random.normal(jax.random.PRNGKey(8), (1, 2, 300, 16))
+    k = jax.random.normal(jax.random.PRNGKey(9), (1, 2, 520, 16))
+    out = flash_attention(q, k, k, causal=False)
+    expected = _ref_bhld(q, k, k, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
 def test_flash_gradients_match_reference():
     q = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 128, 16))
 

@@ -45,6 +45,17 @@ def _causal_mask(qi, bq, j, bk):
     return rows >= cols
 
 
+def _attn_mask(qi, bq, j, bk, causal, kv_len):
+    """Combined causal + ragged-KV mask for one [bq, bk] score tile, or None
+    when every position is valid (the even, non-causal fast path)."""
+    mask = _causal_mask(qi, bq, j, bk) if causal else None
+    if kv_len is not None:
+        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        valid = cols < kv_len
+        mask = valid if mask is None else (mask & valid)
+    return mask
+
+
 class _Streamer:
     """Double-buffered HBM->VMEM block pipeline over one or more arrays
     (the guide's double-buffering pattern, generalized to N streams that
@@ -91,7 +102,7 @@ class _Streamer:
 # ------------------------------------------------------------------ forward
 
 def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
-                *, scale, causal, block_k):
+                *, scale, causal, block_k, kv_len=None):
     """One (batch*head, q-block) program: stream KV blocks, online softmax.
     Also writes the per-row logsumexp residual for the backward."""
     b_ = pl.program_id(0)
@@ -113,12 +124,13 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
             q, k_blk.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                              # [BQ, BK]
-        if causal:
-            s = jnp.where(_causal_mask(qi, bq, j, block_k), s, NEG_INF)
+        mask = _attn_mask(qi, bq, j, block_k, causal, kv_len)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
-            p = jnp.where(_causal_mask(qi, bq, j, block_k), p, 0.0)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jax.lax.dot_general(
@@ -141,7 +153,7 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
 # ------------------------------------------------------------------ backward
 
 def _dq_kernel(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref, dq_ref,
-               k_buf, v_buf, sems, *, scale, causal, block_k):
+               k_buf, v_buf, sems, *, scale, causal, block_k, kv_len=None):
     """dQ for one q block: sweep KV blocks.
     ds = p * (dO@V^T - delta); dQ = scale * ds @ K."""
     b_ = pl.program_id(0)
@@ -168,8 +180,9 @@ def _dq_kernel(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         )
         p = jnp.exp(s - lse)
-        if causal:
-            p = jnp.where(_causal_mask(qi, bq, j, block_k), p, 0.0)
+        mask = _attn_mask(qi, bq, j, block_k, causal, kv_len)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -240,6 +253,14 @@ def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
 
 # ----------------------------------------------------------------- plumbing
 
+def _block(block, l):
+    """Kernel block size for a length-l axis: the configured block, shrunk for
+    short sequences but kept a multiple of 128 — Mosaic requires sliced-ref
+    shapes aligned to the (8, 128) tiling (HBM row slices AND the lane-major
+    lse/delta lane slices), so arbitrary l (e.g. 300) cannot be a block."""
+    return min(block, max(128, -(-l // 128) * 128))
+
+
 def _pad_to(x, axis, multiple):
     size = x.shape[axis]
     rem = size % multiple
@@ -266,13 +287,14 @@ def _flash_fwd(q, k, v, causal, scale, block_q=BLOCK_Q, block_k=BLOCK_K,
     b, h, lq, d = q.shape
     lk = k.shape[2]
     scale = (d ** -0.5) if scale is None else scale
-    block_q = min(block_q, max(8, lq))
-    block_k = min(block_k, max(8, lk))
+    block_q = _block(block_q, lq)
+    block_k = _block(block_k, lk)
     qp = _pad_to(q, 2, block_q)
     kp = _pad_to(k, 2, block_k)
     vp = _pad_to(v, 2, block_k)
-    if not causal and kp.shape[2] != lk:
-        raise NotImplementedError("non-causal flash requires L_k % block_k == 0")
+    # ragged L_k: kernel masks padded KV columns (kv_len is static -> the
+    # even case compiles with no mask at all)
+    kv_len = lk if kp.shape[2] != lk else None
 
     bh = b * h
     qf = qp.reshape(bh, qp.shape[2], d)
@@ -281,7 +303,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q=BLOCK_Q, block_k=BLOCK_K,
     nq = qf.shape[1] // block_q
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, block_k=block_k),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k, kv_len=kv_len),
         grid=(bh, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i: (b_, i, 0)),
@@ -316,19 +339,21 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale,
     b, h, lq, d = q.shape
     lk = k.shape[2]
     scale = (d ** -0.5) if scale is None else scale
-    block_q = min(block_q, max(8, lq))
-    block_k = min(block_k, max(8, lk))
+    block_q = _block(block_q, lq)
+    block_k = _block(block_k, lk)
 
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,H,L]
 
     qp, gp = _pad_to(q, 2, block_q), _pad_to(g, 2, block_q)
     kp, vp = _pad_to(k, 2, block_k), _pad_to(v, 2, block_k)
-    # padded q rows: lse=NEG_INF -> p=0; delta=0
+    kv_len = lk if kp.shape[2] != lk else None
+    # padded q rows: lse=+big -> p = exp(s - lse) = 0; delta=0
+    # (NEG_INF here would make p = exp(s + 1e30) = inf -> NaN dK/dV)
     lsep = _pad_to(lse, 2, block_q)
     deltap = _pad_to(delta, 2, block_q)
     if lsep.shape[2] != lse.shape[2]:
         pad_rows = lsep.shape[2] - lse.shape[2]
-        lsep = lsep.at[:, :, -pad_rows:].set(NEG_INF)
+        lsep = lsep.at[:, :, -pad_rows:].set(-NEG_INF)
     # lane-major layout (see _fwd_kernel note)
 
     bh = b * h
@@ -344,7 +369,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale,
     nk = lkp // block_k
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, block_k=block_k),
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, kv_len=kv_len),
         grid=(bh, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i: (b_, i, 0)),
@@ -418,20 +444,13 @@ def _vjp_bwd(causal, scale, res, g):
 _flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def flash_supported(q: jax.Array, k: jax.Array | None = None,
-                    causal: bool = True) -> bool:
+def flash_supported(q: jax.Array) -> bool:
     """Support envelope of the Pallas kernels, [B, H, L, D] layout: the
     streamer DMAs [block, D] slices and Mosaic requires the lane (last)
-    dimension of a sliced ref to be a multiple of the 128-wide tiling; the
-    non-causal forward additionally needs L_k to tile evenly into KV blocks
-    (the causal path masks the ragged tail, the non-causal one does not)."""
-    if q.shape[-1] % 128 != 0:
-        return False
-    if not causal and k is not None:
-        lk = k.shape[2]
-        if lk % min(BLOCK_K, max(8, lk)) != 0:
-            return False
-    return True
+    dimension of a sliced ref to be a multiple of the 128-wide tiling.
+    Ragged lengths are handled in-kernel (padded KV columns masked, padded
+    Q rows zeroed via the lse residual)."""
+    return q.shape[-1] % 128 == 0
 
 
 def flash_attention(
@@ -447,15 +466,13 @@ def flash_attention(
     Shapes outside the kernel envelope (see flash_supported) fall back to
     naive XLA attention — full L x L scores, O(L^2) memory — with a one-time
     warning, since at long context that is a real memory cliff."""
-    tiling_ok = not _on_tpu() or q.shape[-1] % 128 == 0  # interpret: no tiling
-    lk = k.shape[2]
-    blocks_ok = causal or lk % min(BLOCK_K, max(8, lk)) == 0
-    if not (tiling_ok and blocks_ok):
+    tiling_ok = not _on_tpu() or flash_supported(q)  # interpret: no tiling
+    if not tiling_ok:
         warnings.warn(
             f"flash_attention: shape q={q.shape} causal={causal} is outside "
-            "the Pallas kernel envelope (head_dim % 128, non-causal KV block "
-            "tiling); falling back to naive XLA attention with full L x L "
-            "scores — expect O(L^2) memory",
+            "the Pallas kernel envelope (head_dim % 128); falling back to "
+            "naive XLA attention with full L x L scores — expect O(L^2) "
+            "memory",
             stacklevel=2,
         )
         out = reference_attention(
