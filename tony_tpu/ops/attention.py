@@ -335,7 +335,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q=BLOCK_Q, block_k=BLOCK_K,
     jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret")
 )
 def _flash_bwd(q, k, v, o, lse, g, causal, scale,
-               block_q=BLOCK_Q, block_k=BLOCK_K, interpret=False):
+               block_q=BLOCK_Q, block_k=BLOCK_K, interpret=False, g_lse=None):
     b, h, lq, d = q.shape
     lk = k.shape[2]
     scale = (d ** -0.5) if scale is None else scale
@@ -343,6 +343,11 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale,
     block_k = _block(block_k, lk)
 
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,H,L]
+    if g_lse is not None:
+        # cotangent on the lse output: d lse_i/d s_ij = p_ij, so the extra
+        # ds term is g_lse_i * p_ij — absorbed as delta' = delta - g_lse in
+        # ds = p * (dp - delta'). dV is untouched (no lse dependence).
+        delta = delta - g_lse.astype(jnp.float32)
 
     qp, gp = _pad_to(q, 2, block_q), _pad_to(g, 2, block_q)
     kp, vp = _pad_to(k, 2, block_k), _pad_to(v, 2, block_k)
@@ -424,24 +429,34 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention(q, k, v, causal, scale):
-    out, _ = _flash_fwd(q, k, v, causal, scale, interpret=not _on_tpu())
-    return out
+def flash_attention_with_lse(q, k, v, causal=True, scale=None):
+    """Flash attention that also returns the per-row logsumexp, [B, H, L, D]
+    layout -> (out [B,H,L,D], lse [B,H,L] f32).
+
+    The lse output is differentiable (the backward folds its cotangent into
+    the delta residual), which is what makes flash blocks composable: a
+    caller can merge partial results from disjoint KV shards as
+    ``logaddexp``-weighted sums — ring attention does exactly that — and
+    autodiff still produces exact gradients. No fallback: callers must check
+    ``flash_supported`` (ring attention does)."""
+    return _flash_fwd(q, k, v, causal, scale, interpret=not _on_tpu())
 
 
-def _vjp_fwd(q, k, v, causal, scale):
+def _lse_vjp_fwd(q, k, v, causal, scale):
     out, lse = _flash_fwd(q, k, v, causal, scale, interpret=not _on_tpu())
-    return out, (q, k, v, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _vjp_bwd(causal, scale, res, g):
+def _lse_vjp_bwd(causal, scale, res, g):
     q, k, v, o, lse = res
+    g_out, g_lse = g
     return _flash_bwd(
-        q, k, v, o, lse, g, causal, scale, interpret=not _on_tpu()
+        q, k, v, o, lse, g_out, causal, scale,
+        interpret=not _on_tpu(), g_lse=g_lse,
     )
 
 
-_flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+flash_attention_with_lse.defvjp(_lse_vjp_fwd, _lse_vjp_bwd)
 
 
 def flash_supported(q: jax.Array) -> bool:
@@ -480,7 +495,9 @@ def flash_attention(
             v.transpose(0, 2, 1, 3), causal=causal, scale=scale,
         )
         return out.transpose(0, 2, 1, 3)
-    return _flash_attention(q, k, v, causal, scale)
+    # single custom_vjp path; the unused lse cotangent arrives as zeros and
+    # costs one elementwise subtract in the backward
+    return flash_attention_with_lse(q, k, v, causal, scale)[0]
 
 
 def attention_blhd(
@@ -496,5 +513,6 @@ def attention_blhd(
 
 
 __all__ = [
-    "flash_attention", "flash_supported", "attention_blhd", "reference_attention",
+    "flash_attention", "flash_attention_with_lse", "flash_supported",
+    "attention_blhd", "reference_attention",
 ]

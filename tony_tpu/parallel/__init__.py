@@ -30,7 +30,12 @@ from .sharding import (
     sharding_for,
     tree_shardings,
 )
-from .ring_attention import make_ring_attention, reference_attention, ring_attention
+from .ring_attention import (
+    make_ring_attention,
+    reference_attention,
+    ring_attention,
+    ring_flash_attention,
+)
 from .ulysses import make_ulysses_attention, ulysses_attention
 from .pipeline import make_pipeline, stack_stage_params
 from .expert import load_balancing_loss, moe_ffn, top_k_routing
@@ -42,6 +47,7 @@ __all__ = [
     "merge_rules", "logical_to_spec", "sharding_for", "tree_shardings",
     "shard_params", "batch_sharding",
     "make_ring_attention", "reference_attention", "ring_attention",
+    "ring_flash_attention",
     "make_ulysses_attention", "ulysses_attention",
     "make_pipeline", "stack_stage_params",
     "moe_ffn", "top_k_routing", "load_balancing_loss",

@@ -104,13 +104,91 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Ring attention with the fused Pallas flash kernel as the per-step
+    block computation (ops/attention.py). Same ring as :func:`ring_attention`
+    — K/V circulate via ``lax.ppermute`` — but each step runs the flash
+    kernel on (local Q, circulating KV block) and returns ``(out_t, lse_t)``;
+    partials merge as a streaming logaddexp-weighted sum. Per-device memory
+    is O(kernel block), not O(L_local x L_block) — the XLA path materializes
+    the per-pair score matrix, which at L=128k/8 devices is a 1GB+ f32
+    tensor per head; this path never does.
+
+    The kernel's lse output is differentiable (its cotangent folds into the
+    flash backward's delta residual), so ``jax.grad`` through scan + ppermute
+    + merge is exact. Visibility per step is a 3-way ``lax.switch``: blocks
+    from earlier devices run the kernel non-causally, the device's own block
+    runs it causally, later blocks skip compute entirely (out=0, lse=-inf).
+    """
+    from ..ops.attention import flash_attention_with_lse
+
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    # kernel layout [b, h, l, d]; K/V carried (and ppermuted) in this layout
+    # so the transpose happens once, not per ring step
+    qt = q.transpose(0, 2, 1, 3)
+    kt0 = k.transpose(0, 2, 1, 3)
+    vt0 = v.transpose(0, 2, 1, 3)
+
+    def step(carry, t):
+        kt, vt, out, lse = carry
+        src = (me - t) % n
+
+        def full(_):
+            o, s = flash_attention_with_lse(qt, kt, vt, False, scale)
+            return o.astype(jnp.float32), s
+
+        def diag(_):
+            o, s = flash_attention_with_lse(qt, kt, vt, True, scale)
+            return o.astype(jnp.float32), s
+
+        def skip(_):
+            return jnp.zeros_like(out), jnp.full_like(lse, NEG_INF)
+
+        if causal:
+            case = jnp.where(src < me, 0, jnp.where(src == me, 1, 2))
+            o_t, lse_t = lax.switch(case, [full, diag, skip], None)
+        else:
+            o_t, lse_t = full(None)
+
+        lse_new = jnp.logaddexp(lse, lse_t)
+        w_old = jnp.exp(lse - lse_new)[..., None]           # [b,h,lq,1]
+        w_t = jnp.exp(lse_t - lse_new)[..., None]
+        out_new = out * w_old + o_t * w_t
+        k_nxt = lax.ppermute(kt, axis_name, [(i, (i + 1) % n) for i in range(n)])
+        v_nxt = lax.ppermute(vt, axis_name, [(i, (i + 1) % n) for i in range(n)])
+        return (k_nxt, v_nxt, out_new, lse_new), None
+
+    out0 = jnp.zeros((b, h, lq, d), dtype=jnp.float32)
+    lse0 = jnp.full((b, h, lq), NEG_INF, dtype=jnp.float32)
+    (_, _, out, _), _ = lax.scan(step, (kt0, vt0, out0, lse0), jnp.arange(n))
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
 def make_ring_attention(
     mesh: Mesh,
     axis_name: str = "seq",
     causal: bool = True,
+    impl: str | None = None,
 ) -> Callable:
     """shard_map-wrapped ring attention: takes globally-shaped [B,L,H,D]
-    arrays sequence-sharded over `axis_name`, returns same."""
+    arrays sequence-sharded over `axis_name`, returns same.
+
+    ``impl``: "flash" (Pallas kernel per ring step), "xla" (einsum blocks),
+    or None to auto-select flash when on TPU and the head dim is inside the
+    kernel envelope (multiple of 128 — see ops.attention.flash_supported);
+    off-TPU the kernel would run in the Pallas interpreter, so auto keeps
+    the XLA path (tests opt into interpret coverage with impl="flash")."""
+    if impl not in (None, "flash", "xla"):
+        raise ValueError(f"impl must be None, 'flash', or 'xla', got {impl!r}")
     spec = P(None, axis_name, None, None)
 
     @functools.partial(
@@ -121,6 +199,19 @@ def make_ring_attention(
         check_vma=False,
     )
     def _fn(q, k, v):
+        from ..ops.attention import _on_tpu, flash_supported
+        chosen = impl
+        if chosen is None:
+            chosen = "flash" if (_on_tpu() and flash_supported(q)) else "xla"
+        elif chosen == "flash" and _on_tpu() and not flash_supported(q):
+            # surface the envelope constraint instead of an opaque Mosaic
+            # tiling failure deep inside Pallas
+            raise ValueError(
+                f"impl='flash' requires head_dim % 128 == 0 on TPU, got "
+                f"head_dim={q.shape[-1]}; use impl=None or 'xla'"
+            )
+        if chosen == "flash":
+            return ring_flash_attention(q, k, v, axis_name=axis_name, causal=causal)
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
 
     return _fn
