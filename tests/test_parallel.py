@@ -142,16 +142,26 @@ def test_ring_flash_attention_gradients_flow():
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=1e-3)
 
 
-def test_ring_auto_impl_dispatch():
+def test_ring_auto_impl_dispatch(monkeypatch):
     """impl=None: off-TPU auto keeps the XLA path (the flash kernel would run
     in the slow Pallas interpreter) yet stays numerically correct; bogus impl
     strings are rejected instead of silently falling back."""
+    import sys
+    import tony_tpu.parallel.ring_attention  # noqa: F401 (function shadows module attr)
+    ra = sys.modules["tony_tpu.parallel.ring_attention"]
+
     mesh = build_mesh(MeshSpec(fsdp=1, seq=8))
     key = jax.random.PRNGKey(9)
     q, k, v = (
         jax.random.normal(kk, (1, 128, 1, 128), jnp.float32)
         for kk in jax.random.split(key, 3)
     )
+    # prove auto off-TPU never enters the flash ring (numerics alone can't
+    # distinguish the two paths)
+    def _boom(*a, **kw):
+        raise AssertionError("auto dispatch chose flash off-TPU")
+
+    monkeypatch.setattr(ra, "ring_flash_attention", _boom)
     auto = jax.jit(make_ring_attention(mesh, causal=True))(q, k, v)
     expected = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(auto), np.asarray(expected), atol=2e-4)

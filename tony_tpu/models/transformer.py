@@ -51,6 +51,12 @@ class TransformerConfig:
     # "ulysses" (sequence-parallel over the `seq` mesh axis), or "auto"
     attn_impl: str = "auto"
     remat: bool = False
+    # cross-entropy: "dense" materializes [B,L,V] logits; "blockwise" streams
+    # the vocab in ce_block_v blocks (ops/cross_entropy.py) so nothing of
+    # size [N,V] is ever live; "auto" goes blockwise at vocab >= 16384 unless
+    # the mesh has a tensor axis (vocab-sharded dense wins there)
+    ce_impl: str = "auto"
+    ce_block_v: int = 2048
 
     @property
     def head_dim(self) -> int:
@@ -216,13 +222,15 @@ def _layer(cfg: TransformerConfig, mesh, x, positions, lp):
     return x + mlp_out, aux
 
 
-def apply(
+def apply_hidden(
     params: dict,
     tokens: jax.Array,          # [B, L] int32
     cfg: TransformerConfig,
     mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Forward pass -> (logits [B, L, V] f32, aux_loss scalar)."""
+    """Forward pass up to (and including) the final norm -> (hidden
+    [B, L, D], aux_loss scalar). The unembed projection is left to the
+    caller so the loss can stream it blockwise."""
     dt = cfg.dtype
     b, l = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(l), (b, l))
@@ -239,20 +247,79 @@ def apply(
 
     x, auxes = jax.lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"])
+    return x, jnp.sum(auxes) * cfg.aux_loss_weight
+
+
+def apply(
+    params: dict,
+    tokens: jax.Array,          # [B, L] int32
+    cfg: TransformerConfig,
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Forward pass -> (logits [B, L, V] f32, aux_loss scalar)."""
+    x, aux = apply_hidden(params, tokens, cfg, mesh)
     logits = jnp.einsum(
-        "bld,dv->blv", x, params["unembed"].astype(dt)
+        "bld,dv->blv", x, params["unembed"].astype(cfg.dtype)
     ).astype(jnp.float32)
-    return logits, jnp.sum(auxes) * cfg.aux_loss_weight
+    return logits, aux
+
+
+def _use_blockwise_ce(cfg: TransformerConfig, mesh=None) -> bool:
+    if cfg.ce_impl not in ("auto", "dense", "blockwise"):
+        raise ValueError(
+            f"ce_impl must be 'auto', 'dense', or 'blockwise', got {cfg.ce_impl!r}"
+        )
+    if cfg.ce_impl == "blockwise":
+        return True
+    if cfg.ce_impl == "dense":
+        return False
+    # auto: blockwise pays at large vocab, EXCEPT under tensor parallelism —
+    # the vocab axis is tensor-sharded there and the blockwise sweep's traced
+    # dynamic_slice would make GSPMD gather the full unembed on every device,
+    # while the dense einsum keeps logits vocab-sharded (see
+    # ops/cross_entropy.py sharding note)
+    if mesh is not None and dict(getattr(mesh, "shape", {})).get("tensor", 1) > 1:
+        return False
+    return cfg.vocab_size >= 16384
+
+
+def token_nll(x, unembed, safe_targets, cfg: TransformerConfig, mesh=None):
+    """Per-token next-token NLL from final hidden states, dispatching on
+    cfg.ce_impl: blockwise CE streams the unembed matmul + softmax over
+    vocab blocks so the [B, L, V] logits tensor never materializes (forward
+    or backward); dense CE is the materializing reference path. ``auto``
+    also inspects the mesh: with a tensor axis the dense path stays
+    vocab-sharded and wins.
+
+    x: [B, L, D] hidden (post final norm), unembed: [D, V],
+    safe_targets: [B, L] int with pad rows already clamped -> nll [B, L] f32.
+    """
+    if _use_blockwise_ce(cfg, mesh):
+        from ..ops.cross_entropy import blockwise_cross_entropy as _ce
+        nll = _ce(
+            x.reshape(-1, x.shape[-1]), unembed.astype(cfg.dtype),
+            safe_targets.reshape(-1), cfg.ce_block_v,
+        )
+    else:
+        from ..ops.cross_entropy import dense_cross_entropy
+        nll = dense_cross_entropy(
+            x.reshape(-1, x.shape[-1]), unembed.astype(cfg.dtype),
+            safe_targets.reshape(-1),
+        )
+    return nll.reshape(safe_targets.shape)
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig, mesh=None):
-    """Next-token cross entropy (+ MoE aux); targets [B, L] with -1 = pad."""
-    logits, aux = apply(params, tokens, cfg, mesh)
+    """Next-token cross entropy (+ MoE aux); targets [B, L] with -1 = pad.
+
+    With blockwise CE (cfg.ce_impl, default at large vocab) the [B, L, V]
+    logits tensor is never materialized — the unembed matmul and softmax
+    stream the vocabulary in blocks, forward and backward."""
     valid = targets >= 0
     safe_targets = jnp.where(valid, targets, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
     denom = jnp.maximum(valid.sum(), 1)
+    x, aux = apply_hidden(params, tokens, cfg, mesh)
+    nll = token_nll(x, params["unembed"], safe_targets, cfg, mesh)
     return (nll * valid).sum() / denom + aux
 
 

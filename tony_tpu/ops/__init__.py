@@ -1,5 +1,9 @@
 """TPU kernels and fused ops (Pallas where it pays, XLA fusion elsewhere)."""
 
-from .attention import attention_blhd, flash_attention
+from .attention import attention_blhd, flash_attention, flash_attention_with_lse
+from .cross_entropy import blockwise_cross_entropy, dense_cross_entropy
 
-__all__ = ["flash_attention", "attention_blhd"]
+__all__ = [
+    "flash_attention", "flash_attention_with_lse", "attention_blhd",
+    "blockwise_cross_entropy", "dense_cross_entropy",
+]

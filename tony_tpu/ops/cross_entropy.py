@@ -1,0 +1,163 @@
+"""Blockwise (logits-free) cross entropy for large vocabularies.
+
+The last matmul of an LM — ``hidden @ unembed`` — produces a [B*L, V] f32
+logits tensor that usually dwarfs every activation in the model: at
+B*L=32k, V=256k that is 32GB, and XLA autodiff keeps it (plus the softmax)
+alive for the backward. This op fuses the unembed matmul with the softmax
+cross entropy by streaming the vocabulary in blocks under ``lax.scan``:
+
+- forward: running (max, sumexp) over vocab blocks — the classic online
+  logsumexp — plus an in-block gather of each row's target logit. Peak
+  live memory is [N, block_v] instead of [N, V].
+- backward (custom VJP): one more sweep over vocab blocks recomputing the
+  block logits from the saved (hidden, unembed, lse) residuals;
+  ``ds = g * (softmax_block - onehot_block)`` feeds both dx (accumulated)
+  and dW (written block-by-block into a single [D, V] carry). Nothing of
+  size [N, V] ever exists, and no extra copy of the unembed is made:
+  ragged vocabularies are handled by clamping the last block's start and
+  masking the overlapped columns, not by padding the matrix.
+
+Every block op is a large dense matmul -> MXU-friendly; block_v defaults to
+a lane-aligned 2048. This is an XLA-level fusion (scan + matmuls), not a
+Pallas kernel: the matmuls already saturate the MXU and XLA fuses the
+elementwise tail into them, so a hand kernel would only re-derive the same
+schedule.
+
+Sharding note: the blockwise sweep slices the vocab axis with a traced
+start index, which forces GSPMD to gather a vocab-sharded (tensor-parallel)
+unembed. The model-side dispatch (models/transformer.py token_nll) therefore
+keeps the dense sharded path whenever the mesh has a tensor axis; blockwise
+is for the DP/FSDP/SP regimes where the unembed is replicated or
+fully-sharded-then-gathered anyway.
+
+No reference counterpart: TonY has no compute layer (SURVEY.md §2.3); this
+is part of the TPU-native capability layer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+DEFAULT_BLOCK_V = 2048
+
+
+def _num_blocks(v: int, block_v: int) -> int:
+    return -(-v // block_v)
+
+
+def _block_cols(x, w, j, block_v, v):
+    """Logits for vocab block j in f32 without copying/padding w: the last
+    block's start is clamped to v - block_v, and columns already covered by
+    the previous block are masked to NEG_INF. Returns (logits [N, BV],
+    start, cols [N, BV] global column ids, owned mask or None)."""
+    lo = j * block_v
+    start = jnp.minimum(lo, v - block_v)
+    wj = lax.dynamic_slice_in_dim(w, start, block_v, axis=1)
+    logits = jnp.dot(x, wj, preferred_element_type=jnp.float32)
+    cols = start[None, None] + lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    if v % block_v != 0:
+        owned = cols >= lo
+        logits = jnp.where(owned, logits, NEG_INF)
+    else:
+        owned = None
+    return logits, start, cols, owned
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def blockwise_cross_entropy(x, w, targets, block_v=DEFAULT_BLOCK_V):
+    """Per-row softmax cross entropy of ``x @ w`` against ``targets``
+    without materializing the [N, V] logits.
+
+    x: [N, D] hidden states (any float dtype; accumulation in f32)
+    w: [D, V] unembedding matrix
+    targets: [N] int — caller handles padding rows (mask the returned nll)
+    -> nll [N] f32
+    """
+    nll, _ = _ce_fwd_pass(x, w, targets, block_v)
+    return nll
+
+
+def _ce_fwd_pass(x, w, targets, block_v):
+    v = w.shape[1]
+    block_v = min(block_v, v)
+    nb = _num_blocks(v, block_v)
+    n = x.shape[0]
+
+    def body(carry, j):
+        m, l, tl = carry
+        logits, start, _, _ = _block_cols(x, w, j, block_v, v)   # [N, BV]
+        bm = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, bm)
+        l_new = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        # in-block target gather: rows whose target this block owns
+        lo = j * block_v
+        in_blk = (targets >= lo) & (targets < lo + block_v)
+        idx = jnp.clip(targets - start, 0, block_v - 1)
+        row_logit = jnp.take_along_axis(logits, idx[:, None], axis=1)[:, 0]
+        tl = jnp.where(in_blk, row_logit, tl)
+        return (m_new, l_new, tl), None
+
+    m0 = jnp.full((n,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((n,), jnp.float32)
+    tl0 = jnp.zeros((n,), jnp.float32)
+    (m, l, tl), _ = lax.scan(body, (m0, l0, tl0), jnp.arange(nb))
+    lse = m + jnp.log(jnp.maximum(l, 1e-37))
+    return lse - tl, lse
+
+
+def _ce_vjp_fwd(x, w, targets, block_v):
+    nll, lse = _ce_fwd_pass(x, w, targets, block_v)
+    return nll, (x, w, targets, lse)
+
+
+def _ce_vjp_bwd(block_v, res, g):
+    x, w, targets, lse = res
+    v = w.shape[1]
+    block_v = min(block_v, v)
+    nb = _num_blocks(v, block_v)
+    gf = g.astype(jnp.float32)
+    xf32t = x.astype(jnp.float32).T
+
+    def body(carry, j):
+        dx, dw = carry
+        logits, start, cols, owned = _block_cols(x, w, j, block_v, v)
+        p = jnp.exp(logits - lse[:, None])            # masked cols: exp->0
+        onehot = cols == targets[:, None]
+        if owned is not None:
+            onehot &= owned                           # target owned elsewhere
+        ds = gf[:, None] * (p - onehot)               # [N, BV] f32, 0 in overlap
+        wj = lax.dynamic_slice_in_dim(w, start, block_v, axis=1)
+        dx = dx + jnp.dot(
+            ds, wj.T.astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        dwj = jnp.dot(xf32t, ds, preferred_element_type=jnp.float32)  # [D, BV]
+        # read-modify-write the block into the single [D, V] accumulator;
+        # overlapped columns add exact zeros (ds masked), so no double count
+        cur = lax.dynamic_slice_in_dim(dw, start, block_v, axis=1)
+        dw = lax.dynamic_update_slice_in_dim(dw, cur + dwj, start, axis=1)
+        return (dx, dw), None
+
+    dx0 = jnp.zeros(x.shape, jnp.float32)
+    dw0 = jnp.zeros(w.shape, jnp.float32)
+    (dx, dw), _ = lax.scan(body, (dx0, dw0), jnp.arange(nb))
+    return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+
+blockwise_cross_entropy.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)
+
+
+def dense_cross_entropy(x, w, targets):
+    """Reference path: materialize logits, log_softmax, gather."""
+    logits = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, targets[:, None], axis=1)[:, 0]
+
+
+__all__ = ["blockwise_cross_entropy", "dense_cross_entropy"]

@@ -93,13 +93,11 @@ def create_pipeline_train_step(
         x = params["embed"].astype(dt)[tokens]
         x = pipeline(params["layers"], x)
         x = transformer.rms_norm(x, params["final_norm"])
-        logits = jnp.einsum(
-            "bld,dv->blv", x, params["unembed"].astype(dt)
-        ).astype(jnp.float32)
         valid = targets >= 0
         safe = jnp.where(valid, targets, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        # shared CE dispatch (cfg.ce_impl): blockwise streams the unembed
+        # matmul so [B,L,V] logits never materialize
+        nll = transformer.token_nll(x, params["unembed"], safe, cfg, mesh)
         return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
 
     def step(params, opt_state, tokens, targets):
