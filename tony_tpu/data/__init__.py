@@ -1,0 +1,22 @@
+"""Data plane: memmapped token datasets + sharded deterministic loading.
+
+The reference has no data subsystem (TonY never touches tensors —
+SURVEY.md §2.3); this layer exists because a TPU framework that can't feed
+the chips isn't one. See dataset.py / loader.py for the design notes.
+"""
+
+from .dataset import TokenDataset, write_tokens
+from .loader import (
+    BATCH_AXES,
+    PrefetchLoader,
+    ShardedBatchLoader,
+    device_put_sharded_batch,
+    loader_shard_info,
+    sharded_batch_axes,
+)
+
+__all__ = [
+    "TokenDataset", "write_tokens",
+    "ShardedBatchLoader", "PrefetchLoader", "device_put_sharded_batch",
+    "sharded_batch_axes", "loader_shard_info", "BATCH_AXES",
+]

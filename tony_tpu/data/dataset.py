@@ -1,0 +1,122 @@
+"""Token datasets: flat token streams backed by memory-mapped binary files.
+
+The storage format is the simplest thing that feeds a TPU at line rate: one
+flat array of token ids on disk (`<name>.bin`, little-endian uint16/uint32),
+memory-mapped at load. No per-example framing — language-model training
+reads fixed-length windows, so the OS page cache and sequential readahead do
+all the work, and a dataset of any size costs O(1) RAM per process. Writing
+is append-only via :func:`write_tokens`.
+
+No reference counterpart: TonY delegates all data handling to user code
+(SURVEY.md §2.3 — it never touches tensors); this is part of the TPU-native
+capability layer. The format matches what public LM stacks (nanoGPT, llm.c)
+emit, so existing corpora drop in.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+_MAGIC = b"TTPU"
+_VERSION = 1
+_HEADER_BYTES = 16  # magic(4) version(4) dtype-code(4) reserved(4)
+_DTYPES = {1: np.uint16, 2: np.uint32}
+_DTYPE_CODES = {np.dtype(np.uint16): 1, np.dtype(np.uint32): 2}
+
+
+def _read_header_dtype(path: Path) -> np.dtype:
+    with open(path, "rb") as f:
+        header = f.read(_HEADER_BYTES)
+    if len(header) < _HEADER_BYTES or header[:4] != _MAGIC:
+        raise ValueError(f"{path} is not a tony-tpu token file")
+    version = int.from_bytes(header[4:8], "little")
+    if version != _VERSION:
+        raise ValueError(
+            f"{path}: format version {version} != supported {_VERSION}"
+        )
+    code = int.from_bytes(header[8:12], "little")
+    if code not in _DTYPES:
+        raise ValueError(f"{path}: unknown dtype code {code}")
+    return np.dtype(_DTYPES[code])
+
+
+def write_tokens(path: str | Path, tokens, dtype=np.uint16) -> Path:
+    """Write (or append to) a token file. Creates the header on first write;
+    appends always use the dtype recorded in the existing header (mixing
+    widths in one file would corrupt it)."""
+    path = Path(path)
+    arr = np.asarray(tokens)
+    dt = np.dtype(dtype)
+    if dt not in _DTYPE_CODES:
+        raise ValueError(f"dtype must be uint16 or uint32, got {dt}")
+    new = not path.exists()
+    if not new:
+        dt = _read_header_dtype(path)
+    if arr.size and int(arr.min()) < 0:
+        raise ValueError(
+            f"token id {int(arr.min())} is negative (would wrap to a huge "
+            f"unsigned id)"
+        )
+    if arr.size and int(arr.max()) > np.iinfo(dt).max:
+        raise ValueError(
+            f"token id {int(arr.max())} exceeds {dt} range"
+            + ("; use uint32" if dt == np.uint16 and new else
+               f" (file {path} is {dt})")
+        )
+    with open(path, "ab") as f:
+        if new:
+            header = (
+                _MAGIC
+                + _VERSION.to_bytes(4, "little")
+                + _DTYPE_CODES[dt].to_bytes(4, "little")
+                + b"\x00" * 4
+            )
+            f.write(header)
+        f.write(arr.astype(dt).tobytes())
+    return path
+
+
+class TokenDataset:
+    """A flat token stream; index/slice like an array, tokens come back
+    int32 (what jax wants for embedding lookups)."""
+
+    def __init__(self, tokens: np.ndarray):
+        self._tokens = tokens
+
+    @classmethod
+    def from_bin(cls, path: str | Path) -> "TokenDataset":
+        path = Path(path)
+        dt = _read_header_dtype(path)
+        mm = np.memmap(path, dtype=dt, mode="r", offset=_HEADER_BYTES)
+        return cls(mm)
+
+    @classmethod
+    def from_array(cls, tokens) -> "TokenDataset":
+        return cls(np.asarray(tokens))
+
+    def __len__(self) -> int:
+        return len(self._tokens)
+
+    def window(self, start: int, length: int) -> np.ndarray:
+        """tokens[start : start+length] as int32."""
+        return np.asarray(self._tokens[start:start + length], dtype=np.int32)
+
+    def num_windows(self, seq_len: int) -> int:
+        """How many non-overlapping (seq_len+1)-token windows fit (each
+        window yields seq_len inputs + shifted targets)."""
+        return max(0, (len(self._tokens) - 1) // seq_len)
+
+    def max_token(self, chunk: int = 1 << 24) -> int:
+        """Max token id over the WHOLE stream (one sequential chunked pass
+        over the memmap — O(1) RAM; use for vocab-range validation)."""
+        best = -1
+        for lo in range(0, len(self._tokens), chunk):
+            part = self._tokens[lo:lo + chunk]
+            if len(part):
+                best = max(best, int(part.max()))
+        return best
+
+
+__all__ = ["TokenDataset", "write_tokens"]

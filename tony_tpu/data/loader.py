@@ -1,0 +1,272 @@
+"""Sharded, deterministic, resumable batch loading for LM training.
+
+Design constraints, in order:
+
+1. **Determinism as a function of (seed, step).** Batch ``i`` is fully
+   determined by the seed and the global step — no loader state beyond an
+   integer. That is what makes checkpoint/resume exact (restore the step,
+   get the same stream) and what makes multi-host loading coordination-free:
+   every process computes the same global permutation and takes its slice,
+   no data service, no cross-host chatter on the input path.
+2. **Per-process sharding.** The global batch is split evenly across
+   processes (TPU hosts); process p takes rows ``p::process_count`` of each
+   global batch, so the union over processes is exactly the global batch and
+   shards are disjoint. Pair with
+   ``jax.make_array_from_process_local_data`` to build the global
+   device array (train/bootstrap emits process_index/count from the
+   orchestrator's env contract).
+3. **Host-side prefetch.** A background thread assembles the next batch
+   (page-cache reads + windowing) while the TPU runs the current step —
+   input never gates the step loop. Double-buffered; ``close()`` joins the
+   thread.
+
+Epoch shuffling is a seeded permutation of non-overlapping windows; the
+window order differs every epoch (seed ^ epoch) but never within a resume.
+
+No reference counterpart (TonY has no data plane, SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from .dataset import TokenDataset
+
+
+class ShardedBatchLoader:
+    """Deterministic (seed, step) -> local batch of (inputs, targets).
+
+    global_batch is the TOTAL batch across all processes; this loader
+    yields the local_batch = global_batch / process_count rows belonging to
+    ``process_index``.
+    """
+
+    def __init__(
+        self,
+        dataset: TokenDataset,
+        global_batch: int,
+        seq_len: int,
+        *,
+        seed: int = 0,
+        process_index: int = 0,
+        process_count: int = 1,
+        start_step: int = 0,
+    ):
+        if global_batch % process_count != 0:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by "
+                f"process_count {process_count}"
+            )
+        if seed < 0:
+            raise ValueError(f"seed must be non-negative, got {seed}")
+        self.dataset = dataset
+        self.global_batch = global_batch
+        self.local_batch = global_batch // process_count
+        self.seq_len = seq_len
+        self.seed = seed
+        self.process_index = process_index
+        self.process_count = process_count
+        self.step = start_step
+
+        self._num_windows = dataset.num_windows(seq_len)
+        if self._num_windows < global_batch:
+            raise ValueError(
+                f"dataset has {self._num_windows} windows of seq_len "
+                f"{seq_len}, need at least global_batch={global_batch}"
+            )
+        self.steps_per_epoch = self._num_windows // global_batch
+        self._perm_epoch = -1
+        self._perm: np.ndarray | None = None
+
+    def _epoch_perm(self, epoch: int) -> np.ndarray:
+        if epoch != self._perm_epoch:
+            rng = np.random.default_rng(np.uint64(self.seed) ^ np.uint64(epoch * 0x9E3779B9 + 1))
+            self._perm = rng.permutation(self._num_windows)
+            self._perm_epoch = epoch
+        return self._perm
+
+    def batch_at(self, step: int) -> tuple[np.ndarray, np.ndarray]:
+        """The local (inputs, targets) for global step `step`, each
+        [local_batch, seq_len] int32."""
+        epoch = step // self.steps_per_epoch
+        i = step % self.steps_per_epoch
+        perm = self._epoch_perm(epoch)
+        global_rows = perm[i * self.global_batch:(i + 1) * self.global_batch]
+        local_rows = global_rows[self.process_index::self.process_count]
+        xs = np.stack([
+            self.dataset.window(int(w) * self.seq_len, self.seq_len + 1)
+            for w in local_rows
+        ])
+        return xs[:, :-1].copy(), xs[:, 1:].copy()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> tuple[np.ndarray, np.ndarray]:
+        batch = self.batch_at(self.step)
+        self.step += 1
+        return batch
+
+    # ------------------------------------------------------------- resume
+    def state(self) -> dict:
+        """Checkpointable state — pair with restore() for exact resume."""
+        return {"step": self.step, "seed": self.seed}
+
+    def restore(self, state: dict) -> None:
+        if int(state.get("seed", self.seed)) != self.seed:
+            raise ValueError(
+                f"restoring loader with seed {state['seed']} into a loader "
+                f"seeded {self.seed} would silently change the data order"
+            )
+        self.step = int(state["step"])
+
+
+class PrefetchLoader:
+    """Wrap any batch iterator with a background producer thread so batch
+    assembly overlaps device compute. Yields exactly the wrapped iterator's
+    stream; `close()` (or exhaustion) stops the thread.
+
+    Checkpointing note: the producer runs AHEAD of the consumer (queue depth
+    + one in flight), so the wrapped loader's own ``state()`` would record a
+    step the trainer hasn't seen. Use THIS object's ``state()`` — it counts
+    consumed batches against the state captured at wrap time, so a restore
+    replays exactly the first unconsumed batch."""
+
+    _DONE = object()
+
+    def __init__(self, it, depth: int = 2):
+        self._it = it
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._err: BaseException | None = None
+        self._finished = False
+        self._consumed = 0
+        self._base_state = it.state() if hasattr(it, "state") else None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Blocking put that aborts when close() is requested — a plain
+        put() could deadlock the thread forever on a full queue (close()
+        drains once, but a small depth can refill before the final _DONE)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self):
+        try:
+            for item in self._it:
+                if self._stop.is_set() or not self._put(item):
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            self._err = e
+        finally:
+            self._put(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._finished = True
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        self._consumed += 1
+        return item
+
+    def state(self) -> dict:
+        """Consumption-corrected checkpoint state of the wrapped loader."""
+        if self._base_state is None:
+            raise TypeError(
+                f"wrapped iterator {type(self._it).__name__} has no state()"
+            )
+        out = dict(self._base_state)
+        out["step"] = int(out["step"]) + self._consumed
+        return out
+
+    def close(self):
+        self._stop.set()
+        self._finished = True
+        # unblock a producer waiting on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+
+# the "batch" row of the sharding rule tables is the single source of truth
+# for which mesh axes consume the batch (parallel/sharding.py DP_RULES);
+# callers with a custom rule table pass rules= so loader decisions and
+# train-step shardings can't diverge
+from ..parallel.sharding import DP_RULES as _DP_RULES
+
+BATCH_AXES = tuple(_DP_RULES["batch"])
+
+
+def _resolve_batch_axes(batch_axes, rules):
+    if rules is not None:
+        axes = rules.get("batch", ())
+        return (axes,) if isinstance(axes, str) else tuple(axes)
+    return batch_axes
+
+
+def sharded_batch_axes(mesh, batch_axes=BATCH_AXES, rules=None) -> tuple:
+    """The subset of the batch axes the mesh actually shards (>1 devices)."""
+    batch_axes = _resolve_batch_axes(batch_axes, rules)
+    return tuple(a for a in batch_axes if dict(mesh.shape).get(a, 1) > 1)
+
+
+def loader_shard_info(mesh, process_index: int, process_count: int,
+                      batch_axes=BATCH_AXES, rules=None) -> tuple[int, int]:
+    """(process_index, process_count) a ShardedBatchLoader should use for
+    this mesh: shard the global batch across processes iff the mesh shards a
+    batch axis; otherwise (seq/tensor-only meshes) every process must load
+    the IDENTICAL full batch — the loader's (seed, step) determinism makes
+    that coordination-free — because the device placement below replicates
+    the batch."""
+    if sharded_batch_axes(mesh, batch_axes, rules):
+        return process_index, process_count
+    return 0, 1
+
+
+def device_put_sharded_batch(batch, mesh, batch_axes=BATCH_AXES, rules=None):
+    """Place a process-local [local_batch, ...] numpy batch as a global jax
+    Array sharded over the mesh's batch axes (multi-host safe: uses
+    make_array_from_process_local_data, which is a no-op device_put on a
+    single host).
+
+    Caller contract (what :func:`loader_shard_info` arranges): when the mesh
+    shards a batch axis, each process passes its disjoint local shard; when
+    it shards none, each process passes the SAME full global batch (the spec
+    is replicated, and divergent per-host data would silently corrupt
+    collectives)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axes = sharded_batch_axes(mesh, batch_axes, rules)
+    spec = P(axes if axes else None)
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), batch
+    )
+
+
+__all__ = [
+    "ShardedBatchLoader", "PrefetchLoader", "device_put_sharded_batch",
+    "sharded_batch_axes", "loader_shard_info", "BATCH_AXES",
+]

@@ -39,6 +39,9 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpoint-every", type=int, default=50)
     parser.add_argument("--profile-dir", default="")
     parser.add_argument("--metrics-out", default="")
+    parser.add_argument("--data", default="",
+                        help="token .bin file (tony_tpu.data); empty = synthetic")
+    parser.add_argument("--data-seed", type=int, default=0)
     args = parser.parse_args(argv)
 
     import jax
@@ -91,26 +94,59 @@ def main(argv=None) -> int:
             start_step = latest + 1
             print(f"resumed from checkpoint step {latest}")
 
+    loader = None
+    if args.data:
+        from tony_tpu.data import (
+            PrefetchLoader, ShardedBatchLoader, TokenDataset,
+            device_put_sharded_batch, loader_shard_info,
+        )
+
+        dataset = TokenDataset.from_bin(args.data)
+        corpus_max = dataset.max_token()
+        if corpus_max >= args.vocab:
+            raise SystemExit(
+                f"--data contains token id {corpus_max} >= --vocab "
+                f"{args.vocab}; retokenize or raise --vocab"
+            )
+        # per-process shards when a batch axis is mesh-sharded; on a
+        # seq/tensor-only mesh every host loads the identical full batch
+        pi, pc = loader_shard_info(
+            mesh, info["process_id"], info["num_processes"], rules=rules)
+        loader = PrefetchLoader(ShardedBatchLoader(
+            dataset, args.batch_size, args.seq_len, seed=args.data_seed,
+            process_index=pi, process_count=pc, start_step=start_step,
+        ))
+
+    def next_batch(step_i):
+        if loader is None:
+            return train.synthetic_lm_batch(
+                jax.random.PRNGKey(step_i), args.batch_size, args.seq_len,
+                args.vocab,
+            )
+        return device_put_sharded_batch(next(loader), mesh, rules=rules)
+
     timer = StepTimer()
     losses = []
     t0 = time.time()
-    with trace(args.profile_dir, enabled=bool(args.profile_dir)):
-        for step_i in range(start_step, start_step + args.steps):
-            tokens, targets = train.synthetic_lm_batch(
-                jax.random.PRNGKey(step_i), args.batch_size, args.seq_len, args.vocab
-            )
-            params, opt_state, metrics = bundle.step_fn(
-                params, opt_state, tokens, targets
-            )
-            timer.tick()
-            if step_i % 20 == 0:
-                loss = float(metrics["loss"])  # sync point
-                losses.append(loss)
-                if info["process_id"] == 0:
-                    print(f"step {step_i}: loss {loss:.4f} "
-                          f"({timer.steps_per_sec:.2f} steps/s)")
-            if mgr is not None and step_i % args.checkpoint_every == 0 and step_i > 0:
-                mgr.save(step_i, {"params": params, "opt_state": opt_state})
+    try:
+        with trace(args.profile_dir, enabled=bool(args.profile_dir)):
+            for step_i in range(start_step, start_step + args.steps):
+                tokens, targets = next_batch(step_i)
+                params, opt_state, metrics = bundle.step_fn(
+                    params, opt_state, tokens, targets
+                )
+                timer.tick()
+                if step_i % 20 == 0:
+                    loss = float(metrics["loss"])  # sync point
+                    losses.append(loss)
+                    if info["process_id"] == 0:
+                        print(f"step {step_i}: loss {loss:.4f} "
+                              f"({timer.steps_per_sec:.2f} steps/s)")
+                if mgr is not None and step_i % args.checkpoint_every == 0 and step_i > 0:
+                    mgr.save(step_i, {"params": params, "opt_state": opt_state})
+    finally:
+        if loader is not None:
+            loader.close()
     final_loss = float(metrics["loss"])
     wall = time.time() - t0
     if mgr is not None:

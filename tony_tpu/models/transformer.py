@@ -264,7 +264,7 @@ def apply(
     return logits, aux
 
 
-def _use_blockwise_ce(cfg: TransformerConfig, mesh=None) -> bool:
+def _use_blockwise_ce(cfg: TransformerConfig, mesh=None, rules=None) -> bool:
     if cfg.ce_impl not in ("auto", "dense", "blockwise"):
         raise ValueError(
             f"ce_impl must be 'auto', 'dense', or 'blockwise', got {cfg.ce_impl!r}"
@@ -273,28 +273,37 @@ def _use_blockwise_ce(cfg: TransformerConfig, mesh=None) -> bool:
         return True
     if cfg.ce_impl == "dense":
         return False
-    # auto: blockwise pays at large vocab, EXCEPT under tensor parallelism —
-    # the vocab axis is tensor-sharded there and the blockwise sweep's traced
+    # auto: blockwise pays at large vocab, EXCEPT when the unembed's vocab
+    # dim is mesh-sharded (tensor parallelism) — the blockwise sweep's traced
     # dynamic_slice would make GSPMD gather the full unembed on every device,
     # while the dense einsum keeps logits vocab-sharded (see
-    # ops/cross_entropy.py sharding note)
-    if mesh is not None and dict(getattr(mesh, "shape", {})).get("tensor", 1) > 1:
+    # ops/cross_entropy.py sharding note). The rules table's "vocab" row is
+    # the source of truth for which axis that is; default "tensor".
+    vocab_axes = rules.get("vocab") if rules is not None else "tensor"
+    if isinstance(vocab_axes, str):
+        vocab_axes = (vocab_axes,)
+    if mesh is not None and vocab_axes and any(
+        dict(getattr(mesh, "shape", {})).get(a, 1) > 1 for a in vocab_axes
+    ):
         return False
     return cfg.vocab_size >= 16384
 
 
-def token_nll(x, unembed, safe_targets, cfg: TransformerConfig, mesh=None):
-    """Per-token next-token NLL from final hidden states, dispatching on
+def token_nll(x, unembed, targets, cfg: TransformerConfig, mesh=None,
+              rules=None):
+    """Masked mean next-token NLL from final hidden states, dispatching on
     cfg.ce_impl: blockwise CE streams the unembed matmul + softmax over
     vocab blocks so the [B, L, V] logits tensor never materializes (forward
     or backward); dense CE is the materializing reference path. ``auto``
-    also inspects the mesh: with a tensor axis the dense path stays
-    vocab-sharded and wins.
+    also inspects the mesh/rules: with the vocab dim mesh-sharded the dense
+    path stays vocab-sharded and wins.
 
-    x: [B, L, D] hidden (post final norm), unembed: [D, V],
-    safe_targets: [B, L] int with pad rows already clamped -> nll [B, L] f32.
+    x: [B, L, D] hidden (post final norm), unembed: [D, V], targets: [B, L]
+    int with -1 = pad (masked out here) -> scalar mean NLL (f32).
     """
-    if _use_blockwise_ce(cfg, mesh):
+    valid = targets >= 0
+    safe_targets = jnp.where(valid, targets, 0)
+    if _use_blockwise_ce(cfg, mesh, rules):
         from ..ops.cross_entropy import blockwise_cross_entropy as _ce
         nll = _ce(
             x.reshape(-1, x.shape[-1]), unembed.astype(cfg.dtype),
@@ -306,21 +315,19 @@ def token_nll(x, unembed, safe_targets, cfg: TransformerConfig, mesh=None):
             x.reshape(-1, x.shape[-1]), unembed.astype(cfg.dtype),
             safe_targets.reshape(-1),
         )
-    return nll.reshape(safe_targets.shape)
+    nll = nll.reshape(targets.shape)
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
 
 
-def loss_fn(params, tokens, targets, cfg: TransformerConfig, mesh=None):
+def loss_fn(params, tokens, targets, cfg: TransformerConfig, mesh=None,
+            rules=None):
     """Next-token cross entropy (+ MoE aux); targets [B, L] with -1 = pad.
 
     With blockwise CE (cfg.ce_impl, default at large vocab) the [B, L, V]
     logits tensor is never materialized — the unembed matmul and softmax
     stream the vocabulary in blocks, forward and backward."""
-    valid = targets >= 0
-    safe_targets = jnp.where(valid, targets, 0)
-    denom = jnp.maximum(valid.sum(), 1)
     x, aux = apply_hidden(params, tokens, cfg, mesh)
-    nll = token_nll(x, params["unembed"], safe_targets, cfg, mesh)
-    return (nll * valid).sum() / denom + aux
+    return token_nll(x, params["unembed"], targets, cfg, mesh, rules) + aux
 
 
 def num_params(params) -> int:

@@ -93,12 +93,9 @@ def create_pipeline_train_step(
         x = params["embed"].astype(dt)[tokens]
         x = pipeline(params["layers"], x)
         x = transformer.rms_norm(x, params["final_norm"])
-        valid = targets >= 0
-        safe = jnp.where(valid, targets, 0)
-        # shared CE dispatch (cfg.ce_impl): blockwise streams the unembed
-        # matmul so [B,L,V] logits never materialize
-        nll = transformer.token_nll(x, params["unembed"], safe, cfg, mesh)
-        return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
+        # shared CE dispatch + pad masking (cfg.ce_impl): blockwise streams
+        # the unembed matmul so [B,L,V] logits never materialize
+        return transformer.token_nll(x, params["unembed"], targets, cfg, mesh)
 
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)

@@ -96,7 +96,7 @@ def create_train_step(
 
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(transformer.loss_fn)(
-            params, tokens, targets, cfg, mesh
+            params, tokens, targets, cfg, mesh, rules
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
