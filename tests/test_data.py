@@ -300,8 +300,85 @@ def test_batch_axes_follow_rules_table():
 
 def test_max_token_scans_whole_stream(tmp_path):
     toks = np.zeros(5000, dtype=np.int64)
-    toks[4999] = 300  # out-of-range id at the very end must be found
+    toks[4999] = 300  # id at the very end must be found
     p = write_tokens(tmp_path / "t.bin", toks)
     ds = TokenDataset.from_bin(p)
+    assert ds._header_max == 300  # write_tokens caches it -> O(1) validation
     assert ds.max_token() == 300
-    assert ds.max_token(chunk=64) == 300
+    # files from other writers (field = 0) fall back to the full chunked scan
+    raw = bytearray(p.read_bytes())
+    raw[12:16] = b"\x00" * 4
+    p.write_bytes(bytes(raw))
+    ds2 = TokenDataset.from_bin(p)
+    assert ds2._header_max is None
+    assert ds2.max_token(chunk=64) == 300
+    # append keeps the cached max current
+    write_tokens(tmp_path / "t2.bin", [5])
+    write_tokens(tmp_path / "t2.bin", [9, 2])
+    assert TokenDataset.from_bin(tmp_path / "t2.bin").max_token() == 9
+
+
+def test_lm_train_data_on_seq_mesh(tmp_path):
+    """Regression: --data with a sequence-parallel mesh must place batches
+    with the step's P(batch, seq) sharding (a batch-only spec crashed jit)."""
+    import json
+    from tony_tpu.examples import lm_train
+
+    rng = np.random.default_rng(1)
+    path = write_tokens(tmp_path / "c.bin", rng.integers(0, 128, size=40000))
+    out = tmp_path / "m.json"
+    rc = lm_train.main([
+        "--steps", "2", "--batch-size", "2", "--seq-len", "64",
+        "--vocab", "128", "--d-model", "32", "--n-layers", "1",
+        "--n-heads", "2", "--d-ff", "64", "--dtype", "float32",
+        "--mesh", "seq=8", "--data", str(path), "--metrics-out", str(out),
+    ])
+    assert rc == 0
+    assert np.isfinite(json.loads(out.read_text())["final_loss"])
+
+
+def test_restore_validates_stream_addressing_fields():
+    ds = _toy_dataset()
+    state = ShardedBatchLoader(ds, 8, 32, seed=5).state()
+    with pytest.raises(ValueError, match="global_batch"):
+        ShardedBatchLoader(ds, 4, 32, seed=5).restore(state)
+    with pytest.raises(ValueError, match="seq_len"):
+        ShardedBatchLoader(ds, 8, 16, seed=5).restore(state)
+
+
+def test_device_put_handles_mixed_rank_leaves():
+    """1-D per-example leaves (lengths/weights) must get a batch-only spec,
+    not a rank-2 spec that crashes placement."""
+    from tony_tpu.parallel import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(data=2, fsdp=4))
+    batch = {"tokens": np.zeros((8, 16), np.int32),
+             "weights": np.ones((8,), np.float32)}
+    placed = device_put_sharded_batch(batch, mesh)
+    assert placed["tokens"].shape == (8, 16)
+    assert placed["weights"].shape == (8,)
+
+
+def test_from_raw_headerless_stream(tmp_path):
+    """nanoGPT-style raw uint16 files load via from_raw; lm_train falls back
+    to it automatically when the TTPU magic is absent."""
+    import json
+    from tony_tpu.examples import lm_train
+
+    toks = np.random.default_rng(3).integers(0, 200, size=30000).astype(np.uint16)
+    p = tmp_path / "raw.bin"
+    p.write_bytes(toks.tobytes())
+    ds = TokenDataset.from_raw(p)
+    assert len(ds) == 30000
+    np.testing.assert_array_equal(ds.window(0, 10), toks[:10].astype(np.int32))
+    assert ds.max_token() == int(toks.max())
+
+    out = tmp_path / "m.json"
+    rc = lm_train.main([
+        "--steps", "2", "--batch-size", "8", "--seq-len", "32",
+        "--vocab", "256", "--d-model", "32", "--n-layers", "1",
+        "--n-heads", "2", "--d-ff", "64", "--dtype", "float32",
+        "--mesh", "data=2,fsdp=4", "--data", str(p), "--metrics-out", str(out),
+    ])
+    assert rc == 0
+    assert np.isfinite(json.loads(out.read_text())["final_loss"])

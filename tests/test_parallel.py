@@ -370,3 +370,19 @@ def test_moe_expert_sharded_matches_unsharded():
         lambda *a: moe_ffn(*a, k=2, capacity_factor=4.0)
     )(x, router_w, w_in_s, w_out_s)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+
+
+def test_moe_capacity_no_float_truncation():
+    """capacity_factor = e/k must guarantee capacity >= tokens (drop-free
+    decode contract): (4/3)*21/4 floats to 6.999..., int() must not drop."""
+    from tony_tpu.parallel.expert import top_k_routing
+
+    t, k, e = 7, 3, 4
+    cf = e / k
+    cap = max(1, int(cf * t * k / e + 1e-6))
+    assert cap >= t
+    # end-to-end: no token loses all its routing weight at that capacity
+    logits = jnp.zeros((t, e))  # ties: all tokens pick the same experts
+    dispatch, combine = top_k_routing(logits, k, cap)
+    kept = np.asarray(combine.sum(axis=(1, 2)))
+    assert (kept > 0).all()

@@ -9,8 +9,9 @@ is append-only via :func:`write_tokens`.
 
 No reference counterpart: TonY delegates all data handling to user code
 (SURVEY.md §2.3 — it never touches tensors); this is part of the TPU-native
-capability layer. The format matches what public LM stacks (nanoGPT, llm.c)
-emit, so existing corpora drop in.
+capability layer. Files written here carry a 16-byte TTPU header (dtype +
+cached max token id); raw headerless streams in the nanoGPT/llm.c style load
+via :meth:`TokenDataset.from_raw` with an explicit dtype.
 """
 
 from __future__ import annotations
@@ -21,7 +22,11 @@ import numpy as np
 
 _MAGIC = b"TTPU"
 _VERSION = 1
-_HEADER_BYTES = 16  # magic(4) version(4) dtype-code(4) reserved(4)
+# header: magic(4) version(4) dtype-code(4) max-token+1(4). The last field
+# caches the running max token id (0 = unknown, e.g. files from other
+# writers) so vocab validation is O(1) instead of a full-corpus scan.
+_HEADER_BYTES = 16
+_MAXTOK_OFFSET = 12
 _DTYPES = {1: np.uint16, 2: np.uint32}
 _DTYPE_CODES = {np.dtype(np.uint16): 1, np.dtype(np.uint32): 2}
 
@@ -75,6 +80,19 @@ def write_tokens(path: str | Path, tokens, dtype=np.uint16) -> Path:
             )
             f.write(header)
         f.write(arr.astype(dt).tobytes())
+    if arr.size:
+        # keep the cached max-token header field current (stored as max+1;
+        # 0 = unknown). max id 2**32-1 can't be encoded as max+1 in 4 bytes,
+        # so that corner degrades to unknown (full scan) instead of crashing
+        with open(path, "r+b") as f:
+            f.seek(_MAXTOK_OFFSET)
+            prev = int.from_bytes(f.read(4), "little")
+            cur = int(arr.max()) + 1
+            if cur >= 2 ** 32:
+                cur = 0
+            if new or (prev > 0 and (cur > prev or cur == 0)):
+                f.seek(_MAXTOK_OFFSET)
+                f.write(cur.to_bytes(4, "little"))
     return path
 
 
@@ -82,15 +100,29 @@ class TokenDataset:
     """A flat token stream; index/slice like an array, tokens come back
     int32 (what jax wants for embedding lookups)."""
 
-    def __init__(self, tokens: np.ndarray):
+    def __init__(self, tokens: np.ndarray, header_max: int | None = None):
         self._tokens = tokens
+        self._header_max = header_max  # cached max id from the file header
 
     @classmethod
     def from_bin(cls, path: str | Path) -> "TokenDataset":
         path = Path(path)
         dt = _read_header_dtype(path)
+        with open(path, "rb") as f:
+            f.seek(_MAXTOK_OFFSET)
+            field = int.from_bytes(f.read(4), "little")
         mm = np.memmap(path, dtype=dt, mode="r", offset=_HEADER_BYTES)
-        return cls(mm)
+        return cls(mm, header_max=field - 1 if field > 0 else None)
+
+    @classmethod
+    def from_raw(cls, path: str | Path, dtype=np.uint16) -> "TokenDataset":
+        """Headerless flat token stream (nanoGPT/llm.c style): the whole
+        file is one little-endian array of `dtype`. Max token is unknown
+        up front, so vocab validation does the chunked scan."""
+        dt = np.dtype(dtype)
+        if dt not in _DTYPE_CODES:
+            raise ValueError(f"dtype must be uint16 or uint32, got {dt}")
+        return cls(np.memmap(path, dtype=dt, mode="r"))
 
     @classmethod
     def from_array(cls, tokens) -> "TokenDataset":
@@ -109,8 +141,11 @@ class TokenDataset:
         return max(0, (len(self._tokens) - 1) // seq_len)
 
     def max_token(self, chunk: int = 1 << 24) -> int:
-        """Max token id over the WHOLE stream (one sequential chunked pass
-        over the memmap — O(1) RAM; use for vocab-range validation)."""
+        """Max token id over the WHOLE stream. O(1) when the file header
+        carries the cached max (files written by write_tokens); otherwise
+        one sequential chunked pass over the memmap (O(1) RAM)."""
+        if self._header_max is not None:
+            return self._header_max
         best = -1
         for lo in range(0, len(self._tokens), chunk):
             part = self._tokens[lo:lo + chunk]

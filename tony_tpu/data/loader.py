@@ -113,14 +113,26 @@ class ShardedBatchLoader:
     # ------------------------------------------------------------- resume
     def state(self) -> dict:
         """Checkpointable state — pair with restore() for exact resume."""
-        return {"step": self.step, "seed": self.seed}
+        return {
+            "step": self.step, "seed": self.seed,
+            "global_batch": self.global_batch, "seq_len": self.seq_len,
+            "process_index": self.process_index,
+            "process_count": self.process_count,
+        }
 
     def restore(self, state: dict) -> None:
-        if int(state.get("seed", self.seed)) != self.seed:
-            raise ValueError(
-                f"restoring loader with seed {state['seed']} into a loader "
-                f"seeded {self.seed} would silently change the data order"
-            )
+        # every field that addresses the stream must match, or the resumed
+        # run silently trains on a different window sequence
+        for field in ("seed", "global_batch", "seq_len",
+                      "process_index", "process_count"):
+            mine = getattr(self, field)
+            theirs = int(state.get(field, mine))
+            if theirs != mine:
+                raise ValueError(
+                    f"restoring loader state with {field}={theirs} into a "
+                    f"loader with {field}={mine} would silently change the "
+                    "data stream"
+                )
         self.step = int(state["step"])
 
 
@@ -213,22 +225,14 @@ class PrefetchLoader:
 # for which mesh axes consume the batch (parallel/sharding.py DP_RULES);
 # callers with a custom rule table pass rules= so loader decisions and
 # train-step shardings can't diverge
-from ..parallel.sharding import DP_RULES as _DP_RULES
+from ..parallel.sharding import DP_RULES as _DP_RULES, mesh_shards_rule
 
 BATCH_AXES = tuple(_DP_RULES["batch"])
 
 
-def _resolve_batch_axes(batch_axes, rules):
-    if rules is not None:
-        axes = rules.get("batch", ())
-        return (axes,) if isinstance(axes, str) else tuple(axes)
-    return batch_axes
-
-
 def sharded_batch_axes(mesh, batch_axes=BATCH_AXES, rules=None) -> tuple:
     """The subset of the batch axes the mesh actually shards (>1 devices)."""
-    batch_axes = _resolve_batch_axes(batch_axes, rules)
-    return tuple(a for a in batch_axes if dict(mesh.shape).get(a, 1) > 1)
+    return mesh_shards_rule(mesh, rules, "batch", default=batch_axes)
 
 
 def loader_shard_info(mesh, process_index: int, process_count: int,
@@ -244,26 +248,51 @@ def loader_shard_info(mesh, process_index: int, process_count: int,
     return 0, 1
 
 
-def device_put_sharded_batch(batch, mesh, batch_axes=BATCH_AXES, rules=None):
-    """Place a process-local [local_batch, ...] numpy batch as a global jax
-    Array sharded over the mesh's batch axes (multi-host safe: uses
+def device_put_sharded_batch(batch, mesh, batch_axes=BATCH_AXES, rules=None,
+                             sharding=None, global_batch=None):
+    """Place a process-local [local_batch, seq] numpy batch as a global jax
+    Array matching the train step's input sharding (multi-host safe: uses
     make_array_from_process_local_data, which is a no-op device_put on a
     single host).
 
+    The derived spec covers BOTH input dims: batch over the rules' "batch"
+    axes and sequence over the rules' "act_seq" axis (sequence parallelism)
+    — a batch-only spec would mismatch the jitted step's committed
+    in_shardings on seq meshes and crash. Pass ``sharding`` explicitly (e.g.
+    the bundle's token sharding) to bypass derivation entirely.
+
     Caller contract (what :func:`loader_shard_info` arranges): when the mesh
     shards a batch axis, each process passes its disjoint local shard; when
-    it shards none, each process passes the SAME full global batch (the spec
-    is replicated, and divergent per-host data would silently corrupt
-    collectives)."""
+    it shards none, each process passes the SAME full global batch along
+    that dim (divergent per-host data would silently corrupt collectives).
+
+    Pass ``global_batch`` (the TOTAL batch across processes — the loader's
+    ``global_batch``) on multi-host jobs: without it JAX must infer the
+    global shape from per-host shapes, which double-counts dims where the
+    local data spans the global extent (the replicated-batch seq-mesh case)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    axes = sharded_batch_axes(mesh, batch_axes, rules)
-    spec = P(axes if axes else None)
-    sharding = NamedSharding(mesh, spec)
-    return jax.tree.map(
-        lambda x: jax.make_array_from_process_local_data(sharding, x), batch
-    )
+    def sharding_for_leaf(x):
+        if sharding is not None:
+            return sharding
+        axes = sharded_batch_axes(mesh, batch_axes, rules)
+        seq_axes = mesh_shards_rule(mesh, rules, "act_seq", default=())
+        # spec rank must not exceed the leaf's rank: [B] leaves (lengths,
+        # weights) get batch-only; [B, L, ...] leaves get batch + seq
+        entries = [axes if axes else None]
+        if x.ndim >= 2:
+            entries.append(seq_axes if seq_axes else None)
+        return NamedSharding(mesh, P(*entries))
+
+    def place(x):
+        gshape = None
+        if global_batch is not None:
+            gshape = (global_batch,) + tuple(x.shape[1:])
+        return jax.make_array_from_process_local_data(
+            sharding_for_leaf(x), x, gshape)
+
+    return jax.tree.map(place, batch)
 
 
 __all__ = [

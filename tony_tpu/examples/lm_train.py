@@ -42,6 +42,8 @@ def main(argv=None) -> int:
     parser.add_argument("--data", default="",
                         help="token .bin file (tony_tpu.data); empty = synthetic")
     parser.add_argument("--data-seed", type=int, default=0)
+    parser.add_argument("--data-raw-dtype", default="uint16",
+                        help="dtype for headerless (nanoGPT-style) token files")
     args = parser.parse_args(argv)
 
     import jax
@@ -101,7 +103,13 @@ def main(argv=None) -> int:
             device_put_sharded_batch, loader_shard_info,
         )
 
-        dataset = TokenDataset.from_bin(args.data)
+        try:
+            dataset = TokenDataset.from_bin(args.data)
+        except ValueError:
+            # headerless raw stream (nanoGPT/llm.c style)
+            import numpy as _np
+            dataset = TokenDataset.from_raw(
+                args.data, getattr(_np, args.data_raw_dtype))
         corpus_max = dataset.max_token()
         if corpus_max >= args.vocab:
             raise SystemExit(
@@ -111,7 +119,7 @@ def main(argv=None) -> int:
         # per-process shards when a batch axis is mesh-sharded; on a
         # seq/tensor-only mesh every host loads the identical full batch
         pi, pc = loader_shard_info(
-            mesh, info["process_id"], info["num_processes"], rules=rules)
+            mesh, info["process_id"], info["num_processes"], rules=bundle.rules)
         loader = PrefetchLoader(ShardedBatchLoader(
             dataset, args.batch_size, args.seq_len, seed=args.data_seed,
             process_index=pi, process_count=pc, start_step=start_step,
@@ -123,7 +131,9 @@ def main(argv=None) -> int:
                 jax.random.PRNGKey(step_i), args.batch_size, args.seq_len,
                 args.vocab,
             )
-        return device_put_sharded_batch(next(loader), mesh, rules=rules)
+        return device_put_sharded_batch(
+            next(loader), mesh, sharding=bundle.tok_sharding,
+            global_batch=args.batch_size)
 
     timer = StepTimer()
     losses = []

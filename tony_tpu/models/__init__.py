@@ -1,15 +1,20 @@
-"""Model zoo: flagship transformer (dense + MoE) and the mnist parity model."""
+"""Model zoo: flagship transformer (dense + MoE), KV-cache generation, and
+the mnist parity model."""
 
+from .generate import KVCache, generate, init_cache, sample_token
 from .transformer import (
     TransformerConfig,
     apply,
+    apply_hidden,
     init,
     loss_fn,
     num_params,
     param_logical_axes,
+    token_nll,
 )
 
 __all__ = [
-    "TransformerConfig", "init", "apply", "loss_fn", "param_logical_axes",
-    "num_params",
+    "TransformerConfig", "init", "apply", "apply_hidden", "loss_fn",
+    "token_nll", "param_logical_axes", "num_params",
+    "KVCache", "init_cache", "generate", "sample_token",
 ]

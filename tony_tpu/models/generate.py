@@ -1,0 +1,183 @@
+"""Autoregressive generation with a KV cache for the flagship transformer.
+
+The decode path the training stack doesn't need but users do. TPU-first
+choices:
+
+- **Static shapes everywhere.** The cache is allocated once at
+  ``prompt_len + max_new_tokens`` and written in place with
+  ``dynamic_update_slice``; attention always scores against the full cache
+  buffer with an index mask (positions ``> current`` masked to -inf) instead
+  of growing tensors — so the whole generate loop is one ``lax.scan`` under
+  one jit, no per-step recompilation.
+- **GQA-aware cache.** K/V are cached at ``n_kv_heads`` (the GQA-compressed
+  width); heads are repeated at attention time, so cache HBM scales with
+  kv-heads, not query heads.
+- **Prefill != decode only in length.** One `_forward_with_cache` handles
+  both: prefill runs it at L=prompt_len (causal within the block), each
+  decode step at L=1 — same weights path as training (`transformer._qkv`,
+  `_mlp`), so there is no train/serve numerical drift.
+
+Sampling: greedy (temperature=0), temperature, and top-k.
+
+No reference counterpart: TonY has no model/inference layer (SURVEY.md
+§2.3); part of the TPU-native capability layer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import transformer
+from .transformer import TransformerConfig, rms_norm
+
+NEG_INF = -1e30
+
+
+class KVCache(NamedTuple):
+    k: jax.Array      # [n_layers, B, max_len, n_kv_heads, head_dim]
+    v: jax.Array
+    length: jax.Array  # scalar int32: number of valid positions
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        length=jnp.int32(0),
+    )
+
+
+def _cached_attention(cfg, q, ck, cv, cache_len, l_new):
+    """q: [B, L, H, D] for the L new positions (absolute offsets cache_len..
+    cache_len+L-1); ck/cv: [B, max_len, kvH, D] full cache buffers (already
+    containing the new keys). Scores run against the whole static buffer;
+    invalid/future positions are masked by index.
+
+    GQA is a grouped einsum — query heads are folded to [kvH, rep] and
+    contracted against the UN-repeated cache, so no n_heads-wide copy of
+    the cache is ever materialized (that copy would undo the compressed
+    cache's HBM saving on every decode step)."""
+    b, l, h, d = q.shape
+    kvh = ck.shape[2]
+    rep = h // kvh
+    q5 = q.reshape(b, l, kvh, rep, d)
+    scale = cfg.head_dim ** -0.5
+    s = jnp.einsum(
+        "blgrd,bmgd->bgrlm", q5, ck, preferred_element_type=jnp.float32
+    ) * scale                                           # [B, kvH, rep, L, M]
+    key_pos = jnp.arange(ck.shape[1])                   # [max_len]
+    q_pos = cache_len + jnp.arange(l_new)               # [L] absolute
+    mask = key_pos[None, :] <= q_pos[:, None]           # causal + validity
+    s = jnp.where(mask[None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bgrlm,bmgd->blgrd", p.astype(cv.dtype), cv)
+    return out.reshape(b, l, h, d)
+
+
+def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache):
+    """Run L new tokens (absolute positions cache.length..+L-1) through the
+    stack, reading/writing the cache -> (last-position logits [B, V] f32,
+    new cache). Only the LAST position is projected through the unembed —
+    generation never needs earlier logits, and a full [B, L, V] prefill
+    projection would be a pure HBM bonfire at long prompts / large vocab
+    (the same tensor the blockwise-CE training path exists to avoid)."""
+    dt = cfg.dtype
+    b, l = tokens.shape
+    positions = jnp.broadcast_to(cache.length + jnp.arange(l), (b, l))
+    x = params["embed"].astype(dt)[tokens]
+
+    def body(x, layer_in):
+        lp, ck_l, cv_l = layer_in
+        h = rms_norm(x, lp["attn_norm"])
+        q, k, v = transformer._qkv(cfg, h, positions, lp)
+        ck_l = lax.dynamic_update_slice_in_dim(ck_l, k.astype(dt), cache.length, axis=1)
+        cv_l = lax.dynamic_update_slice_in_dim(cv_l, v.astype(dt), cache.length, axis=1)
+        attn = _cached_attention(cfg, q, ck_l, cv_l, cache.length, l)
+        x = x + jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
+        mlp_out, _ = transformer._mlp(cfg, rms_norm(x, lp["mlp_norm"]), lp)
+        return x + mlp_out, (ck_l, cv_l)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x_last = rms_norm(x[:, -1], params["final_norm"])
+    logits = jnp.einsum(
+        "bd,dv->bv", x_last, params["unembed"].astype(dt)
+    ).astype(jnp.float32)
+    new_cache = KVCache(k=new_k, v=new_v, length=cache.length + l)
+    return logits, new_cache
+
+
+def sample_token(logits, key, temperature: float = 0.0, top_k: int = 0):
+    """logits [B, V] -> token ids [B]. temperature=0 => greedy."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        # O(V log k) threshold, no sorted full-vocab copy on the hot path
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
+        logits = jnp.where(logits >= kth, logits, NEG_INF)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k")
+)
+def generate(
+    params,
+    cfg: TransformerConfig,
+    prompt: jax.Array,          # [B, Lp] int32, unpadded
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Generate max_new_tokens continuations -> [B, max_new_tokens] int32.
+
+    Whole loop is jitted: prefill once, then a lax.scan of single-token
+    decode steps against the in-place cache."""
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}"
+        )
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if cfg.n_experts > 0:
+        # decode routes B*1 tokens at a time; the training capacity formula
+        # (cf * tokens * k / E) would then drop any token that collides with
+        # another on the same expert. E/k guarantees capacity >= token count
+        # -> drop-free decode (and drop-free prefill, so cached generation
+        # matches the full forward whenever that forward doesn't drop).
+        import dataclasses
+        cfg = dataclasses.replace(
+            cfg, capacity_factor=max(
+                cfg.capacity_factor, cfg.n_experts / cfg.expert_top_k),
+        )
+    b, lp_len = prompt.shape
+    cache = init_cache(cfg, b, lp_len + max_new_tokens)
+    logits, cache = _forward_with_cache(params, cfg, prompt, cache)
+    key, sub = jax.random.split(key)
+    first = sample_token(logits, sub, temperature, top_k)
+
+    def step(carry, _):
+        tok, cache, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = _forward_with_cache(params, cfg, tok[:, None], cache)
+        nxt = sample_token(logits, sub, temperature, top_k)
+        return (nxt, cache, key), nxt
+
+    # emit the sampled token so exactly max_new_tokens - 1 decode forwards
+    # run (the prefill already produced the first token's logits)
+    (_, _, _), rest = lax.scan(
+        step, (first, cache, key), None, length=max_new_tokens - 1
+    )
+    toks = jnp.concatenate([first[None], rest], axis=0)
+    return jnp.moveaxis(toks, 0, 1)                     # [B, max_new]
+
+
+__all__ = ["KVCache", "init_cache", "generate", "sample_token"]

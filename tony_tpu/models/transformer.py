@@ -186,23 +186,29 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
     return reference_attention(q, k, v, causal=True)
 
 
-def _layer(cfg: TransformerConfig, mesh, x, positions, lp):
-    """One decoder block; lp = this layer's params (stack dim removed)."""
+def _qkv(cfg: TransformerConfig, h, positions, lp):
+    """Projections + rope for a block of hidden states; k/v stay at
+    n_kv_heads (GQA repeat happens at attention time)."""
     dt = cfg.dtype
-    h = rms_norm(x, lp["attn_norm"])
     q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
     k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
     v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _repeat_kv(cfg: TransformerConfig, k, v):
     if cfg.n_kv_heads != cfg.n_heads:
         rep = cfg.n_heads // cfg.n_kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    attn = _attention(q, k, v, cfg, mesh)
-    x = x + jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
+    return k, v
 
-    h = rms_norm(x, lp["mlp_norm"])
+
+def _mlp(cfg: TransformerConfig, h, lp):
+    """Post-attention MLP (dense SwiGLU or MoE) -> (out, aux_loss)."""
+    dt = cfg.dtype
     aux = jnp.float32(0)
     if cfg.n_experts > 0:
         b, l, d = h.shape
@@ -214,11 +220,22 @@ def _layer(cfg: TransformerConfig, mesh, x, positions, lp):
             capacity_factor=cfg.capacity_factor, activation=jax.nn.silu,
         )
         aux = load_balancing_loss(router_logits, cfg.expert_top_k)
-        mlp_out = out.reshape(b, l, d)
-    else:
-        gate = jax.nn.silu(jnp.einsum("bld,df->blf", h, lp["w_gate"].astype(dt)))
-        up = jnp.einsum("bld,df->blf", h, lp["w_up"].astype(dt))
-        mlp_out = jnp.einsum("blf,fd->bld", gate * up, lp["w_down"].astype(dt))
+        return out.reshape(b, l, d), aux
+    gate = jax.nn.silu(jnp.einsum("bld,df->blf", h, lp["w_gate"].astype(dt)))
+    up = jnp.einsum("bld,df->blf", h, lp["w_up"].astype(dt))
+    return jnp.einsum("blf,fd->bld", gate * up, lp["w_down"].astype(dt)), aux
+
+
+def _layer(cfg: TransformerConfig, mesh, x, positions, lp):
+    """One decoder block; lp = this layer's params (stack dim removed)."""
+    dt = cfg.dtype
+    h = rms_norm(x, lp["attn_norm"])
+    q, k, v = _qkv(cfg, h, positions, lp)
+    k, v = _repeat_kv(cfg, k, v)
+    attn = _attention(q, k, v, cfg, mesh)
+    x = x + jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
+
+    mlp_out, aux = _mlp(cfg, rms_norm(x, lp["mlp_norm"]), lp)
     return x + mlp_out, aux
 
 
@@ -279,12 +296,9 @@ def _use_blockwise_ce(cfg: TransformerConfig, mesh=None, rules=None) -> bool:
     # while the dense einsum keeps logits vocab-sharded (see
     # ops/cross_entropy.py sharding note). The rules table's "vocab" row is
     # the source of truth for which axis that is; default "tensor".
-    vocab_axes = rules.get("vocab") if rules is not None else "tensor"
-    if isinstance(vocab_axes, str):
-        vocab_axes = (vocab_axes,)
-    if mesh is not None and vocab_axes and any(
-        dict(getattr(mesh, "shape", {})).get(a, 1) > 1 for a in vocab_axes
-    ):
+    from ..parallel.sharding import mesh_shards_rule
+
+    if mesh_shards_rule(mesh, rules, "vocab", default=("tensor",)):
         return False
     return cfg.vocab_size >= 16384
 

@@ -71,7 +71,11 @@ def moe_ffn(
     batch-sharded, XLA inserts the token all_to_all automatically."""
     t, d = x.shape
     e = router_w.shape[1]
-    capacity = max(1, int(capacity_factor * t * k / e))
+    # +1e-6 absorbs float error so an exactly-integral product never
+    # truncates down (capacity_factor = e/k must guarantee capacity >= t —
+    # the drop-free decode contract in models/generate.py; without it
+    # (4/3)*21/4 floats to 6.999... and int() drops a token)
+    capacity = max(1, int(capacity_factor * t * k / e + 1e-6))
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
     dispatch, combine = top_k_routing(logits, k, capacity)
     dispatch = dispatch.astype(x.dtype)

@@ -104,3 +104,23 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def batch_sharding(mesh: Mesh, rules: Rules) -> NamedSharding:
     """Sharding for (batch, ...) input arrays."""
     return NamedSharding(mesh, logical_to_spec(("batch",), rules))
+
+
+def mesh_shards_rule(mesh, rules: Rules | None, name: str, default=()) -> tuple:
+    """Mesh axes that actually shard (>1 devices) the rule-table row `name`.
+
+    Normalizes the row (None / str / tuple) and falls back to `default` when
+    no rules are given or the row is absent. The single place where
+    'does the mesh shard logical axis X' is answered — used by the data
+    loader ('batch') and the CE dispatch ('vocab') so they cannot drift."""
+    axes = default
+    if rules is not None:
+        axes = rules.get(name, default)
+    if axes is None:
+        axes = ()
+    if isinstance(axes, str):
+        axes = (axes,)
+    if mesh is None:
+        return ()
+    shape = dict(getattr(mesh, "shape", {}))
+    return tuple(a for a in axes if shape.get(a, 1) > 1)

@@ -34,6 +34,11 @@ class TrainStepBundle:
     optimizer: optax.GradientTransformation
     param_shardings: Any = None
     opt_shardings: Any = None
+    # the step's committed input sharding for [B, L] token/target arrays —
+    # data loaders place batches with THIS (tony_tpu.data
+    # device_put_sharded_batch(sharding=...)) so placement can't drift from
+    # the jitted in_shardings
+    tok_sharding: Any = None
 
 
 def make_optimizer(
@@ -115,6 +120,7 @@ def create_train_step(
     )
     bundle.param_shardings = param_shardings
     bundle.opt_shardings = opt_shardings
+    bundle.tok_sharding = tok_sharding
     return bundle
 
 
