@@ -157,6 +157,22 @@ def test_portal_serves_history(tmp_job_dirs, fixture_script):
         # html index renders
         status, body = get("/", accept="text/html")
         assert status == 200 and app_id in body
+
+        # html job-detail page renders the event timeline + nav links
+        status, body = get(f"/jobs/{app_id}", accept="text/html")
+        assert status == 200
+        assert "APPLICATION_INITED" in body and "TASK_FINISHED" in body
+        assert f"/config/{app_id}" in body and f"/logs/{app_id}" in body
+
+        # unknown job id stays a JSON 404 either way
+        status404 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/jobs/doesnotexist",
+            headers={"Accept": "text/html"})
+        try:
+            urllib.request.urlopen(status404, timeout=10)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
     finally:
         server.shutdown()
         server.server_close()

@@ -382,3 +382,23 @@ def test_from_raw_headerless_stream(tmp_path):
     ])
     assert rc == 0
     assert np.isfinite(json.loads(out.read_text())["final_loss"])
+
+
+def test_bad_ttpu_header_not_reinterpreted_as_raw(tmp_path):
+    """A TTPU file with an unsupported version must error in lm_train, not
+    silently decode its header bytes as tokens via the raw fallback."""
+    from tony_tpu.data.dataset import has_ttpu_magic
+    from tony_tpu.examples import lm_train
+
+    p = write_tokens(tmp_path / "v.bin", np.zeros(30000, dtype=np.int64))
+    raw = bytearray(p.read_bytes())
+    raw[4:8] = (99).to_bytes(4, "little")
+    p.write_bytes(bytes(raw))
+    assert has_ttpu_magic(p)
+    with pytest.raises(ValueError, match="version"):
+        lm_train.main([
+            "--steps", "1", "--batch-size", "8", "--seq-len", "32",
+            "--vocab", "256", "--d-model", "32", "--n-layers", "1",
+            "--n-heads", "2", "--d-ff", "64", "--dtype", "float32",
+            "--mesh", "data=2,fsdp=4", "--data", str(p),
+        ])

@@ -623,3 +623,22 @@ os.execvpe(inner[0], inner, env)
            "tony.worker.command": f"{PY} {fixture_script('check_docker_env.py')}"},
     )
     assert status == JobStatus.SUCCEEDED, dump_logs(client)
+
+
+def test_allocation_timeout_breaks_gang_deadlock(tmp_job_dirs, fixture_script):
+    """One gang member never receives capacity; the allocation-timeout
+    health check must fail the job instead of hanging forever (reference
+    gang-deadlock breaker, MLGenericRuntime.java:110-147 / issue #573)."""
+    os.environ["TONY_TEST_ALLOCATION_HOLD"] = "worker#1"
+    try:
+        status, client = run_job(
+            tmp_job_dirs,
+            **{"tony.worker.instances": 2,
+               "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}",
+               "tony.am.allocation-timeout-ms": 1500,
+               "tony.am.monitor-interval-ms": 100},
+        )
+    finally:
+        del os.environ["TONY_TEST_ALLOCATION_HOLD"]
+    assert status == JobStatus.FAILED
+    assert "allocation" in client.final_state.get("message", "").lower()

@@ -102,10 +102,21 @@ def cmd_notebook(args) -> int:
 
 
 def cmd_history(args) -> int:
+    import signal
+
     from ..portal.server import serve_portal
 
     conf = TonyConf.resolve(conf_files=args.conf, overrides=args.conf_override)
-    serve_portal(conf, port=args.port)
+
+    def _interrupt(signum, frame):
+        raise KeyboardInterrupt
+
+    # clean exit on SIGTERM/ctrl-c instead of a traceback
+    signal.signal(signal.SIGTERM, _interrupt)
+    try:
+        serve_portal(conf, port=args.port)
+    except KeyboardInterrupt:
+        pass
     return 0
 
 

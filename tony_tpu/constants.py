@@ -47,6 +47,9 @@ TEST_WORKER_TERMINATION = "TONY_TEST_WORKER_TERMINATION"    # comma list of task
                                                             # registers (reference
                                                             # AM:1338-1349)
 TEST_COMPLETION_DELAY_MS = "TONY_TEST_COMPLETION_NOTIFICATION_DELAY_MS"
+TEST_ALLOCATION_HOLD = "TONY_TEST_ALLOCATION_HOLD"          # "role#idx" never gets
+#   capacity: the driver skips its launch so the gang waits — exercises the
+#   allocation-timeout deadlock breaker (reference MLGenericRuntime.java:110-147)
                                                             # delay the container-completion
                                                             # callback to exercise the
                                                             # HB-expiry/completion race

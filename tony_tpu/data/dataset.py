@@ -31,6 +31,18 @@ _DTYPES = {1: np.uint16, 2: np.uint32}
 _DTYPE_CODES = {np.dtype(np.uint16): 1, np.dtype(np.uint32): 2}
 
 
+def has_ttpu_magic(path: str | Path) -> bool:
+    """True iff the file starts with the TTPU magic. Lets callers
+    distinguish 'raw headerless stream' (fallback to from_raw) from
+    'TTPU file with a bad/unsupported header' (must NOT be reinterpreted
+    as raw — the header bytes would decode as garbage tokens)."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(4) == _MAGIC
+    except OSError:
+        return False
+
+
 def _read_header_dtype(path: Path) -> np.dtype:
     with open(path, "rb") as f:
         header = f.read(_HEADER_BYTES)

@@ -274,11 +274,18 @@ class Driver:
 
         if isinstance(self.runtime_driver, GenericDriverAdapter):
             self.runtime_driver.note_requests_submitted()
+        hold = os.environ.get(c.TEST_ALLOCATION_HOLD, "")
         for index in range(spec.instances):
             task = self.session.get_task(spec.name, index)
             if task is None or task.status.is_terminal():
                 continue
             task.status = TaskStatus.REQUESTED
+            if hold == f"{spec.name}#{index}":
+                # fault hook: this task never receives capacity (gang
+                # deadlock — broken by the allocation-timeout health check)
+                log.info("TEST_ALLOCATION_HOLD: withholding capacity for %s",
+                         task.task_id)
+                continue
             env = self._task_env(spec, index)
             handle = self.provisioner.launch(
                 spec, index, env, self.job_dir / "logs"

@@ -103,9 +103,13 @@ def main(argv=None) -> int:
             device_put_sharded_batch, loader_shard_info,
         )
 
-        try:
+        from tony_tpu.data.dataset import has_ttpu_magic
+
+        if has_ttpu_magic(args.data):
+            # TTPU header present: parse it strictly (a bad version/dtype
+            # must error, not be reinterpreted as raw garbage tokens)
             dataset = TokenDataset.from_bin(args.data)
-        except ValueError:
+        else:
             # headerless raw stream (nanoGPT/llm.c style)
             import numpy as _np
             dataset = TokenDataset.from_raw(

@@ -147,6 +147,43 @@ def _jobs_html(jobs: list[dict]) -> str:
     )
 
 
+def _job_detail_html(app_id: str, events: list[dict]) -> str:
+    """Job page: event timeline + per-task metrics pulled from
+    TASK_FINISHED payloads (reference: tony-portal JobEventPage rendering
+    the jhist event array, metrics embedded per TaskFinished.avsc)."""
+    ev_rows = []
+    metric_rows = []
+    for e in events:
+        ts = time.strftime("%H:%M:%S", time.localtime(e["timestamp"] / 1000))
+        detail = {k: v for k, v in e.items()
+                  if k not in ("type", "timestamp", "metrics")}
+        ev_rows.append(
+            f"<tr><td>{ts}</td><td>{html.escape(e['type'])}</td>"
+            f"<td>{html.escape(json.dumps(detail))}</td></tr>"
+        )
+        for m in e.get("metrics") or []:
+            name = f"{e.get('job_name', '?')}:{e.get('task_index', '?')}"
+            metric_rows.append(
+                f"<tr><td>{html.escape(name)}</td>"
+                f"<td>{html.escape(str(m.get('name')))}</td>"
+                f"<td>{html.escape(str(m.get('value')))}</td></tr>"
+            )
+    body = (
+        f"<h3>{html.escape(app_id)}</h3>"
+        f"<p><a href='/'>all jobs</a> | <a href='/config/{html.escape(app_id)}'>config</a>"
+        f" | <a href='/logs/{html.escape(app_id)}'>logs</a></p>"
+        "<h4>events</h4><table><tr><th>time</th><th>type</th><th>detail</th></tr>"
+        + "".join(ev_rows) + "</table>"
+    )
+    if metric_rows:
+        body += (
+            "<h4>task metrics</h4>"
+            "<table><tr><th>task</th><th>metric</th><th>value</th></tr>"
+            + "".join(metric_rows) + "</table>"
+        )
+    return _PAGE.format(body=body)
+
+
 def make_handler(index: HistoryIndex):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
@@ -177,7 +214,10 @@ def make_handler(index: HistoryIndex):
                         200, _jobs_html(jobs))
                 kind, app_id = parts[0], parts[1] if len(parts) > 1 else ""
                 if kind == "jobs":
-                    return self._json(index.events(app_id))
+                    events = index.events(app_id)
+                    if want_json or events is None:
+                        return self._json(events)
+                    return self._send(200, _job_detail_html(app_id, events))
                 if kind == "config":
                     return self._json(index.config(app_id))
                 if kind == "logs":
