@@ -42,9 +42,10 @@ def test_flash_ragged_length_causal():
 
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_ragged_padded_blocks(causal):
-    """L=300 > BLOCK_Q forces real padding: padded KV columns must be masked
+    """L=300 pads to a 384-row block: padded KV columns must be masked
     in-kernel and padded Q rows zeroed via the lse residual (regression: the
-    old lse=-inf padding made p=exp(s+1e30)=inf -> NaN dK/dV)."""
+    old lse=-inf padding made p=exp(s+1e30)=inf -> NaN dK/dV). Multi-block
+    grids are covered by test_flash_multi_qblock_paths_small_blocks."""
     keys = jax.random.split(jax.random.PRNGKey(7), 3)
     q, k, v = (jax.random.normal(kk, (1, 2, 300, 16)) for kk in keys)
     out = flash_attention(q, k, v, causal=causal)
@@ -158,3 +159,28 @@ def test_blockwise_ce_bfloat16_inputs():
     assert nll.dtype == jnp.float32
     expected = dense_cross_entropy(x.astype(jnp.float32), w.astype(jnp.float32), t)
     np.testing.assert_allclose(np.asarray(nll), np.asarray(expected), atol=5e-2)
+
+
+def test_flash_multi_qblock_paths_small_blocks():
+    """Force nq>1 and nk>1 with explicit 128-row blocks (the default
+    BLOCK_Q=512 makes every CI-sized sequence a single block, which would
+    leave the qi>0 causal pruning, the _dkv diagonal-down lo start, and the
+    double-buffer slot rotation untested)."""
+    from tony_tpu.ops.attention import _flash_bwd, _flash_fwd
+
+    keys = jax.random.split(jax.random.PRNGKey(11), 4)
+    q, k, v, g = (jax.random.normal(kk, (1, 2, 300, 16)) for kk in keys)
+    out, lse = _flash_fwd(q, k, v, True, None, block_q=128, block_k=128,
+                          interpret=True)
+    expected = _ref_bhld(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, True, None,
+                            block_q=128, block_k=128, interpret=True)
+    eq, ek, ev = jax.grad(
+        lambda q, k, v: jnp.sum(_ref_bhld(q, k, v, True) * g),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(eq), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(ek), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(ev), atol=1e-4)
