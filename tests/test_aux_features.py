@@ -237,3 +237,27 @@ def test_container_per_role_image_and_missing_image():
     assert "base:1" in build_container_command("c", {}, conf, role="worker")
     with _pytest.raises(ValueError, match="image"):
         build_container_command("c", {}, TonyConf({"tony.docker.enabled": True}))
+
+
+def test_tpu_provisioner_refresh_rediscovers_hosts(tmp_path):
+    """Driver retry must re-run discovery (a recreated spot slice has new
+    addresses); static host lists are a no-op refresh."""
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    state = tmp_path / "hosts.txt"
+    state.write_text("old-a\nold-b\nold-c\nold-d\n")
+    conf = TonyConf({
+        "tony.tpu.discover-command": f"cat {state}",
+        "tony.tpu.accelerator-type": "v5litepod-16",
+    })
+    prov = TpuPodProvisioner(conf)
+    assert prov.hosts == ["old-a", "old-b", "old-c", "old-d"]
+    state.write_text("new-a\nnew-b\nnew-c\nnew-d\n")  # slice recreated
+    prov.refresh()
+    assert prov.hosts == ["new-a", "new-b", "new-c", "new-d"]
+
+    static = TpuPodProvisioner(TonyConf({
+        "tony.cluster.static-hosts": "h1,h2",
+    }))
+    static.refresh()
+    assert static.hosts == ["h1", "h2"]

@@ -80,8 +80,20 @@ class TpuPodProvisioner(StaticHostProvisioner):
                 f"accelerator {accel} has {expected} hosts, got {len(hosts)}"
             )
         super().__init__(hosts)
+        self._conf = conf
         self.accelerator_type = accel
         log.info("tpu slice: %d hosts (%s)", len(hosts), accel or "unknown type")
+
+    def refresh(self) -> None:
+        """Re-run host discovery before a retry attempt. A preempted spot
+        slice comes back with NEW host addresses — without re-discovery
+        every retry would SSH the dead slice (the "re-acquire the slice,
+        not a container" retry unit, SURVEY.md §7). No-op for static host
+        lists (discover_hosts returns those first)."""
+        hosts = discover_hosts(self._conf)
+        if hosts != self.hosts:
+            log.info("tpu slice refresh: hosts %s -> %s", self.hosts, hosts)
+        self.hosts = hosts
 
     def validate_layout(self, conf: TonyConf) -> None:
         """Every TPU-holding task needs its own host (libtpu is exclusive

@@ -497,8 +497,15 @@ class Driver:
     # ----------------------------------------------------------------- retry
     def reset(self) -> None:
         """Stop everything, rebuild the session with session_id+1 —
-        reference reset:611-627."""
+        reference reset:611-627. Provisioners that can re-discover capacity
+        (a recreated spot TPU slice has new host addresses) refresh here."""
         self.provisioner.stop_all()
+        refresh = getattr(self.provisioner, "refresh", None)
+        if callable(refresh):
+            try:
+                refresh()
+            except Exception:
+                log.exception("provisioner refresh failed; keeping old hosts")
         old = self.session
         self.session = Session(self.conf, session_id=old.session_id + 1)
         self.runtime_driver = self._runtime.driver_adapter()
