@@ -256,6 +256,14 @@ def test_tpu_provisioner_refresh_rediscovers_hosts(tmp_path):
     prov.refresh()
     assert prov.hosts == ["new-a", "new-b", "new-c", "new-d"]
 
+    # a partially-recreated slice (wrong host count) must be rejected,
+    # keeping the previous host list
+    import pytest
+    state.write_text("half-a\nhalf-b\n")
+    with pytest.raises(ValueError, match="recreating"):
+        prov.refresh()
+    assert prov.hosts == ["new-a", "new-b", "new-c", "new-d"]
+
     static = TpuPodProvisioner(TonyConf({
         "tony.cluster.static-hosts": "h1,h2",
     }))

@@ -402,3 +402,34 @@ def test_bad_ttpu_header_not_reinterpreted_as_raw(tmp_path):
             "--n-heads", "2", "--d-ff", "64", "--dtype", "float32",
             "--mesh", "data=2,fsdp=4", "--data", str(p),
         ])
+
+
+def test_dataset_split_views(tmp_path):
+    ds = _toy_dataset(n=1000)
+    train, val = ds.split(0.1)
+    assert len(train) == 900 and len(val) == 100
+    np.testing.assert_array_equal(val.window(0, 5), ds.window(900, 5))
+    with pytest.raises(ValueError, match="holdout_frac"):
+        ds.split(1.5)
+
+
+def test_lm_train_eval_split(tmp_path):
+    """--eval-every reports held-out loss/ppl in metrics (train/serve loop
+    parity with real frameworks)."""
+    import json
+    from tony_tpu.examples import lm_train
+
+    rng = np.random.default_rng(5)
+    path = write_tokens(tmp_path / "c.bin", rng.integers(0, 128, size=60000))
+    out = tmp_path / "m.json"
+    rc = lm_train.main([
+        "--steps", "4", "--batch-size", "8", "--seq-len", "32",
+        "--vocab", "128", "--d-model", "32", "--n-layers", "1",
+        "--n-heads", "2", "--d-ff", "64", "--dtype", "float32",
+        "--mesh", "data=2,fsdp=4", "--data", str(path),
+        "--eval-every", "2", "--eval-batches", "2",
+        "--metrics-out", str(out),
+    ])
+    assert rc == 0
+    metrics = json.loads(out.read_text())
+    assert np.isfinite(metrics["eval_loss"]) and metrics["eval_ppl"] > 1

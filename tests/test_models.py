@@ -321,3 +321,28 @@ def test_generate_rejects_nonpositive_max_new():
     prompt = jnp.zeros((1, 4), jnp.int32)
     with pytest.raises(ValueError, match="max_new_tokens"):
         generate(params, TINY, prompt, 0)
+
+
+def test_lm_generate_example_end_to_end(tmp_path):
+    """Train briefly with checkpoints, then lm_generate restores and
+    decodes from the checkpoint (the serve-side example)."""
+    import json
+    from tony_tpu.examples import lm_generate, lm_train
+
+    args = ["--batch-size", "8", "--seq-len", "32", "--vocab", "128",
+            "--d-model", "32", "--n-layers", "1", "--n-heads", "2",
+            "--d-ff", "64", "--dtype", "float32", "--mesh", "data=2,fsdp=4"]
+    rc = lm_train.main(["--steps", "3", "--checkpoint-dir",
+                        str(tmp_path / "ck"), "--checkpoint-every", "2"] + args)
+    assert rc == 0
+    out = tmp_path / "gen.json"
+    rc = lm_generate.main([
+        "--checkpoint-dir", str(tmp_path / "ck"), "--vocab", "128",
+        "--d-model", "32", "--n-layers", "1", "--n-heads", "2",
+        "--d-ff", "64", "--dtype", "float32",
+        "--prompt", "1 2 3", "--max-new", "5", "--metrics-out", str(out),
+    ])
+    assert rc == 0
+    result = json.loads(out.read_text())
+    assert len(result["tokens"]) == 5
+    assert all(0 <= t < 128 for t in result["tokens"])

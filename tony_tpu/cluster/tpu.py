@@ -89,8 +89,22 @@ class TpuPodProvisioner(StaticHostProvisioner):
         slice comes back with NEW host addresses — without re-discovery
         every retry would SSH the dead slice (the "re-acquire the slice,
         not a container" retry unit, SURVEY.md §7). No-op for static host
-        lists (discover_hosts returns those first)."""
+        lists (discover_hosts returns those first).
+
+        Validates the host count against the accelerator geometry exactly
+        like __init__ — a slice mid-recreation can report a partial host
+        list, and packing tasks onto it would break the one-TPU-task-per-
+        host invariant. Raising keeps the previous host list (the driver
+        logs and retries with it)."""
         hosts = discover_hosts(self._conf)
+        expected = (slice_num_hosts(self.accelerator_type)
+                    if self.accelerator_type else None)
+        if expected is not None and len(hosts) != expected:
+            raise ValueError(
+                f"slice refresh found {len(hosts)} hosts, accelerator "
+                f"{self.accelerator_type} has {expected} (slice still "
+                "recreating?)"
+            )
         if hosts != self.hosts:
             log.info("tpu slice refresh: hosts %s -> %s", self.hosts, hosts)
         self.hosts = hosts

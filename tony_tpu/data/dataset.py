@@ -152,6 +152,18 @@ class TokenDataset:
         window yields seq_len inputs + shifted targets)."""
         return max(0, (len(self._tokens) - 1) // seq_len)
 
+    def split(self, holdout_frac: float) -> tuple["TokenDataset", "TokenDataset"]:
+        """(train, holdout) views of the stream — zero-copy memmap slices.
+        The holdout is the TAIL of the stream, so growing a corpus by
+        appending never leaks future training tokens into old eval sets."""
+        if not 0.0 < holdout_frac < 1.0:
+            raise ValueError(f"holdout_frac must be in (0, 1), got {holdout_frac}")
+        cut = int(len(self._tokens) * (1.0 - holdout_frac))
+        return (
+            TokenDataset(self._tokens[:cut], header_max=self._header_max),
+            TokenDataset(self._tokens[cut:], header_max=self._header_max),
+        )
+
     def max_token(self, chunk: int = 1 << 24) -> int:
         """Max token id over the WHOLE stream. O(1) when the file header
         carries the cached max (files written by write_tokens); otherwise

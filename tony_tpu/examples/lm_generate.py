@@ -1,0 +1,113 @@
+"""Generate from a checkpoint trained by lm_train — the serve-side half of
+the flagship model (KV-cache decode, models/generate.py).
+
+    # train with checkpoints, then:
+    python -m tony_tpu.examples.lm_generate \
+        --checkpoint-dir /ckpt --vocab 4096 --d-model 256 --n-layers 4 \
+        --n-heads 8 --d-ff 1024 --prompt "1 2 3 4" --max-new 64
+
+Model hyperparams must match the training run (checkpoints store only
+weights). Prompts are whitespace-separated token ids — tokenizers live
+outside the framework, same stance as the data plane. Also reports decode
+throughput (tokens/sec), the serving-side counterpart of lm_train's
+tokens/sec.
+
+No reference counterpart: TonY has no model layer (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="orbax dir from lm_train; empty = random init")
+    parser.add_argument("--d-model", type=int, default=256)
+    parser.add_argument("--n-layers", type=int, default=4)
+    parser.add_argument("--n-heads", type=int, default=8)
+    parser.add_argument("--d-ff", type=int, default=1024)
+    parser.add_argument("--vocab", type=int, default=4096)
+    parser.add_argument("--n-experts", type=int, default=0,
+                        help="must match the training run's --n-experts")
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--prompt", default="1 2 3 4 5 6 7 8",
+                        help="whitespace-separated token ids")
+    parser.add_argument("--max-new", type=int, default=64)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--metrics-out", default="")
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models import transformer
+    from tony_tpu.models.generate import generate
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
+        n_heads=args.n_heads, n_kv_heads=args.n_heads, d_ff=args.d_ff,
+        n_experts=args.n_experts, dtype=getattr(jnp, args.dtype),
+    )
+    params = transformer.init(jax.random.PRNGKey(args.seed), cfg)
+    if args.checkpoint_dir:
+        from tony_tpu.train.checkpoint import CheckpointManager
+
+        from tony_tpu.train.step import make_optimizer
+
+        mgr = CheckpointManager(args.checkpoint_dir)
+        latest = mgr.latest_step()
+        if latest is None:
+            raise SystemExit(f"no checkpoint found in {args.checkpoint_dir}")
+        # lm_train checkpoints {params, opt_state}; restore needs the full
+        # tree structure even though only params matter here
+        template = {"params": params,
+                    "opt_state": make_optimizer().init(params)}
+        restored = mgr.restore(template=template)
+        params = restored["params"]
+        mgr.close()
+        print(f"restored checkpoint step {latest}")
+
+    prompt_ids = [int(t) for t in args.prompt.split()]
+    bad = [t for t in prompt_ids if not 0 <= t < args.vocab]
+    if bad:
+        raise SystemExit(f"prompt ids out of vocab range: {bad}")
+    prompt = jnp.asarray([prompt_ids], jnp.int32)
+
+    out = generate(
+        params, cfg, prompt, args.max_new,
+        temperature=args.temperature, top_k=args.top_k,
+        key=jax.random.PRNGKey(args.seed),
+    )
+    jax.block_until_ready(out)          # exclude compile from timing
+    t0 = time.time()
+    out = generate(
+        params, cfg, prompt, args.max_new,
+        temperature=args.temperature, top_k=args.top_k,
+        key=jax.random.PRNGKey(args.seed),
+    )
+    jax.block_until_ready(out)
+    wall = time.time() - t0
+
+    tokens = [int(t) for t in out[0]]
+    result = {
+        "tokens": tokens,
+        "decode_tokens_per_sec": args.max_new / wall,
+        "backend": jax.default_backend(),
+    }
+    print(" ".join(str(t) for t in tokens))
+    print(f"# {args.max_new} tokens in {wall:.2f}s "
+          f"({result['decode_tokens_per_sec']:.1f} tok/s)")
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            json.dump(result, f)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -44,6 +44,10 @@ def main(argv=None) -> int:
     parser.add_argument("--data-seed", type=int, default=0)
     parser.add_argument("--data-raw-dtype", default="uint16",
                         help="dtype for headerless (nanoGPT-style) token files")
+    parser.add_argument("--eval-every", type=int, default=0,
+                        help="evaluate on a held-out tail split every N steps (0=off; needs --data)")
+    parser.add_argument("--eval-frac", type=float, default=0.05)
+    parser.add_argument("--eval-batches", type=int, default=8)
     args = parser.parse_args(argv)
 
     import jax
@@ -120,6 +124,9 @@ def main(argv=None) -> int:
                 f"--data contains token id {corpus_max} >= --vocab "
                 f"{args.vocab}; retokenize or raise --vocab"
             )
+        val_dataset = None
+        if args.eval_every > 0:
+            dataset, val_dataset = dataset.split(args.eval_frac)
         # per-process shards when a batch axis is mesh-sharded; on a
         # seq/tensor-only mesh every host loads the identical full batch
         pi, pc = loader_shard_info(
@@ -128,6 +135,17 @@ def main(argv=None) -> int:
             dataset, args.batch_size, args.seq_len, seed=args.data_seed,
             process_index=pi, process_count=pc, start_step=start_step,
         ))
+        if val_dataset is not None:
+            try:
+                val_loader = ShardedBatchLoader(
+                    val_dataset, args.batch_size, args.seq_len, seed=0,
+                    process_index=pi, process_count=pc,
+                )
+            except ValueError as e:
+                raise SystemExit(
+                    f"eval split too small for evaluation ({e}); raise "
+                    "--eval-frac or lower --batch-size/--seq-len"
+                ) from e
 
     def next_batch(step_i):
         if loader is None:
@@ -139,8 +157,25 @@ def main(argv=None) -> int:
             next(loader), mesh, sharding=bundle.tok_sharding,
             global_batch=args.batch_size)
 
+    def run_eval(params) -> float:
+        """Mean held-out loss over a fixed deterministic batch set."""
+        import math
+        n = min(args.eval_batches, val_loader.steps_per_epoch)
+        total = 0.0
+        for i in range(n):
+            vt, vy = device_put_sharded_batch(
+                val_loader.batch_at(i), mesh, sharding=bundle.tok_sharding,
+                global_batch=args.batch_size)
+            total += float(bundle.eval_fn(params, vt, vy))
+        loss = total / max(n, 1)
+        if info["process_id"] == 0:
+            print(f"  eval: loss {loss:.4f} ppl {math.exp(min(loss, 30)):.2f}")
+        return loss
+
     timer = StepTimer()
     losses = []
+    last_eval = None
+    last_eval_step = -1
     t0 = time.time()
     try:
         with trace(args.profile_dir, enabled=bool(args.profile_dir)):
@@ -158,11 +193,20 @@ def main(argv=None) -> int:
                               f"({timer.steps_per_sec:.2f} steps/s)")
                 if mgr is not None and step_i % args.checkpoint_every == 0 and step_i > 0:
                     mgr.save(step_i, {"params": params, "opt_state": opt_state})
+                if (loader is not None and args.eval_every > 0
+                        and step_i > start_step
+                        and step_i % args.eval_every == 0):
+                    last_eval = run_eval(params)
+                    last_eval_step = step_i
     finally:
         if loader is not None:
             loader.close()
     final_loss = float(metrics["loss"])
     wall = time.time() - t0
+    # final eval — unless the last loop step just ran the identical one
+    if (loader is not None and args.eval_every > 0
+            and last_eval_step != start_step + args.steps - 1):
+        last_eval = run_eval(params)
     if mgr is not None:
         mgr.save(start_step + args.steps - 1,
                  {"params": params, "opt_state": opt_state})
@@ -177,6 +221,10 @@ def main(argv=None) -> int:
         "n_params": n_params,
         "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
     }
+    if last_eval is not None:
+        import math
+        result["eval_loss"] = last_eval
+        result["eval_ppl"] = math.exp(min(last_eval, 30))
     if info["process_id"] == 0:
         print(json.dumps(result))
     if args.metrics_out:

@@ -39,6 +39,9 @@ class TrainStepBundle:
     # device_put_sharded_batch(sharding=...)) so placement can't drift from
     # the jitted in_shardings
     tok_sharding: Any = None
+    # jitted (params, tokens, targets) -> scalar loss with NO optimizer
+    # update — the held-out evaluation path
+    eval_fn: Any = None
 
 
 def make_optimizer(
@@ -114,6 +117,14 @@ def create_train_step(
         out_shardings=(param_shardings, opt_shardings, None),
         donate_argnums=(0, 1),
     )
+    def eval_loss(params, tokens, targets):
+        return transformer.loss_fn(params, tokens, targets, cfg, mesh, rules)
+
+    eval_fn = jax.jit(
+        eval_loss,
+        in_shardings=(param_shardings, tok_sharding, tok_sharding),
+    )
+
     bundle = TrainStepBundle(
         step_fn=step_fn, params=params, opt_state=opt_state, mesh=mesh,
         rules=rules, config=cfg, optimizer=optimizer,
@@ -121,6 +132,7 @@ def create_train_step(
     bundle.param_shardings = param_shardings
     bundle.opt_shardings = opt_shardings
     bundle.tok_sharding = tok_sharding
+    bundle.eval_fn = eval_fn
     return bundle
 
 
