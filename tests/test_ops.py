@@ -184,3 +184,61 @@ def test_flash_multi_qblock_paths_small_blocks():
     np.testing.assert_allclose(np.asarray(dq), np.asarray(eq), atol=1e-4)
     np.testing.assert_allclose(np.asarray(dk), np.asarray(ek), atol=1e-4)
     np.testing.assert_allclose(np.asarray(dv), np.asarray(ev), atol=1e-4)
+
+
+# ------------------------------------------------------- sliding window
+
+@pytest.mark.parametrize("window", [1, 7, 64, 500])
+def test_flash_sliding_window_matches_reference(window):
+    from tony_tpu.ops.attention import _flash_fwd
+
+    keys = jax.random.split(jax.random.PRNGKey(13), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 300, 16)) for kk in keys)
+    # small blocks force multi-block band pruning (lo > 0 for late q blocks)
+    out, _ = _flash_fwd(q, k, v, True, None, block_q=128, block_k=128,
+                        interpret=True, window=window)
+    expected = reference_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal=True, window=window,
+    ).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+def test_flash_sliding_window_gradients():
+    from tony_tpu.ops.attention import _flash_bwd, _flash_fwd
+
+    keys = jax.random.split(jax.random.PRNGKey(17), 4)
+    q, k, v, g = (jax.random.normal(kk, (1, 1, 300, 16)) for kk in keys)
+    w = 40
+    out, lse = _flash_fwd(q, k, v, True, None, block_q=128, block_k=128,
+                          interpret=True, window=w)
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, True, None,
+                            block_q=128, block_k=128, interpret=True, window=w)
+
+    def ref(q, k, v):
+        o = reference_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=True, window=w,
+        ).transpose(0, 2, 1, 3)
+        return jnp.sum(o * g)
+
+    eq, ek, ev = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(eq), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(ek), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(ev), atol=1e-4)
+
+
+def test_flash_window_public_api_and_validation():
+    q = jax.random.normal(jax.random.PRNGKey(19), (1, 2, 128, 16))
+    out = flash_attention(q, q, q, causal=True, window=16)
+    expected = reference_attention(
+        q.transpose(0, 2, 1, 3), q.transpose(0, 2, 1, 3),
+        q.transpose(0, 2, 1, 3), causal=True, window=16,
+    ).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+    g = jax.grad(lambda x: jnp.sum(flash_attention(x, x, x, True, None, 16) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, q, q, causal=False, window=4)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, q, q, causal=True, window=0)

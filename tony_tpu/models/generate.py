@@ -74,6 +74,10 @@ def _cached_attention(cfg, q, ck, cv, cache_len, l_new):
     key_pos = jnp.arange(ck.shape[1])                   # [max_len]
     q_pos = cache_len + jnp.arange(l_new)               # [L] absolute
     mask = key_pos[None, :] <= q_pos[:, None]           # causal + validity
+    if cfg.attn_window:
+        # sliding-window models must decode with the same band they trained
+        # with, or generation attends to positions the model never saw
+        mask &= key_pos[None, :] > q_pos[:, None] - cfg.attn_window
     s = jnp.where(mask[None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bgrlm,bmgd->blgrd", p.astype(cv.dtype), cv)

@@ -50,6 +50,10 @@ class TransformerConfig:
     # attention implementation: "flash" (pallas), "ref" (XLA), "ring" /
     # "ulysses" (sequence-parallel over the `seq` mesh axis), or "auto"
     attn_impl: str = "auto"
+    # sliding-window (local) attention: each position sees its last
+    # attn_window positions inclusive; 0 = full causal. Supported by the
+    # flash and ref paths (block-pruned O(L*window) in the kernel)
+    attn_window: int = 0
     remat: bool = False
     # cross-entropy: "dense" materializes [B,L,V] logits; "blockwise" streams
     # the vocab in ce_block_v blocks (ops/cross_entropy.py) so nothing of
@@ -165,12 +169,22 @@ def rope(x, positions, theta):
 def _attention(q, k, v, cfg: TransformerConfig, mesh):
     """[B, L, H, D] in/out; dispatch on attn_impl."""
     impl = cfg.attn_impl
+    if cfg.attn_window < 0:
+        raise ValueError(
+            f"attn_window must be >= 0 (0 = full causal), got {cfg.attn_window}"
+        )
+    window = cfg.attn_window or None
     if impl == "auto":
         impl = "flash" if jax.default_backend() in ("tpu", "axon") else "ref"
+    if window is not None and impl in ("ring", "ulysses"):
+        raise ValueError(
+            f"attn_window is not supported with attn_impl={impl!r} "
+            "(sequence-parallel paths are full-causal)"
+        )
     if impl == "flash":
         from ..ops.attention import attention_blhd
 
-        return attention_blhd(q, k, v, causal=True)
+        return attention_blhd(q, k, v, causal=True, window=window)
     if impl == "ring":
         if mesh is None:
             raise ValueError("attn_impl='ring' requires a mesh")
@@ -183,7 +197,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
         from ..parallel.ulysses import make_ulysses_attention
 
         return make_ulysses_attention(mesh, causal=True)(q, k, v)
-    return reference_attention(q, k, v, causal=True)
+    return reference_attention(q, k, v, causal=True, window=window)
 
 
 def _qkv(cfg: TransformerConfig, h, positions, lp):

@@ -40,21 +40,45 @@ BLOCK_Q = 512
 BLOCK_K = 512
 
 
-def _causal_mask(qi, bq, j, bk):
+def _causal_mask(qi, bq, j, bk, window=None):
     rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return rows >= cols
+    mask = rows >= cols
+    if window is not None:
+        # sliding window: each row attends to its last `window` positions
+        # (inclusive of itself)
+        mask &= cols > rows - window
+    return mask
 
 
-def _attn_mask(qi, bq, j, bk, causal, kv_len):
-    """Combined causal + ragged-KV mask for one [bq, bk] score tile, or None
-    when every position is valid (the even, non-causal fast path)."""
-    mask = _causal_mask(qi, bq, j, bk) if causal else None
+def _attn_mask(qi, bq, j, bk, causal, kv_len, window=None):
+    """Combined causal/sliding-window + ragged-KV mask for one [bq, bk]
+    score tile, or None when every position is valid (the even, non-causal
+    fast path)."""
+    mask = _causal_mask(qi, bq, j, bk, window) if causal else None
     if kv_len is not None:
         cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         valid = cols < kv_len
         mask = valid if mask is None else (mask & valid)
     return mask
+
+
+def _window_lo(qi, bq, block_k, window):
+    """First KV block intersecting q-block qi's window band (traced)."""
+    if window is None:
+        return 0
+    return jnp.maximum(0, (qi * bq - window + 1) // block_k)
+
+
+def _validate_window(causal, window):
+    """The band pruning (_window_lo) only matches the mask when causal —
+    a non-causal windowed call would skip blocks WITHOUT masking the rest,
+    silently corrupting the softmax. Validate at every public entry."""
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
 
 
 class _Streamer:
@@ -103,9 +127,11 @@ class _Streamer:
 # ------------------------------------------------------------------ forward
 
 def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
-                *, scale, causal, block_k, kv_len=None):
+                *, scale, causal, block_k, kv_len=None, window=None):
     """One (batch*head, q-block) program: stream KV blocks, online softmax.
-    Also writes the per-row logsumexp residual for the backward."""
+    Also writes the per-row logsumexp residual for the backward. A sliding
+    window additionally prunes blocks BELOW the band — DMA and compute both
+    skip everything outside [row-window, row], so cost is O(L*window)."""
     b_ = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
@@ -115,7 +141,8 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
         jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nk)
         if causal else nk
     )
-    stream = _Streamer([k_hbm, v_hbm], [k_buf, v_buf], sems, b_, block_k, 0, hi)
+    lo = _window_lo(qi, bq, block_k, window)
+    stream = _Streamer([k_hbm, v_hbm], [k_buf, v_buf], sems, b_, block_k, lo, hi)
     stream.start()
 
     def body(j, carry):
@@ -125,7 +152,7 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
             q, k_blk.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                              # [BQ, BK]
-        mask = _attn_mask(qi, bq, j, block_k, causal, kv_len)
+        mask = _attn_mask(qi, bq, j, block_k, causal, kv_len, window)
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -143,7 +170,7 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(lo, hi, body, (m0, l0, acc0))
     l_safe = jnp.where(l > 0, l, 1.0)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     # lse stored lane-major [1, bq]: a [L, 1] layout pads every row to 128
@@ -154,7 +181,8 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
 # ------------------------------------------------------------------ backward
 
 def _dq_kernel(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref, dq_ref,
-               k_buf, v_buf, sems, *, scale, causal, block_k, kv_len=None):
+               k_buf, v_buf, sems, *, scale, causal, block_k, kv_len=None,
+               window=None):
     """dQ for one q block: sweep KV blocks.
     ds = p * (dO@V^T - delta); dQ = scale * ds @ K."""
     b_ = pl.program_id(0)
@@ -169,7 +197,8 @@ def _dq_kernel(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref, dq_ref,
         jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nk)
         if causal else nk
     )
-    stream = _Streamer([k_hbm, v_hbm], [k_buf, v_buf], sems, b_, block_k, 0, hi)
+    lo = _window_lo(qi, bq, block_k, window)
+    stream = _Streamer([k_hbm, v_hbm], [k_buf, v_buf], sems, b_, block_k, lo, hi)
     stream.start()
 
     def body(j, dq):
@@ -181,7 +210,7 @@ def _dq_kernel(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         )
         p = jnp.exp(s - lse)
-        mask = _attn_mask(qi, bq, j, block_k, causal, kv_len)
+        mask = _attn_mask(qi, bq, j, block_k, causal, kv_len, window)
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
@@ -194,16 +223,17 @@ def _dq_kernel(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         )
 
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq = jax.lax.fori_loop(lo, hi, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
                 dk_ref, dv_ref, q_buf, do_buf, sems,
-                *, scale, causal, block_q):
+                *, scale, causal, block_q, window=None):
     """dK/dV for one kv block: sweep Q blocks (from the diagonal down when
-    causal). dV = p^T @ dO; dK = scale * ds^T @ Q. Q/dO stream from HBM;
-    lse/delta are 4B/row and ride in VMEM whole."""
+    causal; a sliding window also bounds the sweep from ABOVE — rows past
+    col+window can't see this block). dV = p^T @ dO; dK = scale * ds^T @ Q.
+    Q/dO stream from HBM; lse/delta are 4B/row and ride in VMEM whole."""
     b_ = pl.program_id(0)
     ki = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)               # [BK, D]
@@ -211,8 +241,13 @@ def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
     bk, d = k_blk.shape
     nq = q_hbm.shape[1] // block_q
     lo = (ki * bk) // block_q if causal else 0
+    hi = nq
+    if window is not None:
+        # rows seeing col c satisfy row < c + window; last col of this
+        # block is ki*bk + bk - 1
+        hi = jnp.minimum(nq, (ki * bk + bk - 1 + window + block_q - 1) // block_q)
     stream = _Streamer(
-        [q_hbm, do_hbm], [q_buf, do_buf], sems, b_, block_q, lo, nq,
+        [q_hbm, do_hbm], [q_buf, do_buf], sems, b_, block_q, lo, hi,
     )
     stream.start()
 
@@ -229,7 +264,7 @@ def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
         )                                              # [BQ, BK]
         p = jnp.exp(s - lse_j)
         if causal:
-            p = jnp.where(_causal_mask(j, block_q, ki, bk), p, 0.0)
+            p = jnp.where(_causal_mask(j, block_q, ki, bk, window), p, 0.0)
         dv_new = dv + jax.lax.dot_general(
             p, do_j, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -247,7 +282,7 @@ def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
 
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
+    dk, dv = jax.lax.fori_loop(lo, hi, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -280,10 +315,11 @@ def _on_tpu() -> bool:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret", "window"),
 )
 def _flash_fwd(q, k, v, causal, scale, block_q=BLOCK_Q, block_k=BLOCK_K,
-               interpret=False):
+               interpret=False, window=None):
     """q,k,v: [B, H, L, D] -> (out [B,H,L,D], lse [B,H,L] f32)."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
@@ -305,7 +341,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q=BLOCK_Q, block_k=BLOCK_K,
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k, kv_len=kv_len),
+                          block_k=block_k, kv_len=kv_len, window=window),
         grid=(bh, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i: (b_, i, 0)),
@@ -333,10 +369,12 @@ def _flash_fwd(q, k, v, causal, scale, block_q=BLOCK_Q, block_k=BLOCK_K,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret", "window"),
 )
 def _flash_bwd(q, k, v, o, lse, g, causal, scale,
-               block_q=BLOCK_Q, block_k=BLOCK_K, interpret=False, g_lse=None):
+               block_q=BLOCK_Q, block_k=BLOCK_K, interpret=False, g_lse=None,
+               window=None):
     b, h, lq, d = q.shape
     lk = k.shape[2]
     scale = (d ** -0.5) if scale is None else scale
@@ -376,7 +414,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, kv_len=kv_len),
+                          block_k=block_k, kv_len=kv_len, window=window),
         grid=(bh, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i: (b_, i, 0)),
@@ -397,7 +435,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale,
     )(qf, kf, vf, gf, lsef, deltaf)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, block_q=block_q),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, window=window),
         grid=(bh, nk),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),   # Q in HBM
@@ -429,8 +468,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention_with_lse(q, k, v, causal=True, scale=None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_with_lse(q, k, v, causal=True, scale=None, window=None):
     """Flash attention that also returns the per-row logsumexp, [B, H, L, D]
     layout -> (out [B,H,L,D], lse [B,H,L] f32).
 
@@ -440,20 +479,23 @@ def flash_attention_with_lse(q, k, v, causal=True, scale=None):
     ``logaddexp``-weighted sums — ring attention does exactly that — and
     autodiff still produces exact gradients. No fallback: callers must check
     ``flash_supported`` (ring attention does)."""
-    return _flash_fwd(q, k, v, causal, scale, interpret=not _on_tpu())
+    _validate_window(causal, window)
+    return _flash_fwd(q, k, v, causal, scale, interpret=not _on_tpu(),
+                      window=window)
 
 
-def _lse_vjp_fwd(q, k, v, causal, scale):
-    out, lse = _flash_fwd(q, k, v, causal, scale, interpret=not _on_tpu())
+def _lse_vjp_fwd(q, k, v, causal, scale, window):
+    out, lse = _flash_fwd(q, k, v, causal, scale, interpret=not _on_tpu(),
+                          window=window)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _lse_vjp_bwd(causal, scale, res, g):
+def _lse_vjp_bwd(causal, scale, window, res, g):
     q, k, v, o, lse = res
     g_out, g_lse = g
     return _flash_bwd(
         q, k, v, o, lse, g_out, causal, scale,
-        interpret=not _on_tpu(), g_lse=g_lse,
+        interpret=not _on_tpu(), g_lse=g_lse, window=window,
     )
 
 
@@ -475,13 +517,20 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Fused attention, [B, H, L, D] layout. Pallas-compiled on TPU,
     interpreted elsewhere; flash backward (O(block) memory both ways).
 
+    ``window`` enables sliding-window (local) attention: each position
+    attends to its last `window` positions inclusive; block pruning skips
+    the DMA and compute of everything outside the band, so cost becomes
+    O(L * window) instead of O(L^2). Requires causal=True.
+
     Shapes outside the kernel envelope (see flash_supported) fall back to
     naive XLA attention — full L x L scores, O(L^2) memory — with a one-time
     warning, since at long context that is a real memory cliff."""
+    _validate_window(causal, window)
     tiling_ok = not _on_tpu() or flash_supported(q)  # interpret: no tiling
     if not tiling_ok:
         warnings.warn(
@@ -494,21 +543,23 @@ def flash_attention(
         out = reference_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3), causal=causal, scale=scale,
+            window=window,
         )
         return out.transpose(0, 2, 1, 3)
     # single custom_vjp path; the unused lse cotangent arrives as zeros and
     # costs one elementwise subtract in the backward
-    return flash_attention_with_lse(q, k, v, causal, scale)[0]
+    return flash_attention_with_lse(q, k, v, causal, scale, window)[0]
 
 
 def attention_blhd(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool = True, scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Convenience wrapper for the [B, L, H, D] model layout."""
     out = flash_attention(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3), causal=causal, scale=scale,
+        v.transpose(0, 2, 1, 3), causal=causal, scale=scale, window=window,
     )
     return out.transpose(0, 2, 1, 3)
 

@@ -217,14 +217,22 @@ def make_ring_attention(
     return _fn
 
 
-def reference_attention(q, k, v, causal: bool = True, scale: float | None = None):
-    """Plain full attention (for tests and the no-SP path)."""
+def reference_attention(q, k, v, causal: bool = True, scale: float | None = None,
+                        window: int | None = None):
+    """Plain full attention (for tests and the no-SP path); optional
+    sliding window (last `window` positions inclusive, causal only)."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     d = q.shape[-1]
     scale = (d ** -0.5) if scale is None else scale
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if causal:
         lq, lk = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((lq, lk), dtype=bool))
+        rows = jnp.arange(lq)[:, None]
+        cols = jnp.arange(lk)[None, :]
+        mask = rows >= cols
+        if window is not None:
+            mask &= cols > rows - window
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
