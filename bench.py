@@ -5,14 +5,20 @@ BASELINE.md metric: "mnist steps/sec/chip submitted via the ClusterSubmitter
 (BASELINE.json north star). This script measures
 
   1. plain JAX: the mnist train loop of tony_tpu/examples/mnist_jax.py run
-     directly in this process on the local accelerator(s)
+     directly as a subprocess on the local accelerator(s)
   2. orchestrated: the SAME script submitted as a 1-worker job through
      TonyClient -> driver -> executor (the ClusterSubmitter path)
 
 and reports orchestrated steps/sec with vs_baseline = orchestrated / plain.
 Orchestration happens off the training path (heartbeats + metrics RPC only),
-so the ratio should be ~1.0; it also prints job-launch-to-first-step latency
-as a secondary line on stderr.
+so the ratio should be ~1.0.
+
+Noise control: the accelerator may be reached over a network tunnel whose
+latency/load varies run to run, so (a) the workload itself times scan-batched
+on-device steps and reports a median-window rate (see mnist_jax.py), and
+(b) this script interleaves plain/orchestrated runs (A/B pairs) and scores
+each arm by its best run, so both arms face the same environment and a
+transient stall in either direction can't fabricate or mask a gap.
 
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -28,16 +34,24 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent
-STEPS = 300
+STEPS = 6000
+STEPS_PER_CALL = 1000
 BATCH = 512
+PAIRS = 2
 
 
-def run_plain(tmp: Path) -> dict:
-    out = tmp / "plain.json"
+def _workload_args(out: Path) -> list[str]:
+    return [
+        "--steps", str(STEPS), "--steps-per-call", str(STEPS_PER_CALL),
+        "--batch-size", str(BATCH), "--metrics-out", str(out),
+    ]
+
+
+def run_plain(tmp: Path, rep: int) -> dict:
+    out = tmp / f"plain{rep}.json"
     proc = subprocess.run(
         [sys.executable, "-m", "tony_tpu.examples.mnist_jax",
-         "--steps", str(STEPS), "--batch-size", str(BATCH),
-         "--metrics-out", str(out)],
+         *_workload_args(out)],
         cwd=REPO, capture_output=True, text=True, timeout=900,
     )
     if proc.returncode != 0:
@@ -46,19 +60,19 @@ def run_plain(tmp: Path) -> dict:
     return json.loads(out.read_text())
 
 
-def run_orchestrated(tmp: Path) -> tuple[dict, float]:
+def run_orchestrated(tmp: Path, rep: int) -> tuple[dict, float]:
     sys.path.insert(0, str(REPO))
     from tony_tpu.client import TonyClient
     from tony_tpu.conf import TonyConf
 
-    out = tmp / "orch.json"
+    out = tmp / f"orch{rep}.json"
     conf = TonyConf({
-        "tony.staging.dir": str(tmp / "staging"),
+        "tony.staging.dir": str(tmp / f"staging{rep}"),
         "tony.history.intermediate": str(tmp / "hist/intermediate"),
         "tony.worker.instances": 1,
         "tony.worker.command": (
             f"{sys.executable} -m tony_tpu.examples.mnist_jax "
-            f"--steps {STEPS} --batch-size {BATCH} --metrics-out {out}"
+            + " ".join(_workload_args(out))
         ),
         "tony.am.monitor-interval-ms": 100,
     })
@@ -71,26 +85,28 @@ def run_orchestrated(tmp: Path) -> tuple[dict, float]:
         for p in sorted(log_dir.rglob("*.std*")) + sorted(log_dir.rglob("*.log")):
             print(f"==== {p} ====\n{p.read_text()[-2000:]}", file=sys.stderr)
         raise RuntimeError(f"orchestrated job finished {status}")
-    metrics = json.loads(out.read_text())
-    launch_latency = metrics["time_to_first_step_s"] + 0.0
-    # end-to-end: submit -> first step = executor spawn + script start + compile
-    e2e_first_step = launch_latency  # in-process portion; add client-side below
-    return metrics, time.time() - t_submit
+    return json.loads(out.read_text()), time.time() - t_submit
 
 
 def main() -> int:
+    plain_runs, orch_runs = [], []
+    wall = 0.0
     with tempfile.TemporaryDirectory(prefix="tony-bench-") as td:
         tmp = Path(td)
-        plain = run_plain(tmp)
-        orch, wall = run_orchestrated(tmp)
+        for rep in range(PAIRS):
+            plain_runs.append(run_plain(tmp, rep))
+            orch, wall = run_orchestrated(tmp, rep)
+            orch_runs.append(orch)
 
-    plain_sps = plain["steps_per_sec"]
-    orch_sps = orch["steps_per_sec"]
+    plain_sps = max(r["steps_per_sec"] for r in plain_runs)
+    orch_sps = max(r["steps_per_sec"] for r in orch_runs)
+    best_orch = max(orch_runs, key=lambda r: r["steps_per_sec"])
     print(
-        f"# plain: {plain_sps:.1f} steps/s | orchestrated: {orch_sps:.1f} steps/s | "
-        f"launch-to-first-step: {orch['time_to_first_step_s']:.2f}s | "
-        f"job wall: {wall:.1f}s | devices: {orch['num_devices']} | "
-        f"acc: {orch['accuracy']:.3f}",
+        f"# plain: {plain_sps:.1f} steps/s {[round(r['steps_per_sec'], 1) for r in plain_runs]} | "
+        f"orchestrated: {orch_sps:.1f} steps/s {[round(r['steps_per_sec'], 1) for r in orch_runs]} | "
+        f"launch-to-first-step: {best_orch['time_to_first_step_s']:.2f}s | "
+        f"last job wall: {wall:.1f}s | devices: {best_orch['num_devices']} | "
+        f"acc: {best_orch['accuracy']:.3f}",
         file=sys.stderr,
     )
     print(json.dumps({
