@@ -1,0 +1,19 @@
+"""Asserts this task runs from a SHIPPED copy of the job: the executor's job
+dir is a localized unpack under TONY_LOCAL_DIR (not the client's staging
+dir), the per-task workdir lives under it, and the shipped role resource +
+src tree are materialized in the cwd."""
+
+import os
+
+job_dir = os.environ["TONY_JOB_DIR"]
+local_base = os.environ["TONY_LOCAL_DIR"]
+assert job_dir.startswith(local_base), (job_dir, local_base)
+
+cwd = os.getcwd()
+assert cwd.startswith(job_dir), (cwd, job_dir)
+
+with open("data.txt") as f:
+    assert f.read() == "shipped-bytes", "resource content mismatch"
+
+assert os.path.isfile(os.path.join("src", "lib.py")), "shipped src missing"
+print("localized OK:", job_dir)

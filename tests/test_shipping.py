@@ -1,0 +1,199 @@
+"""Job-archive shipping tests: the rebuild's analogue of the reference's
+HDFS staging upload + per-container extractResources
+(TonyClient.java:232-315, util/Utils.java:758-771) — and the SSH launch
+seam of StaticHostProvisioner, exercised with a local stand-in template.
+"""
+
+import json
+import os
+import sys
+import tarfile
+from pathlib import Path
+
+import pytest
+
+from tony_tpu.api import JobStatus
+from tony_tpu.client import TonyClient
+from tony_tpu.conf import FINAL_CONF_NAME, TonyConf
+from tony_tpu.utils import shipping
+
+PY = sys.executable
+FIXTURES = Path(__file__).parent / "fixtures" / "scripts"
+
+
+# ------------------------------------------------------------------- unit
+
+def _staged_job_dir(tmp_path: Path) -> Path:
+    job = tmp_path / "job"
+    (job / "src").mkdir(parents=True)
+    (job / "src" / "lib.py").write_text("X = 1\n")
+    (job / "resources").mkdir()
+    (job / "resources" / "data.txt").write_text("shipped-bytes")
+    (job / FINAL_CONF_NAME).write_text(json.dumps({"tony.worker.instances": 1}))
+    # runtime output that must NOT ship
+    (job / "logs").mkdir()
+    (job / "logs" / "worker_0.stdout").write_text("log line")
+    (job / "driver.log").write_text("driver noise")
+    return job
+
+
+def test_archive_roundtrip_excludes_runtime_output(tmp_path):
+    job = _staged_job_dir(tmp_path)
+    archive = shipping.build_job_archive(job)
+    with tarfile.open(archive) as tf:
+        names = tf.getnames()
+    assert FINAL_CONF_NAME in names
+    assert "src/lib.py" in names
+    assert "resources/data.txt" in names
+    assert not any(n.startswith("logs") or n == "driver.log" for n in names)
+
+    local = shipping.localize_job(str(archive), "app_x", base_dir=str(tmp_path / "lz"))
+    assert (Path(local) / FINAL_CONF_NAME).exists()
+    assert (Path(local) / "src" / "lib.py").read_text() == "X = 1\n"
+    # idempotent: second call reuses the unpack
+    again = shipping.localize_job(str(archive), "app_x", base_dir=str(tmp_path / "lz"))
+    assert again == local
+
+
+def test_localize_rejects_non_job_archive(tmp_path):
+    bogus = tmp_path / "bogus.tar.gz"
+    with tarfile.open(bogus, "w:gz") as tf:
+        p = tmp_path / "stray.txt"
+        p.write_text("hi")
+        tf.add(p, arcname="stray.txt")
+    with pytest.raises(FileNotFoundError):
+        shipping.localize_job(str(bogus), "app_y", base_dir=str(tmp_path / "lz"))
+
+
+def test_fetch_file_uri(tmp_path):
+    src = tmp_path / "a.bin"
+    src.write_bytes(b"\x00\x01")
+    out = shipping.fetch_archive(f"file://{src}", tmp_path / "dl" / "a.bin")
+    assert out.read_bytes() == b"\x00\x01"
+
+
+# -------------------------------------------------------------------- e2e
+
+def _shipped_conf(dirs, tmp_path, **extra):
+    """A job whose src + resources must reach the task through the archive."""
+    src = tmp_path / "user_src"
+    src.mkdir()
+    (src / "lib.py").write_text("X = 1\n")
+    res = tmp_path / "data.txt"
+    res.write_text("shipped-bytes")
+    local_base = tmp_path / "hostlocal"
+    conf = TonyConf({
+        "tony.staging.dir": dirs["staging"],
+        "tony.history.intermediate": dirs["history"] + "/intermediate",
+        "tony.am.monitor-interval-ms": 100,
+        "tony.task.registration-poll-interval-ms": 100,
+        "tony.application.src-dir": str(src),
+        "tony.worker.instances": 1,
+        "tony.worker.resources": str(res),
+        "tony.worker.command": f"{PY} {FIXTURES / 'check_localized.py'}",
+        "tony.task.localize": True,
+        "tony.execution.env": f"TONY_LOCAL_DIR={local_base}",
+        **extra,
+    })
+    return conf, local_base
+
+
+def _run(conf):
+    client = TonyClient(conf, poll_interval_s=0.1)
+    client.submit()
+    status = client.monitor()
+    return status, client
+
+
+def _logs(client):
+    return "\n".join(
+        f"==== {p} ====\n{p.read_text()[-3000:]}"
+        for p in sorted(Path(client.job_dir).rglob("*.log"))
+        + sorted(Path(client.job_dir).rglob("*.std*"))
+    )
+
+
+def test_e2e_executor_runs_from_shipped_archive(tmp_job_dirs, tmp_path):
+    """tony.task.localize forces the executor to fetch + unpack the job
+    archive into a host-local dir and run the task from the copy — the whole
+    remote-distribution path minus the network transport."""
+    conf, local_base = _shipped_conf(tmp_job_dirs, tmp_path)
+    status, client = _run(conf)
+    assert status == JobStatus.SUCCEEDED, _logs(client)
+    # archive was built and the task really ran from the localized copy
+    assert (Path(client.job_dir) / shipping.ARCHIVE_NAME).exists()
+    unpacked = local_base / client.app_id
+    assert (unpacked / FINAL_CONF_NAME).exists()
+    out = (Path(client.job_dir) / "logs" / "worker_0.stdout").read_text()
+    assert "localized OK" in out, _logs(client)
+
+
+def test_e2e_app_placeholder_uri_and_upload_cmd(tmp_job_dirs, tmp_path):
+    """{app} in archive-uri resolves to the generated application id, and
+    the upload command template runs — the HDFS-upload seam with a cp
+    stand-in for gsutil."""
+    uri_tpl = str(tmp_path / "bucket" / "{app}" / "job_archive.tar.gz")
+    conf, local_base = _shipped_conf(
+        tmp_job_dirs, tmp_path,
+        **{
+            "tony.application.archive-uri": uri_tpl,
+            "tony.application.archive-upload-cmd":
+                "mkdir -p $(dirname {uri}) && cp {archive} {uri}",
+        },
+    )
+    status, client = _run(conf)
+    assert status == JobStatus.SUCCEEDED, _logs(client)
+    uploaded = tmp_path / "bucket" / client.app_id / "job_archive.tar.gz"
+    assert uploaded.exists(), "upload command did not place the archive"
+    # frozen conf records the resolved (not templated) URI
+    final = json.loads((Path(client.job_dir) / FINAL_CONF_NAME).read_text())
+    assert final["tony.application.archive-uri"] == str(uploaded)
+
+
+def test_e2e_ssh_launch_seam_with_localization(tmp_job_dirs, tmp_path):
+    """StaticHostProvisioner through a {env}-substituting launch template
+    (local stand-in for ssh: `env {env} python -m tony_tpu.executor`) — the
+    reference's NM container-launch seam (ApplicationMaster.java:1158-1227).
+    Proves env quoting, watcher wiring, completion, and archive shipping
+    end-to-end; 2 workers on one 'host' share the localized unpack."""
+    template = "env {env} " + PY + " -S -m tony_tpu.executor"
+    conf, local_base = _shipped_conf(
+        tmp_job_dirs, tmp_path,
+        **{
+            "tony.worker.instances": 2,
+            "tony.cluster.provisioner": "static",
+            "tony.cluster.static-hosts": ["testhost"],
+            "tony.cluster.launch-template": template,
+        },
+    )
+    status, client = _run(conf)
+    assert status == JobStatus.SUCCEEDED, _logs(client)
+    assert {t.task_id for t in client.task_infos} == {"worker:0", "worker:1"}
+    assert all(t.status == "SUCCEEDED" for t in client.task_infos)
+    # both workers ran from the single localized copy on the "host"
+    for i in (0, 1):
+        out = (Path(client.job_dir) / "logs" / f"worker_{i}.stdout").read_text()
+        assert f"localized OK: {local_base / client.app_id}" in out, _logs(client)
+
+
+def test_e2e_ssh_template_env_quoting_survives_spaces(tmp_job_dirs, tmp_path):
+    """Values with spaces (the task command itself) must survive the
+    template's {env} substitution through a real shell."""
+    template = "env {env} " + PY + " -S -m tony_tpu.executor"
+    script = tmp_path / "with space" / "ok.py"
+    script.parent.mkdir()
+    script.write_text("print('spaced ok')\n")
+    conf = TonyConf({
+        "tony.staging.dir": tmp_job_dirs["staging"],
+        "tony.history.intermediate": tmp_job_dirs["history"] + "/intermediate",
+        "tony.am.monitor-interval-ms": 100,
+        "tony.worker.instances": 1,
+        "tony.worker.command": f"{PY} '{script}'",
+        "tony.cluster.provisioner": "static",
+        "tony.cluster.static-hosts": ["testhost"],
+        "tony.cluster.launch-template": template,
+    })
+    status, client = _run(conf)
+    assert status == JobStatus.SUCCEEDED, _logs(client)
+    out = (Path(client.job_dir) / "logs" / "worker_0.stdout").read_text()
+    assert "spaced ok" in out

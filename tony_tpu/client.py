@@ -88,6 +88,7 @@ class TonyClient:
 
         version.inject(self.conf)
         self.conf.write_final(self.job_dir)
+        self._ship_archive()
 
         env = {**os.environ, c.ENV_TOKEN: self.token}
         # make this package importable in the driver/executor processes no
@@ -139,6 +140,40 @@ class TonyClient:
             self.conf.set(
                 keys.role_key(spec.name, "resources"), loc.serialize(staged)
             )
+
+    def _ship_archive(self) -> None:
+        """Build (and optionally upload) the job archive so executors on
+        hosts without the staging FS can fetch the job — the reference's
+        HDFS staging upload (TonyClient.java:232-315). Runs when an
+        archive URI is configured, localization is forced, or the
+        provisioner launches on remote hosts."""
+        from .utils import shipping
+
+        uri = str(self.conf.get(keys.APPLICATION_ARCHIVE_URI, "") or "")
+        # {app} placeholder -> per-application path, so one static config
+        # serves many submissions without archives clobbering each other
+        if "{app}" in uri:
+            uri = uri.replace("{app}", self.app_id)
+            self.conf.set(keys.APPLICATION_ARCHIVE_URI, uri)
+            self.conf.write_final(self.job_dir)
+        localize = self.conf.get_bool(keys.TASK_LOCALIZE, False)
+        prov = str(self.conf.get(keys.CLUSTER_PROVISIONER, "local")).lower()
+        if not uri and not localize and prov == "local":
+            return
+        archive = shipping.build_job_archive(self.job_dir)
+        if not uri:
+            # shared/local FS default; real fleets set an uploadable URI
+            # (gs://... + upload-cmd) or scp://<client-host>:<archive>
+            uri = str(archive)
+            self.conf.set(keys.APPLICATION_ARCHIVE_URI, uri)
+            # re-freeze so the driver sees the resolved URI (executors get
+            # theirs from the archive copy, where the URI is irrelevant)
+            self.conf.write_final(self.job_dir)
+        upload_cmd = str(
+            self.conf.get(keys.APPLICATION_ARCHIVE_UPLOAD_CMD, "") or ""
+        )
+        if upload_cmd:
+            shipping.upload_archive(archive, uri, upload_cmd)
 
     # ------------------------------------------------------------ monitoring
     def _connect(self, timeout_s: float = 60.0) -> RpcClient:

@@ -211,7 +211,8 @@ def create_provisioner(conf: TonyConf) -> Provisioner:
         return LocalProvisioner()
     if kind == "static":
         hosts = conf.get_list(keys.CLUSTER_STATIC_HOSTS)
-        return StaticHostProvisioner(hosts)
+        template = str(conf.get(keys.CLUSTER_LAUNCH_TEMPLATE, "") or "") or None
+        return StaticHostProvisioner(hosts, launch_template=template)
     if kind in ("tpu-pod", "tpu"):
         from .tpu import TpuPodProvisioner
 

@@ -55,6 +55,13 @@ HISTORY_FINISHED = "tony.history.finished"
 HISTORY_RETENTION_SEC = "tony.history.retention-sec"
 HISTORY_MOVER_INTERVAL_MS = "tony.history.mover-interval-ms"
 SRC_DIR = "tony.application.src-dir"
+# job-archive shipping to remote executor hosts (reference HDFS staging,
+# TonyClient.java:232-315): URI executors fetch the archive from, an optional
+# client-side upload command template ({archive}, {uri}), and a per-task
+# switch forcing fetch+unpack even when the path looks shared
+APPLICATION_ARCHIVE_URI = "tony.application.archive-uri"
+APPLICATION_ARCHIVE_UPLOAD_CMD = "tony.application.archive-upload-cmd"
+TASK_LOCALIZE = "tony.task.localize"
 PYTHON_VENV = "tony.application.python-venv"
 PYTHON_BINARY_PATH = "tony.application.python-binary-path"
 EXECUTION_ENV = "tony.execution.env"  # list of K=V propagated to every task
@@ -78,6 +85,8 @@ SECURITY_TOKEN_ENABLED = "tony.security.token-enabled"
 # ------------------------------------------------------------------- cluster
 CLUSTER_PROVISIONER = "tony.cluster.provisioner"  # local|tpu-pod|static
 CLUSTER_STATIC_HOSTS = "tony.cluster.static-hosts"
+# {host}/{env} command template for static-host launches ("" = default ssh)
+CLUSTER_LAUNCH_TEMPLATE = "tony.cluster.launch-template"
 TPU_TOPOLOGY = "tony.tpu.topology"  # e.g. v5e-8; "" = discover
 TPU_ACCELERATOR_TYPE = "tony.tpu.accelerator-type"
 TPU_DISCOVER_COMMAND = "tony.tpu.discover-command"  # prints one worker host per line

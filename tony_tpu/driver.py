@@ -318,6 +318,13 @@ class Driver:
             c.ENV_TOKEN: self.token,
             c.ENV_TASK_COMMAND: spec.command,
         }
+        # job-archive shipping (reference HDFS localization seam): executors
+        # on hosts without the staging FS fetch + unpack this URI
+        archive_uri = str(self.conf.get(keys.APPLICATION_ARCHIVE_URI, "") or "")
+        if archive_uri:
+            env[c.ENV_JOB_ARCHIVE] = archive_uri
+        if self.conf.get_bool(keys.TASK_LOCALIZE, False):
+            env[c.ENV_LOCALIZE] = "true"
         for kv in self.conf.get_list(keys.EXECUTION_ENV):
             if "=" in kv:
                 k, v = kv.split("=", 1)

@@ -89,6 +89,24 @@ class Executor:
         self.job_dir = env.get(c.ENV_JOB_DIR, "")
         self.command = env.get(c.ENV_TASK_COMMAND, "")
         self.task_id = f"{self.job_name}:{self.task_index}"
+
+        # remote-host localization: when the client's job dir isn't visible
+        # here (no shared FS) — or localization is forced — fetch + unpack
+        # the shipped archive and use the local copy as the job dir
+        # (reference Utils.extractResources, util/Utils.java:758-771)
+        archive_uri = env.get(c.ENV_JOB_ARCHIVE, "")
+        force_localize = env.get(c.ENV_LOCALIZE, "") == "true"
+        from .conf import FINAL_CONF_NAME
+
+        final_visible = self.job_dir and os.path.exists(
+            os.path.join(self.job_dir, FINAL_CONF_NAME)
+        )
+        if archive_uri and (force_localize or not final_visible):
+            from .utils import shipping
+
+            self.job_dir = shipping.localize_job(archive_uri, self.app_id)
+            log.info("running from localized job dir %s", self.job_dir)
+
         self.conf = TonyConf.from_final(self.job_dir) if self.job_dir else TonyConf()
 
         token = env.get(c.ENV_TOKEN, "")
@@ -299,10 +317,16 @@ class Executor:
         raw = str(self.conf.get(keys.role_key(self.job_name, "resources"), "") or "")
         try:
             specs = loc.parse_resources(raw.split(",")) if raw else []
+            specs = [self._remap_staged(s) for s in specs]
             loc.localize_resources(specs, work)
         except (OSError, ValueError) as e:
             log.error("resource localization failed: %s", e)
         src = str(self.conf.get(keys.SRC_DIR, "") or "")
+        if src and not os.path.isdir(src):
+            # conf holds the client-side staged path; after archive
+            # localization the copy lives under THIS job dir
+            candidate = os.path.join(self.job_dir, "src")
+            src = candidate if os.path.isdir(candidate) else ""
         if src and os.path.isdir(src):
             dest = os.path.join(work, "src")
             if not os.path.isdir(dest):
@@ -310,6 +334,19 @@ class Executor:
 
                 shutil.copytree(src, dest)
         return work
+
+    def _remap_staged(self, spec):
+        """Rewrite a client-side staged resource path (<client job
+        dir>/resources/<name>) to this executor's job dir when the original
+        path isn't visible on this host."""
+        if os.path.exists(spec.path):
+            return spec
+        from dataclasses import replace
+
+        candidate = os.path.join(
+            self.job_dir, "resources", os.path.basename(spec.path)
+        )
+        return replace(spec, path=candidate) if os.path.exists(candidate) else spec
 
     def _base_child_env(self) -> dict[str, str]:
         return {
