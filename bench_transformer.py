@@ -213,6 +213,12 @@ def main() -> int:
     args = parser.parse_args()
 
     perf = {"train": bench_train(args.steps, args.batch)}
+    # prove the executor-side TPU sampler on a machine with chips attached
+    # (empty on hosts whose TPU runtime serves no local metrics, e.g. a
+    # tunneled chip)
+    from tony_tpu.metrics import sample_tpu_metrics
+
+    perf["tpu_metrics_sampled"] = sample_tpu_metrics()
     if not args.skip_attn:
         perf["flash_vs_xla_fwd_bwd"] = bench_flash_vs_xla()
     elif Path(args.out).exists():

@@ -7,6 +7,8 @@ import sys
 import zipfile
 from pathlib import Path
 
+import pytest
+
 from tony_tpu.api import JobStatus
 from tony_tpu.client import TonyClient
 from tony_tpu.conf import TonyConf
@@ -137,6 +139,135 @@ def test_tensorboard_sidecar_registers_url(tmp_job_dirs):
 
 
 # -------------------------------------------------------------------- metrics
+
+def test_tpu_metric_parsing():
+    """The libtpu-SDK metric reducer — analogue of the reference's
+    TestGpuDeviceInformationParser fixture tests."""
+    from tony_tpu.metrics import (
+        TPU_DUTY_CYCLE, TPU_HBM_USED, parse_tpu_metric_values,
+    )
+
+    assert parse_tpu_metric_values(
+        "duty_cycle_pct", ["0.00", "20.00", "40.00", "0.00"]
+    ) == {TPU_DUTY_CYCLE: 15.0}
+    assert parse_tpu_metric_values(
+        "hbm_capacity_usage", ["1073741824", "0"]
+    ) == {TPU_HBM_USED: 1073741824 / 1e6}
+    # empty list = runtime not serving metrics on this host -> sample nothing
+    assert parse_tpu_metric_values("duty_cycle_pct", []) == {}
+    with pytest.raises(ValueError):
+        parse_tpu_metric_values("unknown_metric", ["1"])
+    with pytest.raises(ValueError):
+        parse_tpu_metric_values("duty_cycle_pct", ["not-a-number"])
+
+
+def test_sample_tpu_metrics_with_mocked_sdk(monkeypatch):
+    """End-to-end sampler against a mocked libtpu.sdk module tree."""
+    import sys
+    import types
+
+    from tony_tpu import metrics as M
+
+    class FakeMetric:
+        def __init__(self, data):
+            self._d = data
+
+        def data(self):
+            return self._d
+
+    data = {
+        "duty_cycle_pct": ["50.00", "100.00"],
+        "hbm_capacity_usage": ["2000000", "3000000"],
+    }
+    tpumonitoring = types.SimpleNamespace(
+        get_metric=lambda name: FakeMetric(data[name]),
+        list_supported_metrics=lambda: list(data),
+    )
+    sdk = types.ModuleType("libtpu.sdk")
+    sdk.tpumonitoring = tpumonitoring
+    libtpu = types.ModuleType("libtpu")
+    libtpu.sdk = sdk
+    monkeypatch.setitem(sys.modules, "libtpu", libtpu)
+    monkeypatch.setitem(sys.modules, "libtpu.sdk", sdk)
+
+    out = M.sample_tpu_metrics()
+    assert out == {M.TPU_DUTY_CYCLE: 75.0, M.TPU_HBM_USED: 5.0}
+
+    # a runtime error on one metric must not lose the other
+    def flaky(name):
+        if name == "duty_cycle_pct":
+            raise RuntimeError("runtime not initialized")
+        return FakeMetric(data[name])
+
+    tpumonitoring.get_metric = flaky
+    assert M.sample_tpu_metrics() == {M.TPU_HBM_USED: 5.0}
+
+
+def test_horovod_real_rendezvous_inits_host_plan(monkeypatch):
+    """With horovod importable, the rendezvous server must be started AND
+    initialised with the host-assignment plan (reference
+    horovod_driver.py:32-42 static_driver_fn) — a started-but-uninitialised
+    server can never rendezvous workers. Horovod isn't installed here, so
+    mock its module tree and assert the plan reaches server.init()."""
+    import sys
+    import types
+
+    from tony_tpu.runtimes.horovod import (
+        HorovodTaskAdapter, compute_slot_assignments,
+    )
+
+    calls = {}
+
+    def parse_hosts(host_str):
+        calls["parse"] = host_str
+        return ["parsed:" + host_str]
+
+    def get_host_assignments(hosts, min_np):
+        calls["assign_args"] = (hosts, min_np)
+        return ["plan-entry-0", "plan-entry-1"]
+
+    class FakeRendezvousServer:
+        def start(self):
+            calls["started"] = True
+            return 43210
+
+        def init(self, plan):
+            calls["init_plan"] = plan
+
+    mods = {
+        "horovod": types.ModuleType("horovod"),
+        "horovod.runner": types.ModuleType("horovod.runner"),
+        "horovod.runner.common": types.ModuleType("horovod.runner.common"),
+        "horovod.runner.common.util": types.ModuleType("horovod.runner.common.util"),
+        "horovod.runner.common.util.hosts": types.ModuleType(
+            "horovod.runner.common.util.hosts"
+        ),
+        "horovod.runner.http": types.ModuleType("horovod.runner.http"),
+        "horovod.runner.http.http_server": types.ModuleType(
+            "horovod.runner.http.http_server"
+        ),
+    }
+    mods["horovod.runner.common.util.hosts"].parse_hosts = parse_hosts
+    mods["horovod.runner.common.util.hosts"].get_host_assignments = (
+        get_host_assignments
+    )
+    mods["horovod.runner.http.http_server"].RendezvousServer = FakeRendezvousServer
+    for name, mod in mods.items():
+        monkeypatch.setitem(sys.modules, name, mod)
+
+    adapter = HorovodTaskAdapter()
+    host_slots = [("hostA", 2), ("hostB", 2)]
+    slots = compute_slot_assignments(host_slots)
+    port = adapter._start_rendezvous(host_slots, slots, test_mode=False)
+
+    assert port == 43210
+    assert calls["parse"] == "hostA:2,hostB:2"
+    assert calls["assign_args"] == (["parsed:hostA:2,hostB:2"], 1)
+    # the critical step: the plan from get_host_assignments reaches init()
+    assert calls["init_plan"] == ["plan-entry-0", "plan-entry-1"]
+    # and the server object is retained so it isn't garbage collected
+    assert isinstance(adapter._real_server, FakeRendezvousServer)
+
 
 def test_metrics_accumulator_avg_max():
     acc = MetricsAccumulator()

@@ -149,6 +149,16 @@ def test_e2e_app_placeholder_uri_and_upload_cmd(tmp_job_dirs, tmp_path):
     final = json.loads((Path(client.job_dir) / FINAL_CONF_NAME).read_text())
     assert final["tony.application.archive-uri"] == str(uploaded)
 
+    # one conf object serves many submissions: the template must survive
+    # the first submit, so the second job resolves to ITS OWN path
+    status2, client2 = _run(conf)
+    assert status2 == JobStatus.SUCCEEDED, _logs(client2)
+    assert client2.app_id != client.app_id
+    final2 = json.loads((Path(client2.job_dir) / FINAL_CONF_NAME).read_text())
+    assert final2["tony.application.archive-uri"] == str(
+        tmp_path / "bucket" / client2.app_id / "job_archive.tar.gz"
+    )
+
 
 def test_e2e_ssh_launch_seam_with_localization(tmp_job_dirs, tmp_path):
     """StaticHostProvisioner through a {env}-substituting launch template

@@ -149,13 +149,10 @@ class TonyClient:
         provisioner launches on remote hosts."""
         from .utils import shipping
 
-        uri = str(self.conf.get(keys.APPLICATION_ARCHIVE_URI, "") or "")
+        template_uri = str(self.conf.get(keys.APPLICATION_ARCHIVE_URI, "") or "")
         # {app} placeholder -> per-application path, so one static config
         # serves many submissions without archives clobbering each other
-        if "{app}" in uri:
-            uri = uri.replace("{app}", self.app_id)
-            self.conf.set(keys.APPLICATION_ARCHIVE_URI, uri)
-            self.conf.write_final(self.job_dir)
+        uri = template_uri.replace("{app}", self.app_id)
         localize = self.conf.get_bool(keys.TASK_LOCALIZE, False)
         prov = str(self.conf.get(keys.CLUSTER_PROVISIONER, "local")).lower()
         if not uri and not localize and prov == "local":
@@ -165,10 +162,17 @@ class TonyClient:
             # shared/local FS default; real fleets set an uploadable URI
             # (gs://... + upload-cmd) or scp://<client-host>:<archive>
             uri = str(archive)
+        if uri != template_uri:
+            # freeze the RESOLVED uri for the driver, but restore the
+            # template in the in-memory conf — a caller reusing one conf
+            # object for several submissions must not inherit this job's
+            # resolved path (executors read the archive copy of the conf,
+            # where the uri is irrelevant)
             self.conf.set(keys.APPLICATION_ARCHIVE_URI, uri)
-            # re-freeze so the driver sees the resolved URI (executors get
-            # theirs from the archive copy, where the URI is irrelevant)
-            self.conf.write_final(self.job_dir)
+            try:
+                self.conf.write_final(self.job_dir)
+            finally:
+                self.conf.set(keys.APPLICATION_ARCHIVE_URI, template_uri)
         upload_cmd = str(
             self.conf.get(keys.APPLICATION_ARCHIVE_UPLOAD_CMD, "") or ""
         )

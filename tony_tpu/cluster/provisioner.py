@@ -177,7 +177,9 @@ class StaticHostProvisioner(Provisioner):
             host = self.hosts[self._count % len(self.hosts)]
             self._count += 1
         env_str = " ".join(f"{k}={shlex.quote(str(v))}" for k, v in env.items())
-        cmd = self.launch_template.format(host=host, env=env_str)
+        # token replace, not str.format: the template is arbitrary shell
+        # where literal braces (${VAR}, awk '{...}') are ordinary syntax
+        cmd = self.launch_template.replace("{host}", host).replace("{env}", env_str)
         log_dir.mkdir(parents=True, exist_ok=True)
         stdout = open(log_dir / f"{spec.name}_{index}.stdout", "ab")
         stderr = open(log_dir / f"{spec.name}_{index}.stderr", "ab")

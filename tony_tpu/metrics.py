@@ -144,19 +144,48 @@ class TaskMonitor:
             pass
 
 
-def sample_tpu_metrics() -> dict[str, float]:
-    """TPU counters via libtpu's monitoring API when the executor host has
-    TPUs attached; {} otherwise. Plays the role of the reference's
-    nvidia-smi XML sampling (util/gpu/GpuDiscoverer.java:41-59) — but reads
-    an in-process API instead of forking a subprocess."""
-    try:
-        from tpu_info import metrics as tpu_metrics  # optional, TPU VMs only
+def parse_tpu_metric_values(name: str, values: list[str]) -> dict[str, float]:
+    """Reduce one libtpu metric's per-chip string list to named floats.
 
-        out = {}
-        usage = tpu_metrics.get_chip_usage()
-        if usage:
-            out[TPU_HBM_USED] = sum(u.memory_usage for u in usage) / 1e6
-            out[TPU_DUTY_CYCLE] = sum(u.duty_cycle_pct for u in usage) / len(usage)
-        return out
-    except Exception:
+    The SDK contract (libtpu.sdk.tpumonitoring.get_metric(name).data()):
+    `duty_cycle_pct` is one percentage string per chip; `hbm_capacity_usage`
+    is one integer-bytes string per chip. An empty list means the host's TPU
+    runtime isn't serving metrics (e.g. no local chips) — sample nothing
+    rather than zeros."""
+    if not values:
         return {}
+    nums = [float(v) for v in values]
+    if name == "duty_cycle_pct":
+        return {TPU_DUTY_CYCLE: sum(nums) / len(nums)}
+    if name == "hbm_capacity_usage":
+        return {TPU_HBM_USED: sum(nums) / 1e6}
+    raise ValueError(f"unmapped TPU metric {name!r}")
+
+
+# libtpu metric names sampled per refresh (of tpumonitoring.list_supported_
+# metrics(), verified on a v5e VM: tensorcore_util, duty_cycle_pct,
+# hbm_capacity_total/usage, hlo_execution_timing, ...)
+_SAMPLED_TPU_METRICS = ("duty_cycle_pct", "hbm_capacity_usage")
+
+
+def sample_tpu_metrics() -> dict[str, float]:
+    """TPU counters via libtpu's SDK monitoring API when the executor host
+    has TPUs attached; {} otherwise. Plays the role of the reference's
+    nvidia-smi XML sampling (util/gpu/GpuDiscoverer.java:41-59 + the
+    fixture-tested GpuDeviceInformation parser) — but reads an in-process
+    API instead of forking and parsing XML."""
+    try:
+        from libtpu.sdk import tpumonitoring  # present on TPU VMs
+    except Exception:  # ImportError, or OSError from the .so loader
+        return {}
+    out: dict[str, float] = {}
+    for name in _SAMPLED_TPU_METRICS:
+        try:
+            values = tpumonitoring.get_metric(name).data()
+            out.update(parse_tpu_metric_values(name, values))
+        except Exception as e:
+            # per-metric, logged: format drift or a runtime that isn't
+            # serving stays visible without ever failing the sampler
+            # (TaskMonitor.refresh and bench rely on best-effort here)
+            log.debug("tpu metric %s unavailable: %s", name, e)
+    return out

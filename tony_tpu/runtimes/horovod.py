@@ -237,7 +237,13 @@ class HorovodTaskAdapter(GenericTaskAdapter):
                 hosts = parse_hosts(host_str)
                 assignments = get_host_assignments(hosts, 1)
                 server = RendezvousServer()
-                return server.start()
+                port = server.start()
+                # the server must be initialised with the host plan or
+                # workers can never rendezvous — reference
+                # horovod_driver.py:32-42 (static_driver_fn)
+                server.init(assignments)
+                self._real_server = server  # keep the server alive
+                return port
             except ImportError:
                 log.warning("horovod not installed; using stub rendezvous server")
         self._stub = _StubRendezvousServer()

@@ -51,7 +51,9 @@ def build_job_archive(job_dir: str | Path) -> Path:
 def upload_archive(archive: Path, uri: str, upload_cmd: str) -> None:
     """Run the user-supplied upload command ({archive} and {uri} templates) —
     the HDFS-upload seam without baking in one cloud's CLI."""
-    cmd = upload_cmd.format(archive=str(archive), uri=uri)
+    # token replace, not str.format: the command is arbitrary shell where
+    # literal braces (${VAR}, awk '{...}') are ordinary syntax
+    cmd = upload_cmd.replace("{archive}", str(archive)).replace("{uri}", uri)
     log.info("uploading job archive: %s", cmd)
     subprocess.run(cmd, shell=True, check=True, timeout=600)
 
