@@ -318,7 +318,7 @@ def _use_blockwise_ce(cfg: TransformerConfig, mesh=None, rules=None) -> bool:
 
 
 def token_nll(x, unembed, targets, cfg: TransformerConfig, mesh=None,
-              rules=None):
+              rules=None, reduction: str = "mean"):
     """Masked mean next-token NLL from final hidden states, dispatching on
     cfg.ce_impl: blockwise CE streams the unembed matmul + softmax over
     vocab blocks so the [B, L, V] logits tensor never materializes (forward
@@ -344,6 +344,11 @@ def token_nll(x, unembed, targets, cfg: TransformerConfig, mesh=None,
             safe_targets.reshape(-1),
         )
     nll = nll.reshape(targets.shape)
+    if reduction == "sum":
+        # caller divides by its own (e.g. global) valid count — the
+        # pipelined head path, where per-microbatch means would up-weight
+        # pad-heavy microbatches
+        return (nll * valid).sum()
     return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
 
 

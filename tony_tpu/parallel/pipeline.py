@@ -32,10 +32,15 @@ def _pipeline_local(
     microbatches: jax.Array,  # [M, mb, ...] identical on every device
     axis_name: str,
     squeeze_stage_dim: bool = True,
+    has_aux: bool = False,
 ) -> jax.Array:
     """Runs on one device inside shard_map; stage_params is this device's
     stage slice (leading dim squeezed when it is a single stage; kept when
-    the stage holds a stack of layers — see make_pipeline_stacked)."""
+    the stage holds a stack of layers — see make_pipeline_stacked).
+
+    With has_aux, stage_fn returns (y, aux_scalar) and the pipeline also
+    returns the aux sum over all (stage, real-microbatch) applications —
+    how MoE load-balancing losses survive pipelining."""
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     m = microbatches.shape[0]
@@ -48,12 +53,20 @@ def _pipeline_local(
         params = stage_params
 
     def tick(carry, t):
-        inbox, outputs = carry
+        inbox, outputs, aux_acc = carry
         # stage 0 feeds itself from the microbatch stream; other stages read
         # their inbox (written by the previous stage last tick)
         feed = microbatches[jnp.minimum(t, m - 1)]
         x = jnp.where(me == 0, feed, inbox)
-        y = stage_fn(params, x)
+        if has_aux:
+            y, aux = stage_fn(params, x)
+            # this device processes a REAL microbatch only during its
+            # active window t in [me, me + m); outside it the tick carries
+            # wrap-around garbage whose aux must not count
+            real = (t >= me) & (t < me + m)
+            aux_acc = aux_acc + jnp.where(real, aux.astype(jnp.float32), 0.0)
+        else:
+            y = stage_fn(params, x)
         # last stage records its result at slot t - (n - 1)
         slot = t - (n - 1)
         valid = (slot >= 0) & (me == n - 1)
@@ -70,16 +83,20 @@ def _pipeline_local(
         inbox_next = lax.ppermute(
             y, axis_name, [(i, (i + 1) % n) for i in range(n)]
         )
-        return (inbox_next, outputs), None
+        return (inbox_next, outputs, aux_acc), None
 
     inbox0 = jnp.zeros(mb_shape, microbatches.dtype)
     outputs0 = jnp.zeros((m,) + mb_shape, microbatches.dtype)
-    (_, outputs), _ = lax.scan(tick, (inbox0, outputs0), jnp.arange(total))
+    (_, outputs, aux_acc), _ = lax.scan(
+        tick, (inbox0, outputs0, jnp.float32(0)), jnp.arange(total)
+    )
     # only stage n-1 holds real outputs; broadcast via masked psum so the
     # shard_map output is replicated across the pipe axis
     outputs = lax.psum(
         jnp.where(me == n - 1, outputs, jnp.zeros_like(outputs)), axis_name
     )
+    if has_aux:
+        return outputs, lax.psum(aux_acc, axis_name)
     return outputs
 
 
@@ -123,19 +140,245 @@ def stack_stage_params(per_stage_params: list[Any]) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
 
 
+# ------------------------------------------------------------------- 1F1B
+
+def _tree_scale_add(acc, delta, mask):
+    return jax.tree.map(lambda a, d: a + d.astype(a.dtype) * mask, acc, delta)
+
+
+def _pipeline_1f1b_local(
+    stage_fn, head_fn, aux_cot,
+    stage_params, head_params, microbatches, targets, head_cot,
+    axis_name: str,
+):
+    """One device's 1F1B schedule inside shard_map.
+
+    Round r (r = 0..M+2S-3), stage i:
+      forward  of microbatch mf = r - i            (if 0 <= mf < M)
+      backward of microbatch mb = r - (2S-2-i)     (if 0 <= mb < M)
+    The last stage runs the head (loss) on each forward output and starts
+    that microbatch's backward the same round; gradients flow stage i ->
+    i-1 one round apart, so each stage holds at most 2(S-1-i)+1 <= 2S-1
+    forward activations — an O(S) residual ring buffer instead of GPipe's
+    O(M) live set (the schedule of Narayanan et al.'s PipeDream-flush /
+    Megatron 1F1B). Backward recomputes the stage forward from the saved
+    input (activation recomputation), so residuals are stage INPUTS only.
+
+    stage_fn(params, x) -> (y, aux_scalar); head_fn(head_params, y, target)
+    -> scalar loss contribution. Gradients are pre-scaled through the
+    cotangents: head calls get `head_cot` (a traced scalar), aux outputs get
+    `aux_cot` — so the returned grads need no further normalisation.
+    Returns (loss_sum [unscaled], aux_sum, dstage_params, dhead_params,
+    dx_per_microbatch)."""
+    S = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    R = 2 * S - 1  # residual ring slots (max in-flight per stage)
+    T = M + 2 * (S - 1)
+
+    f32 = jnp.float32
+
+    def fwd_only(p, x):
+        return stage_fn(p, x)[0]
+
+    def round_(carry, r):
+        (fwd_inbox, bwd_inbox, resid, dparams, dhead, dx_out,
+         loss_acc, aux_acc) = carry
+
+        # ---------------- forward half ----------------
+        mf = r - me
+        f_valid = (mf >= 0) & (mf < M)
+        f_mask = f_valid.astype(f32)
+        feed = microbatches[jnp.clip(mf, 0, M - 1)]
+        x_in = jnp.where(me == 0, feed, fwd_inbox)
+        y, aux = stage_fn(stage_params, x_in)
+        aux_acc = aux_acc + aux.astype(f32) * f_mask
+        # save the stage input for backward recompute; masked read-modify-
+        # write so invalid rounds leave the buffer untouched
+        slot_f = jnp.clip(mf, 0, M - 1) % R
+        old = lax.dynamic_index_in_dim(resid, slot_f, 0, keepdims=False)
+        resid = lax.dynamic_update_index_in_dim(
+            resid, jnp.where(f_valid, x_in, old), slot_f, 0
+        )
+
+        # head at the last stage: loss + dy for this microbatch's backward,
+        # which starts this same round. lax.cond so the (potentially
+        # vocab-sized) head fwd+vjp only executes on the last stage's real
+        # rounds, not S*(M+2S-2) times
+        tgt = targets[jnp.clip(mf, 0, M - 1)]
+        head_on = (me == S - 1) & f_valid
+
+        def do_head(ops):
+            hp, yy = ops
+            loss_mb, vjp_head = jax.vjp(
+                lambda hp_, yy_: head_fn(hp_, yy_, tgt), hp, yy
+            )
+            dhead_mb, dy = vjp_head(head_cot.astype(loss_mb.dtype))
+            return loss_mb.astype(f32), dhead_mb, dy
+
+        def skip_head(ops):
+            hp, yy = ops
+            return (f32(0), jax.tree.map(jnp.zeros_like, hp),
+                    jnp.zeros_like(yy))
+
+        loss_mb, dhead_mb, dy_own = lax.cond(
+            head_on, do_head, skip_head, (head_params, y)
+        )
+        loss_acc = loss_acc + loss_mb  # already zero when head_on is false
+        dhead = _tree_scale_add(dhead, dhead_mb, f32(1))
+
+        # ---------------- backward half ----------------
+        mb_ = r - (2 * S - 2 - me)
+        b_valid = (mb_ >= 0) & (mb_ < M)
+        b_mask = b_valid.astype(f32)
+        dy_in = jnp.where(me == S - 1, dy_own, bwd_inbox)
+        slot_b = jnp.clip(mb_, 0, M - 1) % R
+        x_saved = lax.dynamic_index_in_dim(resid, slot_b, 0, keepdims=False)
+
+        def do_bwd(ops):
+            dy, xs = ops
+            (_, _), vjp_stage = jax.vjp(stage_fn, stage_params, xs)
+            return vjp_stage((dy, f32(aux_cot)))
+
+        def skip_bwd(ops):
+            dy, xs = ops
+            return jax.tree.map(jnp.zeros_like, stage_params), jnp.zeros_like(xs)
+
+        # cond: the recompute+vjp (the schedule's dominant cost) is skipped
+        # on warmup/drain rounds instead of being computed and masked
+        dp_mb, dx = lax.cond(b_valid, do_bwd, skip_bwd, (dy_in, x_saved))
+        dparams = _tree_scale_add(dparams, dp_mb, b_mask)
+        # stage 0's dx is d(embedded input) — recorded for the caller's
+        # embedding gradient
+        is_first = ((me == 0) & b_valid)
+        old_dx = lax.dynamic_index_in_dim(
+            dx_out, jnp.clip(mb_, 0, M - 1), 0, keepdims=False
+        )
+        dx_out = lax.dynamic_update_index_in_dim(
+            dx_out, jnp.where(is_first, dx, old_dx), jnp.clip(mb_, 0, M - 1), 0
+        )
+
+        # ---------------- ring exchanges ----------------
+        fwd_next = lax.ppermute(
+            y, axis_name, [(i, (i + 1) % S) for i in range(S)]
+        )
+        bwd_next = lax.ppermute(
+            dx, axis_name, [(i, (i - 1) % S) for i in range(S)]
+        )
+        return (fwd_next, bwd_next, resid, dparams, dhead, dx_out,
+                loss_acc, aux_acc), None
+
+    carry0 = (
+        jnp.zeros(mb_shape, microbatches.dtype),          # fwd inbox
+        jnp.zeros(mb_shape, microbatches.dtype),          # bwd inbox (dy)
+        jnp.zeros((R,) + mb_shape, microbatches.dtype),   # residual ring
+        jax.tree.map(jnp.zeros_like, stage_params),       # dparams
+        jax.tree.map(jnp.zeros_like, head_params),        # dhead
+        jnp.zeros((M,) + mb_shape, microbatches.dtype),   # dx per microbatch
+        f32(0),                                           # loss sum
+        f32(0),                                           # aux sum
+    )
+    (_, _, _, dparams, dhead, dx_out, loss_acc, aux_acc), _ = lax.scan(
+        round_, carry0, jnp.arange(T)
+    )
+    # losses/head grads live on the last stage, dx on the first — make all
+    # outputs replicated across the pipe axis
+    loss = lax.psum(loss_acc, axis_name)
+    aux = lax.psum(aux_acc, axis_name)
+    dhead = jax.tree.map(lambda g: lax.psum(g, axis_name), dhead)
+    me_f = (me == 0).astype(dx_out.dtype)
+    dx_out = lax.psum(dx_out * me_f, axis_name)
+    return loss, aux, dparams, dhead, dx_out
+
+
+def make_pipeline_1f1b(
+    mesh: Mesh,
+    stage_fn,
+    head_fn,
+    num_microbatches: int,
+    aux_weight: float = 0.0,
+    axis_name: str = "pipe",
+    loss_denom_fn=None,
+):
+    """1F1B pipelined loss + gradients (forward AND backward inside one
+    schedule). Unlike make_pipeline_stacked — whose backward falls out of
+    autodiff and therefore keeps every microbatch's residuals live — this
+    runs the PipeDream-flush schedule with an O(stages) residual buffer and
+    activation recomputation, which is what makes deep-pipeline training
+    fit in HBM at large microbatch counts.
+
+    stage_fn(local_stack, x) -> (y, aux_scalar)
+    head_fn(head_params, y_mb, target_mb) -> per-microbatch loss contribution
+
+    loss_denom_fn(targets) -> scalar D: the head contributions are summed
+    and divided by D. Default D = num_microbatches (right when head_fn
+    returns per-microbatch MEANS). Pass e.g. the global valid-token count
+    (with head_fn returning token SUMS) to weight every token equally
+    regardless of how padding distributes across microbatches.
+
+    apply(stacked_params, head_params, batch, targets) ->
+        (loss, dstacked, dhead, dx[batch])
+    where loss = sum_mb(head) / D + aux_weight * aux_sum / M and the
+    gradients are exactly d loss / d (params, inputs) — scaled through the
+    vjp cotangents, not by post-hoc division (the aux and head terms carry
+    different normalisations).
+    """
+    M = num_microbatches
+
+    def apply(stacked_params: Any, head_params: Any, batch: jax.Array,
+              targets: jax.Array):
+        b = batch.shape[0]
+        if b % M:
+            raise ValueError(f"batch {b} not divisible by {M} microbatches")
+        mb = b // M
+        micro = batch.reshape((M, mb) + batch.shape[1:])
+        micro_t = targets.reshape((M, mb) + targets.shape[1:])
+        denom = (
+            jnp.float32(M) if loss_denom_fn is None
+            else loss_denom_fn(targets).astype(jnp.float32)
+        )
+        head_cot = 1.0 / denom
+
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+        head_specs = jax.tree.map(lambda _: P(), head_params)
+        fn = shard_map(
+            functools.partial(
+                _pipeline_1f1b_local, stage_fn, head_fn, aux_weight / M,
+                axis_name=axis_name,
+            ),
+            mesh=mesh,
+            in_specs=(param_specs, head_specs, P(), P(), P()),
+            out_specs=(P(), P(), param_specs, head_specs, P()),
+            check_vma=False,
+        )
+        loss_sum, aux_sum, dparams, dhead, dx = fn(
+            stacked_params, head_params, micro, micro_t, head_cot
+        )
+        loss = loss_sum * head_cot + aux_weight * aux_sum / M
+        dx = dx.reshape((b,) + dx.shape[2:])
+        return loss, dparams, dhead, dx
+
+    return apply
+
+
 def make_pipeline_stacked(
     mesh: Mesh,
     stage_fn: StageFn,
     num_microbatches: int,
     axis_name: str = "pipe",
+    has_aux: bool = False,
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Pipeline over params whose leading dim is a LAYER stack (n_layers,
     divisible by the pipe-axis size): sharding that dim over `axis_name`
     hands each stage its contiguous run of layers, and `stage_fn(local_stack,
     x)` applies them (typically with lax.scan). This is how the flagship
-    transformer pipelines without re-packing its [n_layers, ...] params."""
+    transformer pipelines without re-packing its [n_layers, ...] params.
 
-    def apply(stacked_params: Any, batch: jax.Array) -> jax.Array:
+    With has_aux, stage_fn returns (y, aux_scalar) per application and
+    apply returns (batch_out, aux_sum)."""
+
+    def apply(stacked_params: Any, batch: jax.Array):
         b = batch.shape[0]
         if b % num_microbatches:
             raise ValueError(
@@ -148,13 +391,16 @@ def make_pipeline_stacked(
         fn = shard_map(
             functools.partial(
                 _pipeline_local, stage_fn, axis_name=axis_name,
-                squeeze_stage_dim=False,
+                squeeze_stage_dim=False, has_aux=has_aux,
             ),
             mesh=mesh,
             in_specs=(param_specs, P()),
-            out_specs=P(),
+            out_specs=(P(), P()) if has_aux else P(),
             check_vma=False,
         )
+        if has_aux:
+            out, aux = fn(stacked_params, micro)
+            return out.reshape((b,) + out.shape[2:]), aux
         out = fn(stacked_params, micro)
         return out.reshape((b,) + out.shape[2:])
 
