@@ -255,6 +255,64 @@ def test_loader_shard_info_and_seed_validation(tmp_path):
         ShardedBatchLoader(_toy_dataset(), 8, 32, seed=-1)
 
 
+def test_seq_sharded_loader_contents():
+    """Sequence shards concatenate bit-for-bit into the unsharded batch,
+    and each shard reads only its slice — the data-plane half of ring/
+    Ulysses SP at context lengths a host can't (or shouldn't) load whole."""
+    ds = _toy_dataset()
+    full = ShardedBatchLoader(ds, 8, 32, seed=7)
+    fx, fy = full.batch_at(5)
+    C = 4
+    shards = [
+        ShardedBatchLoader(ds, 8, 32, seed=7,
+                           seq_shard_index=s, seq_shard_count=C)
+        for s in range(C)
+    ]
+    parts = [sh.batch_at(5) for sh in shards]
+    for s, (px, py) in enumerate(parts):
+        assert px.shape == (8, 8)  # local_seq = 32/4
+        np.testing.assert_array_equal(px, fx[:, s * 8:(s + 1) * 8])
+        np.testing.assert_array_equal(py, fy[:, s * 8:(s + 1) * 8])
+    np.testing.assert_array_equal(
+        np.concatenate([p[0] for p in parts], axis=1), fx
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([p[1] for p in parts], axis=1), fy
+    )
+    # resume state round-trips the seq-shard addressing, and a mismatch is
+    # rejected (it would silently change the stream)
+    st = shards[1].state()
+    shards[1].restore(st)
+    with pytest.raises(ValueError, match="seq_shard_index"):
+        shards[2].restore(st)
+    with pytest.raises(ValueError, match="divisible"):
+        ShardedBatchLoader(ds, 8, 32, seq_shard_count=5)
+
+
+def test_seq_shard_info_from_mesh():
+    """seq_shard_info maps a process's devices to the seq-axis block it
+    should load."""
+    from tony_tpu.parallel import MeshSpec, build_mesh
+    from tony_tpu.data import seq_shard_info
+
+    mesh = build_mesh(MeshSpec(fsdp=1, seq=8))
+    # single process owning everything -> load the full sequence
+    assert seq_shard_info(mesh, 0) == (0, 1)
+    # simulate 4 hosts of 2 devices tiling the seq axis contiguously:
+    # device at seq coord c belongs to process c // 2
+    coord = {id(d): i for i, d in enumerate(mesh.devices.flat)}
+    dp = lambda d: coord[id(d)] // 2
+    assert seq_shard_info(mesh, 0, device_process=dp) == (0, 4)
+    assert seq_shard_info(mesh, 3, device_process=dp) == (3, 4)
+    # interleaved layout (process owns coords {0, 4}) must be rejected
+    dp_bad = lambda d: coord[id(d)] % 4
+    with pytest.raises(ValueError, match="non-contiguous"):
+        seq_shard_info(mesh, 0, device_process=dp_bad)
+    # no seq axis -> no seq sharding
+    dp_mesh = build_mesh(MeshSpec(data=2, fsdp=4))
+    assert seq_shard_info(dp_mesh, 0) == (0, 1)
+
+
 def test_token_file_rejects_future_version(tmp_path):
     p = write_tokens(tmp_path / "v.bin", [1, 2, 3])
     raw = bytearray(p.read_bytes())

@@ -62,6 +62,11 @@ def test_single_worker_passes(tmp_job_dirs, fixture_script):
     )
     assert status == JobStatus.SUCCEEDED, dump_logs(client)
     assert client.task_infos and client.task_infos[0].status == "SUCCEEDED"
+    # per-task log URL is populated and points at the real stdout file
+    # (reference prints container log URLs, util/Utils.java:220-235)
+    url = client.task_infos[0].url
+    assert url.endswith("worker_0.stdout"), url
+    assert Path(url).exists(), url
 
 
 def test_multi_worker_gang_passes(tmp_job_dirs, fixture_script):

@@ -121,6 +121,7 @@ def cmd_history(args) -> int:
 
 
 _last_printed: dict[str, str] = {}
+_url_printed: set = set()
 
 
 def _print_task_updates(infos) -> None:
@@ -128,8 +129,14 @@ def _print_task_updates(infos) -> None:
         prev = _last_printed.get(info.task_id)
         if prev != info.status:
             _last_printed[info.task_id] = info.status
+            # log location once per task, as soon as it is known (reference
+            # Utils.java:220-235 prints each container's log URL)
+            show_url = info.url and info.task_id not in _url_printed
+            if show_url:
+                _url_printed.add(info.task_id)
             print(f"[{time.strftime('%H:%M:%S')}] {info.task_id}: {info.status}"
-                  + (f" @ {info.host}:{info.port}" if info.port > 0 else ""),
+                  + (f" @ {info.host}:{info.port}" if info.port > 0 else "")
+                  + (f" logs: {info.url}" if show_url else ""),
                   file=sys.stderr)
 
 

@@ -82,7 +82,8 @@ class LocalProvisioner(Provisioner):
             cid = f"container_{self._next_id:06d}"
             self._next_id += 1
         log_dir.mkdir(parents=True, exist_ok=True)
-        stdout = open(log_dir / f"{spec.name}_{index}.stdout", "ab")
+        stdout_path = log_dir / f"{spec.name}_{index}.stdout"
+        stdout = open(stdout_path, "ab")
         stderr = open(log_dir / f"{spec.name}_{index}.stderr", "ab")
         full_env = {**os.environ, **env}
         # -S skips site hooks (this environment's sitecustomize imports jax,
@@ -98,6 +99,9 @@ class LocalProvisioner(Provisioner):
         handle = ContainerHandle(
             container_id=cid, host="127.0.0.1", role=spec.name, index=index, process=proc
         )
+        # the log location the driver should advertise for this task — owned
+        # by the provisioner that opened the file, not re-derived elsewhere
+        handle.extra["log_path"] = str(stdout_path)
         with self._lock:
             self._handles[cid] = handle
         threading.Thread(
@@ -181,7 +185,8 @@ class StaticHostProvisioner(Provisioner):
         # where literal braces (${VAR}, awk '{...}') are ordinary syntax
         cmd = self.launch_template.replace("{host}", host).replace("{env}", env_str)
         log_dir.mkdir(parents=True, exist_ok=True)
-        stdout = open(log_dir / f"{spec.name}_{index}.stdout", "ab")
+        stdout_path = log_dir / f"{spec.name}_{index}.stdout"
+        stdout = open(stdout_path, "ab")
         stderr = open(log_dir / f"{spec.name}_{index}.stderr", "ab")
         proc = subprocess.Popen(
             cmd, shell=True, stdout=stdout, stderr=stderr, start_new_session=True
@@ -190,6 +195,7 @@ class StaticHostProvisioner(Provisioner):
             container_id=f"static_{host}_{spec.name}_{index}",
             host=host, role=spec.name, index=index, process=proc,
         )
+        handle.extra["log_path"] = str(stdout_path)
         # register with the inner provisioner so stop_all() reaps the ssh
         # client processes (sshd then tears down the remote session, taking
         # the remote executor with it)

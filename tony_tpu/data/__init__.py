@@ -12,11 +12,12 @@ from .loader import (
     ShardedBatchLoader,
     device_put_sharded_batch,
     loader_shard_info,
+    seq_shard_info,
     sharded_batch_axes,
 )
 
 __all__ = [
     "TokenDataset", "write_tokens",
     "ShardedBatchLoader", "PrefetchLoader", "device_put_sharded_batch",
-    "sharded_batch_axes", "loader_shard_info", "BATCH_AXES",
+    "sharded_batch_axes", "loader_shard_info", "seq_shard_info", "BATCH_AXES",
 ]

@@ -54,6 +54,8 @@ class ShardedBatchLoader:
         process_index: int = 0,
         process_count: int = 1,
         start_step: int = 0,
+        seq_shard_index: int = 0,
+        seq_shard_count: int = 1,
     ):
         if global_batch % process_count != 0:
             raise ValueError(
@@ -62,6 +64,16 @@ class ShardedBatchLoader:
             )
         if seed < 0:
             raise ValueError(f"seed must be non-negative, got {seed}")
+        if seq_len % seq_shard_count != 0:
+            raise ValueError(
+                f"seq_len {seq_len} not divisible by seq_shard_count "
+                f"{seq_shard_count}"
+            )
+        if not 0 <= seq_shard_index < seq_shard_count:
+            raise ValueError(
+                f"seq_shard_index {seq_shard_index} out of range "
+                f"[0, {seq_shard_count})"
+            )
         self.dataset = dataset
         self.global_batch = global_batch
         self.local_batch = global_batch // process_count
@@ -70,6 +82,12 @@ class ShardedBatchLoader:
         self.process_index = process_index
         self.process_count = process_count
         self.step = start_step
+        # sequence sharding (ring/Ulysses SP data plane): this loader reads
+        # only its L/seq_shard_count-token slice of every window — at long
+        # context a host never materializes (or reads) the full sequence
+        self.seq_shard_index = seq_shard_index
+        self.seq_shard_count = seq_shard_count
+        self.local_seq = seq_len // seq_shard_count
 
         self._num_windows = dataset.num_windows(seq_len)
         if self._num_windows < global_batch:
@@ -90,14 +108,21 @@ class ShardedBatchLoader:
 
     def batch_at(self, step: int) -> tuple[np.ndarray, np.ndarray]:
         """The local (inputs, targets) for global step `step`, each
-        [local_batch, seq_len] int32."""
+        [local_batch, seq_len / seq_shard_count] int32.
+
+        With sequence sharding, shard s of window w reads tokens
+        [w*L + s*L/c, w*L + (s+1)*L/c] (one extra token for the shifted
+        targets), which is exactly columns [s*L/c, (s+1)*L/c) of the full
+        window's inputs AND targets — concatenating the shards along the
+        sequence dim reproduces the unsharded batch bit-for-bit."""
         epoch = step // self.steps_per_epoch
         i = step % self.steps_per_epoch
         perm = self._epoch_perm(epoch)
         global_rows = perm[i * self.global_batch:(i + 1) * self.global_batch]
         local_rows = global_rows[self.process_index::self.process_count]
+        off = self.seq_shard_index * self.local_seq
         xs = np.stack([
-            self.dataset.window(int(w) * self.seq_len, self.seq_len + 1)
+            self.dataset.window(int(w) * self.seq_len + off, self.local_seq + 1)
             for w in local_rows
         ])
         return xs[:, :-1].copy(), xs[:, 1:].copy()
@@ -118,13 +143,16 @@ class ShardedBatchLoader:
             "global_batch": self.global_batch, "seq_len": self.seq_len,
             "process_index": self.process_index,
             "process_count": self.process_count,
+            "seq_shard_index": self.seq_shard_index,
+            "seq_shard_count": self.seq_shard_count,
         }
 
     def restore(self, state: dict) -> None:
         # every field that addresses the stream must match, or the resumed
         # run silently trains on a different window sequence
         for field in ("seed", "global_batch", "seq_len",
-                      "process_index", "process_count"):
+                      "process_index", "process_count",
+                      "seq_shard_index", "seq_shard_count"):
             mine = getattr(self, field)
             theirs = int(state.get(field, mine))
             if theirs != mine:
@@ -248,8 +276,59 @@ def loader_shard_info(mesh, process_index: int, process_count: int,
     return 0, 1
 
 
+def seq_shard_info(mesh, process_index: int, rules=None,
+                   device_process=None) -> tuple[int, int]:
+    """(seq_shard_index, seq_shard_count) a ShardedBatchLoader should use
+    for this mesh — the data-plane half of ring/Ulysses sequence
+    parallelism at context lengths where one host cannot hold (or should
+    not read) the full sequence.
+
+    Looks at which coordinates of the ``act_seq`` mesh axis this process's
+    devices occupy: if they span ALL of it (single host, or the seq axis
+    lives within a host), the process must load the full sequence (0, 1);
+    if they occupy a contiguous block, the process loads only that block's
+    slice. Non-contiguous blocks mean the mesh interleaves hosts along seq
+    — reject loudly rather than feed wrong tokens.
+
+    device_process: injectable ``device -> process index`` (tests; defaults
+    to ``d.process_index``)."""
+    import numpy as _np
+
+    # default to the standard `seq` axis (what SP rule tables map act_seq
+    # to); pass rules= when the mesh names it differently
+    seq_axes = mesh_shards_rule(mesh, rules, "act_seq", default=("seq",))
+    if not seq_axes:
+        return 0, 1
+    axis = seq_axes[0]
+    device_process = device_process or (lambda d: d.process_index)
+    names = list(mesh.axis_names)
+    k = names.index(axis)
+    devs = _np.asarray(mesh.devices)
+    # seq coordinates whose device slice contains one of OUR devices
+    mine = [
+        s for s in range(devs.shape[k])
+        if any(device_process(d) == process_index
+               for d in _np.take(devs, s, axis=k).flat)
+    ]
+    size = devs.shape[k]
+    if len(mine) == size:
+        return 0, 1
+    lo, hi = min(mine), max(mine)
+    if (mine != list(range(lo, hi + 1)) or size % len(mine)
+            or lo % len(mine)):
+        # misaligned blocks (e.g. coords [1, 2] of 8) would map to the
+        # wrong shard index and silently feed wrong tokens
+        raise ValueError(
+            f"process {process_index} owns non-contiguous or misaligned seq "
+            f"coordinates {mine} of axis {axis!r} (size {size}); lay the "
+            "mesh out so hosts tile the seq axis in aligned contiguous blocks"
+        )
+    return lo // len(mine), size // len(mine)
+
+
 def device_put_sharded_batch(batch, mesh, batch_axes=BATCH_AXES, rules=None,
-                             sharding=None, global_batch=None):
+                             sharding=None, global_batch=None,
+                             global_seq=None):
     """Place a process-local [local_batch, seq] numpy batch as a global jax
     Array matching the train step's input sharding (multi-host safe: uses
     make_array_from_process_local_data, which is a no-op device_put on a
@@ -269,7 +348,10 @@ def device_put_sharded_batch(batch, mesh, batch_axes=BATCH_AXES, rules=None,
     Pass ``global_batch`` (the TOTAL batch across processes — the loader's
     ``global_batch``) on multi-host jobs: without it JAX must infer the
     global shape from per-host shapes, which double-counts dims where the
-    local data spans the global extent (the replicated-batch seq-mesh case)."""
+    local data spans the global extent (the replicated-batch seq-mesh case).
+    Pass ``global_seq`` (the full sequence length) when each host loaded
+    only its sequence shard (ShardedBatchLoader seq_shard_count > 1), so
+    the global shape reflects the whole sequence."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -288,7 +370,10 @@ def device_put_sharded_batch(batch, mesh, batch_axes=BATCH_AXES, rules=None,
     def place(x):
         gshape = None
         if global_batch is not None:
-            gshape = (global_batch,) + tuple(x.shape[1:])
+            rest = list(x.shape[1:])
+            if global_seq is not None and x.ndim >= 2:
+                rest[0] = global_seq
+            gshape = (global_batch, *rest)
         return jax.make_array_from_process_local_data(
             sharding_for_leaf(x), x, gshape)
 
@@ -297,5 +382,5 @@ def device_put_sharded_batch(batch, mesh, batch_axes=BATCH_AXES, rules=None,
 
 __all__ = [
     "ShardedBatchLoader", "PrefetchLoader", "device_put_sharded_batch",
-    "sharded_batch_axes", "loader_shard_info", "BATCH_AXES",
+    "sharded_batch_axes", "loader_shard_info", "seq_shard_info", "BATCH_AXES",
 ]

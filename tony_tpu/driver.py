@@ -293,10 +293,19 @@ class Driver:
             task.status = TaskStatus.ALLOCATED
             task.container_id = handle.container_id
             task.host = handle.host
+            # per-task log URL, surfaced to the client and portal (reference
+            # prints each container's log URL, util/Utils.java:220-235). The
+            # provisioner that opened the file owns the path; fall back to
+            # the conventional location for provisioners that don't report it
+            task.url = handle.extra.get("log_path") or str(
+                self.job_dir / "logs" / f"{spec.name}_{index}.stdout"
+            )
             self._handles[task.task_id] = handle
             self._launch_ms[task.task_id] = now_ms()
             if self.events:
-                self.events.emit(task_started(task.task_id, handle.host))
+                self.events.emit(
+                    task_started(task.task_id, handle.host, url=task.url)
+                )
             log.info("launched %s as %s on %s", task.task_id,
                      handle.container_id, handle.host)
 

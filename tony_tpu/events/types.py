@@ -52,8 +52,11 @@ def application_finished(app_id: str, status: str, failed_tasks: int,
                   "num_failed_tasks": failed_tasks, "message": message})
 
 
-def task_started(task_id: str, host: str) -> Event:
-    return Event(EventType.TASK_STARTED, {"task_id": task_id, "host": host})
+def task_started(task_id: str, host: str, url: str = "") -> Event:
+    """url: the task's log location (reference prints each container's log
+    URL while monitoring, util/Utils.java:220-235)."""
+    return Event(EventType.TASK_STARTED,
+                 {"task_id": task_id, "host": host, "url": url})
 
 
 def task_finished(task_id: str, status: str, exit_code: int,

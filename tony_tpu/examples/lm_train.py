@@ -104,7 +104,7 @@ def main(argv=None) -> int:
     if args.data:
         from tony_tpu.data import (
             PrefetchLoader, ShardedBatchLoader, TokenDataset,
-            device_put_sharded_batch, loader_shard_info,
+            device_put_sharded_batch, loader_shard_info, seq_shard_info,
         )
 
         from tony_tpu.data.dataset import has_ttpu_magic
@@ -128,18 +128,33 @@ def main(argv=None) -> int:
         if args.eval_every > 0:
             dataset, val_dataset = dataset.split(args.eval_frac)
         # per-process shards when a batch axis is mesh-sharded; on a
-        # seq/tensor-only mesh every host loads the identical full batch
+        # seq/tensor-only mesh every host loads the identical full batch —
+        # EXCEPT along a multi-host seq axis, where each host reads only
+        # its sequence slice (ring/Ulysses long-context data plane)
         pi, pc = loader_shard_info(
             mesh, info["process_id"], info["num_processes"], rules=bundle.rules)
+        si, sc = seq_shard_info(mesh, info["process_id"], rules=bundle.rules)
+        if sc > 1 and pc > 1:
+            # loader_shard_info assumes the batch axes span all processes
+            # (rows p::P), which contradicts a cross-host seq axis — the
+            # row split would misalign with the device layout. Fail loudly
+            # rather than train on silently wrong data.
+            raise SystemExit(
+                "unsupported data layout: batch axes and the seq axis both "
+                "span hosts; put the batch axes within hosts (or drop to a "
+                "seq-only cross-host mesh) for sequence-sharded loading"
+            )
         loader = PrefetchLoader(ShardedBatchLoader(
             dataset, args.batch_size, args.seq_len, seed=args.data_seed,
             process_index=pi, process_count=pc, start_step=start_step,
+            seq_shard_index=si, seq_shard_count=sc,
         ))
         if val_dataset is not None:
             try:
                 val_loader = ShardedBatchLoader(
                     val_dataset, args.batch_size, args.seq_len, seed=0,
                     process_index=pi, process_count=pc,
+                    seq_shard_index=si, seq_shard_count=sc,
                 )
             except ValueError as e:
                 raise SystemExit(
@@ -155,7 +170,7 @@ def main(argv=None) -> int:
             )
         return device_put_sharded_batch(
             next(loader), mesh, sharding=bundle.tok_sharding,
-            global_batch=args.batch_size)
+            global_batch=args.batch_size, global_seq=args.seq_len)
 
     def run_eval(params) -> float:
         """Mean held-out loss over a fixed deterministic batch set."""
@@ -165,7 +180,7 @@ def main(argv=None) -> int:
         for i in range(n):
             vt, vy = device_put_sharded_batch(
                 val_loader.batch_at(i), mesh, sharding=bundle.tok_sharding,
-                global_batch=args.batch_size)
+                global_batch=args.batch_size, global_seq=args.seq_len)
             total += float(bundle.eval_fn(params, vt, vy))
         loss = total / max(n, 1)
         if info["process_id"] == 0:
