@@ -35,6 +35,11 @@ def main(argv=None) -> int:
     parser.add_argument("--n-experts", type=int, default=0)
     parser.add_argument("--dtype", default="bfloat16")
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--remat-policy", default="full",
+                        choices=("full", "dots"),
+                        help="with --remat: 'full' recomputes everything; "
+                             "'dots' saves matmul outputs (less recompute, "
+                             "more memory)")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=50)
     parser.add_argument("--profile-dir", default="")
@@ -73,6 +78,7 @@ def main(argv=None) -> int:
         n_heads=args.n_heads, n_kv_heads=args.n_heads, d_ff=args.d_ff,
         max_seq_len=args.seq_len, n_experts=args.n_experts,
         dtype=getattr(jnp, args.dtype), remat=args.remat,
+        remat_policy=args.remat_policy,
     )
     bundle = train.create_train_step(cfg, mesh, rules=rules)
     params, opt_state = bundle.params, bundle.opt_state

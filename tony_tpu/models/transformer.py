@@ -55,6 +55,11 @@ class TransformerConfig:
     # flash and ref paths (block-pruned O(L*window) in the kernel)
     attn_window: int = 0
     remat: bool = False
+    # remat policy when remat=True: "full" rematerializes everything
+    # (lowest memory, ~1 extra fwd of recompute); "dots" saves matmul
+    # outputs and recomputes only elementwise ops (jax dots_saveable) —
+    # most of full-remat's memory saving at a fraction of its FLOPs cost
+    remat_policy: str = "full"
     # cross-entropy: "dense" materializes [B,L,V] logits; "blockwise" streams
     # the vocab in ce_block_v blocks (ops/cross_entropy.py) so nothing of
     # size [N,V] is ever live; "auto" goes blockwise at vocab >= 16384 unless
@@ -269,7 +274,16 @@ def apply_hidden(
 
     layer_fn = functools.partial(_layer, cfg, mesh)
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        if cfg.remat_policy == "full":
+            policy = None
+        elif cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_saveable
+        else:
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got "
+                f"{cfg.remat_policy!r}"
+            )
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     def scan_body(carry, lp):
         x = carry

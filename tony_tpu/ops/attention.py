@@ -63,6 +63,28 @@ def _attn_mask(qi, bq, j, bk, causal, kv_len, window=None):
     return mask
 
 
+def _n_full_blocks(qi, bq, block_k, hi, causal, kv_len, window):
+    """First kv-block index that needs masking, for q-block qi: blocks in
+    [lo, n_full) are fully visible and run a mask-free loop body; blocks in
+    [n_full, hi) run the masked body. Masked tiles cost ~2x an unmasked
+    tile in VPU passes (iota, compare, where) and most causal tiles are
+    fully below the diagonal, so the static split wins back real kernel
+    time (a runtime cond can't: Mosaic predicates both paths).
+
+    Returns None when the split doesn't apply (sliding window — the band
+    has partial tiles on BOTH edges, handled by the single masked loop)."""
+    if window is not None:
+        return None
+    n_full = hi
+    if causal:
+        # tile j fully visible iff min_row >= max_col:
+        # qi*bq >= (j+1)*block_k - 1
+        n_full = jnp.minimum(n_full, (qi * bq + 1) // block_k)
+    if kv_len is not None:
+        n_full = jnp.minimum(n_full, kv_len // block_k)
+    return n_full
+
+
 def _window_lo(qi, bq, block_k, window):
     """First KV block intersecting q-block qi's window band (traced)."""
     if window is None:
@@ -134,7 +156,10 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
     skip everything outside [row-window, row], so cost is O(L*window)."""
     b_ = pl.program_id(0)
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    # inputs stay in their storage dtype (bf16): the MXU's native mode is
+    # low-precision multiply with f32 accumulation (preferred_element_type);
+    # upcasting before the dot would force ~4x-slower f32 matmul passes
+    q = q_ref[0]                                      # [BQ, D]
     bq, d = q.shape
     nk = k_hbm.shape[1] // block_k
     hi = (
@@ -145,32 +170,51 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
     stream = _Streamer([k_hbm, v_hbm], [k_buf, v_buf], sems, b_, block_k, lo, hi)
     stream.start()
 
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk, v_blk = stream.step(j)
-        s = jax.lax.dot_general(
-            q, k_blk.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                              # [BQ, BK]
-        mask = _attn_mask(qi, bq, j, block_k, causal, kv_len, window)
-        if mask is not None:
-            s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + jax.lax.dot_general(
-            p, v_blk.astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l_new, acc_new
+    def make_body(masked):
+        def body(j, carry):
+            m, l, acc = carry
+            k_blk, v_blk = stream.step(j)
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                  # [BQ, BK] f32
+            mask = (
+                _attn_mask(qi, bq, j, block_k, causal, kv_len, window)
+                if masked else None
+            )
+            if mask is not None:
+                s = jnp.where(mask, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            # rows with no valid column in sight (ragged tails; rows whose
+            # window band starts past the first swept block) must produce
+            # p == 0, which exp(s - m_new) alone can't when m_new is itself
+            # NEG_INF — re-mask p. Plain causal never has such rows (kv
+            # block 0 is fully valid for every row), so it skips the pass.
+            if mask is not None and (window is not None or kv_len is not None):
+                p = jnp.where(mask, p, 0.0)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * corr + jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+        return body
 
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(lo, hi, body, (m0, l0, acc0))
+    carry = (m0, l0, acc0)
+    n_full = _n_full_blocks(qi, bq, block_k, hi, causal, kv_len, window)
+    if n_full is None:
+        carry = jax.lax.fori_loop(lo, hi, make_body(True), carry)
+    else:
+        # mask-free sweep over fully-visible tiles, masked sweep for the rest
+        n_full = jnp.maximum(n_full, lo)
+        carry = jax.lax.fori_loop(lo, n_full, make_body(False), carry)
+        carry = jax.lax.fori_loop(n_full, hi, make_body(True), carry)
+    m, l, acc = carry
     l_safe = jnp.where(l > 0, l, 1.0)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     # lse stored lane-major [1, bq]: a [L, 1] layout pads every row to 128
@@ -187,8 +231,8 @@ def _dq_kernel(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref, dq_ref,
     ds = p * (dO@V^T - delta); dQ = scale * ds @ K."""
     b_ = pl.program_id(0)
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)                   # [BQ, D]
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]                                       # [BQ, D] storage dtype
+    do = do_ref[0]
     lse = lse_ref[0, 0][:, None]                       # [BQ, 1]
     delta = delta_ref[0, 0][:, None]
     bq, d = q.shape
@@ -201,29 +245,39 @@ def _dq_kernel(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref, dq_ref,
     stream = _Streamer([k_hbm, v_hbm], [k_buf, v_buf], sems, b_, block_k, lo, hi)
     stream.start()
 
-    def body(j, dq):
-        k_blk, v_blk = stream.step(j)
-        k_blk = k_blk.astype(jnp.float32)
-        v_blk = v_blk.astype(jnp.float32)
-        s = scale * jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        p = jnp.exp(s - lse)
-        mask = _attn_mask(qi, bq, j, block_k, causal, kv_len, window)
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta)
-        return dq + scale * jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+    def make_body(masked):
+        def body(j, dq):
+            k_blk, v_blk = stream.step(j)
+            # bf16 operands + f32 accumulation (preferred_element_type):
+            # the MXU's native mode — upcasting first costs ~4x in matmuls
+            s = scale * jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            p = jnp.exp(s - lse)
+            if masked:
+                mask = _attn_mask(qi, bq, j, block_k, causal, kv_len, window)
+                if mask is not None:
+                    p = jnp.where(mask, p, 0.0)
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)
+            return dq + scale * jax.lax.dot_general(
+                ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        return body
 
-    dq = jax.lax.fori_loop(lo, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq = jnp.zeros((bq, d), jnp.float32)
+    n_full = _n_full_blocks(qi, bq, block_k, hi, causal, kv_len, window)
+    if n_full is None:
+        dq = jax.lax.fori_loop(lo, hi, make_body(True), dq)
+    else:
+        n_full = jnp.maximum(n_full, lo)
+        dq = jax.lax.fori_loop(lo, n_full, make_body(False), dq)
+        dq = jax.lax.fori_loop(n_full, hi, make_body(True), dq)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
@@ -236,8 +290,8 @@ def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
     Q/dO stream from HBM; lse/delta are 4B/row and ride in VMEM whole."""
     b_ = pl.program_id(0)
     ki = pl.program_id(1)
-    k_blk = k_ref[0].astype(jnp.float32)               # [BK, D]
-    v_blk = v_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0]                                   # [BK, D] storage dtype
+    v_blk = v_ref[0]
     bk, d = k_blk.shape
     nq = q_hbm.shape[1] // block_q
     lo = (ki * bk) // block_q if causal else 0
@@ -251,38 +305,52 @@ def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
     )
     stream.start()
 
-    def body(j, carry):
-        dk, dv = carry
-        q_j, do_j = stream.step(j)
-        q_j = q_j.astype(jnp.float32)
-        do_j = do_j.astype(jnp.float32)
-        lse_j = lse_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]   # [BQ, 1]
-        delta_j = delta_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]
-        s = scale * jax.lax.dot_general(
-            q_j, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                              # [BQ, BK]
-        p = jnp.exp(s - lse_j)
-        if causal:
-            p = jnp.where(_causal_mask(j, block_q, ki, bk, window), p, 0.0)
-        dv_new = dv + jax.lax.dot_general(
-            p, do_j, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            do_j, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta_j)
-        dk_new = dk + scale * jax.lax.dot_general(
-            ds, q_j, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dk_new, dv_new
+    def make_body(masked):
+        def body(j, carry):
+            dk, dv = carry
+            q_j, do_j = stream.step(j)
+            lse_j = lse_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]   # [BQ, 1]
+            delta_j = delta_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]
+            s = scale * jax.lax.dot_general(
+                q_j, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                          # [BQ, BK] f32
+            p = jnp.exp(s - lse_j)
+            if masked and causal:
+                p = jnp.where(_causal_mask(j, block_q, ki, bk, window), p, 0.0)
+            dv_new = dv + jax.lax.dot_general(
+                p.astype(do_j.dtype), do_j, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do_j, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_j)
+            dk_new = dk + scale * jax.lax.dot_general(
+                ds.astype(q_j.dtype), q_j, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk_new, dv_new
+        return body
 
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, hi, body, (dk0, dv0))
+    carry = (dk0, dv0)
+    if not causal:
+        dk, dv = jax.lax.fori_loop(lo, hi, make_body(False), carry)
+    elif window is not None:
+        # band-pruned sweep: partial tiles on both edges, single masked loop
+        dk, dv = jax.lax.fori_loop(lo, hi, make_body(True), carry)
+    else:
+        # roles swapped vs the fwd/dq sweeps: rows are q blocks (j), cols
+        # this kv block (ki). Masked (diagonal) tiles come FIRST in the
+        # sweep; q blocks past the diagonal see the whole kv block.
+        m_end = jnp.minimum(
+            hi, -(-((ki + 1) * bk - 1) // block_q)  # ceil division
+        )
+        carry = jax.lax.fori_loop(lo, m_end, make_body(True), carry)
+        dk, dv = jax.lax.fori_loop(m_end, hi, make_body(False), carry)
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
