@@ -204,12 +204,54 @@ def bench_flash_vs_xla(seq_lens=(2048, 4096), iters: int = 64, reps: int = 3) ->
     return out
 
 
+def bench_decode(batch: int = 8, prompt_len: int = 128,
+                 new_tokens: int = 256, reps: int = 3) -> dict:
+    """KV-cache autoregressive decode throughput on the flagship model
+    (greedy; the whole prefill+scan loop is one jit, timed with a hard
+    sync, so tunnel dispatch latency amortizes over all decode steps)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models import transformer
+    from tony_tpu.models.generate import generate
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=32768, d_model=1024, n_layers=12, n_heads=8, n_kv_heads=8,
+        d_ff=4096, max_seq_len=prompt_len + new_tokens,
+        dtype=jnp.bfloat16, attn_impl="auto",
+    )
+    params = jax.jit(lambda k: transformer.init(k, cfg))(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
+    )
+    run = jax.jit(
+        lambda params, prompt: generate(params, cfg, prompt, new_tokens)
+    )
+    int(run(params, prompt)[0, 0])  # compile + warm
+    times = []
+    for _ in range(reps):
+        t0 = time.time()
+        out = run(params, prompt)
+        int(out[0, 0])  # hard sync
+        times.append(time.time() - t0)
+    dt = statistics.median(times)
+    return {
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "wall_s_median": round(dt, 3),
+        "decode_tokens_per_sec": round(batch * new_tokens / dt, 1),
+        "per_sequence_tokens_per_sec": round(new_tokens / dt, 1),
+    }
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--out", default=str(REPO / "PERF.json"))
     parser.add_argument("--skip-attn", action="store_true")
+    parser.add_argument("--skip-decode", action="store_true")
     args = parser.parse_args()
 
     perf = {"train": bench_train(args.steps, args.batch)}
@@ -219,13 +261,19 @@ def main() -> int:
     from tony_tpu.metrics import sample_tpu_metrics
 
     perf["tpu_metrics_sampled"] = sample_tpu_metrics()
+    try:
+        prior = json.loads(Path(args.out).read_text())
+    except (OSError, ValueError):
+        prior = {}  # absent or corrupt (e.g. a prior run killed mid-write)
+    # skipped sections keep their values from a prior full run
     if not args.skip_attn:
         perf["flash_vs_xla_fwd_bwd"] = bench_flash_vs_xla()
-    elif Path(args.out).exists():
-        # keep the attention section from a prior full run
-        prior = json.loads(Path(args.out).read_text())
-        if "flash_vs_xla_fwd_bwd" in prior:
-            perf["flash_vs_xla_fwd_bwd"] = prior["flash_vs_xla_fwd_bwd"]
+    elif "flash_vs_xla_fwd_bwd" in prior:
+        perf["flash_vs_xla_fwd_bwd"] = prior["flash_vs_xla_fwd_bwd"]
+    if not args.skip_decode:
+        perf["kv_cache_decode"] = bench_decode()
+    elif "kv_cache_decode" in prior:
+        perf["kv_cache_decode"] = prior["kv_cache_decode"]
 
     Path(args.out).write_text(json.dumps(perf, indent=2) + "\n")
     t = perf["train"]
