@@ -224,14 +224,12 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
     )
-    run = jax.jit(
-        lambda params, prompt: generate(params, cfg, prompt, new_tokens)
-    )
-    int(run(params, prompt)[0, 0])  # compile + warm
+    # generate is itself jitted (static cfg/max_new_tokens)
+    int(generate(params, cfg, prompt, new_tokens)[0, 0])  # compile + warm
     times = []
     for _ in range(reps):
         t0 = time.time()
-        out = run(params, prompt)
+        out = generate(params, cfg, prompt, new_tokens)
         int(out[0, 0])  # hard sync
         times.append(time.time() - t0)
     dt = statistics.median(times)
@@ -271,7 +269,7 @@ def main() -> int:
     elif "flash_vs_xla_fwd_bwd" in prior:
         perf["flash_vs_xla_fwd_bwd"] = prior["flash_vs_xla_fwd_bwd"]
     if not args.skip_decode:
-        perf["kv_cache_decode"] = bench_decode()
+        perf["kv_cache_decode"] = bench_decode(batch=args.batch)
     elif "kv_cache_decode" in prior:
         perf["kv_cache_decode"] = prior["kv_cache_decode"]
 

@@ -186,6 +186,51 @@ def test_e2e_ssh_launch_seam_with_localization(tmp_job_dirs, tmp_path):
         assert f"localized OK: {local_base / client.app_id}" in out, _logs(client)
 
 
+def test_static_template_kill_cascade(tmp_path):
+    """stop_container on a template-launched handle must take down the whole
+    process group — the template's shell AND whatever it exec'd (for real
+    ssh: the ssh client, whose teardown reaps the remote session)."""
+    import os
+    import time
+
+    from tony_tpu.cluster.provisioner import StaticHostProvisioner
+    from tony_tpu.conf import RoleSpec
+
+    pidfile = tmp_path / "pid"
+    prov = StaticHostProvisioner(
+        ["h"],
+        launch_template=(
+            "env {env} bash -c 'echo $$ > " + str(pidfile) + "; exec sleep 300'"
+        ),
+    )
+    handle = prov.launch(
+        RoleSpec(name="worker", instances=1), 0, {"TONY_T": "x"},
+        tmp_path / "logs",
+    )
+    deadline = time.time() + 10
+    content = ""
+    while time.time() < deadline:
+        # the shell creates the file before writing the pid — wait for the
+        # content, not just existence
+        content = pidfile.read_text().strip() if pidfile.exists() else ""
+        if content:
+            break
+        time.sleep(0.05)
+    assert content, "template launch never wrote its pid"
+    pid = int(content)
+    os.kill(pid, 0)  # alive
+    prov.stop_container(handle)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+            time.sleep(0.1)
+        except ProcessLookupError:
+            break
+    with pytest.raises(ProcessLookupError):
+        os.kill(pid, 0)
+
+
 def test_e2e_ssh_template_env_quoting_survives_spaces(tmp_job_dirs, tmp_path):
     """Values with spaces (the task command itself) must survive the
     template's {env} substitution through a real shell."""
