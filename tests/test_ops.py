@@ -1,4 +1,9 @@
-"""Pallas op tests (interpret mode on CPU): flash attention vs reference."""
+"""Pallas op tests (interpret mode on CPU): flash attention vs reference.
+
+Every flash test runs TWICE via the autouse `attn_path` fixture: once on
+the VMEM-resident kernels (the default at CI-sized L) and once with
+RESIDENT_MAX_L forced to 0 so the streaming-DMA kernels — the L > 2048
+long-context path — keep full coverage."""
 
 import jax
 import jax.numpy as jnp
@@ -7,6 +12,22 @@ import pytest
 
 from tony_tpu.ops import flash_attention, attention_blhd
 from tony_tpu.parallel import reference_attention
+
+
+@pytest.fixture(params=["resident", "streaming"], autouse=True)
+def attn_path(request, monkeypatch):
+    if request.param == "streaming":
+        import tony_tpu.ops.attention as A
+
+        monkeypatch.setattr(A, "RESIDENT_MAX_L", 0)
+        # _flash_fwd/_flash_bwd are jitted and the dispatch reads the
+        # module global at TRACE time — stale cache entries would silently
+        # run the other path, so retrace everything on entry and exit
+        jax.clear_caches()
+        yield request.param
+        jax.clear_caches()
+    else:
+        yield request.param
 
 
 def _ref_bhld(q, k, v, causal):
@@ -164,8 +185,10 @@ def test_blockwise_ce_bfloat16_inputs():
 def test_flash_multi_qblock_paths_small_blocks():
     """Force nq>1 and nk>1 with explicit 128-row blocks (the default
     BLOCK_Q=512 makes every CI-sized sequence a single block, which would
-    leave the qi>0 causal pruning, the _dkv diagonal-down lo start, and the
-    double-buffer slot rotation untested)."""
+    leave the qi>0 causal pruning untested). Under attn_path='streaming'
+    this also exercises the _dkv diagonal-down lo start and the
+    double-buffer slot rotation; under 'resident' the static tile
+    classification."""
     from tony_tpu.ops.attention import _flash_bwd, _flash_fwd
 
     keys = jax.random.split(jax.random.PRNGKey(11), 4)

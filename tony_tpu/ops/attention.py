@@ -146,6 +146,78 @@ class _Streamer:
         return [buf[slot] for buf in self._bufs]
 
 
+# ------------------------------------------------------------ shared tiles
+# The numerically delicate per-tile math lives ONCE here and serves both
+# kernel families (streaming and VMEM-resident): a fix in the rescale or
+# masking logic cannot diverge between paths.
+
+def _fwd_tile_update(q, k_blk, v_blk, carry, scale, mask, remask):
+    """One online-softmax tile: carry = (m, l, acc) f32 running state.
+    Operands stay in storage dtype (bf16) into the MXU with f32
+    accumulation — upcasting first costs ~4x in matmul passes."""
+    m, l, acc = carry
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                          # [BQ, BK] f32
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    # rows with no valid column in sight (ragged tails; rows whose window
+    # band starts past the first swept block) must produce p == 0, which
+    # exp(s - m_new) alone can't when m_new is itself NEG_INF — re-mask p.
+    # Plain causal never has such rows (kv block 0 is fully valid for every
+    # row), so its callers pass remask=False and skip the pass.
+    if mask is not None and remask:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * corr + jax.lax.dot_general(
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc_new
+
+
+def _bwd_tile(q_j, do_j, k_blk, v_blk, lse_j, delta_j, scale, mask,
+              want_dq=True, want_dkv=True):
+    """One backward tile: recompute p = exp(s - lse), ds = p*(dO V^T - delta),
+    emitting only the requested gradient pieces so each kernel pays exactly
+    its own matmuls. Returns (dq_inc, dk_inc, dv_inc), None where unwanted."""
+    s = scale * jax.lax.dot_general(
+        q_j, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    p = jnp.exp(s - lse_j)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    dv_inc = None
+    if want_dkv:
+        dv_inc = jax.lax.dot_general(
+            p.astype(do_j.dtype), do_j, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    dp = jax.lax.dot_general(
+        do_j, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = (p * (dp - delta_j)).astype(q_j.dtype)
+    dk_inc = None
+    if want_dkv:
+        dk_inc = scale * jax.lax.dot_general(
+            ds, q_j, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    dq_inc = None
+    if want_dq:
+        dq_inc = scale * jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    return dq_inc, dk_inc, dv_inc
+
+
 # ------------------------------------------------------------------ forward
 
 def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
@@ -170,36 +242,16 @@ def _fwd_kernel(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_buf, v_buf, sems,
     stream = _Streamer([k_hbm, v_hbm], [k_buf, v_buf], sems, b_, block_k, lo, hi)
     stream.start()
 
+    remask = window is not None or kv_len is not None
+
     def make_body(masked):
         def body(j, carry):
-            m, l, acc = carry
             k_blk, v_blk = stream.step(j)
-            s = jax.lax.dot_general(
-                q, k_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale                                  # [BQ, BK] f32
             mask = (
                 _attn_mask(qi, bq, j, block_k, causal, kv_len, window)
                 if masked else None
             )
-            if mask is not None:
-                s = jnp.where(mask, s, NEG_INF)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            # rows with no valid column in sight (ragged tails; rows whose
-            # window band starts past the first swept block) must produce
-            # p == 0, which exp(s - m_new) alone can't when m_new is itself
-            # NEG_INF — re-mask p. Plain causal never has such rows (kv
-            # block 0 is fully valid for every row), so it skips the pass.
-            if mask is not None and (window is not None or kv_len is not None):
-                p = jnp.where(mask, p, 0.0)
-            corr = jnp.exp(m - m_new)
-            l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-            acc_new = acc * corr + jax.lax.dot_general(
-                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return m_new, l_new, acc_new
+            return _fwd_tile_update(q, k_blk, v_blk, carry, scale, mask, remask)
         return body
 
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
@@ -248,26 +300,14 @@ def _dq_kernel(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref, dq_ref,
     def make_body(masked):
         def body(j, dq):
             k_blk, v_blk = stream.step(j)
-            # bf16 operands + f32 accumulation (preferred_element_type):
-            # the MXU's native mode — upcasting first costs ~4x in matmuls
-            s = scale * jax.lax.dot_general(
-                q, k_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
+            mask = (
+                _attn_mask(qi, bq, j, block_k, causal, kv_len, window)
+                if masked else None
             )
-            p = jnp.exp(s - lse)
-            if masked:
-                mask = _attn_mask(qi, bq, j, block_k, causal, kv_len, window)
-                if mask is not None:
-                    p = jnp.where(mask, p, 0.0)
-            dp = jax.lax.dot_general(
-                do, v_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
+            dq_inc, _, _ = _bwd_tile(
+                q, do, k_blk, v_blk, lse, delta, scale, mask, want_dkv=False
             )
-            ds = p * (dp - delta)
-            return dq + scale * jax.lax.dot_general(
-                ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
+            return dq + dq_inc
         return body
 
     dq = jnp.zeros((bq, d), jnp.float32)
@@ -311,27 +351,15 @@ def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
             q_j, do_j = stream.step(j)
             lse_j = lse_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]   # [BQ, 1]
             delta_j = delta_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]
-            s = scale * jax.lax.dot_general(
-                q_j, k_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )                                          # [BQ, BK] f32
-            p = jnp.exp(s - lse_j)
-            if masked and causal:
-                p = jnp.where(_causal_mask(j, block_q, ki, bk, window), p, 0.0)
-            dv_new = dv + jax.lax.dot_general(
-                p.astype(do_j.dtype), do_j, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
+            mask = (
+                _causal_mask(j, block_q, ki, bk, window)
+                if masked and causal else None
             )
-            dp = jax.lax.dot_general(
-                do_j, v_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
+            _, dk_inc, dv_inc = _bwd_tile(
+                q_j, do_j, k_blk, v_blk, lse_j, delta_j, scale, mask,
+                want_dq=False,
             )
-            ds = p * (dp - delta_j)
-            dk_new = dk + scale * jax.lax.dot_general(
-                ds.astype(q_j.dtype), q_j, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return dk_new, dv_new
+            return dk + dk_inc, dv + dv_inc
         return body
 
     dk0 = jnp.zeros((bk, d), jnp.float32)
@@ -353,6 +381,132 @@ def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
         dk, dv = jax.lax.fori_loop(m_end, hi, make_body(False), carry)
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------- VMEM-resident kernels
+#
+# At L <= RESIDENT_MAX_L (and D <= 128) one (batch, head)'s whole q/k/v/o —
+# plus the f32 dq accumulator in the backward — fits VMEM, so the kernel
+# needs NO per-block DMA choreography at all: grid (B*H,), Pallas pipelines
+# whole [L, D] blocks between grid steps, and the tile loops are plain
+# Python loops over static slices (every causal/ragged/window decision is
+# resolved at trace time — full tiles compile with zero masking code).
+# The backward is additionally FUSED: one sweep computes dK, dV and dQ,
+# recomputing scores/exp once per tile instead of once in each of the
+# dq/dkv kernels. Longer sequences fall back to the streaming kernels
+# above, which keep O(block) VMEM.
+
+# 2048: at 4096 the fully-unrolled tile loops blow Mosaic's scoped-VMEM
+# stack (~40MB of live temporaries vs the 16MB budget)
+RESIDENT_MAX_L = 2048
+
+
+def _static_tile_kind(qi, bq, j, bk, causal, kv_len, window):
+    """Python-level (static) classification of tile (qi, j): 'skip' (fully
+    masked — don't emit code), 'full' (no mask), or 'partial'."""
+    row_lo, row_hi = qi * bq, (qi + 1) * bq - 1
+    col_lo, col_hi = j * bk, (j + 1) * bk - 1
+    if causal and col_lo > row_hi:
+        return "skip"
+    if window is not None and col_hi < row_lo - window + 1:
+        return "skip"
+    if kv_len is not None and col_lo >= kv_len:
+        return "skip"
+    full = True
+    if causal and col_hi > row_lo:
+        full = False
+    if window is not None and col_lo < row_hi - window + 1:
+        full = False
+    if kv_len is not None and col_hi >= kv_len:
+        full = False
+    return "full" if full else "partial"
+
+
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                         *, scale, causal, block_q, block_k,
+                         kv_len=None, window=None):
+    """One (batch*head) program: everything VMEM-resident, static tile loops."""
+    lq, d = q_ref.shape[1], q_ref.shape[2]
+    lk = k_ref.shape[1]
+    nq, nk = lq // block_q, lk // block_k
+
+    remask = window is not None or kv_len is not None
+    for qi in range(nq):
+        q = q_ref[0, qi * block_q:(qi + 1) * block_q, :]
+        carry = (
+            jnp.full((block_q, 1), NEG_INF, jnp.float32),
+            jnp.zeros((block_q, 1), jnp.float32),
+            jnp.zeros((block_q, d), jnp.float32),
+        )
+        for j in range(nk):
+            kind = _static_tile_kind(
+                qi, block_q, j, block_k, causal, kv_len, window
+            )
+            if kind == "skip":
+                continue
+            k_blk = k_ref[0, j * block_k:(j + 1) * block_k, :]
+            v_blk = v_ref[0, j * block_k:(j + 1) * block_k, :]
+            mask = (
+                _attn_mask(qi, block_q, j, block_k, causal, kv_len, window)
+                if kind == "partial" else None
+            )
+            carry = _fwd_tile_update(q, k_blk, v_blk, carry, scale, mask,
+                                     remask)
+        m, l, acc = carry
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0, qi * block_q:(qi + 1) * block_q, :] = (
+            (acc / l_safe).astype(o_ref.dtype)
+        )
+        lse_ref[0, 0, qi * block_q:(qi + 1) * block_q] = jnp.where(
+            l[:, 0] > 0, m[:, 0] + jnp.log(l_safe[:, 0]), NEG_INF
+        )
+
+
+def _bwd_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dk_ref, dv_ref,
+                         *, scale, causal, block_q, block_k,
+                         kv_len=None, window=None):
+    """Fused dQ/dK/dV for one (batch*head): a single sweep recomputes each
+    tile's scores/exp ONCE (the split dq/dkv kernels do it twice) and
+    accumulates dQ in the f32 VMEM output ref across kv blocks."""
+    lq, d = q_ref.shape[1], q_ref.shape[2]
+    lk = k_ref.shape[1]
+    nq, nk = lq // block_q, lk // block_k
+
+    dq_ref[0] = jnp.zeros((lq, d), dq_ref.dtype)
+    for ki in range(nk):
+        k_blk = k_ref[0, ki * block_k:(ki + 1) * block_k, :]
+        v_blk = v_ref[0, ki * block_k:(ki + 1) * block_k, :]
+        dk = jnp.zeros((block_k, d), jnp.float32)
+        dv = jnp.zeros((block_k, d), jnp.float32)
+        for j in range(nq):
+            kind = _static_tile_kind(
+                j, block_q, ki, block_k, causal, kv_len, window
+            )
+            if kind == "skip":
+                continue
+            sl = slice(j * block_q, (j + 1) * block_q)
+            q_j = q_ref[0, sl, :]
+            do_j = do_ref[0, sl, :]
+            lse_j = lse_ref[0, 0, sl][:, None]
+            delta_j = delta_ref[0, 0, sl][:, None]
+            mask = (
+                _attn_mask(j, block_q, ki, block_k, causal, kv_len, window)
+                if kind == "partial" else None
+            )
+            dq_inc, dk_inc, dv_inc = _bwd_tile(
+                q_j, do_j, k_blk, v_blk, lse_j, delta_j, scale, mask
+            )
+            dk = dk + dk_inc
+            dv = dv + dv_inc
+            dq_ref[0, sl, :] += dq_inc.astype(dq_ref.dtype)
+        dk_ref[0, ki * block_k:(ki + 1) * block_k, :] = dk.astype(dk_ref.dtype)
+        dv_ref[0, ki * block_k:(ki + 1) * block_k, :] = dv.astype(dv_ref.dtype)
+
+
+def _use_resident(lq, lk, d):
+    """Whole-sequence VMEM residency budget (see section comment)."""
+    return lq <= RESIDENT_MAX_L and lk <= RESIDENT_MAX_L and d <= 128
 
 
 # ----------------------------------------------------------------- plumbing
@@ -407,30 +561,54 @@ def _flash_fwd(q, k, v, causal, scale, block_q=BLOCK_Q, block_k=BLOCK_K,
     vf = vp.reshape(bh, vp.shape[2], d)
     nq = qf.shape[1] // block_q
 
-    out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block_k, kv_len=kv_len, window=window),
-        grid=(bh, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b_, i: (b_, i, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),   # K stays in HBM, DMA'd
-            pl.BlockSpec(memory_space=pl.ANY),   # V stays in HBM, DMA'd
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b_, i: (b_, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, i: (b_, 0, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(qf.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, qf.shape[1]), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((2, block_k, d), k.dtype),
-            pltpu.VMEM((2, block_k, d), v.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
-        interpret=interpret,
-    )(qf, kf, vf)
+    if _use_resident(qf.shape[1], kf.shape[1], d):
+        out, lse = pl.pallas_call(
+            functools.partial(
+                _fwd_kernel_resident, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, kv_len=kv_len,
+                window=window,
+            ),
+            grid=(bh,),
+            in_specs=[
+                pl.BlockSpec((1, qf.shape[1], d), lambda b_: (b_, 0, 0)),
+                pl.BlockSpec((1, kf.shape[1], d), lambda b_: (b_, 0, 0)),
+                pl.BlockSpec((1, kf.shape[1], d), lambda b_: (b_, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, qf.shape[1], d), lambda b_: (b_, 0, 0)),
+                pl.BlockSpec((1, 1, qf.shape[1]), lambda b_: (b_, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(qf.shape, q.dtype),
+                jax.ShapeDtypeStruct((bh, 1, qf.shape[1]), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qf, kf, vf)
+    else:
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                              block_k=block_k, kv_len=kv_len, window=window),
+            grid=(bh, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),   # K stays in HBM, DMA'd
+                pl.BlockSpec(memory_space=pl.ANY),   # V stays in HBM, DMA'd
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b_, i: (b_, 0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(qf.shape, q.dtype),
+                jax.ShapeDtypeStruct((bh, 1, qf.shape[1]), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, block_k, d), k.dtype),
+                pltpu.VMEM((2, block_k, d), v.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+            interpret=interpret,
+        )(qf, kf, vf)
     out = out.reshape(b, h, qf.shape[1], d)[:, :, :lq, :]
     lse = lse.reshape(b, h, qf.shape[1])[:, :, :lq]
     return out, lse
@@ -479,6 +657,42 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale,
 
     nq = lqp // block_q
     nk = lkp // block_k
+
+    if _use_resident(lqp, lkp, d):
+        # fused resident backward: dq accumulates in f32 (the in-ref
+        # accumulation across kv blocks must not round in bf16)
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_kernel_resident, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, kv_len=kv_len,
+                window=window,
+            ),
+            grid=(bh,),
+            in_specs=[
+                pl.BlockSpec((1, lqp, d), lambda b_: (b_, 0, 0)),
+                pl.BlockSpec((1, lkp, d), lambda b_: (b_, 0, 0)),
+                pl.BlockSpec((1, lkp, d), lambda b_: (b_, 0, 0)),
+                pl.BlockSpec((1, lqp, d), lambda b_: (b_, 0, 0)),
+                pl.BlockSpec((1, 1, lqp), lambda b_: (b_, 0, 0)),
+                pl.BlockSpec((1, 1, lqp), lambda b_: (b_, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, lqp, d), lambda b_: (b_, 0, 0)),
+                pl.BlockSpec((1, lkp, d), lambda b_: (b_, 0, 0)),
+                pl.BlockSpec((1, lkp, d), lambda b_: (b_, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, lqp, d), jnp.float32),
+                jax.ShapeDtypeStruct(kf.shape, k.dtype),
+                jax.ShapeDtypeStruct(vf.shape, v.dtype),
+            ],
+            interpret=interpret,
+        )(qf, kf, vf, gf, lsef, deltaf)
+        dq = dq.astype(q.dtype)
+        dq = dq.reshape(b, h, lqp, d)[:, :, :lq, :]
+        dk = dk.reshape(b, h, lkp, d)[:, :, :lk, :]
+        dv = dv.reshape(b, h, lkp, d)[:, :, :lk, :]
+        return dq, dk, dv
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
