@@ -71,51 +71,15 @@ def train_flops_per_token(cfg) -> float:
 
 def bench_train(steps: int, batch: int) -> dict:
     import jax
-    import jax.numpy as jnp
+    cfg, timing, n_params = _timed_train_run(seq_len=2048, batch=batch,
+                                             steps=steps)
+    import jax
 
-    from tony_tpu.models import transformer
-    from tony_tpu.parallel import MeshSpec, build_mesh
-    from tony_tpu.train import create_train_step, synthetic_lm_batch
-
-    cfg = transformer.TransformerConfig(
-        vocab_size=32768, d_model=1024, n_layers=12, n_heads=8, n_kv_heads=8,
-        d_ff=4096, max_seq_len=2048, dtype=jnp.bfloat16, attn_impl="auto",
-        remat=True,
-    )
-    mesh = build_mesh(MeshSpec(data=-1, fsdp=1))
-    bundle = create_train_step(cfg, mesh)
-    tokens, targets = synthetic_lm_batch(
-        jax.random.PRNGKey(0), batch, cfg.max_seq_len, cfg.vocab_size
-    )
-    tokens = jax.device_put(tokens, bundle.tok_sharding)
-    targets = jax.device_put(targets, bundle.tok_sharding)
-
-    params, opt_state = bundle.params, bundle.opt_state
-    t0 = time.time()
-    params, opt_state, m = bundle.step_fn(params, opt_state, tokens, targets)
-    float(m["loss"])  # hard sync (device->host transfer)
-    compile_s = time.time() - t0
-
-    # window timing: dispatch `steps` steps asynchronously per window, one
-    # hard sync at the end — amortizes the host<->device round-trip (which
-    # on a tunneled accelerator is ~100ms per blocked call) over the window
-    windows = 4
-    times = []
-    for _ in range(windows):
-        t0 = time.time()
-        for _ in range(steps):
-            params, opt_state, m = bundle.step_fn(
-                params, opt_state, tokens, targets
-            )
-        float(m["loss"])
-        times.append((time.time() - t0) / steps)
-
-    step_s = statistics.median(times)
+    step_s = timing["step_s"]
     toks = batch * cfg.max_seq_len
     fpt = train_flops_per_token(cfg)
     chip, peak = chip_peak_flops()
     n_chips = jax.device_count()
-    n_params = transformer.num_params(params)
     return {
         "model": {
             "d_model": cfg.d_model, "n_layers": cfg.n_layers,
@@ -126,8 +90,8 @@ def bench_train(steps: int, batch: int) -> dict:
         "batch": batch,
         "tokens_per_step": toks,
         "step_time_s_median": round(step_s, 4),
-        "step_times_s": [round(t, 4) for t in times],
-        "compile_plus_first_step_s": round(compile_s, 1),
+        "step_times_s": [round(t, 4) for t in timing["window_times"]],
+        "compile_plus_first_step_s": round(timing["compile_s"], 1),
         "n_chips": n_chips,
         "tokens_per_sec_per_chip": round(toks / step_s / n_chips, 1),
         "model_tflops_per_sec_per_chip": round(
@@ -137,8 +101,66 @@ def bench_train(steps: int, batch: int) -> dict:
         "chip": chip,
         "peak_bf16_tflops_per_chip": peak / 1e12 if peak else None,
         "mfu": round(fpt * toks / step_s / (peak * n_chips), 4) if peak else None,
-        "loss_finite": bool(jax.numpy.isfinite(m["loss"])),
+        "loss_finite": timing["loss_finite"],
     }
+
+
+def _timed_train_run(seq_len: int, batch: int, steps: int, windows: int = 4):
+    """Build the flagship config at `seq_len`, train `windows` timed windows
+    of `steps` steps each, and return (cfg, timing, n_params). One timing
+    methodology for every train bench: window timing dispatches the steps
+    asynchronously with one hard sync per window, amortizing the
+    host<->device round-trip (~100ms per blocked call on a tunneled
+    accelerator); median over windows rejects transient stalls. Frees the
+    run's device state before returning so sequential runs don't stack in
+    HBM."""
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models import transformer
+    from tony_tpu.parallel import MeshSpec, build_mesh
+    from tony_tpu.train import create_train_step, synthetic_lm_batch
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=32768, d_model=1024, n_layers=12, n_heads=8, n_kv_heads=8,
+        d_ff=4096, max_seq_len=seq_len, dtype=jnp.bfloat16, attn_impl="auto",
+        remat=True,
+    )
+    mesh = build_mesh(MeshSpec(data=-1, fsdp=1))
+    bundle = create_train_step(cfg, mesh)
+    tokens, targets = synthetic_lm_batch(
+        jax.random.PRNGKey(0), batch, seq_len, cfg.vocab_size
+    )
+    tokens = jax.device_put(tokens, bundle.tok_sharding)
+    targets = jax.device_put(targets, bundle.tok_sharding)
+
+    params, opt_state = bundle.params, bundle.opt_state
+    n_params = transformer.num_params(params)
+    t0 = time.time()
+    params, opt_state, m = bundle.step_fn(params, opt_state, tokens, targets)
+    float(m["loss"])  # hard sync (device->host transfer)
+    compile_s = time.time() - t0
+
+    times = []
+    for _ in range(windows):
+        t0 = time.time()
+        for _ in range(steps):
+            params, opt_state, m = bundle.step_fn(
+                params, opt_state, tokens, targets
+            )
+        float(m["loss"])
+        times.append((time.time() - t0) / steps)
+
+    timing = {
+        "step_s": statistics.median(times),
+        "window_times": times,
+        "compile_s": compile_s,
+        "loss_finite": bool(jnp.isfinite(m["loss"])),
+    }
+    # drop device references so the next sequence length's model doesn't
+    # coexist with this one in HBM
+    del bundle, params, opt_state, tokens, targets, m
+    return cfg, timing, n_params
 
 
 def bench_flash_vs_xla(seq_lens=(2048, 4096), iters: int = 64, reps: int = 3) -> dict:
@@ -243,6 +265,49 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
     }
 
 
+# constant token budget per step across the long-context sweep, so MFU and
+# tokens/s are comparable between sequence lengths
+TOKENS_PER_STEP = 16384
+
+
+def bench_long_context(seq_lens=(8192, 16384), steps: int = 4,
+                       prior: dict | None = None) -> dict:
+    """Train the flagship at long context on one chip — constant tokens/step
+    (batch shrinks as L grows), remat on, streaming flash kernels. The
+    point: quadratic-attention MFU holds up and HBM doesn't blow. A length
+    that fails (e.g. transient OOM) records the error but keeps that key's
+    previously recorded numbers from `prior` alongside, so one bad rerun
+    can't silently erase the artifact's history."""
+    out = {}
+    for L in seq_lens:
+        batch = max(1, TOKENS_PER_STEP // L)
+        try:
+            cfg, timing, _ = _timed_train_run(seq_len=L, batch=batch,
+                                              steps=steps, windows=3)
+            st = timing["step_s"]
+            toks = batch * L
+            fpt = train_flops_per_token(cfg)
+            _, peak = chip_peak_flops()
+            out[f"L{L}"] = {
+                "batch": batch,
+                "step_time_s": round(st, 3),
+                "tokens_per_sec": round(toks / st, 1),
+                "mfu": round(fpt * toks / st / peak, 4) if peak else None,
+                "loss_finite": timing["loss_finite"],
+                "attn_share_of_model_flops": round(
+                    cfg.n_layers * 2 * cfg.d_model * L / (fpt / 3.0), 3
+                ),
+            }
+        except Exception as e:
+            entry = {"error": str(e)[:200]}
+            if prior and isinstance(prior.get(f"L{L}"), dict):
+                entry["last_good"] = {
+                    k: v for k, v in prior[f"L{L}"].items() if k != "error"
+                }
+            out[f"L{L}"] = entry
+    return out
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=10)
@@ -250,6 +315,7 @@ def main() -> int:
     parser.add_argument("--out", default=str(REPO / "PERF.json"))
     parser.add_argument("--skip-attn", action="store_true")
     parser.add_argument("--skip-decode", action="store_true")
+    parser.add_argument("--skip-long", action="store_true")
     args = parser.parse_args()
 
     perf = {"train": bench_train(args.steps, args.batch)}
@@ -272,6 +338,12 @@ def main() -> int:
         perf["kv_cache_decode"] = bench_decode(batch=args.batch)
     elif "kv_cache_decode" in prior:
         perf["kv_cache_decode"] = prior["kv_cache_decode"]
+    if not args.skip_long:
+        perf["long_context_train"] = bench_long_context(
+            prior=prior.get("long_context_train")
+        )
+    elif "long_context_train" in prior:
+        perf["long_context_train"] = prior["long_context_train"]
 
     Path(args.out).write_text(json.dumps(perf, indent=2) + "\n")
     t = perf["train"]
