@@ -1,6 +1,31 @@
-"""CLI submitters (reference: tony-cli) + TCP proxy (reference: tony-proxy)."""
+"""CLI submitters (reference: tony-cli) + TCP proxy (reference: tony-proxy).
 
-from .main import main
-from .proxy import ProxyServer
+Lazy attribute access instead of eager submodule imports: `python -m
+tony_tpu.cli.main` would otherwise find `tony_tpu.cli.main` pre-imported by
+this package and print runpy's RuntimeWarning on every CLI invocation.
+"""
+
+
+def __getattr__(name):
+    if name == "main":
+        from .main import main as fn
+
+        # importing .main just re-bound this package's `main` attribute to
+        # the SUBMODULE; cache the function over it so every later access
+        # (which bypasses __getattr__ once the attribute exists) still gets
+        # the callable
+        globals()["main"] = fn
+        return fn
+    if name == "ProxyServer":
+        from .proxy import ProxyServer as cls
+
+        globals()["ProxyServer"] = cls
+        return cls
+    if name == "proxy":
+        from . import proxy
+
+        return proxy
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = ["main", "ProxyServer"]
