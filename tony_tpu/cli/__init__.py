@@ -3,6 +3,12 @@
 Lazy attribute access instead of eager submodule imports: `python -m
 tony_tpu.cli.main` would otherwise find `tony_tpu.cli.main` pre-imported by
 this package and print runpy's RuntimeWarning on every CLI invocation.
+
+Known corner: after a DIRECT `import tony_tpu.cli.main`, the import
+machinery binds this package's `main` attribute to that submodule, so
+`from tony_tpu.cli import main` then yields the module — import the
+function from its home (`from tony_tpu.cli.main import main`) in code that
+also imports the submodule.
 """
 
 
@@ -22,9 +28,11 @@ def __getattr__(name):
         globals()["ProxyServer"] = cls
         return cls
     if name == "proxy":
-        from . import proxy
+        # NOT `from . import proxy` — its fromlist handling consults this
+        # very __getattr__ and recurses
+        import importlib
 
-        return proxy
+        return importlib.import_module(".proxy", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
