@@ -149,6 +149,11 @@ def generate(
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}"
         )
+    if not cfg.causal:
+        raise ValueError(
+            "generate requires causal=True (a bidirectional encoder has no "
+            "autoregressive decode)"
+        )
     if key is None:
         key = jax.random.PRNGKey(0)
     if cfg.n_experts > 0:

@@ -54,6 +54,11 @@ class TransformerConfig:
     # attn_window positions inclusive; 0 = full causal. Supported by the
     # flash and ref paths (block-pruned O(L*window) in the kernel)
     attn_window: int = 0
+    # causal=False turns the stack into a bidirectional ENCODER (BERT-style:
+    # every position attends everywhere). Pair with -1-masked targets for
+    # masked-LM training (token_nll scores only the unmasked positions);
+    # KV-cache generation requires causal=True
+    causal: bool = True
     remat: bool = False
     # remat policy when remat=True: "full" rematerializes everything
     # (lowest memory, ~1 extra fwd of recompute); "dots" saves matmul
@@ -179,6 +184,8 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
             f"attn_window must be >= 0 (0 = full causal), got {cfg.attn_window}"
         )
     window = cfg.attn_window or None
+    if window is not None and not cfg.causal:
+        raise ValueError("attn_window requires causal=True")
     if impl == "auto":
         impl = "flash" if jax.default_backend() in ("tpu", "axon") else "ref"
     if window is not None and impl in ("ring", "ulysses"):
@@ -189,20 +196,20 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
     if impl == "flash":
         from ..ops.attention import attention_blhd
 
-        return attention_blhd(q, k, v, causal=True, window=window)
+        return attention_blhd(q, k, v, causal=cfg.causal, window=window)
     if impl == "ring":
         if mesh is None:
             raise ValueError("attn_impl='ring' requires a mesh")
         from ..parallel.ring_attention import make_ring_attention
 
-        return make_ring_attention(mesh, causal=True)(q, k, v)
+        return make_ring_attention(mesh, causal=cfg.causal)(q, k, v)
     if impl == "ulysses":
         if mesh is None:
             raise ValueError("attn_impl='ulysses' requires a mesh")
         from ..parallel.ulysses import make_ulysses_attention
 
-        return make_ulysses_attention(mesh, causal=True)(q, k, v)
-    return reference_attention(q, k, v, causal=True, window=window)
+        return make_ulysses_attention(mesh, causal=cfg.causal)(q, k, v)
+    return reference_attention(q, k, v, causal=cfg.causal, window=window)
 
 
 def _qkv(cfg: TransformerConfig, h, positions, lp):
