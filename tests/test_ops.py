@@ -1,9 +1,10 @@
 """Pallas op tests (interpret mode on CPU): flash attention vs reference.
 
-Every flash test runs TWICE via the autouse `attn_path` fixture: once on
-the VMEM-resident kernels (the default at CI-sized L) and once with
-RESIDENT_MAX_L forced to 0 so the streaming-DMA kernels — the L > 2048
-long-context path — keep full coverage."""
+Every flash test runs THREE times via the autouse `attn_path` fixture:
+on the VMEM-resident kernels (the default at CI-sized L), with
+RESIDENT_MAX_L forced to 0 (the fused-streaming mid tier, 2048 < L <=
+8192 in production), and with FUSED_STREAM_MAX_L also 0 (the split
+dq/dkv O(block)-memory kernels that serve the longest sequences)."""
 
 import jax
 import jax.numpy as jnp
@@ -14,20 +15,23 @@ from tony_tpu.ops import flash_attention, attention_blhd
 from tony_tpu.parallel import reference_attention
 
 
-@pytest.fixture(params=["resident", "streaming"], autouse=True)
+@pytest.fixture(params=["resident", "stream_fused", "stream_split"],
+                autouse=True)
 def attn_path(request, monkeypatch):
-    if request.param == "streaming":
-        import tony_tpu.ops.attention as A
+    if request.param == "resident":
+        yield request.param
+        return
+    import tony_tpu.ops.attention as A
 
-        monkeypatch.setattr(A, "RESIDENT_MAX_L", 0)
-        # _flash_fwd/_flash_bwd are jitted and the dispatch reads the
-        # module global at TRACE time — stale cache entries would silently
-        # run the other path, so retrace everything on entry and exit
-        jax.clear_caches()
-        yield request.param
-        jax.clear_caches()
-    else:
-        yield request.param
+    monkeypatch.setattr(A, "RESIDENT_MAX_L", 0)
+    if request.param == "stream_split":
+        monkeypatch.setattr(A, "FUSED_STREAM_MAX_L", 0)
+    # _flash_fwd/_flash_bwd are jitted and the dispatch reads the module
+    # globals at TRACE time — stale cache entries would silently run the
+    # other path, so retrace everything on entry and exit
+    jax.clear_caches()
+    yield request.param
+    jax.clear_caches()
 
 
 def _ref_bhld(q, k, v, causal):

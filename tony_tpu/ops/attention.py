@@ -1,17 +1,25 @@
 """Fused flash attention (forward + backward) as Pallas TPU kernels.
 
-The hot op of the flagship model. Forward streams K/V blocks HBM -> VMEM with
-double-buffered async DMA and online softmax, so neither the L x L score
-matrix nor the full K/V ever sit in VMEM — sequence length is bounded by HBM,
-not the 16MB VMEM (naive full-KV VMEM residency caps out around L=16k).
-Causal masking prunes the KV sweep to lower-triangular blocks, skipping both
-the compute AND the DMA of masked blocks (~half the FLOPs and bytes).
+The hot op of the flagship model, tiered by sequence length:
 
-The backward is the standard flash recomputation: forward saves only O and
-the per-row logsumexp; dQ sweeps KV blocks, dK/dV sweep Q blocks from the
-diagonal down — backward memory also stays O(block), which is what makes
-long-context training viable (XLA autodiff of naive attention materializes
-L x L residuals: 34GB at L=32k).
+- **VMEM-resident** (L <= 2048): one program per (batch, head), whole
+  q/k/v/o in VMEM, fully static tile loops, fused dQ/dK/dV backward.
+- **Fused streaming** (L <= 8192): K/V blocks stream HBM -> VMEM with
+  double-buffered async DMA and online softmax; the backward is ONE
+  kv-block sweep computing dK/dV and accumulating dQ in an [L, D] f32
+  VMEM block revisited across the grid — scores/exp recomputed once per
+  tile.
+- **Split streaming** (beyond): the same forward, with the classic
+  two-kernel backward (dQ sweeps KV blocks, dK/dV sweep Q blocks from the
+  diagonal down) whose memory stays O(block) — sequence length is bounded
+  by HBM, not the 16MB VMEM, which is what makes long-context training
+  viable (XLA autodiff of naive attention materializes L x L residuals:
+  34GB at L=32k).
+
+Forward saves only O and the per-row logsumexp (standard flash
+recomputation). Causal masking prunes the KV sweep to lower-triangular
+blocks, skipping both the compute AND the DMA of masked blocks (~half the
+FLOPs and bytes).
 
 Layout is [B, H, L, D], length tiled to MXU-friendly blocks, scores in f32.
 On non-TPU backends the same kernels run in interpreter mode (tests).
@@ -321,19 +329,42 @@ def _dq_kernel(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
-                dk_ref, dv_ref, q_buf, do_buf, sems,
-                *, scale, causal, block_q, window=None):
-    """dK/dV for one kv block: sweep Q blocks (from the diagonal down when
-    causal; a sliding window also bounds the sweep from ABOVE — rows past
-    col+window can't see this block). dV = p^T @ dO; dK = scale * ds^T @ Q.
+def _kv_sweep_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref, *refs,
+                     scale, causal, block_q, kv_len=None, window=None,
+                     fused=False):
+    """One (batch*head, kv-block) program sweeping Q blocks — BOTH streaming
+    backward tiers share this body:
+
+    - split (fused=False): emits dK/dV only (refs = dk, dv, scratch). The
+      companion _dq_kernel recomputes scores for dQ; memory stays O(block).
+    - fused (fused=True): refs also lead with a dq accumulator whose block
+      index map is constant along the kv grid dim, so Pallas keeps it
+      VMEM-resident across the sequential revisits (race-free: TPU grid
+      iterations execute in order on the core). Each tile's scores/exp are
+      recomputed ONCE instead of once per split kernel, at the price of an
+      [L, D] f32 dq block (FUSED_STREAM_MAX_L bounds it).
+
+    Sweep bounds: from the diagonal down when causal; a sliding window also
+    bounds the sweep from ABOVE — rows past col+window can't see this
+    block. dV = p^T @ dO; dK = scale * ds^T @ Q; dQ += scale * ds @ K.
     Q/dO stream from HBM; lse/delta are 4B/row and ride in VMEM whole."""
+    if fused:
+        dq_ref, dk_ref, dv_ref, q_buf, do_buf, sems = refs
+    else:
+        dq_ref = None
+        dk_ref, dv_ref, q_buf, do_buf, sems = refs
     b_ = pl.program_id(0)
     ki = pl.program_id(1)
     k_blk = k_ref[0]                                   # [BK, D] storage dtype
     v_blk = v_ref[0]
     bk, d = k_blk.shape
     nq = q_hbm.shape[1] // block_q
+
+    if fused:
+        @pl.when(ki == 0)
+        def _init_dq():
+            dq_ref[0] = jnp.zeros(dq_ref.shape[1:], dq_ref.dtype)
+
     lo = (ki * bk) // block_q if causal else 0
     hi = nq
     if window is not None:
@@ -345,6 +376,11 @@ def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
     )
     stream.start()
 
+    # the split tier never masks padded KV columns here (its dk/dv rows for
+    # padded positions are sliced away by the caller) — but the fused tier's
+    # dQ really consumes them, so it must
+    kv_len_eff = kv_len if fused else None
+
     def make_body(masked):
         def body(j, carry):
             dk, dv = carry
@@ -352,23 +388,30 @@ def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
             lse_j = lse_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]   # [BQ, 1]
             delta_j = delta_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]
             mask = (
-                _causal_mask(j, block_q, ki, bk, window)
-                if masked and causal else None
+                _attn_mask(j, block_q, ki, bk, causal, kv_len_eff, window)
+                if masked else None
             )
-            _, dk_inc, dv_inc = _bwd_tile(
+            dq_inc, dk_inc, dv_inc = _bwd_tile(
                 q_j, do_j, k_blk, v_blk, lse_j, delta_j, scale, mask,
-                want_dq=False,
+                want_dq=fused,
             )
+            if fused:
+                cur = dq_ref[0, pl.ds(j * block_q, block_q), :]
+                dq_ref[0, pl.ds(j * block_q, block_q), :] = (
+                    cur + dq_inc.astype(dq_ref.dtype)
+                )
             return dk + dk_inc, dv + dv_inc
         return body
 
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
     carry = (dk0, dv0)
+    always_mask = kv_len_eff is not None
     if not causal:
-        dk, dv = jax.lax.fori_loop(lo, hi, make_body(False), carry)
-    elif window is not None:
-        # band-pruned sweep: partial tiles on both edges, single masked loop
+        dk, dv = jax.lax.fori_loop(lo, hi, make_body(always_mask), carry)
+    elif window is not None or always_mask:
+        # band-pruned sweep (partial tiles on both edges) or ragged-KV dq
+        # masking: single masked loop
         dk, dv = jax.lax.fori_loop(lo, hi, make_body(True), carry)
     else:
         # roles swapped vs the fwd/dq sweeps: rows are q blocks (j), cols
@@ -399,6 +442,12 @@ def _dkv_kernel(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
 # 2048: at 4096 the fully-unrolled tile loops blow Mosaic's scoped-VMEM
 # stack (~40MB of live temporaries vs the 16MB budget)
 RESIDENT_MAX_L = 2048
+# mid tier for the backward: one FUSED streaming sweep (dq accumulated in a
+# VMEM output block revisited across the kv grid dimension) instead of the
+# split dq/dkv kernels — saves one score/exp recompute per tile. The dq
+# accumulator is [L, D] f32 per (batch, head): 4MB at L=8192; beyond that
+# the split O(block)-memory kernels take over.
+FUSED_STREAM_MAX_L = 8192
 
 
 def _static_tile_kind(qi, bq, j, bk, causal, kv_len, window):
@@ -508,8 +557,6 @@ def _use_resident(lq, lk, d):
     """Whole-sequence VMEM residency budget (see section comment)."""
     return lq <= RESIDENT_MAX_L and lk <= RESIDENT_MAX_L and d <= 128
 
-
-# ----------------------------------------------------------------- plumbing
 
 def _block(block, l):
     """Kernel block size for a length-l axis: the configured block, shrunk for
@@ -694,6 +741,45 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale,
         dv = dv.reshape(b, h, lkp, d)[:, :, :lk, :]
         return dq, dk, dv
 
+    if lqp <= FUSED_STREAM_MAX_L and lkp <= FUSED_STREAM_MAX_L and d <= 128:
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _kv_sweep_kernel, scale=scale, causal=causal,
+                block_q=block_q, kv_len=kv_len, window=window, fused=True,
+            ),
+            grid=(bh, nk),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),   # Q in HBM, streamed
+                pl.BlockSpec((1, block_k, d), lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),   # dO in HBM, streamed
+                pl.BlockSpec((1, 1, lqp), lambda b_, i: (b_, 0, 0)),
+                pl.BlockSpec((1, 1, lqp), lambda b_, i: (b_, 0, 0)),
+            ],
+            out_specs=[
+                # constant index along the kv dim: VMEM-resident across the
+                # revisits, flushed when b_ advances — the dq accumulator
+                pl.BlockSpec((1, lqp, d), lambda b_, i: (b_, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b_, i: (b_, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, lqp, d), jnp.float32),
+                jax.ShapeDtypeStruct(kf.shape, k.dtype),
+                jax.ShapeDtypeStruct(vf.shape, v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, block_q, d), q.dtype),
+                pltpu.VMEM((2, block_q, d), g.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+            interpret=interpret,
+        )(qf, kf, vf, gf, lsef, deltaf)
+        dq = dq.astype(q.dtype).reshape(b, h, lqp, d)[:, :, :lq, :]
+        dk = dk.reshape(b, h, lkp, d)[:, :, :lk, :]
+        dv = dv.reshape(b, h, lkp, d)[:, :, :lk, :]
+        return dq, dk, dv
+
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_k=block_k, kv_len=kv_len, window=window),
@@ -717,7 +803,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale,
     )(qf, kf, vf, gf, lsef, deltaf)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+        functools.partial(_kv_sweep_kernel, scale=scale, causal=causal,
                           block_q=block_q, window=window),
         grid=(bh, nk),
         in_specs=[
