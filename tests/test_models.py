@@ -244,6 +244,33 @@ def test_pipeline_1f1b_transformer_matches_gpipe():
     assert losses[-1] < losses[0] - 0.05, losses
 
 
+def test_pipeline_circular_transformer_matches_gpipe():
+    """The circular (interleaved) schedule must produce the same loss as
+    GPipe on identical params/batch, and train."""
+    from tony_tpu.train.pipeline_step import create_pipeline_train_step
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=4, n_heads=4, n_kv_heads=4,
+        d_ff=128, dtype=jnp.float32, attn_impl="ref",
+    )
+    mesh = build_mesh(MeshSpec(pipe=2, fsdp=4))
+    tokens, targets = synthetic_lm_batch(jax.random.PRNGKey(0), 8, 16, 128)
+
+    g = create_pipeline_train_step(cfg, mesh, num_microbatches=4)
+    c = create_pipeline_train_step(cfg, mesh, num_microbatches=4,
+                                   schedule="circular", num_chunks=2)
+    gl = float(g.loss_fn(g.params, tokens, targets))
+    cl = float(c.loss_fn(c.params, tokens, targets))
+    np.testing.assert_allclose(cl, gl, rtol=1e-5)
+
+    params, opt_state = c.params, c.opt_state
+    losses = []
+    for _ in range(8):
+        params, opt_state, m = c.step_fn(params, opt_state, tokens, targets)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.05, losses
+
+
 def test_pipeline_1f1b_bfloat16_activations():
     """The 1f1b schedule must trace and run with the default bf16
     activation dtype (regression: an f32 mask promotion broke the scan
@@ -295,9 +322,15 @@ def test_pipeline_moe_aux_survives_both_schedules():
     ]))
     assert ref_loss > ce_only  # aux really contributes
 
-    for schedule in ("gpipe", "1f1b"):
+    mesh2 = build_mesh(MeshSpec(pipe=2, fsdp=4))
+    for schedule, m_, kw in (
+        ("gpipe", mesh, {}),
+        ("1f1b", mesh, {}),
+        # circular needs n_layers % (S*V) == 0: S=2, V=2 for 4 layers
+        ("circular", mesh2, {"num_chunks": 2}),
+    ):
         bundle = create_pipeline_train_step(
-            cfg, mesh, num_microbatches=M, schedule=schedule
+            cfg, m_, num_microbatches=M, schedule=schedule, **kw
         )
         loss = float(bundle.loss_fn(bundle.params, tokens, targets))
         np.testing.assert_allclose(loss, ref_loss, rtol=1e-5, err_msg=schedule)

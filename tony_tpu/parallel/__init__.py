@@ -37,7 +37,10 @@ from .ring_attention import (
     ring_flash_attention,
 )
 from .ulysses import make_ulysses_attention, ulysses_attention
-from .pipeline import make_pipeline, make_pipeline_1f1b, stack_stage_params
+from .pipeline import (
+    make_pipeline, make_pipeline_1f1b, make_pipeline_circular,
+    stack_stage_params,
+)
 from .expert import load_balancing_loss, moe_ffn, top_k_routing
 
 __all__ = [
@@ -49,6 +52,7 @@ __all__ = [
     "make_ring_attention", "reference_attention", "ring_attention",
     "ring_flash_attention",
     "make_ulysses_attention", "ulysses_attention",
-    "make_pipeline", "make_pipeline_1f1b", "stack_stage_params",
+    "make_pipeline", "make_pipeline_1f1b", "make_pipeline_circular",
+    "stack_stage_params",
     "moe_ffn", "top_k_routing", "load_balancing_loss",
 ]

@@ -5,7 +5,7 @@ The `pipe` mesh axis carries contiguous runs of decoder layers: the
 n_layers/S layers), embed/unembed stay replicated across the pipe axis, and
 microbatches flow stage-to-stage via ring ppermute.
 
-Two schedules (parallel/pipeline.py):
+Three schedules (parallel/pipeline.py):
 - "gpipe": forward pipeline as one scanned shard_map program; the backward
   schedule falls out of autodiff (ppermute transposes to ppermute, scan
   reverses). Simple, but autodiff keeps every microbatch's residuals live.
@@ -13,8 +13,13 @@ Two schedules (parallel/pipeline.py):
   schedule with an O(stages) residual ring buffer + activation
   recomputation, so activation memory is independent of the microbatch
   count. This is the deep-pipeline memory-viable path.
+- "circular": Megatron-style interleaved/virtual pipeline — each device
+  holds `num_chunks` non-adjacent layer chunks, items loop the ring V
+  times, and the fill/drain bubble costs V× less wall time than GPipe
+  (each tick is 1/V of a stage). Params live in the schedule's native
+  [V, S, per_chunk] layout; autodiff backward.
 
-MoE layers are supported in both schedules: each stage reports its layers'
+MoE layers are supported in all three schedules: each stage reports its layers'
 load-balancing aux losses, accumulated across real (stage, microbatch)
 applications and folded into the loss with cfg.aux_loss_weight. MoE routing
 statistics are per-microbatch under pipelining (each microbatch routes
@@ -45,7 +50,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import transformer
-from ..parallel.pipeline import make_pipeline_1f1b, make_pipeline_stacked
+from ..parallel.pipeline import (
+    make_pipeline_1f1b, make_pipeline_circular, make_pipeline_stacked,
+)
 from .step import make_optimizer
 
 
@@ -67,22 +74,48 @@ def create_pipeline_train_step(
     key: jax.Array | None = None,
     optimizer: optax.GradientTransformation | None = None,
     schedule: str = "gpipe",
+    num_chunks: int = 2,
 ) -> PipelineBundle:
     n_stages = mesh.shape["pipe"]
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"n_layers {cfg.n_layers} not divisible by pipe={n_stages}"
         )
-    if schedule not in ("gpipe", "1f1b"):
+    if schedule not in ("gpipe", "1f1b", "circular"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if schedule == "circular":
+        if cfg.n_layers % (n_stages * num_chunks):
+            raise ValueError(
+                f"n_layers {cfg.n_layers} not divisible by stages*chunks "
+                f"{n_stages * num_chunks}"
+            )
+        if num_microbatches % n_stages:
+            raise ValueError(
+                f"circular schedule needs num_microbatches "
+                f"({num_microbatches}) divisible by pipe stages ({n_stages})"
+            )
     key = jax.random.PRNGKey(0) if key is None else key
     optimizer = optimizer or make_optimizer()
 
     params = transformer.init(key, cfg)
-    # layer stack sharded over pipe; everything else replicated
-    layer_shardings = jax.tree.map(
-        lambda _: NamedSharding(mesh, P("pipe")), params["layers"]
-    )
+    if schedule == "circular":
+        # store the layer stack in the schedule's native [V, S, per_chunk]
+        # layout, sharded over pipe on the stage axis — no per-step reshard
+        per_chunk = cfg.n_layers // (n_stages * num_chunks)
+        params["layers"] = jax.tree.map(
+            lambda p: p.reshape(
+                (num_chunks, n_stages, per_chunk) + p.shape[1:]
+            ),
+            params["layers"],
+        )
+        layer_shardings = jax.tree.map(
+            lambda _: NamedSharding(mesh, P(None, "pipe")), params["layers"]
+        )
+    else:
+        # layer stack sharded over pipe; everything else replicated
+        layer_shardings = jax.tree.map(
+            lambda _: NamedSharding(mesh, P("pipe")), params["layers"]
+        )
     repl = NamedSharding(mesh, P())
     param_shardings = {
         "embed": repl,
@@ -114,9 +147,15 @@ def create_pipeline_train_step(
     def embed_fwd(params, tokens):
         return params["embed"].astype(cfg.dtype)[tokens]
 
-    fwd_pipeline = make_pipeline_stacked(
-        mesh, stage_fn, num_microbatches, has_aux=True
-    )
+    if schedule == "circular":
+        fwd_pipeline = make_pipeline_circular(
+            mesh, stage_fn, num_microbatches, num_chunks,
+            has_aux=True, expect_chunked=True,
+        )
+    else:
+        fwd_pipeline = make_pipeline_stacked(
+            mesh, stage_fn, num_microbatches, has_aux=True
+        )
 
     def fwd_loss(params, tokens, targets):
         x = embed_fwd(params, tokens)
@@ -131,7 +170,7 @@ def create_pipeline_train_step(
     # 1F1B apply computes every gradient, ~3x the cost of a forward
     jitted_loss = jax.jit(fwd_loss)
 
-    if schedule == "gpipe":
+    if schedule in ("gpipe", "circular"):
         def step(params, opt_state, tokens, targets):
             loss, grads = jax.value_and_grad(fwd_loss)(params, tokens, targets)
             updates, opt_state = optimizer.update(grads, opt_state, params)
