@@ -312,10 +312,13 @@ def test_pipeline_single_stage_degenerates():
     np.testing.assert_allclose(np.asarray(out), 3.0 * np.ones((4, 2)))
 
 
-def test_pipeline_circular_matches_sequential():
+@pytest.mark.parametrize("M", [4, 8])
+def test_pipeline_circular_matches_sequential(M):
     """Circular/interleaved schedule (V chunks per device): forward equals
     the sequential stack, and gradients flow (autodiff through the
-    interleaved routing)."""
+    interleaved routing). M=8 > S=4 pins the dense-injection regime where
+    deferred wrap-priority injections interleave with wrap arrivals —
+    exactly what M == S never exercises (round-2 advisor finding)."""
     from tony_tpu.parallel.pipeline import make_pipeline_circular
 
     mesh = build_mesh(MeshSpec(pipe=4, fsdp=2))
@@ -337,10 +340,10 @@ def test_pipeline_circular_matches_sequential():
         "b": jnp.stack([jax.random.normal(ks[n_layers + i], (d,)) * 0.1
                         for i in range(n_layers)]),
     }
-    batch = jax.random.normal(ks[-1], (12, d))  # mb size 3 over M=4
+    batch = jax.random.normal(ks[-1], (3 * M, d))  # mb size 3 over M
 
     pipeline = make_pipeline_circular(
-        mesh, stage_fn, num_microbatches=4, num_chunks=V
+        mesh, stage_fn, num_microbatches=M, num_chunks=V
     )
     out = jax.jit(pipeline)(stacked, batch)
 

@@ -65,6 +65,53 @@ def test_localize_rejects_non_job_archive(tmp_path):
         shipping.localize_job(str(bogus), "app_y", base_dir=str(tmp_path / "lz"))
 
 
+def test_localize_verifies_sha256_and_rejects_tamper(tmp_path):
+    """A bit-flipped archive must be refused BEFORE unpack when the submit
+    -time digest is supplied — the integrity role of the reference's token
+    -secured staging (TonyClient.java:981-1030)."""
+    job = _staged_job_dir(tmp_path)
+    archive = shipping.build_job_archive(job)
+    digest = shipping.sha256_file(archive)
+
+    # matching digest unpacks normally
+    local = shipping.localize_job(
+        str(archive), "app_ok", base_dir=str(tmp_path / "lz"), sha256=digest
+    )
+    assert (Path(local) / FINAL_CONF_NAME).exists()
+
+    # flip one byte mid-file -> clear integrity error, nothing unpacked
+    data = bytearray(archive.read_bytes())
+    data[len(data) // 2] ^= 0x01
+    tampered = tmp_path / "tampered.tar.gz"
+    tampered.write_bytes(bytes(data))
+    with pytest.raises(ValueError, match="integrity"):
+        shipping.localize_job(
+            str(tampered), "app_bad", base_dir=str(tmp_path / "lz2"),
+            sha256=digest,
+        )
+    assert not (tmp_path / "lz2" / "app_bad").exists()
+
+    # the idempotent-reuse path enforces the digest too: a dir localized
+    # WITHOUT verification cannot satisfy a digest-expecting caller, and a
+    # different expected digest is refused
+    shipping.localize_job(str(archive), "app_mix", base_dir=str(tmp_path / "lz3"))
+    with pytest.raises(ValueError, match="refusing to reuse"):
+        shipping.localize_job(
+            str(archive), "app_mix", base_dir=str(tmp_path / "lz3"),
+            sha256=digest,
+        )
+    with pytest.raises(ValueError, match="refusing to reuse"):
+        shipping.localize_job(
+            str(archive), "app_ok", base_dir=str(tmp_path / "lz"),
+            sha256="0" * 64,
+        )
+    # matching digest reuses normally
+    again = shipping.localize_job(
+        str(archive), "app_ok", base_dir=str(tmp_path / "lz"), sha256=digest
+    )
+    assert again == local
+
+
 def test_fetch_file_uri(tmp_path):
     src = tmp_path / "a.bin"
     src.write_bytes(b"\x00\x01")
@@ -158,6 +205,31 @@ def test_e2e_app_placeholder_uri_and_upload_cmd(tmp_job_dirs, tmp_path):
     assert final2["tony.application.archive-uri"] == str(
         tmp_path / "bucket" / client2.app_id / "job_archive.tar.gz"
     )
+
+
+def test_e2e_tampered_archive_fails_task(tmp_job_dirs, tmp_path):
+    """End-to-end integrity: the frozen conf carries the archive sha256, the
+    driver forwards it in the launch env, and an executor that fetches a
+    corrupted copy fails with the integrity error instead of executing it.
+    The upload command plays the tamperer (appends a byte in transit)."""
+    uri = str(tmp_path / "bucket" / "job_archive.tar.gz")
+    conf, _ = _shipped_conf(
+        tmp_job_dirs, tmp_path,
+        **{
+            "tony.application.archive-uri": uri,
+            "tony.application.archive-upload-cmd":
+                "mkdir -p $(dirname {uri}) && cp {archive} {uri} "
+                "&& printf x >> {uri}",
+        },
+    )
+    status, client = _run(conf)
+    assert status == JobStatus.FAILED
+    final = json.loads((Path(client.job_dir) / FINAL_CONF_NAME).read_text())
+    built = Path(client.job_dir) / shipping.ARCHIVE_NAME
+    assert final["tony.application.archive-sha256"] == \
+        shipping.sha256_file(built)
+    logs = _logs(client)
+    assert "integrity check failed" in logs, logs
 
 
 def test_e2e_ssh_launch_seam_with_localization(tmp_job_dirs, tmp_path):

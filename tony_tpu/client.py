@@ -158,24 +158,42 @@ class TonyClient:
         if not uri and not localize and prov == "local":
             return
         archive = shipping.build_job_archive(self.job_dir)
+        digest = shipping.sha256_file(archive)
         if not uri:
             # shared/local FS default; real fleets set an uploadable URI
             # (gs://... + upload-cmd) or scp://<client-host>:<archive>
             uri = str(archive)
-        if uri != template_uri:
-            # freeze the RESOLVED uri for the driver, but restore the
-            # template in the in-memory conf — a caller reusing one conf
-            # object for several submissions must not inherit this job's
-            # resolved path (executors read the archive copy of the conf,
-            # where the uri is irrelevant)
-            self.conf.set(keys.APPLICATION_ARCHIVE_URI, uri)
-            try:
-                self.conf.write_final(self.job_dir)
-            finally:
-                self.conf.set(keys.APPLICATION_ARCHIVE_URI, template_uri)
         upload_cmd = str(
             self.conf.get(keys.APPLICATION_ARCHIVE_UPLOAD_CMD, "") or ""
         )
+        if (prov != "local" and not upload_cmd
+                and not uri.startswith(("scp://", "gs://", "http://",
+                                        "https://"))):
+            # a client-local filesystem path frozen as the URI is only
+            # fetchable from remote hosts over a shared FS; without one the
+            # executors die in localization with a raw FileNotFoundError,
+            # so name the misconfiguration here where it is actionable
+            log.warning(
+                "provisioner %r launches on remote hosts but the job-archive "
+                "URI %r is a local filesystem path and no %s is set — "
+                "executors will fail localization unless %s is on a shared "
+                "filesystem", prov, uri,
+                keys.APPLICATION_ARCHIVE_UPLOAD_CMD, uri,
+            )
+        # freeze the RESOLVED uri + digest for the driver, but restore the
+        # template in the in-memory conf — a caller reusing one conf object
+        # for several submissions must not inherit this job's resolved path
+        # or hash (executors read the archive copy of the conf, where both
+        # are irrelevant: the digest cannot live inside the bytes it hashes,
+        # so it reaches executors via launch env, not the archive)
+        prior_sha = str(self.conf.get(keys.APPLICATION_ARCHIVE_SHA256, "") or "")
+        self.conf.set(keys.APPLICATION_ARCHIVE_URI, uri)
+        self.conf.set(keys.APPLICATION_ARCHIVE_SHA256, digest)
+        try:
+            self.conf.write_final(self.job_dir)
+        finally:
+            self.conf.set(keys.APPLICATION_ARCHIVE_URI, template_uri)
+            self.conf.set(keys.APPLICATION_ARCHIVE_SHA256, prior_sha)
         if upload_cmd:
             shipping.upload_archive(archive, uri, upload_cmd)
 

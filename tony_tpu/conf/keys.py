@@ -61,6 +61,10 @@ SRC_DIR = "tony.application.src-dir"
 # switch forcing fetch+unpack even when the path looks shared
 APPLICATION_ARCHIVE_URI = "tony.application.archive-uri"
 APPLICATION_ARCHIVE_UPLOAD_CMD = "tony.application.archive-upload-cmd"
+# sha256 of the built archive, frozen at submit time and verified by every
+# executor before unpack — the integrity role of the reference's token-secured
+# HDFS staging (TonyClient.java:981-1030) on untrusted transports (http, gs)
+APPLICATION_ARCHIVE_SHA256 = "tony.application.archive-sha256"
 TASK_LOCALIZE = "tony.task.localize"
 PYTHON_VENV = "tony.application.python-venv"
 PYTHON_BINARY_PATH = "tony.application.python-binary-path"

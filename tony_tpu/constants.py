@@ -20,6 +20,7 @@ ENV_JOB_DIR = "TONY_JOB_DIR"              # holds tony-final.json
 ENV_TOKEN = "TONY_SECRET_TOKEN"           # HMAC session token (ClientToAM-token role)
 ENV_TASK_COMMAND = "TONY_TASK_COMMAND"    # user command for this role
 ENV_JOB_ARCHIVE = "TONY_JOB_ARCHIVE"      # fetchable job-archive URI (shipping)
+ENV_JOB_ARCHIVE_SHA256 = "TONY_JOB_ARCHIVE_SHA256"  # expected digest of that URI
 ENV_LOCALIZE = "TONY_LOCALIZE"            # "true" => always fetch+unpack archive
 
 # ---- executor -> user-process env (consumed by training scripts)

@@ -332,6 +332,13 @@ class Driver:
         archive_uri = str(self.conf.get(keys.APPLICATION_ARCHIVE_URI, "") or "")
         if archive_uri:
             env[c.ENV_JOB_ARCHIVE] = archive_uri
+            # integrity digest rides the launch env, not the archive itself
+            # (the hash cannot live inside the bytes it covers)
+            digest = str(
+                self.conf.get(keys.APPLICATION_ARCHIVE_SHA256, "") or ""
+            )
+            if digest:
+                env[c.ENV_JOB_ARCHIVE_SHA256] = digest
         if self.conf.get_bool(keys.TASK_LOCALIZE, False):
             env[c.ENV_LOCALIZE] = "true"
         for kv in self.conf.get_list(keys.EXECUTION_ENV):

@@ -104,7 +104,10 @@ class Executor:
         if archive_uri and (force_localize or not final_visible):
             from .utils import shipping
 
-            self.job_dir = shipping.localize_job(archive_uri, self.app_id)
+            self.job_dir = shipping.localize_job(
+                archive_uri, self.app_id,
+                sha256=env.get(c.ENV_JOB_ARCHIVE_SHA256) or None,
+            )
             log.info("running from localized job dir %s", self.job_dir)
 
         self.conf = TonyConf.from_final(self.job_dir) if self.job_dir else TonyConf()

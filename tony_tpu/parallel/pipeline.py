@@ -369,11 +369,12 @@ def _pipeline_1f1b_local(
         # ---------------- forward half ----------------
         mf = r - me
         f_valid = (mf >= 0) & (mf < M)
-        f_mask = f_valid.astype(f32)
         feed = microbatches[jnp.clip(mf, 0, M - 1)]
         x_in = jnp.where(me == 0, feed, fwd_inbox)
         y, aux = stage_fn(stage_params, x_in)
-        aux_acc = aux_acc + aux.astype(f32) * f_mask
+        # jnp.where, not aux * f_mask: warmup/drain rounds run the stage on
+        # garbage activations whose aux may be non-finite, and NaN*0=NaN
+        aux_acc = aux_acc + jnp.where(f_valid, aux.astype(f32), 0.0)
         # save the stage input for backward recompute; masked read-modify-
         # write so invalid rounds leave the buffer untouched
         slot_f = jnp.clip(mf, 0, M - 1) % R
@@ -411,7 +412,6 @@ def _pipeline_1f1b_local(
         # ---------------- backward half ----------------
         mb_ = r - (2 * S - 2 - me)
         b_valid = (mb_ >= 0) & (mb_ < M)
-        b_mask = b_valid.astype(f32)
         dy_in = jnp.where(me == S - 1, dy_own, bwd_inbox)
         slot_b = jnp.clip(mb_, 0, M - 1) % R
         x_saved = lax.dynamic_index_in_dim(resid, slot_b, 0, keepdims=False)
@@ -428,7 +428,7 @@ def _pipeline_1f1b_local(
         # cond: the recompute+vjp (the schedule's dominant cost) is skipped
         # on warmup/drain rounds instead of being computed and masked
         dp_mb, dx = lax.cond(b_valid, do_bwd, skip_bwd, (dy_in, x_saved))
-        dparams = _tree_scale_add(dparams, dp_mb, b_mask)
+        dparams = _tree_scale_add(dparams, dp_mb, f32(1))  # cond zeroed invalid
         # stage 0's dx is d(embedded input) — recorded for the caller's
         # embedding gradient
         is_first = ((me == 0) & b_valid)

@@ -18,6 +18,7 @@ Supported archive URIs on the fetch side:
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import shutil
@@ -30,6 +31,9 @@ from pathlib import Path
 log = logging.getLogger(__name__)
 
 ARCHIVE_NAME = "job_archive.tar.gz"
+# written next to the unpacked content recording the digest verified at
+# unpack time, so the idempotent-reuse path can enforce it too
+_DIGEST_MARKER = ".archive_sha256"
 # client-staged content worth shipping; logs/workdir/events are runtime output
 _SHIP_EXCLUDE = {"logs", "workdir", "driver.log", "driver_info.json",
                  ARCHIVE_NAME, "events"}
@@ -46,6 +50,15 @@ def build_job_archive(job_dir: str | Path) -> Path:
                 continue
             tf.add(entry, arcname=entry.name)
     return out
+
+
+def sha256_file(path: str | Path) -> str:
+    """Hex sha256 of a file, streamed."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 def upload_archive(archive: Path, uri: str, upload_cmd: str) -> None:
@@ -86,10 +99,16 @@ def fetch_archive(uri: str, dest: Path) -> Path:
     return dest
 
 
-def localize_job(uri: str, app_id: str, base_dir: str | None = None) -> str:
+def localize_job(uri: str, app_id: str, base_dir: str | None = None,
+                 sha256: str | None = None) -> str:
     """Executor side: fetch + unpack the job archive into a host-local
     directory and return it (the executor's job dir from then on) — reference
     Utils.extractResources (util/Utils.java:758-771).
+
+    When `sha256` is given (frozen at submit time), the fetched bytes are
+    verified BEFORE unpack and a mismatch raises — a tampered or truncated
+    archive must never execute (the integrity role of the reference's
+    kerberized HDFS staging, TonyClient.java:981-1030).
 
     Idempotent per (base, app_id): a directory that already holds the frozen
     config is reused, so multiple executors on one host fetch once."""
@@ -99,7 +118,20 @@ def localize_job(uri: str, app_id: str, base_dir: str | None = None) -> str:
                 or Path(tempfile.gettempdir()) / "tony-localized")
     target = base / app_id
     final = target / FINAL_CONF_NAME
+    marker = target / _DIGEST_MARKER
     if final.exists():
+        # the reuse path must uphold the same integrity guarantee as a fresh
+        # fetch: the unpacker records what it verified in a marker file, and
+        # a digest-expecting caller refuses a dir localized without (or with
+        # a different) verification rather than executing unchecked content
+        if sha256:
+            recorded = marker.read_text().strip() if marker.exists() else ""
+            if recorded != sha256.lower():
+                raise ValueError(
+                    f"localized job dir {target} was unpacked from an archive "
+                    f"with sha256 {recorded or '<unverified>'}, but this task "
+                    f"expects {sha256} — refusing to reuse it"
+                )
         log.info("job already localized at %s", target)
         return str(target)
     base.mkdir(parents=True, exist_ok=True)
@@ -107,6 +139,14 @@ def localize_job(uri: str, app_id: str, base_dir: str | None = None) -> str:
     tmp = Path(tempfile.mkdtemp(prefix=f"{app_id}-fetch-", dir=str(base)))
     try:
         archive = fetch_archive(uri, tmp / ARCHIVE_NAME)
+        if sha256:
+            got = sha256_file(archive)
+            if got != sha256.lower():
+                raise ValueError(
+                    f"job archive integrity check failed for {uri}: "
+                    f"expected sha256 {sha256}, fetched {got} — refusing to "
+                    f"unpack (tampered or truncated archive)"
+                )
         unpack = tmp / "unpacked"
         unpack.mkdir()
         with tarfile.open(archive) as tf:
@@ -118,6 +158,8 @@ def localize_job(uri: str, app_id: str, base_dir: str | None = None) -> str:
             raise FileNotFoundError(
                 f"archive at {uri} has no {FINAL_CONF_NAME} — not a job archive"
             )
+        if sha256:
+            (unpack / _DIGEST_MARKER).write_text(sha256.lower() + "\n")
         target.parent.mkdir(parents=True, exist_ok=True)
         try:
             os.replace(unpack, target)  # atomic: concurrent executors race safely
