@@ -1,0 +1,14 @@
+"""Task that simulates a spot preemption on the first attempt: session 0
+destroys the slice's state (as the cloud would) and dies; the retry
+(session 1, on the re-created slice) succeeds."""
+import os
+import sys
+from pathlib import Path
+
+slice_dir = Path(os.environ["STUB_SLICE_DIR"])
+session = int(os.environ["TONY_SESSION_ID"])
+if session == 0:
+    (slice_dir / "slice.json").unlink(missing_ok=True)
+    print("preempted: slice destroyed", file=sys.stderr)
+    sys.exit(1)
+print(f"attempt {session} ran on recreated slice")

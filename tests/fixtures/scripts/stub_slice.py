@@ -1,0 +1,64 @@
+"""Stub cloud CLI for slice-lifecycle tests: a 'slice' is a state dir.
+
+Models the gcloud surface the lifecycle templates wrap without any cloud:
+  create <dir> <n_hosts> [ready_after]  materialize; READY only after
+                                        ready_after further describes
+                                        (async allocation), generation++
+  describe <dir>                        one host per line when READY;
+                                        exit 1 while CREATING or absent
+  delete <dir>                          remove the slice (idempotent)
+
+Host names carry the generation (host0-g2 ...) so tests can assert a
+recreated slice came back with NEW addresses, like a real spot slice.
+"""
+import json
+import sys
+from pathlib import Path
+
+
+def main() -> int:
+    cmd, d = sys.argv[1], Path(sys.argv[2])
+    state_f = d / "slice.json"
+    if cmd == "create":
+        n = int(sys.argv[3])
+        ready_after = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+        d.mkdir(parents=True, exist_ok=True)
+        genf = d / "generation"
+        gen = int(genf.read_text()) + 1 if genf.exists() else 1
+        genf.write_text(str(gen))
+        state_f.write_text(
+            json.dumps({"n": n, "gen": gen, "polls_left": ready_after})
+        )
+        with (d / "create.log").open("a") as f:
+            f.write(f"create gen={gen}\n")
+    elif cmd == "describe":
+        if not state_f.exists():
+            print("NOT_FOUND", file=sys.stderr)
+            return 1
+        st = json.loads(state_f.read_text())
+        if st["polls_left"] > 0:
+            st["polls_left"] -= 1
+            state_f.write_text(json.dumps(st))
+            # mid-creation a real describe lists the endpoints provisioned
+            # so far: print a growing partial list, or fail while empty
+            partial = max(0, st["n"] - 1 - st["polls_left"])
+            if partial == 0:
+                print("CREATING", file=sys.stderr)
+                return 1
+            for i in range(partial):
+                print(f"host{i}-g{st['gen']}")
+            return 0
+        for i in range(st["n"]):
+            print(f"host{i}-g{st['gen']}")
+    elif cmd == "delete":
+        state_f.unlink(missing_ok=True)
+        d.mkdir(parents=True, exist_ok=True)
+        with (d / "delete.log").open("a") as f:
+            f.write("delete\n")
+    else:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

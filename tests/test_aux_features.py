@@ -370,6 +370,109 @@ def test_container_per_role_image_and_missing_image():
         build_container_command("c", {}, TonyConf({"tony.docker.enabled": True}))
 
 
+def _slice_conf(tmp_path, n_hosts=4, ready_after=0, accel="v5litepod-16",
+                **extra):
+    """Lifecycle conf wired to the stub cloud CLI (state dir = tmp_path)."""
+    stub = Path(__file__).parent / "fixtures" / "scripts" / "stub_slice.py"
+    d = tmp_path / "slice"
+    return TonyConf({
+        "tony.tpu.discover-command": f"{PY} {stub} describe {d}",
+        "tony.tpu.create-command":
+            f"{PY} {stub} create {d} {n_hosts} {ready_after}",
+        "tony.tpu.delete-command": f"{PY} {stub} delete {d}",
+        "tony.tpu.accelerator-type": accel,
+        "tony.tpu.create-timeout-s": 15,
+        "tony.tpu.create-poll-interval-s": 0.02,
+        **extra,
+    }), d
+
+
+def test_tpu_slice_create_await_ready_teardown(tmp_path):
+    """No pre-created slice: the provisioner materializes one, polls
+    through the CREATING phase to READY, and teardown deletes it — the
+    capacity-allocation half of the reference RM
+    (TonyClient.submitApplication:317-353, async grants
+    ApplicationMaster.java:1100-1119)."""
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    conf, d = _slice_conf(tmp_path, ready_after=2)
+    prov = TpuPodProvisioner(conf)
+    assert prov.created
+    assert prov.hosts == [f"host{i}-g1" for i in range(4)]
+    assert (d / "slice.json").exists()
+    prov.teardown()
+    assert not (d / "slice.json").exists()
+
+
+def test_tpu_slice_recreate_on_preemption(tmp_path):
+    """A pre-created slice is NOT driver-owned (teardown leaves it), but
+    once preemption destroys it, refresh() re-creates — and from then on
+    the driver owns the replacement."""
+    import subprocess as sp
+
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    conf, d = _slice_conf(tmp_path)
+    sp.run(str(conf.get("tony.tpu.create-command")), shell=True, check=True)
+    prov = TpuPodProvisioner(conf)
+    assert not prov.created  # discovered, not created
+    assert prov.hosts == [f"host{i}-g1" for i in range(4)]
+    prov.teardown()
+    assert (d / "slice.json").exists(), "teardown must not delete user slices"
+
+    (d / "slice.json").unlink()  # spot preemption destroys the slice
+    prov.refresh()
+    assert prov.created
+    assert prov.hosts == [f"host{i}-g2" for i in range(4)], \
+        "recreated slice must re-discover NEW host addresses"
+    prov.teardown()
+    assert not (d / "slice.json").exists()
+
+
+def test_tpu_slice_create_timeout_deletes_leak(tmp_path):
+    """A slice that never reaches READY fails allocation with a clear
+    timeout instead of hanging the driver — and the created-but-unready
+    slice is deleted, not leaked as untracked billable capacity."""
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    conf, d = _slice_conf(
+        tmp_path, ready_after=10_000,
+        **{"tony.tpu.create-timeout-s": 0.2},
+    )
+    with pytest.raises(TimeoutError, match="not READY"):
+        TpuPodProvisioner(conf)
+    assert not (d / "slice.json").exists(), "unready slice leaked"
+
+
+def test_tpu_slice_carcass_cleared_before_create(tmp_path):
+    """Submitting while a preemption carcass (wrong host count) still holds
+    the slice name: the provisioner deletes the remnant first so the cloud
+    create doesn't fail with 'already exists'."""
+    import subprocess as sp
+
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    conf, d = _slice_conf(tmp_path)  # create command makes 4 hosts
+    stub = Path(__file__).parent / "fixtures" / "scripts" / "stub_slice.py"
+    sp.run(f"{PY} {stub} create {d} 2 0", shell=True, check=True)  # carcass
+    prov = TpuPodProvisioner(conf)
+    assert prov.created
+    assert prov.hosts == [f"host{i}-g2" for i in range(4)]
+    assert "delete" in (d / "delete.log").read_text()
+
+
+def test_tpu_slice_await_without_geometry_needs_stable_list(tmp_path):
+    """Without tony.tpu.accelerator-type there is no expected host count;
+    await-READY must not accept the first (possibly partial, mid-creation)
+    non-empty list — it waits for the list to repeat across two polls."""
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    conf, _ = _slice_conf(tmp_path, ready_after=2, accel="")
+    prov = TpuPodProvisioner(conf)
+    # the stub reports growing partials (2 then 3 hosts) before the full 4
+    assert prov.hosts == [f"host{i}-g1" for i in range(4)]
+
+
 def test_tpu_provisioner_refresh_rediscovers_hosts(tmp_path):
     """Driver retry must re-run discovery (a recreated spot slice has new
     addresses); static host lists are a no-op refresh."""

@@ -412,6 +412,47 @@ def test_driver_retry_after_failure(tmp_job_dirs, fixture_script, tmp_path):
     assert status == JobStatus.SUCCEEDED, dump_logs(client)
 
 
+def test_e2e_slice_lifecycle_create_preempt_recreate_delete(
+    tmp_job_dirs, fixture_script, tmp_path
+):
+    """The full RM-capacity lifecycle through a real job: no slice exists at
+    submit, so the driver CREATES one (awaiting READY through the stub's
+    CREATING phase), the first attempt is 'preempted' (the task destroys the
+    slice state and dies), the retry RE-CREATES the slice with new host
+    addresses and succeeds, and teardown DELETES the driver-created slice —
+    reference TonyClient.submitApplication:317-353 +
+    ApplicationMaster.java:1100-1119, driven by a stub gcloud."""
+    stub = fixture_script("stub_slice.py")
+    d = tmp_path / "slice"
+    status, client = run_job(
+        tmp_job_dirs,
+        **{
+            "tony.worker.instances": 1,
+            "tony.worker.command": f"{PY} {fixture_script('preempt_once.py')}",
+            "tony.am.retry-count": 1,
+            "tony.cluster.provisioner": "tpu-pod",
+            # stand-in for ssh: run the executor locally with the task env
+            "tony.cluster.launch-template":
+                "env {env} " + PY + " -S -m tony_tpu.executor",
+            "tony.tpu.discover-command": f"{PY} {stub} describe {d}",
+            "tony.tpu.create-command": f"{PY} {stub} create {d} 1 2",
+            "tony.tpu.delete-command": f"{PY} {stub} delete {d}",
+            "tony.tpu.accelerator-type": "v5litepod-8",  # 1-host slice
+            "tony.tpu.create-timeout-s": 15,
+            "tony.tpu.create-poll-interval-s": 0.02,
+            "tony.execution.env": f"STUB_SLICE_DIR={d}",
+        },
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    # created twice (initial + post-preemption), final teardown deleted it
+    creates = (d / "create.log").read_text().splitlines()
+    assert creates == ["create gen=1", "create gen=2"], creates
+    assert (d / "delete.log").exists()
+    assert not (d / "slice.json").exists(), "teardown must delete the slice"
+    out = (Path(client.job_dir) / "logs" / "worker_0.stdout").read_text()
+    assert "attempt 1 ran on recreated slice" in out, dump_logs(client)
+
+
 def test_real_jax_distributed_collective(tmp_job_dirs, fixture_script):
     """2-worker job where the user processes actually join jax.distributed
     via the coordinator address the runtime emitted, and run a psum. This is

@@ -225,6 +225,12 @@ def create_provisioner(conf: TonyConf) -> Provisioner:
         from .tpu import TpuPodProvisioner
 
         prov = TpuPodProvisioner(conf)
-        prov.validate_layout(conf)
+        try:
+            prov.validate_layout(conf)
+        except Exception:
+            # a layout rejection aborts the driver before stop() ever runs;
+            # release any slice the provisioner just created
+            prov.teardown()
+            raise
         return prov
     raise ValueError(f"unknown provisioner: {kind}")

@@ -13,6 +13,16 @@ Host discovery options:
   (e.g. `gcloud compute tpus tpu-vm describe $NAME --format=...`), run at
   driver start — keeps cloud specifics out of the core.
 
+Slice lifecycle (the RM capacity-*allocation* half — reference
+TonyClient.submitApplication:317-353, container asks TaskScheduler.java:100
+-102, async grants ApplicationMaster.java:1100-1119): when
+tony.tpu.create-command is configured and discovery finds no (or a partial)
+slice, the provisioner materializes one and polls discovery to READY; on
+spot preemption `refresh()` deletes the carcass and re-creates, and
+`teardown()` deletes only what this driver created. Without a create
+command the provisioner is discovery-only (pre-created slices), exactly as
+before.
+
 Slice geometry (chips/host, hosts/slice) for common accelerator types is
 tabulated so validation can reject role layouts that don't fit the slice.
 """
@@ -21,6 +31,7 @@ from __future__ import annotations
 
 import logging
 import subprocess
+import time
 
 from ..conf import TonyConf, keys
 from .provisioner import StaticHostProvisioner
@@ -68,46 +79,168 @@ def discover_hosts(conf: TonyConf) -> list[str]:
     return hosts
 
 
+def create_slice(conf: TonyConf) -> None:
+    """Run the configured create command (the submitApplication analogue).
+    Raises on nonzero exit — a create that the cloud rejects is a hard
+    submit error, not something to poll through."""
+    cmd = str(conf.get(keys.TPU_CREATE_COMMAND, "") or "")
+    if not cmd:
+        raise ValueError(f"{keys.TPU_CREATE_COMMAND} is not set")
+    log.info("creating tpu slice: %s", cmd)
+    out = subprocess.run(
+        cmd, shell=True, capture_output=True, text=True, timeout=1800
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"tpu slice create failed: {out.stderr.strip()}")
+
+
+def delete_slice(conf: TonyConf) -> bool:
+    """Run the configured delete command. Best-effort by design (the
+    carcass of a preempted slice may already be gone, and teardown must
+    not turn a finished job into a failed one): returns False and logs
+    instead of raising."""
+    cmd = str(conf.get(keys.TPU_DELETE_COMMAND, "") or "")
+    if not cmd:
+        return False
+    log.info("deleting tpu slice: %s", cmd)
+    try:
+        out = subprocess.run(
+            cmd, shell=True, capture_output=True, text=True, timeout=1800
+        )
+    except Exception:
+        log.exception("tpu slice delete errored")
+        return False
+    if out.returncode != 0:
+        log.warning("tpu slice delete failed: %s", out.stderr.strip())
+        return False
+    return True
+
+
+def await_slice_ready(conf: TonyConf, expected_hosts: int | None) -> list[str]:
+    """Poll discovery until the slice reports its full host complement —
+    the await-READY phase of allocation (the analogue of waiting for the
+    RM's async container grants). Discovery failures while the slice is
+    still materializing (cloud CLIs error on a not-yet-existing resource)
+    are part of the normal wait, not errors.
+
+    Without an accelerator type there is no expected host count, so a
+    mid-creation describe that lists only some endpoints cannot be told
+    from READY by size; the fallback heuristic is to require the host list
+    to be identical across two consecutive polls before declaring READY.
+    Set tony.tpu.accelerator-type for an exact check."""
+    timeout_s = float(conf.get(keys.TPU_CREATE_TIMEOUT_S, 1800))
+    poll_s = float(conf.get(keys.TPU_CREATE_POLL_S, 10))
+    deadline = time.monotonic() + timeout_s
+    last_state = "no hosts yet"
+    last_hosts: list[str] = []
+    while time.monotonic() < deadline:
+        try:
+            hosts = discover_hosts(conf)
+        except (RuntimeError, ValueError) as e:
+            last_state = str(e)
+            last_hosts = []
+        else:
+            if expected_hosts is not None:
+                if len(hosts) == expected_hosts:
+                    return hosts
+                last_state = f"{len(hosts)}/{expected_hosts} hosts"
+            elif hosts == last_hosts:
+                return hosts
+            else:
+                last_state = f"{len(hosts)} hosts (awaiting a stable list)"
+                last_hosts = hosts
+        time.sleep(poll_s)
+    raise TimeoutError(
+        f"tpu slice not READY after {timeout_s:.0f}s (last: {last_state})"
+    )
+
+
 class TpuPodProvisioner(StaticHostProvisioner):
-    """Gang launch over the hosts of one slice."""
+    """Gang launch over the hosts of one slice, with optional ownership of
+    the slice's lifecycle (create / await-READY / recreate / delete)."""
 
     def __init__(self, conf: TonyConf):
-        hosts = discover_hosts(conf)
-        accel = str(conf.get(keys.TPU_ACCELERATOR_TYPE, "") or "")
-        expected = slice_num_hosts(accel) if accel else None
-        if expected is not None and len(hosts) != expected:
-            raise ValueError(
-                f"accelerator {accel} has {expected} hosts, got {len(hosts)}"
-            )
-        super().__init__(hosts)
         self._conf = conf
-        self.accelerator_type = accel
-        log.info("tpu slice: %d hosts (%s)", len(hosts), accel or "unknown type")
+        self.accelerator_type = str(
+            conf.get(keys.TPU_ACCELERATOR_TYPE, "") or ""
+        )
+        # True once THIS provisioner materialized the slice: teardown only
+        # deletes driver-created capacity, never a user's pre-created slice
+        self.created = False
+        hosts = self._acquire()
+        template = str(
+            conf.get(keys.CLUSTER_LAUNCH_TEMPLATE, "") or ""
+        ) or None
+        super().__init__(hosts, launch_template=template)
+        log.info(
+            "tpu slice: %d hosts (%s)%s", len(hosts),
+            self.accelerator_type or "unknown type",
+            " [driver-created]" if self.created else "",
+        )
+
+    @property
+    def _expected_hosts(self) -> int | None:
+        return (slice_num_hosts(self.accelerator_type)
+                if self.accelerator_type else None)
+
+    def _acquire(self, during_refresh: bool = False) -> list[str]:
+        """Discover the slice; when absent/partial AND a create command is
+        configured, materialize it and poll to READY — the allocation half
+        of the reference RM (submitApplication:317-353 + async grants).
+        Shared by __init__ and refresh() so the two paths cannot drift."""
+        expected = self._expected_hosts
+        try:
+            hosts = discover_hosts(self._conf)
+            if expected is not None and len(hosts) != expected:
+                if during_refresh:
+                    raise ValueError(
+                        f"slice refresh found {len(hosts)} hosts, "
+                        f"accelerator {self.accelerator_type} has {expected} "
+                        "(slice still recreating?)"
+                    )
+                raise ValueError(
+                    f"accelerator {self.accelerator_type} has {expected} "
+                    f"hosts, got {len(hosts)}"
+                )
+            return hosts
+        except (RuntimeError, ValueError):
+            if not str(self._conf.get(keys.TPU_CREATE_COMMAND, "") or ""):
+                raise  # discovery-only mode: absent slice is the user's error
+        log.info("slice absent or partial; creating")
+        # clear any remnant under the same name first (a preemption carcass
+        # or half-created slice makes the cloud's create fail with "exists")
+        delete_slice(self._conf)
+        create_slice(self._conf)
+        self.created = True
+        try:
+            return await_slice_ready(self._conf, expected)
+        except Exception:
+            # a created-but-never-READY slice is billable capacity nothing
+            # tracks once this raise aborts the driver — delete it now
+            if delete_slice(self._conf):
+                self.created = False
+            raise
 
     def refresh(self) -> None:
-        """Re-run host discovery before a retry attempt. A preempted spot
-        slice comes back with NEW host addresses — without re-discovery
-        every retry would SSH the dead slice (the "re-acquire the slice,
-        not a container" retry unit, SURVEY.md §7). No-op for static host
-        lists (discover_hosts returns those first).
-
-        Validates the host count against the accelerator geometry exactly
-        like __init__ — a slice mid-recreation can report a partial host
-        list, and packing tasks onto it would break the one-TPU-task-per-
-        host invariant. Raising keeps the previous host list (the driver
-        logs and retries with it)."""
-        hosts = discover_hosts(self._conf)
-        expected = (slice_num_hosts(self.accelerator_type)
-                    if self.accelerator_type else None)
-        if expected is not None and len(hosts) != expected:
-            raise ValueError(
-                f"slice refresh found {len(hosts)} hosts, accelerator "
-                f"{self.accelerator_type} has {expected} (slice still "
-                "recreating?)"
-            )
+        """Re-acquire the slice before a retry attempt (the "re-acquire the
+        slice, not a container" retry unit, SURVEY.md §7). A preempted spot
+        slice comes back with NEW host addresses, so static host lists
+        aside, every retry must re-discover. When discovery shows the slice
+        gone (or partial) and a create command is configured, the carcass is
+        deleted and the slice re-created — recovery from a preemption that
+        destroyed the capacity outright. Raising keeps the previous host
+        list (the driver logs and retries with it)."""
+        hosts = self._acquire(during_refresh=True)
         if hosts != self.hosts:
             log.info("tpu slice refresh: hosts %s -> %s", self.hosts, hosts)
         self.hosts = hosts
+
+    def teardown(self) -> None:
+        """Delete the slice at job end — only if this driver created it
+        (symmetric with YARN releasing containers the RM granted; a user's
+        pre-created slice outlives the job)."""
+        if self.created:
+            delete_slice(self._conf)
 
     def validate_layout(self, conf: TonyConf) -> None:
         """Every TPU-holding task needs its own host (libtpu is exclusive

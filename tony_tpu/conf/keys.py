@@ -94,6 +94,19 @@ CLUSTER_LAUNCH_TEMPLATE = "tony.cluster.launch-template"
 TPU_TOPOLOGY = "tony.tpu.topology"  # e.g. v5e-8; "" = discover
 TPU_ACCELERATOR_TYPE = "tony.tpu.accelerator-type"
 TPU_DISCOVER_COMMAND = "tony.tpu.discover-command"  # prints one worker host per line
+# slice lifecycle (the RM capacity-allocation half, reference
+# TonyClient.submitApplication:317-353 + async container grants,
+# ApplicationMaster.java:1100-1119): command templates keep cloud CLIs out
+# of core. create-command materializes the slice (e.g. `gcloud compute tpus
+# tpu-vm create ...` or a queued-resources request); the driver then polls
+# discover-command until the slice reports its full host complement
+# (await-READY). delete-command tears down what the driver created — run at
+# job end only for driver-created slices, and before re-creation when a
+# preempted slice must be replaced.
+TPU_CREATE_COMMAND = "tony.tpu.create-command"
+TPU_DELETE_COMMAND = "tony.tpu.delete-command"
+TPU_CREATE_TIMEOUT_S = "tony.tpu.create-timeout-s"  # await-READY deadline
+TPU_CREATE_POLL_S = "tony.tpu.create-poll-interval-s"
 
 # ------------------------------------------------------------------ horovod
 HOROVOD_TEST_MODE = "tony.horovod.mode.test"              # stub rendezvous server

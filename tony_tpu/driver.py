@@ -560,6 +560,14 @@ class Driver:
         if self.events:
             self.events.stop(status.value)
         self.rpc_server.stop()
+        # release provisioner-owned capacity (driver-created TPU slices) —
+        # after the client ack so a slow delete never delays terminal state
+        teardown = getattr(self.provisioner, "teardown", None)
+        if callable(teardown):
+            try:
+                teardown()
+            except Exception:
+                log.exception("provisioner teardown failed")
 
 
 def main(argv: list[str] | None = None) -> int:
