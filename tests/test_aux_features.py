@@ -383,6 +383,9 @@ def _slice_conf(tmp_path, n_hosts=4, ready_after=0, accel="v5litepod-16",
         "tony.tpu.accelerator-type": accel,
         "tony.tpu.create-timeout-s": 15,
         "tony.tpu.create-poll-interval-s": 0.02,
+        # keep tests fast: absence is expected in most scenarios, so don't
+        # armor against flakes (the flake test overrides this)
+        "tony.tpu.discover-retries": 1,
         **extra,
     }), d
 
@@ -459,6 +462,45 @@ def test_tpu_slice_carcass_cleared_before_create(tmp_path):
     assert prov.created
     assert prov.hosts == [f"host{i}-g2" for i in range(4)]
     assert "delete" in (d / "delete.log").read_text()
+
+
+def test_tpu_slice_transient_discovery_flake_does_not_destroy(tmp_path):
+    """One transient describe failure (API 5xx, timeout) must NOT make the
+    lifecycle path delete+recreate healthy capacity: discovery is retried
+    tony.tpu.discover-retries times before the slice is declared gone."""
+    import subprocess as sp
+
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    conf, d = _slice_conf(tmp_path)
+    stub = Path(__file__).parent / "fixtures" / "scripts" / "stub_slice.py"
+    sp.run(f"{PY} {stub} create {d} 4 0", shell=True, check=True)
+    flaked = tmp_path / "flaked"
+    conf.set(
+        "tony.tpu.discover-command",
+        # first call fails (transient), later calls describe normally
+        f"if [ ! -f {flaked} ]; then touch {flaked}; echo 5xx >&2; exit 1; "
+        f"else {PY} {stub} describe {d}; fi",
+    )
+    conf.set("tony.tpu.discover-retries", 3)
+    prov = TpuPodProvisioner(conf)
+    assert not prov.created, "flake must not trigger the create path"
+    assert prov.hosts == [f"host{i}-g1" for i in range(4)]
+    assert not (d / "delete.log").exists(), "healthy slice was deleted"
+
+
+def test_tpu_slice_create_without_discovery_fails_fast(tmp_path):
+    """create-command with no discover mechanism is a config error reported
+    immediately, not a 30-minute await-READY against nothing."""
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    conf = TonyConf({
+        "tony.tpu.create-command": "true",
+        "tony.tpu.discover-retries": 1,
+        "tony.tpu.create-poll-interval-s": 0.01,
+    })
+    with pytest.raises(ValueError, match="no way to await READY"):
+        TpuPodProvisioner(conf)
 
 
 def test_tpu_slice_await_without_geometry_needs_stable_list(tmp_path):

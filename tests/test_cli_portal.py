@@ -106,6 +106,104 @@ def test_proxy_tunnels_bytes():
         upstream.close()
 
 
+def test_portal_paging_sorting_and_token(tmp_path):
+    """300 synthetic jobs page correctly through the JS-free sort/page
+    query params (the reference's DataTables index,
+    tony-portal/app/views/index.scala.html), and every route answers 401
+    without the bearer token (tony.portal.token)."""
+    from tony_tpu.events.history import history_file_name
+
+    inter = tmp_path / "hist" / "intermediate"
+    for i in range(300):
+        job = inter / f"app_{i:04d}"
+        job.mkdir(parents=True)
+        name = history_file_name(
+            f"app_{i:04d}", start_ms=1_000_000 + i * 1000,
+            end_ms=1_000_000 + i * 1000 + 500,
+            user=f"user{i % 7}", status="SUCCEEDED" if i % 3 else "FAILED",
+        )
+        (job / name).write_text("")
+    conf = TonyConf({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.intermediate": str(inter),
+        "tony.history.finished": str(tmp_path / "hist" / "finished"),
+        "tony.portal.token": "s3cret",
+    })
+    server = serve_portal(conf, port=0, block=False)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        def get(path, accept="application/json", token="s3cret",
+                via_header=True):
+            headers = {"Accept": accept}
+            if token and via_header:
+                headers["Authorization"] = f"Bearer {token}"
+            elif token:
+                path += ("&" if "?" in path else "?") + f"token={token}"
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", headers=headers
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, resp.read().decode()
+
+        # --- auth: every route 401s without the token, both auth forms work
+        for path in ("/", "/jobs/app_0001", "/config/app_0001",
+                     "/logs/app_0001"):
+            try:
+                get(path, token="")
+                assert False, f"expected 401 for {path}"
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+        try:
+            get("/", token="wrong")
+            assert False, "expected 401 for a bad token"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+        # non-ASCII token must be a clean 401, not a handler crash
+        try:
+            get("/?token=%C3%A9", token="")
+            assert False, "expected 401 for a non-ascii token"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+        assert get("/", via_header=True)[0] == 200
+        assert get("/?page=2", via_header=False)[0] == 200
+
+        # --- default: newest first, 50 per page
+        _, body = get("/")
+        jobs = json.loads(body)
+        assert len(jobs) == 50
+        assert jobs[0]["app_id"] == "app_0299"
+
+        # --- explicit sort + paging: job id ascending, page 3
+        _, body = get("/?sort=job&dir=asc&page=3&per=100")
+        jobs = json.loads(body)
+        assert len(jobs) == 100
+        assert jobs[0]["app_id"] == "app_0200"
+        assert jobs[-1]["app_id"] == "app_0299"
+
+        # --- last page is the remainder; out-of-range clamps to it
+        _, body = get("/?per=70&page=99")
+        jobs = json.loads(body)
+        assert len(jobs) == 300 - 4 * 70
+
+        # --- sort by user, status works
+        _, body = get("/?sort=user&dir=desc&per=5")
+        assert [j["user"] for j in json.loads(body)] == ["user6"] * 5
+        _, body = get("/?sort=status&dir=asc&per=5")
+        assert all(j["status"] == "FAILED" for j in json.loads(body))
+
+        # --- html keeps sort state, pager links, and the query token
+        _, body = get("/?sort=job&dir=asc&per=20&page=2", accept="text/html",
+                      via_header=False)
+        assert "page 2/15" in body
+        assert "next &raquo;" in body and "&laquo; prev" in body
+        assert "token=s3cret" in body  # links stay authorized
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 def test_portal_serves_history(tmp_job_dirs, fixture_script):
     # run a real job to generate history
     from tony_tpu.client import TonyClient

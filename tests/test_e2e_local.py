@@ -440,6 +440,7 @@ def test_e2e_slice_lifecycle_create_preempt_recreate_delete(
             "tony.tpu.accelerator-type": "v5litepod-8",  # 1-host slice
             "tony.tpu.create-timeout-s": 15,
             "tony.tpu.create-poll-interval-s": 0.02,
+            "tony.tpu.discover-retries": 1,
             "tony.execution.env": f"STUB_SLICE_DIR={d}",
         },
     )

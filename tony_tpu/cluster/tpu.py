@@ -82,13 +82,16 @@ def discover_hosts(conf: TonyConf) -> list[str]:
 def create_slice(conf: TonyConf) -> None:
     """Run the configured create command (the submitApplication analogue).
     Raises on nonzero exit — a create that the cloud rejects is a hard
-    submit error, not something to poll through."""
+    submit error, not something to poll through. The subprocess deadline is
+    the configured create timeout, so a blocking (non --async) create is
+    given the same budget as the await-READY poll."""
     cmd = str(conf.get(keys.TPU_CREATE_COMMAND, "") or "")
     if not cmd:
         raise ValueError(f"{keys.TPU_CREATE_COMMAND} is not set")
     log.info("creating tpu slice: %s", cmd)
     out = subprocess.run(
-        cmd, shell=True, capture_output=True, text=True, timeout=1800
+        cmd, shell=True, capture_output=True, text=True,
+        timeout=max(60.0, float(conf.get(keys.TPU_CREATE_TIMEOUT_S, 1800))),
     )
     if out.returncode != 0:
         raise RuntimeError(f"tpu slice create failed: {out.stderr.strip()}")
@@ -136,7 +139,9 @@ def await_slice_ready(conf: TonyConf, expected_hosts: int | None) -> list[str]:
     while time.monotonic() < deadline:
         try:
             hosts = discover_hosts(conf)
-        except (RuntimeError, ValueError) as e:
+        except (RuntimeError, ValueError, subprocess.SubprocessError) as e:
+            # SubprocessError: a describe that hangs/timeouts mid-allocation
+            # is part of the normal wait too, not a reason to abort
             last_state = str(e)
             last_hosts = []
         else:
@@ -187,32 +192,58 @@ class TpuPodProvisioner(StaticHostProvisioner):
         """Discover the slice; when absent/partial AND a create command is
         configured, materialize it and poll to READY — the allocation half
         of the reference RM (submitApplication:317-353 + async grants).
-        Shared by __init__ and refresh() so the two paths cannot drift."""
+        Shared by __init__ and refresh() so the two paths cannot drift.
+
+        Declaring the slice gone triggers delete+create, so a single
+        transient discovery flake (API 5xx, auth hiccup, describe timeout)
+        must not destroy healthy — possibly user-pre-created — capacity:
+        discovery is retried tony.tpu.discover-retries times before the
+        lifecycle path engages."""
         expected = self._expected_hosts
-        try:
-            hosts = discover_hosts(self._conf)
-            if expected is not None and len(hosts) != expected:
-                if during_refresh:
+        attempts = max(1, int(self._conf.get(keys.TPU_DISCOVER_RETRIES, 3)))
+        poll_s = float(self._conf.get(keys.TPU_CREATE_POLL_S, 10))
+        err: Exception | None = None
+        for attempt in range(attempts):
+            if attempt:
+                time.sleep(poll_s)
+            try:
+                hosts = discover_hosts(self._conf)
+                if expected is not None and len(hosts) != expected:
+                    if during_refresh:
+                        raise ValueError(
+                            f"slice refresh found {len(hosts)} hosts, "
+                            f"accelerator {self.accelerator_type} has "
+                            f"{expected} (slice still recreating?)"
+                        )
                     raise ValueError(
-                        f"slice refresh found {len(hosts)} hosts, "
                         f"accelerator {self.accelerator_type} has {expected} "
-                        "(slice still recreating?)"
+                        f"hosts, got {len(hosts)}"
                     )
-                raise ValueError(
-                    f"accelerator {self.accelerator_type} has {expected} "
-                    f"hosts, got {len(hosts)}"
-                )
-            return hosts
-        except (RuntimeError, ValueError):
-            if not str(self._conf.get(keys.TPU_CREATE_COMMAND, "") or ""):
-                raise  # discovery-only mode: absent slice is the user's error
+                return hosts
+            except (RuntimeError, ValueError,
+                    subprocess.SubprocessError) as e:
+                err = e
+                log.info("slice discovery attempt %d/%d: %s",
+                         attempt + 1, attempts, e)
+        assert err is not None
+        if not str(self._conf.get(keys.TPU_CREATE_COMMAND, "") or ""):
+            raise err  # discovery-only mode: absent slice is the user's error
+        if not (str(self._conf.get(keys.TPU_DISCOVER_COMMAND, "") or "")
+                or self._conf.get_list(keys.CLUSTER_STATIC_HOSTS)):
+            # fail the misconfiguration in seconds, not after polling the
+            # create timeout against a discovery that can never succeed
+            raise ValueError(
+                f"{keys.TPU_CREATE_COMMAND} is set but there is no way to "
+                f"await READY: configure {keys.TPU_DISCOVER_COMMAND} (or "
+                f"{keys.CLUSTER_STATIC_HOSTS})"
+            )
         log.info("slice absent or partial; creating")
-        # clear any remnant under the same name first (a preemption carcass
-        # or half-created slice makes the cloud's create fail with "exists")
-        delete_slice(self._conf)
-        create_slice(self._conf)
-        self.created = True
+        self.created = True  # even a failed create may leave capacity behind
         try:
+            # clear any remnant under the same name first (a preemption
+            # carcass or half-created slice makes the create fail "exists")
+            delete_slice(self._conf)
+            create_slice(self._conf)
             return await_slice_ready(self._conf, expected)
         except Exception:
             # a created-but-never-READY slice is billable capacity nothing

@@ -52,6 +52,10 @@ STAGING_DIR = "tony.staging.dir"
 HISTORY_DIR = "tony.history.location"
 HISTORY_INTERMEDIATE = "tony.history.intermediate"
 HISTORY_FINISHED = "tony.history.finished"
+# bearer token gating every portal route ("" = open); the analogue of the
+# reference portal living behind Hadoop-secured infra
+# (tony-portal/app/hadoop/Requirements.java)
+PORTAL_TOKEN = "tony.portal.token"
 HISTORY_RETENTION_SEC = "tony.history.retention-sec"
 HISTORY_MOVER_INTERVAL_MS = "tony.history.mover-interval-ms"
 SRC_DIR = "tony.application.src-dir"
@@ -107,6 +111,10 @@ TPU_CREATE_COMMAND = "tony.tpu.create-command"
 TPU_DELETE_COMMAND = "tony.tpu.delete-command"
 TPU_CREATE_TIMEOUT_S = "tony.tpu.create-timeout-s"  # await-READY deadline
 TPU_CREATE_POLL_S = "tony.tpu.create-poll-interval-s"
+# discovery attempts before the lifecycle path declares the slice gone and
+# deletes+recreates — armor against one transient describe flake destroying
+# healthy capacity
+TPU_DISCOVER_RETRIES = "tony.tpu.discover-retries"
 
 # ------------------------------------------------------------------ horovod
 HOROVOD_TEST_MODE = "tony.horovod.mode.test"              # stub rendezvous server

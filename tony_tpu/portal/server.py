@@ -9,12 +9,14 @@ in-process. Stdlib http.server: no web-framework dependency.
 
 from __future__ import annotations
 
+import hmac
 import html
 import json
 import logging
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
+from urllib.parse import parse_qs, urlencode, urlparse
 
 from ..conf import TonyConf, keys
 from ..events.handler import read_events
@@ -131,19 +133,82 @@ td,th{{border:1px solid #999;padding:4px 10px;text-align:left}}
 </head><body><h2>tony-tpu job history</h2>{body}</body></html>"""
 
 
-def _jobs_html(jobs: list[dict]) -> str:
+# sortable columns of the job index: query name -> job-dict key (the JS-free
+# counterpart of the reference's DataTables index,
+# tony-portal/app/views/index.scala.html)
+_SORT_KEYS = {
+    "job": "app_id", "user": "user", "started": "started_ms",
+    "completed": "completed_ms", "status": "status",
+}
+_DEFAULT_PER_PAGE = 50
+
+
+def sort_page_jobs(jobs: list[dict], qs: dict) -> tuple[list[dict], dict]:
+    """Apply ?sort/?dir/?page/?per to the job list; returns (page, info)
+    where info carries the resolved params + page count for link building."""
+    sort = qs.get("sort", ["started"])[0]
+    key = _SORT_KEYS.get(sort) or "started_ms"
+    if key == "started_ms" and sort != "started":
+        sort = "started"
+    direction = qs.get("dir", [""])[0]
+    if direction not in ("asc", "desc"):
+        # newest-first is the natural default for timestamps, a-z for text
+        direction = "desc" if key.endswith("_ms") else "asc"
+    jobs = sorted(jobs, key=lambda j: (j[key] is None, j[key]),
+                  reverse=direction == "desc")
+    try:
+        per = max(1, min(500, int(qs.get("per", [_DEFAULT_PER_PAGE])[0])))
+    except ValueError:
+        per = _DEFAULT_PER_PAGE
+    pages = max(1, -(-len(jobs) // per))
+    try:
+        page = max(1, min(pages, int(qs.get("page", [1])[0])))
+    except ValueError:
+        page = 1
+    info = {"sort": sort, "dir": direction, "page": page, "per": per,
+            "pages": pages, "total": len(jobs)}
+    return jobs[(page - 1) * per: page * per], info
+
+
+def _jobs_html(jobs: list[dict], info: dict, token: str = "") -> str:
+    def link(**over) -> str:
+        params = {"sort": info["sort"], "dir": info["dir"],
+                  "page": info["page"], "per": info["per"], **over}
+        if token:
+            params["token"] = token
+        return "/?" + urlencode(params)
+
+    def th(label: str, col: str) -> str:
+        if info["sort"] == col:  # clicking the active column flips it
+            mark = " ▾" if info["dir"] == "desc" else " ▴"
+            nxt = "asc" if info["dir"] == "desc" else "desc"
+        else:
+            mark, nxt = "", "asc"
+        return (f"<th><a href='{link(sort=col, dir=nxt, page=1)}'>"
+                f"{label}{mark}</a></th>")
+
+    tok_q = f"?token={token}" if token else ""
     rows = "".join(
-        f"<tr><td><a href='/jobs/{html.escape(j['app_id'])}'>{html.escape(j['app_id'])}</a></td>"
+        f"<tr><td><a href='/jobs/{html.escape(j['app_id'])}{tok_q}'>{html.escape(j['app_id'])}</a></td>"
         f"<td>{html.escape(j['user'])}</td>"
         f"<td>{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(j['started_ms']/1000))}</td>"
         f"<td class='{j['status']}'>{j['status']}</td>"
-        f"<td><a href='/config/{j['app_id']}'>config</a> "
-        f"<a href='/logs/{j['app_id']}'>logs</a></td></tr>"
+        f"<td><a href='/config/{j['app_id']}{tok_q}'>config</a> "
+        f"<a href='/logs/{j['app_id']}{tok_q}'>logs</a></td></tr>"
         for j in jobs
     )
+    pager = (
+        f"<p>{info['total']} jobs — page {info['page']}/{info['pages']}"
+        + (f" <a href='{link(page=info['page'] - 1)}'>&laquo; prev</a>"
+           if info["page"] > 1 else "")
+        + (f" <a href='{link(page=info['page'] + 1)}'>next &raquo;</a>"
+           if info["page"] < info["pages"] else "")
+        + "</p>"
+    )
     return _PAGE.format(
-        body="<table><tr><th>job</th><th>user</th><th>started</th>"
-             f"<th>status</th><th></th></tr>{rows}</table>"
+        body="<table><tr>" + th("job", "job") + th("user", "user")
+             + th("started", "started") + th("status", "status")
+             + f"<th></th></tr>{rows}</table>" + pager
     )
 
 
@@ -184,7 +249,7 @@ def _job_detail_html(app_id: str, events: list[dict]) -> str:
     return _PAGE.format(body=body)
 
 
-def make_handler(index: HistoryIndex):
+def make_handler(index: HistoryIndex, token: str = ""):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
             log.debug("portal: " + fmt, *args)
@@ -201,17 +266,37 @@ def make_handler(index: HistoryIndex):
             self._send(200 if obj is not None else 404,
                        json.dumps(obj, indent=2), "application/json")
 
+        def _authorized(self, qs: dict) -> bool:
+            """tony.portal.token gate on every route — the bearer-token
+            analogue of the reference portal sitting behind Hadoop-secured
+            infra (tony-portal/app/hadoop/Requirements.java). Accepts the
+            Authorization header (API clients) or ?token= (browsers)."""
+            if not token:
+                return True
+            header = self.headers.get("Authorization", "")
+            supplied = header[len("Bearer "):] if header.startswith("Bearer ") \
+                else qs.get("token", [""])[0]
+            # compare bytes: compare_digest raises TypeError on non-ASCII str
+            return hmac.compare_digest(supplied.encode(), token.encode())
+
         def do_GET(self):
-            parts = [p for p in self.path.split("?")[0].split("/") if p]
+            url = urlparse(self.path)
+            qs = parse_qs(url.query)
+            parts = [p for p in url.path.split("/") if p]
             want_json = "application/json" in self.headers.get("Accept", "") \
                 or self.path.startswith("/api/")
             if parts and parts[0] == "api":
                 parts = parts[1:]
+            if not self._authorized(qs):
+                return self._send(401, "unauthorized: supply the portal "
+                                  "token (Authorization: Bearer ... or "
+                                  "?token=...)", "text/plain")
             try:
                 if not parts:
-                    jobs = index.jobs()
-                    return self._json(jobs) if want_json else self._send(
-                        200, _jobs_html(jobs))
+                    page, info = sort_page_jobs(index.jobs(), qs)
+                    return self._json(page) if want_json else self._send(
+                        200, _jobs_html(page, info,
+                                        qs.get("token", [""])[0]))
                 kind, app_id = parts[0], parts[1] if len(parts) > 1 else ""
                 if kind == "jobs":
                     events = index.events(app_id)
@@ -241,6 +326,7 @@ def make_handler(index: HistoryIndex):
 
 def serve_portal(conf: TonyConf, port: int = 19886, block: bool = True):
     index = HistoryIndex(conf)
+    token = str(conf.get(keys.PORTAL_TOKEN, "") or "")
     mover = HistoryFileMover(
         str(conf.get(keys.HISTORY_INTERMEDIATE)),
         str(conf.get(keys.HISTORY_FINISHED)),
@@ -252,7 +338,7 @@ def serve_portal(conf: TonyConf, port: int = 19886, block: bool = True):
     )
     mover.start()
 
-    server = ThreadingHTTPServer(("0.0.0.0", port), make_handler(index))
+    server = ThreadingHTTPServer(("0.0.0.0", port), make_handler(index, token))
     log.info("portal on :%d", server.server_address[1])
     if block:
         try:
