@@ -169,29 +169,31 @@ def test_portal_paging_sorting_and_token(tmp_path):
         assert get("/", via_header=True)[0] == 200
         assert get("/?page=2", via_header=False)[0] == 200
 
-        # --- default: newest first, 50 per page
+        # --- bare JSON index keeps the pre-paging contract: the FULL list
         _, body = get("/")
         jobs = json.loads(body)
-        assert len(jobs) == 50
-        assert jobs[0]["app_id"] == "app_0299"
+        assert len(jobs) == 300
+        assert jobs[0]["app_id"] == "app_0299"  # newest first
 
-        # --- explicit sort + paging: job id ascending, page 3
+        # --- explicit sort + paging opts into the metadata envelope
         _, body = get("/?sort=job&dir=asc&page=3&per=100")
-        jobs = json.loads(body)
+        env = json.loads(body)
+        assert (env["total"], env["pages"], env["page"]) == (300, 3, 3)
+        jobs = env["jobs"]
         assert len(jobs) == 100
         assert jobs[0]["app_id"] == "app_0200"
         assert jobs[-1]["app_id"] == "app_0299"
 
         # --- last page is the remainder; out-of-range clamps to it
         _, body = get("/?per=70&page=99")
-        jobs = json.loads(body)
-        assert len(jobs) == 300 - 4 * 70
+        assert len(json.loads(body)["jobs"]) == 300 - 4 * 70
 
         # --- sort by user, status works
         _, body = get("/?sort=user&dir=desc&per=5")
-        assert [j["user"] for j in json.loads(body)] == ["user6"] * 5
+        assert [j["user"] for j in json.loads(body)["jobs"]] == ["user6"] * 5
         _, body = get("/?sort=status&dir=asc&per=5")
-        assert all(j["status"] == "FAILED" for j in json.loads(body))
+        assert all(j["status"] == "FAILED"
+                   for j in json.loads(body)["jobs"])
 
         # --- html keeps sort state, pager links, and the query token
         _, body = get("/?sort=job&dir=asc&per=20&page=2", accept="text/html",
@@ -199,6 +201,13 @@ def test_portal_paging_sorting_and_token(tmp_path):
         assert "page 2/15" in body
         assert "next &raquo;" in body and "&laquo; prev" in body
         assert "token=s3cret" in body  # links stay authorized
+
+        # --- the job-detail page's nav links carry the token too (an empty
+        # jhist yields an empty event list, which still renders)
+        _, body = get("/jobs/app_0001", accept="text/html", via_header=False)
+        assert "/config/app_0001?token=s3cret" in body
+        assert "/logs/app_0001?token=s3cret" in body
+        assert "href='/?token=s3cret'" in body
     finally:
         server.shutdown()
         server.server_close()

@@ -258,6 +258,57 @@ def test_e2e_ssh_launch_seam_with_localization(tmp_job_dirs, tmp_path):
         assert f"localized OK: {local_base / client.app_id}" in out, _logs(client)
 
 
+def test_e2e_multihost_jax_collective_via_ssh_seam(tmp_job_dirs, tmp_path):
+    """The full remote multi-host contract in ONE test (round-2 verdict #8):
+    StaticHostProvisioner places the two workers on two 'hosts' through the
+    {env} bash launch template (local stand-in for ssh), each executor
+    fetches + unpacks the SHIPPED archive (sha256-verified), runs the user
+    script from the shipped src tree, joins jax.distributed via the
+    TONY_COORDINATOR_ADDRESS/TONY_PROCESS_ID env contract, and the two
+    processes execute a real cross-process psum — the reference's
+    NM-launch + HDFS-localize + TF-gRPC data-plane path end to end."""
+    import shutil
+
+    import tony_tpu
+
+    repo_root = str(Path(tony_tpu.__file__).resolve().parent.parent)
+    src = tmp_path / "user_src"
+    src.mkdir()
+    shutil.copy(FIXTURES / "distributed_psum.py", src / "train.py")
+    local_base = tmp_path / "hostlocal"
+    conf = TonyConf({
+        "tony.staging.dir": tmp_job_dirs["staging"],
+        "tony.history.intermediate": tmp_job_dirs["history"] + "/intermediate",
+        "tony.am.monitor-interval-ms": 100,
+        "tony.task.registration-poll-interval-ms": 100,
+        "tony.application.src-dir": str(src),
+        "tony.worker.instances": 2,
+        "tony.worker.command": f"{PY} src/train.py",
+        "tony.task.localize": True,
+        "tony.cluster.provisioner": "static",
+        "tony.cluster.static-hosts": ["hostA", "hostB"],
+        "tony.cluster.launch-template":
+            "env {env} " + PY + " -S -m tony_tpu.executor",
+        "tony.execution.env": [
+            f"TONY_LOCAL_DIR={local_base}",
+            f"TONY_REPO_ROOT={repo_root}",
+        ],
+        # jax.distributed gloo bootstrap can take a few seconds
+        "tony.task.heartbeat-interval-ms": 1000,
+    })
+    status, client = _run(conf)
+    assert status == JobStatus.SUCCEEDED, _logs(client)
+    # both workers really ran from the localized unpack and joined the
+    # collective (0+1 ranks both present)
+    outs = [
+        (Path(client.job_dir) / "logs" / f"worker_{i}.stdout").read_text()
+        for i in (0, 1)
+    ]
+    assert any("process 0/2: collective OK" in o for o in outs), _logs(client)
+    assert any("process 1/2: collective OK" in o for o in outs), _logs(client)
+    assert (local_base / client.app_id / FINAL_CONF_NAME).exists()
+
+
 def test_static_template_kill_cascade(tmp_path):
     """stop_container on a template-launched handle must take down the whole
     process group — the template's shell AND whatever it exec'd (for real

@@ -199,6 +199,19 @@ class TpuPodProvisioner(StaticHostProvisioner):
         must not destroy healthy — possibly user-pre-created — capacity:
         discovery is retried tony.tpu.discover-retries times before the
         lifecycle path engages."""
+        create_cmd = str(self._conf.get(keys.TPU_CREATE_COMMAND, "") or "")
+        if create_cmd and not (
+            str(self._conf.get(keys.TPU_DISCOVER_COMMAND, "") or "")
+            or self._conf.get_list(keys.CLUSTER_STATIC_HOSTS)
+        ):
+            # fail the misconfiguration in seconds — before the retry loop
+            # and the create path burn minutes against a discovery that can
+            # never succeed
+            raise ValueError(
+                f"{keys.TPU_CREATE_COMMAND} is set but there is no way to "
+                f"await READY: configure {keys.TPU_DISCOVER_COMMAND} (or "
+                f"{keys.CLUSTER_STATIC_HOSTS})"
+            )
         expected = self._expected_hosts
         attempts = max(1, int(self._conf.get(keys.TPU_DISCOVER_RETRIES, 3)))
         poll_s = float(self._conf.get(keys.TPU_CREATE_POLL_S, 10))
@@ -226,17 +239,8 @@ class TpuPodProvisioner(StaticHostProvisioner):
                 log.info("slice discovery attempt %d/%d: %s",
                          attempt + 1, attempts, e)
         assert err is not None
-        if not str(self._conf.get(keys.TPU_CREATE_COMMAND, "") or ""):
+        if not create_cmd:
             raise err  # discovery-only mode: absent slice is the user's error
-        if not (str(self._conf.get(keys.TPU_DISCOVER_COMMAND, "") or "")
-                or self._conf.get_list(keys.CLUSTER_STATIC_HOSTS)):
-            # fail the misconfiguration in seconds, not after polling the
-            # create timeout against a discovery that can never succeed
-            raise ValueError(
-                f"{keys.TPU_CREATE_COMMAND} is set but there is no way to "
-                f"await READY: configure {keys.TPU_DISCOVER_COMMAND} (or "
-                f"{keys.CLUSTER_STATIC_HOSTS})"
-            )
         log.info("slice absent or partial; creating")
         self.created = True  # even a failed create may leave capacity behind
         try:

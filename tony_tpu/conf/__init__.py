@@ -120,7 +120,12 @@ class TonyConf:
         return bool(v)
 
     def get_list(self, key: str, default: str = "") -> list[str]:
-        raw = str(self._data.get(key, default) or "")
+        raw = self._data.get(key, default)
+        if isinstance(raw, (list, tuple)):
+            # native JSON lists pass through verbatim — stringifying them
+            # would comma-split "['a', 'b']" into quote-riddled garbage
+            return [str(s).strip() for s in raw if str(s).strip()]
+        raw = str(raw or "")
         return [s.strip() for s in re.split(r"[,\s]+", raw) if s.strip()]
 
     def set(self, key: str, value: Any) -> None:

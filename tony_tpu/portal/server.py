@@ -187,7 +187,7 @@ def _jobs_html(jobs: list[dict], info: dict, token: str = "") -> str:
         return (f"<th><a href='{link(sort=col, dir=nxt, page=1)}'>"
                 f"{label}{mark}</a></th>")
 
-    tok_q = f"?token={token}" if token else ""
+    tok_q = "?" + urlencode({"token": token}) if token else ""
     rows = "".join(
         f"<tr><td><a href='/jobs/{html.escape(j['app_id'])}{tok_q}'>{html.escape(j['app_id'])}</a></td>"
         f"<td>{html.escape(j['user'])}</td>"
@@ -212,10 +212,11 @@ def _jobs_html(jobs: list[dict], info: dict, token: str = "") -> str:
     )
 
 
-def _job_detail_html(app_id: str, events: list[dict]) -> str:
+def _job_detail_html(app_id: str, events: list[dict], token: str = "") -> str:
     """Job page: event timeline + per-task metrics pulled from
     TASK_FINISHED payloads (reference: tony-portal JobEventPage rendering
     the jhist event array, metrics embedded per TaskFinished.avsc)."""
+    tok_q = "?" + urlencode({"token": token}) if token else ""
     ev_rows = []
     metric_rows = []
     for e in events:
@@ -235,8 +236,9 @@ def _job_detail_html(app_id: str, events: list[dict]) -> str:
             )
     body = (
         f"<h3>{html.escape(app_id)}</h3>"
-        f"<p><a href='/'>all jobs</a> | <a href='/config/{html.escape(app_id)}'>config</a>"
-        f" | <a href='/logs/{html.escape(app_id)}'>logs</a></p>"
+        f"<p><a href='/{tok_q}'>all jobs</a> | "
+        f"<a href='/config/{html.escape(app_id)}{tok_q}'>config</a>"
+        f" | <a href='/logs/{html.escape(app_id)}{tok_q}'>logs</a></p>"
         "<h4>events</h4><table><tr><th>time</th><th>type</th><th>detail</th></tr>"
         + "".join(ev_rows) + "</table>"
     )
@@ -293,8 +295,17 @@ def make_handler(index: HistoryIndex, token: str = ""):
                                   "?token=...)", "text/plain")
             try:
                 if not parts:
-                    page, info = sort_page_jobs(index.jobs(), qs)
-                    return self._json(page) if want_json else self._send(
+                    jobs = index.jobs()
+                    if want_json:
+                        # back-compat: the bare JSON index returns the FULL
+                        # list; explicit sort/page params opt in to an
+                        # envelope carrying the pagination metadata
+                        if not ({"sort", "dir", "page", "per"} & qs.keys()):
+                            return self._json(jobs)
+                        page, info = sort_page_jobs(jobs, qs)
+                        return self._json({"jobs": page, **info})
+                    page, info = sort_page_jobs(jobs, qs)
+                    return self._send(
                         200, _jobs_html(page, info,
                                         qs.get("token", [""])[0]))
                 kind, app_id = parts[0], parts[1] if len(parts) > 1 else ""
@@ -302,7 +313,8 @@ def make_handler(index: HistoryIndex, token: str = ""):
                     events = index.events(app_id)
                     if want_json or events is None:
                         return self._json(events)
-                    return self._send(200, _job_detail_html(app_id, events))
+                    return self._send(200, _job_detail_html(
+                        app_id, events, qs.get("token", [""])[0]))
                 if kind == "config":
                     return self._json(index.config(app_id))
                 if kind == "logs":
