@@ -30,6 +30,11 @@ def main(argv=None) -> int:
     parser.add_argument("--batch-size", type=int, default=512)
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--metrics-out", default="")
+    parser.add_argument(
+        "--compile-cache", default="",
+        help="persistent XLA compilation cache dir (warm relaunches skip "
+             "the compile phase of launch-to-first-step)",
+    )
     args = parser.parse_args(argv)
 
     t_start = time.time()
@@ -40,6 +45,11 @@ def main(argv=None) -> int:
     from tony_tpu import train
     from tony_tpu.models.mnist import accuracy, init_mlp, loss_fn, synthetic_mnist
     from tony_tpu.parallel import MeshSpec, build_mesh
+
+    t_import = time.time()
+    if args.compile_cache:
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     info = train.init()
     mesh = build_mesh(MeshSpec(data=-1, fsdp=1))
@@ -58,6 +68,11 @@ def main(argv=None) -> int:
     params = jax.device_put(init_mlp(jax.random.PRNGKey(1)), repl)
     opt = optax.adam(args.lr)
     opt_state = jax.device_put(opt.init(params), repl)
+    # block on EVERY staged buffer: device_put is async and independent
+    # transfers have no ordering, so without this the dataset upload leaks
+    # into the compile phase of the launch breakdown
+    jax.block_until_ready((params, opt_state, xb_all, yb_all))
+    t_ready = time.time()  # backend up (tunnel dialed), data staged in HBM
 
     spc = min(args.steps_per_call, args.steps)
 
@@ -101,6 +116,13 @@ def main(argv=None) -> int:
         "window_call_times_s": [round(t, 5) for t in call_times],
         "steps_per_call": spc,
         "time_to_first_step_s": t_first_step - t_start,
+        # launch-latency breakdown (BASELINE.md metric 2 diagnosis): process
+        # start epoch lets the submitter compute its orchestration share
+        # (same-host clocks), the phases split the in-process remainder
+        "t_start_epoch": t_start,
+        "import_s": t_import - t_start,
+        "backend_and_data_s": t_ready - t_import,
+        "compile_first_block_s": t_first_step - t_ready,
         "final_loss": final_loss,
         "accuracy": acc,
         "num_devices": jax.device_count(),

@@ -50,6 +50,11 @@ class TransformerConfig:
     # attention implementation: "flash" (pallas), "ref" (XLA), "ring" /
     # "ulysses" (sequence-parallel over the `seq` mesh axis), or "auto"
     attn_impl: str = "auto"
+    # per-step kernel inside the ring SP path: "auto" (flash on TPU when the
+    # shape fits the envelope, else XLA blocks), or force "flash"/"xla" —
+    # "flash" off-TPU runs the Pallas kernel in interpret mode, which is how
+    # the multichip dryrun covers the kernel x SP composition on a CPU mesh
+    sp_kernel: str = "auto"
     # sliding-window (local) attention: each position sees its last
     # attn_window positions inclusive; 0 = full causal. Supported by the
     # flash and ref paths (block-pruned O(L*window) in the kernel)
@@ -202,7 +207,10 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
             raise ValueError("attn_impl='ring' requires a mesh")
         from ..parallel.ring_attention import make_ring_attention
 
-        return make_ring_attention(mesh, causal=cfg.causal)(q, k, v)
+        return make_ring_attention(
+            mesh, causal=cfg.causal,
+            impl=None if cfg.sp_kernel == "auto" else cfg.sp_kernel,
+        )(q, k, v)
     if impl == "ulysses":
         if mesh is None:
             raise ValueError("attn_impl='ulysses' requires a mesh")
