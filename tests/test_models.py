@@ -449,6 +449,57 @@ def test_generate_matches_teacher_forcing_greedy():
         seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
 
 
+def test_generate_int8_cache_option():
+    """kv_dtype='int8' (half the cache bytes) generates valid tokens; the
+    per-token-per-head symmetric quantizer's roundtrip error is bounded by
+    its 1/127 resolution."""
+    from tony_tpu.models.generate import _quantize_kv, generate
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 5, 16)) * 4.0
+    q, scale = _quantize_kv(x)
+    assert q.dtype == jnp.int8 and scale.shape == (2, 3, 5)
+    deq = np.asarray(q, np.float32) * np.asarray(scale, np.float32)[..., None]
+    xn = np.asarray(x)
+    amax = np.abs(xn).max(axis=-1, keepdims=True)
+    # half a quantization step per element, plus the bf16 rounding of the
+    # scale itself (8 mantissa bits -> ~2^-8 relative on the dequant)
+    bound = amax / 254.0 + np.abs(xn) * 2.0 ** -8 + 1e-6
+    assert (np.abs(deq - xn) <= bound).all(), \
+        float(np.max(np.abs(deq - xn) - bound))
+
+    params = transformer.init(jax.random.PRNGKey(0), TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                TINY.vocab_size)
+    out = generate(params, TINY, prompt, 6, kv_dtype="int8")
+    assert out.shape == (2, 6)
+    assert ((np.asarray(out) >= 0) & (np.asarray(out) < TINY.vocab_size)).all()
+
+
+def test_decode_precast_keeps_moe_router_f32():
+    """The decode weight pre-cast must NOT round the MoE router: _mlp reads
+    it at f32 precisely so expert routing isn't perturbed (a bf16-rounded
+    router can flip a close top-k margin and diverge cached generation
+    from the full forward)."""
+    import dataclasses
+
+    from tony_tpu.models.generate import _cast_decode_params
+
+    cfg = dataclasses.replace(
+        TINY, dtype=jnp.bfloat16, n_experts=4, expert_top_k=2
+    )
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    cast = _cast_decode_params(params, cfg)
+    assert cast["layers"]["router"].dtype == jnp.float32
+    assert cast["layers"]["wq"].dtype == jnp.bfloat16
+    assert cast["embed"].dtype == jnp.bfloat16
+    # bf16 MoE decode runs end to end with the f32 router
+    from tony_tpu.models.generate import generate
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                cfg.vocab_size)
+    out = generate(params, cfg, prompt, 4)
+    assert out.shape == (2, 4)
+
+
 def test_generate_gqa_cache_matches_teacher_forcing():
     """GQA config (cache stored at n_kv_heads) must also match."""
     from tony_tpu.models.generate import generate

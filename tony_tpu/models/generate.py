@@ -14,8 +14,13 @@ choices:
   kv-heads, not query heads.
 - **Prefill != decode only in length.** One `_forward_with_cache` handles
   both: prefill runs it at L=prompt_len (causal within the block), each
-  decode step at L=1 — same weights path as training (`transformer._qkv`,
-  `_mlp`), so there is no train/serve numerical drift.
+  decode step at L=1. Dense models run fused q/k/v and gate/up projections
+  (one skinny GEMV each instead of 3+2 — decode is weight-streaming-bound);
+  the fusion is a concatenation of the training weights, so values match
+  the `transformer._qkv`/`_mlp` path exactly. Weights are pre-cast to
+  cfg.dtype once per call (identical rounding to the forward's per-use
+  casts; the f32 MoE router excepted). `kv_dtype="int8"` is the one option
+  that genuinely changes numerics vs the full forward.
 
 Sampling: greedy (temperature=0), temperature, and top-k.
 
@@ -39,13 +44,39 @@ NEG_INF = -1e30
 
 
 class KVCache(NamedTuple):
-    k: jax.Array      # [n_layers, B, max_len, n_kv_heads, head_dim]
+    k: jax.Array      # [n_layers, B, n_kv_heads, max_len, head_dim]
     v: jax.Array
     length: jax.Array  # scalar int32: number of valid positions
+    # int8 mode only: per-(layer, batch, kv-head, position) dequant scales
+    # ([n_layers, B, n_kv_heads, max_len]); None when the cache is native
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
 
 
-def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
+               kv_dtype: str = "native") -> KVCache:
+    """kv_dtype "native" stores cfg.dtype (exact); "int8" stores
+    per-token-per-head symmetric int8 with bf16 scales — half the cache
+    read traffic on a decode path that is HBM-bound, at the cost of
+    quantization rounding (generation is no longer bit-exact vs the full
+    forward).
+
+    Layout puts the position axis INSIDE the head axis ([..., kvH, M, D]):
+    decode attention reads one head's whole history at a time, and with
+    position outermost that read is strided by kvH*D — measured ~3x below
+    streaming bandwidth on v5e. Head-major, each head's [M, D] block is
+    contiguous."""
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    if kv_dtype == "int8":
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            length=jnp.int32(0),
+            k_scale=jnp.zeros(shape[:-1], jnp.bfloat16),
+            v_scale=jnp.zeros(shape[:-1], jnp.bfloat16),
+        )
+    if kv_dtype != "native":
+        raise ValueError(f"kv_dtype must be 'native' or 'int8', got {kv_dtype!r}")
     return KVCache(
         k=jnp.zeros(shape, cfg.dtype),
         v=jnp.zeros(shape, cfg.dtype),
@@ -53,25 +84,45 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
     )
 
 
-def _cached_attention(cfg, q, ck, cv, cache_len, l_new):
+def _quantize_kv(x):
+    """[B, kvH, L, D] -> (int8 values, [B, kvH, L] scales): symmetric
+    per-token-per-head quantization over the head_dim vector."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale.astype(jnp.bfloat16)
+
+
+def _cached_attention(cfg, q, ck, cv, cache_len, l_new,
+                      k_scale=None, v_scale=None):
     """q: [B, L, H, D] for the L new positions (absolute offsets cache_len..
-    cache_len+L-1); ck/cv: [B, max_len, kvH, D] full cache buffers (already
+    cache_len+L-1); ck/cv: [B, kvH, max_len, D] full cache buffers (already
     containing the new keys). Scores run against the whole static buffer;
     invalid/future positions are masked by index.
 
     GQA is a grouped einsum — query heads are folded to [kvH, rep] and
     contracted against the UN-repeated cache, so no n_heads-wide copy of
     the cache is ever materialized (that copy would undo the compressed
-    cache's HBM saving on every decode step)."""
+    cache's HBM saving on every decode step).
+
+    int8 caches arrive with per-token-per-head scales. NOTE: XLA currently
+    materializes the dequantized bf16 buffer instead of fusing the convert
+    into the einsum read, so int8 does NOT reduce time on this path — it
+    halves cache HBM *capacity* (docs/performance.md, decode roofline)."""
     b, l, h, d = q.shape
-    kvh = ck.shape[2]
+    kvh = ck.shape[1]
     rep = h // kvh
+    if k_scale is not None:
+        ck = ck.astype(cfg.dtype) * k_scale.astype(cfg.dtype)[..., None]
+        cv = cv.astype(cfg.dtype) * v_scale.astype(cfg.dtype)[..., None]
     q5 = q.reshape(b, l, kvh, rep, d)
     scale = cfg.head_dim ** -0.5
     s = jnp.einsum(
-        "blgrd,bmgd->bgrlm", q5, ck, preferred_element_type=jnp.float32
+        "blgrd,bgmd->bgrlm", q5, ck, preferred_element_type=jnp.float32
     ) * scale                                           # [B, kvH, rep, L, M]
-    key_pos = jnp.arange(ck.shape[1])                   # [max_len]
+    key_pos = jnp.arange(ck.shape[2])                   # [max_len]
     q_pos = cache_len + jnp.arange(l_new)               # [L] absolute
     mask = key_pos[None, :] <= q_pos[:, None]           # causal + validity
     if cfg.attn_window:
@@ -80,39 +131,132 @@ def _cached_attention(cfg, q, ck, cv, cache_len, l_new):
         mask &= key_pos[None, :] > q_pos[:, None] - cfg.attn_window
     s = jnp.where(mask[None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bgrlm,bmgd->blgrd", p.astype(cv.dtype), cv)
+    out = jnp.einsum("bgrlm,bgmd->blgrd", p.astype(cv.dtype), cv)
     return out.reshape(b, l, h, d)
 
 
-def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache):
+def _cast_decode_params(params, cfg: TransformerConfig):
+    """Pre-cast f32 master weights to the activation dtype once per
+    generate call. Decode is weight-bandwidth-bound — every step reads the
+    full parameter set, and the training-path convention of casting at use
+    (`.astype(dt)` per op) makes each step read 2x the bytes AND write a
+    copy. Numerically identical to the full forward for every weight the
+    forward reads at cfg.dtype (same f32->bf16 rounding; the per-use casts
+    become no-ops). The MoE ROUTER is the one exception — `_mlp`
+    deliberately reads it at f32 so expert choice isn't perturbed by
+    rounding — so it keeps its dtype."""
+    if cfg.dtype == jnp.float32:
+        return params
+    router = params["layers"].get("router") if cfg.n_experts > 0 else None
+    params = jax.tree.map(
+        lambda a: a.astype(cfg.dtype) if a.dtype == jnp.float32 else a,
+        params,
+    )
+    if router is not None:
+        params["layers"]["router"] = router
+    return params
+
+
+def _fuse_decode_weights(params, cfg: TransformerConfig):
+    """Concatenate per-layer q/k/v and gate/up projection weights into one
+    matrix each ([L, d, h*hd + 2*kvh*hd] and [L, d, 2*f]). Decode-step
+    matmuls are skinny GEMVs whose cost is streaming the weight matrix;
+    fusing 3+2 of them into 1+1 halves the kernel count per layer and
+    streams bigger contiguous blocks. Built once per generate call
+    (amortized over all decode steps); dense MLP only."""
+    L, d = cfg.n_layers, cfg.d_model
+    lp = params["layers"]
+    wqkv = jnp.concatenate([
+        lp["wq"].reshape(L, d, -1),
+        lp["wk"].reshape(L, d, -1),
+        lp["wv"].reshape(L, d, -1),
+    ], axis=-1)
+    w_gu = jnp.concatenate([lp["w_gate"], lp["w_up"]], axis=-1)
+    return {"wqkv": wqkv, "w_gu": w_gu}
+
+
+def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
+                        fused: dict | None = None):
     """Run L new tokens (absolute positions cache.length..+L-1) through the
     stack, reading/writing the cache -> (last-position logits [B, V] f32,
     new cache). Only the LAST position is projected through the unembed —
     generation never needs earlier logits, and a full [B, L, V] prefill
     projection would be a pure HBM bonfire at long prompts / large vocab
-    (the same tensor the blockwise-CE training path exists to avoid)."""
+    (the same tensor the blockwise-CE training path exists to avoid).
+
+    The layer loop is UNROLLED (Python loop), not a lax.scan: a scan would
+    have to thread the cache as per-layer xs/ys, which makes XLA re-read and
+    re-write the ENTIRE cache buffer every decode step — ~2x the cache's
+    footprint in pure overhead traffic on a path that is HBM-bound. Unrolled,
+    the cache stays one carried buffer that each layer updates in place with
+    a dynamic_update_slice of just the L new positions (donation keeps it
+    zero-copy across decode steps); measured ~1.7x decode throughput on the
+    flagship model at batch 8."""
     dt = cfg.dtype
     b, l = tokens.shape
     positions = jnp.broadcast_to(cache.length + jnp.arange(l), (b, l))
     x = params["embed"].astype(dt)[tokens]
 
-    def body(x, layer_in):
-        lp, ck_l, cv_l = layer_in
+    hd = cfg.head_dim
+    nq, nkv = cfg.n_heads * hd, cfg.n_kv_heads * hd
+    ck, cv = cache.k, cache.v
+    ks_buf, vs_buf = cache.k_scale, cache.v_scale
+    int8_cache = ck.dtype == jnp.int8
+    zero = jnp.int32(0)
+    for i in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
         h = rms_norm(x, lp["attn_norm"])
-        q, k, v = transformer._qkv(cfg, h, positions, lp)
-        ck_l = lax.dynamic_update_slice_in_dim(ck_l, k.astype(dt), cache.length, axis=1)
-        cv_l = lax.dynamic_update_slice_in_dim(cv_l, v.astype(dt), cache.length, axis=1)
-        attn = _cached_attention(cfg, q, ck_l, cv_l, cache.length, l)
+        if fused is not None:
+            qkv = jnp.einsum("bld,de->ble", h, fused["wqkv"][i].astype(dt))
+            q = qkv[..., :nq].reshape(b, l, cfg.n_heads, hd)
+            k = qkv[..., nq:nq + nkv].reshape(b, l, cfg.n_kv_heads, hd)
+            v = qkv[..., nq + nkv:].reshape(b, l, cfg.n_kv_heads, hd)
+            q = transformer.rope(q, positions, cfg.rope_theta)
+            k = transformer.rope(k, positions, cfg.rope_theta)
+        else:
+            q, k, v = transformer._qkv(cfg, h, positions, lp)
+        k_hm = k.transpose(0, 2, 1, 3)  # [B, kvH, L, D] head-major
+        v_hm = v.transpose(0, 2, 1, 3)
+        if int8_cache:
+            k_w, ks = _quantize_kv(k_hm)
+            v_w, vs = _quantize_kv(v_hm)
+            ks_buf = lax.dynamic_update_slice(
+                ks_buf, ks[None], (jnp.int32(i), zero, zero, cache.length)
+            )
+            vs_buf = lax.dynamic_update_slice(
+                vs_buf, vs[None], (jnp.int32(i), zero, zero, cache.length)
+            )
+        else:
+            k_w, v_w = k_hm.astype(dt), v_hm.astype(dt)
+        ck = lax.dynamic_update_slice(
+            ck, k_w[None], (jnp.int32(i), zero, zero, cache.length, zero)
+        )
+        cv = lax.dynamic_update_slice(
+            cv, v_w[None], (jnp.int32(i), zero, zero, cache.length, zero)
+        )
+        attn = _cached_attention(
+            cfg, q, ck[i], cv[i], cache.length, l,
+            ks_buf[i] if int8_cache else None,
+            vs_buf[i] if int8_cache else None,
+        )
         x = x + jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
-        mlp_out, _ = transformer._mlp(cfg, rms_norm(x, lp["mlp_norm"]), lp)
-        return x + mlp_out, (ck_l, cv_l)
+        hh = rms_norm(x, lp["mlp_norm"])
+        if fused is not None:
+            gu = jnp.einsum("bld,de->ble", hh, fused["w_gu"][i].astype(dt))
+            gate, up = gu[..., :cfg.d_ff], gu[..., cfg.d_ff:]
+            mlp_out = jnp.einsum(
+                "blf,fd->bld", jax.nn.silu(gate) * up, lp["w_down"].astype(dt)
+            )
+        else:
+            mlp_out, _ = transformer._mlp(cfg, hh, lp)
+        x = x + mlp_out
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x_last = rms_norm(x[:, -1], params["final_norm"])
     logits = jnp.einsum(
         "bd,dv->bv", x_last, params["unembed"].astype(dt)
     ).astype(jnp.float32)
-    new_cache = KVCache(k=new_k, v=new_v, length=cache.length + l)
+    new_cache = KVCache(k=ck, v=cv, length=cache.length + l,
+                        k_scale=ks_buf, v_scale=vs_buf)
     return logits, new_cache
 
 
@@ -129,7 +273,8 @@ def sample_token(logits, key, temperature: float = 0.0, top_k: int = 0):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k")
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k",
+                              "kv_dtype", "max_len")
 )
 def generate(
     params,
@@ -140,11 +285,21 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     key: jax.Array | None = None,
+    kv_dtype: str = "native",
+    max_len: int | None = None,
 ) -> jax.Array:
     """Generate max_new_tokens continuations -> [B, max_new_tokens] int32.
 
     Whole loop is jitted: prefill once, then a lax.scan of single-token
-    decode steps against the in-place cache."""
+    decode steps against the in-place cache.
+
+    ``kv_dtype="int8"`` stores the KV cache quantized (per-token-per-head
+    symmetric int8, bf16 scales) — half the cache's HBM capacity; "native"
+    (default) is bit-exact vs the full forward.
+
+    ``max_len`` fixes the cache capacity independently of this call's
+    prompt+new length (servers that reuse one compiled program across
+    request lengths want one capacity; attention cost scales with it)."""
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}"
@@ -156,6 +311,7 @@ def generate(
         )
     if key is None:
         key = jax.random.PRNGKey(0)
+    params = _cast_decode_params(params, cfg)
     if cfg.n_experts > 0:
         # decode routes B*1 tokens at a time; the training capacity formula
         # (cf * tokens * k / E) would then drop any token that collides with
@@ -168,15 +324,25 @@ def generate(
                 cfg.capacity_factor, cfg.n_experts / cfg.expert_top_k),
         )
     b, lp_len = prompt.shape
-    cache = init_cache(cfg, b, lp_len + max_new_tokens)
-    logits, cache = _forward_with_cache(params, cfg, prompt, cache)
+    if max_len is None:
+        max_len = lp_len + max_new_tokens
+    elif max_len < lp_len + max_new_tokens:
+        raise ValueError(
+            f"max_len={max_len} < prompt ({lp_len}) + max_new_tokens "
+            f"({max_new_tokens})"
+        )
+    fused = _fuse_decode_weights(params, cfg) if cfg.n_experts == 0 else None
+    cache = init_cache(cfg, b, max_len, kv_dtype)
+    logits, cache = _forward_with_cache(params, cfg, prompt, cache, fused)
     key, sub = jax.random.split(key)
     first = sample_token(logits, sub, temperature, top_k)
 
     def step(carry, _):
         tok, cache, key = carry
         key, sub = jax.random.split(key)
-        logits, cache = _forward_with_cache(params, cfg, tok[:, None], cache)
+        logits, cache = _forward_with_cache(
+            params, cfg, tok[:, None], cache, fused
+        )
         nxt = sample_token(logits, sub, temperature, top_k)
         return (nxt, cache, key), nxt
 
