@@ -133,6 +133,34 @@ def test_gqa_and_remat_variants():
     assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree.leaves(grads))
 
 
+def test_remat_policy_attn_matches_full():
+    """remat_policy='attn' (pin the flash forward's out+lse residuals so
+    the backward never re-runs the kernel) must produce the same loss and
+    gradients as full remat — it changes what is cached, not what is
+    computed. attn_impl='flash' so the named residuals actually exist
+    (interpret-mode kernel on CPU)."""
+    import dataclasses
+
+    base = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, dtype=jnp.float32, attn_impl="flash", remat=True,
+    )
+    params = transformer.init(jax.random.PRNGKey(0), base)
+    tokens, targets = synthetic_lm_batch(jax.random.PRNGKey(0), 2, 16, 64)
+    outs = {}
+    for policy in ("full", "attn"):
+        cfg = dataclasses.replace(base, remat_policy=policy)
+        outs[policy] = jax.value_and_grad(transformer.loss_fn)(
+            params, tokens, targets, cfg
+        )
+    np.testing.assert_allclose(float(outs["full"][0]), float(outs["attn"][0]),
+                               rtol=1e-6)
+    for gf, ga in zip(jax.tree.leaves(outs["full"][1]),
+                      jax.tree.leaves(outs["attn"][1])):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(ga),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_mnist_mlp_learns():
     x, y = synthetic_mnist(jax.random.PRNGKey(0), n=2048)
     params = init_mlp(jax.random.PRNGKey(1), sizes=(784, 128, 10))

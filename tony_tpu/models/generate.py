@@ -56,10 +56,10 @@ class KVCache(NamedTuple):
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                kv_dtype: str = "native") -> KVCache:
     """kv_dtype "native" stores cfg.dtype (exact); "int8" stores
-    per-token-per-head symmetric int8 with bf16 scales — half the cache
-    read traffic on a decode path that is HBM-bound, at the cost of
-    quantization rounding (generation is no longer bit-exact vs the full
-    forward).
+    per-token-per-head symmetric int8 with bf16 scales — half the cache's
+    HBM *capacity* (2x the context per GB; NOT a speed win — see
+    _cached_attention), at the cost of quantization rounding (generation
+    is no longer bit-exact vs the full forward).
 
     Layout puts the position axis INSIDE the head axis ([..., kvH, M, D]):
     decode attention reads one head's whole history at a time, and with

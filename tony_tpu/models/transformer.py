@@ -293,9 +293,19 @@ def apply_hidden(
             policy = None
         elif cfg.remat_policy == "dots":
             policy = jax.checkpoint_policies.dots_saveable
+        elif cfg.remat_policy == "attn":
+            # save ONLY the attention output + its logsumexp (named inside
+            # the flash custom_vjp forward rule, ops/attention.py — they
+            # are exactly the kernel's backward residuals) so the remat
+            # backward recomputes the cheap elementwise/matmul ops but
+            # never re-runs the flash forward, whose cost grows
+            # quadratically with L while everything else is linear
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "attn_lse"
+            )
         else:
             raise ValueError(
-                f"remat_policy must be 'full' or 'dots', got "
+                f"remat_policy must be 'full', 'dots', or 'attn', got "
                 f"{cfg.remat_policy!r}"
             )
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
