@@ -246,6 +246,37 @@ def test_ulysses_attention_matches_full(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
 
 
+def test_ulysses_with_flash_local_kernel_matches_full():
+    """Ulysses with the Pallas flash kernel as the local attention
+    (interpret mode here; what TPU jobs run via attn_fn auto-dispatch or
+    TransformerConfig.sp_kernel='flash') must match full attention."""
+    import functools
+
+    from tony_tpu.ops.attention import attention_blhd
+    from tony_tpu.parallel import make_ulysses_attention
+
+    mesh = build_mesh(MeshSpec(fsdp=1, seq=4, tensor=1, data=2))
+    key = jax.random.PRNGKey(1)
+    b, l, h, d = 2, 64, 4, 16
+    q, k, v = (
+        jax.random.normal(kk, (b, l, h, d), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    uly = make_ulysses_attention(
+        mesh, causal=True,
+        attn_fn=functools.partial(attention_blhd, causal=True),
+    )
+    spec = P(None, "seq", None, None)
+    qs, ks, vs = (
+        jax.device_put(a, jax.sharding.NamedSharding(mesh, spec))
+        for a in (q, k, v)
+    )
+    out = jax.jit(uly)(qs, ks, vs)
+    expected = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=2e-5)
+
+
 def test_ulysses_attention_gradients_flow():
     from tony_tpu.parallel import make_ulysses_attention
 

@@ -216,7 +216,23 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh):
             raise ValueError("attn_impl='ulysses' requires a mesh")
         from ..parallel.ulysses import make_ulysses_attention
 
-        return make_ulysses_attention(mesh, causal=cfg.causal)(q, k, v)
+        attn_fn = None  # auto: flash on TPU, reference elsewhere
+        if cfg.sp_kernel == "flash":
+            from ..ops.attention import attention_blhd
+
+            attn_fn = functools.partial(attention_blhd, causal=cfg.causal)
+        elif cfg.sp_kernel == "xla":
+            attn_fn = functools.partial(
+                reference_attention, causal=cfg.causal
+            )
+        elif cfg.sp_kernel != "auto":  # match the ring path's validation
+            raise ValueError(
+                f"sp_kernel must be 'auto', 'flash', or 'xla', got "
+                f"{cfg.sp_kernel!r}"
+            )
+        return make_ulysses_attention(
+            mesh, causal=cfg.causal, attn_fn=attn_fn
+        )(q, k, v)
     return reference_attention(q, k, v, causal=cfg.causal, window=window)
 
 
