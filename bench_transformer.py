@@ -282,12 +282,14 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
         jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
     )
 
-    def walltime(n_new: int) -> float:
-        int(generate(params, cfg, prompt, n_new, max_len=max_len)[0, 0])
+    def walltime(n_new: int, kv_dtype: str = "native") -> float:
+        int(generate(params, cfg, prompt, n_new, max_len=max_len,
+                     kv_dtype=kv_dtype)[0, 0])
         times = []
         for _ in range(reps):
             t0 = time.time()
-            out = generate(params, cfg, prompt, n_new, max_len=max_len)
+            out = generate(params, cfg, prompt, n_new, max_len=max_len,
+                           kv_dtype=kv_dtype)
             int(out[0, 0])  # hard sync
             times.append(time.time() - t0)
         return statistics.median(times)
@@ -296,6 +298,10 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
     dt_short = walltime(short_new)
     step_s = (dt - dt_short) / (new_tokens - short_new)
     overhead_s = max(0.0, dt - (new_tokens - 1) * step_s)
+    # int8 cache arm: device step only (same program shape, half the cache
+    # bytes with scale-folded reads)
+    q_step_s = (walltime(new_tokens, "int8")
+                - walltime(short_new, "int8")) / (new_tokens - short_new)
     return {
         "batch": batch,
         "prompt_len": prompt_len,
@@ -306,6 +312,8 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
         "device_step_ms": round(step_s * 1000, 3),
         "device_tokens_per_sec": round(batch / step_s, 1),
         "call_overhead_s": round(overhead_s, 3),
+        "int8_cache_device_step_ms": round(q_step_s * 1000, 3),
+        "int8_cache_device_tokens_per_sec": round(batch / q_step_s, 1),
     }
 
 

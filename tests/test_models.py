@@ -503,6 +503,35 @@ def test_generate_int8_cache_option():
     assert ((np.asarray(out) >= 0) & (np.asarray(out) < TINY.vocab_size)).all()
 
 
+def test_int8_scale_folded_attention_matches_explicit_dequant():
+    """The scale-folded int8 attention (K scale on score columns
+    post-matmul, V scale pre-applied to probs) must equal attention over
+    an explicitly dequantized cache — guards the broadcast axes."""
+    import dataclasses
+
+    from tony_tpu.models.generate import _cached_attention, _quantize_kv
+
+    cfg = dataclasses.replace(TINY, dtype=jnp.float32)
+    key = jax.random.PRNGKey(3)
+    b, l, kvh, d, m = 2, 1, TINY.n_heads, TINY.head_dim, 24
+    kq = jax.random.split(key, 3)
+    q = jax.random.normal(kq[0], (b, l, TINY.n_heads, d))
+    k = jax.random.normal(kq[1], (b, kvh, m, d)) * 2.0  # head-major
+    v = jax.random.normal(kq[2], (b, kvh, m, d)) * 2.0
+    k_int, ks = _quantize_kv(k)
+    v_int, vs = _quantize_kv(v)
+    cache_len, l_new = jnp.int32(m - 1), 1
+
+    folded = _cached_attention(cfg, q, k_int, v_int, cache_len, l_new,
+                               k_scale=ks, v_scale=vs)
+    k_deq = k_int.astype(jnp.float32) * np.asarray(ks, np.float32)[..., None]
+    v_deq = v_int.astype(jnp.float32) * np.asarray(vs, np.float32)[..., None]
+    explicit = _cached_attention(cfg, q, jnp.asarray(k_deq),
+                                 jnp.asarray(v_deq), cache_len, l_new)
+    np.testing.assert_allclose(np.asarray(folded), np.asarray(explicit),
+                               atol=2e-2)
+
+
 def test_decode_precast_keeps_moe_router_f32():
     """The decode weight pre-cast must NOT round the MoE router: _mlp reads
     it at f32 precisely so expert routing isn't perturbed (a bf16-rounded
