@@ -106,7 +106,7 @@ def bench_train(steps: int, batch: int) -> dict:
 
 
 def _timed_train_run(seq_len: int, batch: int, steps: int, windows: int = 4,
-                     remat_policy: str = "full"):
+                     remat_policy: str = "full", attn_window: int = 0):
     """Build the flagship config at `seq_len`, train `windows` timed windows
     of `steps` steps each, and return (cfg, timing, n_params). One timing
     methodology for every train bench: window timing dispatches the steps
@@ -125,7 +125,7 @@ def _timed_train_run(seq_len: int, batch: int, steps: int, windows: int = 4,
     cfg = transformer.TransformerConfig(
         vocab_size=32768, d_model=1024, n_layers=12, n_heads=8, n_kv_heads=8,
         d_ff=4096, max_seq_len=seq_len, dtype=jnp.bfloat16, attn_impl="auto",
-        remat=True, remat_policy=remat_policy,
+        remat=True, remat_policy=remat_policy, attn_window=attn_window,
     )
     mesh = build_mesh(MeshSpec(data=-1, fsdp=1))
     bundle = create_train_step(cfg, mesh)
@@ -362,6 +362,35 @@ def bench_long_context(seq_lens=(8192, 16384, 32768), steps: int = 4,
                     k: v for k, v in prior[f"L{L}"].items() if k != "error"
                 }
             out[f"L{L}"] = entry
+
+    # sliding-window showcase at the longest L: the band-pruned kernel's
+    # O(L*window) cost vs full causal's O(L^2) (window 4096 ~= mistral).
+    # Only meaningful when the band is a strict subset of the sequence.
+    L, win = max(seq_lens, default=0), 4096
+    if L <= win:
+        return out
+    key = f"L{L}_window{win}"
+    batch = max(1, TOKENS_PER_STEP // L)
+    try:
+        _, timing, _ = _timed_train_run(
+            seq_len=L, batch=batch, steps=steps, windows=3,
+            remat_policy="attn", attn_window=win,
+        )
+        toks = batch * L
+        out[key] = {
+            "batch": batch,
+            "attn_window": win,
+            "step_time_s": round(timing["step_s"], 3),
+            "tokens_per_sec": round(toks / timing["step_s"], 1),
+            "loss_finite": timing["loss_finite"],
+        }
+    except Exception as e:
+        entry = {"error": str(e)[:200]}
+        if prior and isinstance(prior.get(key), dict):
+            entry["last_good"] = {
+                k: v for k, v in prior[key].items() if k != "error"
+            }
+        out[key] = entry
     return out
 
 
