@@ -277,6 +277,31 @@ def test_ulysses_with_flash_local_kernel_matches_full():
                                atol=2e-5)
 
 
+def test_ring_flash_remat_attn_composition_trains():
+    """ring SP x forced flash kernel x remat_policy='attn' (the named
+    residuals now live inside a scanned shard_map) must compile and
+    produce a finite training step — the combination a long-context
+    multi-host job actually runs."""
+    from tony_tpu.models import transformer
+    from tony_tpu.parallel import DP_RULES
+    from tony_tpu.train import create_train_step, synthetic_lm_batch
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, max_seq_len=32, dtype=jnp.float32, remat=True,
+        remat_policy="attn", sp_kernel="flash",
+    )
+    mesh = build_mesh(MeshSpec(data=2, fsdp=1, seq=4))
+    bundle = create_train_step(cfg, mesh, rules=dict(DP_RULES),
+                               sp_impl="ring")
+    tokens, targets = synthetic_lm_batch(jax.random.PRNGKey(0), 4, 32, 128)
+    tokens = jax.device_put(tokens, bundle.tok_sharding)
+    targets = jax.device_put(targets, bundle.tok_sharding)
+    _, _, m = bundle.step_fn(bundle.params, bundle.opt_state, tokens,
+                             targets)
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_ulysses_attention_gradients_flow():
     from tony_tpu.parallel import make_ulysses_attention
 

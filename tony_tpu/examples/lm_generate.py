@@ -40,6 +40,11 @@ def main(argv=None) -> int:
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--top-k", type=int, default=0)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--kv-dtype", default="native",
+                        choices=("native", "int8"),
+                        help="'int8' quantizes the KV cache: half the HBM "
+                             "capacity and faster long-context decode, at "
+                             "the cost of bit-exactness vs the full forward")
     parser.add_argument("--metrics-out", default="")
     args = parser.parse_args(argv)
 
@@ -82,14 +87,14 @@ def main(argv=None) -> int:
     out = generate(
         params, cfg, prompt, args.max_new,
         temperature=args.temperature, top_k=args.top_k,
-        key=jax.random.PRNGKey(args.seed),
+        key=jax.random.PRNGKey(args.seed), kv_dtype=args.kv_dtype,
     )
     jax.block_until_ready(out)          # exclude compile from timing
     t0 = time.time()
     out = generate(
         params, cfg, prompt, args.max_new,
         temperature=args.temperature, top_k=args.top_k,
-        key=jax.random.PRNGKey(args.seed),
+        key=jax.random.PRNGKey(args.seed), kv_dtype=args.kv_dtype,
     )
     jax.block_until_ready(out)
     wall = time.time() - t0
@@ -99,6 +104,7 @@ def main(argv=None) -> int:
         "tokens": tokens,
         "decode_tokens_per_sec": args.max_new / wall,
         "backend": jax.default_backend(),
+        "kv_dtype": args.kv_dtype,
     }
     print(" ".join(str(t) for t in tokens))
     print(f"# {args.max_new} tokens in {wall:.2f}s "
