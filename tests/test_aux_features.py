@@ -376,10 +376,10 @@ def _slice_conf(tmp_path, n_hosts=4, ready_after=0, accel="v5litepod-16",
     stub = Path(__file__).parent / "fixtures" / "scripts" / "stub_slice.py"
     d = tmp_path / "slice"
     return TonyConf({
-        "tony.tpu.discover-command": f"{PY} {stub} describe {d}",
+        "tony.tpu.discover-command": f"{PY} -S {stub} describe {d}",
         "tony.tpu.create-command":
-            f"{PY} {stub} create {d} {n_hosts} {ready_after}",
-        "tony.tpu.delete-command": f"{PY} {stub} delete {d}",
+            f"{PY} -S {stub} create {d} {n_hosts} {ready_after}",
+        "tony.tpu.delete-command": f"{PY} -S {stub} delete {d}",
         "tony.tpu.accelerator-type": accel,
         "tony.tpu.create-timeout-s": 15,
         "tony.tpu.create-poll-interval-s": 0.02,
@@ -457,7 +457,7 @@ def test_tpu_slice_carcass_cleared_before_create(tmp_path):
 
     conf, d = _slice_conf(tmp_path)  # create command makes 4 hosts
     stub = Path(__file__).parent / "fixtures" / "scripts" / "stub_slice.py"
-    sp.run(f"{PY} {stub} create {d} 2 0", shell=True, check=True)  # carcass
+    sp.run(f"{PY} -S {stub} create {d} 2 0", shell=True, check=True)  # carcass
     prov = TpuPodProvisioner(conf)
     assert prov.created
     assert prov.hosts == [f"host{i}-g2" for i in range(4)]
@@ -474,13 +474,13 @@ def test_tpu_slice_transient_discovery_flake_does_not_destroy(tmp_path):
 
     conf, d = _slice_conf(tmp_path)
     stub = Path(__file__).parent / "fixtures" / "scripts" / "stub_slice.py"
-    sp.run(f"{PY} {stub} create {d} 4 0", shell=True, check=True)
+    sp.run(f"{PY} -S {stub} create {d} 4 0", shell=True, check=True)
     flaked = tmp_path / "flaked"
     conf.set(
         "tony.tpu.discover-command",
         # first call fails (transient), later calls describe normally
         f"if [ ! -f {flaked} ]; then touch {flaked}; echo 5xx >&2; exit 1; "
-        f"else {PY} {stub} describe {d}; fi",
+        f"else {PY} -S {stub} describe {d}; fi",
     )
     conf.set("tony.tpu.discover-retries", 3)
     prov = TpuPodProvisioner(conf)

@@ -434,9 +434,9 @@ def test_e2e_slice_lifecycle_create_preempt_recreate_delete(
             # stand-in for ssh: run the executor locally with the task env
             "tony.cluster.launch-template":
                 "env {env} " + PY + " -S -m tony_tpu.executor",
-            "tony.tpu.discover-command": f"{PY} {stub} describe {d}",
-            "tony.tpu.create-command": f"{PY} {stub} create {d} 1 2",
-            "tony.tpu.delete-command": f"{PY} {stub} delete {d}",
+            "tony.tpu.discover-command": f"{PY} -S {stub} describe {d}",
+            "tony.tpu.create-command": f"{PY} -S {stub} create {d} 1 2",
+            "tony.tpu.delete-command": f"{PY} -S {stub} delete {d}",
             "tony.tpu.accelerator-type": "v5litepod-8",  # 1-host slice
             "tony.tpu.create-timeout-s": 15,
             "tony.tpu.create-poll-interval-s": 0.02,

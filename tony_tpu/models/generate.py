@@ -4,11 +4,14 @@ The decode path the training stack doesn't need but users do. TPU-first
 choices:
 
 - **Static shapes everywhere.** The cache is allocated once at
-  ``prompt_len + max_new_tokens`` and written in place with
-  ``dynamic_update_slice``; attention always scores against the full cache
-  buffer with an index mask (positions ``> current`` masked to -inf) instead
-  of growing tensors — so the whole generate loop is one ``lax.scan`` under
-  one jit, no per-step recompilation.
+  ``prompt_len + max_new_tokens`` (or a pinned ``max_len``) and written in
+  place with ``dynamic_update_slice``; decode steps score against the full
+  static cache buffer with an index mask (positions ``> current`` masked to
+  -inf) instead of growing tensors — so the whole generate loop is one
+  ``lax.scan`` under one jit, no per-step recompilation. Prefill is the
+  exception: the cache is empty there, so it runs plain causal attention
+  over the prompt via the model's own kernel (flash on TPU) rather than
+  scoring against the whole buffer.
 - **GQA-aware cache.** K/V are cached at ``n_kv_heads`` (the GQA-compressed
   width); heads are repeated at attention time, so cache HBM scales with
   kv-heads, not query heads.
@@ -145,6 +148,18 @@ def _cached_attention(cfg, q, ck, cv, cache_len, l_new,
     return out.reshape(b, l, h, d)
 
 
+def _prefill_cfg(cfg: TransformerConfig) -> TransformerConfig:
+    """The config used for the prefill attention dispatch: the model's own
+    impl, except sequence-parallel impls (ring/ulysses need a mesh and a
+    seq-sharded layout decode doesn't have) fall back to single-device
+    auto dispatch."""
+    if cfg.attn_impl in ("ring", "ulysses"):
+        import dataclasses
+
+        return dataclasses.replace(cfg, attn_impl="auto")
+    return cfg
+
+
 def _cast_decode_params(params, cfg: TransformerConfig):
     """Pre-cast f32 master weights to the activation dtype once per
     generate call. Decode is weight-bandwidth-bound — every step reads the
@@ -186,7 +201,7 @@ def _fuse_decode_weights(params, cfg: TransformerConfig):
 
 
 def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
-                        fused: dict | None = None):
+                        fused: dict | None = None, prefill: bool = False):
     """Run L new tokens (absolute positions cache.length..+L-1) through the
     stack, reading/writing the cache -> (last-position logits [B, V] f32,
     new cache). Only the LAST position is projected through the unembed —
@@ -201,7 +216,17 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
     the cache stays one carried buffer that each layer updates in place with
     a dynamic_update_slice of just the L new positions (donation keeps it
     zero-copy across decode steps); measured ~1.7x decode throughput on the
-    flagship model at batch 8."""
+    flagship model at batch 8.
+
+    ``prefill=True`` asserts the cache is EMPTY (generate's first call):
+    attention over (cache + new) then reduces to causal attention within
+    the block itself and runs through the model's own _attention (the
+    flash kernel on TPU, O(block) memory; numerics identical to the
+    training forward) instead of scoring q against the whole max_len
+    buffer, whose f32 [.., L, max_len] scores OOM at long prompts (~18GB
+    at L=8192, batch 8 on the flagship). A chunked-prefill caller feeding
+    L > 1 into a NON-empty cache must pass prefill=False to get the
+    general cached-attention path."""
     dt = cfg.dtype
     b, l = tokens.shape
     positions = jnp.broadcast_to(cache.length + jnp.arange(l), (b, l))
@@ -209,6 +234,7 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
 
     hd = cfg.head_dim
     nq, nkv = cfg.n_heads * hd, cfg.n_kv_heads * hd
+    p_cfg = _prefill_cfg(cfg) if prefill else None
     ck, cv = cache.k, cache.v
     ks_buf, vs_buf = cache.k_scale, cache.v_scale
     int8_cache = ck.dtype == jnp.int8
@@ -244,11 +270,15 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
         cv = lax.dynamic_update_slice(
             cv, v_w[None], (jnp.int32(i), zero, zero, cache.length, zero)
         )
-        attn = _cached_attention(
-            cfg, q, ck[i], cv[i], cache.length, l,
-            ks_buf[i] if int8_cache else None,
-            vs_buf[i] if int8_cache else None,
-        )
+        if prefill:
+            kr, vr = transformer._repeat_kv(cfg, k, v)
+            attn = transformer._attention(q, kr, vr, p_cfg, None)
+        else:
+            attn = _cached_attention(
+                cfg, q, ck[i], cv[i], cache.length, l,
+                ks_buf[i] if int8_cache else None,
+                vs_buf[i] if int8_cache else None,
+            )
         x = x + jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
         hh = rms_norm(x, lp["mlp_norm"])
         if fused is not None:
@@ -344,7 +374,8 @@ def generate(
         )
     fused = _fuse_decode_weights(params, cfg) if cfg.n_experts == 0 else None
     cache = init_cache(cfg, b, max_len, kv_dtype)
-    logits, cache = _forward_with_cache(params, cfg, prompt, cache, fused)
+    logits, cache = _forward_with_cache(params, cfg, prompt, cache, fused,
+                                        prefill=True)
     key, sub = jax.random.split(key)
     first = sample_token(logits, sub, temperature, top_k)
 
