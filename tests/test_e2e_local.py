@@ -92,6 +92,23 @@ def test_jax_ranks_are_distinct(tmp_job_dirs, fixture_script, tmp_path):
     assert ranks == ["rank_0", "rank_1", "rank_2"]
 
 
+def test_large_gang_48_workers(tmp_job_dirs):
+    """Moderate-scale gang: 48 executors allocate, pass the gang barrier,
+    register, heartbeat, and complete — the task-table/scheduler/liveness
+    machinery at the container counts the reference's YARN deployments run
+    (each worker asserts it sees the full gang size). ~9s wall."""
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 48,
+           "tony.worker.command":
+               PY + " -S -c \"import os; "
+               "assert os.environ['TONY_NUM_TOTAL_TASKS']=='48'\""},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    assert len(client.task_infos) == 48
+    assert all(t.status == "SUCCEEDED" for t in client.task_infos)
+
+
 def test_worker_failure_fails_job(tmp_job_dirs, fixture_script):
     status, client = run_job(
         tmp_job_dirs,

@@ -15,15 +15,17 @@ choices:
 - **GQA-aware cache.** K/V are cached at ``n_kv_heads`` (the GQA-compressed
   width); heads are repeated at attention time, so cache HBM scales with
   kv-heads, not query heads.
-- **Prefill != decode only in length.** One `_forward_with_cache` handles
-  both: prefill runs it at L=prompt_len (causal within the block), each
-  decode step at L=1. Dense models run fused q/k/v and gate/up projections
-  (one skinny GEMV each instead of 3+2 — decode is weight-streaming-bound);
-  the fusion is a concatenation of the training weights, so values match
-  the `transformer._qkv`/`_mlp` path exactly. Weights are pre-cast to
-  cfg.dtype once per call (identical rounding to the forward's per-use
-  casts; the f32 MoE router excepted). `kv_dtype="int8"` is the one option
-  that genuinely changes numerics vs the full forward.
+- **One `_forward_with_cache` for prefill and decode** — same projections,
+  cache writes, and unembed; they differ in the attention read (prefill:
+  the model's own kernel over the prompt; decode: `_cached_attention` over
+  the static buffer — see above). Dense models run fused q/k/v and gate/up
+  projections (one skinny GEMV each instead of 3+2 — decode is
+  weight-streaming-bound); the fusion is a concatenation of the training
+  weights, so values match the `transformer._qkv`/`_mlp` path exactly.
+  Weights are pre-cast to cfg.dtype once per call (identical rounding to
+  the forward's per-use casts; the f32 MoE router excepted).
+  `kv_dtype="int8"` is the one option that genuinely changes numerics vs
+  the full forward.
 
 Sampling: greedy (temperature=0), temperature, and top-k.
 
