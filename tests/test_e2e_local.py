@@ -471,6 +471,103 @@ def test_e2e_slice_lifecycle_create_preempt_recreate_delete(
     assert "attempt 1 ran on recreated slice" in out, dump_logs(client)
 
 
+def test_e2e_killed_job_releases_created_slice(
+    tmp_job_dirs, fixture_script, tmp_path
+):
+    """SIGTERM to the driver (a client kill) must delete a slice the driver
+    created — otherwise a killed job leaks billable capacity that nothing
+    tracks afterwards."""
+    import signal
+    import subprocess
+
+    stub = fixture_script("stub_slice.py")
+    d = tmp_path / "slice"
+    conf = base_conf(
+        tmp_job_dirs,
+        **{
+            "tony.worker.instances": 1,
+            "tony.worker.command": f"{PY} {fixture_script('sleep_long.py')}",
+            "tony.cluster.provisioner": "tpu-pod",
+            "tony.cluster.launch-template":
+                "env {env} " + PY + " -S -m tony_tpu.executor",
+            "tony.tpu.discover-command": f"{PY} -S {stub} describe {d}",
+            "tony.tpu.create-command": f"{PY} -S {stub} create {d} 1 0",
+            "tony.tpu.delete-command": f"{PY} -S {stub} delete {d}",
+            "tony.tpu.accelerator-type": "v5litepod-8",
+            "tony.tpu.create-poll-interval-s": 0.02,
+            "tony.tpu.discover-retries": 1,
+        },
+    )
+    client = TonyClient(conf, poll_interval_s=0.1)
+    client.submit()
+    # wait past startup: the executor's stdout file existing means the
+    # driver created the slice, installed its signal handlers, and launched
+    log_f = Path(client.job_dir) / "logs" / "worker_0.stdout"
+    deadline = time.time() + 30
+    while time.time() < deadline and not log_f.exists():
+        time.sleep(0.1)
+    assert log_f.exists(), "driver never launched the worker"
+    assert (d / "slice.json").exists(), "driver never created the slice"
+    client._driver_proc.send_signal(signal.SIGTERM)
+    try:
+        client._driver_proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        client._driver_proc.kill()
+        raise AssertionError("driver did not exit on SIGTERM")
+    deadline = time.time() + 10
+    while time.time() < deadline and (d / "slice.json").exists():
+        time.sleep(0.1)
+    assert not (d / "slice.json").exists(), \
+        "killed driver leaked its created slice"
+
+
+def test_e2e_kill_during_await_ready_releases_slice(
+    tmp_job_dirs, fixture_script, tmp_path
+):
+    """The likeliest kill window: SIGTERM while the driver is still inside
+    the (possibly minutes-long) await-READY poll. The provisioner registers
+    itself with the signal path BEFORE acquisition, so the slice it just
+    created is deleted even though Driver construction never finished."""
+    import signal
+    import subprocess
+
+    stub = fixture_script("stub_slice.py")
+    d = tmp_path / "slice"
+    conf = base_conf(
+        tmp_job_dirs,
+        **{
+            "tony.worker.instances": 1,
+            "tony.worker.command": "true",
+            "tony.cluster.provisioner": "tpu-pod",
+            "tony.tpu.discover-command": f"{PY} -S {stub} describe {d}",
+            # never reaches READY within this test
+            "tony.tpu.create-command": f"{PY} -S {stub} create {d} 1 100000",
+            "tony.tpu.delete-command": f"{PY} -S {stub} delete {d}",
+            "tony.tpu.accelerator-type": "v5litepod-8",
+            "tony.tpu.create-timeout-s": 120,
+            "tony.tpu.create-poll-interval-s": 0.1,
+            "tony.tpu.discover-retries": 1,
+        },
+    )
+    client = TonyClient(conf, poll_interval_s=0.1)
+    client.submit()
+    deadline = time.time() + 30
+    while time.time() < deadline and not (d / "slice.json").exists():
+        time.sleep(0.05)
+    assert (d / "slice.json").exists(), "driver never created the slice"
+    client._driver_proc.send_signal(signal.SIGTERM)
+    try:
+        client._driver_proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        client._driver_proc.kill()
+        raise AssertionError("driver did not exit on SIGTERM mid-await")
+    deadline = time.time() + 10
+    while time.time() < deadline and (d / "slice.json").exists():
+        time.sleep(0.1)
+    assert not (d / "slice.json").exists(), \
+        "kill during await-READY leaked the created slice"
+
+
 def test_real_jax_distributed_collective(tmp_job_dirs, fixture_script):
     """2-worker job where the user processes actually join jax.distributed
     via the coordinator address the runtime emitted, and run a psum. This is

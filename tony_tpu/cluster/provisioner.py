@@ -64,6 +64,12 @@ class Provisioner:
     def stop_all(self) -> None:
         raise NotImplementedError
 
+    def teardown(self) -> None:
+        """Release provisioner-OWNED capacity (e.g. a TPU slice this
+        provisioner created) at end of job. Default: nothing is owned.
+        Must be safe to call at any point after __init__ begins — the
+        driver's signal path may invoke it mid-construction."""
+
 
 class LocalProvisioner(Provisioner):
     """Executors as local subprocesses; per-task stdout/stderr files mirror
@@ -213,7 +219,12 @@ class StaticHostProvisioner(Provisioner):
         self._local.stop_all()
 
 
-def create_provisioner(conf: TonyConf) -> Provisioner:
+def create_provisioner(conf: TonyConf, on_constructing=None) -> Provisioner:
+    """`on_constructing(prov)` is invoked with the instance BEFORE any
+    capacity acquisition runs (for lifecycle provisioners), so a signal
+    handler can reach `prov.teardown()` even when the process dies while
+    the slice is still materializing — the await-READY poll can last
+    minutes and is the likeliest window for a user kill."""
     kind = str(conf.get(keys.CLUSTER_PROVISIONER, "local")).lower()
     if kind == "local":
         return LocalProvisioner()
@@ -224,7 +235,7 @@ def create_provisioner(conf: TonyConf) -> Provisioner:
     if kind in ("tpu-pod", "tpu"):
         from .tpu import TpuPodProvisioner
 
-        prov = TpuPodProvisioner(conf)
+        prov = TpuPodProvisioner(conf, on_constructing=on_constructing)
         try:
             prov.validate_layout(conf)
         except Exception:

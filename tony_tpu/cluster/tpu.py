@@ -164,7 +164,7 @@ class TpuPodProvisioner(StaticHostProvisioner):
     """Gang launch over the hosts of one slice, with optional ownership of
     the slice's lifecycle (create / await-READY / recreate / delete)."""
 
-    def __init__(self, conf: TonyConf):
+    def __init__(self, conf: TonyConf, on_constructing=None):
         self._conf = conf
         self.accelerator_type = str(
             conf.get(keys.TPU_ACCELERATOR_TYPE, "") or ""
@@ -172,6 +172,12 @@ class TpuPodProvisioner(StaticHostProvisioner):
         # True once THIS provisioner materialized the slice: teardown only
         # deletes driver-created capacity, never a user's pre-created slice
         self.created = False
+        if on_constructing is not None:
+            # expose the instance BEFORE acquisition: teardown() depends
+            # only on (created, _conf), both set, so a signal handler can
+            # release a slice created during the (possibly minutes-long)
+            # await-READY poll below. stop_all/launch are NOT safe yet.
+            on_constructing(self)
         hosts = self._acquire()
         template = str(
             conf.get(keys.CLUSTER_LAUNCH_TEMPLATE, "") or ""

@@ -562,12 +562,10 @@ class Driver:
         self.rpc_server.stop()
         # release provisioner-owned capacity (driver-created TPU slices) —
         # after the client ack so a slow delete never delays terminal state
-        teardown = getattr(self.provisioner, "teardown", None)
-        if callable(teardown):
-            try:
-                teardown()
-            except Exception:
-                log.exception("provisioner teardown failed")
+        try:
+            self.provisioner.teardown()
+        except Exception:
+            log.exception("provisioner teardown failed")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -584,18 +582,36 @@ def main(argv: list[str] | None = None) -> int:
     # ApplicationMaster.java:382-393) — handled after first task launch via env
     conf = TonyConf.from_final(args.job_dir)
     token = os.environ.get(c.ENV_TOKEN, "")
-    driver = Driver(conf, app_id=args.app_id, job_dir=args.job_dir, token=token)
 
     # a killed driver must take its containers with it: executors run in
     # their own process groups (so the driver's own group kill can't reach
-    # them) — mirror the reference AM shutdown hook stopping containers
+    # them) — mirror the reference AM shutdown hook stopping containers.
+    # Handlers are registered BEFORE Driver construction (via a holder) so
+    # a kill arriving right after the provisioner materialized a TPU slice
+    # still releases it; the only uncovered window is a signal mid-slice-
+    # creation inside the constructor itself.
     import signal as _signal
 
+    holder: dict = {}
+
     def _teardown(signum):
+        # containers first, then owned capacity — in SEPARATE try blocks so
+        # a failure reaping processes can't skip the slice release (a
+        # killed job leaking a billable TPU slice is the worse outcome).
+        # `provisioner` is registered before acquisition even begins, so a
+        # kill during the minutes-long await-READY poll still deletes the
+        # slice it created; `driver` exists only once construction is done.
         try:
-            driver.provisioner.stop_all()
-        finally:
-            os._exit(128 + signum)
+            if holder.get("driver") is not None:
+                holder["driver"].provisioner.stop_all()
+        except Exception:
+            log.exception("stop_all on signal failed")
+        try:
+            if holder.get("provisioner") is not None:
+                holder["provisioner"].teardown()
+        except Exception:
+            log.exception("teardown on signal failed")
+        os._exit(128 + signum)
 
     def _on_term(signum, frame):
         # do the actual teardown on a helper thread: stop_all takes the
@@ -607,6 +623,14 @@ def main(argv: list[str] | None = None) -> int:
 
     _signal.signal(_signal.SIGTERM, _on_term)
     _signal.signal(_signal.SIGINT, _on_term)
+
+    prov = create_provisioner(
+        conf, on_constructing=lambda p: holder.__setitem__("provisioner", p)
+    )
+    holder["provisioner"] = prov  # non-lifecycle kinds never call back
+    driver = Driver(conf, app_id=args.app_id, job_dir=args.job_dir,
+                    token=token, provisioner=prov)
+    holder["driver"] = driver
 
     if os.environ.get(c.TEST_DRIVER_CRASH):
         def _crash_later():
