@@ -282,14 +282,15 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
         jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
     )
 
-    def walltime(n_new: int, kv_dtype: str = "native") -> float:
-        int(generate(params, cfg, prompt, n_new, max_len=max_len,
-                     kv_dtype=kv_dtype)[0, 0])
+    def walltime(n_new: int, kv_dtype: str = "native",
+                 weight_dtype: str = "native") -> float:
+        kw = dict(max_len=max_len, kv_dtype=kv_dtype,
+                  weight_dtype=weight_dtype)
+        int(generate(params, cfg, prompt, n_new, **kw)[0, 0])
         times = []
         for _ in range(reps):
             t0 = time.time()
-            out = generate(params, cfg, prompt, n_new, max_len=max_len,
-                           kv_dtype=kv_dtype)
+            out = generate(params, cfg, prompt, n_new, **kw)
             int(out[0, 0])  # hard sync
             times.append(time.time() - t0)
         return statistics.median(times)
@@ -302,6 +303,11 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
     # bytes with scale-folded reads)
     q_step_s = (walltime(new_tokens, "int8")
                 - walltime(short_new, "int8")) / (new_tokens - short_new)
+    # w8a16 arm: int8 weights AND cache — halves the weight stream that
+    # floors decode, scales folded out of every matmul
+    w8_step_s = (walltime(new_tokens, "int8", "int8")
+                 - walltime(short_new, "int8", "int8")) \
+        / (new_tokens - short_new)
     return {
         "batch": batch,
         "prompt_len": prompt_len,
@@ -314,6 +320,9 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
         "call_overhead_s": round(overhead_s, 3),
         "int8_cache_device_step_ms": round(q_step_s * 1000, 3),
         "int8_cache_device_tokens_per_sec": round(batch / q_step_s, 1),
+        "int8_weights_cache_device_step_ms": round(w8_step_s * 1000, 3),
+        "int8_weights_cache_device_tokens_per_sec": round(
+            batch / w8_step_s, 1),
     }
 
 

@@ -532,6 +532,40 @@ def test_int8_scale_folded_attention_matches_explicit_dequant():
                                atol=2e-2)
 
 
+def test_int8_weight_quantization_matches_dequant():
+    """w8a16 decode weights: the scale-folded matmul (x @ W_int8) * s must
+    equal x @ dequant(W) exactly, and the quantizer's per-output-channel
+    roundtrip error is bounded by its resolution."""
+    import dataclasses
+
+    from tony_tpu.models.generate import _quantize_weight, generate
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (3, 16, 24)) * 2.0
+    q, s = _quantize_weight(w)
+    assert q.dtype == jnp.int8 and s.shape == (3, 1, 24)
+    deq = np.asarray(q, np.float32) * np.asarray(s, np.float32)
+    amax = np.abs(np.asarray(w)).max(axis=-2, keepdims=True)
+    assert (np.abs(deq - np.asarray(w)) <= amax / 254.0 + 1e-6).all()
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16))
+    folded = (x @ q[0].astype(jnp.float32)) * jnp.asarray(s[0, 0])
+    explicit = x @ jnp.asarray(deq[0])
+    np.testing.assert_allclose(np.asarray(folded), np.asarray(explicit),
+                               rtol=1e-5, atol=1e-5)
+
+    # end to end: int8 weights generate valid tokens; MoE is rejected
+    params = transformer.init(jax.random.PRNGKey(0), TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                TINY.vocab_size)
+    out = generate(params, TINY, prompt, 6, weight_dtype="int8")
+    assert out.shape == (2, 6)
+    assert ((np.asarray(out) >= 0) & (np.asarray(out) < TINY.vocab_size)).all()
+    moe = dataclasses.replace(TINY, n_experts=4, expert_top_k=2)
+    moe_params = transformer.init(jax.random.PRNGKey(0), moe)
+    with pytest.raises(ValueError, match="dense-only"):
+        generate(moe_params, moe, prompt, 2, weight_dtype="int8")
+
+
 def test_decode_precast_keeps_moe_router_f32():
     """The decode weight pre-cast must NOT round the MoE router: _mlp reads
     it at f32 precisely so expert routing isn't perturbed (a bf16-rounded

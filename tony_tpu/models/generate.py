@@ -24,8 +24,8 @@ choices:
   weights, so values match the `transformer._qkv`/`_mlp` path exactly.
   Weights are pre-cast to cfg.dtype once per call (identical rounding to
   the forward's per-use casts; the f32 MoE router excepted).
-  `kv_dtype="int8"` is the one option that genuinely changes numerics vs
-  the full forward.
+  `kv_dtype="int8"` and `weight_dtype="int8"` are the two opt-ins that
+  genuinely change numerics vs the full forward (within int8 resolution).
 
 Sampling: greedy (temperature=0), temperature, and top-k.
 
@@ -91,15 +91,22 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
     )
 
 
+def _symmetric_int8(x, axis: int):
+    """Symmetric int8 quantization over `axis` -> (int8 values, f32 scales
+    with `axis` kept as size 1)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
 def _quantize_kv(x):
     """[B, kvH, L, D] -> (int8 values, [B, kvH, L] scales): symmetric
     per-token-per-head quantization over the head_dim vector."""
-    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-    scale = jnp.maximum(amax / 127.0, 1e-8)
-    q = jnp.clip(
-        jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127
-    ).astype(jnp.int8)
-    return q, scale.astype(jnp.bfloat16)
+    q, scale = _symmetric_int8(x, axis=-1)
+    return q, scale[..., 0].astype(jnp.bfloat16)
 
 
 def _cached_attention(cfg, q, ck, cv, cache_len, l_new,
@@ -184,14 +191,30 @@ def _cast_decode_params(params, cfg: TransformerConfig):
     return params
 
 
-def _fuse_decode_weights(params, cfg: TransformerConfig):
+def _quantize_weight(w):
+    """[..., d_in, d_out] -> (int8, scales [..., 1, d_out]): symmetric
+    per-output-channel quantization over the contraction axis. The scale
+    folds OUT of the matmul — y = (x @ W_int8) * s — so the weight operand
+    streamed from HBM is pure int8 (half the bytes of bf16), and only the
+    tiny activation row pays the multiply."""
+    return _symmetric_int8(w, axis=-2)
+
+
+def _fuse_decode_weights(params, cfg: TransformerConfig,
+                         weight_dtype: str = "native"):
     """Concatenate per-layer q/k/v and gate/up projection weights into one
     matrix each ([L, d, h*hd + 2*kvh*hd] and [L, d, 2*f]). Decode-step
     matmuls are skinny GEMVs whose cost is streaming the weight matrix;
     fusing 3+2 of them into 1+1 halves the kernel count per layer and
     streams bigger contiguous blocks. Built once per generate call
-    (amortized over all decode steps); dense MLP only."""
+    (amortized over all decode steps); dense MLP only.
+
+    weight_dtype="int8" additionally quantizes EVERY large decode matrix
+    (fused qkv, gate/up, wo, w_down, unembed) per-output-channel — decode
+    is weight-bandwidth-bound, so halving the streamed bytes buys ~that
+    much step time; numerics change within the int8 resolution (opt-in)."""
     L, d = cfg.n_layers, cfg.d_model
+    dt = cfg.dtype
     lp = params["layers"]
     wqkv = jnp.concatenate([
         lp["wq"].reshape(L, d, -1),
@@ -199,7 +222,20 @@ def _fuse_decode_weights(params, cfg: TransformerConfig):
         lp["wv"].reshape(L, d, -1),
     ], axis=-1)
     w_gu = jnp.concatenate([lp["w_gate"], lp["w_up"]], axis=-1)
-    return {"wqkv": wqkv, "w_gu": w_gu}
+    if weight_dtype != "int8":
+        return {"wqkv": wqkv, "w_gu": w_gu}
+    out = {}
+    for name, w in (
+        ("wqkv", wqkv),
+        ("w_gu", w_gu),
+        ("wo", lp["wo"].reshape(L, cfg.n_heads * cfg.head_dim, d)),
+        ("w_down", lp["w_down"]),
+        ("unembed", params["unembed"]),
+    ):
+        q, s = _quantize_weight(w)
+        out[name] = q
+        out[name + "_s"] = s.astype(dt)
+    return out
 
 
 def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
@@ -237,6 +273,7 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
     hd = cfg.head_dim
     nq, nkv = cfg.n_heads * hd, cfg.n_kv_heads * hd
     p_cfg = _prefill_cfg(cfg) if prefill else None
+    w8 = fused is not None and "wqkv_s" in fused  # int8 decode weights
     ck, cv = cache.k, cache.v
     ks_buf, vs_buf = cache.k_scale, cache.v_scale
     int8_cache = ck.dtype == jnp.int8
@@ -246,6 +283,8 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
         h = rms_norm(x, lp["attn_norm"])
         if fused is not None:
             qkv = jnp.einsum("bld,de->ble", h, fused["wqkv"][i].astype(dt))
+            if w8:
+                qkv = qkv * fused["wqkv_s"][i]
             q = qkv[..., :nq].reshape(b, l, cfg.n_heads, hd)
             k = qkv[..., nq:nq + nkv].reshape(b, l, cfg.n_kv_heads, hd)
             v = qkv[..., nq + nkv:].reshape(b, l, cfg.n_kv_heads, hd)
@@ -281,22 +320,40 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
                 ks_buf[i] if int8_cache else None,
                 vs_buf[i] if int8_cache else None,
             )
-        x = x + jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
+        if w8:
+            proj = jnp.einsum(
+                "ble,ed->bld", attn.reshape(b, l, nq),
+                fused["wo"][i].astype(dt),
+            ) * fused["wo_s"][i]
+        else:
+            proj = jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
+        x = x + proj
         hh = rms_norm(x, lp["mlp_norm"])
         if fused is not None:
             gu = jnp.einsum("bld,de->ble", hh, fused["w_gu"][i].astype(dt))
+            if w8:
+                gu = gu * fused["w_gu_s"][i]
             gate, up = gu[..., :cfg.d_ff], gu[..., cfg.d_ff:]
+            down = (fused["w_down"][i] if w8 else lp["w_down"]).astype(dt)
             mlp_out = jnp.einsum(
-                "blf,fd->bld", jax.nn.silu(gate) * up, lp["w_down"].astype(dt)
+                "blf,fd->bld", jax.nn.silu(gate) * up, down
             )
+            if w8:
+                mlp_out = mlp_out * fused["w_down_s"][i]
         else:
             mlp_out, _ = transformer._mlp(cfg, hh, lp)
         x = x + mlp_out
 
     x_last = rms_norm(x[:, -1], params["final_norm"])
-    logits = jnp.einsum(
-        "bd,dv->bv", x_last, params["unembed"].astype(dt)
-    ).astype(jnp.float32)
+    if w8:
+        logits = (
+            jnp.einsum("bd,dv->bv", x_last, fused["unembed"].astype(dt))
+            * fused["unembed_s"][0]
+        ).astype(jnp.float32)
+    else:
+        logits = jnp.einsum(
+            "bd,dv->bv", x_last, params["unembed"].astype(dt)
+        ).astype(jnp.float32)
     new_cache = KVCache(k=ck, v=cv, length=cache.length + l,
                         k_scale=ks_buf, v_scale=vs_buf)
     return logits, new_cache
@@ -316,7 +373,7 @@ def sample_token(logits, key, temperature: float = 0.0, top_k: int = 0):
 
 @functools.partial(
     jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k",
-                              "kv_dtype", "max_len")
+                              "kv_dtype", "max_len", "weight_dtype")
 )
 def generate(
     params,
@@ -329,6 +386,7 @@ def generate(
     key: jax.Array | None = None,
     kv_dtype: str = "native",
     max_len: int | None = None,
+    weight_dtype: str = "native",
 ) -> jax.Array:
     """Generate max_new_tokens continuations -> [B, max_new_tokens] int32.
 
@@ -339,6 +397,12 @@ def generate(
     symmetric int8, bf16 scales) — half the cache's HBM capacity and
     faster decode at long contexts; "native" (default) is bit-exact vs
     the full forward.
+
+    ``weight_dtype="int8"`` (w8a16; dense models only) quantizes every
+    large decode matrix per-output-channel, halving the ~0.5GB/step weight
+    stream that floors decode — the scales fold out of the matmuls so the
+    streamed operand is pure int8. Numerics change within the int8
+    resolution; the master params are untouched (quantized once per call).
 
     ``max_len`` fixes the cache capacity independently of this call's
     prompt+new length (servers that reuse one compiled program across
@@ -374,7 +438,19 @@ def generate(
             f"max_len={max_len} < prompt ({lp_len}) + max_new_tokens "
             f"({max_new_tokens})"
         )
-    fused = _fuse_decode_weights(params, cfg) if cfg.n_experts == 0 else None
+    if weight_dtype not in ("native", "int8"):
+        raise ValueError(
+            f"weight_dtype must be 'native' or 'int8', got {weight_dtype!r}"
+        )
+    if cfg.n_experts > 0:
+        if weight_dtype == "int8":
+            raise ValueError(
+                "weight_dtype='int8' is dense-only (MoE expert weights are "
+                "routed, not streamed every step)"
+            )
+        fused = None
+    else:
+        fused = _fuse_decode_weights(params, cfg, weight_dtype)
     cache = init_cache(cfg, b, max_len, kv_dtype)
     logits, cache = _forward_with_cache(params, cfg, prompt, cache, fused,
                                         prefill=True)
