@@ -489,6 +489,70 @@ def test_tpu_slice_transient_discovery_flake_does_not_destroy(tmp_path):
     assert not (d / "delete.log").exists(), "healthy slice was deleted"
 
 
+def test_tpu_slice_sustained_outage_refuses_delete_recreate(tmp_path):
+    """A discovery outage longer than the whole retry budget — but with NO
+    positive not-found evidence (5xx-style stderr) — must abort instead of
+    engaging delete+recreate: the slice may be healthy capacity the driver
+    does not own, and 'describe kept failing' is not proof it is gone."""
+    import subprocess as sp
+
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    conf, d = _slice_conf(tmp_path)
+    sp.run(str(conf.get("tony.tpu.create-command")), shell=True, check=True)
+    conf.set(
+        "tony.tpu.discover-command",
+        "echo 'ERROR: backend error 503' >&2; exit 1",
+    )
+    conf.set("tony.tpu.discover-retries", 2)
+    with pytest.raises(RuntimeError, match="refusing to delete"):
+        TpuPodProvisioner(conf)
+    assert not (d / "delete.log").exists(), \
+        "transient outage destroyed a healthy slice"
+    assert (d / "slice.json").exists()
+
+
+def test_tpu_slice_custom_not_found_pattern(tmp_path):
+    """A CLI whose absent-resource message doesn't match the default
+    pattern still engages the lifecycle path once
+    tony.tpu.not-found-pattern names it."""
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    conf, d = _slice_conf(tmp_path)
+    stub = Path(__file__).parent / "fixtures" / "scripts" / "stub_slice.py"
+    flagged = tmp_path / "created_once"
+    # before the create runs, describe reports an unusual absence message;
+    # the create command drops a marker so later describes hit the stub
+    conf.set(
+        "tony.tpu.discover-command",
+        f"if [ -f {flagged} ]; then {PY} -S {stub} describe {d}; "
+        f"else echo 'no such resource in project' >&2; exit 1; fi",
+    )
+    base_create = str(conf.get("tony.tpu.create-command"))
+    conf.set("tony.tpu.create-command", f"touch {flagged} && {base_create}")
+    # default pattern would refuse ("no such resource" matches nothing)
+    with pytest.raises(RuntimeError, match="refusing to delete"):
+        TpuPodProvisioner(conf)
+    conf.set("tony.tpu.not-found-pattern", "no such resource")
+    prov = TpuPodProvisioner(conf)
+    assert prov.created
+    assert prov.hosts == [f"host{i}-g1" for i in range(4)]
+
+
+def test_tpu_slice_malformed_not_found_pattern_fails_fast(tmp_path):
+    """An unbalanced-paren tony.tpu.not-found-pattern is a config error at
+    provisioner construction — before any cloud I/O — not an re.error
+    surfacing mid-await-READY where cleanup would misread it as a failed
+    create and delete the slice."""
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    conf, d = _slice_conf(tmp_path)
+    conf.set("tony.tpu.not-found-pattern", "not found (")
+    with pytest.raises(ValueError, match="not-found-pattern"):
+        TpuPodProvisioner(conf)
+    assert not (d / "create.log").exists(), "config error ran the create"
+
+
 def test_tpu_slice_create_without_discovery_fails_fast(tmp_path):
     """create-command with no discover mechanism is a config error reported
     immediately, not a 30-minute await-READY against nothing."""
@@ -506,7 +570,8 @@ def test_tpu_slice_create_without_discovery_fails_fast(tmp_path):
 def test_tpu_slice_await_without_geometry_needs_stable_list(tmp_path):
     """Without tony.tpu.accelerator-type there is no expected host count;
     await-READY must not accept the first (possibly partial, mid-creation)
-    non-empty list — it waits for the list to repeat across two polls."""
+    non-empty list — it waits for the list to repeat across
+    tony.tpu.ready-stable-polls consecutive polls (default 3)."""
     from tony_tpu.cluster.tpu import TpuPodProvisioner
 
     conf, _ = _slice_conf(tmp_path, ready_after=2, accel="")

@@ -195,19 +195,103 @@ def test_portal_paging_sorting_and_token(tmp_path):
         assert all(j["status"] == "FAILED"
                    for j in json.loads(body)["jobs"])
 
-        # --- html keeps sort state, pager links, and the query token
-        _, body = get("/?sort=job&dir=asc&per=20&page=2", accept="text/html",
-                      via_header=False)
+        # --- browser flow: ?token= is exchanged for an HttpOnly cookie +
+        # redirect to a token-free URL; HTML never reflects the token into
+        # hrefs (it would leak via history/shared links/access logs)
+        import http.cookiejar
+
+        jar = http.cookiejar.CookieJar()
+        opener = urllib.request.build_opener(
+            urllib.request.HTTPCookieProcessor(jar)
+        )
+
+        def browse(path):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                headers={"Accept": "text/html"},
+            )
+            with opener.open(req, timeout=10) as resp:
+                return resp.status, resp.url, resp.read().decode()
+
+        status, final_url, body = browse(
+            "/?sort=job&dir=asc&per=20&page=2&token=s3cret"
+        )
+        assert status == 200
+        assert "token" not in final_url, "redirect must strip the token"
+        assert {c.name for c in jar} == {"tony_portal_token"}
         assert "page 2/15" in body
         assert "next &raquo;" in body and "&laquo; prev" in body
-        assert "token=s3cret" in body  # links stay authorized
+        assert "s3cret" not in body, "token reflected into HTML"
 
-        # --- the job-detail page's nav links carry the token too (an empty
-        # jhist yields an empty event list, which still renders)
-        _, body = get("/jobs/app_0001", accept="text/html", via_header=False)
-        assert "/config/app_0001?token=s3cret" in body
-        assert "/logs/app_0001?token=s3cret" in body
-        assert "href='/?token=s3cret'" in body
+        # --- the cookie alone now authorizes every route, token-free links
+        status, _, body = browse("/jobs/app_0001")
+        assert status == 200
+        assert "/config/app_0001" in body and "/logs/app_0001" in body
+        assert "s3cret" not in body
+
+        # --- a WRONG query token 401s without setting any cookie
+        try:
+            browse("/?token=wrong&x=1")
+            assert False, "expected 401 for a bad browser token"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_portal_cookie_survives_delimiter_token_and_blocks_open_redirect(
+        tmp_path):
+    """A token containing cookie delimiters (';', '=', spaces) must survive
+    the Set-Cookie round-trip (the value is %-quoted, not sent raw —
+    'abc;def' raw would truncate to 'abc' and 401 every following request),
+    and a scheme-relative '//evil.com' path must not become an off-site
+    Location after the token→cookie exchange."""
+    import http.cookiejar
+
+    tok = "a b;c=d,é"
+    inter = tmp_path / "hist" / "intermediate"
+    inter.mkdir(parents=True)
+    conf = TonyConf({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.intermediate": str(inter),
+        "tony.history.finished": str(tmp_path / "hist" / "finished"),
+        "tony.portal.token": tok,
+    })
+    server = serve_portal(conf, port=0, block=False)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        jar = http.cookiejar.CookieJar()
+        opener = urllib.request.build_opener(
+            urllib.request.HTTPCookieProcessor(jar)
+        )
+
+        def browse(path):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                headers={"Accept": "text/html"},
+            )
+            with opener.open(req, timeout=10) as resp:
+                return resp.status, resp.url
+
+        from urllib.parse import quote
+        status, final_url = browse("/?token=" + quote(tok))
+        assert status == 200 and "token" not in final_url
+        # the cookie alone must authorize the next request (round-trip
+        # preserved the delimiter characters)
+        assert browse("/")[0] == 200
+
+        # open-redirect guard: '//evil.com/' collapses to the on-site path
+        # '/evil.com/' — the portal 404s it rather than emitting a
+        # scheme-relative Location the browser would follow off-site
+        try:
+            browse("//evil.com/?token=" + quote(tok))
+            assert False, "expected on-site 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert f"127.0.0.1:{port}" in e.url, \
+                f"scheme-relative redirect escaped the portal: {e.url}"
     finally:
         server.shutdown()
         server.server_close()

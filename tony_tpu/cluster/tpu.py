@@ -30,6 +30,7 @@ tabulated so validation can reject role layouts that don't fit the slice.
 from __future__ import annotations
 
 import logging
+import re
 import subprocess
 import time
 
@@ -51,6 +52,41 @@ SLICE_GEOMETRY: dict[str, tuple[int, int]] = {
 }
 
 
+# stderr fragments the major cloud CLIs emit for a genuinely absent
+# resource (gcloud NOT_FOUND / "could not be found", generic 404s)
+DEFAULT_NOT_FOUND_PATTERN = (
+    r"(?i)not[_ ]?found|could not be found|does not exist|\b404\b"
+)
+
+
+class DiscoveryError(RuntimeError):
+    """Host discovery failed. ``not_found=True`` means the cloud positively
+    reported the slice absent (stderr matched tony.tpu.not-found-pattern, or
+    a successful describe listed zero endpoints) — the only failure the
+    lifecycle path may answer with delete+recreate. ``False`` is a
+    transient/ambiguous failure (API 5xx, auth outage, describe timeout)
+    that must never destroy possibly-healthy capacity."""
+
+    def __init__(self, msg: str, not_found: bool = False):
+        super().__init__(msg)
+        self.not_found = not_found
+
+
+def _not_found_re(conf: TonyConf) -> re.Pattern:
+    """Compile tony.tpu.not-found-pattern eagerly so a malformed user regex
+    is a config error at first use — not an re.error mid-await-READY that
+    the lifecycle cleanup path would misread as a failed create."""
+    pattern = str(
+        conf.get(keys.TPU_NOT_FOUND_PATTERN, "") or ""
+    ) or DEFAULT_NOT_FOUND_PATTERN
+    try:
+        return re.compile(pattern)
+    except re.error as e:
+        raise ValueError(
+            f"invalid {keys.TPU_NOT_FOUND_PATTERN} regex {pattern!r}: {e}"
+        ) from None
+
+
 def slice_num_hosts(accelerator_type: str) -> int | None:
     geom = SLICE_GEOMETRY.get(accelerator_type)
     if geom is None:
@@ -69,8 +105,18 @@ def discover_hosts(conf: TonyConf) -> list[str]:
             cmd, shell=True, capture_output=True, text=True, timeout=120
         )
         if out.returncode != 0:
-            raise RuntimeError(f"tpu host discovery failed: {out.stderr.strip()}")
+            stderr = out.stderr.strip()
+            raise DiscoveryError(
+                f"tpu host discovery failed: {stderr}",
+                not_found=bool(_not_found_re(conf).search(stderr)),
+            )
         hosts = [h.strip() for h in out.stdout.splitlines() if h.strip()]
+        if not hosts:
+            # the describe SUCCEEDED and listed zero endpoints: positive
+            # absence, not a flake
+            raise DiscoveryError(
+                "tpu host discovery returned no hosts", not_found=True
+            )
     if not hosts:
         raise ValueError(
             "no TPU hosts: set tony.cluster.static-hosts or "
@@ -129,13 +175,24 @@ def await_slice_ready(conf: TonyConf, expected_hosts: int | None) -> list[str]:
     Without an accelerator type there is no expected host count, so a
     mid-creation describe that lists only some endpoints cannot be told
     from READY by size; the fallback heuristic is to require the host list
-    to be identical across two consecutive polls before declaring READY.
-    Set tony.tpu.accelerator-type for an exact check."""
+    to be identical across tony.tpu.ready-stable-polls consecutive polls
+    (default 3) before declaring READY — a cloud that stalls on a partial
+    endpoint list for that long still gets the gang packed onto a partial
+    slice, so set tony.tpu.accelerator-type for an exact check."""
     timeout_s = float(conf.get(keys.TPU_CREATE_TIMEOUT_S, 1800))
     poll_s = float(conf.get(keys.TPU_CREATE_POLL_S, 10))
+    stable_needed = max(2, int(conf.get(keys.TPU_READY_STABLE_POLLS, 3)))
+    if expected_hosts is None:
+        log.warning(
+            "no %s: declaring READY after %d identical host lists — a "
+            "stalled partial endpoint list can fool this; set the "
+            "accelerator type for an exact host-count check",
+            keys.TPU_ACCELERATOR_TYPE, stable_needed,
+        )
     deadline = time.monotonic() + timeout_s
     last_state = "no hosts yet"
     last_hosts: list[str] = []
+    stable_count = 0
     while time.monotonic() < deadline:
         try:
             hosts = discover_hosts(conf)
@@ -144,16 +201,24 @@ def await_slice_ready(conf: TonyConf, expected_hosts: int | None) -> list[str]:
             # is part of the normal wait too, not a reason to abort
             last_state = str(e)
             last_hosts = []
+            stable_count = 0
         else:
             if expected_hosts is not None:
                 if len(hosts) == expected_hosts:
                     return hosts
                 last_state = f"{len(hosts)}/{expected_hosts} hosts"
             elif hosts == last_hosts:
-                return hosts
+                stable_count += 1
+                if stable_count >= stable_needed - 1:
+                    return hosts
+                last_state = (
+                    f"{len(hosts)} hosts (stable {stable_count + 1}/"
+                    f"{stable_needed} polls)"
+                )
             else:
                 last_state = f"{len(hosts)} hosts (awaiting a stable list)"
                 last_hosts = hosts
+                stable_count = 0
         time.sleep(poll_s)
     raise TimeoutError(
         f"tpu slice not READY after {timeout_s:.0f}s (last: {last_state})"
@@ -172,6 +237,7 @@ class TpuPodProvisioner(StaticHostProvisioner):
         # True once THIS provisioner materialized the slice: teardown only
         # deletes driver-created capacity, never a user's pre-created slice
         self.created = False
+        _not_found_re(conf)  # reject a malformed pattern before any I/O
         if on_constructing is not None:
             # expose the instance BEFORE acquisition: teardown() depends
             # only on (created, _conf), both set, so a signal handler can
@@ -222,12 +288,20 @@ class TpuPodProvisioner(StaticHostProvisioner):
         attempts = max(1, int(self._conf.get(keys.TPU_DISCOVER_RETRIES, 3)))
         poll_s = float(self._conf.get(keys.TPU_CREATE_POLL_S, 10))
         err: Exception | None = None
+        # only positive evidence — the cloud saying NOT_FOUND, or a
+        # successful describe listing the wrong host count — may engage
+        # delete+recreate below; a run of purely transient failures (API
+        # 5xx, auth outage, describe timeouts) longer than the retry budget
+        # must abort rather than destroy a possibly-healthy slice the
+        # driver does not own
+        confirmed_gone = False
         for attempt in range(attempts):
             if attempt:
                 time.sleep(poll_s)
             try:
                 hosts = discover_hosts(self._conf)
                 if expected is not None and len(hosts) != expected:
+                    confirmed_gone = True  # successful describe, wrong size
                     if during_refresh:
                         raise ValueError(
                             f"slice refresh found {len(hosts)} hosts, "
@@ -242,12 +316,23 @@ class TpuPodProvisioner(StaticHostProvisioner):
             except (RuntimeError, ValueError,
                     subprocess.SubprocessError) as e:
                 err = e
+                confirmed_gone = confirmed_gone or getattr(
+                    e, "not_found", False
+                )
                 log.info("slice discovery attempt %d/%d: %s",
                          attempt + 1, attempts, e)
         assert err is not None
         if not create_cmd:
             raise err  # discovery-only mode: absent slice is the user's error
-        log.info("slice absent or partial; creating")
+        if not confirmed_gone:
+            raise RuntimeError(
+                f"slice discovery failed {attempts}x without the cloud "
+                f"confirming the slice absent (set "
+                f"{keys.TPU_NOT_FOUND_PATTERN} if your CLI's not-found "
+                f"message is unusual); refusing to delete+recreate "
+                f"capacity that may be healthy: {err}"
+            ) from err
+        log.info("slice confirmed absent or partial; creating")
         self.created = True  # even a failed create may leave capacity behind
         try:
             # clear any remnant under the same name first (a preemption

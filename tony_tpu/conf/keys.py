@@ -115,6 +115,16 @@ TPU_CREATE_POLL_S = "tony.tpu.create-poll-interval-s"
 # deletes+recreates — armor against one transient describe flake destroying
 # healthy capacity
 TPU_DISCOVER_RETRIES = "tony.tpu.discover-retries"
+# regex matched against a failed discover-command's stderr: a match is
+# positive "the cloud says the slice does not exist" evidence; only then
+# (or on a successful-but-partial describe) may the lifecycle path
+# delete+recreate. A nonzero exit that does NOT match (API 5xx, auth
+# outage, timeout) aborts instead of destroying possibly-healthy capacity.
+TPU_NOT_FOUND_PATTERN = "tony.tpu.not-found-pattern"
+# consecutive identical host lists required to declare READY when no
+# accelerator-type gives an exact host count (stalled partial endpoint
+# lists can look stable briefly; more polls = stronger evidence)
+TPU_READY_STABLE_POLLS = "tony.tpu.ready-stable-polls"
 
 # ------------------------------------------------------------------ horovod
 HOROVOD_TEST_MODE = "tony.horovod.mode.test"              # stub rendezvous server

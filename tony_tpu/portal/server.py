@@ -29,6 +29,9 @@ from ..events.history import (
 
 log = logging.getLogger(__name__)
 
+# session cookie the browser auth flow sets in exchange for ?token=
+_COOKIE_NAME = "tony_portal_token"
+
 
 class _TTLCache:
     """Guava-cache stand-in: bounded TTL memo (CacheWrapper.java:28-76)."""
@@ -170,12 +173,10 @@ def sort_page_jobs(jobs: list[dict], qs: dict) -> tuple[list[dict], dict]:
     return jobs[(page - 1) * per: page * per], info
 
 
-def _jobs_html(jobs: list[dict], info: dict, token: str = "") -> str:
+def _jobs_html(jobs: list[dict], info: dict) -> str:
     def link(**over) -> str:
         params = {"sort": info["sort"], "dir": info["dir"],
                   "page": info["page"], "per": info["per"], **over}
-        if token:
-            params["token"] = token
         return "/?" + urlencode(params)
 
     def th(label: str, col: str) -> str:
@@ -187,14 +188,13 @@ def _jobs_html(jobs: list[dict], info: dict, token: str = "") -> str:
         return (f"<th><a href='{link(sort=col, dir=nxt, page=1)}'>"
                 f"{label}{mark}</a></th>")
 
-    tok_q = "?" + urlencode({"token": token}) if token else ""
     rows = "".join(
-        f"<tr><td><a href='/jobs/{html.escape(j['app_id'])}{tok_q}'>{html.escape(j['app_id'])}</a></td>"
+        f"<tr><td><a href='/jobs/{html.escape(j['app_id'])}'>{html.escape(j['app_id'])}</a></td>"
         f"<td>{html.escape(j['user'])}</td>"
         f"<td>{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(j['started_ms']/1000))}</td>"
         f"<td class='{j['status']}'>{j['status']}</td>"
-        f"<td><a href='/config/{j['app_id']}{tok_q}'>config</a> "
-        f"<a href='/logs/{j['app_id']}{tok_q}'>logs</a></td></tr>"
+        f"<td><a href='/config/{j['app_id']}'>config</a> "
+        f"<a href='/logs/{j['app_id']}'>logs</a></td></tr>"
         for j in jobs
     )
     pager = (
@@ -212,11 +212,10 @@ def _jobs_html(jobs: list[dict], info: dict, token: str = "") -> str:
     )
 
 
-def _job_detail_html(app_id: str, events: list[dict], token: str = "") -> str:
+def _job_detail_html(app_id: str, events: list[dict]) -> str:
     """Job page: event timeline + per-task metrics pulled from
     TASK_FINISHED payloads (reference: tony-portal JobEventPage rendering
     the jhist event array, metrics embedded per TaskFinished.avsc)."""
-    tok_q = "?" + urlencode({"token": token}) if token else ""
     ev_rows = []
     metric_rows = []
     for e in events:
@@ -236,9 +235,9 @@ def _job_detail_html(app_id: str, events: list[dict], token: str = "") -> str:
             )
     body = (
         f"<h3>{html.escape(app_id)}</h3>"
-        f"<p><a href='/{tok_q}'>all jobs</a> | "
-        f"<a href='/config/{html.escape(app_id)}{tok_q}'>config</a>"
-        f" | <a href='/logs/{html.escape(app_id)}{tok_q}'>logs</a></p>"
+        f"<p><a href='/'>all jobs</a> | "
+        f"<a href='/config/{html.escape(app_id)}'>config</a>"
+        f" | <a href='/logs/{html.escape(app_id)}'>logs</a></p>"
         "<h4>events</h4><table><tr><th>time</th><th>type</th><th>detail</th></tr>"
         + "".join(ev_rows) + "</table>"
     )
@@ -268,16 +267,37 @@ def make_handler(index: HistoryIndex, token: str = ""):
             self._send(200 if obj is not None else 404,
                        json.dumps(obj, indent=2), "application/json")
 
+        def _cookie_token(self) -> str:
+            from http.cookies import SimpleCookie
+            from urllib.parse import unquote
+
+            jar = SimpleCookie()
+            try:
+                jar.load(self.headers.get("Cookie", ""))
+            except Exception:
+                return ""
+            morsel = jar.get(_COOKIE_NAME)
+            return unquote(morsel.value) if morsel else ""
+
         def _authorized(self, qs: dict) -> bool:
             """tony.portal.token gate on every route — the bearer-token
             analogue of the reference portal sitting behind Hadoop-secured
             infra (tony-portal/app/hadoop/Requirements.java). Accepts the
-            Authorization header (API clients) or ?token= (browsers)."""
+            Authorization header (API clients), the session cookie, or
+            ?token= — which for browsers is immediately exchanged for an
+            HttpOnly cookie + redirect in do_GET, so the token is not
+            reflected into links or kept in the address bar. (It still
+            transits plaintext HTTP once: bind to localhost or front with
+            TLS for untrusted networks.) Cookie-less HTML scrapers should
+            send `Authorization: Bearer <token>` (no redirect on that
+            path) or follow the 302 with a cookie jar (curl -L -c/-b)."""
             if not token:
                 return True
             header = self.headers.get("Authorization", "")
-            supplied = header[len("Bearer "):] if header.startswith("Bearer ") \
-                else qs.get("token", [""])[0]
+            supplied = (
+                header[len("Bearer "):] if header.startswith("Bearer ")
+                else qs.get("token", [""])[0] or self._cookie_token()
+            )
             # compare bytes: compare_digest raises TypeError on non-ASCII str
             return hmac.compare_digest(supplied.encode(), token.encode())
 
@@ -293,6 +313,30 @@ def make_handler(index: HistoryIndex, token: str = ""):
                 return self._send(401, "unauthorized: supply the portal "
                                   "token (Authorization: Bearer ... or "
                                   "?token=...)", "text/plain")
+            if token and "token" in qs and not want_json:
+                # browser flow: swap the query token for a cookie and
+                # bounce to a token-free URL so hrefs/history stay clean
+                from urllib.parse import quote
+
+                clean_qs = urlencode(
+                    {k: v for k, v in qs.items() if k != "token"},
+                    doseq=True,
+                )
+                # collapse leading '//' — browsers read a scheme-relative
+                # Location as an off-site redirect (open-redirect vector)
+                path = "/" + url.path.lstrip("/")
+                self.send_response(302)
+                self.send_header(
+                    "Location", path + ("?" + clean_qs if clean_qs else "")
+                )
+                self.send_header(
+                    "Set-Cookie",
+                    f"{_COOKIE_NAME}={quote(qs['token'][0], safe='')}; "
+                    "HttpOnly; Path=/; SameSite=Strict",
+                )
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return None
             try:
                 if not parts:
                     jobs = index.jobs()
@@ -305,16 +349,14 @@ def make_handler(index: HistoryIndex, token: str = ""):
                         page, info = sort_page_jobs(jobs, qs)
                         return self._json({"jobs": page, **info})
                     page, info = sort_page_jobs(jobs, qs)
-                    return self._send(
-                        200, _jobs_html(page, info,
-                                        qs.get("token", [""])[0]))
+                    return self._send(200, _jobs_html(page, info))
                 kind, app_id = parts[0], parts[1] if len(parts) > 1 else ""
                 if kind == "jobs":
                     events = index.events(app_id)
                     if want_json or events is None:
                         return self._json(events)
-                    return self._send(200, _job_detail_html(
-                        app_id, events, qs.get("token", [""])[0]))
+                    return self._send(
+                        200, _job_detail_html(app_id, events))
                 if kind == "config":
                     return self._json(index.config(app_id))
                 if kind == "logs":
