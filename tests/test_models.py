@@ -653,6 +653,138 @@ def test_generate_rejects_nonpositive_max_new():
         generate(params, TINY, prompt, 0)
 
 
+def test_generate_stop_tokens_early_exit():
+    """stop_tokens: each row returns exactly its pre-stop tokens (stop
+    included), pad_id after, and the while_loop exits at the SLOWEST
+    sequence's stop position, not at max_new_tokens."""
+    from tony_tpu.models.generate import generate
+
+    params = transformer.init(jax.random.PRNGKey(0), TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                TINY.vocab_size)
+    max_new = 12
+    ref = np.asarray(generate(params, TINY, prompt, max_new))
+
+    # staggered: row 0's token at position 2 and row 1's at position 5 —
+    # greedy decode is deterministic, so pre-stop tokens must match ref
+    stops = (int(ref[0, 2]), int(ref[1, 5]))
+    pad = TINY.vocab_size - 1
+    out, steps = generate(params, TINY, prompt, max_new,
+                          stop_tokens=stops, pad_id=pad, return_steps=True)
+    out = np.asarray(out)
+
+    expected_steps = 0
+    for r in range(2):
+        hit = [i for i in range(max_new) if int(ref[r, i]) in stops]
+        p = hit[0] if hit else max_new - 1
+        expected_steps = max(expected_steps, p)
+        np.testing.assert_array_equal(out[r, :p + 1], ref[r, :p + 1])
+        assert (out[r, p + 1:] == pad).all(), out[r]
+    assert int(steps) == expected_steps
+    assert int(steps) < max_new - 1  # genuinely exited early
+
+
+def test_generate_stop_on_first_token():
+    """A row whose very first sampled token is a stop pays zero decode
+    steps when the whole batch stops immediately."""
+    from tony_tpu.models.generate import generate
+
+    params = transformer.init(jax.random.PRNGKey(0), TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                TINY.vocab_size)
+    ref = np.asarray(generate(params, TINY, prompt, 4))
+    stops = tuple({int(ref[0, 0]), int(ref[1, 0])})
+    out, steps = generate(params, TINY, prompt, 4, stop_tokens=stops,
+                          pad_id=0, return_steps=True)
+    assert int(steps) == 0
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[:, 0], ref[:, 0])
+    assert (out[:, 1:] == 0).all()
+
+
+def test_prepare_decode_matches_in_call_path():
+    """prepare_decode (build once, no per-call weight copies) must produce
+    the same tokens as the in-call cast/fuse path — native and w8a16."""
+    from tony_tpu.models.generate import generate, prepare_decode
+
+    params = transformer.init(jax.random.PRNGKey(0), TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                TINY.vocab_size)
+    ref = np.asarray(generate(params, TINY, prompt, 6))
+    prep = prepare_decode(params, TINY)
+    assert prep.fused is not None and "wqkv" in prep.fused
+    np.testing.assert_array_equal(
+        np.asarray(generate(prep, TINY, prompt, 6)), ref)
+
+    ref8 = np.asarray(generate(params, TINY, prompt, 6, weight_dtype="int8"))
+    prep8 = prepare_decode(params, TINY, weight_dtype="int8")
+    assert "wqkv_s" in prep8.fused
+    np.testing.assert_array_equal(
+        np.asarray(generate(prep8, TINY, prompt, 6)), ref8)
+
+
+def test_generate_tp_mesh_parity():
+    """Mesh-sharded decode (data x tensor; KV cache sharded over kv heads)
+    must be token-exact vs the single-device greedy path — raw params and
+    the prepare_decode server path both."""
+    from tony_tpu.models.generate import generate, prepare_decode
+    from tony_tpu.parallel import TP_DECODE_RULES
+
+    mesh = build_mesh(MeshSpec(data=2, fsdp=1, tensor=2),
+                      devices=jax.devices()[:4])
+    params = transformer.init(jax.random.PRNGKey(0), TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                TINY.vocab_size)
+    ref = np.asarray(generate(params, TINY, prompt, 6))
+
+    out = generate(params, TINY, prompt, 6, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+    prep = prepare_decode(params, TINY, mesh=mesh, rules=TP_DECODE_RULES)
+    assert prep.fused is None  # fusion is single-device-only
+    kv_shard = prep.params["layers"]["wk"].sharding
+    assert "tensor" in str(kv_shard.spec), kv_shard  # kv genuinely sharded
+    out2 = generate(prep, TINY, prompt, 6, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(out2), ref)
+
+    # int8 cache under the mesh: scale buffers shard alongside; tokens valid
+    out3 = np.asarray(generate(params, TINY, prompt, 6, kv_dtype="int8",
+                               mesh=mesh))
+    assert ((out3 >= 0) & (out3 < TINY.vocab_size)).all()
+
+    # stop tokens compose with the mesh (while_loop under GSPMD)
+    stops = (int(ref[0, 2]), int(ref[1, 4]))
+    out4, steps = generate(params, TINY, prompt, 6, mesh=mesh,
+                           stop_tokens=stops, pad_id=0, return_steps=True)
+    assert int(steps) <= 4
+
+
+def test_generate_tp_mesh_rejections():
+    """GQA with kvH < tensor axis, indivisible batch, and w8a16-under-TP
+    all fail with clear errors instead of wrong layouts."""
+    from tony_tpu.models.generate import generate, prepare_decode
+
+    params = transformer.init(jax.random.PRNGKey(0), TINY)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    mesh8 = build_mesh(MeshSpec(fsdp=1, tensor=8))
+    with pytest.raises(ValueError, match="n_kv_heads=2.*kv"):
+        generate(params, TINY, prompt, 2, mesh=mesh8)
+
+    mesh = build_mesh(MeshSpec(data=2, fsdp=1, tensor=2),
+                      devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="batch 3"):
+        generate(params, TINY, jnp.zeros((3, 4), jnp.int32), 2, mesh=mesh)
+    with pytest.raises(ValueError, match="int8"):
+        prepare_decode(params, TINY, weight_dtype="int8", mesh=mesh)
+
+    # prepared/call mismatches are errors, not silent wrong layouts
+    prep = prepare_decode(params, TINY)
+    with pytest.raises(ValueError, match="mesh mismatch"):
+        generate(prep, TINY, prompt, 2, mesh=mesh)
+    with pytest.raises(ValueError, match="prepared weights were built"):
+        generate(prep, TINY, prompt, 2, weight_dtype="int8")
+
+
 def test_lm_generate_example_end_to_end(tmp_path):
     """Train briefly with checkpoints, then lm_generate restores and
     decodes from the checkpoint (the serve-side example)."""

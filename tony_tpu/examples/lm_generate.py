@@ -51,6 +51,16 @@ def main(argv=None) -> int:
                              "decode weights — ~1.5x decode throughput on "
                              "the bandwidth-bound step, within int8 "
                              "resolution of the native output")
+    parser.add_argument("--stop-tokens", default="",
+                        help="whitespace-separated token ids that end a "
+                             "sequence (EOS); decode exits as soon as every "
+                             "row has stopped")
+    parser.add_argument("--pad-id", type=int, default=0,
+                        help="fill value after a row's stop token")
+    parser.add_argument("--tensor-parallel", type=int, default=1,
+                        help=">1 runs mesh-sharded decode: weights + KV "
+                             "cache sharded over the first N devices "
+                             "(models/generate.py TP path)")
     parser.add_argument("--metrics-out", default="")
     args = parser.parse_args(argv)
 
@@ -89,34 +99,60 @@ def main(argv=None) -> int:
     if bad:
         raise SystemExit(f"prompt ids out of vocab range: {bad}")
     prompt = jnp.asarray([prompt_ids], jnp.int32)
+    stop_tokens = tuple(int(t) for t in args.stop_tokens.split())
 
-    out = generate(
-        params, cfg, prompt, args.max_new,
-        temperature=args.temperature, top_k=args.top_k,
-        key=jax.random.PRNGKey(args.seed), kv_dtype=args.kv_dtype,
-        weight_dtype=args.weight_dtype,
+    mesh = None
+    if args.tensor_parallel > 1:
+        from tony_tpu.parallel import MeshSpec, build_mesh
+
+        mesh = build_mesh(
+            MeshSpec(fsdp=1, tensor=args.tensor_parallel),
+            devices=jax.devices()[:args.tensor_parallel],
+        )
+
+    from tony_tpu.models.generate import prepare_decode
+    prepared = prepare_decode(
+        params, cfg, weight_dtype=args.weight_dtype, mesh=mesh
     )
-    jax.block_until_ready(out)          # exclude compile from timing
+
+    def run():
+        out, steps = generate(
+            prepared, cfg, prompt, args.max_new,
+            temperature=args.temperature, top_k=args.top_k,
+            key=jax.random.PRNGKey(args.seed), kv_dtype=args.kv_dtype,
+            stop_tokens=stop_tokens, pad_id=args.pad_id, mesh=mesh,
+            return_steps=True,
+        )
+        jax.block_until_ready(out)
+        return out, steps
+
+    run()                               # exclude compile from timing
     t0 = time.time()
-    out = generate(
-        params, cfg, prompt, args.max_new,
-        temperature=args.temperature, top_k=args.top_k,
-        key=jax.random.PRNGKey(args.seed), kv_dtype=args.kv_dtype,
-        weight_dtype=args.weight_dtype,
-    )
-    jax.block_until_ready(out)
+    out, steps = run()
     wall = time.time() - t0
+    # prefill emitted 1 token + `steps` decode forwards; with stop_tokens
+    # the loop exits early, so max_new would overstate throughput
+    n_generated = int(steps) + 1
 
     tokens = [int(t) for t in out[0]]
+    if stop_tokens:
+        # trim the pad tail (the stop token itself stays)
+        for i, t in enumerate(tokens):
+            if t in stop_tokens:
+                tokens = tokens[:i + 1]
+                break
     result = {
         "tokens": tokens,
-        "decode_tokens_per_sec": args.max_new / wall,
+        "decode_tokens_per_sec": n_generated / wall,
+        "generated_tokens": n_generated,
         "backend": jax.default_backend(),
         "kv_dtype": args.kv_dtype,
         "weight_dtype": args.weight_dtype,
+        "tensor_parallel": args.tensor_parallel,
+        "stop_tokens": list(stop_tokens),
     }
     print(" ".join(str(t) for t in tokens))
-    print(f"# {args.max_new} tokens in {wall:.2f}s "
+    print(f"# {n_generated} tokens in {wall:.2f}s "
           f"({result['decode_tokens_per_sec']:.1f} tok/s)")
     if args.metrics_out:
         with open(args.metrics_out, "w") as f:

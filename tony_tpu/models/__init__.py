@@ -1,7 +1,14 @@
 """Model zoo: flagship transformer (dense + MoE), KV-cache generation, and
 the mnist parity model."""
 
-from .generate import KVCache, generate, init_cache, sample_token
+from .generate import (
+    DecodeWeights,
+    KVCache,
+    generate,
+    init_cache,
+    prepare_decode,
+    sample_token,
+)
 from .transformer import (
     TransformerConfig,
     apply,
@@ -17,4 +24,5 @@ __all__ = [
     "TransformerConfig", "init", "apply", "apply_hidden", "loss_fn",
     "token_nll", "param_logical_axes", "num_params",
     "KVCache", "init_cache", "generate", "sample_token",
+    "prepare_decode", "DecodeWeights",
 ]

@@ -27,7 +27,20 @@ choices:
   `kv_dtype="int8"` and `weight_dtype="int8"` are the two opt-ins that
   genuinely change numerics vs the full forward (within int8 resolution).
 
-Sampling: greedy (temperature=0), temperature, and top-k.
+Sampling: greedy (temperature=0), temperature, and top-k. ``stop_tokens``
+adds EOS semantics: a per-sequence finished mask plus a `lax.while_loop`
+that exits as soon as every row has stopped, so a batch never pays decode
+steps past its slowest sequence.
+
+- **Mesh-sharded decode.** ``generate(..., mesh=..., rules=...)`` runs the
+  whole loop under tensor parallelism: params are placed by the same
+  logical-axis rule tables training uses (`parallel/sharding.py`), and the
+  KV cache is sharded over `n_kv_heads` on the rules' "kv" axes — so a
+  model bigger than one chip's HBM decodes across the mesh with the
+  single-controller API unchanged. GQA models whose kv-head count doesn't
+  divide the kv axes are rejected with a clear error (a split kv head has
+  no layout). Use `prepare_decode` to shard + cast the weights once and
+  serve many requests.
 
 No reference counterpart: TonY has no model/inference layer (SURVEY.md
 §2.3); part of the TPU-native capability layer.
@@ -36,7 +49,8 @@ No reference counterpart: TonY has no model/inference layer (SURVEY.md
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+import math
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -212,7 +226,13 @@ def _fuse_decode_weights(params, cfg: TransformerConfig,
     weight_dtype="int8" additionally quantizes EVERY large decode matrix
     (fused qkv, gate/up, wo, w_down, unembed) per-output-channel — decode
     is weight-bandwidth-bound, so halving the streamed bytes buys ~that
-    much step time; numerics change within the int8 resolution (opt-in)."""
+    much step time; numerics change within the int8 resolution (opt-in).
+
+    HBM note: the fused (and, in w8 mode, quantized) copies live ALONGSIDE
+    the master params for the duration of the generate call — roughly the
+    attention+MLP weight bytes of extra peak residency. Servers sized
+    tightly should build them ONCE with `prepare_decode` and drop the
+    master params; then no per-call copies are made at all."""
     L, d = cfg.n_layers, cfg.d_model
     dt = cfg.dtype
     lp = params["layers"]
@@ -239,7 +259,8 @@ def _fuse_decode_weights(params, cfg: TransformerConfig,
 
 
 def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
-                        fused: dict | None = None, prefill: bool = False):
+                        fused: dict | None = None, prefill: bool = False,
+                        shardings: "DecodeShardings | None" = None):
     """Run L new tokens (absolute positions cache.length..+L-1) through the
     stack, reading/writing the cache -> (last-position logits [B, V] f32,
     new cache). Only the LAST position is projected through the unembed —
@@ -269,6 +290,11 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
     b, l = tokens.shape
     positions = jnp.broadcast_to(cache.length + jnp.arange(l), (b, l))
     x = params["embed"].astype(dt)[tokens]
+    if shardings is not None:
+        # pin activations batch-sharded / model-dim-replicated so GSPMD
+        # keeps the Megatron layout (psum after wo / w_down) instead of
+        # resharding mid-layer
+        x = lax.with_sharding_constraint(x, shardings.act)
 
     hd = cfg.head_dim
     nq, nkv = cfg.n_heads * hd, cfg.n_kv_heads * hd
@@ -354,6 +380,13 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
         logits = jnp.einsum(
             "bd,dv->bv", x_last, params["unembed"].astype(dt)
         ).astype(jnp.float32)
+    if shardings is not None:
+        logits = lax.with_sharding_constraint(logits, shardings.act)
+        ck = lax.with_sharding_constraint(ck, shardings.cache)
+        cv = lax.with_sharding_constraint(cv, shardings.cache)
+        if int8_cache:
+            ks_buf = lax.with_sharding_constraint(ks_buf, shardings.scale)
+            vs_buf = lax.with_sharding_constraint(vs_buf, shardings.scale)
     new_cache = KVCache(k=ck, v=cv, length=cache.length + l,
                         k_scale=ks_buf, v_scale=vs_buf)
     return logits, new_cache
@@ -371,10 +404,219 @@ def sample_token(logits, key, temperature: float = 0.0, top_k: int = 0):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+class DecodeShardings(NamedTuple):
+    """Static (hashable) sharding triple threaded through the jitted decode:
+    cache = KV buffers [layers, B, kvH, M, D], scale = int8 scale buffers
+    [layers, B, kvH, M], act = activations/logits (batch axes only)."""
+    cache: jax.sharding.NamedSharding
+    scale: jax.sharding.NamedSharding
+    act: jax.sharding.NamedSharding
+
+
+class DecodeWeights(NamedTuple):
+    """Decode-ready weights built once by `prepare_decode`: pre-cast (and
+    pre-fused / pre-quantized / mesh-sharded) so repeated generate calls
+    make no per-call weight copies. Pass in place of raw params.
+
+    `weight_dtype` and `mesh` record what the weights were built FOR;
+    generate() rejects calls whose arguments contradict them (a silently
+    ignored mismatch would serve the wrong numerics or layout)."""
+    params: Any
+    fused: dict | None
+    weight_dtype: str = "native"
+    mesh: Any = None
+
+
+def _decode_shardings(mesh, rules) -> DecodeShardings:
+    from ..parallel.sharding import sharding_for
+
+    return DecodeShardings(
+        cache=sharding_for(mesh, (None, "batch", "kv", None, None), rules),
+        scale=sharding_for(mesh, (None, "batch", "kv", None), rules),
+        act=sharding_for(mesh, ("batch",), rules),
+    )
+
+
+def _rule_size(mesh, rules, name: str) -> int:
+    """Product of mesh-axis sizes sharding rule-table row `name`."""
+    from ..parallel.sharding import mesh_shards_rule
+
+    shape = dict(mesh.shape)
+    return math.prod(shape[a] for a in mesh_shards_rule(mesh, rules, name))
+
+
+def _validate_decode_mesh(cfg: TransformerConfig, mesh, rules) -> None:
+    """Head counts must divide their sharding axes: a split head has no
+    layout (the [M, D] cache block and the per-head softmax are atomic)."""
+    t_kv = _rule_size(mesh, rules, "kv")
+    if cfg.n_kv_heads % t_kv:
+        raise ValueError(
+            f"mesh-sharded decode: n_kv_heads={cfg.n_kv_heads} is not "
+            f"divisible by the 'kv' mesh axes (size {t_kv}) — a GQA model "
+            "with fewer kv heads than the tensor axis cannot shard its KV "
+            "cache. Shrink the tensor axis, or set rules['kv'] = None to "
+            "replicate the cache."
+        )
+    t_h = _rule_size(mesh, rules, "heads")
+    if cfg.n_heads % t_h:
+        raise ValueError(
+            f"mesh-sharded decode: n_heads={cfg.n_heads} is not divisible "
+            f"by the 'heads' mesh axes (size {t_h})"
+        )
+
+
+def prepare_decode(
+    params,
+    cfg: TransformerConfig,
+    *,
+    weight_dtype: str = "native",
+    mesh=None,
+    rules=None,
+) -> DecodeWeights:
+    """Build decode-ready weights ONCE, outside generate.
+
+    Casts f32 masters to cfg.dtype, fuses qkv / gate-up (dense models),
+    optionally quantizes (``weight_dtype="int8"``), and — when a mesh is
+    given — device_puts every parameter by the logical-axis rule table
+    (`transformer.param_logical_axes` x `parallel/sharding.py`), so the
+    result is laid out exactly as the jitted decode wants it. Callers that
+    drop their f32 masters after this hold only ONE resident copy of the
+    model; per-request generate calls then make no weight copies at all
+    (the in-call cast/fuse path costs roughly the attention+MLP weight
+    bytes of extra peak HBM per call).
+
+    Under a mesh whose rules shard heads/kv/mlp, the qkv and gate/up
+    fusions are skipped: concatenating differently-sharded matrices would
+    force GSPMD to reshuffle them every step, and TP decode is already
+    per-device-bandwidth-bound on the sharded weights themselves
+    (``weight_dtype="int8"`` is rejected there for the same reason — the
+    w8a16 path streams the fused layout)."""
+    if weight_dtype not in ("native", "int8"):
+        raise ValueError(
+            f"weight_dtype must be 'native' or 'int8', got {weight_dtype!r}"
+        )
+    sharded_tp = False
+    if mesh is not None:
+        if rules is None:
+            from ..parallel.sharding import TP_DECODE_RULES
+            rules = TP_DECODE_RULES
+        _validate_decode_mesh(cfg, mesh, rules)
+        sharded_tp = any(
+            _rule_size(mesh, rules, r) > 1 for r in ("heads", "kv", "mlp")
+        )
+        if sharded_tp and weight_dtype == "int8":
+            raise ValueError(
+                "weight_dtype='int8' decode is single-device: the w8a16 "
+                "path streams the fused qkv/gate-up layout, which conflicts "
+                "with head/mlp-sharded weights"
+            )
+        from ..parallel.sharding import shard_params
+        params = shard_params(
+            mesh, params, transformer.param_logical_axes(cfg), rules
+        )
+    params = _cast_decode_params(params, cfg)
+    if cfg.n_experts > 0:
+        if weight_dtype == "int8":
+            raise ValueError(
+                "weight_dtype='int8' is dense-only (MoE expert weights are "
+                "routed, not streamed every step)"
+            )
+        fused = None
+    elif sharded_tp:
+        fused = None
+    else:
+        fused = _fuse_decode_weights(params, cfg, weight_dtype)
+    return DecodeWeights(params=params, fused=fused,
+                         weight_dtype=weight_dtype, mesh=mesh)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k",
-                              "kv_dtype", "max_len", "weight_dtype")
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k",
+                     "kv_dtype", "max_len", "weight_dtype", "build_fused",
+                     "stop_tokens", "pad_id", "shardings"),
 )
+def _generate_jit(
+    params,
+    fused,
+    prompt,
+    key,
+    *,
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int,
+    kv_dtype: str,
+    max_len: int,
+    weight_dtype: str,
+    build_fused: bool,
+    stop_tokens: tuple,
+    pad_id: int,
+    shardings: DecodeShardings | None,
+):
+    """The whole generate loop under one jit: prefill once, then either a
+    lax.scan of decode steps (no stop tokens: fixed trip count) or a
+    lax.while_loop with a per-sequence finished mask (stop tokens: exits
+    as soon as EVERY row has emitted a stop, so the batch pays for the
+    slowest sequence, not for max_new_tokens). Returns
+    (tokens [B, max_new], decode_steps scalar int32)."""
+    params = _cast_decode_params(params, cfg)   # no-op on prepared weights
+    if build_fused:
+        fused = _fuse_decode_weights(params, cfg, weight_dtype)
+    b, _ = prompt.shape
+    cache = init_cache(cfg, b, max_len, kv_dtype)
+    logits, cache = _forward_with_cache(params, cfg, prompt, cache, fused,
+                                        prefill=True, shardings=shardings)
+    key, sub = jax.random.split(key)
+    first = sample_token(logits, sub, temperature, top_k)
+
+    if not stop_tokens:
+        def step(carry, _):
+            tok, cache, key = carry
+            key, sub = jax.random.split(key)
+            logits, cache = _forward_with_cache(
+                params, cfg, tok[:, None], cache, fused, shardings=shardings
+            )
+            nxt = sample_token(logits, sub, temperature, top_k)
+            return (nxt, cache, key), nxt
+
+        # emit the sampled token so exactly max_new_tokens - 1 decode
+        # forwards run (the prefill already produced the first token)
+        (_, _, _), rest = lax.scan(
+            step, (first, cache, key), None, length=max_new_tokens - 1
+        )
+        toks = jnp.concatenate([first[None], rest], axis=0)
+        return jnp.moveaxis(toks, 0, 1), jnp.int32(max_new_tokens - 1)
+
+    stops = jnp.asarray(stop_tokens, jnp.int32)
+    out = jnp.full((b, max_new_tokens), pad_id, jnp.int32)
+    out = lax.dynamic_update_slice(out, first[:, None], (0, 0))
+    finished = jnp.isin(first, stops)
+
+    def cond(carry):
+        i, _, _, _, finished, _ = carry
+        return (i < max_new_tokens - 1) & ~jnp.all(finished)
+
+    def body(carry):
+        i, tok, cache, key, finished, out = carry
+        key, sub = jax.random.split(key)
+        logits, cache = _forward_with_cache(
+            params, cfg, tok[:, None], cache, fused, shardings=shardings
+        )
+        nxt = sample_token(logits, sub, temperature, top_k)
+        # finished rows emit pad and stay finished (pad may equal a stop id;
+        # the OR below keeps them finished either way)
+        nxt = jnp.where(finished, jnp.int32(pad_id), nxt)
+        finished = finished | jnp.isin(nxt, stops)
+        out = lax.dynamic_update_slice(out, nxt[:, None], (0, i + 1))
+        return (i + 1, nxt, cache, key, finished, out)
+
+    steps, _, _, _, _, out = lax.while_loop(
+        cond, body, (jnp.int32(0), first, cache, key, finished, out)
+    )
+    return out, steps
+
+
 def generate(
     params,
     cfg: TransformerConfig,
@@ -387,11 +629,21 @@ def generate(
     kv_dtype: str = "native",
     max_len: int | None = None,
     weight_dtype: str = "native",
-) -> jax.Array:
+    stop_tokens: tuple = (),
+    pad_id: int = 0,
+    mesh=None,
+    rules=None,
+    return_steps: bool = False,
+):
     """Generate max_new_tokens continuations -> [B, max_new_tokens] int32.
 
-    Whole loop is jitted: prefill once, then a lax.scan of single-token
-    decode steps against the in-place cache.
+    Whole loop is jitted: prefill once, then single-token decode steps
+    against the in-place cache (a fixed-length lax.scan, or a while_loop
+    with early exit when ``stop_tokens`` is given).
+
+    ``params`` may be a raw parameter pytree or a `DecodeWeights` from
+    `prepare_decode` (servers: build once, drop the f32 masters, no
+    per-call weight copies).
 
     ``kv_dtype="int8"`` stores the KV cache quantized (per-token-per-head
     symmetric int8, bf16 scales) — half the cache's HBM capacity and
@@ -406,7 +658,21 @@ def generate(
 
     ``max_len`` fixes the cache capacity independently of this call's
     prompt+new length (servers that reuse one compiled program across
-    request lengths want one capacity; attention cost scales with it)."""
+    request lengths want one capacity; attention cost scales with it).
+
+    ``stop_tokens`` (EOS): rows that emit any listed token stop; their
+    remaining positions are ``pad_id``. The emitted stop token itself IS
+    included in the output. Decode exits when all rows have stopped, so
+    the step count is bounded by the slowest sequence. ``return_steps=True``
+    additionally returns the number of decode forwards executed.
+
+    ``mesh`` + ``rules`` run the whole loop tensor-parallel: weights placed
+    by the training rule tables (default `TP_DECODE_RULES`), the KV cache
+    sharded over kv heads on the rules' "kv" axes, activations psum'd after
+    wo / w_down exactly as in Megatron-style training. n_kv_heads (and
+    n_heads) must divide their sharding axes — GQA models with fewer kv
+    heads than the tensor axis are rejected. qkv/gate-up fusion and w8a16
+    are single-device-only and disabled/rejected under a sharded mesh."""
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}"
@@ -416,9 +682,77 @@ def generate(
             "generate requires causal=True (a bidirectional encoder has no "
             "autoregressive decode)"
         )
+    if weight_dtype not in ("native", "int8"):
+        raise ValueError(
+            f"weight_dtype must be 'native' or 'int8', got {weight_dtype!r}"
+        )
     if key is None:
         key = jax.random.PRNGKey(0)
-    params = _cast_decode_params(params, cfg)
+    b, lp_len = prompt.shape
+    if max_len is None:
+        max_len = lp_len + max_new_tokens
+    elif max_len < lp_len + max_new_tokens:
+        raise ValueError(
+            f"max_len={max_len} < prompt ({lp_len}) + max_new_tokens "
+            f"({max_new_tokens})"
+        )
+
+    shardings = None
+    if mesh is not None:
+        if rules is None:
+            from ..parallel.sharding import TP_DECODE_RULES
+            rules = TP_DECODE_RULES
+        _validate_decode_mesh(cfg, mesh, rules)
+        t_b = _rule_size(mesh, rules, "batch")
+        if b % t_b:
+            raise ValueError(
+                f"mesh-sharded decode: batch {b} is not divisible by the "
+                f"'batch' mesh axes (size {t_b})"
+            )
+        shardings = _decode_shardings(mesh, rules)
+        # commit the inputs so jit doesn't guess a placement: prompt batch-
+        # sharded like the activations, key replicated
+        prompt = jax.device_put(prompt, shardings.act)
+        key = jax.device_put(
+            key, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+        )
+
+    if isinstance(params, DecodeWeights):
+        prepared = params
+        build_fused = False
+        if weight_dtype != "native" and weight_dtype != prepared.weight_dtype:
+            raise ValueError(
+                f"weight_dtype={weight_dtype!r} requested but the prepared "
+                f"weights were built with {prepared.weight_dtype!r} — pass "
+                "weight_dtype to prepare_decode instead"
+            )
+        prep_mesh = prepared.mesh
+        if (mesh is None) != (prep_mesh is None) or (
+            mesh is not None and mesh != prep_mesh
+        ):
+            raise ValueError(
+                "mesh mismatch: prepared weights were built "
+                + ("without a mesh" if prep_mesh is None
+                   else "for a different mesh")
+                + (" but generate was called with one" if prep_mesh is None
+                   else f" ({prep_mesh} != {mesh})")
+                + " — rebuild with prepare_decode(..., mesh=...) matching "
+                "the generate call"
+            )
+    elif mesh is not None:
+        prepared = prepare_decode(
+            params, cfg, weight_dtype=weight_dtype, mesh=mesh, rules=rules
+        )
+        build_fused = False
+    else:
+        if cfg.n_experts > 0 and weight_dtype == "int8":
+            raise ValueError(
+                "weight_dtype='int8' is dense-only (MoE expert weights are "
+                "routed, not streamed every step)"
+            )
+        prepared = DecodeWeights(params=params, fused=None)
+        build_fused = cfg.n_experts == 0
+
     if cfg.n_experts > 0:
         # decode routes B*1 tokens at a time; the training capacity formula
         # (cf * tokens * k / E) would then drop any token that collides with
@@ -430,49 +764,21 @@ def generate(
             cfg, capacity_factor=max(
                 cfg.capacity_factor, cfg.n_experts / cfg.expert_top_k),
         )
-    b, lp_len = prompt.shape
-    if max_len is None:
-        max_len = lp_len + max_new_tokens
-    elif max_len < lp_len + max_new_tokens:
-        raise ValueError(
-            f"max_len={max_len} < prompt ({lp_len}) + max_new_tokens "
-            f"({max_new_tokens})"
-        )
-    if weight_dtype not in ("native", "int8"):
-        raise ValueError(
-            f"weight_dtype must be 'native' or 'int8', got {weight_dtype!r}"
-        )
-    if cfg.n_experts > 0:
-        if weight_dtype == "int8":
-            raise ValueError(
-                "weight_dtype='int8' is dense-only (MoE expert weights are "
-                "routed, not streamed every step)"
-            )
-        fused = None
-    else:
-        fused = _fuse_decode_weights(params, cfg, weight_dtype)
-    cache = init_cache(cfg, b, max_len, kv_dtype)
-    logits, cache = _forward_with_cache(params, cfg, prompt, cache, fused,
-                                        prefill=True)
-    key, sub = jax.random.split(key)
-    first = sample_token(logits, sub, temperature, top_k)
 
-    def step(carry, _):
-        tok, cache, key = carry
-        key, sub = jax.random.split(key)
-        logits, cache = _forward_with_cache(
-            params, cfg, tok[:, None], cache, fused
-        )
-        nxt = sample_token(logits, sub, temperature, top_k)
-        return (nxt, cache, key), nxt
-
-    # emit the sampled token so exactly max_new_tokens - 1 decode forwards
-    # run (the prefill already produced the first token's logits)
-    (_, _, _), rest = lax.scan(
-        step, (first, cache, key), None, length=max_new_tokens - 1
+    out, steps = _generate_jit(
+        prepared.params, prepared.fused, prompt, key,
+        cfg=cfg, max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, kv_dtype=kv_dtype, max_len=max_len,
+        weight_dtype=weight_dtype, build_fused=build_fused,
+        stop_tokens=tuple(int(t) for t in stop_tokens), pad_id=int(pad_id),
+        shardings=shardings,
     )
-    toks = jnp.concatenate([first[None], rest], axis=0)
-    return jnp.moveaxis(toks, 0, 1)                     # [B, max_new]
+    if return_steps:
+        return out, steps
+    return out
 
 
-__all__ = ["KVCache", "init_cache", "generate", "sample_token"]
+__all__ = [
+    "KVCache", "init_cache", "generate", "sample_token",
+    "prepare_decode", "DecodeWeights",
+]

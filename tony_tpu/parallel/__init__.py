@@ -22,6 +22,7 @@ from .sharding import (
     FSDP_RULES,
     FSDP_TP_RULES,
     SP_RULES,
+    TP_DECODE_RULES,
     TP_RULES,
     batch_sharding,
     logical_to_spec,
@@ -46,7 +47,8 @@ from .expert import load_balancing_loss, moe_ffn, top_k_routing
 __all__ = [
     "AXIS_ORDER", "MeshSpec", "build_hybrid_mesh", "build_mesh",
     "detect_num_slices", "mesh_from_string", "slice_topology",
-    "DP_RULES", "FSDP_RULES", "TP_RULES", "FSDP_TP_RULES", "SP_RULES", "EP_RULES",
+    "DP_RULES", "FSDP_RULES", "TP_RULES", "TP_DECODE_RULES", "FSDP_TP_RULES",
+    "SP_RULES", "EP_RULES",
     "merge_rules", "logical_to_spec", "sharding_for", "tree_shardings",
     "shard_params", "batch_sharding",
     "make_ring_attention", "reference_attention", "ring_attention",

@@ -44,6 +44,17 @@ FSDP_TP_RULES: Rules = {
     "embed": "fsdp",
 }
 
+TP_DECODE_RULES: Rules = {
+    # inference tensor parallelism (models/generate.py). Training TP keeps
+    # "kv" replicated (GQA kv-head counts often don't divide the tensor
+    # axis, and training HBM is dominated by activations+optimizer, not the
+    # cache); decode HBM is dominated by the KV cache, so here it shards
+    # over kv heads — generate() rejects models whose n_kv_heads doesn't
+    # divide the axis rather than silently replicating.
+    **TP_RULES,
+    "kv": "tensor",
+}
+
 SP_RULES: Rules = {
     # context parallelism: activations sharded along sequence; used with
     # ring attention (parallel/ring_attention.py)
