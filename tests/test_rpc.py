@@ -46,6 +46,48 @@ def test_hmac_auth():
         server.stop()
 
 
+def test_role_based_authorization():
+    """Per-method ACL: an executor-signed finish_application is rejected
+    (authorization), a client-signed one accepted; a caller signing the
+    client role with the executor key fails authentication (the role claim
+    is covered by the MAC, and keys are one-way per role)."""
+    from tony_tpu.rpc.protocol import derive_role_key
+
+    secret = "job-s3cret"
+    roles = {
+        "client": derive_role_key(secret, "client"),
+        "executor": derive_role_key(secret, "executor"),
+    }
+    server = RpcServer(roles=roles, acl={"finish_application": {"client"}})
+    server.register("finish_application", lambda: "done")
+    server.register("heartbeat", lambda task_id: True)
+    server.start()
+    try:
+        ex = RpcClient("127.0.0.1", server.port,
+                       token=roles["executor"], role="executor")
+        assert ex.call("heartbeat", task_id="w:0") is True
+        with pytest.raises(RpcError, match="authorization failed"):
+            ex.call("finish_application")
+        # executor key + client role claim: authentication fails (can't
+        # derive the client key from the executor key)
+        forged = RpcClient("127.0.0.1", server.port,
+                           token=roles["executor"], role="client")
+        with pytest.raises(RpcError, match="authentication"):
+            forged.call("finish_application")
+        # unknown role claim
+        nobody = RpcClient("127.0.0.1", server.port,
+                           token=roles["executor"], role="admin")
+        with pytest.raises(RpcError, match="authentication"):
+            nobody.call("heartbeat", task_id="w:0")
+        cl = RpcClient("127.0.0.1", server.port,
+                       token=roles["client"], role="client")
+        assert cl.call("finish_application") == "done"
+        assert cl.call("heartbeat", task_id="w:0") is True  # not in ACL
+        ex.close(); forged.close(); nobody.close(); cl.close()
+    finally:
+        server.stop()
+
+
 def test_reconnect_after_server_restart():
     server = make_server()
     port = server.port

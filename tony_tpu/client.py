@@ -211,7 +211,15 @@ class TonyClient:
                 )
             if info_path.exists():
                 info = json.loads(info_path.read_text())
-                return RpcClient(info["host"], info["port"], token=self.token)
+                from .rpc.protocol import derive_role_key
+                # the client signs with its derived client-role key —
+                # executors (who hold only the executor key) cannot forge
+                # these calls (driver-side ACL on finish_application)
+                return RpcClient(
+                    info["host"], info["port"],
+                    token=derive_role_key(self.token, "client"),
+                    role="client" if self.token else "",
+                )
             time.sleep(0.05)
         raise TimeoutError("driver did not advertise its endpoint in time")
 

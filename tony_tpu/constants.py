@@ -17,7 +17,10 @@ ENV_DRIVER_HOST = "TONY_DRIVER_HOST"
 ENV_DRIVER_PORT = "TONY_DRIVER_PORT"
 ENV_APP_ID = "TONY_APP_ID"
 ENV_JOB_DIR = "TONY_JOB_DIR"              # holds tony-final.json
-ENV_TOKEN = "TONY_SECRET_TOKEN"           # HMAC session token (ClientToAM-token role)
+ENV_TOKEN = "TONY_SECRET_TOKEN"           # HMAC key (ClientToAM-token role): the
+                                          # ROOT job secret in the client->driver
+                                          # env; the derived EXECUTOR-role key in
+                                          # driver->executor envs (rpc/protocol.py)
 ENV_TASK_COMMAND = "TONY_TASK_COMMAND"    # user command for this role
 ENV_JOB_ARCHIVE = "TONY_JOB_ARCHIVE"      # fetchable job-archive URI (shipping)
 ENV_JOB_ARCHIVE_SHA256 = "TONY_JOB_ARCHIVE_SHA256"  # expected digest of that URI

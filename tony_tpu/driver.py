@@ -173,8 +173,25 @@ class Driver:
         self.runtime_driver.set_session(self.session)
         self.scheduler: TaskScheduler | None = None
 
+        # per-principal auth: the root job secret (held by client + driver
+        # only) derives one key per role; executors get ONLY the executor
+        # key, so they cannot sign client-privileged calls. finish_application
+        # flips the driver into teardown — an executor must not be able to
+        # end the job for everyone (reference TonyPolicyProvider ACL split,
+        # ApplicationMaster.java:483-503).
+        from .rpc.protocol import derive_role_key
+
+        self.executor_token = derive_role_key(token, "executor")
+        roles = acl = None
+        if token:
+            roles = {
+                "client": derive_role_key(token, "client"),
+                "executor": self.executor_token,
+            }
+            acl = {"finish_application": {"client"}}
         self.rpc_server = RpcServer(
-            host=str(conf.get(keys.AM_RPC_HOST, "127.0.0.1")), token=token
+            host=str(conf.get(keys.AM_RPC_HOST, "127.0.0.1")), token=token,
+            roles=roles, acl=acl,
         )
         self.rpc_server.register_service(DriverService(self))
         self.events: EventHandler | None = None
@@ -324,7 +341,7 @@ class Driver:
             c.ENV_DRIVER_PORT: str(self.rpc_server.port),
             c.ENV_APP_ID: self.app_id,
             c.ENV_JOB_DIR: str(self.job_dir),
-            c.ENV_TOKEN: self.token,
+            c.ENV_TOKEN: self.executor_token,
             c.ENV_TASK_COMMAND: spec.command,
         }
         # job-archive shipping (reference HDFS localization seam): executors

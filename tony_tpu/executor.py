@@ -113,8 +113,12 @@ class Executor:
         self.conf = TonyConf.from_final(self.job_dir) if self.job_dir else TonyConf()
 
         token = env.get(c.ENV_TOKEN, "")
+        # ENV_TOKEN carries the executor-role key (derived one-way from the
+        # job secret by the driver) — sufficient for the umbilical methods,
+        # unable to sign client-privileged ones
         self.rpc = RpcClient(self.driver_host, self.driver_port, token=token,
-                             max_retries=30)
+                             max_retries=30,
+                             role="executor" if token else "")
 
         from .runtimes import get_runtime
 
@@ -207,9 +211,11 @@ class Executor:
         # failed call must count as exactly one missed heartbeat. Started
         # BEFORE the gang barrier so a driver that dies mid-registration
         # still takes this executor down promptly.
+        hb_token = os.environ.get(c.ENV_TOKEN, "")
         hb_rpc = RpcClient(
             self.driver_host, self.driver_port,
-            token=os.environ.get(c.ENV_TOKEN, ""), max_retries=1,
+            token=hb_token, max_retries=1,
+            role="executor" if hb_token else "",
         )
         heartbeater = Heartbeater(
             hb_rpc, self.task_id, hb_interval,

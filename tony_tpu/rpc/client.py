@@ -22,9 +22,11 @@ class RpcClient:
         token: str = "",
         max_retries: int = 10,
         connect_timeout: float = 5.0,
+        role: str = "",
     ):
         self._addr = (host, port)
         self._token = token
+        self._role = role
         self._max_retries = max_retries
         self._connect_timeout = connect_timeout
         self._sock: socket.socket | None = None
@@ -58,7 +60,9 @@ class RpcClient:
                         {
                             "method": method,
                             "params": params,
-                            "auth": sign(self._token, method, params),
+                            "auth": sign(self._token, method, params,
+                                         self._role),
+                            "role": self._role,
                         },
                     )
                     resp = recv_frame(sock)

@@ -26,9 +26,24 @@ class RpcServer:
     connection (connections are persistent — executors keep one open for
     heartbeats)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, token: str = ""):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        token: str = "",
+        roles: dict[str, str] | None = None,
+        acl: dict[str, frozenset[str] | set[str]] | None = None,
+    ):
+        """``token`` alone = single-key mode (every holder may call every
+        method). ``roles`` (role name -> HMAC key) switches to per-principal
+        auth: the request's role claim selects the key, and ``acl``
+        (method -> allowed roles; methods absent from it accept any
+        authenticated role) enforces the client/executor privilege split —
+        reference TonyPolicyProvider service ACLs."""
         self._handlers: dict[str, Handler] = {}
         self._token = token
+        self._roles = roles
+        self._acl = {m: frozenset(r) for m, r in (acl or {}).items()}
         outer = self
 
         class _ConnHandler(socketserver.BaseRequestHandler):
@@ -89,7 +104,22 @@ class RpcServer:
     def _dispatch(self, req: dict[str, Any]) -> dict[str, Any]:
         method = req.get("method", "")
         params = req.get("params", {}) or {}
-        if not verify(self._token, method, params, req.get("auth", "")):
+        role = str(req.get("role", "") or "")
+        if self._roles is not None:
+            key = self._roles.get(role)
+            if key is None or not verify(
+                key, method, params, req.get("auth", ""), role
+            ):
+                return {"ok": False, "error": "authentication failed"}
+            allowed = self._acl.get(method)
+            if allowed is not None and role not in allowed:
+                return {
+                    "ok": False,
+                    "error": f"authorization failed: {method} requires role "
+                             f"{sorted(allowed)}, caller holds {role!r}",
+                }
+        elif not verify(self._token, method, params, req.get("auth", ""),
+                        role):
             return {"ok": False, "error": "authentication failed"}
         handler = self._handlers.get(method)
         if handler is None:
