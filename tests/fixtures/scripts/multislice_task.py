@@ -1,0 +1,38 @@
+"""Worker for the multislice e2e: asserts the full multislice env contract
+(provisioner-injected TONY_SLICE_* + the JAX adapter's MEGASCALE_* mapping),
+then simulates a spot preemption of slice 1 on the first attempt — the
+worker on slice 1 destroys its own slice's state (as the cloud would) and
+dies; the retry must find slice 0 intact and slice 1 re-created."""
+import os
+import sys
+from pathlib import Path
+
+sid = int(os.environ["TONY_SLICE_ID"])
+n = int(os.environ["TONY_NUM_SLICES"])
+session = int(os.environ["TONY_SESSION_ID"])
+task_index = int(os.environ["TONY_TASK_INDEX"])
+
+assert n == 2, f"TONY_NUM_SLICES={n}"
+# 1 host per slice, round-robin packing: task i lands on slice i
+assert sid == task_index, (sid, task_index)
+assert os.environ["TONY_SLICE0_HOST"].startswith("host0"), \
+    os.environ["TONY_SLICE0_HOST"]
+assert os.environ["MEGASCALE_NUM_SLICES"] == "2"
+assert os.environ["MEGASCALE_SLICE_ID"] == str(sid)
+assert os.environ["MEGASCALE_COORDINATOR_ADDRESS"].endswith(":8080"), \
+    os.environ["MEGASCALE_COORDINATOR_ADDRESS"]
+
+if session == 0:
+    # a slice preemption collapses the whole gang: the worker ON the
+    # preempted slice destroys its slice state (as the cloud would) and
+    # dies; its gang peers lose their collective and die too (the chief's
+    # failure is what fails the attempt under "succeed unless chief/
+    # stop-on-failure fails" semantics)
+    if sid == 1:
+        Path(os.environ["STUB_PREEMPT_DIR"],
+             "slice.json").unlink(missing_ok=True)
+        print("preempted: slice 1 destroyed", file=sys.stderr)
+    else:
+        print("gang peer lost (slice 1 preempted)", file=sys.stderr)
+    sys.exit(1)
+print(f"attempt {session} slice {sid} ok")

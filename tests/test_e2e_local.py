@@ -471,6 +471,59 @@ def test_e2e_slice_lifecycle_create_preempt_recreate_delete(
     assert "attempt 1 ran on recreated slice" in out, dump_logs(client)
 
 
+def test_e2e_multislice_create_preempt_recreate_delete(
+    tmp_job_dirs, fixture_script, tmp_path
+):
+    """Two-slice job end to end: neither slice exists at submit, so the
+    driver creates BOTH ({slice}-templated lifecycle commands, one cloud
+    resource per slice); the gang spans both slices and every worker sees
+    the multislice env contract (TONY_SLICE_* + MEGASCALE_* mapping); the
+    first attempt 'preempts' slice 1 (its worker destroys the slice state
+    and dies), the retry re-creates ONLY slice 1; teardown deletes both
+    driver-created slices. Reference analogue: the RM granting containers
+    across racks, ApplicationMaster.java:1100-1119."""
+    stub = fixture_script("stub_slice.py")
+    base = tmp_path / "slices"
+    status, client = run_job(
+        tmp_job_dirs,
+        **{
+            "tony.worker.instances": 2,
+            "tony.worker.command":
+                f"{PY} {fixture_script('multislice_task.py')}",
+            "tony.am.retry-count": 1,
+            "tony.cluster.provisioner": "tpu-pod",
+            "tony.cluster.launch-template":
+                "env {env} " + PY + " -S -m tony_tpu.executor",
+            "tony.tpu.num-slices": 2,
+            "tony.tpu.discover-command":
+                f"{PY} -S {stub} describe {base}/s{{slice}}",
+            "tony.tpu.create-command":
+                f"{PY} -S {stub} create {base}/s{{slice}} 1 0",
+            "tony.tpu.delete-command":
+                f"{PY} -S {stub} delete {base}/s{{slice}}",
+            "tony.tpu.accelerator-type": "v5litepod-8",  # 1 host per slice
+            "tony.tpu.create-timeout-s": 15,
+            "tony.tpu.create-poll-interval-s": 0.02,
+            "tony.tpu.discover-retries": 1,
+            "tony.execution.env": f"STUB_PREEMPT_DIR={base}/s1",
+        },
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    # slice 0 created once and never again; slice 1 created twice
+    assert (base / "s0" / "create.log").read_text().splitlines() == \
+        ["create gen=1"]
+    assert (base / "s1" / "create.log").read_text().splitlines() == \
+        ["create gen=1", "create gen=2"], \
+        (base / "s1" / "create.log").read_text()
+    # teardown deleted both driver-created slices
+    for s in ("s0", "s1"):
+        assert not (base / s / "slice.json").exists(), f"{s} leaked"
+        assert (base / s / "delete.log").exists()
+    logs = Path(client.job_dir) / "logs"
+    assert "attempt 1 slice 0 ok" in (logs / "worker_0.stdout").read_text()
+    assert "attempt 1 slice 1 ok" in (logs / "worker_1.stdout").read_text()
+
+
 def test_e2e_killed_job_releases_created_slice(
     tmp_job_dirs, fixture_script, tmp_path
 ):

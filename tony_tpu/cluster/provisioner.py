@@ -180,12 +180,23 @@ class StaticHostProvisioner(Provisioner):
     def on_completion(self, cb):
         self._local.on_completion = cb
 
+    def _host_env(self, host_index: int, host: str) -> dict[str, str]:
+        """Extra env derived from WHERE the task landed — capacity topology
+        only the provisioner knows (e.g. the multislice contract vars).
+        Keyed by host index, not name: stub clouds may report identical
+        names across slices."""
+        return {}
+
     def launch(
         self, spec: RoleSpec, index: int, env: dict[str, str], log_dir: Path
     ) -> ContainerHandle:
         with self._lock:
-            host = self.hosts[self._count % len(self.hosts)]
+            host_index = self._count % len(self.hosts)
+            host = self.hosts[host_index]
             self._count += 1
+        extra = self._host_env(host_index, host)
+        if extra:
+            env = {**env, **extra}
         env_str = " ".join(f"{k}={shlex.quote(str(v))}" for k, v in env.items())
         # token replace, not str.format: the template is arbitrary shell
         # where literal braces (${VAR}, awk '{...}') are ordinary syntax

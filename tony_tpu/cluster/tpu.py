@@ -25,6 +25,16 @@ before.
 
 Slice geometry (chips/host, hosts/slice) for common accelerator types is
 tabulated so validation can reject role layouts that don't fit the slice.
+
+Multislice (tony.tpu.num-slices > 1): every lifecycle/discover template is
+instantiated once per slice with `{slice}` replaced by the slice index, so
+each slice is its own cloud resource with its own create/await/recreate/
+delete lifecycle; a preemption re-creates only the slice that died. The
+provisioner knows which hosts belong to which slice and injects
+TONY_SLICE_ID / TONY_NUM_SLICES / TONY_SLICE0_HOST into each launch — the
+env contract the JAX runtime turns into cross-slice (MEGASCALE) transport.
+Reference analogue: the RM granting containers across racks,
+ApplicationMaster.java:1100-1119.
 """
 
 from __future__ import annotations
@@ -34,10 +44,26 @@ import re
 import subprocess
 import time
 
+from .. import constants as c
 from ..conf import TonyConf, keys
 from .provisioner import StaticHostProvisioner
 
 log = logging.getLogger(__name__)
+
+SLICE_PLACEHOLDER = "{slice}"
+
+
+def slice_view(conf: TonyConf, slice_idx: int) -> TonyConf:
+    """A conf copy with `{slice}` substituted into the lifecycle command
+    templates — one cloud resource per slice index. With num-slices = 1 and
+    no placeholder in the templates this is the identity."""
+    sub = TonyConf(conf.as_dict())
+    for key in (keys.TPU_DISCOVER_COMMAND, keys.TPU_CREATE_COMMAND,
+                keys.TPU_DELETE_COMMAND):
+        v = str(conf.get(key, "") or "")
+        if v:
+            sub.set(key, v.replace(SLICE_PLACEHOLDER, str(slice_idx)))
+    return sub
 
 # accelerator type -> (chips per host, total chips) for common slices
 SLICE_GEOMETRY: dict[str, tuple[int, int]] = {
@@ -234,15 +260,18 @@ class TpuPodProvisioner(StaticHostProvisioner):
         self.accelerator_type = str(
             conf.get(keys.TPU_ACCELERATOR_TYPE, "") or ""
         )
-        # True once THIS provisioner materialized the slice: teardown only
-        # deletes driver-created capacity, never a user's pre-created slice
-        self.created = False
+        self.num_slices = max(1, conf.get_int(keys.TPU_NUM_SLICES, 1))
+        # slice indices THIS provisioner materialized: teardown only deletes
+        # driver-created capacity, never a user's pre-created slice
+        self._created_slices: set[int] = set()
+        self._slice_hosts: list[list[str]] = []
         _not_found_re(conf)  # reject a malformed pattern before any I/O
         if on_constructing is not None:
             # expose the instance BEFORE acquisition: teardown() depends
-            # only on (created, _conf), both set, so a signal handler can
-            # release a slice created during the (possibly minutes-long)
-            # await-READY poll below. stop_all/launch are NOT safe yet.
+            # only on (_created_slices, _conf), both set, so a signal
+            # handler can release slices created during the (possibly
+            # minutes-long) await-READY polls below. stop_all/launch are
+            # NOT safe yet.
             on_constructing(self)
         hosts = self._acquire()
         template = str(
@@ -250,27 +279,46 @@ class TpuPodProvisioner(StaticHostProvisioner):
         ) or None
         super().__init__(hosts, launch_template=template)
         log.info(
-            "tpu slice: %d hosts (%s)%s", len(hosts),
-            self.accelerator_type or "unknown type",
-            " [driver-created]" if self.created else "",
+            "tpu capacity: %d hosts / %d slice(s) (%s)%s", len(hosts),
+            self.num_slices, self.accelerator_type or "unknown type",
+            f" [driver-created: {sorted(self._created_slices)}]"
+            if self._created_slices else "",
         )
+
+    @property
+    def created(self) -> bool:
+        """True once this provisioner materialized ANY slice."""
+        return bool(self._created_slices)
 
     @property
     def _expected_hosts(self) -> int | None:
         return (slice_num_hosts(self.accelerator_type)
                 if self.accelerator_type else None)
 
-    def _acquire(self, during_refresh: bool = False) -> list[str]:
-        """Discover the slice; when absent/partial AND a create command is
-        configured, materialize it and poll to READY — the allocation half
-        of the reference RM (submitApplication:317-353 + async grants).
-        Shared by __init__ and refresh() so the two paths cannot drift.
+    def _host_env(self, host_index: int, host: str) -> dict[str, str]:
+        """The multislice env contract: which slice this task's host sits
+        on, the slice count, and slice 0's first host (the cross-slice
+        rendezvous point the JAX adapter feeds to MEGASCALE transport)."""
+        if self.num_slices <= 1:
+            return {}
+        sid, seen = 0, 0
+        for i, sh in enumerate(self._slice_hosts):
+            if host_index < seen + len(sh):
+                sid = i
+                break
+            seen += len(sh)
+        return {
+            c.ENV_SLICE_ID: str(sid),
+            c.ENV_NUM_SLICES: str(self.num_slices),
+            c.ENV_SLICE0_HOST: self._slice_hosts[0][0],
+        }
 
-        Declaring the slice gone triggers delete+create, so a single
-        transient discovery flake (API 5xx, auth hiccup, describe timeout)
-        must not destroy healthy — possibly user-pre-created — capacity:
-        discovery is retried tony.tpu.discover-retries times before the
-        lifecycle path engages."""
+    def _acquire(self, during_refresh: bool = False) -> list[str]:
+        """Discover every slice; materialize the absent ones (when a create
+        command is configured) — the allocation half of the reference RM
+        (submitApplication:317-353 + async grants). Shared by __init__ and
+        refresh() so the two paths cannot drift. Per-slice: a preemption
+        that killed slice 2 re-creates slice 2 only."""
         create_cmd = str(self._conf.get(keys.TPU_CREATE_COMMAND, "") or "")
         if create_cmd and not (
             str(self._conf.get(keys.TPU_DISCOVER_COMMAND, "") or "")
@@ -284,33 +332,55 @@ class TpuPodProvisioner(StaticHostProvisioner):
                 f"await READY: configure {keys.TPU_DISCOVER_COMMAND} (or "
                 f"{keys.CLUSTER_STATIC_HOSTS})"
             )
+        if self.num_slices > 1 and not self._conf.get(
+            keys.TPU_DISCOVER_COMMAND
+        ):
+            raise ValueError(
+                f"{keys.TPU_NUM_SLICES}={self.num_slices} needs per-slice "
+                f"discovery: set {keys.TPU_DISCOVER_COMMAND} (static host "
+                "lists carry no slice boundaries)"
+            )
+        slice_hosts = [
+            self._acquire_slice(s, during_refresh)
+            for s in range(self.num_slices)
+        ]
+        self._slice_hosts = slice_hosts
+        return [h for sh in slice_hosts for h in sh]
+
+    def _acquire_slice(self, s: int, during_refresh: bool) -> list[str]:
+        """Acquire ONE slice (index `s`; templates instantiated via
+        slice_view).
+
+        Declaring a slice gone triggers delete+create, so a single
+        transient discovery flake (API 5xx, auth hiccup, describe timeout)
+        must not destroy healthy — possibly user-pre-created — capacity:
+        discovery is retried tony.tpu.discover-retries times, and only
+        positive evidence (a NOT_FOUND stderr match, or a successful
+        describe listing the wrong host count) may engage the lifecycle
+        path."""
+        sconf = slice_view(self._conf, s)
+        create_cmd = str(sconf.get(keys.TPU_CREATE_COMMAND, "") or "")
         expected = self._expected_hosts
-        attempts = max(1, int(self._conf.get(keys.TPU_DISCOVER_RETRIES, 3)))
-        poll_s = float(self._conf.get(keys.TPU_CREATE_POLL_S, 10))
+        attempts = max(1, int(sconf.get(keys.TPU_DISCOVER_RETRIES, 3)))
+        poll_s = float(sconf.get(keys.TPU_CREATE_POLL_S, 10))
         err: Exception | None = None
-        # only positive evidence — the cloud saying NOT_FOUND, or a
-        # successful describe listing the wrong host count — may engage
-        # delete+recreate below; a run of purely transient failures (API
-        # 5xx, auth outage, describe timeouts) longer than the retry budget
-        # must abort rather than destroy a possibly-healthy slice the
-        # driver does not own
         confirmed_gone = False
         for attempt in range(attempts):
             if attempt:
                 time.sleep(poll_s)
             try:
-                hosts = discover_hosts(self._conf)
+                hosts = discover_hosts(sconf)
                 if expected is not None and len(hosts) != expected:
                     confirmed_gone = True  # successful describe, wrong size
                     if during_refresh:
                         raise ValueError(
-                            f"slice refresh found {len(hosts)} hosts, "
+                            f"slice {s} refresh found {len(hosts)} hosts, "
                             f"accelerator {self.accelerator_type} has "
                             f"{expected} (slice still recreating?)"
                         )
                     raise ValueError(
                         f"accelerator {self.accelerator_type} has {expected} "
-                        f"hosts, got {len(hosts)}"
+                        f"hosts, slice {s} got {len(hosts)}"
                     )
                 return hosts
             except (RuntimeError, ValueError,
@@ -319,54 +389,54 @@ class TpuPodProvisioner(StaticHostProvisioner):
                 confirmed_gone = confirmed_gone or getattr(
                     e, "not_found", False
                 )
-                log.info("slice discovery attempt %d/%d: %s",
-                         attempt + 1, attempts, e)
+                log.info("slice %d discovery attempt %d/%d: %s",
+                         s, attempt + 1, attempts, e)
         assert err is not None
         if not create_cmd:
             raise err  # discovery-only mode: absent slice is the user's error
         if not confirmed_gone:
             raise RuntimeError(
-                f"slice discovery failed {attempts}x without the cloud "
+                f"slice {s} discovery failed {attempts}x without the cloud "
                 f"confirming the slice absent (set "
                 f"{keys.TPU_NOT_FOUND_PATTERN} if your CLI's not-found "
                 f"message is unusual); refusing to delete+recreate "
                 f"capacity that may be healthy: {err}"
             ) from err
-        log.info("slice confirmed absent or partial; creating")
-        self.created = True  # even a failed create may leave capacity behind
+        log.info("slice %d confirmed absent or partial; creating", s)
+        # even a failed create may leave capacity behind
+        self._created_slices.add(s)
         try:
             # clear any remnant under the same name first (a preemption
             # carcass or half-created slice makes the create fail "exists")
-            delete_slice(self._conf)
-            create_slice(self._conf)
-            return await_slice_ready(self._conf, expected)
+            delete_slice(sconf)
+            create_slice(sconf)
+            return await_slice_ready(sconf, expected)
         except Exception:
             # a created-but-never-READY slice is billable capacity nothing
             # tracks once this raise aborts the driver — delete it now
-            if delete_slice(self._conf):
-                self.created = False
+            if delete_slice(sconf):
+                self._created_slices.discard(s)
             raise
 
     def refresh(self) -> None:
-        """Re-acquire the slice before a retry attempt (the "re-acquire the
-        slice, not a container" retry unit, SURVEY.md §7). A preempted spot
-        slice comes back with NEW host addresses, so static host lists
-        aside, every retry must re-discover. When discovery shows the slice
-        gone (or partial) and a create command is configured, the carcass is
-        deleted and the slice re-created — recovery from a preemption that
-        destroyed the capacity outright. Raising keeps the previous host
+        """Re-acquire every slice before a retry attempt (the "re-acquire
+        the slice, not a container" retry unit, SURVEY.md §7). A preempted
+        spot slice comes back with NEW host addresses, so every retry must
+        re-discover; a slice discovery shows gone (or partial) is deleted
+        and re-created — only that slice. Raising keeps the previous host
         list (the driver logs and retries with it)."""
         hosts = self._acquire(during_refresh=True)
         if hosts != self.hosts:
-            log.info("tpu slice refresh: hosts %s -> %s", self.hosts, hosts)
+            log.info("tpu capacity refresh: hosts %s -> %s",
+                     self.hosts, hosts)
         self.hosts = hosts
 
     def teardown(self) -> None:
-        """Delete the slice at job end — only if this driver created it
-        (symmetric with YARN releasing containers the RM granted; a user's
-        pre-created slice outlives the job)."""
-        if self.created:
-            delete_slice(self._conf)
+        """Delete every driver-created slice at job end (symmetric with
+        YARN releasing containers the RM granted; a user's pre-created
+        slice outlives the job)."""
+        for s in sorted(self._created_slices):
+            delete_slice(slice_view(self._conf, s))
 
     def validate_layout(self, conf: TonyConf) -> None:
         """Every TPU-holding task needs its own host (libtpu is exclusive

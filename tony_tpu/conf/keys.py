@@ -109,6 +109,13 @@ TPU_DISCOVER_COMMAND = "tony.tpu.discover-command"  # prints one worker host per
 # preempted slice must be replaced.
 TPU_CREATE_COMMAND = "tony.tpu.create-command"
 TPU_DELETE_COMMAND = "tony.tpu.delete-command"
+# >1 = the job spans N slices (multislice): each lifecycle/discover command
+# template is instantiated once per slice with `{slice}` replaced by the
+# slice index (0..N-1) — one cloud resource per slice — and executors get
+# TONY_SLICE_ID / TONY_NUM_SLICES / TONY_SLICE0_HOST so the JAX runtime can
+# bring up cross-slice (DCN) transport. Reference analogue: the RM granting
+# containers across racks (ApplicationMaster.java:1100-1119).
+TPU_NUM_SLICES = "tony.tpu.num-slices"
 TPU_CREATE_TIMEOUT_S = "tony.tpu.create-timeout-s"  # await-READY deadline
 TPU_CREATE_POLL_S = "tony.tpu.create-poll-interval-s"
 # discovery attempts before the lifecycle path declares the slice gone and

@@ -38,6 +38,16 @@ ENV_COORDINATOR_ADDRESS = "TONY_COORDINATOR_ADDRESS"
 ENV_PROCESS_ID = "TONY_PROCESS_ID"
 ENV_NUM_PROCESSES = "TONY_NUM_PROCESSES"
 
+# multi-slice contract (tony.tpu.num-slices > 1): injected by the
+# provisioner at launch from its capacity topology — which slice this
+# task's host belongs to, how many slices the job spans, and slice 0's
+# first host (the cross-slice rendezvous point). The JAX adapter maps these
+# to libtpu's MEGASCALE_* vars so DCN transport comes up across slices.
+ENV_SLICE_ID = "TONY_SLICE_ID"
+ENV_NUM_SLICES = "TONY_NUM_SLICES"
+ENV_SLICE0_HOST = "TONY_SLICE0_HOST"
+MEGASCALE_PORT = 8080                     # libtpu's default coordinator port
+
 # ---- well-known files in the job dir
 DRIVER_INFO_FILE = "driver.json"          # driver's rpc endpoint, written at prepare
                                           # (plays the YARN app-report role for the client)

@@ -61,4 +61,21 @@ class JaxTaskAdapter(GenericTaskAdapter):
             c.ENV_PROCESS_ID: str(rank),
             c.ENV_NUM_PROCESSES: str(payload.get("num_processes", ctx.world_size())),
         })
+        # multislice: the provisioner stamped TONY_SLICE_ID/NUM_SLICES/
+        # SLICE0_HOST into this executor's env from its capacity topology;
+        # map them to libtpu's MEGASCALE_* vars so DCN transport comes up
+        # across slices. jax.distributed.initialize still uses the single
+        # TONY coordinator for the control plane — the same one-coordinator
+        # contract, now spanning slices.
+        import os
+
+        n_slices = int(os.environ.get(c.ENV_NUM_SLICES, "1") or 1)
+        if n_slices > 1:
+            env.update({
+                "MEGASCALE_NUM_SLICES": str(n_slices),
+                "MEGASCALE_SLICE_ID": os.environ.get(c.ENV_SLICE_ID, "0"),
+                "MEGASCALE_COORDINATOR_ADDRESS":
+                    f"{os.environ.get(c.ENV_SLICE0_HOST, '')}:"
+                    f"{c.MEGASCALE_PORT}",
+            })
         return env

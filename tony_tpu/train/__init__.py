@@ -1,6 +1,6 @@
 """Training layer: distributed bootstrap, sharded train step, checkpointing."""
 
-from .bootstrap import init, task_info
+from .bootstrap import init, num_slices, slice_id, task_info
 from .step import (
     TrainStepBundle,
     create_train_step,
@@ -10,7 +10,7 @@ from .step import (
 )
 
 __all__ = [
-    "init", "task_info",
+    "init", "task_info", "num_slices", "slice_id",
     "TrainStepBundle", "create_train_step", "make_forward", "make_optimizer",
     "synthetic_lm_batch",
 ]

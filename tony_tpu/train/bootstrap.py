@@ -52,6 +52,18 @@ def init(timeout_s: int = 300) -> dict:
     }
 
 
+def num_slices() -> int:
+    """Slice count from the multislice env contract (1 = single slice).
+    Feed to `parallel.build_hybrid_mesh(num_slices=...)` to lay DCN-safe
+    axes across slices and bandwidth-hungry axes within them."""
+    return int(os.environ.get(c.ENV_NUM_SLICES, "1") or 1)
+
+
+def slice_id() -> int:
+    """This host's slice index from the multislice env contract."""
+    return int(os.environ.get(c.ENV_SLICE_ID, "0") or 0)
+
+
 def task_info() -> dict:
     """This task's identity from the executor env contract."""
     env = os.environ
