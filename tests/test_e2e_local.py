@@ -10,6 +10,7 @@ injection, assertions on final job status and task states.
 import json
 import os
 import sys
+import threading
 import time
 from pathlib import Path
 
@@ -107,6 +108,115 @@ def test_large_gang_48_workers(tmp_job_dirs):
     assert status == JobStatus.SUCCEEDED, dump_logs(client)
     assert len(client.task_infos) == 48
     assert all(t.status == "SUCCEEDED" for t in client.task_infos)
+
+
+def test_gang_scale_192_stub_executors(tmp_job_dirs, tmp_path):
+    """Driver scale one notch past the 48-proc test: 192 stub executors —
+    threads speaking the REAL framed-JSON RPC protocol over real sockets,
+    each holding a persistent connection like a live executor — against one
+    in-process driver. Asserts the ThreadingTCPServer control plane keeps
+    the gang barrier and heartbeat processing bounded at the container
+    counts the reference's YARN deployments run (hundreds per AM): barrier
+    release (first registration -> last cluster-spec handout) under 30s,
+    worst single heartbeat RTT under 2s while all 192 connections live.
+    ~10s wall; prints the measured barrier-release time."""
+    import tony_tpu.constants as c
+    from tony_tpu.cluster.provisioner import ContainerHandle, Provisioner
+    from tony_tpu.driver import Driver
+    from tony_tpu.rpc import RpcClient
+
+    N = 192
+    t_register: list[float] = []
+    t_spec: list[float] = []
+    hb_rtts: list[float] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    class StubExecutorProvisioner(Provisioner):
+        """launch() = start a thread that behaves like an executor agent:
+        register, poll the gang barrier, heartbeat, report success."""
+
+        def __init__(self):
+            super().__init__()
+            self.threads: list[threading.Thread] = []
+
+        def launch(self, spec, index, env, log_dir):
+            handle = ContainerHandle(
+                container_id=f"stub_{spec.name}_{index}",
+                host="127.0.0.1", role=spec.name, index=index,
+            )
+            t = threading.Thread(
+                target=self._run, args=(spec, index, env, handle),
+                daemon=True,
+            )
+            self.threads.append(t)
+            t.start()
+            return handle
+
+        def _run(self, spec, index, env, handle):
+            task_id = f"{spec.name}:{index}"
+            try:
+                rpc = RpcClient(
+                    env[c.ENV_DRIVER_HOST], int(env[c.ENV_DRIVER_PORT]),
+                    token=env.get(c.ENV_TOKEN, ""), role="executor",
+                )
+                with lock:
+                    t_register.append(time.time())
+                payload = rpc.call("register_worker", task_id=task_id,
+                                   host="127.0.0.1", port=20000 + index)
+                while payload is None:
+                    time.sleep(0.05)
+                    payload = rpc.call("get_cluster_spec", task_id=task_id)
+                with lock:
+                    t_spec.append(time.time())
+                assert payload["num_processes"] == N
+                for _ in range(3):
+                    t0 = time.time()
+                    rpc.call("heartbeat", task_id=task_id)
+                    with lock:
+                        hb_rtts.append(time.time() - t0)
+                    time.sleep(0.05)
+                rpc.call("register_execution_result", task_id=task_id,
+                         exit_code=0)
+                rpc.close()
+            except Exception as e:  # surfaced via the errors list
+                with lock:
+                    errors.append(f"{task_id}: {type(e).__name__}: {e}")
+                cb = self.on_completion
+                if cb:
+                    cb(handle, 1)
+                return
+            cb = self.on_completion
+            if cb:
+                cb(handle, 0)
+
+        def stop_container(self, handle):
+            pass
+
+        def stop_all(self):
+            pass
+
+    conf = base_conf(
+        tmp_job_dirs,
+        **{"tony.worker.instances": N, "tony.worker.command": "stub"},
+    )
+    job_dir = tmp_path / "job"
+    job_dir.mkdir()
+    conf.write_final(job_dir)
+    driver = Driver(conf, app_id="scale_test", job_dir=str(job_dir),
+                    token="scale-secret",
+                    provisioner=StubExecutorProvisioner())
+    driver.client_signal.set()  # no client: don't wait for the ack
+    status = driver.run()
+    assert not errors, errors[:5]
+    assert status == JobStatus.SUCCEEDED, driver.session.failure_message
+    assert len(t_spec) == N
+    barrier_release = max(t_spec) - min(t_register)
+    print(f"\n192-executor gang: barrier release {barrier_release:.2f}s, "
+          f"max heartbeat RTT {max(hb_rtts)*1e3:.0f}ms "
+          f"over {len(hb_rtts)} heartbeats")
+    assert barrier_release < 30, f"barrier took {barrier_release:.1f}s"
+    assert max(hb_rtts) < 2.0, f"heartbeat RTT {max(hb_rtts):.2f}s"
 
 
 def test_worker_failure_fails_job(tmp_job_dirs, fixture_script):
