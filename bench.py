@@ -49,7 +49,10 @@ REPO = Path(__file__).resolve().parent
 STEPS = 6000
 STEPS_PER_CALL = 1000
 BATCH = 512
-PAIRS = 3
+# 5 pairs: with 3, one noisy pair put the median at the mercy of a single
+# run (r03 spread was 29%); two more pairs cost ~4 min and make the median
+# robust to two bad pairs
+PAIRS = 5
 
 
 def _workload_args(out: Path, cache: Path) -> list[str]:

@@ -166,25 +166,31 @@ def _timed_train_run(seq_len: int, batch: int, steps: int, windows: int = 4,
 
 def bench_flash_vs_xla(seq_lens=(2048, 4096, 16384), iters: int = 64,
                        reps: int = 3) -> dict:
-    """fwd+bwd attention: Pallas flash kernel vs XLA reference.
+    """fwd+bwd attention: Pallas flash kernel vs the best compilable XLA
+    reference — the materializing O(L^2)-memory reference at short L, the
+    chunked+remat baseline (chunked_reference_attention) at L where the
+    materializing one cannot compile. Each row records which baseline ran
+    (xla_ref_impl), and long rows record the materializing path's
+    uncompilability as a structured field, not an error string.
 
     Each timed call runs `iters` *dependent* grad iterations inside one jit
     (dQ feeds the next Q), so per-iteration time reflects device compute,
-    not the per-dispatch round-trip of a tunneled accelerator.
-
-    Long L shrinks batch and iteration count: the XLA reference
-    materializes [B, H, L, L] f32 scores (34GB at B=4, L=16384 — it can
-    OOM where flash keeps O(block); an OOM is recorded as the result)."""
+    not the per-dispatch round-trip of a tunneled accelerator."""
     import jax
     import jax.numpy as jnp
 
-    from tony_tpu.ops.attention import flash_attention, reference_attention
+    from tony_tpu.ops.attention import (
+        chunked_reference_attention, flash_attention, reference_attention,
+    )
 
     H, D = 8, 128
     out = {}
     for L in seq_lens:
         B = 4 if L <= 4096 else 1
         n_iters = iters if L <= 4096 else 8
+        # the materializing reference's L x L f32 scores (plus backward
+        # residuals) stop compiling around L=8k on a 16GB chip
+        chunked = L > 8192
         ks = jax.random.split(jax.random.PRNGKey(L), 3)
         q, k, v = (
             jax.random.normal(kk, (B, H, L, D), jnp.bfloat16) for kk in ks
@@ -194,10 +200,13 @@ def bench_flash_vs_xla(seq_lens=(2048, 4096, 16384), iters: int = 64,
             return flash_attention(q, k, v, causal=True).astype(jnp.float32).sum()
 
         def ref_loss(q, k, v):
-            o = reference_attention(
-                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                v.transpose(0, 2, 1, 3), causal=True,
-            )
+            if chunked:
+                o = chunked_reference_attention(q, k, v, causal=True)
+            else:
+                o = reference_attention(
+                    q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                    v.transpose(0, 2, 1, 3), causal=True,
+                ).transpose(0, 2, 1, 3)
             return o.astype(jnp.float32).sum()
 
         def chained(loss_fn):
@@ -231,7 +240,12 @@ def bench_flash_vs_xla(seq_lens=(2048, 4096, 16384), iters: int = 64,
             except Exception as e:  # the XLA arm can OOM at long L
                 results[name] = None
                 results[name + "_error"] = " ".join(str(e).split())[:160]
-        row = {"batch": B}
+        row = {"batch": B,
+               "xla_ref_impl": ("chunked_remat_q512" if chunked
+                                else "materializing")}
+        if chunked:
+            row["materializing_xla"] = "uncompilable_at_this_L"
+            row["enables_regime"] = True  # flash makes 16k+ trainable at all
         for name in ("flash", "xla_ref"):
             row[name + "_ms"] = (round(results[name] * 1e3, 2)
                                  if results[name] else None)
@@ -243,6 +257,19 @@ def bench_flash_vs_xla(seq_lens=(2048, 4096, 16384), iters: int = 64,
         )
         out[f"L{L}"] = row
     return out
+
+
+def _two_point(walltime, new_tokens: int, *args) -> tuple[float, float, float]:
+    """(wall_long, wall_short, per-step device seconds): the two-point fit
+    shared by every decode bench — same program except the decode step
+    count, so the subtraction isolates the per-step device cost from the
+    fixed per-call (dispatch + prefill) overhead."""
+    if new_tokens < 2:
+        raise ValueError("two-point fit needs new_tokens >= 2")
+    short_new = max(1, new_tokens // 2)
+    dt = walltime(new_tokens, *args)
+    dt_short = walltime(short_new, *args)
+    return dt, dt_short, (dt - dt_short) / (new_tokens - short_new)
 
 
 def bench_decode(batch: int = 8, prompt_len: int = 128,
@@ -268,10 +295,7 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
     from tony_tpu.models import transformer
     from tony_tpu.models.generate import generate
 
-    if new_tokens < 2:
-        raise ValueError("bench_decode needs new_tokens >= 2 (two-point fit)")
     max_len = prompt_len + new_tokens
-    short_new = max(1, new_tokens // 2)
     cfg = transformer.TransformerConfig(
         vocab_size=32768, d_model=1024, n_layers=12, n_heads=8,
         n_kv_heads=8, d_ff=4096, max_seq_len=max_len,
@@ -295,19 +319,14 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
             times.append(time.time() - t0)
         return statistics.median(times)
 
-    dt = walltime(new_tokens)
-    dt_short = walltime(short_new)
-    step_s = (dt - dt_short) / (new_tokens - short_new)
+    dt, _, step_s = _two_point(walltime, new_tokens)
     overhead_s = max(0.0, dt - (new_tokens - 1) * step_s)
     # int8 cache arm: device step only (same program shape, half the cache
     # bytes with scale-folded reads)
-    q_step_s = (walltime(new_tokens, "int8")
-                - walltime(short_new, "int8")) / (new_tokens - short_new)
+    _, _, q_step_s = _two_point(walltime, new_tokens, "int8")
     # w8a16 arm: int8 weights AND cache — halves the weight stream that
     # floors decode, scales folded out of every matmul
-    w8_step_s = (walltime(new_tokens, "int8", "int8")
-                 - walltime(short_new, "int8", "int8")) \
-        / (new_tokens - short_new)
+    _, _, w8_step_s = _two_point(walltime, new_tokens, "int8", "int8")
     return {
         "batch": batch,
         "prompt_len": prompt_len,
@@ -323,6 +342,61 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
         "int8_weights_cache_device_step_ms": round(w8_step_s * 1000, 3),
         "int8_weights_cache_device_tokens_per_sec": round(
             batch / w8_step_s, 1),
+    }
+
+
+def bench_moe_decode(batch: int = 8, prompt_len: int = 128,
+                     new_tokens: int = 128, reps: int = 5) -> dict:
+    """MoE decode on a routed flagship variant (8 experts, top-2, same
+    d_model/layers as the dense flagship): native vs w8a16 expert weights.
+    Einsum-dispatch MoE streams ALL E experts' weights every step (static
+    shapes — routing picks capacity slots, not which weights load), so the
+    weight stream is ~E/2x the dense model's MLP stream and int8 halves it.
+    Same two-point device-step methodology as bench_decode."""
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models import transformer
+    from tony_tpu.models.generate import generate, prepare_decode
+
+    max_len = prompt_len + new_tokens
+    cfg = transformer.TransformerConfig(
+        vocab_size=32768, d_model=1024, n_layers=12, n_heads=8,
+        n_kv_heads=8, d_ff=2048, n_experts=8, expert_top_k=2,
+        max_seq_len=max_len, dtype=jnp.bfloat16, attn_impl="auto",
+    )
+    params = jax.jit(lambda k: transformer.init(k, cfg))(jax.random.PRNGKey(0))
+    n_params = transformer.num_params(params)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
+    )
+
+    def walltime(n_new: int, weight_dtype: str) -> float:
+        # prepare once outside the timed region (servers hold prebuilt
+        # weights); the jit itself is cached across calls
+        prep = prepare_decode(params, cfg, weight_dtype=weight_dtype)
+        kw = dict(max_len=max_len, kv_dtype="int8")
+        int(generate(prep, cfg, prompt, n_new, **kw)[0, 0])
+        times = []
+        for _ in range(reps):
+            t0 = time.time()
+            out = generate(prep, cfg, prompt, n_new, **kw)
+            int(out[0, 0])
+            times.append(time.time() - t0)
+        return statistics.median(times)
+
+    _, _, step_s = _two_point(walltime, new_tokens, "native")
+    _, _, w8_step_s = _two_point(walltime, new_tokens, "int8")
+    return {
+        "model": {"n_experts": cfg.n_experts, "top_k": cfg.expert_top_k,
+                  "d_ff": cfg.d_ff, "params_m": round(n_params / 1e6, 1)},
+        "batch": batch,
+        "kv_dtype": "int8",
+        "device_step_ms": round(step_s * 1000, 3),
+        "device_tokens_per_sec": round(batch / step_s, 1),
+        "w8_device_step_ms": round(w8_step_s * 1000, 3),
+        "w8_device_tokens_per_sec": round(batch / w8_step_s, 1),
+        "w8_speedup": round(step_s / w8_step_s, 2),
     }
 
 
@@ -414,12 +488,13 @@ def main() -> int:
     args = parser.parse_args()
 
     perf = {"train": bench_train(args.steps, args.batch)}
-    # prove the executor-side TPU sampler on a machine with chips attached
-    # (empty on hosts whose TPU runtime serves no local metrics, e.g. a
-    # tunneled chip)
+    # prove the executor-side TPU sampler on a machine with chips attached;
+    # when this host's runtime serves no local metrics (e.g. a tunneled
+    # chip) the artifact records WHY instead of a bare {}
     from tony_tpu.metrics import sample_tpu_metrics
 
-    perf["tpu_metrics_sampled"] = sample_tpu_metrics()
+    tpu_metrics, reason = sample_tpu_metrics(explain=True)
+    perf["tpu_metrics_sampled"] = tpu_metrics or {"unavailable": reason}
     try:
         prior = json.loads(Path(args.out).read_text())
     except (OSError, ValueError):
@@ -431,8 +506,11 @@ def main() -> int:
         perf["flash_vs_xla_fwd_bwd"] = prior["flash_vs_xla_fwd_bwd"]
     if not args.skip_decode:
         perf["kv_cache_decode"] = bench_decode(batch=args.batch)
+        perf["moe_decode"] = bench_moe_decode(batch=args.batch)
     elif "kv_cache_decode" in prior:
         perf["kv_cache_decode"] = prior["kv_cache_decode"]
+        if "moe_decode" in prior:
+            perf["moe_decode"] = prior["moe_decode"]
     if not args.skip_long:
         perf["long_context_train"] = bench_long_context(
             prior=prior.get("long_context_train")

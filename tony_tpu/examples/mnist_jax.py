@@ -76,8 +76,14 @@ def main(argv=None) -> int:
 
     spc = min(args.steps_per_call, args.steps)
 
+    # the dataset is an ARGUMENT, not a closure capture: captured device
+    # arrays get baked into the executable as constants, which bloated the
+    # cached program to 53MB and made even a persistent-cache HIT pay
+    # seconds of executable load over a tunneled backend — the entire
+    # "warm relaunch still compiles 13s" mystery of the round-3 bench.
+    # As an argument the program is ~1MB and a warm relaunch loads fast.
     @jax.jit
-    def run_block(params, opt_state, start):
+    def run_block(params, opt_state, xb_all, yb_all, start):
         def body(carry, i):
             params, opt_state = carry
             j = (start + i) % nb
@@ -93,9 +99,13 @@ def main(argv=None) -> int:
         return params, opt_state, losses[-1]
 
     # warm-up/compile call (excluded from throughput, included in launch
-    # latency — the block runs spc steps, but compile dominates its cost)
-    params, opt_state, loss = run_block(params, opt_state, jnp.int32(0))
-    loss.block_until_ready()
+    # latency — the block runs spc steps, but compile dominates its cost).
+    # float() is the sync, here and in the timed loop: block_until_ready
+    # returns early on tunneled backends (measured 900k "steps/s" — queue
+    # depth, not compute), so only a device->host transfer is a hard sync.
+    params, opt_state, loss = run_block(params, opt_state, xb_all, yb_all,
+                                        jnp.int32(0))
+    float(loss)
     t_first_step = time.time()
 
     n_calls = max(1, args.steps // spc)
@@ -103,11 +113,11 @@ def main(argv=None) -> int:
     step = spc
     for _ in range(n_calls):
         t0 = time.time()
-        params, opt_state, loss = run_block(params, opt_state, jnp.int32(step))
-        loss.block_until_ready()
+        params, opt_state, loss = run_block(params, opt_state, xb_all,
+                                            yb_all, jnp.int32(step))
+        final_loss = float(loss)  # hard sync
         call_times.append(time.time() - t0)
         step += spc
-    final_loss = float(loss)
 
     median_call = statistics.median(call_times)
     acc = float(accuracy(params, x[:2048], y[:2048]))

@@ -224,9 +224,16 @@ def _fuse_decode_weights(params, cfg: TransformerConfig,
     (amortized over all decode steps); dense MLP only.
 
     weight_dtype="int8" additionally quantizes EVERY large decode matrix
-    (fused qkv, gate/up, wo, w_down, unembed) per-output-channel — decode
-    is weight-bandwidth-bound, so halving the streamed bytes buys ~that
-    much step time; numerics change within the int8 resolution (opt-in).
+    per-output-channel — decode is weight-bandwidth-bound, so halving the
+    streamed bytes buys ~that much step time; numerics change within the
+    int8 resolution (opt-in). Dense models quantize the fused qkv, gate/up,
+    wo, w_down, and unembed; MoE models quantize qkv/wo/unembed plus EVERY
+    expert's w_in/w_out with per-expert per-output-channel scales — the
+    einsum-dispatch MoE streams all E experts' weights every decode step
+    (static shapes; routing picks capacity slots, not which weights load),
+    so expert weights dominate the stream and quantize just as profitably
+    as dense ones. The scales fold out of the matmuls (parallel/expert.py
+    moe_ffn) so the streamed operand stays pure int8.
 
     HBM note: the fused (and, in w8 mode, quantized) copies live ALONGSIDE
     the master params for the duration of the generate call — roughly the
@@ -241,17 +248,22 @@ def _fuse_decode_weights(params, cfg: TransformerConfig,
         lp["wk"].reshape(L, d, -1),
         lp["wv"].reshape(L, d, -1),
     ], axis=-1)
-    w_gu = jnp.concatenate([lp["w_gate"], lp["w_up"]], axis=-1)
+    moe = cfg.n_experts > 0
+    if not moe:
+        w_gu = jnp.concatenate([lp["w_gate"], lp["w_up"]], axis=-1)
     if weight_dtype != "int8":
-        return {"wqkv": wqkv, "w_gu": w_gu}
-    out = {}
-    for name, w in (
+        return {"wqkv": wqkv} if moe else {"wqkv": wqkv, "w_gu": w_gu}
+    big = [
         ("wqkv", wqkv),
-        ("w_gu", w_gu),
         ("wo", lp["wo"].reshape(L, cfg.n_heads * cfg.head_dim, d)),
-        ("w_down", lp["w_down"]),
         ("unembed", params["unembed"]),
-    ):
+    ]
+    if moe:
+        big += [("w_in", lp["w_in"]), ("w_out", lp["w_out"])]
+    else:
+        big += [("w_gu", w_gu), ("w_down", lp["w_down"])]
+    out = {}
+    for name, w in big:
         q, s = _quantize_weight(w)
         out[name] = q
         out[name + "_s"] = s.astype(dt)
@@ -355,7 +367,7 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
             proj = jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
         x = x + proj
         hh = rms_norm(x, lp["mlp_norm"])
-        if fused is not None:
+        if fused is not None and "w_gu" in fused:
             gu = jnp.einsum("bld,de->ble", hh, fused["w_gu"][i].astype(dt))
             if w8:
                 gu = gu * fused["w_gu_s"][i]
@@ -366,6 +378,23 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
             )
             if w8:
                 mlp_out = mlp_out * fused["w_down_s"][i]
+        elif fused is not None and "w_in" in fused:
+            # w8 routed experts: int8 expert weights streamed, per-expert
+            # per-output-channel scales folded out of the matmuls
+            # (moe_ffn applies them post-matmul, broadcast over capacity).
+            # Same router/capacity/activation as transformer._mlp so
+            # routing decisions match the native path exactly.
+            from ..parallel.expert import moe_ffn
+
+            flat = hh.reshape(b * l, cfg.d_model)
+            mlp_out = moe_ffn(
+                flat, lp["router"].astype(dt),
+                fused["w_in"][i], fused["w_out"][i],
+                k=cfg.expert_top_k, capacity_factor=cfg.capacity_factor,
+                activation=jax.nn.silu,
+                w_in_scale=fused["w_in_s"][i],
+                w_out_scale=fused["w_out_s"][i],
+            ).reshape(b, l, cfg.d_model)
         else:
             mlp_out, _ = transformer._mlp(cfg, hh, lp)
         x = x + mlp_out
@@ -515,17 +544,8 @@ def prepare_decode(
             mesh, params, transformer.param_logical_axes(cfg), rules
         )
     params = _cast_decode_params(params, cfg)
-    if cfg.n_experts > 0:
-        if weight_dtype == "int8":
-            raise ValueError(
-                "weight_dtype='int8' is dense-only (MoE expert weights are "
-                "routed, not streamed every step)"
-            )
-        fused = None
-    elif sharded_tp:
-        fused = None
-    else:
-        fused = _fuse_decode_weights(params, cfg, weight_dtype)
+    fused = (None if sharded_tp
+             else _fuse_decode_weights(params, cfg, weight_dtype))
     return DecodeWeights(params=params, fused=fused,
                          weight_dtype=weight_dtype, mesh=mesh)
 
@@ -650,11 +670,14 @@ def generate(
     faster decode at long contexts; "native" (default) is bit-exact vs
     the full forward.
 
-    ``weight_dtype="int8"`` (w8a16; dense models only) quantizes every
-    large decode matrix per-output-channel, halving the ~0.5GB/step weight
-    stream that floors decode — the scales fold out of the matmuls so the
-    streamed operand is pure int8. Numerics change within the int8
-    resolution; the master params are untouched (quantized once per call).
+    ``weight_dtype="int8"`` (w8a16) quantizes every large decode matrix
+    per-output-channel, halving the ~0.5GB/step weight stream that floors
+    decode — the scales fold out of the matmuls so the streamed operand is
+    pure int8. MoE models quantize every expert's w_in/w_out with
+    per-expert scales (all E experts stream every step under einsum
+    dispatch, so they dominate the stream). Numerics change within the
+    int8 resolution; the master params are untouched (quantized once per
+    call).
 
     ``max_len`` fixes the cache capacity independently of this call's
     prompt+new length (servers that reuse one compiled program across
@@ -745,13 +768,8 @@ def generate(
         )
         build_fused = False
     else:
-        if cfg.n_experts > 0 and weight_dtype == "int8":
-            raise ValueError(
-                "weight_dtype='int8' is dense-only (MoE expert weights are "
-                "routed, not streamed every step)"
-            )
         prepared = DecodeWeights(params=params, fused=None)
-        build_fused = cfg.n_experts == 0
+        build_fused = True
 
     if cfg.n_experts > 0:
         # decode routes B*1 tokens at a time; the training capacity formula

@@ -955,7 +955,41 @@ def attention_blhd(
     return out.transpose(0, 2, 1, 3)
 
 
+def chunked_reference_attention(q, k, v, causal=True, q_block: int = 512):
+    """The strongest long-context attention plain XLA can offer without a
+    fused kernel: queries processed in blocks (lax.map) with jax.checkpoint
+    on the per-block body, so neither forward nor backward materializes the
+    [L, L] score matrix — only per-block [B, H, bq, L] scores, recomputed
+    in the backward. The materializing `reference_attention` is
+    uncompilable at L=16k on a 16GB chip (its L x L f32 residuals exceed
+    HBM); this is the honest XLA baseline the flash kernel is benchmarked
+    against there (bench_transformer.py), and a usable fallback for
+    platforms without Pallas. q/k/v: [B, H, L, D]."""
+    b, h, L, d = q.shape
+    nb = L // q_block
+    if nb * q_block != L:
+        raise ValueError(f"L={L} not divisible by q_block={q_block}")
+    scale = d ** -0.5
+
+    @jax.checkpoint
+    def block(qb, offset):
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qb, k, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qpos = offset + jnp.arange(L // nb)
+            mask = jnp.arange(L)[None, :] <= qpos[:, None]
+            s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    qb = q.reshape(b, h, nb, q_block, d).transpose(2, 0, 1, 3, 4)
+    offs = jnp.arange(nb) * q_block
+    out = jax.lax.map(lambda args: block(*args), (qb, offs))
+    return out.transpose(1, 2, 0, 3, 4).reshape(b, h, L, d)
+
+
 __all__ = [
     "flash_attention", "flash_attention_with_lse", "flash_supported",
-    "attention_blhd", "reference_attention",
+    "attention_blhd", "reference_attention", "chunked_reference_attention",
 ]

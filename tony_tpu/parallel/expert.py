@@ -66,9 +66,18 @@ def moe_ffn(
     k: int = 2,
     capacity_factor: float = 1.25,
     activation: Callable = jax.nn.gelu,
+    w_in_scale: jax.Array | None = None,    # [n_experts, 1, d_ff]
+    w_out_scale: jax.Array | None = None,   # [n_experts, 1, d_model]
 ):
     """Dense-dispatch MoE FFN. With w_in/w_out sharded P('expert', ...) and x
-    batch-sharded, XLA inserts the token all_to_all automatically."""
+    batch-sharded, XLA inserts the token all_to_all automatically.
+
+    ``w_in_scale``/``w_out_scale`` carry per-expert per-output-channel
+    dequant scales for int8 expert weights (w8a16 decode): the scales are
+    applied AFTER each expert matmul — broadcasting over the capacity dim —
+    so the weight operand streamed from HBM stays pure int8 (the einsum's
+    int8->dtype convert fuses into the operand read; pre-multiplying would
+    materialize a dequantized copy of every expert's weights per step)."""
     t, d = x.shape
     e = router_w.shape[1]
     # +1e-6 absorbs float error so an exactly-integral product never
@@ -82,8 +91,13 @@ def moe_ffn(
     combine = combine.astype(x.dtype)
 
     xs = jnp.einsum("td,tec->ecd", x, dispatch)            # [E, C, d]
-    h = activation(jnp.einsum("ecd,edf->ecf", xs, w_in))
-    ys = jnp.einsum("ecf,efd->ecd", h, w_out)              # [E, C, d]
+    h = jnp.einsum("ecd,edf->ecf", xs, w_in.astype(x.dtype))
+    if w_in_scale is not None:
+        h = h * w_in_scale
+    h = activation(h)
+    ys = jnp.einsum("ecf,efd->ecd", h, w_out.astype(x.dtype))  # [E, C, d]
+    if w_out_scale is not None:
+        ys = ys * w_out_scale
     return jnp.einsum("ecd,tec->td", ys, combine)
 
 
