@@ -321,6 +321,23 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
 
     dt, _, step_s = _two_point(walltime, new_tokens)
     overhead_s = max(0.0, dt - (new_tokens - 1) * step_s)
+
+    # mitigation measurement for the wall-vs-device gap: a serving loop
+    # that keeps several requests in flight dispatches the next generate
+    # before syncing the previous, so the fixed per-call cost (tunnel
+    # round trip + prefill queueing) overlaps device compute. depth=4
+    # identical calls, one hard sync on the last (FIFO queue => all done).
+    def pipelined_rate(depth: int = 4) -> float:
+        kw = dict(max_len=max_len)
+        int(generate(params, cfg, prompt, new_tokens, **kw)[0, 0])  # warm
+        times = []
+        for _ in range(reps):
+            t0 = time.time()
+            outs = [generate(params, cfg, prompt, new_tokens, **kw)
+                    for _ in range(depth)]
+            int(outs[-1][0, 0])
+            times.append(time.time() - t0)
+        return depth * batch * new_tokens / statistics.median(times)
     # int8 cache arm: device step only (same program shape, half the cache
     # bytes with scale-folded reads)
     _, _, q_step_s = _two_point(walltime, new_tokens, "int8")
@@ -337,6 +354,7 @@ def bench_decode(batch: int = 8, prompt_len: int = 128,
         "device_step_ms": round(step_s * 1000, 3),
         "device_tokens_per_sec": round(batch / step_s, 1),
         "call_overhead_s": round(overhead_s, 3),
+        "pipelined_depth4_tokens_per_sec": round(pipelined_rate(), 1),
         "int8_cache_device_step_ms": round(q_step_s * 1000, 3),
         "int8_cache_device_tokens_per_sec": round(batch / q_step_s, 1),
         "int8_weights_cache_device_step_ms": round(w8_step_s * 1000, 3),

@@ -70,16 +70,33 @@ def main(argv=None) -> int:
     from tony_tpu.models import transformer
     from tony_tpu.models.generate import generate
 
+    import functools
+
     cfg = transformer.TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
         n_heads=args.n_heads, n_kv_heads=args.n_heads, d_ff=args.d_ff,
         n_experts=args.n_experts, dtype=getattr(jnp, args.dtype),
     )
-    params = transformer.init(jax.random.PRNGKey(args.seed), cfg)
-    if args.checkpoint_dir:
-        from tony_tpu.train.checkpoint import CheckpointManager
 
-        from tony_tpu.train.step import make_optimizer
+    mesh = pshard = None
+    if args.tensor_parallel > 1:
+        from tony_tpu.parallel import MeshSpec, TP_DECODE_RULES, build_mesh
+        from tony_tpu.parallel.sharding import tree_shardings
+
+        mesh = build_mesh(
+            MeshSpec(fsdp=1, tensor=args.tensor_parallel),
+            devices=jax.devices()[:args.tensor_parallel],
+        )
+        pshard = tree_shardings(
+            mesh, transformer.param_logical_axes(cfg), TP_DECODE_RULES
+        )
+
+    init_fn = functools.partial(transformer.init, cfg=cfg)
+    if args.checkpoint_dir:
+        from tony_tpu.train.checkpoint import (
+            CheckpointManager, sharded_restore_template,
+        )
+        from tony_tpu.train.step import _opt_state_shardings, make_optimizer
 
         mgr = CheckpointManager(args.checkpoint_dir)
         latest = mgr.latest_step()
@@ -87,12 +104,32 @@ def main(argv=None) -> int:
             raise SystemExit(f"no checkpoint found in {args.checkpoint_dir}")
         # lm_train checkpoints {params, opt_state}; restore needs the full
         # tree structure even though only params matter here
-        template = {"params": params,
-                    "opt_state": make_optimizer().init(params)}
+        if mesh is not None:
+            abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(args.seed))
+            opt_abstract = jax.eval_shape(make_optimizer().init, abstract)
+            # restore every shard DIRECTLY to its device: a model bigger
+            # than one chip's HBM never materializes whole anywhere
+            # (opt_state restores sharded too — orbax can't skip a saved
+            # subtree — and is dropped immediately)
+            oshard = _opt_state_shardings(opt_abstract, abstract, pshard,
+                                          mesh)
+            template = {
+                "params": sharded_restore_template(abstract, pshard),
+                "opt_state": sharded_restore_template(opt_abstract, oshard),
+            }
+        else:
+            p0 = transformer.init(jax.random.PRNGKey(args.seed), cfg)
+            template = {"params": p0, "opt_state": make_optimizer().init(p0)}
         restored = mgr.restore(template=template)
         params = restored["params"]
         mgr.close()
         print(f"restored checkpoint step {latest}")
+    elif mesh is not None:
+        # random init directly sharded (same no-single-device guarantee)
+        params = jax.jit(init_fn, out_shardings=pshard)(
+            jax.random.PRNGKey(args.seed))
+    else:
+        params = init_fn(jax.random.PRNGKey(args.seed))
 
     prompt_ids = [int(t) for t in args.prompt.split()]
     bad = [t for t in prompt_ids if not 0 <= t < args.vocab]
@@ -100,15 +137,6 @@ def main(argv=None) -> int:
         raise SystemExit(f"prompt ids out of vocab range: {bad}")
     prompt = jnp.asarray([prompt_ids], jnp.int32)
     stop_tokens = tuple(int(t) for t in args.stop_tokens.split())
-
-    mesh = None
-    if args.tensor_parallel > 1:
-        from tony_tpu.parallel import MeshSpec, build_mesh
-
-        mesh = build_mesh(
-            MeshSpec(fsdp=1, tensor=args.tensor_parallel),
-            devices=jax.devices()[:args.tensor_parallel],
-        )
 
     from tony_tpu.models.generate import prepare_decode
     prepared = prepare_decode(

@@ -15,6 +15,21 @@ from typing import Any
 log = logging.getLogger(__name__)
 
 
+def sharded_restore_template(abstract_tree: Any, shardings: Any) -> Any:
+    """Attach NamedShardings to a `jax.eval_shape` tree so
+    `CheckpointManager.restore(template=...)` writes each leaf's shards
+    DIRECTLY to their devices — a model bigger than one device's HBM
+    restores across the mesh without ever materializing whole on one chip
+    (the serve-side requirement of mesh-sharded decode,
+    models/generate.py)."""
+    import jax
+
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        abstract_tree, shardings,
+    )
+
+
 class CheckpointManager:
     """Thin orbax wrapper: async save every N steps, restore-latest."""
 
