@@ -418,6 +418,84 @@ def bench_moe_decode(batch: int = 8, prompt_len: int = 128,
     }
 
 
+def bench_spec_decode(prompt_len: int = 128, new_tokens: int = 128,
+                      gamma: int = 4, reps: int = 5) -> dict:
+    """Speculative decode cost model, measured on-chip. The compiled round
+    is acceptance-INDEPENDENT (static shapes: gamma+1 draft steps + one
+    (gamma+1)-wide verify), so the honest artifact is the measured round
+    cost plus the measured vanilla step cost; speedup at draft-agreement
+    rate a follows as E(a) * step / round with E(a) = (1-a^(g+1))/(1-a)
+    expected tokens per round. Random weights can't fake agreement, so the
+    modeled column is reported at a in {0.6, 0.8} alongside the measured
+    worst case (a=0: every round emits exactly 1 token)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models import transformer
+    from tony_tpu.models.generate import generate
+    from tony_tpu.models.speculative import speculative_generate
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=32768, d_model=1024, n_layers=12, n_heads=8,
+        n_kv_heads=8, d_ff=4096, max_seq_len=prompt_len + new_tokens,
+        dtype=jnp.bfloat16, attn_impl="auto",
+    )
+    draft = transformer.TransformerConfig(
+        vocab_size=32768, d_model=256, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=1024, max_seq_len=prompt_len + new_tokens,
+        dtype=jnp.bfloat16, attn_impl="auto",
+    )
+    tp = jax.jit(lambda k: transformer.init(k, cfg))(jax.random.PRNGKey(0))
+    dp = jax.jit(lambda k: transformer.init(k, draft))(jax.random.PRNGKey(1))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(2), (1, prompt_len), 0, cfg.vocab_size)
+    max_len = prompt_len + new_tokens
+
+    def vanilla_wall(n_new):
+        int(generate(tp, cfg, prompt, n_new, max_len=max_len)[0, 0])
+        times = []
+        for _ in range(reps):
+            t0 = time.time()
+            int(generate(tp, cfg, prompt, n_new, max_len=max_len)[0, 0])
+            times.append(time.time() - t0)
+        return statistics.median(times)
+
+    def spec_wall(n_new):
+        int(speculative_generate(tp, cfg, dp, draft, prompt, n_new,
+                                 gamma=gamma)[0, 0])
+        times = []
+        for _ in range(reps):
+            t0 = time.time()
+            int(speculative_generate(tp, cfg, dp, draft, prompt, n_new,
+                                     gamma=gamma)[0, 0])
+            times.append(time.time() - t0)
+        return statistics.median(times)
+
+    _, _, step_s = _two_point(vanilla_wall, new_tokens)
+    # random draft: acceptance ~0, so rounds == emitted-1 and the same
+    # two-point subtraction yields the per-ROUND cost
+    _, _, round_s = _two_point(spec_wall, new_tokens)
+    _, stats = speculative_generate(tp, cfg, dp, draft, prompt, 32,
+                                    gamma=gamma, return_stats=True)
+
+    def modeled(a):
+        e = sum(a ** i for i in range(gamma + 1))  # expected tokens/round
+        return round(e * step_s / round_s, 2)
+
+    return {
+        "gamma": gamma,
+        "target_params_m": round(transformer.num_params(tp) / 1e6, 1),
+        "draft_params_m": round(transformer.num_params(dp) / 1e6, 1),
+        "target_step_ms": round(step_s * 1e3, 3),
+        "round_ms": round(round_s * 1e3, 3),
+        "measured_acceptance_random_draft": round(
+            stats["acceptance_rate"], 3),
+        "speedup_at_acceptance_0": modeled(0.0),  # measured-cost worst case
+        "modeled_speedup_at_acceptance_0.6": modeled(0.6),
+        "modeled_speedup_at_acceptance_0.8": modeled(0.8),
+    }
+
+
 # constant token budget per step across the long-context sweep, so MFU and
 # tokens/s are comparable between sequence lengths
 TOKENS_PER_STEP = 16384
@@ -525,10 +603,11 @@ def main() -> int:
     if not args.skip_decode:
         perf["kv_cache_decode"] = bench_decode(batch=args.batch)
         perf["moe_decode"] = bench_moe_decode(batch=args.batch)
+        perf["speculative_decode"] = bench_spec_decode()
     elif "kv_cache_decode" in prior:
-        perf["kv_cache_decode"] = prior["kv_cache_decode"]
-        if "moe_decode" in prior:
-            perf["moe_decode"] = prior["moe_decode"]
+        for k in ("kv_cache_decode", "moe_decode", "speculative_decode"):
+            if k in prior:
+                perf[k] = prior[k]
     if not args.skip_long:
         perf["long_context_train"] = bench_long_context(
             prior=prior.get("long_context_train")

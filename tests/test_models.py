@@ -901,6 +901,66 @@ def test_lm_generate_sharded_checkpoint_restore(tmp_path):
     assert outs[0] == outs[1], outs
 
 
+DRAFT_TINY = transformer.TransformerConfig(
+    vocab_size=128, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+    d_ff=64, max_seq_len=64, dtype=jnp.float32, attn_impl="ref",
+)
+
+
+def test_speculative_generate_exact_any_draft():
+    """The acceptance rule guarantees output == vanilla greedy for ANY
+    draft: a random (useless) draft and the target-as-its-own-draft must
+    both reproduce generate()'s tokens exactly; self-draft accepts every
+    proposal (rounds = ceil((N-1)/(gamma+1)) verify forwards)."""
+    from tony_tpu.models.generate import generate
+    from tony_tpu.models.speculative import speculative_generate
+
+    tp = transformer.init(jax.random.PRNGKey(0), TINY)
+    dp = transformer.init(jax.random.PRNGKey(7), DRAFT_TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                TINY.vocab_size)
+    ref = np.asarray(generate(tp, TINY, prompt, 12))
+
+    out, stats = speculative_generate(tp, TINY, dp, DRAFT_TINY, prompt, 12,
+                                      gamma=3, return_stats=True)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert stats["rounds"] >= 1
+
+    out2, stats2 = speculative_generate(tp, TINY, tp, TINY, prompt, 12,
+                                        gamma=3, return_stats=True)
+    np.testing.assert_array_equal(np.asarray(out2), ref)
+    assert stats2["acceptance_rate"] == 1.0
+    assert stats2["rounds"] == -(-11 // 4)  # ceil((12-1)/(3+1))
+
+
+def test_speculative_generate_moe_and_rejections():
+    """MoE targets speculate too (drop-free capacity applied to both
+    models); bad configs fail loudly."""
+    import dataclasses
+
+    from tony_tpu.models.generate import generate
+    from tony_tpu.models.speculative import speculative_generate
+
+    moe = dataclasses.replace(TINY, n_experts=4, expert_top_k=2,
+                              capacity_factor=2.0)
+    tp = transformer.init(jax.random.PRNGKey(0), moe)
+    dp = transformer.init(jax.random.PRNGKey(7), DRAFT_TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 128)
+    ref = np.asarray(generate(tp, moe, prompt, 8))
+    out = speculative_generate(tp, moe, dp, DRAFT_TINY, prompt, 8, gamma=2)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+    with pytest.raises(ValueError, match="batch-1"):
+        speculative_generate(tp, moe, dp, DRAFT_TINY,
+                             jnp.zeros((2, 4), jnp.int32), 4)
+    with pytest.raises(ValueError, match="vocab"):
+        bad = dataclasses.replace(DRAFT_TINY, vocab_size=256)
+        speculative_generate(tp, moe, transformer.init(
+            jax.random.PRNGKey(2), bad), bad, prompt, 4)
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(tp, moe, dp, DRAFT_TINY, prompt, 4, gamma=0)
+
+
 def test_attn_window_model_variant():
     """Sliding-window config trains (ref path on CPU) and rejects the
     sequence-parallel combination."""

@@ -9,6 +9,7 @@ from .generate import (
     prepare_decode,
     sample_token,
 )
+from .speculative import speculative_generate
 from .transformer import (
     TransformerConfig,
     apply,
@@ -24,5 +25,5 @@ __all__ = [
     "TransformerConfig", "init", "apply", "apply_hidden", "loss_fn",
     "token_nll", "param_logical_axes", "num_params",
     "KVCache", "init_cache", "generate", "sample_token",
-    "prepare_decode", "DecodeWeights",
+    "prepare_decode", "DecodeWeights", "speculative_generate",
 ]

@@ -183,6 +183,24 @@ def _prefill_cfg(cfg: TransformerConfig) -> TransformerConfig:
     return cfg
 
 
+def moe_dropfree(cfg: TransformerConfig) -> TransformerConfig:
+    """Decode routes B*1 tokens at a time; the training capacity formula
+    (cf * tokens * k / E) would then drop any token that collides with
+    another on the same expert. E/k guarantees capacity >= token count ->
+    drop-free decode (and drop-free prefill, so cached generation matches
+    the full forward whenever that forward doesn't drop). The ONE place
+    this bound lives — generate and speculative_generate both call it, and
+    their output-exactness contract depends on them agreeing."""
+    if cfg.n_experts <= 0:
+        return cfg
+    import dataclasses
+
+    return dataclasses.replace(
+        cfg, capacity_factor=max(cfg.capacity_factor,
+                                 cfg.n_experts / cfg.expert_top_k),
+    )
+
+
 def _cast_decode_params(params, cfg: TransformerConfig):
     """Pre-cast f32 master weights to the activation dtype once per
     generate call. Decode is weight-bandwidth-bound — every step reads the
@@ -272,13 +290,17 @@ def _fuse_decode_weights(params, cfg: TransformerConfig,
 
 def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
                         fused: dict | None = None, prefill: bool = False,
-                        shardings: "DecodeShardings | None" = None):
+                        shardings: "DecodeShardings | None" = None,
+                        all_logits: bool = False):
     """Run L new tokens (absolute positions cache.length..+L-1) through the
     stack, reading/writing the cache -> (last-position logits [B, V] f32,
-    new cache). Only the LAST position is projected through the unembed —
-    generation never needs earlier logits, and a full [B, L, V] prefill
-    projection would be a pure HBM bonfire at long prompts / large vocab
-    (the same tensor the blockwise-CE training path exists to avoid).
+    new cache) — or ([B, L, V], new cache) with ``all_logits=True`` (the
+    speculative verify forward, models/speculative.py). By default only
+    the LAST position is projected through the unembed — generation never
+    needs earlier logits, and a full [B, L, V] prefill projection would be
+    a pure HBM bonfire at long prompts / large vocab (the same tensor the
+    blockwise-CE training path exists to avoid); all_logits callers keep L
+    small.
 
     The layer loop is UNROLLED (Python loop), not a lax.scan: a scan would
     have to thread the cache as per-layer xs/ys, which makes XLA re-read and
@@ -399,15 +421,22 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
             mlp_out, _ = transformer._mlp(cfg, hh, lp)
         x = x + mlp_out
 
-    x_last = rms_norm(x[:, -1], params["final_norm"])
+    # all_logits=True projects EVERY position ([B, L, V]) — the speculative
+    # verify forward needs the target's prediction after each drafted
+    # token; L there is the small draft window, so the projection stays
+    # tiny. Default projects only the last position (generation never
+    # needs earlier logits; a full [B, L, V] prefill projection would be
+    # a pure HBM bonfire at long prompts / large vocab).
+    x_out = rms_norm(x if all_logits else x[:, -1], params["final_norm"])
+    eq = "bld,dv->blv" if all_logits else "bd,dv->bv"
     if w8:
         logits = (
-            jnp.einsum("bd,dv->bv", x_last, fused["unembed"].astype(dt))
+            jnp.einsum(eq, x_out, fused["unembed"].astype(dt))
             * fused["unembed_s"][0]
         ).astype(jnp.float32)
     else:
         logits = jnp.einsum(
-            "bd,dv->bv", x_last, params["unembed"].astype(dt)
+            eq, x_out, params["unembed"].astype(dt)
         ).astype(jnp.float32)
     if shardings is not None:
         logits = lax.with_sharding_constraint(logits, shardings.act)
@@ -771,17 +800,7 @@ def generate(
         prepared = DecodeWeights(params=params, fused=None)
         build_fused = True
 
-    if cfg.n_experts > 0:
-        # decode routes B*1 tokens at a time; the training capacity formula
-        # (cf * tokens * k / E) would then drop any token that collides with
-        # another on the same expert. E/k guarantees capacity >= token count
-        # -> drop-free decode (and drop-free prefill, so cached generation
-        # matches the full forward whenever that forward doesn't drop).
-        import dataclasses
-        cfg = dataclasses.replace(
-            cfg, capacity_factor=max(
-                cfg.capacity_factor, cfg.n_experts / cfg.expert_top_k),
-        )
+    cfg = moe_dropfree(cfg)
 
     out, steps = _generate_jit(
         prepared.params, prepared.fused, prompt, key,

@@ -1,0 +1,222 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Decode is HBM-bandwidth-bound — every step streams the full weight set to
+produce ONE token (docs/performance.md roofline). Speculative decoding
+buys latency by converting target decode steps into a single wider verify
+forward: the draft autoregressively proposes ``gamma`` tokens (gamma cheap
+steps), the target runs ONE forward over all gamma+1 positions (same
+weight stream as one decode step — the extra positions ride along nearly
+free on the bandwidth-bound path), and the longest prefix of draft tokens
+that matches the target's own greedy choices is accepted, plus one
+correction/bonus token from the target itself.
+
+**Output-exactness guarantee**: every emitted token is the target's greedy
+argmax given its prefix, so the output is IDENTICAL to vanilla greedy
+decode for any draft model — a broken draft can only cost speed, never
+correctness (tested against `generate` token-for-token).
+
+TPU-first mechanics — why this slots into the static-cache design
+(models/generate.py) with no new machinery:
+
+- **Rollback is free.** Cache entries beyond ``cache.length`` are already
+  invisible (attention masks by position index), so rejecting a draft
+  suffix = resetting the length scalar. No copies, no re-writes.
+- **Static shapes.** gamma is static; every round runs exactly gamma+1
+  draft steps and one (gamma+1)-wide verify forward inside one
+  ``lax.while_loop`` — one compiled program regardless of acceptance.
+- **The verify forward reuses `_forward_with_cache`** with
+  ``all_logits=True`` ([B, gamma+1, V] — tiny) and writes the drafted
+  tokens' KV as a side effect, exactly what acceptance needs.
+
+Scope: greedy (temperature 0) and batch 1 — speculative decoding is a
+LATENCY optimization for the small-batch regime where decode is deepest
+into the bandwidth wall; throughput serving at large batch should use
+plain `generate` (or its pipelined serving loop, docs/performance.md).
+Temperature>0 needs the rejection-sampling acceptance rule; not
+implemented.
+
+No reference counterpart: TonY has no model/inference layer (SURVEY.md
+§2.3); part of the TPU-native capability layer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .generate import (
+    DecodeWeights,
+    _cast_decode_params,
+    _forward_with_cache,
+    _fuse_decode_weights,
+    init_cache,
+    moe_dropfree,
+)
+from .transformer import TransformerConfig
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "draft_cfg", "max_new_tokens", "gamma",
+                     "kv_dtype", "build_fused", "build_draft_fused"),
+)
+def _spec_jit(params, fused, draft_params, draft_fused, prompt, *,
+              cfg, draft_cfg, max_new_tokens, gamma, kv_dtype,
+              build_fused, build_draft_fused):
+    params = _cast_decode_params(params, cfg)
+    draft_params = _cast_decode_params(draft_params, draft_cfg)
+    if build_fused:
+        fused = _fuse_decode_weights(params, cfg, "native")
+    if build_draft_fused:
+        draft_fused = _fuse_decode_weights(draft_params, draft_cfg, "native")
+
+    b, lp = prompt.shape
+    cap = lp + max_new_tokens + gamma + 1   # worst-case overshoot
+    tc = init_cache(cfg, b, cap, kv_dtype)
+    dc = init_cache(draft_cfg, b, cap, kv_dtype)
+
+    # prefill both; the target's last-position logits seed the first token
+    logits, tc = _forward_with_cache(params, cfg, prompt, tc, fused,
+                                     prefill=True)
+    _, dc = _forward_with_cache(draft_params, draft_cfg, prompt, dc,
+                                draft_fused, prefill=True)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B]
+
+    out = jnp.zeros((b, max_new_tokens + gamma + 1), jnp.int32)
+    out = lax.dynamic_update_slice(out, first[:, None], (0, 0))
+
+    def round_body(carry):
+        produced, rounds, tok, tc, dc, out = carry
+
+        # --- draft proposes gamma tokens (gamma+1 steps: the extra step
+        # ingests the last proposal so the draft cache stays one-ahead
+        # for the all-accept case; its output is discarded)
+        def draft_step(carry, _):
+            tok, dc = carry
+            lg, dc = _forward_with_cache(
+                draft_params, draft_cfg, tok[:, None], dc, draft_fused)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (nxt, dc), tok
+
+        (_, dc), drafted_in = lax.scan(
+            draft_step, (tok, dc), None, length=gamma + 1)
+        # drafted_in[i] = token INGESTED at step i = [tok, d_1..d_gamma];
+        # the proposals are entries 1..gamma
+        d = jnp.moveaxis(drafted_in[1:], 0, 1)              # [B, gamma]
+
+        # --- target verifies all gamma+1 positions in ONE forward
+        verify_in = jnp.concatenate([tok[:, None], d], axis=1)
+        t_old = tc.length
+        lg_all, tc = _forward_with_cache(
+            params, cfg, verify_in, tc, fused, all_logits=True)
+        t = jnp.argmax(lg_all, axis=-1).astype(jnp.int32)   # [B, gamma+1]
+
+        # longest matching prefix: n_acc in [0, gamma]
+        matches = (d == t[:, :gamma]).astype(jnp.int32)     # [B, gamma]
+        n_acc = jnp.cumprod(matches, axis=1).sum(axis=1)    # [B]; B==1
+        n = n_acc[0]
+
+        # emitted this round: d[:n] then the target's correction/bonus t[n]
+        correction = jnp.take_along_axis(t, n_acc[:, None], axis=1)
+        idx = jnp.arange(gamma + 1)
+        d_ext = jnp.concatenate([d, jnp.zeros((b, 1), jnp.int32)], axis=1)
+        cand = jnp.where(idx[None, :] == n_acc[:, None], correction, d_ext)
+        out = lax.dynamic_update_slice(out, cand, (jnp.int32(0), produced))
+
+        # roll both caches back to prompt+emitted[:-1] — stale suffix
+        # entries are index-masked, so this is just the length scalar
+        tc2 = tc._replace(length=t_old + n + 1)
+        dc2 = dc._replace(length=t_old + n + 1)
+        tok = correction[:, 0]
+        return (produced + n + 1, rounds + 1, tok, tc2, dc2, out)
+
+    def cond(carry):
+        produced = carry[0]
+        return produced < max_new_tokens
+
+    produced, rounds, _, _, _, out = lax.while_loop(
+        cond, round_body,
+        (jnp.int32(1), jnp.int32(0), first, tc, dc, out),
+    )
+    return out[:, :max_new_tokens], produced, rounds
+
+
+def speculative_generate(
+    params,
+    cfg: TransformerConfig,
+    draft_params,
+    draft_cfg: TransformerConfig,
+    prompt: jax.Array,          # [1, Lp] int32
+    max_new_tokens: int,
+    *,
+    gamma: int = 4,
+    kv_dtype: str = "native",
+    return_stats: bool = False,
+):
+    """Greedy speculative decode -> [1, max_new_tokens] int32, identical to
+    ``generate(params, cfg, prompt, max_new_tokens)`` for ANY draft model.
+
+    ``params``/``draft_params`` may be raw pytrees or `DecodeWeights` from
+    `prepare_decode` (single-device, native only — w8a16 composes but is
+    not wired here). ``gamma`` drafts per round; higher gamma wins when
+    the draft agrees often and costs little.
+
+    ``return_stats=True`` additionally returns {"rounds", "drafted",
+    "accepted", "acceptance_rate"} — rounds is the number of target verify
+    forwards, so target forwards = rounds + 1 (prefill) vs max_new_tokens
+    for vanilla decode."""
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            "speculative_generate is batch-1 (a latency optimization; "
+            f"got batch {prompt.shape[0]}). Use generate() for batched "
+            "throughput serving."
+        )
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError(
+            f"draft and target must share a vocabulary "
+            f"({draft_cfg.vocab_size} != {cfg.vocab_size})"
+        )
+    if not cfg.causal or not draft_cfg.causal:
+        raise ValueError("speculative decode requires causal models")
+
+    def unpack(p):
+        if isinstance(p, DecodeWeights):
+            if p.mesh is not None:
+                raise ValueError("speculative_generate is single-device; "
+                                 "prepare_decode without a mesh")
+            return p.params, p.fused, False
+        return p, None, True               # raw params: cast+fuse in-jit
+
+    cfg = moe_dropfree(cfg)
+    draft_cfg = moe_dropfree(draft_cfg)
+    t_params, t_fused, build_t = unpack(params)
+    d_params, d_fused, build_d = unpack(draft_params)
+
+    out, produced, rounds = _spec_jit(
+        t_params, t_fused, d_params, d_fused, prompt,
+        cfg=cfg, draft_cfg=draft_cfg, max_new_tokens=max_new_tokens,
+        gamma=gamma, kv_dtype=kv_dtype,
+        build_fused=build_t, build_draft_fused=build_d,
+    )
+    if not return_stats:
+        return out
+    rounds_i = int(rounds)
+    produced_i = int(produced)
+    accepted = produced_i - 1 - rounds_i   # t0 + per-round (n_acc + 1)
+    drafted = rounds_i * gamma
+    return out, {
+        "rounds": rounds_i,
+        "drafted": drafted,
+        "accepted": accepted,
+        "acceptance_rate": accepted / drafted if drafted else 0.0,
+    }
+
+
+__all__ = ["speculative_generate"]
