@@ -61,6 +61,11 @@ def main(argv=None) -> int:
                         help=">1 runs mesh-sharded decode: weights + KV "
                              "cache sharded over the first N devices "
                              "(models/generate.py TP path)")
+    parser.add_argument("--hf-checkpoint", default="",
+                        help="local HuggingFace Llama/Mistral checkpoint "
+                             "dir: weights are imported into the flagship "
+                             "model (models/hf_import.py) and the model "
+                             "hyperparam flags are ignored")
     parser.add_argument("--metrics-out", default="")
     args = parser.parse_args(argv)
 
@@ -72,11 +77,25 @@ def main(argv=None) -> int:
 
     import functools
 
-    cfg = transformer.TransformerConfig(
-        vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
-        n_heads=args.n_heads, n_kv_heads=args.n_heads, d_ff=args.d_ff,
-        n_experts=args.n_experts, dtype=getattr(jnp, args.dtype),
-    )
+    hf_params = None
+    if args.hf_checkpoint:
+        if args.checkpoint_dir:
+            raise SystemExit(
+                "--hf-checkpoint and --checkpoint-dir are exclusive")
+        from tony_tpu.models.hf_import import load_hf
+
+        hf_params, cfg = load_hf(args.hf_checkpoint,
+                                 dtype=getattr(jnp, args.dtype))
+        args.vocab = cfg.vocab_size
+        print(f"imported HF checkpoint: {cfg.n_layers}L d{cfg.d_model} "
+              f"{cfg.n_heads}h/{cfg.n_kv_heads}kv vocab {cfg.vocab_size}")
+    else:
+        cfg = transformer.TransformerConfig(
+            vocab_size=args.vocab, d_model=args.d_model,
+            n_layers=args.n_layers, n_heads=args.n_heads,
+            n_kv_heads=args.n_heads, d_ff=args.d_ff,
+            n_experts=args.n_experts, dtype=getattr(jnp, args.dtype),
+        )
 
     mesh = pshard = None
     if args.tensor_parallel > 1:
@@ -92,7 +111,9 @@ def main(argv=None) -> int:
         )
 
     init_fn = functools.partial(transformer.init, cfg=cfg)
-    if args.checkpoint_dir:
+    if hf_params is not None:
+        params = hf_params          # prepare_decode shards under a mesh
+    elif args.checkpoint_dir:
         from tony_tpu.train.checkpoint import (
             CheckpointManager, sharded_restore_template,
         )

@@ -340,7 +340,7 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
     zero = jnp.int32(0)
     for i in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
-        h = rms_norm(x, lp["attn_norm"])
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         if fused is not None:
             qkv = jnp.einsum("bld,de->ble", h, fused["wqkv"][i].astype(dt))
             if w8:
@@ -388,7 +388,7 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
         else:
             proj = jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
         x = x + proj
-        hh = rms_norm(x, lp["mlp_norm"])
+        hh = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         if fused is not None and "w_gu" in fused:
             gu = jnp.einsum("bld,de->ble", hh, fused["w_gu"][i].astype(dt))
             if w8:
@@ -427,7 +427,8 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
     # tiny. Default projects only the last position (generation never
     # needs earlier logits; a full [B, L, V] prefill projection would be
     # a pure HBM bonfire at long prompts / large vocab).
-    x_out = rms_norm(x if all_logits else x[:, -1], params["final_norm"])
+    x_out = rms_norm(x if all_logits else x[:, -1], params["final_norm"],
+                     cfg.norm_eps)
     eq = "bld,dv->blv" if all_logits else "bd,dv->bv"
     if w8:
         logits = (

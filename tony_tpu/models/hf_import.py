@@ -1,0 +1,140 @@
+"""Import HuggingFace Llama-family checkpoints into the flagship model.
+
+The flagship transformer (models/transformer.py) IS the Llama
+architecture — RoPE (rotate-half form), GQA, SwiGLU, pre-RMSNorm — so a
+Llama/Mistral checkpoint maps onto it by pure weight-layout transposition,
+no graph changes. This module does that mapping, which makes every
+capability in this framework — mesh-sharded TP decode, w8a16/int8-cache
+quantized serving, speculative decoding, sharded training/fine-tuning —
+apply to real public checkpoints:
+
+    from transformers import AutoModelForCausalLM
+    from tony_tpu.models.hf_import import config_from_hf, params_from_hf
+
+    hf = AutoModelForCausalLM.from_pretrained(path)       # torch, CPU
+    cfg = config_from_hf(hf.config)
+    params = params_from_hf(hf.state_dict(), cfg)         # jax pytree
+    out = generate(params, cfg, prompt, 64, mesh=mesh)    # serve on TPU
+
+Supported: LlamaForCausalLM / MistralForCausalLM graphs (`model_type`
+"llama"/"mistral"), including tied embeddings and Mistral's sliding
+window (-> cfg.attn_window). Parity is tested logits-level against the
+transformers implementation (tests/test_models.py) — argmax decode
+matches HF `generate(do_sample=False)` token for token.
+
+Layout notes (HF nn.Linear stores [out, in]; this framework stores
+[in, out] so activations hit the MXU as x @ W without transposes):
+  q_proj [H*hd, d]  -> wq [d, H, hd]       o_proj [d, H*hd] -> wo [H, hd, d]
+  k/v_proj [kvH*hd, d] -> wk/wv [d, kvH, hd]
+  gate/up_proj [f, d] -> w_gate/w_up [d, f]  down_proj [d, f] -> w_down [f, d]
+  lm_head [V, d] -> unembed [d, V] (falls back to embed^T when tied)
+
+No reference counterpart: TonY has no model layer (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import TransformerConfig
+
+_SUPPORTED = ("llama", "mistral")
+
+
+def config_from_hf(hf_config: Any, dtype=jnp.bfloat16) -> TransformerConfig:
+    """Map a transformers LlamaConfig/MistralConfig to TransformerConfig."""
+    mt = getattr(hf_config, "model_type", "")
+    if mt not in _SUPPORTED:
+        raise ValueError(
+            f"unsupported model_type {mt!r}; supported: {_SUPPORTED} "
+            "(the flagship graph is Llama-shaped: RoPE/GQA/SwiGLU/RMSNorm)"
+        )
+    window = getattr(hf_config, "sliding_window", None) or 0
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        norm_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
+        attn_window=int(window),
+        dtype=dtype,
+    )
+
+
+def _t(sd: Mapping[str, Any], key: str) -> np.ndarray:
+    w = sd[key]
+    if hasattr(w, "detach"):            # torch tensor
+        w = w.detach().to("cpu").float().numpy()
+    return np.asarray(w, np.float32)
+
+
+def params_from_hf(state_dict: Mapping[str, Any],
+                   cfg: TransformerConfig) -> dict:
+    """HF state_dict -> this framework's parameter pytree (f32 masters;
+    `prepare_decode` / the train step cast to cfg.dtype at use). Layer
+    weights are stacked [n_layers, ...] as transformer.init builds them."""
+    hd, d = cfg.head_dim, cfg.d_model
+    L = cfg.n_layers
+
+    def stack(fmt: str, transform) -> jnp.ndarray:
+        return jnp.asarray(np.stack([
+            transform(_t(state_dict, fmt.format(i=i))) for i in range(L)
+        ]))
+
+    params: dict = {
+        "embed": jnp.asarray(_t(state_dict, "model.embed_tokens.weight")),
+        "layers": {
+            "attn_norm": stack(
+                "model.layers.{i}.input_layernorm.weight", lambda w: w),
+            "wq": stack(
+                "model.layers.{i}.self_attn.q_proj.weight",
+                lambda w: w.T.reshape(d, cfg.n_heads, hd)),
+            "wk": stack(
+                "model.layers.{i}.self_attn.k_proj.weight",
+                lambda w: w.T.reshape(d, cfg.n_kv_heads, hd)),
+            "wv": stack(
+                "model.layers.{i}.self_attn.v_proj.weight",
+                lambda w: w.T.reshape(d, cfg.n_kv_heads, hd)),
+            "wo": stack(
+                "model.layers.{i}.self_attn.o_proj.weight",
+                lambda w: w.T.reshape(cfg.n_heads, hd, d)),
+            "mlp_norm": stack(
+                "model.layers.{i}.post_attention_layernorm.weight",
+                lambda w: w),
+            "w_gate": stack(
+                "model.layers.{i}.mlp.gate_proj.weight", lambda w: w.T),
+            "w_up": stack(
+                "model.layers.{i}.mlp.up_proj.weight", lambda w: w.T),
+            "w_down": stack(
+                "model.layers.{i}.mlp.down_proj.weight", lambda w: w.T),
+        },
+        "final_norm": jnp.asarray(_t(state_dict, "model.norm.weight")),
+    }
+    if "lm_head.weight" in state_dict:
+        params["unembed"] = jnp.asarray(_t(state_dict, "lm_head.weight").T)
+    else:                               # tied embeddings
+        params["unembed"] = params["embed"].T
+    return params
+
+
+def load_hf(path: str, dtype=jnp.bfloat16):
+    """Convenience: local HF checkpoint dir -> (params, cfg)."""
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_cfg = AutoConfig.from_pretrained(path)
+    cfg = config_from_hf(hf_cfg, dtype=dtype)
+    model = AutoModelForCausalLM.from_pretrained(path)
+    params = params_from_hf(model.state_dict(), cfg)
+    del model
+    return params, cfg
+
+
+__all__ = ["config_from_hf", "params_from_hf", "load_hf"]

@@ -59,6 +59,9 @@ class TransformerConfig:
     # attn_window positions inclusive; 0 = full causal. Supported by the
     # flash and ref paths (block-pruned O(L*window) in the kernel)
     attn_window: int = 0
+    # RMSNorm epsilon — HF Llama uses 1e-6, Mistral 1e-5; must match the
+    # source model for imported checkpoints (models/hf_import.py)
+    norm_eps: float = 1e-6
     # causal=False turns the stack into a bidirectional ENCODER (BERT-style:
     # every position attends everywhere). Pair with -1-masked targets for
     # masked-LM training (token_nll scores only the unmasked positions);
@@ -279,13 +282,13 @@ def _mlp(cfg: TransformerConfig, h, lp):
 def _layer(cfg: TransformerConfig, mesh, x, positions, lp):
     """One decoder block; lp = this layer's params (stack dim removed)."""
     dt = cfg.dtype
-    h = rms_norm(x, lp["attn_norm"])
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     q, k, v = _qkv(cfg, h, positions, lp)
     k, v = _repeat_kv(cfg, k, v)
     attn = _attention(q, k, v, cfg, mesh)
     x = x + jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
 
-    mlp_out, aux = _mlp(cfg, rms_norm(x, lp["mlp_norm"]), lp)
+    mlp_out, aux = _mlp(cfg, rms_norm(x, lp["mlp_norm"], cfg.norm_eps), lp)
     return x + mlp_out, aux
 
 
@@ -332,7 +335,7 @@ def apply_hidden(
         return x, aux
 
     x, auxes = jax.lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     return x, jnp.sum(auxes) * cfg.aux_loss_weight
 
 
