@@ -901,6 +901,46 @@ def test_lm_generate_sharded_checkpoint_restore(tmp_path):
     assert outs[0] == outs[1], outs
 
 
+def test_generate_cache_continuation_multi_turn():
+    """Multi-turn serving: generate(return_cache=True) returns a cache
+    holding prompt + ALL emitted tokens, and continuing with only the new
+    turn's tokens is token-exact vs a one-shot generate over the whole
+    concatenated conversation — chat never re-prefills history."""
+    from tony_tpu.models.generate import generate
+
+    params = transformer.init(jax.random.PRNGKey(0), TINY)
+    t1 = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0,
+                            TINY.vocab_size)
+    t2 = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0,
+                            TINY.vocab_size)
+
+    out1, cache = generate(params, TINY, t1, 5, max_len=32,
+                           return_cache=True)
+    assert int(cache.length) == 6 + 5  # prompt + ALL emitted
+    out2 = generate(params, TINY, t2, 6, cache=cache)
+
+    full_prompt = jnp.concatenate([t1, out1, t2], axis=1)
+    ref = generate(params, TINY, full_prompt, 6)
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(ref))
+
+    # int8 cache continues too (kv_dtype inherited from the cache)
+    o1, c8 = generate(params, TINY, t1, 5, max_len=32, kv_dtype="int8",
+                      return_cache=True)
+    assert c8.k.dtype == jnp.int8
+    o2 = generate(params, TINY, t2, 4, cache=c8)
+    assert o2.shape == (2, 4)
+
+    # rejections: capacity overflow, batch mismatch, kv conflict
+    _, small = generate(params, TINY, t1, 5, max_len=16, return_cache=True)
+    with pytest.raises(ValueError, match="capacity"):
+        generate(params, TINY, t2, 6, cache=small)
+    _, c2 = generate(params, TINY, t1, 2, max_len=32, return_cache=True)
+    with pytest.raises(ValueError, match="batch"):
+        generate(params, TINY, jnp.zeros((1, 2), jnp.int32), 2, cache=c2)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        generate(params, TINY, t2, 2, cache=c2, kv_dtype="int8")
+
+
 def test_hf_import_llama_parity():
     """The flagship transformer IS the Llama graph: importing a random HF
     LlamaForCausalLM must reproduce its logits to float tolerance and its

@@ -584,13 +584,15 @@ def prepare_decode(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "temperature", "top_k",
                      "kv_dtype", "max_len", "weight_dtype", "build_fused",
-                     "stop_tokens", "pad_id", "shardings"),
+                     "stop_tokens", "pad_id", "shardings", "return_cache"),
+    donate_argnames=("cache_in",),
 )
 def _generate_jit(
     params,
     fused,
     prompt,
     key,
+    cache_in,
     *,
     cfg: TransformerConfig,
     max_new_tokens: int,
@@ -603,22 +605,47 @@ def _generate_jit(
     stop_tokens: tuple,
     pad_id: int,
     shardings: DecodeShardings | None,
+    return_cache: bool,
 ):
     """The whole generate loop under one jit: prefill once, then either a
     lax.scan of decode steps (no stop tokens: fixed trip count) or a
     lax.while_loop with a per-sequence finished mask (stop tokens: exits
     as soon as EVERY row has emitted a stop, so the batch pays for the
     slowest sequence, not for max_new_tokens). Returns
-    (tokens [B, max_new], decode_steps scalar int32)."""
+    (tokens [B, max_new], decode_steps scalar int32, final cache | None).
+
+    ``cache_in`` continues from a previous call's returned cache (the
+    prompt chunk is ingested through the general cached-attention path —
+    the cache isn't empty, so the true-prefill fast path doesn't apply);
+    it is DONATED, so the buffers update in place across turns. With
+    ``return_cache`` the final emitted token is ingested too, so the
+    returned cache holds prompt+ALL emitted tokens and the next turn's
+    chunk is just the new tokens."""
     params = _cast_decode_params(params, cfg)   # no-op on prepared weights
     if build_fused:
         fused = _fuse_decode_weights(params, cfg, weight_dtype)
     b, _ = prompt.shape
-    cache = init_cache(cfg, b, max_len, kv_dtype)
-    logits, cache = _forward_with_cache(params, cfg, prompt, cache, fused,
-                                        prefill=True, shardings=shardings)
+    if cache_in is None:
+        cache = init_cache(cfg, b, max_len, kv_dtype)
+        logits, cache = _forward_with_cache(
+            params, cfg, prompt, cache, fused, prefill=True,
+            shardings=shardings)
+    else:
+        cache = cache_in
+        logits, cache = _forward_with_cache(
+            params, cfg, prompt, cache, fused, shardings=shardings)
     key, sub = jax.random.split(key)
     first = sample_token(logits, sub, temperature, top_k)
+
+    def finalize(cache, last_tok):
+        if not return_cache:
+            return None
+        # ingest the final emitted token so the cache holds the WHOLE
+        # conversation so far (one extra forward, only on this path)
+        _, cache = _forward_with_cache(
+            params, cfg, last_tok[:, None], cache, fused,
+            shardings=shardings)
+        return cache
 
     if not stop_tokens:
         def step(carry, _):
@@ -632,11 +659,12 @@ def _generate_jit(
 
         # emit the sampled token so exactly max_new_tokens - 1 decode
         # forwards run (the prefill already produced the first token)
-        (_, _, _), rest = lax.scan(
+        (last, cache, _), rest = lax.scan(
             step, (first, cache, key), None, length=max_new_tokens - 1
         )
         toks = jnp.concatenate([first[None], rest], axis=0)
-        return jnp.moveaxis(toks, 0, 1), jnp.int32(max_new_tokens - 1)
+        return (jnp.moveaxis(toks, 0, 1), jnp.int32(max_new_tokens - 1),
+                finalize(cache, last))
 
     stops = jnp.asarray(stop_tokens, jnp.int32)
     out = jnp.full((b, max_new_tokens), pad_id, jnp.int32)
@@ -661,10 +689,10 @@ def _generate_jit(
         out = lax.dynamic_update_slice(out, nxt[:, None], (0, i + 1))
         return (i + 1, nxt, cache, key, finished, out)
 
-    steps, _, _, _, _, out = lax.while_loop(
+    steps, last, cache, _, _, out = lax.while_loop(
         cond, body, (jnp.int32(0), first, cache, key, finished, out)
     )
-    return out, steps
+    return out, steps, finalize(cache, last)
 
 
 def generate(
@@ -684,6 +712,8 @@ def generate(
     mesh=None,
     rules=None,
     return_steps: bool = False,
+    cache: KVCache | None = None,
+    return_cache: bool = False,
 ):
     """Generate max_new_tokens continuations -> [B, max_new_tokens] int32.
 
@@ -725,7 +755,18 @@ def generate(
     wo / w_down exactly as in Megatron-style training. n_kv_heads (and
     n_heads) must divide their sharding axes — GQA models with fewer kv
     heads than the tensor axis are rejected. qkv/gate-up fusion and w8a16
-    are single-device-only and disabled/rejected under a sharded mesh."""
+    are single-device-only and disabled/rejected under a sharded mesh.
+
+    ``return_cache=True`` additionally returns the KV cache holding
+    prompt + ALL emitted tokens; pass it back as ``cache=`` on the next
+    call with only the NEW tokens as the prompt — multi-turn chat never
+    re-prefills history, and greedy continuation is token-exact vs a
+    one-shot generate over the concatenated conversation (tested). The
+    passed cache is DONATED (updated in place — jnp.copy it first to fan
+    several continuations out of one shared prefix); its capacity must
+    hold the new chunk + max_new_tokens, so size the FIRST call's
+    ``max_len`` for the whole conversation. After an EOS stop, finished
+    rows' caches contain the pad tail — continuing them is meaningless."""
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}"
@@ -742,7 +783,33 @@ def generate(
     if key is None:
         key = jax.random.PRNGKey(0)
     b, lp_len = prompt.shape
-    if max_len is None:
+    if cache is not None:
+        cap = cache.k.shape[3]
+        if cache.k.shape[1] != b:
+            raise ValueError(
+                f"continuation batch {b} != cache batch {cache.k.shape[1]}"
+            )
+        used = int(cache.length)
+        if used + lp_len + max_new_tokens > cap:
+            raise ValueError(
+                f"cache capacity {cap} cannot hold {used} cached + "
+                f"{lp_len} new prompt + {max_new_tokens} generated tokens "
+                "— size the first call's max_len for the whole conversation"
+            )
+        if max_len is not None and max_len != cap:
+            raise ValueError(
+                f"max_len={max_len} conflicts with the passed cache's "
+                f"capacity {cap} (omit max_len when continuing)"
+            )
+        max_len = cap
+        cache_kv = "int8" if cache.k.dtype == jnp.int8 else "native"
+        if kv_dtype != "native" and kv_dtype != cache_kv:
+            raise ValueError(
+                f"kv_dtype={kv_dtype!r} conflicts with the passed cache "
+                f"({cache_kv})"
+            )
+        kv_dtype = cache_kv
+    elif max_len is None:
         max_len = lp_len + max_new_tokens
     elif max_len < lp_len + max_new_tokens:
         raise ValueError(
@@ -803,20 +870,23 @@ def generate(
 
     cfg = moe_dropfree(cfg)
 
-    out, steps = _generate_jit(
-        prepared.params, prepared.fused, prompt, key,
+    out, steps, cache_out = _generate_jit(
+        prepared.params, prepared.fused, prompt, key, cache,
         cfg=cfg, max_new_tokens=max_new_tokens, temperature=temperature,
         top_k=top_k, kv_dtype=kv_dtype, max_len=max_len,
         weight_dtype=weight_dtype, build_fused=build_fused,
         stop_tokens=tuple(int(t) for t in stop_tokens), pad_id=int(pad_id),
-        shardings=shardings,
+        shardings=shardings, return_cache=return_cache,
     )
+    result = (out,)
     if return_steps:
-        return out, steps
-    return out
+        result += (steps,)
+    if return_cache:
+        result += (cache_out,)
+    return result if len(result) > 1 else out
 
 
 __all__ = [
     "KVCache", "init_cache", "generate", "sample_token",
-    "prepare_decode", "DecodeWeights",
+    "prepare_decode", "DecodeWeights", "moe_dropfree",
 ]
