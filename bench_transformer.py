@@ -418,6 +418,53 @@ def bench_moe_decode(batch: int = 8, prompt_len: int = 128,
     }
 
 
+def bench_long_decode(prompt_len: int = 16384, new_tokens: int = 64,
+                      reps: int = 3) -> dict:
+    """Long-context serving: prefill a 16k-token prompt (the flash kernel,
+    O(block) memory) then decode against the full-length int8 cache —
+    the serve-side counterpart of the long-context training rows. The
+    two-point fit splits per-step decode cost (attention over the 16k
+    cache dominates) from the one-time prefill."""
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models import transformer
+    from tony_tpu.models.generate import generate, prepare_decode
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=32768, d_model=1024, n_layers=12, n_heads=8,
+        n_kv_heads=8, d_ff=4096, max_seq_len=prompt_len + new_tokens,
+        dtype=jnp.bfloat16, attn_impl="auto",
+    )
+    params = jax.jit(lambda k: transformer.init(k, cfg))(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (1, prompt_len), 0, cfg.vocab_size)
+    prep = prepare_decode(params, cfg)
+    max_len = prompt_len + new_tokens
+
+    def wall(n):
+        kw = dict(max_len=max_len, kv_dtype="int8")
+        int(generate(prep, cfg, prompt, n, **kw)[0, 0])
+        times = []
+        for _ in range(reps):
+            t0 = time.time()
+            int(generate(prep, cfg, prompt, n, **kw)[0, 0])
+            times.append(time.time() - t0)
+        return statistics.median(times)
+
+    dt, _, step_s = _two_point(wall, new_tokens)
+    prefill_s = max(0.0, dt - (new_tokens - 1) * step_s)
+    return {
+        "prompt_len": prompt_len, "new_tokens": new_tokens, "batch": 1,
+        "kv_dtype": "int8",
+        "wall_s": round(dt, 3),
+        "decode_step_ms": round(step_s * 1e3, 3),
+        "decode_tokens_per_sec": round(1.0 / step_s, 1),
+        "prefill_plus_overhead_s": round(prefill_s, 3),
+        "prefill_tokens_per_sec": round(prompt_len / prefill_s, 1),
+    }
+
+
 def bench_spec_decode(prompt_len: int = 128, new_tokens: int = 128,
                       gamma: int = 4, reps: int = 5) -> dict:
     """Speculative decode cost model, measured on-chip. The compiled round
@@ -604,8 +651,10 @@ def main() -> int:
         perf["kv_cache_decode"] = bench_decode(batch=args.batch)
         perf["moe_decode"] = bench_moe_decode(batch=args.batch)
         perf["speculative_decode"] = bench_spec_decode()
+        perf["long_context_decode"] = bench_long_decode()
     elif "kv_cache_decode" in prior:
-        for k in ("kv_cache_decode", "moe_decode", "speculative_decode"):
+        for k in ("kv_cache_decode", "moe_decode", "speculative_decode",
+                  "long_context_decode"):
             if k in prior:
                 perf[k] = prior[k]
     if not args.skip_long:
