@@ -567,6 +567,30 @@ def test_tpu_slice_create_without_discovery_fails_fast(tmp_path):
         TpuPodProvisioner(conf)
 
 
+def test_tpu_multislice_requires_slice_placeholder(tmp_path):
+    """num-slices > 1 with a lifecycle template missing {slice} is a config
+    error, not N operations against ONE cloud resource (double-booked
+    hosts, a slice-1 refresh deleting slice 0's capacity)."""
+    from tony_tpu.cluster.tpu import TpuPodProvisioner
+
+    base = {
+        "tony.tpu.num-slices": 2,
+        "tony.tpu.discover-retries": 1,
+        "tony.tpu.create-poll-interval-s": 0.01,
+    }
+    conf = TonyConf({**base, "tony.tpu.discover-command": "echo host0"})
+    with pytest.raises(ValueError, match=r"\{slice\} placeholder"):
+        TpuPodProvisioner(conf)
+    # templated discover but raw delete: still rejected
+    conf2 = TonyConf({
+        **base,
+        "tony.tpu.discover-command": "echo host-s{slice}",
+        "tony.tpu.delete-command": "true",
+    })
+    with pytest.raises(ValueError, match="delete-command.*placeholder"):
+        TpuPodProvisioner(conf2)
+
+
 def test_tpu_slice_await_without_geometry_needs_stable_list(tmp_path):
     """Without tony.tpu.accelerator-type there is no expected host count;
     await-READY must not accept the first (possibly partial, mid-creation)

@@ -332,14 +332,27 @@ class TpuPodProvisioner(StaticHostProvisioner):
                 f"await READY: configure {keys.TPU_DISCOVER_COMMAND} (or "
                 f"{keys.CLUSTER_STATIC_HOSTS})"
             )
-        if self.num_slices > 1 and not self._conf.get(
-            keys.TPU_DISCOVER_COMMAND
-        ):
-            raise ValueError(
-                f"{keys.TPU_NUM_SLICES}={self.num_slices} needs per-slice "
-                f"discovery: set {keys.TPU_DISCOVER_COMMAND} (static host "
-                "lists carry no slice boundaries)"
-            )
+        if self.num_slices > 1:
+            if not self._conf.get(keys.TPU_DISCOVER_COMMAND):
+                raise ValueError(
+                    f"{keys.TPU_NUM_SLICES}={self.num_slices} needs "
+                    f"per-slice discovery: set {keys.TPU_DISCOVER_COMMAND} "
+                    "(static host lists carry no slice boundaries)"
+                )
+            # every configured template must be {slice}-parameterized:
+            # without the placeholder slice_view() is the identity and all
+            # N "slices" would operate on ONE cloud resource — double-
+            # booked hosts, conflicting slice ids, and a slice-1 refresh
+            # deleting the resource slice 0 is running on
+            for key in (keys.TPU_DISCOVER_COMMAND, keys.TPU_CREATE_COMMAND,
+                        keys.TPU_DELETE_COMMAND):
+                v = str(self._conf.get(key, "") or "")
+                if v and SLICE_PLACEHOLDER not in v:
+                    raise ValueError(
+                        f"{keys.TPU_NUM_SLICES}={self.num_slices} but {key} "
+                        f"has no {SLICE_PLACEHOLDER} placeholder — each "
+                        "slice must be its own cloud resource"
+                    )
         slice_hosts = [
             self._acquire_slice(s, during_refresh)
             for s in range(self.num_slices)
