@@ -1065,6 +1065,34 @@ def test_speculative_generate_exact_any_draft():
     assert stats2["rounds"] == -(-11 // 4)  # ceil((12-1)/(3+1))
 
 
+def test_speculative_generate_stop_tokens_match_generate():
+    """EOS in the speculative path: output (stop kept, pad after) must
+    match generate(stop_tokens=...) exactly for both a random draft and
+    the high-acceptance self-draft (stop lands INSIDE an accepted prefix),
+    and the round loop exits early."""
+    from tony_tpu.models.generate import generate
+    from tony_tpu.models.speculative import speculative_generate
+
+    tp = transformer.init(jax.random.PRNGKey(0), TINY)
+    dp = transformer.init(jax.random.PRNGKey(7), DRAFT_TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                                TINY.vocab_size)
+    max_new = 14
+    ref_free = np.asarray(generate(tp, TINY, prompt, max_new))
+    stops = (int(ref_free[0, 4]),)
+    pad = TINY.vocab_size - 1
+    ref = np.asarray(generate(tp, TINY, prompt, max_new,
+                              stop_tokens=stops, pad_id=pad))
+
+    for draft_p, draft_c in ((dp, DRAFT_TINY), (tp, TINY)):
+        out, stats = speculative_generate(
+            tp, TINY, draft_p, draft_c, prompt, max_new, gamma=3,
+            stop_tokens=stops, pad_id=pad, return_stats=True)
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        # stop position bounds the verify-forward count
+        assert stats["rounds"] <= 5, stats
+
+
 def test_speculative_generate_moe_and_rejections():
     """MoE targets speculate too (drop-free capacity applied to both
     models); bad configs fail loudly."""

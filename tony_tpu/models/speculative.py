@@ -61,11 +61,12 @@ from .transformer import TransformerConfig
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "draft_cfg", "max_new_tokens", "gamma",
-                     "kv_dtype", "build_fused", "build_draft_fused"),
+                     "kv_dtype", "build_fused", "build_draft_fused",
+                     "stop_tokens", "pad_id"),
 )
 def _spec_jit(params, fused, draft_params, draft_fused, prompt, *,
               cfg, draft_cfg, max_new_tokens, gamma, kv_dtype,
-              build_fused, build_draft_fused):
+              build_fused, build_draft_fused, stop_tokens, pad_id):
     params = _cast_decode_params(params, cfg)
     draft_params = _cast_decode_params(draft_params, draft_cfg)
     if build_fused:
@@ -88,8 +89,10 @@ def _spec_jit(params, fused, draft_params, draft_fused, prompt, *,
     out = jnp.zeros((b, max_new_tokens + gamma + 1), jnp.int32)
     out = lax.dynamic_update_slice(out, first[:, None], (0, 0))
 
+    stops = jnp.asarray(stop_tokens, jnp.int32) if stop_tokens else None
+
     def round_body(carry):
-        produced, rounds, tok, tc, dc, out = carry
+        produced, rounds, tok, tc, dc, out, stop_seen = carry
 
         # --- draft proposes gamma tokens (gamma+1 steps: the extra step
         # ingests the last proposal so the draft cache stays one-ahead
@@ -131,17 +134,36 @@ def _spec_jit(params, fused, draft_params, draft_fused, prompt, *,
         tc2 = tc._replace(length=t_old + n + 1)
         dc2 = dc._replace(length=t_old + n + 1)
         tok = correction[:, 0]
-        return (produced + n + 1, rounds + 1, tok, tc2, dc2, out)
+        if stops is not None:
+            # did any ACCEPTED emission (cand positions 0..n) hit a stop?
+            emitted_mask = idx[None, :] <= n_acc[:, None]
+            stop_seen = stop_seen | jnp.any(
+                jnp.isin(cand, stops) & emitted_mask)
+        return (produced + n + 1, rounds + 1, tok, tc2, dc2, out, stop_seen)
 
     def cond(carry):
-        produced = carry[0]
-        return produced < max_new_tokens
+        produced, stop_seen = carry[0], carry[6]
+        return (produced < max_new_tokens) & ~stop_seen
 
-    produced, rounds, _, _, _, out = lax.while_loop(
+    init_stop = (jnp.isin(first, stops).any() if stops is not None
+                 else jnp.bool_(False))
+    produced, rounds, _, _, _, out, _ = lax.while_loop(
         cond, round_body,
-        (jnp.int32(1), jnp.int32(0), first, tc, dc, out),
+        (jnp.int32(1), jnp.int32(0), first, tc, dc, out, init_stop),
     )
-    return out[:, :max_new_tokens], produced, rounds
+    out = out[:, :max_new_tokens]
+    if stops is not None:
+        # pad strictly after the first stop (the stop token itself stays),
+        # covering both the in-round tail after an accepted stop and any
+        # leftover candidate writes past `produced`
+        hit = jnp.isin(out, stops)
+        after = jnp.cumsum(hit.astype(jnp.int32), axis=1) - hit
+        out = jnp.where(after > 0, jnp.int32(pad_id), out)
+        # also pad anything past `produced` (un-emitted buffer tail from
+        # the final round's speculative writes)
+        out = jnp.where(jnp.arange(out.shape[1])[None, :] >= produced,
+                        jnp.int32(pad_id), out)
+    return out, produced, rounds
 
 
 def speculative_generate(
@@ -154,10 +176,17 @@ def speculative_generate(
     *,
     gamma: int = 4,
     kv_dtype: str = "native",
+    stop_tokens: tuple = (),
+    pad_id: int = 0,
     return_stats: bool = False,
 ):
     """Greedy speculative decode -> [1, max_new_tokens] int32, identical to
     ``generate(params, cfg, prompt, max_new_tokens)`` for ANY draft model.
+
+    ``stop_tokens``/``pad_id`` give the same EOS semantics as `generate`:
+    the first emitted stop token is kept, everything after is ``pad_id``,
+    and the round loop exits as soon as an accepted emission stops —
+    output matches ``generate(..., stop_tokens=...)`` token for token.
 
     ``params``/``draft_params`` may be raw pytrees or `DecodeWeights` from
     `prepare_decode` (single-device, native only — w8a16 composes but is
@@ -204,6 +233,7 @@ def speculative_generate(
         cfg=cfg, draft_cfg=draft_cfg, max_new_tokens=max_new_tokens,
         gamma=gamma, kv_dtype=kv_dtype,
         build_fused=build_t, build_draft_fused=build_d,
+        stop_tokens=tuple(int(t) for t in stop_tokens), pad_id=int(pad_id),
     )
     if not return_stats:
         return out
