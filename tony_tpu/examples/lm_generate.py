@@ -66,6 +66,11 @@ def main(argv=None) -> int:
                              "dir: weights are imported into the flagship "
                              "model (models/hf_import.py) and the model "
                              "hyperparam flags are ignored")
+    parser.add_argument("--draft-hf-checkpoint", default="",
+                        help="local HF checkpoint dir for a DRAFT model: "
+                             "decodes speculatively (greedy only, batch 1; "
+                             "output identical to plain decode — "
+                             "models/speculative.py)")
     parser.add_argument("--metrics-out", default="")
     args = parser.parse_args(argv)
 
@@ -164,7 +169,31 @@ def main(argv=None) -> int:
         params, cfg, weight_dtype=args.weight_dtype, mesh=mesh
     )
 
+    draft = None
+    if args.draft_hf_checkpoint:
+        if mesh is not None or args.temperature > 0:
+            raise SystemExit("speculative decode is single-device greedy "
+                             "(drop --tensor-parallel / --temperature)")
+        from tony_tpu.models.hf_import import load_hf
+
+        d_params, d_cfg = load_hf(args.draft_hf_checkpoint,
+                                  dtype=getattr(jnp, args.dtype))
+        draft = (prepare_decode(d_params, d_cfg), d_cfg)
+        print(f"speculative draft: {d_cfg.n_layers}L d{d_cfg.d_model}")
+
     def run():
+        if draft is not None:
+            from tony_tpu.models.speculative import speculative_generate
+
+            d_prep, d_cfg = draft
+            out, stats = speculative_generate(
+                prepared, cfg, d_prep, d_cfg, prompt, args.max_new,
+                kv_dtype=args.kv_dtype, stop_tokens=stop_tokens,
+                pad_id=args.pad_id, return_stats=True,
+            )
+            jax.block_until_ready(out)
+            # rounds = verify forwards; emitted = accepted + rounds (+ 1)
+            return out, stats["accepted"] + stats["rounds"]
         out, steps = generate(
             prepared, cfg, prompt, args.max_new,
             temperature=args.temperature, top_k=args.top_k,
