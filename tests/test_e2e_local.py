@@ -165,6 +165,11 @@ def test_gang_scale_192_stub_executors(tmp_job_dirs, tmp_path):
                 payload = rpc.call("register_worker", task_id=task_id,
                                    host="127.0.0.1", port=20000 + index)
                 while payload is None:
+                    # real executors heartbeat THROUGH the barrier wait
+                    # (Heartbeater starts before the gang barrier) — with
+                    # 192 sequential launches the barrier takes seconds,
+                    # longer than heartbeat expiry
+                    rpc.call("heartbeat", task_id=task_id)
                     time.sleep(0.05)
                     payload = rpc.call("get_cluster_spec", task_id=task_id)
                 with lock:
