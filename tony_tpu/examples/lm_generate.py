@@ -208,9 +208,6 @@ def main(argv=None) -> int:
     t0 = time.time()
     out, steps = run()
     wall = time.time() - t0
-    # prefill emitted 1 token + `steps` decode forwards; with stop_tokens
-    # the loop exits early, so max_new would overstate throughput
-    n_generated = int(steps) + 1
 
     tokens = [int(t) for t in out[0]]
     if stop_tokens:
@@ -219,6 +216,15 @@ def main(argv=None) -> int:
             if t in stop_tokens:
                 tokens = tokens[:i + 1]
                 break
+    if draft is not None:
+        # speculative rounds can overshoot max_new and draft past a stop;
+        # count the tokens actually DELIVERED, not `produced`
+        n_generated = len(tokens)
+    else:
+        # prefill emitted 1 token + `steps` decode forwards; with
+        # stop_tokens the loop exits early, so max_new would overstate
+        # throughput
+        n_generated = int(steps) + 1
     result = {
         "tokens": tokens,
         "decode_tokens_per_sec": n_generated / wall,

@@ -153,16 +153,14 @@ def _spec_jit(params, fused, draft_params, draft_fused, prompt, *,
     )
     out = out[:, :max_new_tokens]
     if stops is not None:
-        # pad strictly after the first stop (the stop token itself stays),
-        # covering both the in-round tail after an accepted stop and any
-        # leftover candidate writes past `produced`
+        # pad strictly after the first stop (the stop token itself stays).
+        # This also covers any leftover candidate writes: in-slice
+        # positions >= produced can only exist when the loop exited via
+        # stop_seen with the stop at a position < produced, so the
+        # after-first-stop mask reaches them
         hit = jnp.isin(out, stops)
         after = jnp.cumsum(hit.astype(jnp.int32), axis=1) - hit
         out = jnp.where(after > 0, jnp.int32(pad_id), out)
-        # also pad anything past `produced` (un-emitted buffer tail from
-        # the final round's speculative writes)
-        out = jnp.where(jnp.arange(out.shape[1])[None, :] >= produced,
-                        jnp.int32(pad_id), out)
     return out, produced, rounds
 
 
