@@ -634,3 +634,41 @@ def test_tpu_provisioner_refresh_rediscovers_hosts(tmp_path):
     }))
     static.refresh()
     assert static.hosts == ["h1", "h2"]
+
+
+# ------------------------------------------------- multislice env contract
+
+def test_jax_adapter_multislice_requires_slice0_host(monkeypatch):
+    """TONY_NUM_SLICES>1 without TONY_SLICE0_HOST must fail fast at env-build
+    time — otherwise MEGASCALE_COORDINATOR_ADDRESS would be the malformed
+    ':8080' and libtpu would fail much later with an opaque transport error."""
+    from tony_tpu import constants as c
+    from tony_tpu.runtimes.base import TaskContext
+    from tony_tpu.runtimes.jax_runtime import JaxTaskAdapter
+
+    ctx = TaskContext(
+        job_name="worker", task_index=0, task_num=2, num_total_tasks=2,
+        is_chief=True, command="true",
+        cluster_payload={"cluster": {"worker": ["h0:1", "h1:1"]},
+                         "ranks": {"worker:0": 0, "worker:1": 1},
+                         "num_processes": 2,
+                         "coordinator_address": "h0:1"},
+        base_child_env={},
+    )
+    adapter = JaxTaskAdapter()
+
+    monkeypatch.setenv(c.ENV_NUM_SLICES, "2")
+    monkeypatch.setenv(c.ENV_SLICE_ID, "1")
+    monkeypatch.delenv(c.ENV_SLICE0_HOST, raising=False)
+    with pytest.raises(RuntimeError, match="TONY_SLICE0_HOST"):
+        adapter.build_env(ctx)
+    monkeypatch.setenv(c.ENV_SLICE0_HOST, "")
+    with pytest.raises(RuntimeError, match="TONY_SLICE0_HOST"):
+        adapter.build_env(ctx)
+
+    monkeypatch.setenv(c.ENV_SLICE0_HOST, "slice0-host")
+    env = adapter.build_env(ctx)
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == (
+        f"slice0-host:{c.MEGASCALE_PORT}")
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"

@@ -917,7 +917,7 @@ def test_generate_cache_continuation_multi_turn():
     out1, cache = generate(params, TINY, t1, 5, max_len=32,
                            return_cache=True)
     assert int(cache.length) == 6 + 5  # prompt + ALL emitted
-    out2 = generate(params, TINY, t2, 6, cache=cache)
+    out2, _ = generate(params, TINY, t2, 6, cache=cache, return_cache=True)
 
     full_prompt = jnp.concatenate([t1, out1, t2], axis=1)
     ref = generate(params, TINY, full_prompt, 6)
@@ -927,18 +927,23 @@ def test_generate_cache_continuation_multi_turn():
     o1, c8 = generate(params, TINY, t1, 5, max_len=32, kv_dtype="int8",
                       return_cache=True)
     assert c8.k.dtype == jnp.int8
-    o2 = generate(params, TINY, t2, 4, cache=c8)
+    o2, _ = generate(params, TINY, t2, 4, cache=c8, return_cache=True)
     assert o2.shape == (2, 4)
 
-    # rejections: capacity overflow, batch mismatch, kv conflict
+    # rejections: donation without return, capacity overflow, batch
+    # mismatch, kv conflict
     _, small = generate(params, TINY, t1, 5, max_len=16, return_cache=True)
-    with pytest.raises(ValueError, match="capacity"):
+    with pytest.raises(ValueError, match="return_cache"):
         generate(params, TINY, t2, 6, cache=small)
+    with pytest.raises(ValueError, match="capacity"):
+        generate(params, TINY, t2, 6, cache=small, return_cache=True)
     _, c2 = generate(params, TINY, t1, 2, max_len=32, return_cache=True)
     with pytest.raises(ValueError, match="batch"):
-        generate(params, TINY, jnp.zeros((1, 2), jnp.int32), 2, cache=c2)
+        generate(params, TINY, jnp.zeros((1, 2), jnp.int32), 2, cache=c2,
+                 return_cache=True)
     with pytest.raises(ValueError, match="kv_dtype"):
-        generate(params, TINY, t2, 2, cache=c2, kv_dtype="int8")
+        generate(params, TINY, t2, 2, cache=c2, kv_dtype="int8",
+                 return_cache=True)
 
 
 def test_hf_import_llama_parity():
@@ -1003,6 +1008,41 @@ def test_hf_import_mistral_sliding_window_parity():
 
     with pytest.raises(ValueError, match="unsupported model_type"):
         config_from_hf(tfm.GPT2Config())
+
+
+def test_hf_import_rejects_unimplemented_config_features():
+    """Checkpoints whose configs need graph features the flagship does not
+    implement (Llama-3.x rope_scaling, attention/mlp bias) must be rejected
+    at import — silently dropping them would serve wrong logits."""
+    torch = pytest.importorskip("torch")
+    tfm = pytest.importorskip("transformers")
+
+    from tony_tpu.models.hf_import import config_from_hf, params_from_hf
+
+    base = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64)
+
+    scaled = tfm.LlamaConfig(**base, rope_scaling={
+        "rope_type": "llama3", "factor": 8.0,
+        "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+        "original_max_position_embeddings": 32})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(scaled)
+
+    biased = tfm.LlamaConfig(**base, attention_bias=True)
+    with pytest.raises(ValueError, match="attention_bias"):
+        config_from_hf(biased)
+
+    # belt-and-suspenders: a state_dict that still carries bias tensors is
+    # rejected even if the config gate were bypassed
+    ok_cfg = config_from_hf(tfm.LlamaConfig(**base), dtype=jnp.float32)
+    torch.manual_seed(0)
+    sd = dict(tfm.LlamaForCausalLM(tfm.LlamaConfig(**base)).state_dict())
+    sd["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(64)
+    with pytest.raises(ValueError, match="bias"):
+        params_from_hf(sd, ok_cfg)
 
 
 def test_lm_generate_hf_checkpoint_serving(tmp_path):

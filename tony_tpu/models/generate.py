@@ -763,7 +763,9 @@ def generate(
     re-prefills history, and greedy continuation is token-exact vs a
     one-shot generate over the concatenated conversation (tested). The
     passed cache is DONATED (updated in place — jnp.copy it first to fan
-    several continuations out of one shared prefix); its capacity must
+    several continuations out of one shared prefix), so ``cache=`` requires
+    ``return_cache=True``: without it the conversation state would be
+    consumed with no replacement returned; its capacity must
     hold the new chunk + max_new_tokens, so size the FIRST call's
     ``max_len`` for the whole conversation. After an EOS stop, finished
     rows' caches contain the pad tail — continuing them is meaningless."""
@@ -784,6 +786,13 @@ def generate(
         key = jax.random.PRNGKey(0)
     b, lp_len = prompt.shape
     if cache is not None:
+        if not return_cache:
+            raise ValueError(
+                "cache= requires return_cache=True: the passed cache is "
+                "donated (updated in place), so without returning it the "
+                "conversation state would be irrecoverably consumed. On a "
+                "final turn, pass return_cache=True and drop the result."
+            )
         cap = cache.k.shape[3]
         if cache.k.shape[1] != b:
             raise ValueError(

@@ -52,6 +52,24 @@ def config_from_hf(hf_config: Any, dtype=jnp.bfloat16) -> TransformerConfig:
             f"unsupported model_type {mt!r}; supported: {_SUPPORTED} "
             "(the flagship graph is Llama-shaped: RoPE/GQA/SwiGLU/RMSNorm)"
         )
+    # Reject config features the flagship graph does not implement rather
+    # than silently serving wrong logits: Llama-3.x rope_scaling rewrites
+    # the RoPE frequency table, and attention/mlp bias adds tensors that
+    # params_from_hf would drop on the floor.
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported: the flagship graph "
+            "uses unscaled rotate-half RoPE, so importing this checkpoint "
+            "would serve wrong logits at long positions"
+        )
+    for attr in ("attention_bias", "mlp_bias"):
+        if getattr(hf_config, attr, False):
+            raise ValueError(
+                f"{attr}=True is not supported: the flagship graph has no "
+                "bias terms, so the checkpoint's bias tensors would be "
+                "silently dropped"
+            )
     window = getattr(hf_config, "sliding_window", None) or 0
     return TransformerConfig(
         vocab_size=hf_config.vocab_size,
@@ -81,6 +99,12 @@ def params_from_hf(state_dict: Mapping[str, Any],
     """HF state_dict -> this framework's parameter pytree (f32 masters;
     `prepare_decode` / the train step cast to cfg.dtype at use). Layer
     weights are stacked [n_layers, ...] as transformer.init builds them."""
+    bias_keys = [k for k in state_dict if k.endswith(".bias")]
+    if bias_keys:
+        raise ValueError(
+            f"checkpoint has bias tensors the flagship graph cannot consume "
+            f"(e.g. {bias_keys[0]!r}); importing would drop them silently"
+        )
     hd, d = cfg.head_dim, cfg.d_model
     L = cfg.n_layers
 

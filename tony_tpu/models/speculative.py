@@ -45,6 +45,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .generate import (
@@ -192,9 +193,13 @@ def speculative_generate(
     the draft agrees often and costs little.
 
     ``return_stats=True`` additionally returns {"rounds", "drafted",
-    "accepted", "acceptance_rate"} — rounds is the number of target verify
-    forwards, so target forwards = rounds + 1 (prefill) vs max_new_tokens
-    for vanilla decode."""
+    "accepted", "acceptance_rate", "delivered"} — rounds is the number of
+    target verify forwards, so target forwards = rounds + 1 (prefill) vs
+    max_new_tokens for vanilla decode. accepted/acceptance_rate count
+    pre-truncation emissions (true draft-target agreement; the final round
+    can accept past max_new_tokens or an EOS); ``delivered`` is the tokens
+    actually in the output — through the stop token when stop_tokens is
+    set, else min(produced, max_new_tokens) — for tokens/s accounting."""
     if prompt.shape[0] != 1:
         raise ValueError(
             "speculative_generate is batch-1 (a latency optimization; "
@@ -239,11 +244,18 @@ def speculative_generate(
     produced_i = int(produced)
     accepted = produced_i - 1 - rounds_i   # t0 + per-round (n_acc + 1)
     drafted = rounds_i * gamma
+    row = np.asarray(out[0])
+    if stop_tokens:
+        hits = np.nonzero(np.isin(row, list(stop_tokens)))[0]
+        delivered = int(hits[0]) + 1 if hits.size else row.shape[0]
+    else:
+        delivered = min(produced_i, max_new_tokens)
     return out, {
         "rounds": rounds_i,
         "drafted": drafted,
         "accepted": accepted,
         "acceptance_rate": accepted / drafted if drafted else 0.0,
+        "delivered": delivered,
     }
 
 

@@ -71,11 +71,20 @@ class JaxTaskAdapter(GenericTaskAdapter):
 
         n_slices = int(os.environ.get(c.ENV_NUM_SLICES, "1") or 1)
         if n_slices > 1:
+            slice0 = os.environ.get(c.ENV_SLICE0_HOST, "")
+            if not slice0:
+                # Without this, MEGASCALE_COORDINATOR_ADDRESS would be the
+                # malformed ":port" and libtpu would fail much later with an
+                # opaque transport error.
+                raise RuntimeError(
+                    f"{c.ENV_NUM_SLICES}={n_slices} but {c.ENV_SLICE0_HOST} "
+                    "is unset/empty; the multislice provisioner must stamp "
+                    "the slice-0 host so DCN transport can rendezvous"
+                )
             env.update({
                 "MEGASCALE_NUM_SLICES": str(n_slices),
                 "MEGASCALE_SLICE_ID": os.environ.get(c.ENV_SLICE_ID, "0"),
                 "MEGASCALE_COORDINATOR_ADDRESS":
-                    f"{os.environ.get(c.ENV_SLICE0_HOST, '')}:"
-                    f"{c.MEGASCALE_PORT}",
+                    f"{slice0}:{c.MEGASCALE_PORT}",
             })
         return env
