@@ -203,6 +203,72 @@ def test_sample_tpu_metrics_with_mocked_sdk(monkeypatch):
     assert M.sample_tpu_metrics() == {M.TPU_HBM_USED: 5.0}
 
 
+def test_sample_tpu_metrics_jax_memory_stats_fallback(monkeypatch):
+    """When tpumonitoring serves no per-chip HBM data (the axon-tunneled
+    chip does exactly that), an ALREADY-imported jax client's
+    memory_stats() fills in live occupancy. The fallback must never import
+    jax itself — from the executor's monitor that would initialize a second
+    TPU client contending with the child for the chip."""
+    import sys
+    import types
+
+    from tony_tpu import metrics as M
+
+    class FakeDev:
+        def __init__(self, bytes_in_use, platform="tpu"):
+            self._b = bytes_in_use
+            self.platform = platform
+
+        def memory_stats(self):
+            if self._b is None:
+                return None          # the axon tunnel reports no stats
+            return {"bytes_in_use": self._b, "peak_bytes_in_use": self._b}
+
+    fake_jax = types.ModuleType("jax")
+    fake_jax.local_devices = lambda: [FakeDev(4_000_000), FakeDev(8_000_000)]
+    monkeypatch.setitem(sys.modules, "jax", fake_jax)
+    monkeypatch.delitem(sys.modules, "libtpu", raising=False)
+    monkeypatch.delitem(sys.modules, "libtpu.sdk", raising=False)
+
+    out, reason = M.sample_tpu_metrics(explain=True)
+    assert out == {M.TPU_HBM_USED: 12.0}     # SUM over chips, like the sdk
+    assert reason is None                     # non-empty sample: no excuse
+
+    # non-TPU devices must never masquerade as TPU memory
+    fake_jax.local_devices = lambda: [FakeDev(4_000_000, platform="gpu"),
+                                      FakeDev(4_000_000, platform="cpu")]
+    out, _ = M.sample_tpu_metrics(explain=True)
+    assert out == {}
+
+    # TPU devices without stats (the tunnel) -> live-buffer floor
+    fake_jax.local_devices = lambda: [FakeDev(None)]
+    fake_jax.live_arrays = lambda: [types.SimpleNamespace(nbytes=2_000_000)]
+    out, reason = M.sample_tpu_metrics(explain=True)
+    assert out == {M.TPU_HBM_LIVE: 2.0}
+    assert reason is None
+
+    # no stats AND no live arrays -> empty, with the primary-channel reason
+    fake_jax.live_arrays = lambda: []
+    out, reason = M.sample_tpu_metrics(explain=True)
+    assert out == {}
+    # primary-channel diagnosis survives: either libtpu is absent or its
+    # runtime served no data (this image ships libtpu without local chips)
+    assert ("tpumonitoring not importable" in reason
+            or "no per-chip data" in reason)
+
+    # jax absent from sys.modules -> the fallback must not try to import it
+    monkeypatch.delitem(sys.modules, "jax", raising=False)
+    real_import = __builtins__["__import__"] if isinstance(__builtins__, dict) \
+        else __builtins__.__import__
+
+    def guard(name, *a, **k):
+        assert name != "jax", "fallback must not import jax"
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr("builtins.__import__", guard)
+    assert M._jax_memory_stats() == {}
+
+
 def test_horovod_real_rendezvous_inits_host_plan(monkeypatch):
     """With horovod importable, the rendezvous server must be started AND
     initialised with the host-assignment plan (reference

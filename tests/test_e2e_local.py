@@ -442,6 +442,52 @@ def test_history_events_written(tmp_job_dirs, fixture_script):
     assert types[-1] == "APPLICATION_FINISHED"
 
 
+def test_tpu_metrics_flow_into_task_finished(tmp_job_dirs, fixture_script,
+                                             tmp_path, monkeypatch):
+    """Full observability chain for accelerator metrics: the executor's
+    TaskMonitor samples the TPU channel (a fake libtpu.sdk injected via
+    PYTHONPATH — the same import surface the real chip serves), pushes over
+    the metrics RPC, and the driver stamps them into the TASK_FINISHED
+    history event (reference: GPU metrics via GpuDiscoverer ->
+    TaskMonitor -> jhist, TaskMonitor.java:101-170)."""
+    pkg = tmp_path / "fakelibs" / "libtpu"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "sdk.py").write_text(
+        "class _Metric:\n"
+        "    def __init__(self, data): self._d = data\n"
+        "    def data(self): return self._d\n"
+        "class tpumonitoring:\n"
+        "    _DATA = {'duty_cycle_pct': ['62.5'],\n"
+        "             'hbm_capacity_usage': ['3000000']}\n"
+        "    @staticmethod\n"
+        "    def get_metric(name):\n"
+        "        return _Metric(tpumonitoring._DATA[name])\n"
+    )
+    existing = os.environ.get("PYTHONPATH", "")
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        str(tmp_path / "fakelibs") + (os.pathsep + existing if existing else ""),
+    )
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.worker.command": f"{PY} {fixture_script('exit_0.py')}",
+           "tony.task.metrics-interval-ms": 200},
+    )
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    inter = Path(tmp_job_dirs["history"]) / "intermediate" / client.app_id
+    lines = [json.loads(l) for l in
+             next(iter(inter.glob("*.jhist"))).read_text().splitlines()]
+    finished = [l for l in lines if l["type"] == "TASK_FINISHED"]
+    assert len(finished) == 1
+    metrics = {m["name"]: m["value"]
+               for m in finished[0]["payload"]["metrics"]}
+    assert metrics["max_tpu_duty_cycle_pct"] == 62.5
+    assert metrics["max_tpu_hbm_used_mb"] == 3.0
+    assert "max_memory_rss_mb" in metrics and metrics["max_memory_rss_mb"] > 0
+
+
 # ------------------------------------------------------------ fault injection
 
 def test_executor_crash_before_register_fails_job(tmp_job_dirs, fixture_script):

@@ -22,6 +22,10 @@ log = logging.getLogger(__name__)
 MEMORY_RSS = "memory_rss_mb"
 TPU_DUTY_CYCLE = "tpu_duty_cycle_pct"
 TPU_HBM_USED = "tpu_hbm_used_mb"
+# framework-tracked live device buffers (jax.live_arrays) — reported when no
+# runtime channel serves occupancy; excludes XLA temps/executables, so it is
+# a floor on true HBM use and labeled distinctly to say so
+TPU_HBM_LIVE = "tpu_hbm_live_buffer_mb"
 
 
 def _proc_tree_rss_mb(root_pid: int) -> float:
@@ -168,37 +172,94 @@ def parse_tpu_metric_values(name: str, values: list[str]) -> dict[str, float]:
 _SAMPLED_TPU_METRICS = ("duty_cycle_pct", "hbm_capacity_usage")
 
 
+def _jax_memory_stats() -> dict[str, float]:
+    """Fallback HBM channel: per-device ``memory_stats()`` from an ALREADY
+    initialized jax client in this process. Deliberately never imports jax,
+    and backs off unless a backend is already live (module presence alone
+    is not enough: ``local_devices()`` would itself initialize a second TPU
+    client inside the executor's monitor and contend with the child for the
+    chip). Where the computation runs in-process (bench harnesses,
+    standalone/notebook jobs, user code pushing through
+    TaskMonitor.refresh), the backend is up and this reports occupancy even
+    when the host's tpumonitoring serves no per-chip data (the
+    axon-tunneled chip does exactly that). Sums over TPU devices — same
+    semantics as the primary hbm_capacity_usage channel."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {}
+    bridge = getattr(getattr(jax, "_src", None), "xla_bridge", None)
+    if bridge is not None and not getattr(bridge, "_backends", True):
+        return {}        # real jax, no backend initialized yet: stay out
+    try:
+        devices = [d for d in jax.local_devices()
+                   if getattr(d, "platform", "") == "tpu"]
+    except Exception:
+        return {}
+    if not devices:
+        return {}        # never report host/GPU memory under TPU names
+    used = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "bytes_in_use" in stats:
+            used.append(float(stats["bytes_in_use"]))
+    if used:
+        return {TPU_HBM_USED: sum(used) / 1e6}
+    # last resort (the axon-tunneled chip returns memory_stats() = None):
+    # framework-tracked live buffers — a floor on occupancy, honestly named
+    try:
+        total = sum(
+            a.nbytes for a in jax.live_arrays()
+            if getattr(a, "nbytes", None) is not None
+        )
+    except Exception:
+        return {}
+    if total <= 0:
+        return {}
+    return {TPU_HBM_LIVE: total / 1e6}
+
+
 def sample_tpu_metrics(explain: bool = False):
     """TPU counters via libtpu's SDK monitoring API when the executor host
     has TPUs attached; {} otherwise. Plays the role of the reference's
     nvidia-smi XML sampling (util/gpu/GpuDiscoverer.java:41-59 + the
     fixture-tested GpuDeviceInformation parser) — but reads an in-process
-    API instead of forking and parsing XML.
+    API instead of forking and parsing XML. When tpumonitoring serves no
+    HBM data, an already-initialized in-process jax client's
+    ``memory_stats()`` fills in live HBM occupancy (see _jax_memory_stats).
 
     ``explain=True`` returns ``(metrics, reason)`` where ``reason`` (str |
     None) says WHY the sample is empty — an artifact recording plain ``{}``
     cannot distinguish "the channel is broken" from "this host's runtime
     serves no local metrics" (round-3 verdict weak #2)."""
     reasons: list[str] = []
+    out: dict[str, float] = {}
     try:
         from libtpu.sdk import tpumonitoring  # present on TPU VMs
     except Exception as e:  # ImportError, or OSError from the .so loader
-        reason = f"libtpu.sdk.tpumonitoring not importable: {e!r}"
-        return ({}, reason) if explain else {}
-    out: dict[str, float] = {}
-    for name in _SAMPLED_TPU_METRICS:
-        try:
-            values = tpumonitoring.get_metric(name).data()
-            parsed = parse_tpu_metric_values(name, values)
-            if not parsed:
-                reasons.append(f"{name}: runtime returned no per-chip data")
-            out.update(parsed)
-        except Exception as e:
-            # per-metric, logged: format drift or a runtime that isn't
-            # serving stays visible without ever failing the sampler
-            # (TaskMonitor.refresh and bench rely on best-effort here)
-            log.debug("tpu metric %s unavailable: %s", name, e)
-            reasons.append(f"{name}: {e!r}")
+        reasons.append(f"libtpu.sdk.tpumonitoring not importable: {e!r}")
+        tpumonitoring = None
+    if tpumonitoring is not None:
+        for name in _SAMPLED_TPU_METRICS:
+            try:
+                values = tpumonitoring.get_metric(name).data()
+                parsed = parse_tpu_metric_values(name, values)
+                if not parsed:
+                    reasons.append(
+                        f"{name}: runtime returned no per-chip data")
+                out.update(parsed)
+            except Exception as e:
+                # per-metric, logged: format drift or a runtime that isn't
+                # serving stays visible without ever failing the sampler
+                # (TaskMonitor.refresh and bench rely on best-effort here)
+                log.debug("tpu metric %s unavailable: %s", name, e)
+                reasons.append(f"{name}: {e!r}")
+    if TPU_HBM_USED not in out:
+        out.update(_jax_memory_stats())
     if explain:
         return out, ("; ".join(reasons) if not out and reasons else None)
     return out
