@@ -13,14 +13,21 @@ and reports orchestrated steps/sec with vs_baseline = orchestrated / plain.
 Orchestration happens off the training path (heartbeats + metrics RPC only),
 so the ratio should be ~1.0.
 
-Noise control: the accelerator may be reached over a network tunnel whose
-latency/load varies run to run, so (a) the workload itself times scan-batched
-on-device steps and reports a median-window rate (see mnist_jax.py), and
-(b) this script interleaves plain/orchestrated runs (A/B pairs) and scores
-the MEDIAN of the paired ratios: within a pair the two runs are adjacent in
-time, so the ratio cancels tunnel/device drift, and the median keeps one
-stalled (or lucky) pair in either direction from moving the gate. Every
-arm's number and every pair ratio are persisted in the JSON.
+Noise control (the round-4 regression forensics, docs/performance.md):
+  - The workload reports a TWO-POINT device rate: scan blocks of N and N/2
+    steps, interleaved; the step delta over the median-time delta cancels the
+    fixed per-call cost. On the tunneled chip that fixed cost (~110ms RTT +
+    dispatch) was ~90% of a 1000-step call's wall time, so the old wall-rate
+    ratio compared RTT jitter, not training speed — the whole r04 "5pp
+    regression" lived in that jitter. The wall-rate ratio is still recorded.
+  - A/B pairs run adjacent in time and the MEDIAN of paired ratios is
+    scored; one stalled (or lucky) pair cannot move the gate.
+  - Pair ORDER alternates (pair 0 orchestrated-first for the cold-launch
+    breakdown, then flipping): any systematic within-pair drift — link
+    warming, page cache — hits each arm first equally often instead of
+    always favoring the second runner.
+  - Host telemetry per arm: loadavg + /proc/stat busy fraction, persisted so
+    a deficit can be attributed to host contention instead of guessed at.
 
 BASELINE.md metric 2 (launch-to-first-step) is reported as a breakdown:
 orchestration (submit -> user-process exec) vs in-process phases (import,
@@ -38,6 +45,7 @@ Prints exactly ONE JSON line on stdout:
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import subprocess
 import sys
@@ -46,8 +54,12 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent
-STEPS = 6000
-STEPS_PER_CALL = 1000
+STEPS = 120000          # total long-block steps timed (short blocks add half)
+STEPS_PER_CALL = 12000  # long block; short is half -> diff ~0.125s of device
+                        # time per round vs per-call RTT jitter of a few ms;
+                        # 10 rounds tighten each median to ~1-2ms (the first
+                        # r05 trial at 5 rounds x 6k steps still showed +-7%
+                        # pair noise, all of it from the PLAIN arm's medians)
 BATCH = 512
 # 5 pairs: with 3, one noisy pair put the median at the mercy of a single
 # run (r03 spread was 29%); two more pairs cost ~4 min and make the median
@@ -65,20 +77,52 @@ def _workload_args(out: Path, cache: Path) -> list[str]:
     ]
 
 
-def run_plain(tmp: Path, rep: int) -> dict:
+def _cpu_busy() -> tuple[float, float]:
+    """(busy_jiffies, total_jiffies) from /proc/stat line 1."""
+    with open("/proc/stat") as f:
+        parts = f.readline().split()[1:]
+    nums = [float(p) for p in parts]
+    idle = nums[3] + (nums[4] if len(nums) > 4 else 0.0)  # idle + iowait
+    return sum(nums) - idle, sum(nums)
+
+
+class _HostLoad:
+    """Samples host contention around one arm's run."""
+
+    def __enter__(self):
+        self._busy0, self._total0 = _cpu_busy()
+        self.load_start = os.getloadavg()[0]
+        return self
+
+    def __exit__(self, *exc):
+        busy1, total1 = _cpu_busy()
+        self.load_end = os.getloadavg()[0]
+        dt = total1 - self._total0
+        self.cpu_busy_frac = (busy1 - self._busy0) / dt if dt > 0 else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "loadavg_start": round(self.load_start, 2),
+            "loadavg_end": round(self.load_end, 2),
+            "cpu_busy_frac": round(self.cpu_busy_frac, 4),
+        }
+
+
+def run_plain(tmp: Path, rep: int) -> tuple[dict, dict]:
     out = tmp / f"plain{rep}.json"
-    proc = subprocess.run(
-        [sys.executable, "-m", "tony_tpu.examples.mnist_jax",
-         *_workload_args(out, tmp / "xla-cache")],
-        cwd=REPO, capture_output=True, text=True, timeout=900,
-    )
+    with _HostLoad() as hl:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tony_tpu.examples.mnist_jax",
+             *_workload_args(out, tmp / "xla-cache")],
+            cwd=REPO, capture_output=True, text=True, timeout=900,
+        )
     if proc.returncode != 0:
         print(proc.stdout, proc.stderr, file=sys.stderr)
         raise RuntimeError("plain jax run failed")
-    return json.loads(out.read_text())
+    return json.loads(out.read_text()), hl.as_dict()
 
 
-def run_orchestrated(tmp: Path, rep: int) -> tuple[dict, float, float]:
+def run_orchestrated(tmp: Path, rep: int) -> tuple[dict, float, float, dict]:
     sys.path.insert(0, str(REPO))
     from tony_tpu.client import TonyClient
     from tony_tpu.conf import TonyConf
@@ -95,15 +139,16 @@ def run_orchestrated(tmp: Path, rep: int) -> tuple[dict, float, float]:
         "tony.am.monitor-interval-ms": 100,
     })
     client = TonyClient(conf, poll_interval_s=0.1)
-    t_submit = time.time()
-    client.submit()
-    status = client.monitor()
+    with _HostLoad() as hl:
+        t_submit = time.time()
+        client.submit()
+        status = client.monitor()
     if status.value != "SUCCEEDED":
         log_dir = Path(client.job_dir)
         for p in sorted(log_dir.rglob("*.std*")) + sorted(log_dir.rglob("*.log")):
             print(f"==== {p} ====\n{p.read_text()[-2000:]}", file=sys.stderr)
         raise RuntimeError(f"orchestrated job finished {status}")
-    return json.loads(out.read_text()), time.time() - t_submit, t_submit
+    return json.loads(out.read_text()), time.time() - t_submit, t_submit, hl.as_dict()
 
 
 def _launch_breakdown(m: dict, t_submit: float) -> dict:
@@ -123,31 +168,42 @@ def _launch_breakdown(m: dict, t_submit: float) -> dict:
 
 def main() -> int:
     plain_runs, orch_runs, submits = [], [], []
+    loads = []
     wall = 0.0
     with tempfile.TemporaryDirectory(prefix="tony-bench-") as td:
         tmp = Path(td)
         for rep in range(PAIRS):
-            # orchestrated first so rep 0's launch breakdown is genuinely
-            # COLD — a preceding plain run would warm the shared compile
-            # cache and fake the number this breakdown exists to diagnose.
-            # (Throughput is unaffected: compile is excluded from it.)
-            orch, wall, t_submit = run_orchestrated(tmp, rep)
+            # pair 0 runs orchestrated first so its launch breakdown is
+            # genuinely COLD (a preceding plain run would warm the shared
+            # compile cache); later pairs alternate so within-pair drift
+            # (link warming, cache effects) hits each arm first equally
+            if rep % 2 == 0:
+                orch, wall, t_submit, ol = run_orchestrated(tmp, rep)
+                plain, pl = run_plain(tmp, rep)
+            else:
+                plain, pl = run_plain(tmp, rep)
+                orch, wall, t_submit, ol = run_orchestrated(tmp, rep)
             orch_runs.append(orch)
+            plain_runs.append(plain)
             submits.append(t_submit)
-            plain_runs.append(run_plain(tmp, rep))
+            loads.append({"orchestrated": ol, "plain": pl,
+                          "order": "orch_first" if rep % 2 == 0 else "plain_first"})
 
     plain_all = [round(r["steps_per_sec"], 2) for r in plain_runs]
     orch_all = [round(r["steps_per_sec"], 2) for r in orch_runs]
     plain_sps = max(plain_all)
     orch_sps = max(orch_all)
     # score the MEDIAN of paired ratios: each pair's runs are adjacent in
-    # time, so the ratio cancels tunnel/device drift that max(orch)/
-    # max(plain) does not — one outlier run in a single arm (observed: a
-    # plain arm 17% above its own siblings) would otherwise swing the gate
-    # by ~10 points; the median is robust to one bad pair in EITHER
-    # direction (max would inherit the mirror-image bias)
+    # time so the ratio cancels slow tunnel/device drift, and the median is
+    # robust to a bad pair in either direction. The per-run rate is the
+    # two-point device rate (see module docstring) — the wall-rate pairing
+    # is recorded alongside for continuity with r01-r04.
     paired = [
         round(o["steps_per_sec"] / p["steps_per_sec"], 4)
+        for o, p in zip(orch_runs, plain_runs)
+    ]
+    paired_wall = [
+        round(o["steps_per_sec_wall"] / p["steps_per_sec_wall"], 4)
         for o, p in zip(orch_runs, plain_runs)
     ]
     vs_baseline = round(statistics.median(paired), 4)
@@ -160,6 +216,7 @@ def main() -> int:
     print(
         f"# plain: {plain_sps:.1f} steps/s {plain_all} | "
         f"orchestrated: {orch_sps:.1f} steps/s {orch_all} | "
+        f"paired {paired} wall-paired {paired_wall} | "
         f"launch cold: {launch_cold['total_submit_to_first_step_s']:.1f}s "
         f"(orchestration {launch_cold['orchestration_submit_to_exec_s']:.1f}s) | "
         f"warm: {launch_warm['total_submit_to_first_step_s']:.1f}s | "
@@ -173,9 +230,15 @@ def main() -> int:
         "unit": "steps/s",
         "vs_baseline": vs_baseline,
         "vs_baseline_paired_all": paired,
+        "vs_baseline_paired_wall_rate": paired_wall,
         "vs_baseline_max_over_max": round(orch_sps / plain_sps, 4),
         "plain_steps_per_sec_all": plain_all,
         "orchestrated_steps_per_sec_all": orch_all,
+        "call_overhead_s_orchestrated": [
+            r.get("call_overhead_s") for r in orch_runs],
+        "call_overhead_s_plain": [
+            r.get("call_overhead_s") for r in plain_runs],
+        "host_load_per_pair": loads,
         "launch_cold": launch_cold,
         "launch_warm": launch_warm,
     }))
