@@ -238,6 +238,11 @@ def main() -> int:
             r.get("call_overhead_s") for r in orch_runs],
         "call_overhead_s_plain": [
             r.get("call_overhead_s") for r in plain_runs],
+        # any True here means that run's two-point fit was jitter-swamped
+        # and fell back to its wall rate — inspect before trusting the pair
+        "two_point_degenerate": [
+            [r.get("two_point_degenerate") for r in orch_runs],
+            [r.get("two_point_degenerate") for r in plain_runs]],
         "host_load_per_pair": loads,
         "launch_cold": launch_cold,
         "launch_warm": launch_warm,

@@ -167,6 +167,18 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=19886)
     p.set_defaults(fn=cmd_history)
 
+    # `serve` owns a rich argparser of its own (model source + slot-pool
+    # knobs, cli/serve.py); hand the remaining argv through untouched
+    sub.add_parser(
+        "serve", add_help=False,
+        help="serve a model over HTTP with continuous batching",
+    )
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        from . import serve as serve_mod
+
+        return serve_mod.main(argv[1:])
+
     args = parser.parse_args(argv)
     return args.fn(args)
 

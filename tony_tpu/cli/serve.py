@@ -1,0 +1,247 @@
+"""``tony-tpu serve`` — a long-lived generation service over the
+continuous-batching slot pool (models/serving.py).
+
+    python -m tony_tpu.cli.main serve --port 8200 \
+        --checkpoint-dir /ckpt --vocab 4096 --d-model 256 ...   # or
+        --hf-checkpoint /path/to/llama
+
+    curl -s localhost:8200/generate -d '{"prompt": [1,2,3],
+                                         "max_new_tokens": 64}'
+    -> {"id": 0, "tokens": [...], "finish_reason": "length"}
+
+One serving thread owns the device: it admits queued requests into freed
+KV-cache slots and runs compiled decode blocks; HTTP handler threads only
+enqueue and wait. POST /generate blocks until the request completes
+(simple and proxy-friendly — the reference fronts exactly this kind of
+long-lived service with its proxy, tony-proxy/.../ProxyServer.java:27-39);
+GET /stats reports slot occupancy and queue depth.
+
+Model loading matches lm_generate: an lm_train orbax checkpoint (with the
+matching hyperparam flags), a local HF Llama/Mistral checkpoint dir, or
+random init for smoke tests. Single-device in this version (the slot pool
+is; mesh-sharded serving goes through generate()).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tony-tpu serve")
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--checkpoint-dir", default="",
+                   help="orbax dir from lm_train; empty = random init")
+    p.add_argument("--hf-checkpoint", default="",
+                   help="local HuggingFace Llama/Mistral checkpoint dir")
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--n-layers", type=int, default=4)
+    p.add_argument("--n-heads", type=int, default=8)
+    p.add_argument("--d-ff", type=int, default=1024)
+    p.add_argument("--vocab", type=int, default=4096)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--slots", type=int, default=8,
+                   help="concurrent KV-cache slots (the max in-flight batch)")
+    p.add_argument("--max-len", type=int, default=2048,
+                   help="per-slot cache capacity: prompt + generation")
+    p.add_argument("--block-size", type=int, default=16,
+                   help="decode steps per compiled dispatch; trades "
+                        "scheduling latency against host-sync amortization")
+    p.add_argument("--prefill-chunk", type=int, default=128)
+    p.add_argument("--kv-dtype", default="native", choices=("native", "int8"))
+    p.add_argument("--weight-dtype", default="native",
+                   choices=("native", "int8"))
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--stop-tokens", default="",
+                   help="whitespace-separated EOS token ids")
+    p.add_argument("--pad-id", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def load_model(args):
+    """(params, cfg) from the configured source — same sources as
+    lm_generate (examples/lm_generate.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import transformer
+
+    if args.hf_checkpoint and args.checkpoint_dir:
+        raise SystemExit("--hf-checkpoint and --checkpoint-dir are exclusive")
+    if args.hf_checkpoint:
+        from ..models.hf_import import load_hf
+
+        return load_hf(args.hf_checkpoint, dtype=getattr(jnp, args.dtype))
+    cfg = transformer.TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
+        n_heads=args.n_heads, n_kv_heads=args.n_heads, d_ff=args.d_ff,
+        dtype=getattr(jnp, args.dtype),
+    )
+    if args.checkpoint_dir:
+        from ..train.checkpoint import CheckpointManager
+        from ..train.step import make_optimizer
+
+        mgr = CheckpointManager(args.checkpoint_dir)
+        if mgr.latest_step() is None:
+            raise SystemExit(f"no checkpoint found in {args.checkpoint_dir}")
+        p0 = transformer.init(jax.random.PRNGKey(args.seed), cfg)
+        restored = mgr.restore(
+            template={"params": p0, "opt_state": make_optimizer().init(p0)})
+        mgr.close()
+        return restored["params"], cfg
+    return transformer.init(jax.random.PRNGKey(args.seed), cfg), cfg
+
+
+class ServeApp:
+    """The serving loop + request rendezvous. One lock guards the
+    SlotServer (it is not thread-safe); HTTP threads enqueue under it and
+    block on a per-request event the loop thread sets at completion."""
+
+    def __init__(self, server):
+        self.server = server            # SlotServer
+        self.lock = threading.Lock()
+        self.wake = threading.Event()
+        self.stop = threading.Event()
+        self._events: dict[int, threading.Event] = {}
+        self._results: dict[int, object] = {}
+        self.thread = threading.Thread(
+            target=self._loop, name="serve-loop", daemon=True)
+
+    def start(self):
+        self.thread.start()
+
+    def shutdown(self):
+        self.stop.set()
+        self.wake.set()
+        self.thread.join(timeout=10)
+
+    def _loop(self):
+        while not self.stop.is_set():
+            with self.lock:
+                busy = not self.server.idle
+                done = {}
+                if busy:
+                    self.server.step()
+                    # only drain when something is (or is known to be)
+                    # finished: in predictive mode drain_completed forces
+                    # a device sync, which called every tick would
+                    # serialize compute with the host round trip
+                    if self.server.completions_ready:
+                        done = self.server.drain_completed()
+            for rid, comp in done.items():
+                ev = self._events.pop(rid, None)
+                if ev is not None:
+                    # no waiter (timed out / failed submit): drop the
+                    # completion instead of growing _results forever
+                    self._results[rid] = comp
+                    ev.set()
+            if not busy:
+                self.wake.wait(0.02)
+                self.wake.clear()
+
+    def generate(self, prompt, max_new_tokens: int, timeout: float = 600.0):
+        from ..models.serving import Request
+
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens)
+        ev = threading.Event()
+        self._events[req.id] = ev
+        try:
+            with self.lock:
+                self.server.submit(req)
+        except Exception:
+            self._events.pop(req.id, None)   # rejected: no waiter to leak
+            raise
+        self.wake.set()
+        if not ev.wait(timeout):
+            self._events.pop(req.id, None)
+            self._results.pop(req.id, None)  # may have landed post-timeout
+            raise TimeoutError(f"request {req.id} timed out")
+        return self._results.pop(req.id)
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "slots": self.server.slots,
+                "active": self.server.n_active,
+                "queued": self.server.pending,
+                "max_len": self.server.max_len,
+                "block_size": self.server.block_size,
+            }
+
+
+def make_handler(app: ServeApp):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):      # quiet; the loop is the log story
+            pass
+
+        def _send(self, code: int, obj: dict):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in ("/stats", "/healthz"):
+                self._send(200, app.stats())
+            else:
+                self._send(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, {"error": "unknown path"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                prompt = payload["prompt"]
+                max_new = int(payload.get("max_new_tokens", 64))
+                comp = app.generate(prompt, max_new)
+                self._send(200, {"id": comp.id, "tokens": comp.tokens,
+                                 "finish_reason": comp.finish_reason})
+            except (KeyError, ValueError, TypeError) as e:
+                self._send(400, {"error": str(e)})
+            except TimeoutError as e:
+                self._send(504, {"error": str(e)})
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    params, cfg = load_model(args)
+
+    from ..models.serving import SlotServer
+
+    slot_server = SlotServer(
+        params, cfg, slots=args.slots, max_len=args.max_len,
+        block_size=args.block_size, prefill_chunk=args.prefill_chunk,
+        kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+        temperature=args.temperature, top_k=args.top_k,
+        stop_tokens=tuple(int(t) for t in args.stop_tokens.split()),
+        pad_id=args.pad_id, seed=args.seed)
+    app = ServeApp(slot_server)
+    app.start()
+    httpd = ThreadingHTTPServer((args.host, args.port), make_handler(app))
+    print(f"serving {cfg.n_layers}L d{cfg.d_model} on "
+          f"http://{args.host}:{httpd.server_address[1]} "
+          f"({args.slots} slots x {args.max_len} tokens)", flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        app.shutdown()
+        httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -150,12 +150,17 @@ def main(argv=None) -> int:
     median_long = statistics.median(times_long)
     median_short = statistics.median(times_short)
     # two-point fit: per-step device seconds from the step delta; the fixed
-    # per-call cost (tunnel RTT + dispatch + host sync) cancels out
-    step_s = (median_long - median_short) / (spc - spc_short)
-    step_s = max(step_s, 1e-9)
+    # per-call cost (tunnel RTT + dispatch + host sync) cancels out. A
+    # non-positive delta means host jitter swamped the device signal — fall
+    # back to the (pessimistic) wall rate and FLAG it rather than emitting
+    # a ~1e9 steps/s artifact that would poison the bench gate silently.
+    delta = median_long - median_short
+    degenerate = delta <= 0
+    step_s = (median_long / spc) if degenerate else delta / (spc - spc_short)
     acc = float(accuracy(params, x[:2048], y[:2048]))
     metrics = {
         "steps_per_sec": 1.0 / step_s,
+        "two_point_degenerate": degenerate,
         "steps_per_sec_wall": spc / median_long,
         "call_overhead_s": round(median_long - spc * step_s, 5),
         "window_call_times_s": [round(t, 5) for t in times_long],

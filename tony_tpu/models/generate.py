@@ -124,11 +124,13 @@ def _quantize_kv(x):
 
 
 def _cached_attention(cfg, q, ck, cv, cache_len, l_new,
-                      k_scale=None, v_scale=None):
+                      k_scale=None, v_scale=None, ring_offsets=None):
     """q: [B, L, H, D] for the L new positions (absolute offsets cache_len..
     cache_len+L-1); ck/cv: [B, kvH, max_len, D] full cache buffers (already
     containing the new keys). Scores run against the whole static buffer;
-    invalid/future positions are masked by index.
+    invalid/future positions are masked by index. ``cache_len`` is a scalar
+    (all rows at the same offset — generate) or a [B] vector (each row at
+    its own offset — the serving slot pool, models/serving.py).
 
     GQA is a grouped einsum — query heads are folded to [kvH, rep] and
     contracted against the UN-repeated cache, so no n_heads-wide copy of
@@ -141,7 +143,13 @@ def _cached_attention(cfg, q, ck, cv, cache_len, l_new,
     matrix — so the only op left on the cache operand is the int8->bf16
     convert, which XLA fuses into the matmul's operand read. (A naive
     `cache * scale[..., None]` materializes a full dequantized buffer per
-    step and erases int8's bandwidth saving.)"""
+    step and erases int8's bandwidth saving.)
+
+    ``ring_offsets`` [B] (serving slot pool): each row's buffer is a RING
+    whose index m holds logical position (m - offset_b) mod M. Offsets are
+    chosen at admission so every active row's next write lands at the same
+    global cursor index (see models/serving.py) — the mask maps indices to
+    logical positions per row; nothing else changes."""
     b, l, h, d = q.shape
     kvh = ck.shape[1]
     rep = h // kvh
@@ -155,13 +163,30 @@ def _cached_attention(cfg, q, ck, cv, cache_len, l_new,
         # per-position column scale: [B, kvH, M] -> [B, kvH, 1, 1, M]
         s = s * k_scale.astype(jnp.float32)[:, :, None, None, :]
     key_pos = jnp.arange(ck.shape[2])                   # [max_len]
-    q_pos = cache_len + jnp.arange(l_new)               # [L] absolute
-    mask = key_pos[None, :] <= q_pos[:, None]           # causal + validity
-    if cfg.attn_window:
-        # sliding-window models must decode with the same band they trained
-        # with, or generation attends to positions the model never saw
-        mask &= key_pos[None, :] > q_pos[:, None] - cfg.attn_window
-    s = jnp.where(mask[None, None, None], s, NEG_INF)
+    if ring_offsets is not None:
+        # ring buffers: index m holds logical position (m - offset) mod M
+        key_log = (key_pos[None, :] - ring_offsets[:, None]) % ck.shape[2]
+    else:
+        key_log = key_pos[None, :]
+    if jnp.ndim(cache_len) == 0:
+        q_pos = cache_len + jnp.arange(l_new)           # [L] absolute
+        mask_bc = (None, None, None)                    # -> [1,1,1,L,M]
+    else:
+        q_pos = cache_len[:, None] + jnp.arange(l_new)  # [B, L] per-row
+        mask_bc = (slice(None), None, None)             # -> [B,1,1,L,M]
+    if ring_offsets is not None:
+        mask = key_log[:, None, :] <= q_pos[..., :, None]
+        if cfg.attn_window:
+            mask &= key_log[:, None, :] > q_pos[..., :, None] - cfg.attn_window
+        mask_bc = (slice(None), None, None)
+    else:
+        mask = key_log <= q_pos[..., :, None]           # causal + validity
+        if cfg.attn_window:
+            # sliding-window models must decode with the same band they
+            # trained with, or generation attends to positions the model
+            # never saw
+            mask &= key_log > q_pos[..., :, None] - cfg.attn_window
+    s = jnp.where(mask[mask_bc], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     if v_scale is not None:
         p = p * v_scale.astype(jnp.float32)[:, :, None, None, :]
@@ -291,11 +316,25 @@ def _fuse_decode_weights(params, cfg: TransformerConfig,
 def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
                         fused: dict | None = None, prefill: bool = False,
                         shardings: "DecodeShardings | None" = None,
-                        all_logits: bool = False):
+                        all_logits: bool = False, ring: tuple | None = None):
     """Run L new tokens (absolute positions cache.length..+L-1) through the
     stack, reading/writing the cache -> (last-position logits [B, V] f32,
     new cache) — or ([B, L, V], new cache) with ``all_logits=True`` (the
-    speculative verify forward, models/speculative.py). By default only
+    speculative verify forward, models/speculative.py). ``cache.length``
+    may be a [B] vector — every row then decodes at its OWN logical
+    position (rope positions and attention masks per-row), which is the
+    decode step of the continuous-batching slot pool (models/serving.py).
+    Per-row mode requires ``ring=(cursor, offsets)``: each row's buffer is
+    a ring where logical position p lives at index (p + offset_b) mod M,
+    and the offsets are chosen at admission so every row's CURRENT write
+    lands at the same scalar ``cursor`` index — the K/V write is then the
+    same cheap shared-offset dynamic_update_slice as the lockstep path
+    (per-row-offset writes lower to TPU scatters that cost more than the
+    whole step), and only the mask pays the index→logical remap
+    arithmetic. Active rows advance one position per step exactly as the
+    cursor does, so a live row never wraps onto its own data. Scalar
+    length (all rows in lockstep) is the generate() path; l > 1 per-row
+    is unsupported (serving prefill has its own program). By default only
     the LAST position is projected through the unembed — generation never
     needs earlier logits, and a full [B, L, V] prefill projection would be
     a pure HBM bonfire at long prompts / large vocab (the same tensor the
@@ -322,7 +361,17 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
     general cached-attention path."""
     dt = cfg.dtype
     b, l = tokens.shape
-    positions = jnp.broadcast_to(cache.length + jnp.arange(l), (b, l))
+    per_row = jnp.ndim(cache.length) == 1   # serving slot pool: [B] lengths
+    if per_row:
+        if ring is None or l != 1:
+            raise ValueError(
+                "per-row cache lengths require ring=(cursor, offsets) and "
+                "single-token steps (the serving decode contract)")
+        ring_cursor, ring_offsets = ring
+        positions = cache.length[:, None] + jnp.arange(l)
+    else:
+        ring_cursor = ring_offsets = None
+        positions = jnp.broadcast_to(cache.length + jnp.arange(l), (b, l))
     x = params["embed"].astype(dt)[tokens]
     if shardings is not None:
         # pin activations batch-sharded / model-dim-replicated so GSPMD
@@ -338,6 +387,18 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
     ks_buf, vs_buf = cache.k_scale, cache.v_scale
     int8_cache = ck.dtype == jnp.int8
     zero = jnp.int32(0)
+
+    def write_kv(buf, new, layer):
+        """Write this layer's new K/V (or int8-scale) block into the cache:
+        buf [Ly, B, kvH, M(, D)], new [B, kvH, L(, D)] — one shared scalar
+        offset for every row: cache.length on the lockstep path, the ring
+        cursor on the per-row path (that is the point of the ring layout;
+        see the function docstring)."""
+        offset = cache.length if ring_cursor is None else ring_cursor
+        idx = (jnp.int32(layer), zero, zero, offset)
+        if new.ndim == 4:
+            idx += (zero,)
+        return lax.dynamic_update_slice(buf, new[None], idx)
     for i in range(cfg.n_layers):
         lp = jax.tree.map(lambda a: a[i], params["layers"])
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
@@ -357,20 +418,12 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
         if int8_cache:
             k_w, ks = _quantize_kv(k_hm)
             v_w, vs = _quantize_kv(v_hm)
-            ks_buf = lax.dynamic_update_slice(
-                ks_buf, ks[None], (jnp.int32(i), zero, zero, cache.length)
-            )
-            vs_buf = lax.dynamic_update_slice(
-                vs_buf, vs[None], (jnp.int32(i), zero, zero, cache.length)
-            )
+            ks_buf = write_kv(ks_buf, ks, i)
+            vs_buf = write_kv(vs_buf, vs, i)
         else:
             k_w, v_w = k_hm.astype(dt), v_hm.astype(dt)
-        ck = lax.dynamic_update_slice(
-            ck, k_w[None], (jnp.int32(i), zero, zero, cache.length, zero)
-        )
-        cv = lax.dynamic_update_slice(
-            cv, v_w[None], (jnp.int32(i), zero, zero, cache.length, zero)
-        )
+        ck = write_kv(ck, k_w, i)
+        cv = write_kv(cv, v_w, i)
         if prefill:
             kr, vr = transformer._repeat_kv(cfg, k, v)
             attn = transformer._attention(q, kr, vr, p_cfg, None)
@@ -379,6 +432,7 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
                 cfg, q, ck[i], cv[i], cache.length, l,
                 ks_buf[i] if int8_cache else None,
                 vs_buf[i] if int8_cache else None,
+                ring_offsets=ring_offsets,
             )
         if w8:
             proj = jnp.einsum(
