@@ -1,0 +1,103 @@
+"""Checkpointed training task that is spot-preempted mid-run.
+
+Session 0 trains from step 0, checkpointing on the configured cadence;
+at PREEMPT_AT it destroys the stub slice's state (as the cloud would) and
+dies mid-step-loop. The driver retry (session 1, on the re-created slice)
+must resume from the latest checkpoint — NOT step 0 — and continue the
+exact same training stream: same loader batches (the (seed, step)-pure
+contract), same losses (restored params+opt_state + deterministic CPU
+math). Every step appends {"session", "step", "loss", "batch_sha"} to
+STREAM_OUT so the test can compare against an unpreempted golden run.
+"""
+
+import hashlib
+import json
+import os
+import sys
+from pathlib import Path
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["TONY_REPO_ROOT"])
+
+from tony_tpu import train  # noqa: E402
+from tony_tpu.data import (  # noqa: E402
+    ShardedBatchLoader, TokenDataset, device_put_sharded_batch,
+)
+from tony_tpu.models import transformer  # noqa: E402
+from tony_tpu.parallel import mesh_from_string  # noqa: E402
+from tony_tpu.train.checkpoint import CheckpointManager  # noqa: E402
+
+TOTAL_STEPS = 12
+PREEMPT_AT = 7          # session 0 dies before running this step
+CKPT_EVERY = 3          # last checkpoint before preemption: step 6
+B, L = 8, 32
+
+session = int(os.environ["TONY_SESSION_ID"])
+slice_dir = Path(os.environ["STUB_SLICE_DIR"])
+out_dir = Path(os.environ["TRAIN_OUT_DIR"])
+stream_f = out_dir / "stream.jsonl"
+
+info = train.init()
+mesh = mesh_from_string("fsdp=-1")
+cfg = transformer.TransformerConfig(
+    vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+    d_ff=128, max_seq_len=L, dtype=jax.numpy.float32,
+)
+bundle = train.create_train_step(cfg, mesh)
+params, opt_state = bundle.params, bundle.opt_state
+
+mgr = CheckpointManager(str(out_dir / "ckpt"), save_interval=CKPT_EVERY)
+start_step = 0
+latest = mgr.latest_step()
+if latest is not None:
+    restored = mgr.restore(
+        template={"params": params, "opt_state": opt_state})
+    restored = jax.device_put(
+        restored,
+        jax.tree.map(lambda x: x.sharding,
+                     {"params": params, "opt_state": opt_state}))
+    params, opt_state = restored["params"], restored["opt_state"]
+    start_step = latest + 1
+    print(f"resumed from checkpoint step {latest}")
+if session == 1:
+    assert start_step == (PREEMPT_AT // CKPT_EVERY) * CKPT_EVERY + 1, (
+        f"retry must resume from the last checkpoint, got start "
+        f"{start_step}")
+
+import numpy as np  # noqa: E402
+
+dataset = TokenDataset.from_raw(os.environ["DATA_BIN"], np.uint16)
+loader = ShardedBatchLoader(
+    dataset, B, L, seed=0, process_index=0, process_count=1,
+    start_step=start_step,
+)
+
+with stream_f.open("a") as f:
+    for step_i in range(start_step, TOTAL_STEPS):
+        if session == 0 and step_i == PREEMPT_AT:
+            (slice_dir / "slice.json").unlink(missing_ok=True)
+            print("preempted: slice destroyed mid-training", file=sys.stderr)
+            os._exit(1)
+        tokens, targets = next(loader)
+        sha = hashlib.sha256(tokens.tobytes()).hexdigest()[:16]
+        dev = device_put_sharded_batch(
+            (tokens, targets), mesh, sharding=bundle.tok_sharding,
+            global_batch=B, global_seq=L)
+        params, opt_state, metrics = bundle.step_fn(
+            params, opt_state, dev[0], dev[1])
+        f.write(json.dumps({
+            "session": session, "step": step_i,
+            "loss": float(metrics["loss"]), "batch_sha": sha,
+        }) + "\n")
+        f.flush()
+        if step_i % CKPT_EVERY == 0 and step_i > 0:
+            mgr.save(step_i, {"params": params, "opt_state": opt_state})
+            mgr.wait()
+
+mgr.save(TOTAL_STEPS - 1, {"params": params, "opt_state": opt_state})
+mgr.wait()
+mgr.close()
+print("training complete")
