@@ -305,3 +305,91 @@ def test_chunked_reference_attention_matches_reference():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-3, atol=5e-3)
+
+
+# ------------------------------------------------------- flash decode kernel
+
+def _decode_ref(q, ck, cv, length, window=0):
+    """Dense reference: [B, kvH, rep, D] query vs [B, kvH, M, D] cache."""
+    M = ck.shape[2]
+    s = jnp.einsum("bhrd,bhmd->bhrm", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * ck.shape[-1] ** -0.5
+    mask = jnp.arange(M) <= length
+    if window:
+        mask &= jnp.arange(M) > length - window
+    s = jnp.where(mask[None, None, None], s, -1e30)
+    return jnp.einsum("bhrm,bhmd->bhrd", jax.nn.softmax(s, -1),
+                      cv.astype(jnp.float32))
+
+
+def test_flash_decode_matches_reference():
+    """Split-KV decode kernel vs dense reference: GQA grouping, ragged
+    final block (M not a multiple of block_k), length masking."""
+    from tony_tpu.ops.decode_attention import flash_decode
+
+    B, kvH, rep, D, M = 2, 4, 2, 128, 700
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, kvH, rep, D), jnp.float32)
+    ck = jax.random.normal(ks[1], (B, kvH, M, D), jnp.float32)
+    cv = jax.random.normal(ks[2], (B, kvH, M, D), jnp.float32)
+    for length in (0, 437, M - 1):
+        out = flash_decode(q, ck, cv, jnp.int32(length), block_k=256,
+                           interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(_decode_ref(q, ck, cv, length)),
+            rtol=2e-5, atol=2e-5)
+
+
+def test_flash_decode_window_and_int8():
+    """Sliding-window band + int8 cache with folded dequant scales: the
+    softmax denominator must sum RAW probabilities (V scales apply only
+    to the value accumulation)."""
+    from tony_tpu.ops.decode_attention import flash_decode
+
+    B, kvH, rep, D, M = 1, 2, 4, 128, 384
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (B, kvH, rep, D), jnp.float32)
+    ck = jax.random.normal(ks[1], (B, kvH, M, D), jnp.float32)
+    cv = jax.random.normal(ks[2], (B, kvH, M, D), jnp.float32)
+    out = flash_decode(q, ck, cv, jnp.int32(300), window=64, block_k=128,
+                       interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_decode_ref(q, ck, cv, 300, window=64)),
+        rtol=2e-5, atol=2e-5)
+
+    def quant(x):
+        amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        sc = jnp.maximum(amax / 127.0, 1e-8)
+        qv = jnp.clip(jnp.round(x / sc), -127, 127).astype(jnp.int8)
+        return qv, sc[..., 0].astype(jnp.bfloat16)
+
+    ck8, cks = quant(ck)
+    cv8, cvs = quant(cv)
+    out8 = flash_decode(q, ck8, cv8, jnp.int32(300), cks, cvs,
+                        block_k=128, interpret=True)
+    ref8 = _decode_ref(
+        q,
+        ck8.astype(jnp.float32) * cks[..., None].astype(jnp.float32),
+        cv8.astype(jnp.float32) * cvs[..., None].astype(jnp.float32), 300)
+    np.testing.assert_allclose(np.asarray(out8), np.asarray(ref8),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_decode_layer_indexed_stack():
+    """`layer=` reads one layer of the full [Ly, B, kvH, M, D] stack via
+    the BlockSpecs (the caller never slices — a sliced pallas operand is
+    a real copy)."""
+    from tony_tpu.ops.decode_attention import flash_decode
+
+    Ly, B, kvH, rep, D, M = 3, 1, 2, 1, 128, 256
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, kvH, rep, D), jnp.float32)
+    ck = jax.random.normal(ks[1], (Ly, B, kvH, M, D), jnp.float32)
+    cv = jax.random.normal(ks[2], (Ly, B, kvH, M, D), jnp.float32)
+    for i in range(Ly):
+        out = flash_decode(q, ck, cv, jnp.int32(100), layer=i,
+                           block_k=128, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(_decode_ref(q, ck[i], cv[i], 100)),
+            rtol=2e-5, atol=2e-5)

@@ -124,7 +124,8 @@ def _quantize_kv(x):
 
 
 def _cached_attention(cfg, q, ck, cv, cache_len, l_new,
-                      k_scale=None, v_scale=None, ring_offsets=None):
+                      k_scale=None, v_scale=None, ring_offsets=None,
+                      allow_kernel=True, layer_idx=None):
     """q: [B, L, H, D] for the L new positions (absolute offsets cache_len..
     cache_len+L-1); ck/cv: [B, kvH, max_len, D] full cache buffers (already
     containing the new keys). Scores run against the whole static buffer;
@@ -151,8 +152,33 @@ def _cached_attention(cfg, q, ck, cv, cache_len, l_new,
     global cursor index (see models/serving.py) — the mask maps indices to
     logical positions per row; nothing else changes."""
     b, l, h, d = q.shape
-    kvh = ck.shape[1]
+    kvh = ck.shape[1 if layer_idx is None else 2]
     rep = h // kvh
+    if (allow_kernel and l == 1 and jnp.ndim(cache_len) == 0
+            and ring_offsets is None and cfg.attn_impl != "ref"
+            and ck.shape[-2] >= 4096
+            and jax.default_backend() in ("tpu", "axon")):
+        # long-context single-token lockstep decode on a real chip: the
+        # split-KV Pallas kernel streams the cache at ~1.2x its HBM bound
+        # where this function's einsum graph measured ~4.3x (16k context,
+        # v5e) — ops/decode_attention.py. Below ~4k positions the einsum
+        # wins (12 kernel launches/step of fixed cost vs a small cache
+        # read: measured crossover between M=2048 and 4096). With
+        # layer_idx the kernel indexes the full cache stack itself
+        # (slicing a pallas operand is a real copy). Mesh-sharded (GSPMD)
+        # and serving-ring paths keep the XLA formulation.
+        from ..ops.decode_attention import flash_decode
+
+        out = flash_decode(
+            q.reshape(b, kvh, rep, d), ck, cv, cache_len,
+            k_scale, v_scale, window=cfg.attn_window or 0,
+            layer=layer_idx,
+        )
+        return out.reshape(b, 1, h, d)
+    if layer_idx is not None:           # einsum path works on the slice
+        ck, cv = ck[layer_idx], cv[layer_idx]
+        if k_scale is not None:
+            k_scale, v_scale = k_scale[layer_idx], v_scale[layer_idx]
     q5 = q.reshape(b, l, kvh, rep, d)
     scale = cfg.head_dim ** -0.5
     s = jnp.einsum(
@@ -429,10 +455,14 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
             attn = transformer._attention(q, kr, vr, p_cfg, None)
         else:
             attn = _cached_attention(
-                cfg, q, ck[i], cv[i], cache.length, l,
-                ks_buf[i] if int8_cache else None,
-                vs_buf[i] if int8_cache else None,
+                cfg, q, ck, cv, cache.length, l,
+                ks_buf if int8_cache else None,
+                vs_buf if int8_cache else None,
                 ring_offsets=ring_offsets,
+                # a pallas call inside the GSPMD-sharded decode would need
+                # a shard_map wrapper; the sharded path keeps the einsum
+                allow_kernel=shardings is None,
+                layer_idx=i,
             )
         if w8:
             proj = jnp.einsum(

@@ -1,0 +1,197 @@
+"""Flash-decode: split-KV cached attention for single-token decode steps.
+
+The XLA einsum formulation of decode attention (generate._cached_attention)
+measures ~4.3x its HBM bound at 16k context on v5e — the [kvH, M, D]
+cache read does not stream well through the einsum+mask+softmax graph.
+This kernel is the decode-side counterpart of the training flash kernel
+(ops/attention.py): grid over (batch, kv head, KV blocks), each program
+streams one [block_k, D] cache block through the online-softmax update
+with f32 running (m, l, acc) state in VMEM scratch, writing the
+normalized output on the last block. Pallas's grid pipeline overlaps the
+HBM block fetches with compute — the kernel's cost is the cache bytes.
+
+GQA folds the q heads to [kvH, rep, D]; each program's matmuls are
+[rep, D] x [D, block_k] — skinny on the MXU, but decode attention is
+bandwidth-bound, so the streamed cache bytes are the cost that matters.
+
+int8 caches stream as int8 (HALF the bytes — the entire point of the
+quantized cache) and dequantize per block in VMEM: K's per-position
+scales fold into the score columns AFTER the matmul, V's scales
+pre-multiply the (tiny) probability row — the same scale-folding
+discipline as the XLA path, so no dequantized copy of the cache ever
+exists anywhere.
+
+The current token's K/V must already be written to the cache (the
+write-then-attend order generate uses); masking is by absolute position:
+key_pos <= q_pos = length, with the optional sliding-window band.
+
+No reference counterpart: TonY has no compute layer (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, scale, block_k, n_blocks,
+                   window):
+    """One (b, kv-head, KV-block) grid step of the online softmax. The
+    grid's last dimension iterates sequentially, so the f32 (m, l, acc)
+    scratch carries across a head's blocks; init at block 0, normalize
+    and emit at the last block."""
+    j = pl.program_id(2)
+    length = len_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0]                                   # [rep, D]
+    d = q.shape[-1]
+    k_blk = k_ref[...].reshape(block_k, d)
+    s = jax.lax.dot_general(
+        q, k_blk.astype(q.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                          # [rep, block_k] f32
+    if ks_ref is not None:
+        s = s * ks_ref[...].reshape(1, block_k).astype(jnp.float32)
+    key_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, dimension=1)
+    mask = key_pos <= length
+    if window:
+        mask &= key_pos > length - window
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev, l_prev = m_ref[...], l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    # blocks fully past the valid range (or before the window band) have
+    # no valid column: exp(NEG_INF - NEG_INF) = 1 must be re-masked to 0
+    p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    # the softmax denominator sums the RAW probabilities; V's dequant
+    # scale applies only to the value accumulation below
+    l_ref[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[...] = m_new
+    # the tail block's out-of-bounds lanes hold unspecified values; p is 0
+    # there but 0 * NaN = NaN, so the V operand (and its scale) must be
+    # zeroed at masked columns before the accumulation. The [block_k, 1]
+    # mask is built with its own iota — Mosaic cannot transpose an i1
+    # vector ("insertion of minor dim" is 32-bit-only).
+    if vs_ref is not None:
+        vs = vs_ref[...].reshape(1, block_k).astype(jnp.float32)
+        p = p * jnp.where(mask[:1], vs, 0.0)
+    key_col = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, 1), 0)
+    col_valid = key_col <= length
+    if window:
+        col_valid &= key_col > length - window
+    v_blk = v_ref[...].reshape(block_k, d)
+    v_blk = jnp.where(col_valid, v_blk.astype(q.dtype), 0)
+    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+        p.astype(q.dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == n_blocks - 1)
+    def _finish():
+        l = l_ref[...]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _kernel_no_scale(len_ref, q_ref, k_ref, v_ref, o_ref,
+                     m_ref, l_ref, acc_ref, **kw):
+    _decode_kernel(len_ref, q_ref, k_ref, v_ref, None, None, o_ref,
+                   m_ref, l_ref, acc_ref, **kw)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("window", "block_k", "layer", "interpret"))
+def flash_decode(q, ck, cv, length, k_scale=None, v_scale=None, *,
+                 window: int = 0, block_k: int = 2048,
+                 layer: int | None = None, interpret: bool = False):
+    """Cached decode attention for ONE new token per sequence.
+
+    q: [B, kvH, rep, D] current-position queries, grouped by kv head
+    ck/cv: [B, kvH, M, D] cache buffers (bf16, or int8 with scales) — or
+        the FULL [Ly, B, kvH, M, D] stack with ``layer`` set: the kernel
+        then indexes the layer in its BlockSpecs, so the caller's
+        per-layer slice never materializes (an XLA slice feeding a pallas
+        operand is a real copy — 34MB/layer at 16k, measured ~0.6ms/step
+        of pure overhead across the flagship's 12 layers)
+    length: scalar int32 — the new token's absolute position (its K/V
+        already written at this index); every row at the same offset
+        (generate's lockstep path — the serving ring layout keeps the
+        XLA path)
+    k_scale/v_scale: [B, kvH, M] scales ([Ly, B, kvH, M] with ``layer``)
+    -> [B, kvH, rep, D] attention output in q's dtype.
+
+    The KV length M need not divide block_k: the tail block's
+    out-of-bounds lanes load unspecified values that the position mask
+    discards (length < M always).
+    """
+    b, kvh, rep, d = q.shape
+    m_cap = ck.shape[-2]
+    # one whole-cache block when the cache is small (a block larger than
+    # the array is illegal; equal is); 2048 measured best at 16k on v5e
+    # (1.2x the int8 streaming bound; 512 ran 2.6x)
+    block_k = min(block_k, m_cap)
+    n_blocks = pl.cdiv(m_cap, block_k)
+    int8 = k_scale is not None
+
+    if layer is None:
+        kv_spec = pl.BlockSpec(
+            (1, 1, block_k, d), lambda b_, h, j: (b_, h, j, 0))
+        sc_spec = pl.BlockSpec(
+            (1, 1, 1, block_k), lambda b_, h, j: (b_, h, 0, j))
+        sc = lambda s: s[:, :, None, :]
+    else:
+        kv_spec = pl.BlockSpec(
+            (1, 1, 1, block_k, d), lambda b_, h, j: (layer, b_, h, j, 0))
+        sc_spec = pl.BlockSpec(
+            (1, 1, 1, 1, block_k), lambda b_, h, j: (layer, b_, h, 0, j))
+        sc = lambda s: s[:, :, :, None, :]
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),        # length scalar
+        pl.BlockSpec((1, 1, rep, d), lambda b_, h, j: (b_, h, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    args = [jnp.asarray(length, jnp.int32)[None], q, ck, cv]
+    if int8:
+        # trailing [1, block_k] so the streamed block is TPU-legal
+        in_specs += [sc_spec, sc_spec]
+        args += [sc(k_scale), sc(v_scale)]
+
+    kernel = functools.partial(
+        _decode_kernel if int8 else _kernel_no_scale,
+        scale=d ** -0.5, block_k=block_k, n_blocks=n_blocks, window=window,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(b, kvh, n_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rep, d), lambda b_, h, j: (b_, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, rep, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+
+
+__all__ = ["flash_decode"]
