@@ -571,38 +571,86 @@ def bench_serving(slots: int = 8, n_requests: int = 24,
     }
 
 
-def bench_spec_decode(prompt_len: int = 128, new_tokens: int = 128,
-                      gamma: int = 4, reps: int = 5) -> dict:
-    """Speculative decode cost model, measured on-chip. The compiled round
-    is acceptance-INDEPENDENT (static shapes: gamma+1 draft steps + one
-    (gamma+1)-wide verify), so the honest artifact is the measured round
-    cost plus the measured vanilla step cost; speedup at draft-agreement
-    rate a follows as E(a) * step / round with E(a) = (1-a^(g+1))/(1-a)
-    expected tokens per round. Random weights can't fake agreement, so the
-    modeled column is reported at a in {0.6, 0.8} alongside the measured
-    worst case (a=0: every round emits exactly 1 token)."""
+def _markov_batch(rng, succ, batch, seq_len):
+    """Sequences from a sparse first-order chain: each state follows its
+    primary successor w.p. 0.85, its secondary otherwise — enough entropy
+    that nothing is memorizable verbatim, enough structure that a trained
+    model's greedy continuation is predictable by a SMALLER trained model
+    (the real-world condition speculative decoding exploits)."""
+    import numpy as np
+
+    V = succ.shape[0]
+    x = np.empty((batch, seq_len + 1), np.int32)
+    x[:, 0] = rng.integers(0, V, batch)
+    for t in range(seq_len):
+        pick = rng.random(batch) < 0.85
+        x[:, t + 1] = np.where(pick, succ[x[:, t], 0], succ[x[:, t], 1])
+    return x[:, :-1], x[:, 1:]
+
+
+def bench_spec_decode(prompt_len: int = 64, new_tokens: int = 256,
+                      gamma: int = 4, reps: int = 5,
+                      train_steps: int = 500) -> dict:
+    """Speculative decode measured FOR REAL: a flagship-dimension target
+    and a 33x-smaller draft are both trained on-chip on the same Markov
+    corpus (~1 min), so the draft's agreement with the target is the
+    genuine article — the same-distribution alignment a production
+    draft/target pair has — not a modeled parameter. Reports measured
+    acceptance, measured wall speedup, and the two-point device-side
+    speedup (both arms same discipline, RTT cancelled). The acceptance-0
+    floor (a round's cost when every draft is rejected) stays as the
+    honest worst case; the speedup-vs-acceptance curve is a footnote
+    derived from the same measured costs."""
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
 
     from tony_tpu.models import transformer
-    from tony_tpu.models.generate import generate
+    from tony_tpu.models.generate import generate, prepare_decode
     from tony_tpu.models.speculative import speculative_generate
+    from tony_tpu.parallel import MeshSpec, build_mesh
+    from tony_tpu.train import create_train_step
 
+    V = 4096                    # flagship dims, LM-learnable vocab
+    max_len = prompt_len + new_tokens
     cfg = transformer.TransformerConfig(
-        vocab_size=32768, d_model=1024, n_layers=12, n_heads=8,
-        n_kv_heads=8, d_ff=4096, max_seq_len=prompt_len + new_tokens,
+        vocab_size=V, d_model=1024, n_layers=12, n_heads=8,
+        n_kv_heads=8, d_ff=4096, max_seq_len=max(512, max_len),
         dtype=jnp.bfloat16, attn_impl="auto",
     )
     draft = transformer.TransformerConfig(
-        vocab_size=32768, d_model=256, n_layers=2, n_heads=4, n_kv_heads=4,
-        d_ff=1024, max_seq_len=prompt_len + new_tokens,
+        vocab_size=V, d_model=256, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=1024, max_seq_len=max(512, max_len),
         dtype=jnp.bfloat16, attn_impl="auto",
     )
-    tp = jax.jit(lambda k: transformer.init(k, cfg))(jax.random.PRNGKey(0))
-    dp = jax.jit(lambda k: transformer.init(k, draft))(jax.random.PRNGKey(1))
-    prompt = jax.random.randint(
-        jax.random.PRNGKey(2), (1, prompt_len), 0, cfg.vocab_size)
-    max_len = prompt_len + new_tokens
+    rng = np.random.default_rng(0)
+    succ = rng.integers(0, V, (V, 2)).astype(np.int32)
+
+    def train(model_cfg, steps, seed):
+        mesh = build_mesh(MeshSpec(data=-1, fsdp=1))
+        bundle = create_train_step(model_cfg, mesh,
+                                   key=jax.random.PRNGKey(seed))
+        params, opt = bundle.params, bundle.opt_state
+        r = np.random.default_rng(seed)
+        for chunk in range(steps // 50):
+            for _ in range(50):
+                tk, tg = _markov_batch(r, succ, 16, 128)
+                params, opt, m = bundle.step_fn(
+                    params, opt, jnp.asarray(tk), jnp.asarray(tg))
+            float(m["loss"])    # sync per 50-step window
+        return params, float(m["loss"])
+
+    tp_raw, t_loss = train(cfg, train_steps, seed=0)
+    dp_raw, d_loss = train(draft, train_steps, seed=1)
+    tp = prepare_decode(tp_raw, cfg)
+    dp = prepare_decode(dp_raw, draft)
+    del tp_raw, dp_raw
+
+    # held-out prompts drawn from the same chain
+    er = np.random.default_rng(99)
+    pt, _ = _markov_batch(er, succ, 1, prompt_len)
+    prompt = jnp.asarray(pt)
 
     def vanilla_wall(n_new):
         int(generate(tp, cfg, prompt, n_new, max_len=max_len)[0, 0])
@@ -624,12 +672,38 @@ def bench_spec_decode(prompt_len: int = 128, new_tokens: int = 128,
             times.append(time.time() - t0)
         return statistics.median(times)
 
-    _, _, step_s = _two_point(vanilla_wall, new_tokens)
-    # random draft: acceptance ~0, so rounds == emitted-1 and the same
-    # two-point subtraction yields the per-ROUND cost
-    _, _, round_s = _two_point(spec_wall, new_tokens)
-    _, stats = speculative_generate(tp, cfg, dp, draft, prompt, 32,
-                                    gamma=gamma, return_stats=True)
+    wall_plain, _, step_s = _two_point(vanilla_wall, new_tokens)
+    wall_spec, _, spec_tok_s = _two_point(spec_wall, new_tokens)
+    # acceptance measured over several held-out prompts
+    accs, delivered = [], 0
+    for i in range(4):
+        p, _ = _markov_batch(np.random.default_rng(100 + i), succ, 1,
+                             prompt_len)
+        _, stats = speculative_generate(
+            tp, cfg, dp, draft, jnp.asarray(p), new_tokens, gamma=gamma,
+            return_stats=True)
+        accs.append(stats["acceptance_rate"])
+        delivered += stats["delivered"]
+    acceptance = float(np.mean(accs))
+
+    # acceptance-0 floor from the same measured costs: per-round cost via
+    # a random-init draft (agreement ~0 -> two-point isolates the round)
+    dp0 = prepare_decode(
+        jax.jit(lambda k: transformer.init(k, draft))(jax.random.PRNGKey(7)),
+        draft)
+
+    def spec0_wall(n_new):
+        int(speculative_generate(tp, cfg, dp0, draft, prompt, n_new,
+                                 gamma=gamma)[0, 0])
+        times = []
+        for _ in range(reps):
+            t0 = time.time()
+            int(speculative_generate(tp, cfg, dp0, draft, prompt, n_new,
+                                     gamma=gamma)[0, 0])
+            times.append(time.time() - t0)
+        return statistics.median(times)
+
+    _, _, round_s = _two_point(spec0_wall, new_tokens)
 
     def modeled(a):
         e = sum(a ** i for i in range(gamma + 1))  # expected tokens/round
@@ -637,15 +711,21 @@ def bench_spec_decode(prompt_len: int = 128, new_tokens: int = 128,
 
     return {
         "gamma": gamma,
-        "target_params_m": round(transformer.num_params(tp) / 1e6, 1),
-        "draft_params_m": round(transformer.num_params(dp) / 1e6, 1),
+        "target_params_m": round(
+            transformer.num_params(tp.params) / 1e6, 1),
+        "draft_params_m": round(
+            transformer.num_params(dp.params) / 1e6, 1),
+        "trained_on": f"markov chain V={V}, {train_steps} steps each "
+                      f"(losses {t_loss:.3f} / {d_loss:.3f})",
+        "measured_acceptance": round(acceptance, 3),
+        "measured_wall_speedup": round(wall_plain / wall_spec, 2),
+        "measured_device_speedup": round(step_s / spec_tok_s, 2),
         "target_step_ms": round(step_s * 1e3, 3),
-        "round_ms": round(round_s * 1e3, 3),
-        "measured_acceptance_random_draft": round(
-            stats["acceptance_rate"], 3),
-        "speedup_at_acceptance_0": modeled(0.0),  # measured-cost worst case
-        "modeled_speedup_at_acceptance_0.6": modeled(0.6),
-        "modeled_speedup_at_acceptance_0.8": modeled(0.8),
+        "spec_ms_per_token": round(spec_tok_s * 1e3, 3),
+        "new_tokens": new_tokens,
+        "footnote_round_ms": round(round_s * 1e3, 3),
+        "footnote_speedup_at_acceptance_0": modeled(0.0),
+        "footnote_modeled_speedup_at_0.8": modeled(0.8),
     }
 
 
