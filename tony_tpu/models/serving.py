@@ -11,49 +11,54 @@ layer, so WHAT to serve is this framework's capability extension).
 
 Design — everything stays one compiled program over static shapes:
 
-- **Fixed slot pool.** The KV cache is allocated once as [layers, S, kvH,
-  max_len, D] for S slots. ``cache.length`` is a [S] VECTOR — each slot
-  reads/writes at its own offset (the per-row mode of
-  generate._forward_with_cache). No tensor ever changes shape when
-  requests come and go; admission just rewinds a slot's length to 0.
-- **One decode step for all slots.** Every tick runs ``block_size``
+- **Fixed slot pool, ring-aligned.** The KV cache is allocated once as
+  [layers, S, kvH, max_len, D] for S slots; ``cache.length`` is a [S]
+  VECTOR of logical lengths. Each slot's buffer is a RING: logical
+  position p lives at index (p + offset_slot) mod max_len, with the
+  offset chosen at admission so that every active slot's NEXT write
+  lands at one shared global cursor index. The decode K/V write is then
+  the same cheap shared-offset dynamic_update_slice the lockstep
+  generate() path uses — per-row-offset writes lower to TPU scatters
+  that cost more than the whole step — and only the attention mask pays
+  the index→logical remap. Active rows advance one position per step
+  exactly as the cursor does, so a live row never wraps onto its own
+  data. No tensor ever changes shape when requests come and go.
+- **One decode step for all slots.** Every block runs ``block_size``
   single-token steps for ALL S slots under one jit (a lax.scan) — active
   or not. Inactive slots compute garbage that is never read: masking rows
   would need dynamic shapes, and a masked row costs the same HBM stream
   the active rows already pay (decode is weight-bound; the weight read is
   shared). Per-row EOS/budget masks freeze finished rows' lengths
   in-device so a row that stops mid-block stays exactly where it stopped.
-- **Chunked prefill into one slot.** A new request's prompt (all but its
-  last token) is fed through the cached-attention path in fixed-size
-  chunks (its OWN compiled program, one per chunk size) that write K/V
-  directly into the slot's rows — other slots are untouched, nothing is
-  recompiled for a new prompt length, and the padded tail of the last
-  chunk lands beyond the slot's length where the attention mask never
-  looks. The prompt's LAST token is not prefilled: it becomes the slot's
-  first fed token, so the first sampled token falls out of the normal
-  decode step and needs no special logits plumbing.
-- **Host syncs once per block**, not per token: the block returns the
-  emitted [S, block] token matrix plus the updated per-slot lengths and
-  active mask; admission/completion bookkeeping is host-side numpy
-  between blocks. On a tunneled dev chip one sync costs ~100ms, so
-  block_size directly trades scheduling latency against sync amortization
-  (on a real TPU host the sync is microseconds and block_size=1 gives
-  per-token scheduling).
-- **Blocks pipeline.** The per-slot state vectors (tokens/active/lengths/
-  budgets) are DEVICE-carried: block N+1 consumes block N's output arrays
-  without the host ever seeing them, so the dispatch queue stays
-  ``pipeline_depth`` blocks deep and the host's result sync (the tunnel
-  round trip) overlaps device compute. The host's view lags by up to
-  ``pipeline_depth`` blocks — it only steers: admission prefills and
-  slot-state pokes are dispatched between blocks and logged against the
-  block they follow, so the lagging bookkeeping replays them in order
-  (a slot freed in block N idles for the in-flight blocks and is
-  re-admitted ``pipeline_depth`` blocks later — bounded idleness, never
-  wrong output).
+- **Chunked prefill into one slot, one dispatch per chunk.** A new
+  request's prompt (all but its last token) is fed through the
+  cached-attention path in fixed-size chunks that scatter K/V at the
+  slot's ring indices — other slots are untouched, nothing recompiles
+  for a new prompt length, and the padded tail's writes are DROPPED
+  (out-of-bounds indices + mode="drop"; wrapping them would corrupt the
+  slot's own earliest positions). The final chunk also commits the
+  slot's decode state (fed token, active, budget, offset) in the same
+  dispatch. The prompt's LAST token is not prefilled: it becomes the
+  slot's first fed token, so the first sampled token falls out of the
+  normal decode step with no special logits plumbing.
+- **The device never waits on the host.** Per-slot state vectors
+  (tokens/active/lengths) are DEVICE-carried: block N+1 consumes block
+  N's output arrays without the host seeing them. Without stop tokens
+  every completion is deterministic, so the host schedules OPEN-LOOP
+  from an exact model — zero mid-run syncs, one packed transfer at the
+  end (a device→host transfer costs a full tunnel round trip ~0.1-0.2s
+  REGARDLESS of size or readiness; dispatches pipeline freely). With
+  stop tokens, blocks sync in single-transfer bursts behind a
+  ``pipeline_depth`` lag, and each block's admissions are logged against
+  it so the lagging bookkeeping replays them in order — bounded slot
+  idleness, never wrong output.
 
 Exactness: a request's greedy tokens equal a solo ``generate()`` run —
 same forward, same cache layout, same masks (tested, tests/test_serving
-.py). kv_dtype/weight_dtype compose exactly as in generate().
+.py). kv_dtype/weight_dtype compose exactly as in generate(). Measured
+(PERF.json continuous_batching): 1.08-1.25x the strongest static
+batching generate() supports on a mixed-length workload, wall-clock
+with all scheduling included.
 """
 
 from __future__ import annotations
@@ -245,8 +250,9 @@ def _decode_block(params, fused, cache, tokens, active, target_len,
         nxt = sample_token(logits, sub, temperature, top_k)
         emitted = jnp.where(active, nxt, pad_id).astype(jnp.int32)
         # only rows active this step advance (staying ring-aligned with
-        # the cursor); a frozen row's garbage write lands at ring indices
-        # its mask can only reach after re-admission resets the offset
+        # the cursor); a frozen row keeps taking the shared-cursor garbage
+        # write, but its data is dead — completions are extracted from the
+        # emitted tokens, and re-admission rewrites the slot from scratch
         new_len = jnp.where(active, new_cache.length, cache.length)
         new_cache = new_cache._replace(length=new_len)
         hit_stop = (jnp.isin(nxt, stop_arr) if stop_arr is not None
