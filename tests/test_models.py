@@ -1010,10 +1010,48 @@ def test_hf_import_mistral_sliding_window_parity():
         config_from_hf(tfm.GPT2Config())
 
 
+def test_hf_import_llama3_rope_scaling_parity():
+    """Llama-3.x checkpoints ship rope_scaling (rope_type='llama3'): the
+    scaled frequency table must reproduce the transformers implementation
+    — logits to float tolerance at positions past the ORIGINAL context,
+    where unscaled RoPE would rotate off the trained manifold."""
+    torch = pytest.importorskip("torch")
+    tfm = pytest.importorskip("transformers")
+
+    from tony_tpu.models.hf_import import config_from_hf, params_from_hf
+
+    hf_cfg = tfm.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=96, rope_theta=10000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32})
+    torch.manual_seed(2)
+    hf = tfm.LlamaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32)
+    assert cfg.rope_scaling == ("llama3", 8.0, 1.0, 4.0, 32)
+    params = params_from_hf(hf.state_dict(), cfg)
+    # 80 positions: well past original_max_position_embeddings=32
+    ids = torch.randint(0, 128, (2, 80))
+    with torch.no_grad():
+        hf_logits = hf(ids).logits.numpy()
+    ours = np.asarray(
+        transformer.apply(params, jnp.asarray(ids.numpy()), cfg)[0])
+    np.testing.assert_allclose(ours, hf_logits, rtol=3e-4, atol=3e-4)
+
+    with pytest.raises(ValueError, match="rope_scaling type"):
+        config_from_hf(tfm.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            rope_scaling={"rope_type": "yarn", "factor": 4.0}))
+
+
 def test_hf_import_rejects_unimplemented_config_features():
     """Checkpoints whose configs need graph features the flagship does not
-    implement (Llama-3.x rope_scaling, attention/mlp bias) must be rejected
-    at import — silently dropping them would serve wrong logits."""
+    implement (attention/mlp bias) must be rejected at import — silently
+    dropping them would serve wrong logits."""
     torch = pytest.importorskip("torch")
     tfm = pytest.importorskip("transformers")
 
@@ -1023,13 +1061,6 @@ def test_hf_import_rejects_unimplemented_config_features():
         vocab_size=128, hidden_size=64, intermediate_size=128,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
         max_position_embeddings=64)
-
-    scaled = tfm.LlamaConfig(**base, rope_scaling={
-        "rope_type": "llama3", "factor": 8.0,
-        "low_freq_factor": 1.0, "high_freq_factor": 4.0,
-        "original_max_position_embeddings": 32})
-    with pytest.raises(ValueError, match="rope_scaling"):
-        config_from_hf(scaled)
 
     biased = tfm.LlamaConfig(**base, attention_bias=True)
     with pytest.raises(ValueError, match="attention_bias"):

@@ -435,8 +435,10 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
             q = qkv[..., :nq].reshape(b, l, cfg.n_heads, hd)
             k = qkv[..., nq:nq + nkv].reshape(b, l, cfg.n_kv_heads, hd)
             v = qkv[..., nq + nkv:].reshape(b, l, cfg.n_kv_heads, hd)
-            q = transformer.rope(q, positions, cfg.rope_theta)
-            k = transformer.rope(k, positions, cfg.rope_theta)
+            q = transformer.rope(q, positions, cfg.rope_theta,
+                                 cfg.rope_scaling)
+            k = transformer.rope(k, positions, cfg.rope_theta,
+                                 cfg.rope_scaling)
         else:
             q, k, v = transformer._qkv(cfg, h, positions, lp)
         k_hm = k.transpose(0, 2, 1, 3)  # [B, kvH, L, D] head-major

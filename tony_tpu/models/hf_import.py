@@ -17,10 +17,13 @@ apply to real public checkpoints:
     out = generate(params, cfg, prompt, 64, mesh=mesh)    # serve on TPU
 
 Supported: LlamaForCausalLM / MistralForCausalLM graphs (`model_type`
-"llama"/"mistral"), including tied embeddings and Mistral's sliding
-window (-> cfg.attn_window). Parity is tested logits-level against the
-transformers implementation (tests/test_models.py) — argmax decode
-matches HF `generate(do_sample=False)` token for token.
+"llama"/"mistral"), including tied embeddings, Mistral's sliding window
+(-> cfg.attn_window), and Llama-3.x rope_scaling (rope_type "llama3" ->
+cfg.rope_scaling — every Llama 3.1+ checkpoint ships it). Parity is
+tested logits-level against the transformers implementation
+(tests/test_models.py), including scaled-rope positions past the
+original context — argmax decode matches HF `generate(do_sample=False)`
+token for token.
 
 Layout notes (HF nn.Linear stores [out, in]; this framework stores
 [in, out] so activations hit the MXU as x @ W without transposes):
@@ -52,16 +55,27 @@ def config_from_hf(hf_config: Any, dtype=jnp.bfloat16) -> TransformerConfig:
             f"unsupported model_type {mt!r}; supported: {_SUPPORTED} "
             "(the flagship graph is Llama-shaped: RoPE/GQA/SwiGLU/RMSNorm)"
         )
-    # Reject config features the flagship graph does not implement rather
-    # than silently serving wrong logits: Llama-3.x rope_scaling rewrites
-    # the RoPE frequency table, and attention/mlp bias adds tensors that
-    # params_from_hf would drop on the floor.
+    # Map (or reject) config features beyond the base graph rather than
+    # silently serving wrong logits: Llama-3.x rope_scaling is implemented
+    # (the llama3 frequency rule — every Llama 3.1+ checkpoint ships it);
+    # other rope types and attention/mlp bias are rejected because
+    # params_from_hf would drop the information on the floor.
     scaling = getattr(hf_config, "rope_scaling", None)
+    rope_scaling = None
     if scaling:
-        raise ValueError(
-            f"rope_scaling={scaling!r} is not supported: the flagship graph "
-            "uses unscaled rotate-half RoPE, so importing this checkpoint "
-            "would serve wrong logits at long positions"
+        kind = scaling.get("rope_type", scaling.get("type", ""))
+        if kind != "llama3":
+            raise ValueError(
+                f"rope_scaling type {kind!r} is not supported (implemented: "
+                "'llama3'); importing would serve wrong logits at long "
+                "positions"
+            )
+        rope_scaling = (
+            "llama3",
+            float(scaling["factor"]),
+            float(scaling["low_freq_factor"]),
+            float(scaling["high_freq_factor"]),
+            int(scaling["original_max_position_embeddings"]),
         )
     for attr in ("attention_bias", "mlp_bias"):
         if getattr(hf_config, attr, False):
@@ -81,6 +95,7 @@ def config_from_hf(hf_config: Any, dtype=jnp.bfloat16) -> TransformerConfig:
         d_ff=hf_config.intermediate_size,
         max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        rope_scaling=rope_scaling,
         norm_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
         attn_window=int(window),
         dtype=dtype,

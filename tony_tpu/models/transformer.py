@@ -40,6 +40,10 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
+    # ("llama3", factor, low_freq_factor, high_freq_factor, original_max
+    # _position_embeddings) or None — Llama-3.x context-extension rope
+    # (a tuple, not a dict: the config is a static jit argument)
+    rope_scaling: tuple | None = None
     dtype: Any = jnp.bfloat16     # activation dtype
     param_dtype: Any = jnp.float32
     # MoE: n_experts=0 => dense SwiGLU MLP everywhere
@@ -169,11 +173,32 @@ def rms_norm(x, weight, eps=1e-6):
     return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
 
 
-def rope(x, positions, theta):
-    """Rotary position embedding; x: [B, L, H, D]."""
+def rope(x, positions, theta, scaling=None):
+    """Rotary position embedding; x: [B, L, H, D].
+
+    ``scaling`` — ("llama3", factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings) — applies Llama-3.x's context
+    extension: frequencies whose wavelength exceeds the original context
+    are slowed by ``factor``, short wavelengths are untouched, and the
+    band between interpolates smoothly (the HF _compute_llama3_parameters
+    rule). Every Llama 3.1+ checkpoint ships this; without it long-range
+    positions are rotated off the manifold the weights were trained on."""
     d = x.shape[-1]
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if scaling is not None:
+        kind, factor, low_f, high_f, orig_max = scaling
+        if kind != "llama3":
+            raise ValueError(f"unsupported rope scaling kind {kind!r}")
+        wavelen = 2.0 * jnp.pi / freqs
+        low_wl = orig_max / low_f          # longest unscaled wavelength
+        high_wl = orig_max / high_f
+        smooth = jnp.clip(
+            (orig_max / wavelen - low_f) / (high_f - low_f), 0.0, 1.0)
+        interp = (1.0 - smooth) * freqs / factor + smooth * freqs
+        freqs = jnp.where(
+            wavelen < high_wl, freqs,
+            jnp.where(wavelen > low_wl, freqs / factor, interp))
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, L, half]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -246,8 +271,8 @@ def _qkv(cfg: TransformerConfig, h, positions, lp):
     q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(dt))
     k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(dt))
     v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(dt))
-    q = rope(q, positions, cfg.rope_theta)
-    k = rope(k, positions, cfg.rope_theta)
+    q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     return q, k, v
 
 
