@@ -71,6 +71,15 @@ def main(argv=None) -> int:
                              "decodes speculatively (greedy only, batch 1; "
                              "output identical to plain decode — "
                              "models/speculative.py)")
+    parser.add_argument("--draft-checkpoint-dir", default="",
+                        help="orbax dir of an lm_train-trained DRAFT "
+                             "(e.g. a small model trained on the same "
+                             "data); decodes speculatively. Shape it with "
+                             "the --draft-* hyperparam flags")
+    parser.add_argument("--draft-d-model", type=int, default=128)
+    parser.add_argument("--draft-n-layers", type=int, default=2)
+    parser.add_argument("--draft-n-heads", type=int, default=4)
+    parser.add_argument("--draft-d-ff", type=int, default=512)
     parser.add_argument("--metrics-out", default="")
     args = parser.parse_args(argv)
 
@@ -170,14 +179,39 @@ def main(argv=None) -> int:
     )
 
     draft = None
-    if args.draft_hf_checkpoint:
+    if args.draft_hf_checkpoint and args.draft_checkpoint_dir:
+        raise SystemExit("--draft-hf-checkpoint and --draft-checkpoint-dir "
+                         "are exclusive")
+    if args.draft_hf_checkpoint or args.draft_checkpoint_dir:
         if mesh is not None or args.temperature > 0:
             raise SystemExit("speculative decode is single-device greedy "
                              "(drop --tensor-parallel / --temperature)")
-        from tony_tpu.models.hf_import import load_hf
+        if args.draft_hf_checkpoint:
+            from tony_tpu.models.hf_import import load_hf
 
-        d_params, d_cfg = load_hf(args.draft_hf_checkpoint,
-                                  dtype=getattr(jnp, args.dtype))
+            d_params, d_cfg = load_hf(args.draft_hf_checkpoint,
+                                      dtype=getattr(jnp, args.dtype))
+        else:
+            # an lm_train-trained draft: same vocab as the target (the
+            # draft proposes the target's token ids)
+            from tony_tpu.train.checkpoint import CheckpointManager
+            from tony_tpu.train.step import make_optimizer
+
+            d_cfg = transformer.TransformerConfig(
+                vocab_size=args.vocab, d_model=args.draft_d_model,
+                n_layers=args.draft_n_layers, n_heads=args.draft_n_heads,
+                n_kv_heads=args.draft_n_heads, d_ff=args.draft_d_ff,
+                dtype=getattr(jnp, args.dtype),
+            )
+            mgr = CheckpointManager(args.draft_checkpoint_dir)
+            if mgr.latest_step() is None:
+                raise SystemExit(
+                    f"no checkpoint found in {args.draft_checkpoint_dir}")
+            p0 = transformer.init(jax.random.PRNGKey(args.seed), d_cfg)
+            restored = mgr.restore(template={
+                "params": p0, "opt_state": make_optimizer().init(p0)})
+            mgr.close()
+            d_params = restored["params"]
         draft = (prepare_decode(d_params, d_cfg), d_cfg)
         print(f"speculative draft: {d_cfg.n_layers}L d{d_cfg.d_model}")
 
