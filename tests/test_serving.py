@@ -237,3 +237,25 @@ def test_slot_server_prefill_tail_past_ring_capacity(params):
     srv.submit(r)
     done = srv.run_until_drained()
     assert done[r.id].tokens == _solo(params, prompt, 4)
+
+
+def test_slot_server_per_request_temperature(params):
+    """Greedy and sampled requests share one pool: per-row temperatures
+    mean a temperature-0 request stays token-exact vs solo greedy
+    generate() even while its neighbors sample."""
+    prompts = _prompts(6, key=53)
+    srv = SlotServer(params, TINY, slots=3, max_len=64, block_size=4,
+                     prefill_chunk=8, temperature=0.9, seed=3)
+    reqs = [Request(prompt=p, max_new_tokens=6,
+                    temperature=0.0 if i % 2 == 0 else None)
+            for i, p in enumerate(prompts)]
+    for r in reqs:
+        srv.submit(r)
+    done = srv.run_until_drained()
+    assert len(done) == len(reqs)
+    for i, (r, p) in enumerate(zip(reqs, prompts)):
+        toks = done[r.id].tokens
+        assert len(toks) == 6
+        assert all(0 <= t < TINY.vocab_size for t in toks)
+        if i % 2 == 0:   # greedy rows: exact despite sampled neighbors
+            assert toks == _solo(params, p, 6), f"greedy request {i} diverged"

@@ -145,10 +145,12 @@ class ServeApp:
                 self.wake.wait(0.02)
                 self.wake.clear()
 
-    def generate(self, prompt, max_new_tokens: int, timeout: float = 600.0):
+    def generate(self, prompt, max_new_tokens: int, timeout: float = 600.0,
+                 temperature: float | None = None):
         from ..models.serving import Request
 
-        req = Request(prompt=prompt, max_new_tokens=max_new_tokens)
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      temperature=temperature)
         ev = threading.Event()
         self._events[req.id] = ev
         try:
@@ -203,7 +205,10 @@ def make_handler(app: ServeApp):
                 payload = json.loads(self.rfile.read(n) or b"{}")
                 prompt = payload["prompt"]
                 max_new = int(payload.get("max_new_tokens", 64))
-                comp = app.generate(prompt, max_new)
+                temp = payload.get("temperature")
+                comp = app.generate(
+                    prompt, max_new,
+                    temperature=None if temp is None else float(temp))
                 self._send(200, {"id": comp.id, "tokens": comp.tokens,
                                  "finish_reason": comp.finish_reason})
             except (KeyError, ValueError, TypeError) as e:

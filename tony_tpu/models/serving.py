@@ -85,7 +85,6 @@ from .generate import (
     init_cache,
     moe_dropfree,
     prepare_decode,
-    sample_token,
 )
 from .transformer import TransformerConfig, rms_norm
 from . import transformer
@@ -96,9 +95,12 @@ class Request:
     """One generation request. ``prompt`` is a token-id sequence (>= 1
     token); ``max_new_tokens`` bounds the emission; stop tokens end it
     early (the stop token itself is included in the output, matching
-    generate())."""
+    generate()). ``temperature`` overrides the server default per request
+    (0 = greedy) — sampling is per-row in the decode step, so greedy and
+    sampled requests share one pool."""
     prompt: Any
     max_new_tokens: int
+    temperature: float | None = None
     id: int = field(default_factory=itertools.count().__next__)
 
 
@@ -113,10 +115,11 @@ class Completion:
     jax.jit,
     static_argnames=("cfg", "chunk", "kv_dtype", "finalize"),
     donate_argnames=("cache", "d_tokens", "d_active", "d_target",
-                     "d_offsets"),
+                     "d_offsets", "d_temps"),
 )
 def _prefill_chunk(params, cache, d_tokens, d_active, d_target, d_offsets,
-                   tokens, slot, start, offset, n_valid, last_token, target,
+                   d_temps, tokens, slot, start, offset, n_valid,
+                   last_token, target, temp,
                    *, cfg: TransformerConfig, chunk: int, kv_dtype: str,
                    finalize: bool):
     """Feed ``chunk`` prompt tokens ([1, C], padded past n_valid) into slot
@@ -207,19 +210,20 @@ def _prefill_chunk(params, cache, d_tokens, d_active, d_target, d_offsets,
         d_active = d_active.at[slot].set(True)
         d_target = d_target.at[slot].set(target)
         d_offsets = d_offsets.at[slot].set(offset)
-    return cache, d_tokens, d_active, d_target, d_offsets
+        d_temps = d_temps.at[slot].set(temp)
+    return cache, d_tokens, d_active, d_target, d_offsets, d_temps
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "block", "stop_tokens", "pad_id", "temperature",
+    static_argnames=("cfg", "block", "stop_tokens", "pad_id",
                      "top_k", "weight_dtype", "build_fused"),
     donate_argnames=("cache",),
 )
 def _decode_block(params, fused, cache, tokens, active, target_len,
-                  offsets, cursor, key,
+                  offsets, cursor, temps, key,
                   *, cfg: TransformerConfig, block: int, stop_tokens: tuple,
-                  pad_id: int, temperature: float, top_k: int,
+                  pad_id: int, top_k: int,
                   weight_dtype: str, build_fused: bool):
     """``block`` single-token decode steps for ALL slots under one scan.
     Per-row masks freeze finished slots: their length stops advancing (the
@@ -247,7 +251,16 @@ def _decode_block(params, fused, cache, tokens, active, target_len,
             params, cfg, tokens[:, None], cache, fused,
             ring=(cursor, offsets))
         key, sub = jax.random.split(key)
-        nxt = sample_token(logits, sub, temperature, top_k)
+        # per-ROW sampling: each slot decodes at its own request's
+        # temperature (0 = greedy), so mixed traffic shares one pool
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        if top_k > 0:
+            kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
+            scaled = jnp.where(scaled >= kth, scaled, -1e30)
+        sampled = jax.random.categorical(sub, scaled, axis=-1).astype(
+            jnp.int32)
+        nxt = jnp.where(temps > 0, sampled, greedy)
         emitted = jnp.where(active, nxt, pad_id).astype(jnp.int32)
         # only rows active this step advance (staying ring-aligned with
         # the cursor); a frozen row keeps taking the shared-cursor garbage
@@ -279,9 +292,10 @@ class SlotServer:
 
     For a live service, call ``submit()`` from the request handler and
     ``step()`` on the serving loop; ``drain_completed()`` hands back
-    finished requests after each step. Greedy by default; ``temperature``/
-    ``top_k`` apply server-wide (per-request sampling params would make
-    the sampling step row-dynamic).
+    finished requests after each step. Greedy by default; the server
+    ``temperature`` is the default a request's own ``temperature``
+    overrides (sampling is per-row, so greedy and sampled requests share
+    one pool); ``top_k`` applies server-wide.
 
     ``params`` may be raw parameters or a single-device ``prepare_decode``
     result (servers should prepare once and drop the f32 masters)."""
@@ -337,6 +351,7 @@ class SlotServer:
         # (p + offset_b) mod max_len; offsets are picked at admission so
         # every active slot's next write is at the shared global cursor
         self._d_offsets = jnp.zeros((slots,), jnp.int32)
+        self._d_temps = jnp.zeros((slots,), jnp.float32)  # per-request
         self._cursor = 0        # host-tracked, advances block per dispatch
         # exact host model of the device slot state as of the NEWEST
         # dispatched block — usable for scheduling only in predictive mode
@@ -445,6 +460,8 @@ class SlotServer:
             # max_new emissions end at body + max_new (the last emitted
             # token is never fed/written, same as generate)
             target = body.size + req.max_new_tokens
+            temp = (self.temperature if req.temperature is None
+                    else float(req.temperature))
             chunk_starts = (list(range(0, body.size, C)) or [0])
             for c0 in chunk_starts:
                 n_valid = max(0, min(C, body.size - c0))
@@ -452,12 +469,15 @@ class SlotServer:
                 chunk[0, :n_valid] = body[c0:c0 + n_valid]
                 final = c0 == chunk_starts[-1]
                 (self._cache, self._d_tokens, self._d_active,
-                 self._d_target, self._d_offsets) = _prefill_chunk(
+                 self._d_target, self._d_offsets,
+                 self._d_temps) = _prefill_chunk(
                     self._params, self._cache, self._d_tokens,
                     self._d_active, self._d_target, self._d_offsets,
+                    self._d_temps,
                     jnp.asarray(chunk), jnp.int32(slot), jnp.int32(c0),
                     jnp.int32(offset), jnp.int32(n_valid),
                     jnp.int32(int(prompt[-1])), jnp.int32(target),
+                    jnp.float32(temp),
                     cfg=self.cfg, chunk=C, kv_dtype=self.kv_dtype,
                     finalize=final)
             self._host_busy[slot] = True
@@ -482,10 +502,10 @@ class SlotServer:
         (self._cache, self._d_tokens, self._d_active, packed) = _decode_block(
             self._params, self._fused, self._cache,
             self._d_tokens, self._d_active, self._d_target,
-            self._d_offsets, jnp.int32(self._cursor), sub,
+            self._d_offsets, jnp.int32(self._cursor), self._d_temps, sub,
             cfg=self.cfg, block=self.block_size,
             stop_tokens=self.stop_tokens, pad_id=self.pad_id,
-            temperature=self.temperature, top_k=self.top_k,
+            top_k=self.top_k,
             weight_dtype=self.weight_dtype, build_fused=self._build_fused)
         self._cursor = (self._cursor + self.block_size) % self.max_len
         self._pipeline.append({"packed": packed, "admits": []})
