@@ -537,16 +537,30 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
     return logits, new_cache
 
 
-def sample_token(logits, key, temperature: float = 0.0, top_k: int = 0):
-    """logits [B, V] -> token ids [B]. temperature=0 => greedy."""
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
+def sample_token(logits, key, temperature=0.0, top_k: int = 0):
+    """logits [B, V] -> token ids [B]. temperature=0 => greedy.
+
+    ``temperature`` may be a [B] ARRAY (the serving slot pool: each row
+    decodes at its own request's temperature) — rows at 0 take the greedy
+    argmax, others sample; the select is traced, so one compiled program
+    serves mixed greedy/sampled traffic."""
+    if not isinstance(temperature, jax.Array):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        temps = None
+        scaled = logits / temperature
+    else:
+        temps = temperature
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     if top_k > 0:
         # O(V log k) threshold, no sorted full-vocab copy on the hot path
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
-        logits = jnp.where(logits >= kth, logits, NEG_INF)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
+        scaled = jnp.where(scaled >= kth, scaled, NEG_INF)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    if temps is None:
+        return sampled
+    return jnp.where(temps > 0, sampled,
+                     jnp.argmax(logits, axis=-1).astype(jnp.int32))
 
 
 class DecodeShardings(NamedTuple):

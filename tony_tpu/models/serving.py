@@ -85,6 +85,7 @@ from .generate import (
     init_cache,
     moe_dropfree,
     prepare_decode,
+    sample_token,
 )
 from .transformer import TransformerConfig, rms_norm
 from . import transformer
@@ -217,14 +218,14 @@ def _prefill_chunk(params, cache, d_tokens, d_active, d_target, d_offsets,
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "block", "stop_tokens", "pad_id",
-                     "top_k", "weight_dtype", "build_fused"),
+                     "top_k", "weight_dtype", "build_fused", "all_greedy"),
     donate_argnames=("cache",),
 )
 def _decode_block(params, fused, cache, tokens, active, target_len,
                   offsets, cursor, temps, key,
                   *, cfg: TransformerConfig, block: int, stop_tokens: tuple,
                   pad_id: int, top_k: int,
-                  weight_dtype: str, build_fused: bool):
+                  weight_dtype: str, build_fused: bool, all_greedy: bool):
     """``block`` single-token decode steps for ALL slots under one scan.
     Per-row masks freeze finished slots: their length stops advancing (the
     K/V garbage an idle row computes lands at its frozen length, beyond
@@ -252,15 +253,11 @@ def _decode_block(params, fused, cache, tokens, active, target_len,
             ring=(cursor, offsets))
         key, sub = jax.random.split(key)
         # per-ROW sampling: each slot decodes at its own request's
-        # temperature (0 = greedy), so mixed traffic shares one pool
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        if top_k > 0:
-            kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
-            scaled = jnp.where(scaled >= kth, scaled, -1e30)
-        sampled = jax.random.categorical(sub, scaled, axis=-1).astype(
-            jnp.int32)
-        nxt = jnp.where(temps > 0, sampled, greedy)
+        # temperature (0 = greedy), so mixed traffic shares one pool;
+        # all_greedy (static, host-known) compiles the argmax-only
+        # program instead of a discarded full-vocab categorical
+        nxt = sample_token(logits, sub,
+                           0.0 if all_greedy else temps, top_k)
         emitted = jnp.where(active, nxt, pad_id).astype(jnp.int32)
         # only rows active this step advance (staying ring-aligned with
         # the cursor); a frozen row keeps taking the shared-cursor garbage
@@ -352,6 +349,9 @@ class SlotServer:
         # every active slot's next write is at the shared global cursor
         self._d_offsets = jnp.zeros((slots,), jnp.int32)
         self._d_temps = jnp.zeros((slots,), jnp.float32)  # per-request
+        # host mirror of the admitted temps: when every busy slot is
+        # greedy, blocks dispatch the argmax-only program variant
+        self._np_temps = np.zeros((slots,), np.float32)
         self._cursor = 0        # host-tracked, advances block per dispatch
         # exact host model of the device slot state as of the NEWEST
         # dispatched block — usable for scheduling only in predictive mode
@@ -481,6 +481,7 @@ class SlotServer:
                     cfg=self.cfg, chunk=C, kv_dtype=self.kv_dtype,
                     finalize=final)
             self._host_busy[slot] = True
+            self._np_temps[slot] = temp
             self._model_len[slot] = body.size
             self._model_active[slot] = True
             self._model_target[slot] = target
@@ -506,7 +507,11 @@ class SlotServer:
             cfg=self.cfg, block=self.block_size,
             stop_tokens=self.stop_tokens, pad_id=self.pad_id,
             top_k=self.top_k,
-            weight_dtype=self.weight_dtype, build_fused=self._build_fused)
+            weight_dtype=self.weight_dtype, build_fused=self._build_fused,
+            # _host_busy never goes False while a row is still active on
+            # device, so this is safe whenever it says all-greedy
+            all_greedy=not bool(
+                (self._np_temps[self._host_busy] > 0).any()))
         self._cursor = (self._cursor + self.block_size) % self.max_len
         self._pipeline.append({"packed": packed, "admits": []})
         if self._predictive:            # exact: no EOS can surprise us
