@@ -40,6 +40,15 @@ and variable); orchestration's share is ~1s.
 
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...breakdown}
+
+`python bench.py --serving` instead benchmarks the continuous-batching
+SlotServer (models/serving.py): tokens/sec with batched multi-slot
+admission vs the serial per-slot path (same completions, fewer host
+dispatches per admission burst — both counts reported), and, when >= 2
+devices are visible, the mesh-sharded (tensor-parallel) server with a
+parity check against the single-device completions. On CPU run it under
+`XLA_FLAGS=--xla_force_host_platform_device_count=4`. Results land in
+PERF.json under `continuous_batching_tp`.
 """
 
 from __future__ import annotations
@@ -166,7 +175,106 @@ def _launch_breakdown(m: dict, t_submit: float) -> dict:
     }
 
 
+def run_serving_bench() -> int:
+    """Continuous-batching serving benchmark (in-process, one JSON line).
+
+    One warm-up pass compiles every program variant; the timed pass then
+    measures pure serving throughput. The admission-burst comparison is
+    the tentpole number: all requests submitted up front, so the first
+    _admit() sees a full burst of free slots — the batched path collapses
+    its sum-of-chunks dispatches into max-chunks rounds."""
+    import time as _time
+
+    sys.path.insert(0, str(REPO))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tony_tpu.models import transformer
+    from tony_tpu.models.serving import Request, SlotServer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=2048, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
+        d_ff=1024, max_seq_len=512,
+        dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+        else jnp.float32,
+    )
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    slots, max_len = 8, 512
+    prompt_lens = [16, 48, 96, 160]
+    budgets = [32, 96, 48, 64, 16, 80, 56, 40]
+    n_requests = 24
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, size=prompt_lens[i % len(prompt_lens)],
+                     dtype=np.int32)
+        for i in range(n_requests)
+    ]
+
+    def serve(server_params, *, batched, mesh=None):
+        srv = SlotServer(
+            server_params, cfg, slots=slots, max_len=max_len,
+            block_size=16, prefill_chunk=64, batched_admission=batched,
+            mesh=mesh)
+        reqs = [Request(prompt=p, max_new_tokens=budgets[i % len(budgets)])
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            srv.submit(r)
+        t0 = _time.time()
+        done = srv.run_until_drained()
+        wall = _time.time() - t0
+        # key by submission index: Request.id is a process-global counter,
+        # so ids differ between server instances serving the same workload
+        toks = {i: done[r.id].tokens for i, r in enumerate(reqs)}
+        n_tokens = sum(len(t) for t in toks.values())
+        return {
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(n_tokens / wall, 1),
+            "useful_tokens": n_tokens,
+            "admission_dispatches": srv.admission_dispatches,
+        }, toks
+
+    serve(params, batched=True)                       # compile warm-up
+    batched, toks_b = serve(params, batched=True)
+    serve(params, batched=False)                      # warm per-slot too
+    perslot, toks_p = serve(params, batched=False)
+    assert toks_b == toks_p, "admission policy changed completions"
+
+    out = {
+        "metric": "continuous_batching_serving_tokens_per_sec",
+        "value": batched["tokens_per_sec"],
+        "unit": "tokens/s",
+        "slots": slots,
+        "n_requests": n_requests,
+        "prompt_lens_cycle": prompt_lens,
+        "budgets_cycle": budgets,
+        "batched_admission": batched,
+        "per_slot_admission": perslot,
+        "admission_dispatch_ratio": round(
+            perslot["admission_dispatches"]
+            / max(1, batched["admission_dispatches"]), 2),
+        "num_devices": jax.device_count(),
+    }
+    if jax.device_count() >= 2:
+        from tony_tpu.models.generate import prepare_decode
+        from tony_tpu.parallel import MeshSpec, build_mesh
+
+        tensor = 2 if cfg.n_kv_heads % 2 == 0 else 1
+        data = 2 if jax.device_count() >= 4 else 1
+        mesh = build_mesh(MeshSpec(data=data, fsdp=1, tensor=tensor),
+                          devices=jax.devices()[:data * tensor])
+        prep = prepare_decode(params, cfg, mesh=mesh)
+        serve(prep, batched=True, mesh=mesh)          # warm-up
+        tp, toks_tp = serve(prep, batched=True, mesh=mesh)
+        out["tp"] = {**tp, "mesh": dict(mesh.shape),
+                     "parity_vs_single_device": toks_tp == toks_b}
+    print(json.dumps(out))
+    return 0
+
+
 def main() -> int:
+    if "--serving" in sys.argv:
+        return run_serving_bench()
     plain_runs, orch_runs, submits = [], [], []
     loads = []
     wall = 0.0

@@ -226,6 +226,10 @@ def test_sample_tpu_metrics_jax_memory_stats_fallback(monkeypatch):
 
     fake_jax = types.ModuleType("jax")
     fake_jax.local_devices = lambda: [FakeDev(4_000_000), FakeDev(8_000_000)]
+    # a live backend must be POSITIVELY visible in the bridge registry or
+    # the fallback stays out (fail-safe against jax version bumps)
+    fake_jax._src = types.SimpleNamespace(
+        xla_bridge=types.SimpleNamespace(_backends={"tpu": object()}))
     monkeypatch.setitem(sys.modules, "jax", fake_jax)
     monkeypatch.delitem(sys.modules, "libtpu", raising=False)
     monkeypatch.delitem(sys.modules, "libtpu.sdk", raising=False)
@@ -255,6 +259,15 @@ def test_sample_tpu_metrics_jax_memory_stats_fallback(monkeypatch):
     # runtime served no data (this image ships libtpu without local chips)
     assert ("tpumonitoring not importable" in reason
             or "no per-chip data" in reason)
+
+    # bridge registry missing (jax version bump moved the private module/
+    # attribute): FAIL SAFE — report nothing rather than call
+    # local_devices(), which would initialize a second TPU client inside
+    # the executor's monitor
+    del fake_jax._src
+    fake_jax.local_devices = lambda: (_ for _ in ()).throw(
+        AssertionError("fail-safe must not touch local_devices"))
+    assert M._jax_memory_stats() == {}
 
     # jax absent from sys.modules -> the fallback must not try to import it
     monkeypatch.delitem(sys.modules, "jax", raising=False)

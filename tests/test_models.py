@@ -51,6 +51,7 @@ def test_causality():
 
 
 @pytest.mark.parametrize("rules_name", ["dp", "fsdp_tp"])
+@pytest.mark.slow
 def test_sharded_training_loss_decreases(rules_name):
     mesh = build_mesh(
         MeshSpec(data=2, fsdp=2, tensor=2) if rules_name == "fsdp_tp"
@@ -68,6 +69,7 @@ def test_sharded_training_loss_decreases(rules_name):
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_ring_attention_training():
     """Train step with the sequence sharded over a 4-way seq axis."""
     mesh = build_mesh(MeshSpec(data=2, fsdp=1, seq=4))
@@ -101,6 +103,7 @@ def test_ring_training_matches_flashless_single_device():
     )
 
 
+@pytest.mark.slow
 def test_moe_model_trains():
     cfg = transformer.TransformerConfig(
         vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
@@ -121,6 +124,7 @@ def test_moe_model_trains():
     assert losses[-1] < losses[0] - 0.05, losses
 
 
+@pytest.mark.slow
 def test_gqa_and_remat_variants():
     cfg = transformer.TransformerConfig(
         vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=1,
@@ -203,6 +207,7 @@ def test_forward_jit_compiles():
     assert logits.shape == (1, 8, 128)
 
 
+@pytest.mark.slow
 def test_pipeline_transformer_matches_and_trains():
     """Model-level pipeline parallelism: loss equals the unpipelined model,
     and training decreases it."""
@@ -229,6 +234,7 @@ def test_pipeline_transformer_matches_and_trains():
     assert losses[-1] < losses[0] - 0.05, losses
 
 
+@pytest.mark.slow
 def test_pipeline_1f1b_transformer_matches_gpipe():
     """The 1F1B schedule (manual interleaved backward, O(stages) residuals)
     must train identically to the autodiff GPipe schedule: same loss, and
@@ -272,6 +278,7 @@ def test_pipeline_1f1b_transformer_matches_gpipe():
     assert losses[-1] < losses[0] - 0.05, losses
 
 
+@pytest.mark.slow
 def test_pipeline_circular_transformer_matches_gpipe():
     """The circular (interleaved) schedule must produce the same loss as
     GPipe on identical params/batch, and train."""
@@ -318,6 +325,7 @@ def test_pipeline_1f1b_bfloat16_activations():
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow
 def test_pipeline_moe_aux_survives_both_schedules():
     """PP x MoE: expert layers pipeline in both schedules, and the
     load-balancing aux loss is accumulated (loss > plain CE). Parity
@@ -369,6 +377,7 @@ def test_pipeline_moe_aux_survives_both_schedules():
         assert np.isfinite(float(m["loss"])), schedule
 
 
+@pytest.mark.slow
 def test_bidirectional_encoder():
     """causal=False turns the stack into a BERT-style encoder: every
     position attends everywhere (verified against a manual full-attention
@@ -438,6 +447,7 @@ def test_loss_fn_blockwise_ce_matches_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_blockwise_ce_trains_sharded():
     """Blockwise CE inside the sharded train step (fsdp mesh, unembed
     sharded): loss must decrease and match the dense-CE step."""
@@ -562,6 +572,7 @@ def test_int8_weight_quantization_matches_dequant():
     assert ((np.asarray(out) >= 0) & (np.asarray(out) < TINY.vocab_size)).all()
 
 
+@pytest.mark.slow
 def test_moe_w8_decode_numerics_bounded():
     """MoE w8a16: int8 expert weights with per-expert per-output-channel
     scales folded out of the matmuls. The prefill logits must stay within
@@ -849,6 +860,7 @@ def test_generate_tp_mesh_rejections():
         generate(prep, TINY, prompt, 2, weight_dtype="int8")
 
 
+@pytest.mark.slow
 def test_lm_generate_example_end_to_end(tmp_path):
     """Train briefly with checkpoints, then lm_generate restores and
     decodes from the checkpoint (the serve-side example)."""
@@ -874,6 +886,7 @@ def test_lm_generate_example_end_to_end(tmp_path):
     assert all(0 <= t < 128 for t in result["tokens"])
 
 
+@pytest.mark.slow
 def test_lm_generate_own_trained_draft_speculative(tmp_path):
     """lm_generate pairs an lm_train-trained DRAFT checkpoint with the
     target (--draft-checkpoint-dir + --draft-* shape flags) and decodes
@@ -913,6 +926,7 @@ def test_lm_generate_own_trained_draft_speculative(tmp_path):
     assert spec == plain, "speculative CLI output diverged from plain"
 
 
+@pytest.mark.slow
 def test_lm_generate_sharded_checkpoint_restore(tmp_path):
     """Serve-side big-model path: --tensor-parallel restores the checkpoint
     SHARDED (every leaf lands directly on its mesh devices — a model bigger
@@ -940,6 +954,7 @@ def test_lm_generate_sharded_checkpoint_restore(tmp_path):
     assert outs[0] == outs[1], outs
 
 
+@pytest.mark.slow
 def test_generate_cache_continuation_multi_turn():
     """Multi-turn serving: generate(return_cache=True) returns a cache
     holding prompt + ALL emitted tokens, and continuing with only the new
@@ -985,6 +1000,7 @@ def test_generate_cache_continuation_multi_turn():
                  return_cache=True)
 
 
+@pytest.mark.slow
 def test_hf_import_llama_parity():
     """The flagship transformer IS the Llama graph: importing a random HF
     LlamaForCausalLM must reproduce its logits to float tolerance and its
@@ -1149,6 +1165,7 @@ DRAFT_TINY = transformer.TransformerConfig(
 )
 
 
+@pytest.mark.slow
 def test_speculative_generate_exact_any_draft():
     """The acceptance rule guarantees output == vanilla greedy for ANY
     draft: a random (useless) draft and the target-as-its-own-draft must
@@ -1175,6 +1192,7 @@ def test_speculative_generate_exact_any_draft():
     assert stats2["rounds"] == -(-11 // 4)  # ceil((12-1)/(3+1))
 
 
+@pytest.mark.slow
 def test_speculative_generate_stop_tokens_match_generate():
     """EOS in the speculative path: output (stop kept, pad after) must
     match generate(stop_tokens=...) exactly for both a random draft and
@@ -1252,6 +1270,7 @@ def test_attn_window_model_variant():
         transformer.loss_fn(params, tokens, targets, bad, mesh)
 
 
+@pytest.mark.slow
 def test_generate_sliding_window_matches_teacher_forcing():
     """Windowed models must decode with the trained band: cached decode ==
     full-forward argmax for attn_window configs, including prompts longer

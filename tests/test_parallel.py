@@ -277,6 +277,7 @@ def test_ulysses_with_flash_local_kernel_matches_full():
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_flash_remat_attn_composition_trains():
     """ring SP x forced flash kernel x remat_policy='attn' (the named
     residuals now live inside a scanned shard_map) must compile and
@@ -416,6 +417,7 @@ def test_pipeline_circular_matches_sequential(M):
         assert (per_layer > 0).all(), per_layer
 
 
+@pytest.mark.slow
 def test_pipeline_1f1b_loss_and_grads_match_autodiff():
     """The manually scheduled 1F1B backward must produce the same loss and
     gradients (stage params, head params, batch input) as autodiff of the

@@ -18,8 +18,11 @@ GET /stats reports slot occupancy and queue depth.
 
 Model loading matches lm_generate: an lm_train orbax checkpoint (with the
 matching hyperparam flags), a local HF Llama/Mistral checkpoint dir, or
-random init for smoke tests. Single-device in this version (the slot pool
-is; mesh-sharded serving goes through generate()).
+random init for smoke tests. ``--mesh "tensor=4"`` (axis=size pairs) serves
+TENSOR-PARALLEL: weights are prepared once onto the mesh and the slot
+pool's KV cache shards over ("batch", "kv") — a model bigger than one
+chip's HBM serves live traffic with this same single-controller loop
+(models/serving.py).
 """
 
 from __future__ import annotations
@@ -61,7 +64,48 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="whitespace-separated EOS token ids")
     p.add_argument("--pad-id", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mesh", default="",
+                   help="serve tensor-parallel: comma-separated axis=size "
+                        "pairs (e.g. 'tensor=4' or 'data=2,tensor=2'); "
+                        "axes from parallel.mesh.AXIS_ORDER. Empty = "
+                        "single device")
+    p.add_argument("--per-slot-admission", action="store_true",
+                   help="disable batched multi-slot admission (debugging/"
+                        "comparison; one prefill dispatch per chunk per "
+                        "slot instead of per chunk round)")
     return p
+
+
+def build_serving_mesh(spec_str: str):
+    """'data=2,tensor=2' -> a Mesh over the first prod(sizes) devices.
+    Unnamed axes are pinned to 1 (no wildcard -1: a server's parallelism
+    should be exactly what the operator asked for)."""
+    from ..parallel.mesh import AXIS_ORDER, MeshSpec, build_mesh
+    import jax
+    import math
+
+    sizes = {}
+    for part in spec_str.split(","):
+        axis, sep, val = part.strip().partition("=")
+        if not sep or axis not in AXIS_ORDER:
+            raise SystemExit(
+                f"--mesh: expected axis=size pairs over {AXIS_ORDER}, "
+                f"got {part!r}")
+        try:
+            size = int(val)
+        except ValueError:
+            size = 0
+        if size < 1:
+            raise SystemExit(
+                f"--mesh: axis size must be a positive integer, "
+                f"got {part!r}")
+        sizes[axis] = size
+    n = math.prod(sizes.values())
+    if n > len(jax.devices()):
+        raise SystemExit(
+            f"--mesh needs {n} devices, only {len(jax.devices())} visible")
+    spec = MeshSpec(**{**{a: 1 for a in AXIS_ORDER}, **sizes})
+    return build_mesh(spec, devices=jax.devices()[:n])
 
 
 def load_model(args):
@@ -98,16 +142,28 @@ def load_model(args):
     return transformer.init(jax.random.PRNGKey(args.seed), cfg), cfg
 
 
+class ServingLoopError(RuntimeError):
+    """The serving loop died; the message carries the cause."""
+
+
 class ServeApp:
     """The serving loop + request rendezvous. One lock guards the
     SlotServer (it is not thread-safe); HTTP threads enqueue under it and
-    block on a per-request event the loop thread sets at completion."""
+    block on a per-request event the loop thread sets at completion.
+
+    If a step raises, the loop does NOT die silently with requests left
+    hanging until their timeouts: the error is logged, every pending
+    request's event is failed with it, the app is marked unhealthy
+    (``/healthz`` reports 503 + the error), and new submissions are
+    rejected immediately."""
 
     def __init__(self, server):
         self.server = server            # SlotServer
         self.lock = threading.Lock()
         self.wake = threading.Event()
         self.stop = threading.Event()
+        self.healthy = True
+        self.error: str | None = None
         self._events: dict[int, threading.Event] = {}
         self._results: dict[int, object] = {}
         self.thread = threading.Thread(
@@ -121,19 +177,44 @@ class ServeApp:
         self.wake.set()
         self.thread.join(timeout=10)
 
+    def _fail_pending(self, exc: Exception) -> None:
+        """Fail every waiting request with the loop's error — waiters get
+        a ServingLoopError instead of hanging to their timeouts."""
+        for rid, ev in list(self._events.items()):
+            self._results[rid] = ServingLoopError(
+                f"serving loop failed: {exc!r}")
+            self._events.pop(rid, None)
+            ev.set()
+
     def _loop(self):
         while not self.stop.is_set():
-            with self.lock:
-                busy = not self.server.idle
-                done = {}
-                if busy:
-                    self.server.step()
-                    # only drain when something is (or is known to be)
-                    # finished: in predictive mode drain_completed forces
-                    # a device sync, which called every tick would
-                    # serialize compute with the host round trip
-                    if self.server.completions_ready:
-                        done = self.server.drain_completed()
+            try:
+                with self.lock:
+                    busy = not self.server.idle
+                    done = {}
+                    if busy:
+                        self.server.step()
+                        # only drain when something is (or is known to be)
+                        # finished: in predictive mode drain_completed
+                        # forces a device sync, which called every tick
+                        # would serialize compute with the host round trip
+                        if self.server.completions_ready:
+                            done = self.server.drain_completed()
+            except Exception as e:
+                import traceback
+
+                print("serving loop failed; marking unhealthy:\n"
+                      + traceback.format_exc(), flush=True)
+                # flip unhealthy and fail waiters UNDER the lock: a
+                # generate() thread either registered its event before
+                # this (it gets failed here) or checks healthy after
+                # (it raises instead of submitting into a dead loop) —
+                # no window where a request hangs to its timeout
+                with self.lock:
+                    self.healthy = False
+                    self.error = f"{type(e).__name__}: {e}"
+                    self._fail_pending(e)
+                return
             for rid, comp in done.items():
                 ev = self._events.pop(rid, None)
                 if ev is not None:
@@ -152,9 +233,15 @@ class ServeApp:
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature)
         ev = threading.Event()
-        self._events[req.id] = ev
         try:
+            # health check + event registration + submit are ONE atomic
+            # step vs the loop's failure handler (which flips healthy and
+            # fails registered events under this same lock)
             with self.lock:
+                if not self.healthy:
+                    raise ServingLoopError(
+                        f"serving loop is down: {self.error}")
+                self._events[req.id] = ev
                 self.server.submit(req)
         except Exception:
             self._events.pop(req.id, None)   # rejected: no waiter to leak
@@ -164,7 +251,10 @@ class ServeApp:
             self._events.pop(req.id, None)
             self._results.pop(req.id, None)  # may have landed post-timeout
             raise TimeoutError(f"request {req.id} timed out")
-        return self._results.pop(req.id)
+        res = self._results.pop(req.id)
+        if isinstance(res, Exception):   # the loop failed this request
+            raise res
+        return res
 
     def stats(self) -> dict:
         with self.lock:
@@ -191,7 +281,12 @@ def make_handler(app: ServeApp):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path in ("/stats", "/healthz"):
+            if self.path == "/healthz":
+                if app.healthy:
+                    self._send(200, {"healthy": True})
+                else:
+                    self._send(503, {"healthy": False, "error": app.error})
+            elif self.path == "/stats":
                 self._send(200, app.stats())
             else:
                 self._send(404, {"error": "unknown path"})
@@ -211,6 +306,8 @@ def make_handler(app: ServeApp):
                     temperature=None if temp is None else float(temp))
                 self._send(200, {"id": comp.id, "tokens": comp.tokens,
                                  "finish_reason": comp.finish_reason})
+            except ServingLoopError as e:
+                self._send(503, {"error": str(e)})
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": str(e)})
             except TimeoutError as e:
@@ -225,13 +322,22 @@ def main(argv=None) -> int:
 
     from ..models.serving import SlotServer
 
+    if args.mesh:
+        from ..models.generate import prepare_decode
+
+        mesh = build_serving_mesh(args.mesh)
+        # prepare ONCE onto the mesh and drop the unsharded masters: the
+        # server then holds a single sharded copy of the model
+        params = prepare_decode(params, cfg, weight_dtype=args.weight_dtype,
+                                mesh=mesh)
     slot_server = SlotServer(
         params, cfg, slots=args.slots, max_len=args.max_len,
         block_size=args.block_size, prefill_chunk=args.prefill_chunk,
         kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
         temperature=args.temperature, top_k=args.top_k,
         stop_tokens=tuple(int(t) for t in args.stop_tokens.split()),
-        pad_id=args.pad_id, seed=args.seed)
+        pad_id=args.pad_id, seed=args.seed,
+        batched_admission=not args.per_slot_admission)
     app = ServeApp(slot_server)
     app.start()
     httpd = ThreadingHTTPServer((args.host, args.port), make_handler(app))

@@ -148,7 +148,10 @@ class HistoryFilePurger:
         purged = []
         if not self.finished.exists():
             return purged
-        for jhist in self.finished.rglob("*" + SUFFIX):
+        # materialize before deleting: rglob walks lazily, and rmtree-ing a
+        # job dir mid-iteration makes older pathlib scandir the removed
+        # directory and raise FileNotFoundError
+        for jhist in list(self.finished.rglob("*" + SUFFIX)):
             meta = parse_history_file_name(jhist.name)
             end_ms = (meta.end_ms or meta.start_ms) if meta else None
             if end_ms is None:

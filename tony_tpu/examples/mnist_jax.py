@@ -154,8 +154,11 @@ def main(argv=None) -> int:
     # non-positive delta means host jitter swamped the device signal — fall
     # back to the (pessimistic) wall rate and FLAG it rather than emitting
     # a ~1e9 steps/s artifact that would poison the bench gate silently.
+    # spc == spc_short (--steps-per-call 1: 1 // 2 floors to the same block
+    # size) has no step delta to fit AT ALL — same fallback, not a
+    # ZeroDivisionError.
     delta = median_long - median_short
-    degenerate = delta <= 0
+    degenerate = delta <= 0 or spc == spc_short
     step_s = (median_long / spc) if degenerate else delta / (spc - spc_short)
     acc = float(accuracy(params, x[:2048], y[:2048]))
     metrics = {

@@ -189,9 +189,15 @@ def _jax_memory_stats() -> dict[str, float]:
     jax = sys.modules.get("jax")
     if jax is None:
         return {}
+    # only proceed when the private bridge registry POSITIVELY confirms an
+    # initialized backend. If a jax version bump moves/renames the module
+    # or the attribute, fail SAFE (report nothing) — proceeding would call
+    # jax.local_devices() below, which initializes a second TPU client
+    # inside the executor's monitor and contends with the child for the
+    # chip, the exact thing this guard exists to prevent.
     bridge = getattr(getattr(jax, "_src", None), "xla_bridge", None)
-    if bridge is not None and not getattr(bridge, "_backends", True):
-        return {}        # real jax, no backend initialized yet: stay out
+    if not getattr(bridge, "_backends", None):
+        return {}
     try:
         devices = [d for d in jax.local_devices()
                    if getattr(d, "platform", "") == "tpu"]

@@ -26,6 +26,11 @@ choices:
   the forward's per-use casts; the f32 MoE router excepted).
   `kv_dtype="int8"` and `weight_dtype="int8"` are the two opt-ins that
   genuinely change numerics vs the full forward (within int8 resolution).
+  The flash-decode kernel (auto-dispatched at M>=4096 on TPU) computes
+  softmax+PV in f32 like the einsum formulation, but its blockwise online
+  softmax accumulates in a different ORDER — greedy tokens across the
+  kernel gate agree to float tolerance, not provably bit-for-bit (a logit
+  tie at f32 resolution could in principle flip; never observed in tests).
 
 Sampling: greedy (temperature=0), temperature, and top-k. ``stop_tokens``
 adds EOS semantics: a per-sequence finished mask plus a `lax.while_loop`
@@ -579,11 +584,16 @@ class DecodeWeights(NamedTuple):
 
     `weight_dtype` and `mesh` record what the weights were built FOR;
     generate() rejects calls whose arguments contradict them (a silently
-    ignored mismatch would serve the wrong numerics or layout)."""
+    ignored mismatch would serve the wrong numerics or layout). `rules` is
+    the logical-axis rule table the mesh placement used — consumers
+    (generate, SlotServer) that are handed prepared weights recover the
+    cache/activation shardings from it instead of guessing a table that
+    might not match the weight layout."""
     params: Any
     fused: dict | None
     weight_dtype: str = "native"
     mesh: Any = None
+    rules: Any = None
 
 
 def _decode_shardings(mesh, rules) -> DecodeShardings:
@@ -677,7 +687,7 @@ def prepare_decode(
     fused = (None if sharded_tp
              else _fuse_decode_weights(params, cfg, weight_dtype))
     return DecodeWeights(params=params, fused=fused,
-                         weight_dtype=weight_dtype, mesh=mesh)
+                         weight_dtype=weight_dtype, mesh=mesh, rules=rules)
 
 
 @functools.partial(
@@ -928,6 +938,11 @@ def generate(
 
     shardings = None
     if mesh is not None:
+        if rules is None and isinstance(params, DecodeWeights):
+            # prepared weights remember the rule table their layout used;
+            # defaulting to a different table here would make GSPMD
+            # reshard them every call
+            rules = params.rules
         if rules is None:
             from ..parallel.sharding import TP_DECODE_RULES
             rules = TP_DECODE_RULES

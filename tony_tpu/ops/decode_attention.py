@@ -97,9 +97,14 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
     if window:
         col_valid &= key_col > length - window
     v_blk = v_ref[...].reshape(block_k, d)
-    v_blk = jnp.where(col_valid, v_blk.astype(q.dtype), 0)
+    # the PV accumulation keeps p in f32 (v upcast too): casting the
+    # probabilities to bf16 here made greedy tokens drift vs the XLA
+    # einsum path (f32-accumulated) right where the M>=4096 kernel gate
+    # engages. The matmul is cache-bandwidth-bound — the [rep, block_k]
+    # prob operand is tiny, so the f32 MXU pass costs nothing measurable.
+    v_blk = jnp.where(col_valid, v_blk.astype(jnp.float32), 0.0)
     acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-        p.astype(q.dtype), v_blk, (((1,), (0,)), ((), ())),
+        p, v_blk, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 

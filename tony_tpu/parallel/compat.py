@@ -1,0 +1,21 @@
+"""jax API compatibility: one import site for symbols that moved between
+jax versions, so kernel/parallelism modules don't each carry a try/except.
+
+`shard_map` graduated from `jax.experimental.shard_map` (keyword
+`check_rep`) to `jax.shard_map` (keyword `check_vma`). Callers here use
+the NEW spelling; on older jax the wrapper translates the keyword.
+"""
+
+from __future__ import annotations
+
+try:                                    # jax >= 0.6: public API
+    from jax import shard_map as shard_map  # noqa: F401
+except ImportError:                     # older jax: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma, **kw)
+
+
+__all__ = ["shard_map"]

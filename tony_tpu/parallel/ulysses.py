@@ -27,7 +27,7 @@ from typing import Callable
 import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .compat import shard_map
 
 from .ring_attention import reference_attention
 
