@@ -49,6 +49,15 @@ devices are visible, the mesh-sharded (tensor-parallel) server with a
 parity check against the single-device completions. On CPU run it under
 `XLA_FLAGS=--xla_force_host_platform_device_count=4`. Results land in
 PERF.json under `continuous_batching_tp`.
+
+`python bench.py --serving --shared-prefix` benchmarks the chunk-aligned
+prefix KV cache on the workload it exists for: N requests sharing one
+long template + short unique suffixes (the system-prompt/few-shot shape).
+A cold server (prefix cache off) and a warm one (`prefix_cache_blocks`)
+serve the identical submission order; the bench asserts byte-identical
+completions and reports the reused-token fraction, prefill/copy/insert
+dispatch counts, and tokens/sec for both paths. Results land in PERF.json
+under `prefix_cache`.
 """
 
 from __future__ import annotations
@@ -272,8 +281,103 @@ def run_serving_bench() -> int:
     return 0
 
 
+def run_shared_prefix_bench() -> int:
+    """Prefix-cache serving benchmark (one JSON line; see module
+    docstring). Submission order, budgets, and slot scheduling are
+    identical between the cold and warm servers, so the only difference
+    is WHERE prompt-body KV comes from — recomputed (cold) or copied out
+    of the shared pool (warm). The bench asserts the completions are
+    byte-identical: prefix reuse is a pure data-movement optimization,
+    never a numerics change (int8 pools store the quantized bytes)."""
+    import time as _time
+
+    sys.path.insert(0, str(REPO))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tony_tpu.models import transformer
+    from tony_tpu.models.serving import Request, SlotServer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=2048, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
+        d_ff=1024, max_seq_len=512,
+        dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+        else jnp.float32,
+    )
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    slots, max_len, chunk = 8, 512, 64
+    n_requests, template_len = 24, 192          # template = 3 full chunks
+    suffix_cycle = [9, 13, 17, 21]
+    budgets = [32, 48, 24, 40]
+    rng = np.random.default_rng(7)
+    template = rng.integers(0, cfg.vocab_size, size=template_len,
+                            dtype=np.int32)
+    prompts = [
+        np.concatenate([template, rng.integers(
+            0, cfg.vocab_size, size=suffix_cycle[i % len(suffix_cycle)],
+            dtype=np.int32)])
+        for i in range(n_requests)
+    ]
+    body_tokens = sum(p.size - 1 for p in prompts)
+
+    def serve(*, blocks):
+        srv = SlotServer(params, cfg, slots=slots, max_len=max_len,
+                         block_size=16, prefill_chunk=chunk,
+                         prefix_cache_blocks=blocks)
+        reqs = [Request(prompt=p, max_new_tokens=budgets[i % len(budgets)])
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            srv.submit(r)
+        t0 = _time.time()
+        done = srv.run_until_drained()
+        wall = _time.time() - t0
+        toks = {i: done[r.id].tokens for i, r in enumerate(reqs)}
+        n_tokens = sum(len(t) for t in toks.values())
+        return {
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(n_tokens / wall, 1),
+            "useful_tokens": n_tokens,
+            "admission_dispatches": srv.admission_dispatches,
+            "prefill_tokens_computed": srv.prefill_tokens_computed,
+            "prefill_tokens_reused": srv.prefill_tokens_reused,
+            **({"prefix_cache": srv.stats()["prefix_cache"]} if blocks
+               else {}),
+        }, toks
+
+    pool_blocks = 32
+    serve(blocks=0)                              # compile warm-up
+    cold, toks_cold = serve(blocks=0)
+    serve(blocks=pool_blocks)                    # warm the hit-path too
+    hit, toks_hit = serve(blocks=pool_blocks)
+    assert toks_hit == toks_cold, (
+        "prefix cache changed completions — reuse must be byte-identical")
+    reused_frac = hit["prefill_tokens_reused"] / body_tokens
+    out = {
+        "metric": "prefix_cache_serving_reused_token_fraction",
+        "value": round(reused_frac, 4),
+        "unit": "fraction of prompt-body tokens served from cache",
+        "slots": slots,
+        "n_requests": n_requests,
+        "template_len": template_len,
+        "suffix_cycle": suffix_cycle,
+        "budgets_cycle": budgets,
+        "prefill_chunk": chunk,
+        "prefix_cache_blocks": pool_blocks,
+        "body_tokens_total": body_tokens,
+        "completions_identical_hit_vs_cold": True,
+        "cold": cold,
+        "hit": hit,
+        "num_devices": jax.device_count(),
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def main() -> int:
     if "--serving" in sys.argv:
+        if "--shared-prefix" in sys.argv:
+            return run_shared_prefix_bench()
         return run_serving_bench()
     plain_runs, orch_runs, submits = [], [], []
     loads = []

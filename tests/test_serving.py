@@ -18,7 +18,9 @@ import pytest
 
 from tony_tpu.models import transformer
 from tony_tpu.models.generate import generate, prepare_decode
-from tony_tpu.models.serving import Completion, Request, SlotServer
+from tony_tpu.models.serving import (
+    Completion, PrefixCache, Request, SlotServer,
+)
 
 TINY = transformer.TransformerConfig(
     vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
@@ -440,6 +442,249 @@ def test_slot_server_mesh_rejections(params):
     with pytest.raises(ValueError, match="without a mesh"):
         SlotServer(prepare_decode(params, TINY), TINY, slots=4,
                    max_len=64, mesh=mesh)
+
+
+# --------------------------------------------------------------------------
+# chunk-aligned prefix KV cache
+# --------------------------------------------------------------------------
+
+_TEMPLATE = np.asarray(
+    jax.random.randint(jax.random.PRNGKey(97), (16,), 0, TINY.vocab_size),
+    np.int32)                    # 2 full chunks at prefill_chunk=8
+
+
+def _templated(n, lo=2, hi=9, key=101):
+    """n prompts sharing the 16-token template + short unique suffixes."""
+    return [np.concatenate([_TEMPLATE, s]) for s in _prompts(n, key, lo, hi)]
+
+
+def _serve_all(params, prompts, budgets, **kw):
+    srv = SlotServer(params, TINY, slots=2, max_len=64, block_size=4,
+                     prefill_chunk=8, **kw)
+    reqs = [Request(prompt=p, max_new_tokens=b)
+            for p, b in zip(prompts, budgets)]
+    for r in reqs:
+        srv.submit(r)
+    done = srv.run_until_drained()
+    return [done[r.id].tokens for r in reqs], srv
+
+
+def test_prefix_cache_hit_path_token_identical(params):
+    """THE prefix-cache contract: completions with the cache enabled are
+    token-identical to the cold path AND to solo generate() — reuse is
+    pure data movement, never a numerics change. Includes the degenerate
+    full-hit prompt (body == cached prefix: no suffix to prefill at
+    all)."""
+    prompts = _templated(6)
+    # body exactly the 2 template chunks -> full hit, finalize-only chunk
+    prompts.append(np.concatenate([_TEMPLATE, _TEMPLATE[:1]]))
+    budgets = [5 + (i % 3) for i in range(len(prompts))]
+    cold, _ = _serve_all(params, prompts, budgets)
+    warm, srv = _serve_all(params, prompts, budgets, prefix_cache_blocks=8)
+    assert warm == cold
+    for toks, p, b in zip(warm, prompts, budgets):
+        assert toks == _solo(params, p, b), "hit path diverged from solo"
+    st = srv.stats()["prefix_cache"]
+    # slots=2 -> the first burst of 2 misses and populates; the rest hit
+    assert st["hits"] >= 4 and st["misses"] >= 1
+    assert srv.prefill_tokens_reused >= 4 * _TEMPLATE.size
+    assert st["copy_dispatches"] >= 1 and st["insert_dispatches"] >= 1
+    assert srv.admission_dispatches < (
+        _serve_all(params, prompts, budgets)[1].admission_dispatches)
+
+
+def test_prefix_cache_int8_kv_hit_identical(params):
+    """int8 kv: the pool stores the QUANTIZED blocks + scales, so hit and
+    cold paths read the same bytes — completions exactly identical (a
+    stronger claim than the int8 serving-vs-solo tolerance, which is
+    about chunked prefill vs true prefill, not about reuse)."""
+    prompts = _templated(5, key=103)
+    budgets = [5] * len(prompts)
+    cold, _ = _serve_all(params, prompts, budgets, kv_dtype="int8")
+    warm, srv = _serve_all(params, prompts, budgets, kv_dtype="int8",
+                           prefix_cache_blocks=8)
+    assert warm == cold
+    assert srv.prefill_tokens_reused > 0
+
+
+@pytest.mark.slow
+def test_prefix_cache_tp_mesh_hit_identical(params):
+    """The prefix pool composes with tensor-parallel serving: pool blocks
+    shard over ("batch", "kv") like the slot cache, and the hit path
+    stays token-identical to the cold path and to the single-device
+    server on 4 forced host devices."""
+    mesh = _tp_mesh()
+    prompts = _templated(6, key=107)
+    budgets = [5 + (i % 3) for i in range(len(prompts))]
+
+    def run(server_params, **kw):
+        srv = SlotServer(server_params, TINY, slots=4, max_len=64,
+                         block_size=4, prefill_chunk=8, **kw)
+        reqs = [Request(prompt=p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        for r in reqs:
+            srv.submit(r)
+        done = srv.run_until_drained()
+        return [done[r.id].tokens for r in reqs], srv
+
+    prep = prepare_decode(params, TINY, mesh=mesh)
+    cold_tp, _ = run(prep)
+    warm_tp, srv = run(prep, prefix_cache_blocks=8)
+    warm_single, _ = run(params, prefix_cache_blocks=8)
+    assert warm_tp == cold_tp
+    assert warm_tp == warm_single
+    assert srv.prefill_tokens_reused > 0
+
+
+def test_prefix_cache_ring_wrap_reuse(params):
+    """A copied prefix that spans the max_len ring boundary must land at
+    the wrapped indices exactly as prefill's own writes would. Filler
+    requests (cache_prompt=False, so they leave the trie alone) advance
+    the global cursor until the next admission's ring offset forces the
+    template copy to wrap, then the templated request must still match
+    solo generate()."""
+    max_len = 48
+    template = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(113), (32,), 0,
+                           TINY.vocab_size), np.int32)    # 4 chunks
+    sfx = _prompts(2, key=127, lo=2, hi=4)
+    srv = SlotServer(params, TINY, slots=2, max_len=max_len, block_size=4,
+                     prefill_chunk=8, prefix_cache_blocks=8)
+
+    def run_one(prompt, **kw):
+        r = Request(prompt=prompt, max_new_tokens=4, **kw)
+        srv.submit(r)
+        return srv.run_until_drained()[r.id].tokens
+
+    first = np.concatenate([template, sfx[0]])
+    assert run_one(first) == _solo(params, first, 4)      # populates trie
+    wrapped = False
+    second = np.concatenate([template, sfx[1]])
+    body = second.size - 1
+    for _ in range(40):          # advance the cursor into the wrap zone
+        offset = (srv._cursor - body) % max_len
+        if offset + template.size > max_len:    # prefix copy will wrap
+            wrapped = True
+            break
+        filler = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(srv._cursor + 1), (5,),
+                               0, TINY.vocab_size), np.int32)
+        run_one(filler, cache_prompt=False)
+    assert wrapped, "test never reached a wrapping offset"
+    reused_before = srv.prefill_tokens_reused
+    assert run_one(second) == _solo(params, second, 4), (
+        "wrapped prefix copy corrupted the ring")
+    assert srv.prefill_tokens_reused == reused_before + template.size
+
+
+def test_prefix_cache_refcount_and_eviction_unit():
+    """The host trie/allocator contract, no model needed: the budget is
+    respected, eviction is LRU over unreferenced LEAVES only, evicting a
+    referenced (or interior) node is impossible, and insertion degrades
+    to a shorter cached prefix when nothing is evictable."""
+    pc = PrefixCache(2, 4)
+    a = np.arange(8, dtype=np.int32)            # 2 chunks
+    created = pc.insert(a)
+    assert [ci for ci, _ in created] == [0, 1] and pc.blocks_used == 2
+    pc.release([n for _, n in created])         # drop the insert-refs
+
+    path = pc.lookup(a)
+    assert [n.block for n in path] == [n.block for _, n in created]
+    pc.acquire(path)
+    # both blocks are on a referenced path: nothing evictable
+    assert pc.alloc() is None
+    b = np.arange(100, 108, dtype=np.int32)
+    assert pc.insert(b) == []                   # degrades, doesn't fail
+    pc.release(path)
+
+    # unreferenced now: eviction peels the LEAF (deepest chunk) first
+    blk = pc.alloc()
+    assert blk == path[1].block and pc.evictions == 1
+    assert pc.lookup(a) == path[:1]             # 1-chunk prefix still hits
+    # the surviving root child became a leaf -> evictable next
+    assert pc.alloc() == path[0].block and pc.evictions == 2
+    assert pc.lookup(a) == []
+
+    # LRU: two sibling templates, refresh the older one, evict -> the
+    # stale one goes
+    pc2 = PrefixCache(2, 4)
+    na = pc2.insert(np.arange(4, dtype=np.int32))
+    nb = pc2.insert(np.arange(50, 54, dtype=np.int32))
+    pc2.release([n for _, n in na] + [n for _, n in nb])
+    pc2.lookup(np.arange(4, dtype=np.int32))    # touch A -> B is LRU
+    assert pc2.alloc() == nb[0][1].block
+
+
+def test_prefix_cache_eviction_stress_server(params):
+    """A 2-block pool cycling through 3 distinct 2-chunk templates: every
+    admission evicts, the budget holds, and every completion stays exact
+    vs solo generate()."""
+    keys = (131, 137, 139)
+    templates = [np.asarray(
+        jax.random.randint(jax.random.PRNGKey(k), (16,), 0,
+                           TINY.vocab_size), np.int32) for k in keys]
+    srv = SlotServer(params, TINY, slots=2, max_len=64, block_size=4,
+                     prefill_chunk=8, prefix_cache_blocks=2)
+    for rnd in range(3):
+        for t in templates:
+            prompt = np.concatenate([t, t[:3]])
+            r = Request(prompt=prompt, max_new_tokens=4)
+            srv.submit(r)
+            got = srv.run_until_drained()[r.id].tokens
+            assert got == _solo(params, prompt, 4)
+            pc = srv._prefix_cache
+            assert pc.blocks_used <= pc.n_blocks == 2
+    assert srv.stats()["prefix_cache"]["evictions"] > 0
+
+
+def test_serve_app_stats_exposes_serving_counters(params):
+    """ServeApp.stats (the /stats payload) carries the SlotServer's
+    prefix-cache/prefill counters plus the MetricsAccumulator snapshot of
+    the serving-load gauges."""
+    from tony_tpu.cli.serve import ServeApp
+
+    slot_server = SlotServer(params, TINY, slots=2, max_len=64,
+                             block_size=4, prefill_chunk=8,
+                             prefix_cache_blocks=4)
+    app = ServeApp(slot_server)
+    app.start()
+    try:
+        prompt = [int(t) for t in _TEMPLATE] + [3]
+        app.generate(prompt, 4, timeout=120)
+        app.generate(prompt[:-1] + [7], 4, timeout=120)
+        st = app.stats()
+    finally:
+        app.shutdown()
+    assert st["prefix_cache"]["hits"] >= 1
+    assert st["prefill_tokens_reused"] >= _TEMPLATE.size
+    assert st["admission_dispatches"] >= 1
+    assert st["active"] == 0 and st["slots"] == 2
+    names = {m["name"] for m in st["metrics"]}
+    assert {"max_serving_active_slots", "avg_serving_queue_depth"} <= names
+
+
+def test_slot_server_per_request_top_k(params):
+    """Per-request top_k shares the pool like per-request temperature: a
+    top_k=1 request at a hot temperature is argmax by construction, so it
+    must reproduce solo greedy generate() even while its neighbors sample
+    from the server-global (unfiltered) distribution."""
+    prompts = _prompts(6, key=149)
+    srv = SlotServer(params, TINY, slots=3, max_len=64, block_size=4,
+                     prefill_chunk=8, temperature=0.8, top_k=0, seed=11)
+    reqs = [Request(prompt=p, max_new_tokens=6,
+                    temperature=4.0 if i % 2 == 0 else None,
+                    top_k=1 if i % 2 == 0 else None)
+            for i, p in enumerate(prompts)]
+    for r in reqs:
+        srv.submit(r)
+    done = srv.run_until_drained()
+    assert len(done) == len(reqs)
+    for i, (r, p) in enumerate(zip(reqs, prompts)):
+        toks = done[r.id].tokens
+        assert len(toks) == 6
+        assert all(0 <= t < TINY.vocab_size for t in toks)
+        if i % 2 == 0:      # top_k=1 == greedy, neighbors sampling freely
+            assert toks == _solo(params, p, 6), f"top_k=1 request {i} diverged"
 
 
 def test_slot_server_per_request_temperature(params):

@@ -14,7 +14,11 @@ KV-cache slots and runs compiled decode blocks; HTTP handler threads only
 enqueue and wait. POST /generate blocks until the request completes
 (simple and proxy-friendly — the reference fronts exactly this kind of
 long-lived service with its proxy, tony-proxy/.../ProxyServer.java:27-39);
-GET /stats reports slot occupancy and queue depth.
+GET /stats reports slot occupancy, queue depth, the prefix-cache counters
+(hits/misses/evictions, prefill tokens computed vs reused — see
+``--prefix-cache-blocks`` and docs/serving.md), and a MetricsAccumulator
+snapshot of the serving-load gauges, the same shape the portal/history
+layer renders for executor metrics.
 
 Model loading matches lm_generate: an lm_train orbax checkpoint (with the
 matching hyperparam flags), a local HF Llama/Mistral checkpoint dir, or
@@ -73,6 +77,17 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="disable batched multi-slot admission (debugging/"
                         "comparison; one prefill dispatch per chunk per "
                         "slot instead of per chunk round)")
+    p.add_argument("--prefix-cache-blocks", type=int, default=0,
+                   help="enable the chunk-aligned prefix KV cache with "
+                        "this many shared prefill-chunk-sized blocks "
+                        "(the HBM budget; 0 = disabled). Shared prompt "
+                        "prefixes — system prompts, few-shot templates — "
+                        "then prefill once and later requests copy the "
+                        "cached KV instead of recomputing it")
+    p.add_argument("--no-cache-prompts", action="store_true",
+                   help="with --prefix-cache-blocks: serve FROM the cache "
+                        "but never insert admitted prompts into it unless "
+                        "a request sets cache_prompt=true explicitly")
     return p
 
 
@@ -99,6 +114,10 @@ def build_serving_mesh(spec_str: str):
             raise SystemExit(
                 f"--mesh: axis size must be a positive integer, "
                 f"got {part!r}")
+        if axis in sizes:
+            raise SystemExit(
+                f"--mesh: axis {axis!r} given twice — a duplicate would "
+                "silently serve with only the last value")
         sizes[axis] = size
     n = math.prod(sizes.values())
     if n > len(jax.devices()):
@@ -158,6 +177,8 @@ class ServeApp:
     rejected immediately."""
 
     def __init__(self, server):
+        from ..metrics import MetricsAccumulator
+
         self.server = server            # SlotServer
         self.lock = threading.Lock()
         self.wake = threading.Event()
@@ -166,6 +187,11 @@ class ServeApp:
         self.error: str | None = None
         self._events: dict[int, threading.Event] = {}
         self._results: dict[int, object] = {}
+        # serving-load gauges (active slots, queue depth, reused-token
+        # fraction) accumulated the same way TaskMonitor accumulates
+        # executor metrics — snapshot rides /stats so the portal/history
+        # layer sees serving load next to the resource metrics
+        self.metrics = MetricsAccumulator()
         self.thread = threading.Thread(
             target=self._loop, name="serve-loop", daemon=True)
 
@@ -200,6 +226,7 @@ class ServeApp:
                         # would serialize compute with the host round trip
                         if self.server.completions_ready:
                             done = self.server.drain_completed()
+                        self._observe_load()
             except Exception as e:
                 import traceback
 
@@ -215,23 +242,35 @@ class ServeApp:
                     self.error = f"{type(e).__name__}: {e}"
                     self._fail_pending(e)
                 return
-            for rid, comp in done.items():
-                ev = self._events.pop(rid, None)
-                if ev is not None:
-                    # no waiter (timed out / failed submit): drop the
-                    # completion instead of growing _results forever
-                    self._results[rid] = comp
-                    ev.set()
+            if done:
+                # deliver under the lock so this can't interleave with a
+                # waiter's timeout cleanup (event popped here, then the
+                # waiter clears _results, then the store below lands and
+                # leaks) — atomically: either the waiter cleaned up first
+                # (ev is None, completion dropped) or the store+set land
+                # before the waiter's cleanup pops both
+                with self.lock:
+                    for rid, comp in done.items():
+                        ev = self._events.pop(rid, None)
+                        if ev is not None:
+                            # no waiter (timed out / failed submit): drop
+                            # the completion instead of growing _results
+                            # forever
+                            self._results[rid] = comp
+                            ev.set()
             if not busy:
                 self.wake.wait(0.02)
                 self.wake.clear()
 
     def generate(self, prompt, max_new_tokens: int, timeout: float = 600.0,
-                 temperature: float | None = None):
+                 temperature: float | None = None,
+                 top_k: int | None = None,
+                 cache_prompt: bool | None = None):
         from ..models.serving import Request
 
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
-                      temperature=temperature)
+                      temperature=temperature, top_k=top_k,
+                      cache_prompt=cache_prompt)
         ev = threading.Event()
         try:
             # health check + event registration + submit are ONE atomic
@@ -248,23 +287,42 @@ class ServeApp:
             raise
         self.wake.set()
         if not ev.wait(timeout):
-            self._events.pop(req.id, None)
-            self._results.pop(req.id, None)  # may have landed post-timeout
+            with self.lock:     # atomic vs the loop's locked delivery
+                self._events.pop(req.id, None)
+                self._results.pop(req.id, None)  # may have landed already
             raise TimeoutError(f"request {req.id} timed out")
         res = self._results.pop(req.id)
         if isinstance(res, Exception):   # the loop failed this request
             raise res
         return res
 
+    def _observe_load(self) -> None:
+        """Feed the serving-load gauges (called under the lock, once per
+        scheduling turn — block-paced, so sampling is cheap)."""
+        self.metrics.observe("serving_active_slots",
+                             float(self.server.n_active))
+        self.metrics.observe("serving_queue_depth",
+                             float(self.server.pending))
+        computed = getattr(self.server, "prefill_tokens_computed", 0)
+        reused = getattr(self.server, "prefill_tokens_reused", 0)
+        if computed + reused > 0:
+            self.metrics.observe("serving_prefill_reused_frac",
+                                 reused / (computed + reused))
+
     def stats(self) -> dict:
         with self.lock:
-            return {
-                "slots": self.server.slots,
-                "active": self.server.n_active,
-                "queued": self.server.pending,
-                "max_len": self.server.max_len,
-                "block_size": self.server.block_size,
-            }
+            if hasattr(self.server, "stats"):   # SlotServer counters
+                out = self.server.stats()
+            else:
+                out = {
+                    "slots": self.server.slots,
+                    "active": self.server.n_active,
+                    "queued": self.server.pending,
+                    "max_len": self.server.max_len,
+                    "block_size": self.server.block_size,
+                }
+            out["metrics"] = self.metrics.snapshot()
+            return out
 
 
 def make_handler(app: ServeApp):
@@ -301,9 +359,19 @@ def make_handler(app: ServeApp):
                 prompt = payload["prompt"]
                 max_new = int(payload.get("max_new_tokens", 64))
                 temp = payload.get("temperature")
+                top_k = payload.get("top_k")
+                cache_prompt = payload.get("cache_prompt")
+                if cache_prompt is not None and not isinstance(
+                        cache_prompt, bool):
+                    # bool("false") is True — coercion would invert a
+                    # string opt-out into caching the prompt
+                    raise ValueError(
+                        "cache_prompt must be a JSON boolean")
                 comp = app.generate(
                     prompt, max_new,
-                    temperature=None if temp is None else float(temp))
+                    temperature=None if temp is None else float(temp),
+                    top_k=None if top_k is None else int(top_k),
+                    cache_prompt=cache_prompt)
                 self._send(200, {"id": comp.id, "tokens": comp.tokens,
                                  "finish_reason": comp.finish_reason})
             except ServingLoopError as e:
@@ -337,7 +405,9 @@ def main(argv=None) -> int:
         temperature=args.temperature, top_k=args.top_k,
         stop_tokens=tuple(int(t) for t in args.stop_tokens.split()),
         pad_id=args.pad_id, seed=args.seed,
-        batched_admission=not args.per_slot_admission)
+        batched_admission=not args.per_slot_admission,
+        prefix_cache_blocks=args.prefix_cache_blocks,
+        cache_prompts=not args.no_cache_prompts)
     app = ServeApp(slot_server)
     app.start()
     httpd = ThreadingHTTPServer((args.host, args.port), make_handler(app))

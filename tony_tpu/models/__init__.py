@@ -4,8 +4,10 @@ the mnist parity model."""
 from .generate import (
     DecodeWeights,
     KVCache,
+    PrefixPool,
     generate,
     init_cache,
+    init_prefix_pool,
     prepare_decode,
     sample_token,
 )
@@ -26,4 +28,5 @@ __all__ = [
     "token_nll", "param_logical_axes", "num_params",
     "KVCache", "init_cache", "generate", "sample_token",
     "prepare_decode", "DecodeWeights", "speculative_generate",
+    "PrefixPool", "init_prefix_pool",
 ]

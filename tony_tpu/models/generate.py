@@ -110,6 +110,46 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
     )
 
 
+class PrefixPool(NamedTuple):
+    """Device-resident shared KV block pool for the serving prefix cache
+    (models/serving.py): ``n_blocks`` chunk-sized KV blocks, each holding
+    ``chunk`` consecutive positions of some cached prompt prefix.
+
+    Layout mirrors the slot cache with the block axis where the slot axis
+    sits ([layers, N, kvH, chunk, D], head-major positions inside) so a
+    block copies to/from a slot ring with pure gathers/scatters — no
+    transpose through a different layout on the admission hot path — and
+    so a mesh shards it with the cache's own ("batch", "kv") rule: blocks
+    over the batch axes, kv heads over the tensor axes. dtype matches the
+    slot cache (``kv_dtype``): an int8 pool stores the QUANTIZED values
+    plus their scales, so a cache hit replays byte-identical reads."""
+    k: jax.Array       # [n_layers, n_blocks, n_kv_heads, chunk, head_dim]
+    v: jax.Array
+    k_scale: jax.Array | None = None   # int8 mode: [n_layers, n_blocks,
+    v_scale: jax.Array | None = None   #             n_kv_heads, chunk]
+
+
+def init_prefix_pool(cfg: TransformerConfig, n_blocks: int, chunk: int,
+                     kv_dtype: str = "native") -> PrefixPool:
+    """Allocate the shared prefix-cache block pool (HBM budget =
+    n_blocks x the per-block KV bytes; see docs/serving.md for the
+    arithmetic). Same dtype rules as init_cache."""
+    shape = (cfg.n_layers, n_blocks, cfg.n_kv_heads, chunk, cfg.head_dim)
+    if kv_dtype == "int8":
+        return PrefixPool(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.bfloat16),
+            v_scale=jnp.zeros(shape[:-1], jnp.bfloat16),
+        )
+    if kv_dtype != "native":
+        raise ValueError(f"kv_dtype must be 'native' or 'int8', got {kv_dtype!r}")
+    return PrefixPool(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+    )
+
+
 def _symmetric_int8(x, axis: int):
     """Symmetric int8 quantization over `axis` -> (int8 values, f32 scales
     with `axis` kept as size 1)."""
@@ -542,13 +582,18 @@ def _forward_with_cache(params, cfg: TransformerConfig, tokens, cache: KVCache,
     return logits, new_cache
 
 
-def sample_token(logits, key, temperature=0.0, top_k: int = 0):
+def sample_token(logits, key, temperature=0.0, top_k=0):
     """logits [B, V] -> token ids [B]. temperature=0 => greedy.
 
     ``temperature`` may be a [B] ARRAY (the serving slot pool: each row
     decodes at its own request's temperature) — rows at 0 take the greedy
     argmax, others sample; the select is traced, so one compiled program
-    serves mixed greedy/sampled traffic."""
+    serves mixed greedy/sampled traffic. ``top_k`` likewise: a static int
+    applies one threshold to every row (the O(V log k) lax.top_k path); a
+    [B] int32 ARRAY gives each row its own k (0 = unfiltered) via a
+    per-row kth-value threshold from one full-vocab sort — costlier than
+    lax.top_k, so the serving loop only dispatches this variant when some
+    admitted request actually overrides the server k."""
     if not isinstance(temperature, jax.Array):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -557,7 +602,16 @@ def sample_token(logits, key, temperature=0.0, top_k: int = 0):
     else:
         temps = temperature
         scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    if top_k > 0:
+    if isinstance(top_k, jax.Array):
+        v = scaled.shape[-1]
+        srt = jnp.sort(scaled, axis=-1)             # ascending
+        # row r keeps values >= the top_k[r]-th largest = srt[r, V - k];
+        # k <= 0 (or k >= V) keeps everything
+        idx = jnp.clip(v - top_k, 0, v - 1).astype(jnp.int32)
+        kth = jnp.take_along_axis(srt, idx[:, None], axis=-1)
+        keep = (top_k[:, None] <= 0) | (scaled >= kth)
+        scaled = jnp.where(keep, scaled, NEG_INF)
+    elif top_k > 0:
         # O(V log k) threshold, no sorted full-vocab copy on the hot path
         kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
         scaled = jnp.where(scaled >= kth, scaled, NEG_INF)
@@ -1013,4 +1067,5 @@ def generate(
 __all__ = [
     "KVCache", "init_cache", "generate", "sample_token",
     "prepare_decode", "DecodeWeights", "moe_dropfree",
+    "PrefixPool", "init_prefix_pool",
 ]

@@ -73,6 +73,34 @@ Design — everything stays one compiled program over static shapes:
   dispatch cost is material (real/tunneled chips), a wash-to-loss on a
   compute-bound CPU backend; ``batched_admission=False`` keeps the
   serial path. Output is exactly the per-slot path's (tested).
+- **Chunk-aligned prefix cache: shared prompts prefill once.** Real
+  traffic is dominated by shared prefixes (system prompts, few-shot
+  templates, multi-turn histories); ``prefix_cache_blocks=N`` keeps a
+  host-managed TRIE keyed on ``prefill_chunk``-sized token blocks whose
+  nodes own KV blocks in a device-resident shared pool (separate from
+  the slot rings; same ("batch", "kv") sharding rule, blocks where slots
+  sit). Admission walks the trie for the longest cached chunk-aligned
+  prefix, copies its blocks into the slot ring with ONE batched
+  gather/scatter program per admission burst (ring-wrap handled by the
+  same mod-M indexing prefill uses), then prefills only the suffix; the
+  request's own new full chunks are gathered back into fresh pool blocks
+  in one more program, dispatched at ADMISSION time — right after the
+  suffix prefill, before any decode block — because a frozen slot's ring
+  keeps taking the shared-cursor garbage write, so by the time a
+  completion is *processed* the prompt body may already be overwritten
+  (insert-at-admission is also what lets the next burst hit a template
+  the previous burst introduced). Nodes are ref-counted while an
+  admitted request holds its matched path (admission -> processed
+  completion) and unreferenced LEAVES are LRU-evicted when the block
+  budget is exhausted — interior nodes are unreachable without their
+  ancestors, so eviction peels the trie from the leaves and can never
+  orphan a reachable block. KV at position p depends only on tokens
+  <= p, so a cached block is bit-identical to what the cold prefill
+  would have written — including int8: the pool stores the QUANTIZED
+  values + scales, hit and cold paths read the same bytes, completions
+  are token-identical either way (tested; lookups within one admission
+  burst see the trie as of the burst start, so two same-template
+  requests admitted together both prefill — the second burst hits).
 - **The device never waits on the host.** Per-slot state vectors
   (tokens/active/lengths) are DEVICE-carried: block N+1 consumes block
   N's output arrays without the host seeing them. Without stop tokens
@@ -115,6 +143,7 @@ from .generate import (
     DecodeShardings,
     DecodeWeights,
     KVCache,
+    PrefixPool,
     _cached_attention,
     _cast_decode_params,
     _decode_shardings,
@@ -124,6 +153,7 @@ from .generate import (
     _rule_size,
     _validate_decode_mesh,
     init_cache,
+    init_prefix_pool,
     moe_dropfree,
     prepare_decode,
     sample_token,
@@ -137,12 +167,18 @@ class Request:
     """One generation request. ``prompt`` is a token-id sequence (>= 1
     token); ``max_new_tokens`` bounds the emission; stop tokens end it
     early (the stop token itself is included in the output, matching
-    generate()). ``temperature`` overrides the server default per request
-    (0 = greedy) — sampling is per-row in the decode step, so greedy and
-    sampled requests share one pool."""
+    generate()). ``temperature`` and ``top_k`` override the server
+    defaults per request (temperature 0 = greedy, top_k 0 = unfiltered) —
+    sampling is per-row in the decode step, so greedy, sampled, and
+    top-k-filtered requests share one pool. ``cache_prompt`` overrides
+    the server's ``cache_prompts`` default: whether this prompt's body
+    chunks are inserted into the prefix cache at admission (None = server
+    default; lookups always run when the cache is enabled)."""
     prompt: Any
     max_new_tokens: int
     temperature: float | None = None
+    top_k: int | None = None
+    cache_prompt: bool | None = None
     id: int = field(default_factory=itertools.count().__next__)
 
 
@@ -151,6 +187,25 @@ class Completion:
     id: int
     tokens: list[int]
     finish_reason: str          # "stop" | "length"
+
+
+@dataclass
+class _Admission:
+    """One (slot, request) pair of an admission burst, with the layout
+    decisions made at collection time: ring offset, budget target,
+    sampling overrides, the chunk-aligned cached-prefix length (0 when
+    the prefix cache is off or missed) and the matched trie path, and
+    the suffix chunk starts the prefill programs will feed."""
+    slot: int
+    req: Request
+    body: np.ndarray
+    offset: int
+    target: int
+    temp: float
+    topk: int
+    chunk_starts: list
+    prefix_len: int = 0
+    hit_path: list = field(default_factory=list)
 
 
 def _constrain_pool(shardings, cache, *vecs):
@@ -173,15 +228,242 @@ def _constrain_pool(shardings, cache, *vecs):
     return (cache, *(c(v, shardings.act) for v in vecs))
 
 
+class _PrefixNode:
+    """One trie node = one ``prefill_chunk``-sized token block owning one
+    pool block. ``refs`` counts admitted requests whose matched path runs
+    through this node (held admission -> processed completion) plus a
+    transient insert-ref protecting a just-allocated node until its
+    gather program is dispatched; ``tick`` is the LRU clock."""
+    __slots__ = ("children", "parent", "key", "block", "refs", "tick")
+
+    def __init__(self, parent, key, block):
+        self.children: dict[bytes, _PrefixNode] = {}
+        self.parent = parent
+        self.key = key
+        self.block = block
+        self.refs = 0
+        self.tick = 0
+
+
+class PrefixCache:
+    """Host-side bookkeeping for the shared prefix pool: a trie keyed on
+    chunk-sized token blocks + a block allocator with LRU eviction of
+    unreferenced leaves. Pure host data structure (device programs are
+    the SlotServer's job), so the ref-count/eviction contract is unit-
+    testable without a model.
+
+    Invariants:
+    - every trie node owns exactly one pool block; free blocks are owned
+      by nobody.
+    - eviction only ever takes a LEAF with refs == 0 (an interior node's
+      children are unreachable without it; a referenced node's block is
+      aliased by an admitted slot's pending copy). ``alloc`` returns None
+      when the budget is exhausted and nothing is evictable — callers
+      skip insertion rather than fail.
+    """
+
+    def __init__(self, n_blocks: int, chunk: int):
+        if n_blocks < 1:
+            raise ValueError(f"prefix cache needs >= 1 block, got {n_blocks}")
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.n_blocks = n_blocks
+        self.chunk = chunk
+        self.root = _PrefixNode(None, b"", -1)
+        self._free = list(range(n_blocks - 1, -1, -1))
+        self._owned: set[_PrefixNode] = set()
+        self._tick = 0
+        self.hits = 0           # admissions matching >= 1 chunk
+        self.misses = 0         # admissions matching none
+        self.evictions = 0
+        self.inserted_blocks = 0
+
+    @property
+    def blocks_used(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    def _touch(self, node: _PrefixNode) -> None:
+        self._tick += 1
+        node.tick = self._tick
+
+    def lookup(self, body: np.ndarray) -> list["_PrefixNode"]:
+        """Longest cached chunk-aligned prefix of ``body`` -> the matched
+        node path (block ids via node.block). Counts a hit/miss and
+        touches the path's LRU clocks; does NOT take refs (acquire)."""
+        node, path = self.root, []
+        c = self.chunk
+        for c0 in range(0, len(body) - c + 1, c):
+            child = node.children.get(body[c0:c0 + c].tobytes())
+            if child is None:
+                break
+            path.append(child)
+            node = child
+        for n in path:
+            self._touch(n)
+        if path:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return path
+
+    def acquire(self, path) -> None:
+        for n in path:
+            n.refs += 1
+
+    def release(self, path) -> None:
+        for n in path:
+            n.refs -= 1
+            assert n.refs >= 0, "prefix-cache ref underflow"
+
+    def _evict_one(self) -> int | None:
+        """Reclaim the least-recently-used unreferenced leaf's block."""
+        victim = None
+        for node in self._owned:
+            if node.children or node.refs > 0:
+                continue
+            if victim is None or node.tick < victim.tick:
+                victim = node
+        if victim is None:
+            return None
+        del victim.parent.children[victim.key]
+        self._owned.discard(victim)
+        self.evictions += 1
+        return victim.block
+
+    def alloc(self) -> int | None:
+        if self._free:
+            return self._free.pop()
+        return self._evict_one()
+
+    def insert(self, body: np.ndarray) -> list[tuple[int, "_PrefixNode"]]:
+        """Add ``body``'s full chunks to the trie, reusing existing nodes
+        (first writer wins — a burst-mate may have created them moments
+        ago) and allocating blocks for new ones. Returns only the NEW
+        (chunk_index, node) pairs (their blocks need the device gather);
+        each node carries one insert-ref the caller must ``release``
+        after dispatching it, so a later insert in the same burst can't
+        evict a block whose gather hasn't been dispatched yet. Stops
+        early (still a valid prefix chain) when the budget is
+        exhausted."""
+        node, created = self.root, []
+        c = self.chunk
+        for c0 in range(0, len(body) - c + 1, c):
+            key = body[c0:c0 + c].tobytes()
+            child = node.children.get(key)
+            if child is None:
+                block = self.alloc()
+                if block is None:
+                    break
+                child = _PrefixNode(node, key, block)
+                node.children[key] = child
+                self._owned.add(child)
+                child.refs = 1          # insert-ref, released post-dispatch
+                created.append((c0 // c, child))
+                self.inserted_blocks += 1
+            self._touch(child)
+            node = child
+        return created
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("shardings",),
+    donate_argnames=("cache",),
+)
+def _copy_prefix_blocks(pool, cache, slots, blocks, chunk_idx, offsets,
+                        *, shardings: DecodeShardings | None = None):
+    """Cache-hit path: scatter ``T`` pool blocks into their slots' rings —
+    row t copies pool block ``blocks[t]`` to slot ``slots[t]``'s ring
+    indices for logical positions [chunk_idx[t]*C, ..+C) (mod-M, so a
+    prefix spanning the ring boundary wraps exactly as prefill's writes
+    would). One dispatch per admission BURST: rows are padded to a power
+    of two with OUT-OF-BOUNDS slot ids whose writes drop, same as
+    `_prefill_batch`'s padding rows. Pure data movement — the copied
+    bytes are exactly what the cold prefill wrote (int8 pools carry the
+    quantized values + scales), so the hit path is token-identical."""
+    C = pool.k.shape[3]
+    m_cap = cache.k.shape[3]
+    n_blocks = pool.k.shape[1]
+    pos = chunk_idx[:, None] * C + jnp.arange(C)[None, :]       # [T, C]
+    ring_idx = (offsets[:, None] + pos) % m_cap
+    gb = jnp.minimum(blocks, n_blocks - 1)      # clamp pad rows for gather
+    swr = dict(unique_indices=True, mode="drop")
+    # gather [L, T, kvH, C(, D)] -> update layout [T, C, L, kvH(, D)]
+    # (advanced indices at axes 1 and 3 are separated by the kvH slice,
+    # so the broadcast dims lead)
+    ck = cache.k.at[:, slots[:, None], :, ring_idx, :].set(
+        pool.k[:, gb].transpose(1, 3, 0, 2, 4), **swr)
+    cv = cache.v.at[:, slots[:, None], :, ring_idx, :].set(
+        pool.v[:, gb].transpose(1, 3, 0, 2, 4), **swr)
+    ks_buf, vs_buf = cache.k_scale, cache.v_scale
+    if pool.k_scale is not None:
+        ks_buf = ks_buf.at[:, slots[:, None], :, ring_idx].set(
+            pool.k_scale[:, gb].transpose(1, 3, 0, 2), **swr)
+        vs_buf = vs_buf.at[:, slots[:, None], :, ring_idx].set(
+            pool.v_scale[:, gb].transpose(1, 3, 0, 2), **swr)
+    cache = KVCache(k=ck, v=cv, length=cache.length,
+                    k_scale=ks_buf, v_scale=vs_buf)
+    return _constrain_pool(shardings, cache)[0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("shardings",),
+    donate_argnames=("pool",),
+)
+def _insert_prefix_blocks(pool, cache, slots, blocks, chunk_idx, offsets,
+                          *, shardings: DecodeShardings | None = None):
+    """Trie insertion's device half: gather ``T`` freshly-prefilled
+    chunks out of their slots' rings into pool blocks — row t reads slot
+    ``slots[t]``'s ring at logical [chunk_idx[t]*C, ..+C) into block
+    ``blocks[t]``. Dispatched at admission immediately after the suffix
+    prefill (before any decode block can lay garbage over a frozen
+    ring); padding rows carry OUT-OF-BOUNDS block ids (writes drop) and
+    clamped slot ids (gather garbage nobody keeps)."""
+    C = pool.k.shape[3]
+    m_cap = cache.k.shape[3]
+    n_slots = cache.k.shape[1]
+    pos = chunk_idx[:, None] * C + jnp.arange(C)[None, :]       # [T, C]
+    ring_idx = (offsets[:, None] + pos) % m_cap
+    gs = jnp.minimum(slots, n_slots - 1)
+    swr = dict(unique_indices=True, mode="drop")
+    # gather -> [T, C, L, kvH(, D)]; pool wants [L, T, kvH, C(, D)]
+    pk = pool.k.at[:, blocks].set(
+        cache.k[:, gs[:, None], :, ring_idx, :].transpose(2, 0, 3, 1, 4),
+        **swr)
+    pv = pool.v.at[:, blocks].set(
+        cache.v[:, gs[:, None], :, ring_idx, :].transpose(2, 0, 3, 1, 4),
+        **swr)
+    pks, pvs = pool.k_scale, pool.v_scale
+    if pks is not None:
+        pks = pks.at[:, blocks].set(
+            cache.k_scale[:, gs[:, None], :, ring_idx].transpose(2, 0, 3, 1),
+            **swr)
+        pvs = pvs.at[:, blocks].set(
+            cache.v_scale[:, gs[:, None], :, ring_idx].transpose(2, 0, 3, 1),
+            **swr)
+    pool = PrefixPool(k=pk, v=pv, k_scale=pks, v_scale=pvs)
+    if shardings is not None:
+        c = lax.with_sharding_constraint
+        pool = PrefixPool(
+            k=c(pool.k, shardings.cache), v=c(pool.v, shardings.cache),
+            k_scale=(None if pool.k_scale is None
+                     else c(pool.k_scale, shardings.scale)),
+            v_scale=(None if pool.v_scale is None
+                     else c(pool.v_scale, shardings.scale)),
+        )
+    return pool
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "chunk", "kv_dtype", "finalize", "shardings"),
     donate_argnames=("cache", "d_tokens", "d_active", "d_target",
-                     "d_offsets", "d_temps"),
+                     "d_offsets", "d_temps", "d_topks"),
 )
 def _prefill_chunk(params, cache, d_tokens, d_active, d_target, d_offsets,
-                   d_temps, tokens, slot, start, offset, n_valid,
-                   last_token, target, temp,
+                   d_temps, d_topks, tokens, slot, start, offset, n_valid,
+                   last_token, target, temp, topk,
                    *, cfg: TransformerConfig, chunk: int, kv_dtype: str,
                    finalize: bool, shardings: DecodeShardings | None = None):
     """Feed ``chunk`` prompt tokens ([1, C], padded past n_valid) into slot
@@ -273,19 +555,20 @@ def _prefill_chunk(params, cache, d_tokens, d_active, d_target, d_offsets,
         d_target = d_target.at[slot].set(target)
         d_offsets = d_offsets.at[slot].set(offset)
         d_temps = d_temps.at[slot].set(temp)
+        d_topks = d_topks.at[slot].set(topk)
     return _constrain_pool(shardings, cache, d_tokens, d_active, d_target,
-                           d_offsets, d_temps)
+                           d_offsets, d_temps, d_topks)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "chunk", "kv_dtype", "shardings"),
     donate_argnames=("cache", "d_tokens", "d_active", "d_target",
-                     "d_offsets", "d_temps"),
+                     "d_offsets", "d_temps", "d_topks"),
 )
 def _prefill_batch(params, cache, d_tokens, d_active, d_target, d_offsets,
-                   d_temps, tokens, slots, starts, offsets, n_valids,
-                   last_tokens, targets, temps, fin,
+                   d_temps, d_topks, tokens, slots, starts, offsets, n_valids,
+                   last_tokens, targets, temps, topks, fin,
                    *, cfg: TransformerConfig, chunk: int, kv_dtype: str,
                    shardings: DecodeShardings | None = None):
     """Batched multi-slot admission: ONE dispatch feeds chunk tokens
@@ -376,21 +659,22 @@ def _prefill_batch(params, cache, d_tokens, d_active, d_target, d_offsets,
     d_target = d_target.at[commit].set(targets, **swr)
     d_offsets = d_offsets.at[commit].set(offsets, **swr)
     d_temps = d_temps.at[commit].set(temps, **swr)
+    d_topks = d_topks.at[commit].set(topks, **swr)
     return _constrain_pool(shardings, cache, d_tokens, d_active, d_target,
-                           d_offsets, d_temps)
+                           d_offsets, d_temps, d_topks)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "block", "stop_tokens", "pad_id",
-                     "top_k", "weight_dtype", "build_fused", "all_greedy",
-                     "shardings"),
+                     "top_k", "per_row_topk", "weight_dtype", "build_fused",
+                     "all_greedy", "shardings"),
     donate_argnames=("cache",),
 )
 def _decode_block(params, fused, cache, tokens, active, target_len,
-                  offsets, cursor, temps, key,
+                  offsets, cursor, temps, topks, key,
                   *, cfg: TransformerConfig, block: int, stop_tokens: tuple,
-                  pad_id: int, top_k: int,
+                  pad_id: int, top_k: int, per_row_topk: bool,
                   weight_dtype: str, build_fused: bool, all_greedy: bool,
                   shardings: DecodeShardings | None = None):
     """``block`` single-token decode steps for ALL slots under one scan.
@@ -420,11 +704,13 @@ def _decode_block(params, fused, cache, tokens, active, target_len,
             ring=(cursor, offsets), shardings=shardings)
         key, sub = jax.random.split(key)
         # per-ROW sampling: each slot decodes at its own request's
-        # temperature (0 = greedy), so mixed traffic shares one pool;
-        # all_greedy (static, host-known) compiles the argmax-only
-        # program instead of a discarded full-vocab categorical
+        # temperature (0 = greedy) and top_k, so mixed traffic shares one
+        # pool; all_greedy / per_row_topk (static, host-known) compile
+        # the argmax-only / static-threshold programs whenever no busy
+        # row actually needs the costlier variant
         nxt = sample_token(logits, sub,
-                           0.0 if all_greedy else temps, top_k)
+                           0.0 if all_greedy else temps,
+                           topks if per_row_topk else top_k)
         emitted = jnp.where(active, nxt, pad_id).astype(jnp.int32)
         # only rows active this step advance (staying ring-aligned with
         # the cursor); a frozen row keeps taking the shared-cursor garbage
@@ -482,7 +768,20 @@ class SlotServer:
     serialize K x chunks host dispatches in front of the next decode
     block. Output is exactly the per-slot path's (tested); False keeps
     the serial path (comparison/debugging). ``admission_dispatches``
-    counts prefill program dispatches either way."""
+    counts prefill program dispatches either way.
+
+    ``prefix_cache_blocks=N`` enables the chunk-aligned prefix cache
+    (module docstring): N ``prefill_chunk``-sized KV blocks in a shared
+    device pool (HBM budget = N x layers x kvH x chunk x head_dim x
+    kv-dtype bytes, x2 for K+V), a host trie mapping token blocks to
+    them, ref-counting while admitted requests hold their matched path,
+    LRU eviction of unreferenced leaves. Admission then prefills only
+    the uncached suffix of each prompt — token-identical completions
+    either way (including int8 kv, where the pool stores the quantized
+    bytes). ``cache_prompts`` is the server default for inserting
+    admitted prompts' chunks back into the trie; ``Request.cache_prompt``
+    overrides per request. 0 (default) disables the cache entirely.
+    ``stats()`` reports the counters."""
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
                  max_len: int = 2048, block_size: int = 16,
@@ -490,7 +789,8 @@ class SlotServer:
                  weight_dtype: str = "native", temperature: float = 0.0,
                  top_k: int = 0, stop_tokens: tuple = (), pad_id: int = 0,
                  seed: int = 0, pipeline_depth: int = 2,
-                 mesh=None, rules=None, batched_admission: bool = True):
+                 mesh=None, rules=None, batched_admission: bool = True,
+                 prefix_cache_blocks: int = 0, cache_prompts: bool = True):
         if not cfg.causal:
             raise ValueError("serving requires a causal model")
         if isinstance(params, DecodeWeights):
@@ -536,6 +836,11 @@ class SlotServer:
             self._shardings = _decode_shardings(mesh, rules)
         self.batched_admission = batched_admission
         self.admission_dispatches = 0   # prefill programs dispatched
+        # prefix-cache dispatch + token counters (stats())
+        self.prefix_copy_dispatches = 0
+        self.prefix_insert_dispatches = 0
+        self.prefill_tokens_computed = 0    # real (non-pad) prefill tokens
+        self.prefill_tokens_reused = 0      # served from the prefix pool
         self.cfg = moe_dropfree(cfg)
         self.slots = slots
         self.max_len = max_len
@@ -569,6 +874,7 @@ class SlotServer:
         # every active slot's next write is at the shared global cursor
         self._d_offsets = jnp.zeros((slots,), jnp.int32)
         self._d_temps = jnp.zeros((slots,), jnp.float32)  # per-request
+        self._d_topks = jnp.zeros((slots,), jnp.int32)    # per-request
         if self._shardings is not None:
             # commit the pool's initial layout so the first dispatch (and
             # every donated successor) already sits where the programs'
@@ -588,12 +894,42 @@ class SlotServer:
             self._d_target = jax.device_put(self._d_target, sh.act)
             self._d_offsets = jax.device_put(self._d_offsets, sh.act)
             self._d_temps = jax.device_put(self._d_temps, sh.act)
+            self._d_topks = jax.device_put(self._d_topks, sh.act)
             self._key = jax.device_put(
                 self._key, jax.sharding.NamedSharding(
                     mesh, jax.sharding.PartitionSpec()))
-        # host mirror of the admitted temps: when every busy slot is
-        # greedy, blocks dispatch the argmax-only program variant
+        # host mirrors of the admitted temps/top_ks: when every busy slot
+        # is greedy (or on the server-global k), blocks dispatch the
+        # argmax-only / static-threshold program variants
         self._np_temps = np.zeros((slots,), np.float32)
+        self._np_topks = np.full((slots,), self.top_k, np.int32)
+        # ---- chunk-aligned prefix cache (module docstring) ----
+        self.cache_prompts = cache_prompts
+        self._prefix_cache: PrefixCache | None = None
+        self._pool: PrefixPool | None = None
+        # request id -> matched trie path, ref-held until the completion
+        # is processed
+        self._prefix_refs: dict[int, list] = {}
+        if prefix_cache_blocks > 0:
+            n_blocks = prefix_cache_blocks
+            if mesh is not None:
+                # the pool's block axis shards where the slot axis does;
+                # round the budget up to a whole number of shards
+                t_b = _rule_size(mesh, rules, "batch")
+                n_blocks = -(-n_blocks // t_b) * t_b
+            self._prefix_cache = PrefixCache(n_blocks, prefill_chunk)
+            self._pool = init_prefix_pool(
+                self.cfg, n_blocks, prefill_chunk, kv_dtype)
+            if self._shardings is not None:
+                sh = self._shardings
+                self._pool = PrefixPool(
+                    k=jax.device_put(self._pool.k, sh.cache),
+                    v=jax.device_put(self._pool.v, sh.cache),
+                    k_scale=(None if self._pool.k_scale is None else
+                             jax.device_put(self._pool.k_scale, sh.scale)),
+                    v_scale=(None if self._pool.v_scale is None else
+                             jax.device_put(self._pool.v_scale, sh.scale)),
+                )
         self._cursor = 0        # host-tracked, advances block per dispatch
         # exact host model of the device slot state as of the NEWEST
         # dispatched block — usable for scheduling only in predictive mode
@@ -666,6 +1002,36 @@ class SlotServer:
         the view lags by up to pipeline_depth blocks)."""
         return int(self._host_busy.sum())
 
+    def stats(self) -> dict:
+        """Serving-load + prefix-cache counters, one flat snapshot (the
+        ServeApp /stats payload and MetricsAccumulator feed). Token
+        counters measure the prefill economy: ``prefill_tokens_reused``
+        never touched the MXU — they were copied out of the shared pool —
+        vs ``prefill_tokens_computed`` that ran the model."""
+        out = {
+            "slots": self.slots,
+            "active": self.n_active,
+            "queued": self.pending,
+            "max_len": self.max_len,
+            "block_size": self.block_size,
+            "admission_dispatches": self.admission_dispatches,
+            "prefill_tokens_computed": self.prefill_tokens_computed,
+            "prefill_tokens_reused": self.prefill_tokens_reused,
+        }
+        pc = self._prefix_cache
+        if pc is not None:
+            out["prefix_cache"] = {
+                "hits": pc.hits,
+                "misses": pc.misses,
+                "evictions": pc.evictions,
+                "inserted_blocks": pc.inserted_blocks,
+                "blocks_used": pc.blocks_used,
+                "blocks_total": pc.n_blocks,
+                "copy_dispatches": self.prefix_copy_dispatches,
+                "insert_dispatches": self.prefix_insert_dispatches,
+            }
+        return out
+
     # ----------------------------------------------------------- the loop
 
     def _free_for_admission(self, slot: int) -> bool:
@@ -685,11 +1051,19 @@ class SlotServer:
 
         The whole burst of admissible (slot, request) pairs is collected
         FIRST — every pair's ring offset derives from the same cursor, so
-        batching changes no layout decision — then dispatched either as
-        one `_prefill_batch` program per chunk round (default) or as the
-        serial per-slot chunk loop (``batched_admission=False``)."""
+        batching changes no layout decision — then dispatched in three
+        phases whose device order is the correctness contract: (1) copy
+        cached prefix blocks into the slot rings (one batched program),
+        (2) prefill each request's uncached suffix (one `_prefill_batch`
+        program per chunk round by default, or the serial per-slot chunk
+        loop with ``batched_admission=False``), (3) gather the burst's
+        new full-body chunks into fresh pool blocks (one batched
+        program). Prefix lookups all run against the trie as of the
+        burst start — a same-burst template twin prefills too (its copy
+        would otherwise be dispatched before the twin's insert) — so
+        sharing begins one burst after a template first appears."""
         C = self.prefill_chunk
-        admissions = []     # (slot, req, body, offset, target, temp, starts)
+        admissions: list[_Admission] = []
         for slot in range(self.slots):
             if not self._queue:
                 break
@@ -711,31 +1085,115 @@ class SlotServer:
             target = body.size + req.max_new_tokens
             temp = (self.temperature if req.temperature is None
                     else float(req.temperature))
-            chunk_starts = (list(range(0, body.size, C)) or [0])
-            admissions.append(
-                (slot, req, body, offset, target, temp, chunk_starts))
+            topk = (self.top_k if req.top_k is None else int(req.top_k))
+            prefix_len, path = 0, []
+            if self._prefix_cache is not None:
+                path = self._prefix_cache.lookup(body)
+                prefix_len = len(path) * C
+                if path:
+                    # path blocks stay pinned (unevictable) until this
+                    # request's completion is processed
+                    self._prefix_cache.acquire(path)
+                    self.prefill_tokens_reused += prefix_len
+            chunk_starts = (list(range(prefix_len, body.size, C))
+                            or [prefix_len])
+            admissions.append(_Admission(
+                slot=slot, req=req, body=body, offset=offset, target=target,
+                temp=temp, topk=topk, chunk_starts=chunk_starts,
+                prefix_len=prefix_len, hit_path=path))
         if not admissions:
             return
+        self._dispatch_prefix_copy(admissions)
         if self.batched_admission and len(admissions) > 1:
             self._prefill_burst(admissions)
         else:
             for adm in admissions:
                 self._prefill_one(adm)
-        for slot, req, body, offset, target, temp, _ in admissions:
+        self._dispatch_prefix_insert(admissions)
+        for adm in admissions:
+            slot, req, body = adm.slot, adm.req, adm.body
             self._host_busy[slot] = True
-            self._np_temps[slot] = temp
+            self._np_temps[slot] = adm.temp
+            self._np_topks[slot] = adm.topk
             self._model_len[slot] = body.size
             self._model_active[slot] = True
-            self._model_target[slot] = target
+            self._model_target[slot] = adm.target
+            if adm.hit_path:
+                self._prefix_refs[req.id] = adm.hit_path
             admit = (slot, body.size, req)
             if self._pipeline:
                 self._pipeline[-1]["admits"].append(admit)
             else:                       # nothing in flight: applies now
                 self._apply_admit(admit)
 
-    def _prefill_one(self, adm) -> None:
-        """Serial admission: one `_prefill_chunk` dispatch per chunk."""
-        slot, req, body, offset, target, temp, chunk_starts = adm
+    def _dispatch_prefix_copy(self, admissions) -> None:
+        """Phase 1 of admission: ONE `_copy_prefix_blocks` dispatch moves
+        every matched pool block of the burst into its slot's ring (rows
+        padded to a power of two; pad rows write nowhere). Must precede
+        the suffix prefill, whose attention reads the copied prefix."""
+        rows = [(a.slot, n.block, ci, a.offset)
+                for a in admissions for ci, n in enumerate(a.hit_path)]
+        if not rows:
+            return
+        self._cache = _copy_prefix_blocks(
+            self._pool, self._cache, *self._prefix_rows(rows, oob="slot"),
+            shardings=self._shardings)
+        self.prefix_copy_dispatches += 1
+
+    def _dispatch_prefix_insert(self, admissions) -> None:
+        """Phase 3 of admission: insert the burst's new full-body chunks
+        into the trie and gather their just-prefilled KV out of the slot
+        rings into pool blocks — ONE `_insert_prefix_blocks` dispatch.
+        Runs strictly after the suffix prefill (the data source) and
+        before any later decode block (whose shared-cursor garbage
+        writes would eventually lap a frozen ring)."""
+        if self._prefix_cache is None:
+            return
+        rows, created = [], []
+        for a in admissions:
+            want = (self.cache_prompts if a.req.cache_prompt is None
+                    else a.req.cache_prompt)
+            if not want:
+                continue
+            for ci, node in self._prefix_cache.insert(a.body):
+                rows.append((a.slot, node.block, ci, a.offset))
+                created.append(node)
+        if rows:
+            self._pool = _insert_prefix_blocks(
+                self._pool, self._cache,
+                *self._prefix_rows(rows, oob="block"),
+                shardings=self._shardings)
+            self.prefix_insert_dispatches += 1
+        if created:     # insert-refs protected the blocks until dispatch
+            self._prefix_cache.release(created)
+
+    def _prefix_rows(self, rows, *, oob: str):
+        """(slot, block, chunk_idx, offset) rows -> padded device arrays
+        for the copy/insert programs. Pad rows divert the WRITE index out
+        of bounds (the destination axis named by ``oob``) so their writes
+        drop, and leave the other (gather) index at 0 — `jnp.minimum`
+        clamping in the programs keeps gathers in range anyway."""
+        n = len(rows)
+        k_rows = 1 << (n - 1).bit_length() if n > 1 else 1
+        slots = np.zeros(k_rows, np.int32)
+        blocks = np.zeros(k_rows, np.int32)
+        chunk_idx = np.zeros(k_rows, np.int32)
+        offsets = np.zeros(k_rows, np.int32)
+        if oob == "slot":
+            slots[:] = self.slots + np.arange(k_rows, dtype=np.int32)
+        else:
+            blocks[:] = (self._prefix_cache.n_blocks
+                         + np.arange(k_rows, dtype=np.int32))
+        for r, (s, b, ci, off) in enumerate(rows):
+            slots[r], blocks[r], chunk_idx[r], offsets[r] = s, b, ci, off
+        return (jnp.asarray(slots), jnp.asarray(blocks),
+                jnp.asarray(chunk_idx), jnp.asarray(offsets))
+
+    def _prefill_one(self, adm: _Admission) -> None:
+        """Serial admission: one `_prefill_chunk` dispatch per chunk (of
+        the uncached suffix — chunk_starts begins at the cached prefix
+        length)."""
+        body, chunk_starts = adm.body, adm.chunk_starts
         C = self.prefill_chunk
         for c0 in chunk_starts:
             n_valid = max(0, min(C, body.size - c0))
@@ -744,17 +1202,18 @@ class SlotServer:
             final = c0 == chunk_starts[-1]
             (self._cache, self._d_tokens, self._d_active,
              self._d_target, self._d_offsets,
-             self._d_temps) = _prefill_chunk(
+             self._d_temps, self._d_topks) = _prefill_chunk(
                 self._params, self._cache, self._d_tokens,
                 self._d_active, self._d_target, self._d_offsets,
-                self._d_temps,
-                jnp.asarray(chunk), jnp.int32(slot), jnp.int32(c0),
-                jnp.int32(offset), jnp.int32(n_valid),
-                jnp.int32(int(req.prompt[-1])), jnp.int32(target),
-                jnp.float32(temp),
+                self._d_temps, self._d_topks,
+                jnp.asarray(chunk), jnp.int32(adm.slot), jnp.int32(c0),
+                jnp.int32(adm.offset), jnp.int32(n_valid),
+                jnp.int32(int(adm.req.prompt[-1])), jnp.int32(adm.target),
+                jnp.float32(adm.temp), jnp.int32(adm.topk),
                 cfg=self.cfg, chunk=C, kv_dtype=self.kv_dtype,
                 finalize=final, shardings=self._shardings)
             self.admission_dispatches += 1
+            self.prefill_tokens_computed += n_valid
 
     def _prefill_burst(self, admissions) -> None:
         """Batched admission: chunk round r of EVERY admitted request in
@@ -766,7 +1225,7 @@ class SlotServer:
         C = self.prefill_chunk
         n = len(admissions)
         k_rows = 1 << (n - 1).bit_length()
-        rounds = max(len(a[6]) for a in admissions)
+        rounds = max(len(a.chunk_starts) for a in admissions)
         S = self.slots
         for r in range(rounds):
             tokens = np.zeros((k_rows, C), np.int32)
@@ -777,33 +1236,36 @@ class SlotServer:
             lasts = np.zeros(k_rows, np.int32)
             targets = np.zeros(k_rows, np.int32)
             temps = np.zeros(k_rows, np.float32)
+            topks = np.zeros(k_rows, np.int32)
             fin = np.zeros(k_rows, bool)
-            for row, (slot, req, body, offset, target, temp,
-                      chunk_starts) in enumerate(admissions):
+            for row, adm in enumerate(admissions):
+                chunk_starts, body = adm.chunk_starts, adm.body
                 if r >= len(chunk_starts):
                     continue            # this prompt has no chunk round r
                 c0 = chunk_starts[r]
                 nv = max(0, min(C, body.size - c0))
                 tokens[row, :nv] = body[c0:c0 + nv]
-                slots[row] = slot
+                slots[row] = adm.slot
                 starts[row] = c0
-                offsets[row] = offset
+                offsets[row] = adm.offset
                 n_valids[row] = nv
-                lasts[row] = int(req.prompt[-1])
-                targets[row] = target
-                temps[row] = temp
+                lasts[row] = int(adm.req.prompt[-1])
+                targets[row] = adm.target
+                temps[row] = adm.temp
+                topks[row] = adm.topk
                 fin[row] = r == len(chunk_starts) - 1
+                self.prefill_tokens_computed += nv
             (self._cache, self._d_tokens, self._d_active,
              self._d_target, self._d_offsets,
-             self._d_temps) = _prefill_batch(
+             self._d_temps, self._d_topks) = _prefill_batch(
                 self._params, self._cache, self._d_tokens,
                 self._d_active, self._d_target, self._d_offsets,
-                self._d_temps,
+                self._d_temps, self._d_topks,
                 jnp.asarray(tokens), jnp.asarray(slots),
                 jnp.asarray(starts), jnp.asarray(offsets),
                 jnp.asarray(n_valids), jnp.asarray(lasts),
                 jnp.asarray(targets), jnp.asarray(temps),
-                jnp.asarray(fin),
+                jnp.asarray(topks), jnp.asarray(fin),
                 cfg=self.cfg, chunk=C, kv_dtype=self.kv_dtype,
                 shardings=self._shardings)
             self.admission_dispatches += 1
@@ -820,13 +1282,17 @@ class SlotServer:
         (self._cache, self._d_tokens, self._d_active, packed) = _decode_block(
             self._params, self._fused, self._cache,
             self._d_tokens, self._d_active, self._d_target,
-            self._d_offsets, jnp.int32(self._cursor), self._d_temps, sub,
+            self._d_offsets, jnp.int32(self._cursor), self._d_temps,
+            self._d_topks, sub,
             cfg=self.cfg, block=self.block_size,
             stop_tokens=self.stop_tokens, pad_id=self.pad_id,
             top_k=self.top_k,
-            weight_dtype=self.weight_dtype, build_fused=self._build_fused,
             # _host_busy never goes False while a row is still active on
-            # device, so this is safe whenever it says all-greedy
+            # device, so these are safe whenever they say all-greedy /
+            # nobody-overrides-k
+            per_row_topk=bool(
+                (self._np_topks[self._host_busy] != self.top_k).any()),
+            weight_dtype=self.weight_dtype, build_fused=self._build_fused,
             all_greedy=not bool(
                 (self._np_temps[self._host_busy] > 0).any()),
             shardings=self._shardings)
@@ -869,6 +1335,9 @@ class SlotServer:
                     self._requests[slot] = None
                     self._emitted[slot] = []
                     self._host_busy[slot] = False
+                    path = self._prefix_refs.pop(req.id, None)
+                    if path is not None:    # unpin the matched trie path
+                        self._prefix_cache.release(path)
             self._expect_len = np.array(lengths)
             self._expect_active = np.array(active)
             for admit in rec["admits"]:
@@ -928,4 +1397,4 @@ class SlotServer:
         return out
 
 
-__all__ = ["Request", "Completion", "SlotServer"]
+__all__ = ["Request", "Completion", "SlotServer", "PrefixCache"]
