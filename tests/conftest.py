@@ -27,6 +27,52 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------- watchdog
+# Per-test watchdog: a HUNG test (a serving-loop deadlock, a waiter that
+# never wakes) must fail fast with a stack trace of every thread instead of
+# silently eating the tier-1 gate's whole 870s budget. faulthandler's timer
+# dumps all thread stacks and hard-exits the process — blunt, but a hang
+# has no cooperative way out, and the dump names the guilty frame.
+# Budget: TONY_TEST_WATCHDOG_S env (0 disables); @pytest.mark.slow tests
+# (compile-bound, excluded from tier-1) get 3x.
+
+import faulthandler  # noqa: E402
+
+try:
+    _WATCHDOG_S = float(os.environ.get("TONY_TEST_WATCHDOG_S", "300"))
+except ValueError:      # bad knob degrades to the default, never aborts
+    _WATCHDOG_S = 300.0
+
+
+def _watchdog_budget(item) -> float:
+    if _WATCHDOG_S <= 0:
+        return 0.0
+    mult = 3.0 if item.get_closest_marker("slow") else 1.0
+    return _WATCHDOG_S * mult
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_runtest_setup(item):
+    # tryfirst: arm before the runner starts fixture setup, so a hang
+    # INSIDE a fixture is covered too
+    budget = _watchdog_budget(item)
+    if budget > 0:
+        faulthandler.dump_traceback_later(budget, exit=True)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item, nextitem):
+    # wrapper: the watchdog stays armed THROUGH fixture finalizers (a
+    # hang in e.g. a deadlocked ServeApp.shutdown is covered) and the
+    # finally-cancel runs even when a finalizer raises — a plain trylast
+    # impl would be skipped by the re-raise, leaving the hard-exit timer
+    # live into session teardown
+    try:
+        yield
+    finally:
+        if _WATCHDOG_S > 0:
+            faulthandler.cancel_dump_traceback_later()
+
 
 @pytest.fixture
 def tmp_job_dirs(tmp_path):

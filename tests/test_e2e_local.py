@@ -931,6 +931,35 @@ def test_per_task_restart_within_session(tmp_job_dirs, fixture_script, tmp_path)
     assert marker.exists()
 
 
+def test_heartbeat_death_consumes_restart_budget(
+        tmp_job_dirs, fixture_script, tmp_path):
+    """A hung executor (heartbeat expiry) is a RESTARTABLE failure: it
+    must route through the per-task restart budget before failing the
+    job — the seed behavior called session._fail on the first expiry
+    even with tony.<role>.max-restarts attempts left. Every attempt here
+    hangs (the skip-all-heartbeats knob rides the role env), so the
+    driver should burn 1 + max-restarts launches and only then fail
+    with the heartbeat message — and the killed attempts' container
+    completions must not double-spend the budget."""
+    attempts = tmp_path / "attempts"
+    cmd = (f"bash -c 'echo launch >> {attempts}; "
+           f"exec {PY} {fixture_script('sleep_long.py')}'")
+    status, client = run_job(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 1,
+           "tony.worker.command": cmd,
+           "tony.worker.max-restarts": 2,
+           "tony.task.heartbeat-interval-ms": 100,
+           "tony.task.max-missed-heartbeats": 5,
+           "tony.worker.env": "TONY_TEST_EXECUTOR_NUM_HB_MISS=1000"},
+    )
+    assert status == JobStatus.FAILED, dump_logs(client)
+    assert "heartbeat" in client.final_state.get("message", "")
+    # 1 original + exactly the 2 budgeted restarts reached the command
+    n = (len(attempts.read_text().splitlines()) if attempts.exists() else 0)
+    assert n == 3, (n, dump_logs(client))
+
+
 def test_driver_crash_reported_to_client(tmp_job_dirs, fixture_script):
     """Driver dies mid-run (reference TEST_AM_CRASH,
     ApplicationMaster.java:382-393); the client must detect and not hang."""

@@ -33,8 +33,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import select
+import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import metrics as _metrics
 
 
 def build_argparser() -> argparse.ArgumentParser:
@@ -88,6 +93,21 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="with --prefix-cache-blocks: serve FROM the cache "
                         "but never insert admitted prompts into it unless "
                         "a request sets cache_prompt=true explicitly")
+    p.add_argument("--max-queue", type=int, default=0,
+                   help="bound the wait queue: requests beyond this many "
+                        "waiting are shed with HTTP 429 + Retry-After "
+                        "instead of queueing past their deadlines "
+                        "(0 = unbounded)")
+    p.add_argument("--loop-max-restarts", type=int, default=3,
+                   help="serving-loop recovery budget: consecutive step "
+                        "failures tolerated (each one resets the slot "
+                        "state and restarts under exponential backoff) "
+                        "before /healthz flips to 503")
+    p.add_argument("--loop-backoff-s", type=float, default=0.5,
+                   help="base of the exponential restart backoff")
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="SIGTERM/SIGINT graceful drain: how long in-"
+                        "flight requests get to finish before shutdown")
     return p
 
 
@@ -170,35 +190,88 @@ class ServeApp:
     SlotServer (it is not thread-safe); HTTP threads enqueue under it and
     block on a per-request event the loop thread sets at completion.
 
-    If a step raises, the loop does NOT die silently with requests left
-    hanging until their timeouts: the error is logged, every pending
-    request's event is failed with it, the app is marked unhealthy
-    (``/healthz`` reports 503 + the error), and new submissions are
-    rejected immediately."""
+    Failure model (docs/serving.md "Failure model"): a step failure is
+    NOT terminal. The loop fails only the requests whose in-flight work
+    died, re-arms the slot state via ``SlotServer.reset()`` (weights
+    untouched), and restarts under an exponential-backoff budget of
+    ``max_loop_restarts`` CONSECUTIVE failures (a successful scheduling
+    turn re-arms the streak). ``/healthz`` reports ``degraded`` while a
+    restart is pending and flips to 503 ``down`` only when the budget is
+    exhausted (or the engine has no ``reset()``) — at which point every
+    waiter is failed immediately and new submissions are rejected.
+    ``shutdown(drain=True)`` stops admission, fails queued-but-unstarted
+    requests with a clear error, and lets in-flight slots finish up to a
+    drain deadline. A waiter that gives up (``generate`` timeout, HTTP
+    client gone) actively CANCELS its request so dead work stops burning
+    decode steps."""
 
-    def __init__(self, server):
+    def __init__(self, server, *, max_loop_restarts: int = 3,
+                 loop_backoff_s: float = 0.5):
         from ..metrics import MetricsAccumulator
 
         self.server = server            # SlotServer
         self.lock = threading.Lock()
         self.wake = threading.Event()
         self.stop = threading.Event()
-        self.healthy = True
+        self.status = "ok"              # "ok" | "degraded" | "down"
+        self.draining = False
         self.error: str | None = None
+        self.max_loop_restarts = max_loop_restarts
+        self.loop_backoff_s = loop_backoff_s
+        self.loop_failures = 0          # step exceptions, cumulative
+        self.loop_restarts = 0          # successful reset+restart cycles
+        self._restart_streak = 0        # consecutive failures (the budget)
         self._events: dict[int, threading.Event] = {}
         self._results: dict[int, object] = {}
         # serving-load gauges (active slots, queue depth, reused-token
-        # fraction) accumulated the same way TaskMonitor accumulates
-        # executor metrics — snapshot rides /stats so the portal/history
-        # layer sees serving load next to the resource metrics
+        # fraction, shed/cancelled/expired/restart counters) accumulated
+        # the same way TaskMonitor accumulates executor metrics —
+        # snapshot rides /stats so the portal/history layer sees serving
+        # load next to the resource metrics
         self.metrics = MetricsAccumulator()
         self.thread = threading.Thread(
             target=self._loop, name="serve-loop", daemon=True)
 
+    @property
+    def healthy(self) -> bool:
+        """Mirrors the /healthz bool (see ``health()``): degraded still
+        serves (requests queue through a restart), but ``down`` and
+        ``draining`` are both out of rotation."""
+        return self.status != "down" and not self.draining
+
     def start(self):
         self.thread.start()
 
-    def shutdown(self):
+    def shutdown(self, drain: bool = False, drain_timeout_s: float = 30.0):
+        """Stop the loop. ``drain=True`` first parks admission, fails
+        queued-but-unstarted requests with a clear error, and waits (up
+        to ``drain_timeout_s``) for every in-flight waiter to be answered
+        — a supervisor's SIGTERM then never kills a request mid-decode."""
+        if drain and self.thread.is_alive() and self.status != "down":
+            with self.lock:
+                self.draining = True
+                if hasattr(self.server, "pause_admission"):
+                    self.server.pause_admission = True
+                fail_queued = getattr(self.server, "fail_queued", None)
+                for req in (fail_queued() if callable(fail_queued) else []):
+                    ev = self._events.pop(req.id, None)
+                    if ev is not None:
+                        self._results[req.id] = ServingLoopError(
+                            f"request {req.id} failed: server shutting "
+                            "down before it was admitted")
+                        ev.set()
+            deadline = time.monotonic() + drain_timeout_s
+            while time.monotonic() < deadline:
+                with self.lock:
+                    if (not self._events
+                            and getattr(self.server, "n_active", 0) == 0):
+                        break
+                time.sleep(0.05)
+            with self.lock:
+                if self._events:    # drain deadline exceeded: fail loudly
+                    self._fail_pending(RuntimeError(
+                        f"shutdown drain deadline ({drain_timeout_s}s) "
+                        "exceeded"))
         self.stop.set()
         self.wake.set()
         self.thread.join(timeout=10)
@@ -215,99 +288,235 @@ class ServeApp:
     def _loop(self):
         while not self.stop.is_set():
             try:
-                with self.lock:
-                    busy = not self.server.idle
-                    done = {}
-                    if busy:
-                        self.server.step()
-                        # only drain when something is (or is known to be)
-                        # finished: in predictive mode drain_completed
-                        # forces a device sync, which called every tick
-                        # would serialize compute with the host round trip
-                        if self.server.completions_ready:
-                            done = self.server.drain_completed()
-                        self._observe_load()
+                self._serve()
+                return                  # clean stop
             except Exception as e:
-                import traceback
+                if not self._recover(e):
+                    return              # terminally down
 
-                print("serving loop failed; marking unhealthy:\n"
-                      + traceback.format_exc(), flush=True)
-                # flip unhealthy and fail waiters UNDER the lock: a
-                # generate() thread either registered its event before
-                # this (it gets failed here) or checks healthy after
-                # (it raises instead of submitting into a dead loop) —
-                # no window where a request hangs to its timeout
-                with self.lock:
-                    self.healthy = False
-                    self.error = f"{type(e).__name__}: {e}"
-                    self._fail_pending(e)
-                return
+    def _serve(self):
+        """The inner serving loop; any exception out of here is a step
+        failure handed to _recover."""
+        # recovery attestation: a turn only proves the engine recovered
+        # when it actually TOUCHED the device — the dispatch counters
+        # moved. Idle passes, drain-only turns, and expired-sweep-only
+        # turns prove nothing; re-arming on them would let a permanently
+        # broken engine fail sparse requests one at a time forever
+        # without ever exhausting the budget (or flipping /healthz).
+        # Engines without the counters (test stubs) fall back to "had
+        # work to do" (active slots or a queue) observed pre-step.
+        has_ctrs = hasattr(self.server, "blocks_dispatched")
+
+        def dispatch_ctrs():
+            return (getattr(self.server, "admission_dispatches", 0),
+                    getattr(self.server, "blocks_dispatched", 0))
+
+        while not self.stop.is_set():
+            with self.lock:
+                busy = not self.server.idle
+                attests = (getattr(self.server, "n_active", 1) > 0
+                           or getattr(self.server, "pending", 1) > 0)
+                pre = dispatch_ctrs()
+                done = {}
+                if busy:
+                    self.server.step()
+                    # only drain when something is (or is known to be)
+                    # finished: in predictive mode drain_completed
+                    # forces a device sync, which called every tick
+                    # would serialize compute with the host round trip
+                    if self.server.completions_ready:
+                        done = self.server.drain_completed()
+                    self._observe_load()
+                if has_ctrs:
+                    attests = dispatch_ctrs() != pre
+                if busy and attests and self.status == "degraded":
+                    # a real device dispatch survived: recovery complete,
+                    # the failure streak, its backoff, and the sticky
+                    # error message re-arm
+                    self.status = "ok"
+                    self._restart_streak = 0
+                    self.error = None
             if done:
-                # deliver under the lock so this can't interleave with a
-                # waiter's timeout cleanup (event popped here, then the
-                # waiter clears _results, then the store below lands and
-                # leaks) — atomically: either the waiter cleaned up first
-                # (ev is None, completion dropped) or the store+set land
-                # before the waiter's cleanup pops both
-                with self.lock:
-                    for rid, comp in done.items():
-                        ev = self._events.pop(rid, None)
-                        if ev is not None:
-                            # no waiter (timed out / failed submit): drop
-                            # the completion instead of growing _results
-                            # forever
-                            self._results[rid] = comp
-                            ev.set()
+                self._deliver(done)
             if not busy:
                 self.wake.wait(0.02)
                 self.wake.clear()
+
+    def _deliver(self, done: dict) -> None:
+        # deliver under the lock so this can't interleave with a
+        # waiter's timeout cleanup (event popped here, then the
+        # waiter clears _results, then the store below lands and
+        # leaks) — atomically: either the waiter cleaned up first
+        # (ev is None, completion dropped) or the store+set land
+        # before the waiter's cleanup pops both
+        with self.lock:
+            for rid, comp in done.items():
+                ev = self._events.pop(rid, None)
+                if ev is None:
+                    # no waiter (timed out / cancelled / failed submit):
+                    # drop the completion instead of growing _results
+                    continue
+                if getattr(comp, "finish_reason", None) == "expired":
+                    # the deadline passed while queued; the waiter gets
+                    # the timeout it already paid for, as an error — not
+                    # a 200 with zero tokens
+                    self._results[rid] = TimeoutError(
+                        f"request {rid} expired in queue before admission")
+                else:
+                    self._results[rid] = comp
+                ev.set()
+
+    def _recover(self, exc: Exception) -> bool:
+        """Handle a serving-loop failure: reset the engine and report
+        True to restart, or flip terminally down and report False."""
+        import traceback
+
+        print("serving loop failed:\n" + traceback.format_exc(),
+              flush=True)
+        with self.lock:
+            self.loop_failures += 1
+            self._restart_streak += 1
+            self.error = f"{type(exc).__name__}: {exc}"
+            reset = getattr(self.server, "reset", None)
+            if not callable(reset):
+                self.status = "down"
+                self._fail_pending(exc)
+                return False
+            if self._restart_streak > self.max_loop_restarts:
+                self.status = "down"
+                self.error += (f" (restart budget of "
+                               f"{self.max_loop_restarts} exhausted)")
+                self._fail_pending(exc)
+                return False
+            self.status = "degraded"
+            try:
+                lost = reset()
+            except Exception as e2:
+                print("serving reset failed:\n" + traceback.format_exc(),
+                      flush=True)
+                self.status = "down"
+                self.error = f"reset failed: {type(e2).__name__}: {e2}"
+                self._fail_pending(e2)
+                return False
+            # fail ONLY the requests whose in-flight work died with the
+            # ring; queued waiters ride through the restart untouched
+            for rid in lost:
+                ev = self._events.pop(rid, None)
+                if ev is not None:
+                    self._results[rid] = ServingLoopError(
+                        f"request {rid} lost to a serving-loop failure: "
+                        f"{self.error}")
+                    ev.set()
+            self.loop_restarts += 1
+            backoff = min(
+                self.loop_backoff_s * (2 ** (self._restart_streak - 1)),
+                10.0)
+        # exponential backoff OUTSIDE the lock (waiters must be able to
+        # time out / submit while we sit out a flapping device)
+        return not self.stop.wait(backoff)
+
+    # ------------------------------------------------------------ requests
+
+    def submit_async(self, prompt, max_new_tokens: int,
+                     timeout: float = 600.0,
+                     temperature: float | None = None,
+                     top_k: int | None = None,
+                     cache_prompt: bool | None = None):
+        """Admission half of generate(): returns (request_id, event). The
+        request carries ``timeout`` as its queue deadline — if it is
+        still queued when the waiter would have given up, admission skips
+        it instead of decoding for nobody."""
+        from ..models.serving import Request
+
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      temperature=temperature, top_k=top_k,
+                      cache_prompt=cache_prompt,
+                      deadline=time.monotonic() + timeout)
+        ev = threading.Event()
+        try:
+            # health check + event registration + submit are ONE atomic
+            # step vs the loop's failure handler (which flips the status
+            # and fails registered events under this same lock)
+            with self.lock:
+                if self.status == "down":
+                    raise ServingLoopError(
+                        f"serving loop is down: {self.error}")
+                if self.draining:
+                    raise ServingLoopError(
+                        "server is draining; not accepting requests")
+                self._events[req.id] = ev
+                self.server.submit(req)     # may shed: QueueFullError
+        except Exception:
+            self._events.pop(req.id, None)   # rejected: no waiter to leak
+            raise
+        self.wake.set()
+        return req.id, ev
+
+    def take_result(self, request_id: int):
+        res = self._results.pop(request_id)
+        if isinstance(res, Exception):   # the loop failed this request
+            raise res
+        return res
+
+    def cancel(self, request_id: int) -> bool:
+        """The abandonment path: drop the waiter and stop the request
+        wherever it is (queued, prefilling, or mid-decode) so a dead
+        client's work stops burning decode steps in its slot."""
+        with self.lock:
+            self._events.pop(request_id, None)
+            self._results.pop(request_id, None)
+            srv_cancel = getattr(self.server, "cancel", None)
+            return bool(callable(srv_cancel) and srv_cancel(request_id))
 
     def generate(self, prompt, max_new_tokens: int, timeout: float = 600.0,
                  temperature: float | None = None,
                  top_k: int | None = None,
                  cache_prompt: bool | None = None):
-        from ..models.serving import Request
-
-        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
-                      temperature=temperature, top_k=top_k,
-                      cache_prompt=cache_prompt)
-        ev = threading.Event()
-        try:
-            # health check + event registration + submit are ONE atomic
-            # step vs the loop's failure handler (which flips healthy and
-            # fails registered events under this same lock)
-            with self.lock:
-                if not self.healthy:
-                    raise ServingLoopError(
-                        f"serving loop is down: {self.error}")
-                self._events[req.id] = ev
-                self.server.submit(req)
-        except Exception:
-            self._events.pop(req.id, None)   # rejected: no waiter to leak
-            raise
-        self.wake.set()
+        rid, ev = self.submit_async(
+            prompt, max_new_tokens, timeout=timeout,
+            temperature=temperature, top_k=top_k, cache_prompt=cache_prompt)
         if not ev.wait(timeout):
-            with self.lock:     # atomic vs the loop's locked delivery
-                self._events.pop(req.id, None)
-                self._results.pop(req.id, None)  # may have landed already
-            raise TimeoutError(f"request {req.id} timed out")
-        res = self._results.pop(req.id)
-        if isinstance(res, Exception):   # the loop failed this request
-            raise res
-        return res
+            self.cancel(rid)     # free the slot, don't decode for nobody
+            raise TimeoutError(
+                f"request {rid} timed out after {timeout}s; cancelled")
+        return self.take_result(rid)
+
+    # -------------------------------------------------------- observability
 
     def _observe_load(self) -> None:
         """Feed the serving-load gauges (called under the lock, once per
         scheduling turn — block-paced, so sampling is cheap)."""
-        self.metrics.observe("serving_active_slots",
-                             float(self.server.n_active))
-        self.metrics.observe("serving_queue_depth",
-                             float(self.server.pending))
+        m = self.metrics
+        m.observe(_metrics.SERVING_ACTIVE_SLOTS,
+                  float(self.server.n_active))
+        m.observe(_metrics.SERVING_QUEUE_DEPTH, float(self.server.pending))
         computed = getattr(self.server, "prefill_tokens_computed", 0)
         reused = getattr(self.server, "prefill_tokens_reused", 0)
         if computed + reused > 0:
-            self.metrics.observe("serving_prefill_reused_frac",
-                                 reused / (computed + reused))
+            m.observe(_metrics.SERVING_PREFILL_REUSED_FRAC,
+                      reused / (computed + reused))
+        m.observe(_metrics.SERVING_SHED_TOTAL,
+                  float(getattr(self.server, "shed_requests", 0)))
+        m.observe(_metrics.SERVING_CANCELLED_TOTAL,
+                  float(getattr(self.server, "cancelled_requests", 0)))
+        m.observe(_metrics.SERVING_EXPIRED_TOTAL,
+                  float(getattr(self.server, "expired_requests", 0)))
+        m.observe(_metrics.SERVING_LOOP_RESTARTS,
+                  float(self.loop_restarts))
+
+    def health(self) -> dict:
+        """The /healthz payload: ``status`` is the lifecycle word
+        (ok/degraded/draining/down), ``healthy`` the load-balancer bool.
+        Draining reports UNhealthy: the whole point of a graceful drain
+        is that the balancer stops routing here while in-flight requests
+        finish — a 200 would feed it traffic that only ever sees 503s.
+        Degraded stays healthy: the server still accepts and queues."""
+        with self.lock:
+            status = ("draining" if self.draining and self.status != "down"
+                      else self.status)
+            return {"healthy": self.healthy, "status": status,
+                    "error": self.error,
+                    "loop_restarts": self.loop_restarts}
 
     def stats(self) -> dict:
         with self.lock:
@@ -321,6 +530,12 @@ class ServeApp:
                     "max_len": self.server.max_len,
                     "block_size": self.server.block_size,
                 }
+            out["loop"] = {
+                "status": self.status,
+                "restarts": self.loop_restarts,
+                "failures": self.loop_failures,
+                "max_restarts": self.max_loop_restarts,
+            }
             out["metrics"] = self.metrics.snapshot()
             return out
 
@@ -330,20 +545,36 @@ def make_handler(app: ServeApp):
         def log_message(self, *a):      # quiet; the loop is the log story
             pass
 
-        def _send(self, code: int, obj: dict):
+        def _send(self, code: int, obj: dict, headers: dict | None = None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
+        def _client_gone(self) -> bool:
+            """True when the client hung up while we wait on its
+            completion — a peeked EOF on the connection. A client with
+            pipelined bytes still pending reads as alive. Known
+            limitation (shared with asgi-style disconnect detection): a
+            client that half-closes its send side after the request
+            (shutdown(SHUT_WR)) delivers the same EOF and is treated as
+            gone — don't half-close if you want the response."""
+            try:
+                r, _, _ = select.select([self.connection], [], [], 0)
+                if not r:
+                    return False
+                return self.connection.recv(1, socket.MSG_PEEK) == b""
+            except OSError:
+                return True
+
         def do_GET(self):
             if self.path == "/healthz":
-                if app.healthy:
-                    self._send(200, {"healthy": True})
-                else:
-                    self._send(503, {"healthy": False, "error": app.error})
+                payload = app.health()
+                self._send(200 if payload["healthy"] else 503, payload)
             elif self.path == "/stats":
                 self._send(200, app.stats())
             else:
@@ -353,6 +584,8 @@ def make_handler(app: ServeApp):
             if self.path != "/generate":
                 self._send(404, {"error": "unknown path"})
                 return
+            from ..models.serving import QueueFullError
+
             try:
                 n = int(self.headers.get("Content-Length", "0"))
                 payload = json.loads(self.rfile.read(n) or b"{}")
@@ -367,19 +600,56 @@ def make_handler(app: ServeApp):
                     # string opt-out into caching the prompt
                     raise ValueError(
                         "cache_prompt must be a JSON boolean")
-                comp = app.generate(
-                    prompt, max_new,
+                timeout = float(payload.get("timeout_s", 600.0))
+                # NaN/Infinity pass float() and json.loads: a NaN
+                # deadline compares False forever, silently disabling
+                # both the 504 path and the queue-expiry sweep (NaN
+                # fails the chained comparison too)
+                if not 0 < timeout < float("inf"):
+                    raise ValueError(
+                        "timeout_s must be a positive finite number")
+                rid, ev = app.submit_async(
+                    prompt, max_new, timeout=timeout,
                     temperature=None if temp is None else float(temp),
                     top_k=None if top_k is None else int(top_k),
                     cache_prompt=cache_prompt)
-                self._send(200, {"id": comp.id, "tokens": comp.tokens,
-                                 "finish_reason": comp.finish_reason})
+            except QueueFullError as e:
+                # shed: the queue is full. 429 + Retry-After is the
+                # load-balancer contract — retry elsewhere/later instead
+                # of queueing into a deadline miss
+                self._send(429, {"error": str(e)},
+                           headers={"Retry-After": "1"})
+                return
             except ServingLoopError as e:
                 self._send(503, {"error": str(e)})
+                return
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": str(e)})
+                return
+            # wait in short beats so a vanished client is noticed and its
+            # request CANCELLED — the slot goes back to live traffic
+            # instead of decoding to completion for nobody
+            deadline = time.monotonic() + timeout
+            while not ev.wait(0.25):
+                if time.monotonic() >= deadline:
+                    app.cancel(rid)
+                    self._send(504, {"error": f"request {rid} timed out "
+                                     f"after {timeout}s; cancelled"})
+                    return
+                if self._client_gone():
+                    app.cancel(rid)     # abandonment: nobody to answer
+                    self.close_connection = True
+                    return
+            try:
+                comp = app.take_result(rid)
+            except ServingLoopError as e:
+                self._send(503, {"error": str(e)})
+                return
             except TimeoutError as e:
                 self._send(504, {"error": str(e)})
+                return
+            self._send(200, {"id": comp.id, "tokens": comp.tokens,
+                             "finish_reason": comp.finish_reason})
 
     return Handler
 
@@ -407,19 +677,47 @@ def main(argv=None) -> int:
         pad_id=args.pad_id, seed=args.seed,
         batched_admission=not args.per_slot_admission,
         prefix_cache_blocks=args.prefix_cache_blocks,
-        cache_prompts=not args.no_cache_prompts)
-    app = ServeApp(slot_server)
+        cache_prompts=not args.no_cache_prompts,
+        max_queue=args.max_queue)
+    app = ServeApp(slot_server, max_loop_restarts=args.loop_max_restarts,
+                   loop_backoff_s=args.loop_backoff_s)
     app.start()
     httpd = ThreadingHTTPServer((args.host, args.port), make_handler(app))
     print(f"serving {cfg.n_layers}L d{cfg.d_model} on "
           f"http://{args.host}:{httpd.server_address[1]} "
           f"({args.slots} slots x {args.max_len} tokens)", flush=True)
+
+    # graceful drain on SIGTERM/SIGINT: a supervisor's TERM must finish
+    # in-flight requests instead of killing them mid-decode. A foreground
+    # ^C reaches the same path; a SECOND signal force-exits. The drain
+    # runs on a helper thread — httpd.shutdown() deadlocks if called from
+    # the serve_forever thread, and signal handlers must return fast.
+    import os as _os
+    import signal as _signal
+
+    draining = threading.Event()
+
+    def _drain_and_stop():
+        app.shutdown(drain=True, drain_timeout_s=args.drain_timeout_s)
+        httpd.shutdown()
+
+    def _on_signal(signum, frame):
+        if draining.is_set():
+            print("second signal: exiting immediately", flush=True)
+            _os._exit(128 + signum)
+        draining.set()
+        print(f"signal {signum}: draining (finishing in-flight requests, "
+              f"up to {args.drain_timeout_s}s)", flush=True)
+        threading.Thread(target=_drain_and_stop, daemon=True).start()
+
+    _signal.signal(_signal.SIGTERM, _on_signal)
+    _signal.signal(_signal.SIGINT, _on_signal)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
-        app.shutdown()
+        app.shutdown()      # no-op after a completed drain
         httpd.server_close()
     return 0
 

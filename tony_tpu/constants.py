@@ -63,6 +63,17 @@ TEST_WORKER_TERMINATION = "TONY_TEST_WORKER_TERMINATION"    # comma list of task
                                                             # registers (reference
                                                             # AM:1338-1349)
 TEST_COMPLETION_DELAY_MS = "TONY_TEST_COMPLETION_NOTIFICATION_DELAY_MS"
+
+# serving-side chaos hooks (models/serving.py SlotServer; read once at
+# construction, seeded so a chaos run's fault sequence is reproducible):
+TEST_SERVING_DISPATCH_FAIL_RATE = "TONY_TEST_SERVING_DISPATCH_FAIL_RATE"
+#   probability in [0,1] that a scheduling turn raises like a real
+#   dispatch failure (device loss) — exercises the serve loop's
+#   reset/restart recovery path
+TEST_SERVING_STEP_DELAY_MS = "TONY_TEST_SERVING_STEP_DELAY_MS"
+#   added latency per scheduling turn: makes a fast test backend behave
+#   like a slow device so overload/shedding paths actually engage
+TEST_SERVING_CHAOS_SEED = "TONY_TEST_SERVING_CHAOS_SEED"
 TEST_ALLOCATION_HOLD = "TONY_TEST_ALLOCATION_HOLD"          # "role#idx" never gets
 #   capacity: the driver skips its launch so the gang waits — exercises the
 #   allocation-timeout deadlock breaker (reference MLGenericRuntime.java:110-147)

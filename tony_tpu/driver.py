@@ -198,6 +198,11 @@ class Driver:
         self._handles: dict[str, ContainerHandle] = {}  # task_id -> handle
         self._launch_ms: dict[str, int] = {}            # task_id -> launch time
         self._restarts: dict[str, int] = {}             # task_id -> restarts used
+        # serializes the two restart paths — container completion (watcher
+        # threads) and heartbeat expiry (monitor thread) — so a crash that
+        # coincides with heartbeat death can't double-spend the budget or
+        # kill the replacement the other path just launched
+        self._restart_lock = threading.Lock()
         self._retries_left = conf.get_int(keys.AM_RETRY_COUNT, 0)
         self._start_ms = now_ms()
 
@@ -385,7 +390,21 @@ class Driver:
             log.warning("fault injection: delaying completion of %s by %dms",
                         task_id, delay_ms)
             time.sleep(delay_ms / 1000)
-        self.on_task_result(task_id, exit_code, source="container")
+        # a superseded attempt's container (e.g. one killed after its
+        # heartbeat death already triggered an in-place restart) completes
+        # AFTER the replacement launched: its exit must not burn the new
+        # attempt's restart budget or fail the job out from under it.
+        # Guard + result handling run under the restart lock so the
+        # staleness read and any restart it triggers are atomic vs the
+        # monitor thread's heartbeat-expiry restart.
+        with self._restart_lock:
+            current = self._handles.get(task_id)
+            if current is None or current.container_id != handle.container_id:
+                log.info(
+                    "ignoring completion of superseded container %s for %s",
+                    handle.container_id, task_id)
+                return
+            self.on_task_result(task_id, exit_code, source="container")
 
     def on_task_result(self, task_id: str, exit_code: int, source: str) -> None:
         task = self.session.get_task_by_id(task_id)
@@ -424,10 +443,12 @@ class Driver:
             if self.scheduler:
                 self.scheduler.on_task_completed(name, exit_code == 0)
 
-    def _try_restart_task(self, task_id: str, exit_code: int) -> bool:
+    def _try_restart_task(self, task_id: str, exit_code: int,
+                          cause: str = "") -> bool:
         """Per-task restart within the same session — a recovery capability
         the reference lacks (it only supports whole-job AM retry,
-        SURVEY.md §5). Budgeted by tony.<role>.max-restarts."""
+        SURVEY.md §5). Budgeted by tony.<role>.max-restarts; both container
+        exits and heartbeat deaths (``cause``) spend from the same budget."""
         name, _, idx = task_id.partition(":")
         spec = self.session.role_specs.get(name)
         if spec is None or spec.max_restarts <= 0:
@@ -437,8 +458,9 @@ class Driver:
             return False
         self._restarts[task_id] = used + 1
         log.warning(
-            "task %s exited %d; restarting (%d/%d)",
-            task_id, exit_code, used + 1, spec.max_restarts,
+            "task %s %s; restarting (%d/%d)",
+            task_id, cause or f"exited {exit_code}",
+            used + 1, spec.max_restarts,
         )
         task = self.session.get_task_by_id(task_id)
         task.status = TaskStatus.REQUESTED
@@ -484,13 +506,54 @@ class Driver:
                 if task is None or task.status.is_terminal() or task.exit_code is not None:
                     continue
                 if now - last > hb_expiry_s:
-                    msg = f"task {task_id} missed {max_missed} heartbeats; deemed dead"
-                    log.error(msg)
-                    # record the heartbeat reason before the kill cascades into
-                    # completion callbacks with a generic exit-code message
+                    with self._restart_lock:
+                        # re-check under the lock: a concurrent container-
+                        # completion restart may have just relaunched this
+                        # task (popping its heartbeat entry on the watcher
+                        # thread) — proceeding on the stale read would
+                        # kill the fresh attempt and double-spend the
+                        # restart budget
+                        last = self.heartbeats.get(task_id)
+                        if last is None or now - last <= hb_expiry_s:
+                            continue
+                        msg = (f"task {task_id} missed {max_missed} "
+                               "heartbeats; deemed dead")
+                        log.error(msg)
+                        # a hung executor is a restartable failure, same
+                        # as a crashed one: route it through the per-task
+                        # budget BEFORE failing the whole job. Popping the
+                        # handle under the lock makes the dying
+                        # container's completion callback read as
+                        # superseded (it must not burn a second restart or
+                        # fail the job the new attempt is serving) — that
+                        # also makes the same-task watcher path inert, so
+                        # the kill itself can run OUTSIDE the lock: a
+                        # SIGTERM-ignoring hung process costs its own 5s
+                        # wait, not a stall of every other task's
+                        # completion handling.
+                        old = self._handles.pop(task_id, None)
+                        self.heartbeats.pop(task_id, None)
+                    # stop BEFORE launching the replacement — the hung
+                    # process still holds the device; a replacement racing
+                    # it to chip init would exit device-busy and burn the
+                    # budget on the collision
+                    if old is not None:
+                        self.provisioner.stop_container(old)
+                    restarted = (
+                        not self._stop_requested.is_set()
+                        and self._try_restart_task(
+                            task_id, c.EXIT_KILLED,
+                            cause=f"missed {max_missed} heartbeats")
+                    )
+                    if restarted:
+                        continue
+                    # budget spent (or none configured): record the
+                    # heartbeat reason before the kill cascades into
+                    # completion callbacks with a generic exit-code
+                    # message
                     self.session._fail(msg)
-                    self._kill_task(task_id)
-                    self.session.on_task_completed(task.name, task.index, c.EXIT_KILLED)
+                    self.session.on_task_completed(
+                        task.name, task.index, c.EXIT_KILLED)
 
             # 3. registration timeout (reference :1314-1334)
             for task_id, launched in list(self._launch_ms.items()):

@@ -27,6 +27,18 @@ TPU_HBM_USED = "tpu_hbm_used_mb"
 # a floor on true HBM use and labeled distinctly to say so
 TPU_HBM_LIVE = "tpu_hbm_live_buffer_mb"
 
+# serving-load gauges (fed by cli/serve.ServeApp once per scheduling turn;
+# named here so the /stats payload, the portal/history renderer, and tests
+# share one contract). The *_total names are cumulative counters sampled as
+# gauges — their max_ snapshot is the running total.
+SERVING_ACTIVE_SLOTS = "serving_active_slots"
+SERVING_QUEUE_DEPTH = "serving_queue_depth"
+SERVING_PREFILL_REUSED_FRAC = "serving_prefill_reused_frac"
+SERVING_SHED_TOTAL = "serving_shed_total"
+SERVING_CANCELLED_TOTAL = "serving_cancelled_total"
+SERVING_EXPIRED_TOTAL = "serving_expired_total"
+SERVING_LOOP_RESTARTS = "serving_loop_restarts"
+
 
 def _proc_tree_rss_mb(root_pid: int) -> float:
     """Sum RSS over root_pid and its descendants via /proc (the reference uses

@@ -131,6 +131,10 @@ from __future__ import annotations
 import collections
 import functools
 import itertools
+import logging
+import os
+import random
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -138,6 +142,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from .. import constants as c
+
+log = logging.getLogger(__name__)
 
 from .generate import (
     DecodeShardings,
@@ -173,12 +181,20 @@ class Request:
     top-k-filtered requests share one pool. ``cache_prompt`` overrides
     the server's ``cache_prompts`` default: whether this prompt's body
     chunks are inserted into the prefix cache at admission (None = server
-    default; lookups always run when the cache is enabled)."""
+    default; lookups always run when the cache is enabled).
+
+    ``deadline`` is an absolute ``time.monotonic()`` instant: a request
+    still QUEUED past its deadline is never admitted — it completes with
+    finish_reason "expired" instead of burning prefill+decode for a
+    client that already gave up. (A request already decoding is stopped
+    via ``SlotServer.cancel``, the caller's job — the server cannot know
+    the waiter left.) None = no deadline."""
     prompt: Any
     max_new_tokens: int
     temperature: float | None = None
     top_k: int | None = None
     cache_prompt: bool | None = None
+    deadline: float | None = None
     id: int = field(default_factory=itertools.count().__next__)
 
 
@@ -186,7 +202,14 @@ class Request:
 class Completion:
     id: int
     tokens: list[int]
-    finish_reason: str          # "stop" | "length"
+    finish_reason: str    # "stop" | "length" | "cancelled" | "expired"
+
+
+class QueueFullError(RuntimeError):
+    """Admission refused: the wait queue is at ``max_queue``. The shed
+    request was never accepted — the caller should surface backpressure
+    (HTTP 429 + Retry-After) rather than let an unbounded queue push
+    every admitted request's latency past its deadline."""
 
 
 @dataclass
@@ -734,6 +757,26 @@ def _decode_block(params, fused, cache, tokens, active, target_len,
     return cache, tokens, active, packed
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("shardings",),
+    donate_argnames=("active",),
+)
+def _cancel_slot(active, slot, *, shardings: DecodeShardings | None = None):
+    """Deactivate one slot's device-carried active flag. Dispatched
+    between blocks, so — dispatch order being device order — it takes
+    effect exactly at its position in the event log: every block
+    dispatched before the cancel still decodes the slot (those tokens
+    are already paid for), every block after treats it as an idle row
+    whose garbage is never read. The slot's length freezes with it, so
+    re-admission rewrites the ring from scratch exactly as it would
+    after a natural completion."""
+    active = active.at[slot].set(False)
+    if shardings is not None:
+        active = lax.with_sharding_constraint(active, shardings.act)
+    return active
+
+
 class SlotServer:
     """Continuous-batching server: S cache slots, requests admitted into
     freed slots while other slots keep decoding.
@@ -781,7 +824,30 @@ class SlotServer:
     bytes). ``cache_prompts`` is the server default for inserting
     admitted prompts' chunks back into the trie; ``Request.cache_prompt``
     overrides per request. 0 (default) disables the cache entirely.
-    ``stats()`` reports the counters."""
+    ``stats()`` reports the counters.
+
+    Failure model (docs/serving.md "Failure model"):
+
+    - ``max_queue=N`` bounds the wait queue: ``submit`` raises
+      ``QueueFullError`` instead of queueing the N+1th request (0 =
+      unbounded). Admission also skips requests whose ``deadline``
+      already passed (finish_reason "expired") — dead work never takes
+      a slot.
+    - ``cancel(request_id)`` stops a request wherever it is: queued
+      (dequeued), prefilling, or mid-decode (the slot's device-side
+      active flag is dropped between blocks, freeing it for the next
+      admission; a matched prefix-cache path is unpinned). The freed
+      slot's next occupant is token-identical to a fresh server —
+      re-admission rewrites the ring from scratch (tested).
+    - ``reset()`` re-arms every serving buffer (KV ring, slot state,
+      prefix pool) WITHOUT touching the weights after a loop failure;
+      queued requests survive, admitted ones are returned as lost so
+      the caller can fail them upstream.
+    - Chaos hooks (``TONY_TEST_SERVING_DISPATCH_FAIL_RATE`` /
+      ``_STEP_DELAY_MS`` / ``_CHAOS_SEED`` env, read at construction,
+      seeded for reproducibility) inject step failures/latency into
+      production code paths, same contract as the driver's ``TEST_*``
+      knobs (constants.py)."""
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
                  max_len: int = 2048, block_size: int = 16,
@@ -790,7 +856,8 @@ class SlotServer:
                  top_k: int = 0, stop_tokens: tuple = (), pad_id: int = 0,
                  seed: int = 0, pipeline_depth: int = 2,
                  mesh=None, rules=None, batched_admission: bool = True,
-                 prefix_cache_blocks: int = 0, cache_prompts: bool = True):
+                 prefix_cache_blocks: int = 0, cache_prompts: bool = True,
+                 max_queue: int = 0):
         if not cfg.causal:
             raise ValueError("serving requires a causal model")
         if isinstance(params, DecodeWeights):
@@ -841,6 +908,26 @@ class SlotServer:
         self.prefix_insert_dispatches = 0
         self.prefill_tokens_computed = 0    # real (non-pad) prefill tokens
         self.prefill_tokens_reused = 0      # served from the prefix pool
+        # failure-model counters (stats()) — cumulative across reset()
+        self.shed_requests = 0          # refused at submit (queue full)
+        self.cancelled_requests = 0     # cancel() reached the request
+        self.expired_requests = 0       # deadline passed while queued
+        self.resets = 0                 # reset() calls (loop recoveries)
+        self.blocks_dispatched = 0      # decode blocks sent to the device
+        self.max_queue = int(max_queue)
+        # drain support: ServeApp.shutdown(drain=True) parks admission so
+        # in-flight slots finish while nothing new starts
+        self.pause_admission = False
+        # chaos hooks: seeded fault injection on the serving hot path,
+        # the serving-side analogue of the driver's TEST_* env knobs.
+        # Read once at construction (a server's failure behavior should
+        # not drift mid-run); bad values degrade to "off", never crash.
+        self._chaos_fail_rate = self._env_float(
+            c.TEST_SERVING_DISPATCH_FAIL_RATE)
+        self._chaos_delay_ms = self._env_float(c.TEST_SERVING_STEP_DELAY_MS)
+        self._chaos_rng = random.Random(
+            int(self._env_float(c.TEST_SERVING_CHAOS_SEED)))
+        self.chaos_faults_injected = 0
         self.cfg = moe_dropfree(cfg)
         self.slots = slots
         self.max_len = max_len
@@ -862,7 +949,49 @@ class SlotServer:
         # tokens the host must observe the device to see EOS, so blocks
         # sync (in bursts) behind a pipeline of in-flight blocks.
         self._predictive = not self.stop_tokens
-        cache = init_cache(self.cfg, slots, max_len, kv_dtype)
+        self._init_device_state()
+        # ---- chunk-aligned prefix cache (module docstring) ----
+        self.cache_prompts = cache_prompts
+        self._prefix_cache: PrefixCache | None = None
+        self._pool: PrefixPool | None = None
+        # request id -> matched trie path, ref-held until the completion
+        # is processed
+        self._prefix_refs: dict[int, list] = {}
+        self._prefix_blocks = 0
+        if prefix_cache_blocks > 0:
+            n_blocks = prefix_cache_blocks
+            if mesh is not None:
+                # the pool's block axis shards where the slot axis does;
+                # round the budget up to a whole number of shards
+                t_b = _rule_size(mesh, rules, "batch")
+                n_blocks = -(-n_blocks // t_b) * t_b
+            self._prefix_blocks = n_blocks
+            self._init_prefix_pool()
+        self._init_host_state()
+        self._queue: collections.deque[Request] = collections.deque()
+        self._done: dict[int, Completion] = {}
+
+    @staticmethod
+    def _env_float(name: str) -> float:
+        """A bad chaos knob must degrade to 'off', not crash the server
+        at construction (same contract as the driver's TEST_* parsing)."""
+        raw = os.environ.get(name, "")
+        if not raw:
+            return 0.0
+        try:
+            return float(raw)
+        except ValueError:
+            log.error("bad %s value %r; ignoring", name, raw)
+            return 0.0
+
+    def _init_device_state(self) -> None:
+        """(Re)create the device-resident slot pool + per-slot state
+        vectors as FRESH buffers (weights untouched) and commit their
+        mesh layout. Called at construction and by ``reset()`` — after a
+        failed dispatch the old donated buffers may be dead, so recovery
+        must never reuse them."""
+        slots = self.slots
+        cache = init_cache(self.cfg, slots, self.max_len, self.kv_dtype)
         # device-carried slot state: blocks consume the previous block's
         # outputs directly, never waiting on a host round trip
         self._cache = cache._replace(length=jnp.zeros((slots,), jnp.int32))
@@ -897,39 +1026,37 @@ class SlotServer:
             self._d_topks = jax.device_put(self._d_topks, sh.act)
             self._key = jax.device_put(
                 self._key, jax.sharding.NamedSharding(
-                    mesh, jax.sharding.PartitionSpec()))
+                    self._mesh, jax.sharding.PartitionSpec()))
+
+    def _init_prefix_pool(self) -> None:
+        """(Re)create the shared prefix pool's device blocks (fresh
+        buffers; the host trie is rebuilt by the caller)."""
+        self._pool = init_prefix_pool(
+            self.cfg, self._prefix_blocks, self.prefill_chunk, self.kv_dtype)
+        self._prefix_cache = PrefixCache(self._prefix_blocks,
+                                         self.prefill_chunk)
+        if self._shardings is not None:
+            sh = self._shardings
+            self._pool = PrefixPool(
+                k=jax.device_put(self._pool.k, sh.cache),
+                v=jax.device_put(self._pool.v, sh.cache),
+                k_scale=(None if self._pool.k_scale is None else
+                         jax.device_put(self._pool.k_scale, sh.scale)),
+                v_scale=(None if self._pool.v_scale is None else
+                         jax.device_put(self._pool.v_scale, sh.scale)),
+            )
+
+    def _init_host_state(self) -> None:
+        """(Re)zero the host-side scheduling state: sampling mirrors, the
+        exact model, the processing expectations, slot ownership, and the
+        in-flight pipeline. The request QUEUE is deliberately not touched
+        — queued requests were never started and survive a reset()."""
+        slots = self.slots
         # host mirrors of the admitted temps/top_ks: when every busy slot
         # is greedy (or on the server-global k), blocks dispatch the
         # argmax-only / static-threshold program variants
         self._np_temps = np.zeros((slots,), np.float32)
         self._np_topks = np.full((slots,), self.top_k, np.int32)
-        # ---- chunk-aligned prefix cache (module docstring) ----
-        self.cache_prompts = cache_prompts
-        self._prefix_cache: PrefixCache | None = None
-        self._pool: PrefixPool | None = None
-        # request id -> matched trie path, ref-held until the completion
-        # is processed
-        self._prefix_refs: dict[int, list] = {}
-        if prefix_cache_blocks > 0:
-            n_blocks = prefix_cache_blocks
-            if mesh is not None:
-                # the pool's block axis shards where the slot axis does;
-                # round the budget up to a whole number of shards
-                t_b = _rule_size(mesh, rules, "batch")
-                n_blocks = -(-n_blocks // t_b) * t_b
-            self._prefix_cache = PrefixCache(n_blocks, prefill_chunk)
-            self._pool = init_prefix_pool(
-                self.cfg, n_blocks, prefill_chunk, kv_dtype)
-            if self._shardings is not None:
-                sh = self._shardings
-                self._pool = PrefixPool(
-                    k=jax.device_put(self._pool.k, sh.cache),
-                    v=jax.device_put(self._pool.v, sh.cache),
-                    k_scale=(None if self._pool.k_scale is None else
-                             jax.device_put(self._pool.k_scale, sh.scale)),
-                    v_scale=(None if self._pool.v_scale is None else
-                             jax.device_put(self._pool.v_scale, sh.scale)),
-                )
         self._cursor = 0        # host-tracked, advances block per dispatch
         # exact host model of the device slot state as of the NEWEST
         # dispatched block — usable for scheduling only in predictive mode
@@ -944,15 +1071,19 @@ class SlotServer:
         # busy from admission until the completion is PROCESSED
         self._host_busy = np.zeros((slots,), bool)
         # dispatched-but-unprocessed blocks: lazy packed results + the
-        # admissions dispatched after each
+        # admissions/cancellations dispatched after each
         self._pipeline: collections.deque = collections.deque()
         # processing-side slot ownership (replayed in dispatch order, so a
         # slot re-admitted while its previous request's blocks are still
         # unprocessed never mixes the two streams)
         self._requests: list[Request | None] = [None] * slots
         self._emitted: list[list[int]] = [[] for _ in range(slots)]
-        self._queue: collections.deque[Request] = collections.deque()
-        self._done: dict[int, Completion] = {}
+        # dispatch-side views: which slot is CURRENTLY serving a request
+        # id (cancel targeting — _requests lags by the pipeline depth),
+        # and every admitted id whose completion hasn't been delivered
+        # (reset() fails exactly these)
+        self._slot_of: dict[int, int] = {}
+        self._inflight: set[int] = set()
 
     # ------------------------------------------------------------- intake
 
@@ -967,9 +1098,114 @@ class SlotServer:
                 f"request needs {prompt.size} prompt + "
                 f"{request.max_new_tokens} new tokens but slots hold "
                 f"max_len={self.max_len}")
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            # shed at the door: an unbounded queue converts overload into
+            # unbounded latency for EVERY admitted request; a bounded one
+            # keeps admitted-request latency flat and tells the excess to
+            # retry (HTTP 429 upstream). Sweep expired corpses first — a
+            # queue full of requests whose deadlines already passed is
+            # capacity the next _admit would reclaim anyway, not load
+            self._sweep_expired()
+            if len(self._queue) >= self.max_queue:
+                self.shed_requests += 1
+                raise QueueFullError(
+                    f"queue full ({self.max_queue} waiting); request shed")
         request.prompt = prompt
         self._queue.append(request)
         return request.id
+
+    def _sweep_expired(self) -> None:
+        """Deadline sweep: a request whose client already gave up must
+        not take a slot (or hold a queue seat) — prefill + decode for a
+        dead waiter is the purest form of wasted accelerator time under
+        overload. Expired requests complete as "expired"."""
+        if not self._queue:
+            return
+        now = time.monotonic()
+        if not any(r.deadline is not None and now > r.deadline
+                   for r in self._queue):
+            return
+        kept: collections.deque[Request] = collections.deque()
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                self.expired_requests += 1
+                self._done[req.id] = Completion(req.id, [], "expired")
+            else:
+                kept.append(req)
+        self._queue = kept
+
+    def cancel(self, request_id: int) -> bool:
+        """Stop a request wherever it is. Queued: dequeued (never takes a
+        slot). Admitted (prefilling or decoding): the slot's device-side
+        active flag drops between blocks — dispatch order is device
+        order, so every block dispatched before the cancel still decodes
+        it and every later block sees an idle row — and the cancellation
+        is logged against the newest in-flight block so the lagging
+        bookkeeping frees the slot, emits a Completion(finish_reason=
+        "cancelled") with the tokens produced so far, and unpins any
+        matched prefix-cache path at exactly the right replay position.
+        Returns False when the request is unknown or already finished
+        (its completion is on its way — too late to save the work). In
+        EOS mode the host cannot see an un-synced device stop, so a True
+        can race a natural completion; the delivered finish_reason is
+        authoritative (the counter reconciles at replay)."""
+        for i, req in enumerate(self._queue):
+            if req.id == request_id:
+                del self._queue[i]      # by index: Request's array field
+                #                         makes == comparisons ambiguous
+                self.cancelled_requests += 1
+                self._done[request_id] = Completion(request_id, [],
+                                                    "cancelled")
+                return True
+        slot = self._slot_of.get(request_id)
+        if slot is None:
+            return False
+        if self._predictive and not self._model_active[slot]:
+            return False        # already decoded to completion on device
+        self._d_active = _cancel_slot(self._d_active, jnp.int32(slot),
+                                      shardings=self._shardings)
+        self._model_active[slot] = False
+        self.cancelled_requests += 1
+        ev = ("cancel", (slot, request_id))
+        if self._pipeline:
+            self._pipeline[-1]["events"].append(ev)
+        else:                   # nothing in flight: applies now
+            self._apply_cancel((slot, request_id))
+        return True
+
+    def reset(self) -> list[int]:
+        """Re-arm the serving state after a loop failure WITHOUT touching
+        the weights: fresh KV ring + slot-state buffers (a failed dispatch
+        may have killed the donated old ones), fresh prefix pool + trie,
+        pipeline and slot bookkeeping cleared. Queued requests survive —
+        they were never started. Admitted-but-undelivered requests cannot
+        be recovered (their cache state died with the ring); their ids
+        are returned so the caller fails them upstream instead of letting
+        their waiters hang."""
+        failed = sorted(self._inflight)
+        self._prefix_refs.clear()
+        self._init_device_state()
+        if self._prefix_blocks:
+            self._init_prefix_pool()
+        self._init_host_state()
+        self.resets += 1
+        return failed
+
+    def fail_queued(self) -> list[Request]:
+        """Drain the wait queue (requests never admitted) — the graceful-
+        shutdown path: the caller owns telling their waiters why."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out
+
+    def _release_request(self, request_id: int) -> None:
+        """Drop the dispatch-side tracking of a finished/cancelled
+        request and unpin its matched prefix-cache path."""
+        self._slot_of.pop(request_id, None)
+        self._inflight.discard(request_id)
+        path = self._prefix_refs.pop(request_id, None)
+        if path is not None:
+            self._prefix_cache.release(path)
 
     @property
     def pending(self) -> int:
@@ -977,9 +1213,14 @@ class SlotServer:
 
     @property
     def idle(self) -> bool:
-        """Nothing queued, in flight, or admitted-and-unfinished."""
+        """Nothing queued, in flight, admitted-and-unfinished, or
+        finished-but-undrained. The last term matters after a reset():
+        completions that survived the failure sit in _done with no block
+        ever coming — a serving loop that gates its drain on ``not idle``
+        must keep turning until they are handed out, or their waiters
+        hang to their timeouts."""
         return not (self._queue or self._pipeline
-                    or self._host_busy.any())
+                    or self._host_busy.any() or self._done)
 
     @property
     def completions_ready(self) -> bool:
@@ -1014,9 +1255,19 @@ class SlotServer:
             "queued": self.pending,
             "max_len": self.max_len,
             "block_size": self.block_size,
+            "max_queue": self.max_queue,
             "admission_dispatches": self.admission_dispatches,
+            "blocks_dispatched": self.blocks_dispatched,
             "prefill_tokens_computed": self.prefill_tokens_computed,
             "prefill_tokens_reused": self.prefill_tokens_reused,
+            # failure-model counters: recovery/shedding must be VISIBLE
+            # (a server that silently sheds reads as a server that lost
+            # requests)
+            "shed": self.shed_requests,
+            "cancelled": self.cancelled_requests,
+            "expired": self.expired_requests,
+            "resets": self.resets,
+            "chaos_faults_injected": self.chaos_faults_injected,
         }
         pc = self._prefix_cache
         if pc is not None:
@@ -1062,6 +1313,9 @@ class SlotServer:
         burst start — a same-burst template twin prefills too (its copy
         would otherwise be dispatched before the twin's insert) — so
         sharing begins one burst after a template first appears."""
+        if self.pause_admission:
+            return
+        self._sweep_expired()
         C = self.prefill_chunk
         admissions: list[_Admission] = []
         for slot in range(self.slots):
@@ -1070,6 +1324,16 @@ class SlotServer:
             if not self._free_for_admission(slot):
                 continue
             req = self._queue.popleft()
+            # dispatch-side ownership: the slot now serves THIS id (a
+            # predecessor whose blocks are still unprocessed keeps its
+            # _requests/_inflight entries — only its cancel-target mapping
+            # is superseded), and the id is in-flight until its completion
+            # is delivered, even if a prefill dispatch dies mid-burst
+            # (reset() fails exactly the _inflight set)
+            for stale in [r for r, s in self._slot_of.items() if s == slot]:
+                del self._slot_of[stale]
+            self._slot_of[req.id] = slot
+            self._inflight.add(req.id)
             prompt = req.prompt
             # all but the last token is prefilled; the last becomes the
             # slot's first fed token so the first sample falls out of the
@@ -1122,7 +1386,7 @@ class SlotServer:
                 self._prefix_refs[req.id] = adm.hit_path
             admit = (slot, body.size, req)
             if self._pipeline:
-                self._pipeline[-1]["admits"].append(admit)
+                self._pipeline[-1]["events"].append(("admit", admit))
             else:                       # nothing in flight: applies now
                 self._apply_admit(admit)
 
@@ -1276,6 +1540,36 @@ class SlotServer:
         self._expect_active[slot] = True
         self._requests[slot] = req
         self._emitted[slot] = []
+        # re-arm busy at the replay position: when this slot was
+        # re-admitted before its PREDECESSOR's completion was processed,
+        # that processing (replayed just before this admit) cleared
+        # _host_busy — without the re-arm the server can read idle while
+        # this request still decodes on device, and a loop that gates
+        # stepping on busyness strands it (its waiter hangs)
+        self._host_busy[slot] = True
+
+    def _apply_cancel(self, payload) -> None:
+        """Processing-side half of cancel(): replayed at the cancel's
+        position in the event log (after every block dispatched before
+        it, before every one after), so the emitted-token tally is
+        exactly what the device produced before the deactivation took
+        effect. A request that finished naturally in one of those earlier
+        blocks won the race — its completion already fired and the slot
+        may even belong to a successor; skip."""
+        slot, rid = payload
+        req = self._requests[slot]
+        if req is None or req.id != rid:
+            # the request finished naturally in an earlier-dispatched
+            # block (EOS-mode race): the cancel did nothing — reconcile
+            # the counter its optimistic True incremented
+            self.cancelled_requests -= 1
+            return
+        self._done[rid] = Completion(rid, self._emitted[slot], "cancelled")
+        self._requests[slot] = None
+        self._emitted[slot] = []
+        self._host_busy[slot] = False
+        self._expect_active[slot] = False
+        self._release_request(rid)
 
     def _dispatch_block(self) -> None:
         self._key, sub = jax.random.split(self._key)
@@ -1297,7 +1591,8 @@ class SlotServer:
                 (self._np_temps[self._host_busy] > 0).any()),
             shardings=self._shardings)
         self._cursor = (self._cursor + self.block_size) % self.max_len
-        self._pipeline.append({"packed": packed, "admits": []})
+        self.blocks_dispatched += 1
+        self._pipeline.append({"packed": packed, "events": []})
         if self._predictive:            # exact: no EOS can surprise us
             adv = np.minimum(self.block_size,
                              self._model_target - self._model_len)
@@ -1311,7 +1606,8 @@ class SlotServer:
         on-device first (transfers cost a full tunnel round trip EACH, no
         matter the size). Emitted token count per slot is the length delta
         vs the expectation; completions fire where a slot went inactive;
-        each block's admissions replay after it."""
+        each block's admissions AND cancellations replay after it, in
+        dispatch order (the order the device applied them)."""
         recs = [self._pipeline.popleft() for _ in range(count)]
         if len(recs) == 1:
             flat = np.asarray(recs[0]["packed"])
@@ -1335,19 +1631,37 @@ class SlotServer:
                     self._requests[slot] = None
                     self._emitted[slot] = []
                     self._host_busy[slot] = False
-                    path = self._prefix_refs.pop(req.id, None)
-                    if path is not None:    # unpin the matched trie path
-                        self._prefix_cache.release(path)
+                    self._release_request(req.id)
             self._expect_len = np.array(lengths)
             self._expect_active = np.array(active)
-            for admit in rec["admits"]:
-                self._apply_admit(admit)
+            for kind, payload in rec["events"]:
+                if kind == "admit":
+                    self._apply_admit(payload)
+                else:
+                    self._apply_cancel(payload)
 
     def _device_may_be_active(self) -> bool:
         if self._predictive:
             return bool(self._model_active.any())
         return bool(self._expect_active.any()) or any(
-            r["admits"] for r in self._pipeline)
+            kind == "admit"
+            for r in self._pipeline for kind, _ in r["events"])
+
+    def _inject_chaos(self) -> None:
+        """Serving-side fault injection (constants.py TEST_SERVING_*):
+        seeded, so a chaos run's fault sequence is reproducible — the
+        n-th scheduling turn fails iff the n-th RNG draw does, regardless
+        of wall-clock timing. Raises the same way a real dispatch failure
+        (device loss, OOM) surfaces: out of step(), into the serving
+        loop's recovery path."""
+        if self._chaos_delay_ms:
+            time.sleep(self._chaos_delay_ms / 1000)
+        if (self._chaos_fail_rate
+                and self._chaos_rng.random() < self._chaos_fail_rate):
+            self.chaos_faults_injected += 1
+            raise RuntimeError(
+                "chaos: injected serving dispatch failure "
+                f"#{self.chaos_faults_injected}")
 
     def step(self) -> None:
         """One scheduling turn.
@@ -1360,6 +1674,7 @@ class SlotServer:
         EOS mode: admit when the host's view is current, dispatch a block
         if any slot may be running, and burst-process blocks beyond the
         pipeline depth (all of them on the drain tail)."""
+        self._inject_chaos()
         if self._predictive:
             self._admit()
             if self._device_may_be_active():
@@ -1397,4 +1712,5 @@ class SlotServer:
         return out
 
 
-__all__ = ["Request", "Completion", "SlotServer", "PrefixCache"]
+__all__ = ["Request", "Completion", "SlotServer", "PrefixCache",
+           "QueueFullError"]
