@@ -48,7 +48,10 @@ dispatches per admission burst — both counts reported), and, when >= 2
 devices are visible, the mesh-sharded (tensor-parallel) server with a
 parity check against the single-device completions. On CPU run it under
 `XLA_FLAGS=--xla_force_host_platform_device_count=4`. Results land in
-PERF.json under `continuous_batching_tp`.
+PERF.json under `continuous_batching_tp`, and the timed pass's
+p50/p90/p99 TTFT/TPOT/queue-wait/e2e (from the observability
+histograms, docs/observability.md) under `serving_latency` — the
+latency baseline future perf PRs regress against.
 
 `python bench.py --serving --shared-prefix` benchmarks the chunk-aligned
 prefix KV cache on the workload it exists for: N requests sharing one
@@ -253,6 +256,7 @@ def run_serving_bench() -> int:
             "tokens_per_sec": round(n_tokens / wall, 1),
             "useful_tokens": n_tokens,
             "admission_dispatches": srv.admission_dispatches,
+            "latency": srv.telemetry.snapshot(),
         }, toks
 
     serve(params, batched=True)                       # compile warm-up
@@ -261,6 +265,16 @@ def run_serving_bench() -> int:
     perslot, toks_p = serve(params, batched=False)
     assert toks_b == toks_p, "admission policy changed completions"
 
+    # latency baseline (ISSUE 4): p50/p90/p99 TTFT / TPOT / queue wait /
+    # e2e of the timed batched pass, from the observability histograms —
+    # the PERF.json `serving_latency` section future perf PRs regress
+    # against. Host-monotonic spans; the whole burst is submitted up
+    # front, so queue waits here measure the saturated-backlog shape.
+    serving_latency = {
+        k: v for k, v in batched.pop("latency").items()
+        if k in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_s")
+    }
+    perslot.pop("latency", None)
     out = {
         "metric": "continuous_batching_serving_tokens_per_sec",
         "value": batched["tokens_per_sec"],
@@ -269,6 +283,7 @@ def run_serving_bench() -> int:
         "n_requests": n_requests,
         "prompt_lens_cycle": prompt_lens,
         "budgets_cycle": budgets,
+        "serving_latency": serving_latency,
         "batched_admission": batched,
         "per_slot_admission": perslot,
         "admission_dispatch_ratio": round(
@@ -287,6 +302,7 @@ def run_serving_bench() -> int:
         prep = prepare_decode(params, cfg, mesh=mesh)
         serve(prep, batched=True, mesh=mesh)          # warm-up
         tp, toks_tp = serve(prep, batched=True, mesh=mesh)
+        tp.pop("latency", None)
         out["tp"] = {**tp, "mesh": dict(mesh.shape),
                      "parity_vs_single_device": toks_tp == toks_b}
     print(json.dumps(out))

@@ -297,6 +297,91 @@ def test_portal_cookie_survives_delimiter_token_and_blocks_open_redirect(
         server.server_close()
 
 
+def test_portal_request_timeline_and_metrics(tmp_path):
+    """Observability routes: /traces/<id> renders the per-request
+    waterfall from the job's requests.trace.jsonl (written by ``serve
+    --trace-dir``), JSON and HTML, 404s cleanly when absent; /metrics
+    serves the portal's own counters/latency in parseable Prometheus
+    text."""
+    import re
+
+    from tony_tpu.events.history import history_file_name
+    from tony_tpu.events.trace import TraceWriter
+
+    inter = tmp_path / "hist" / "intermediate"
+    job = inter / "app_traced"
+    job.mkdir(parents=True)
+    (job / history_file_name("app_traced", 1000, end_ms=9000, user="u",
+                             status="SUCCEEDED")).write_text("")
+    bare = inter / "app_bare"           # history but no trace file
+    bare.mkdir(parents=True)
+    (bare / history_file_name("app_bare", 1000, end_ms=2000, user="u",
+                              status="SUCCEEDED")).write_text("")
+    w = TraceWriter(job)
+    w.write({"id": 0, "spans": [
+        ["submitted", 10.0], ["admitted", 10.4], ["prefill_done", 10.5],
+        ["first_token", 11.0], ["finished", 12.5]],
+        "attrs": {"n_tokens": 9, "finish_reason": "length",
+                  "prefix_hit_blocks": 2, "submitted_unix": 1700.0}})
+    w.write({"id": 1, "spans": [["submitted", 10.1], ["shed", 10.11]],
+             "attrs": {"finish_reason": "shed", "submitted_unix": 1700.1}})
+    # valid JSON, malformed shape: must not 500 the timeline page
+    w.write({"id": 9, "spans": [["submitted"]]})
+    w.close()
+
+    conf = TonyConf({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.intermediate": str(inter),
+        "tony.history.finished": str(tmp_path / "hist" / "finished"),
+    })
+    server = serve_portal(conf, port=0, block=False)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        def get(path, accept="application/json"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", headers={"Accept": accept})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, resp.headers, resp.read().decode()
+
+        # JSON: the parsed records, verbatim (malformed one included)
+        status, _, body = get("/traces/app_traced")
+        traces = json.loads(body)
+        assert status == 200 and [t["id"] for t in traces] == [0, 1, 9]
+
+        # HTML: waterfall table with outcomes + phase durations, linked
+        # from the job page
+        status, _, body = get("/traces/app_traced", accept="text/html")
+        assert status == 200
+        assert "request timeline" in body and "length" in body
+        assert "shed" in body and "host-monotonic" in body
+        status, _, body = get("/jobs/app_traced", accept="text/html")
+        assert "/traces/app_traced" in body
+
+        # no trace file -> JSON 404, not a crash
+        try:
+            get("/traces/app_bare")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+        # /metrics: the portal's own telemetry, Prometheus text format
+        status, headers, body = get("/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        line_re = re.compile(
+            r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+|"
+            r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^\s]+)$")
+        for line in body.strip().splitlines():
+            assert line_re.match(line), f"unparseable line: {line!r}"
+        assert 'portal_http_requests_total{route="traces"} 3' in body
+        assert "portal_request_seconds_bucket" in body
+        assert "portal_jobs_indexed 2" in body
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 def test_portal_serves_history(tmp_job_dirs, fixture_script):
     # run a real job to generate history
     from tony_tpu.client import TonyClient

@@ -93,11 +93,16 @@ def test_jax_ranks_are_distinct(tmp_job_dirs, fixture_script, tmp_path):
     assert ranks == ["rank_0", "rank_1", "rank_2"]
 
 
+@pytest.mark.slow
 def test_large_gang_48_workers(tmp_job_dirs):
     """Moderate-scale gang: 48 executors allocate, pass the gang barrier,
     register, heartbeat, and complete — the task-table/scheduler/liveness
     machinery at the container counts the reference's YARN deployments run
-    (each worker asserts it sees the full gang size). ~9s wall."""
+    (each worker asserts it sees the full gang size). ~9s wall (observed
+    up to ~34s on the loaded 2-core tier-1 host). Slow-marked with its
+    192-executor sibling: the pair dominated tier-1 variance and flaked
+    under load (ROADMAP), and the gate keeps the cheaper gang coverage
+    (multi_worker_gang, straggler_skew, worker_failure)."""
     status, client = run_job(
         tmp_job_dirs,
         **{"tony.worker.instances": 48,
@@ -110,6 +115,7 @@ def test_large_gang_48_workers(tmp_job_dirs):
     assert all(t.status == "SUCCEEDED" for t in client.task_infos)
 
 
+@pytest.mark.slow
 def test_gang_scale_192_stub_executors(tmp_job_dirs, tmp_path):
     """Driver scale one notch past the 48-proc test: 192 stub executors —
     threads speaking the REAL framed-JSON RPC protocol over real sockets,
@@ -320,11 +326,14 @@ def test_horovod_two_phase_rendezvous(tmp_job_dirs, fixture_script):
     assert roles == {"worker", "driver"}, "driver role must be injected"
 
 
+@pytest.mark.slow
 def test_real_torch_distributed_allreduce(tmp_job_dirs, fixture_script):
     """4 workers (the BASELINE.md DDP topology) join a real c10d gloo group
     from the emitted INIT_METHOD contract and allreduce — the pytorch
     analogue of the jax.distributed collective e2e (reference mnist-pytorch
-    example contract)."""
+    example contract). Slow-marked (~26s: torch import + gloo rendezvous
+    x4 procs) to keep tier-1 under its 870s cap; the jax-collective e2e
+    keeps real-distributed coverage in the gate."""
     status, client = run_job(
         tmp_job_dirs,
         **{"tony.application.framework": "pytorch",

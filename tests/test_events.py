@@ -13,7 +13,12 @@ from tony_tpu.events import (
     parse_history_file_name,
 )
 from tony_tpu.events.handler import read_events
-from tony_tpu.events.types import application_inited, task_finished
+from tony_tpu.events.trace import TRACE_FILE, TraceWriter, read_traces
+from tony_tpu.events.types import (
+    application_inited,
+    request_trace,
+    task_finished,
+)
 
 
 def test_filename_codec_roundtrip():
@@ -45,6 +50,33 @@ def test_event_json_roundtrip():
     e = Event(EventType.TASK_STARTED, {"task_id": "w:1"}, timestamp=123)
     e2 = Event.from_json(e.to_json())
     assert e2.type == e.type and e2.payload == e.payload and e2.timestamp == 123
+
+
+def test_request_trace_event_roundtrip():
+    rec = {"id": 4, "spans": [["submitted", 1.0], ["finished", 2.0]],
+           "attrs": {"n_tokens": 3}}
+    e = Event.from_json(request_trace(rec).to_json())
+    assert e.type == EventType.REQUEST_TRACE
+    assert e.payload["trace"]["id"] == 4
+
+
+def test_trace_writer_roundtrip_and_torn_line(tmp_path):
+    """TraceWriter appends JSONL records read_traces round-trips; a torn
+    (malformed) line is skipped instead of hiding the rest."""
+    w = TraceWriter(tmp_path / "job")
+    assert w.path.name == TRACE_FILE
+    recs = [
+        {"id": 0, "spans": [["submitted", 1.0], ["finished", 2.5]],
+         "attrs": {"n_tokens": 2, "finish_reason": "length"}},
+        {"id": 1, "spans": [["submitted", 1.1], ["shed", 1.2]],
+         "attrs": {"finish_reason": "shed"}},
+    ]
+    for r in recs:
+        w.write(r)
+    w.close()
+    with open(w.path, "a") as f:
+        f.write('{"id": 2, "spans": [["subm')     # crash-torn tail
+    assert read_traces(w.path) == recs
 
 
 def test_mover_moves_finished_and_finalizes_orphans(tmp_path):

@@ -1,0 +1,430 @@
+"""Request-level serving telemetry (tony_tpu/observability.py).
+
+The contract under test: every request that terminates — completed,
+cancelled, expired, shed — leaves a complete, ordered lifecycle trace
+(host-monotonic spans); the latency histograms those traces feed are
+correct at the bucket level (boundaries, merge, quantiles); GET /metrics
+renders everything in parseable Prometheus text format whose numbers
+match /stats; and the 429 Retry-After header is a rate-derived estimate
+that grows with the backlog instead of a constant. Model-backed tests
+reuse the TINY shapes of tests/test_serving*.py so the tier-1 run hits
+the already-compiled programs.
+"""
+
+import json
+import re
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu import metrics as _metrics
+from tony_tpu.cli.serve import ServeApp, make_handler
+from tony_tpu.models import transformer
+from tony_tpu.models.serving import (
+    QueueFullError, Request, SlotServer,
+)
+from tony_tpu.observability import (
+    Histogram,
+    PromRenderer,
+    RequestTrace,
+    ServiceRateEstimator,
+    ServingTelemetry,
+)
+
+TINY = transformer.TransformerConfig(
+    vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq_len=128, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init(jax.random.PRNGKey(0), TINY)
+
+
+def _srv(params, **kw):
+    """Same shapes as tests/test_serving.py — the tier-1 run reuses the
+    already-compiled programs."""
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prefill_chunk", 8)
+    return SlotServer(params, TINY, **kw)
+
+
+def _prompt(n, seed=5):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, TINY.vocab_size, size=n, dtype=np.int32)
+
+
+# --------------------------------------------------------------------------
+# Histogram: boundaries, merge, quantiles
+# --------------------------------------------------------------------------
+
+def test_histogram_bucket_boundaries():
+    h = Histogram(lo=1.0, hi=1000.0, per_decade=1)
+    assert h.bounds == [1.0, 10.0, 100.0, 1000.0]
+    h.observe(0.5)          # <= lo: first bucket
+    h.observe(10.0)         # ON a boundary: le semantics, bucket le=10
+    h.observe(10.0001)      # just past it: next bucket
+    h.observe(5000.0)       # past hi: +Inf overflow
+    assert h.counts == [1, 1, 1, 0, 1]
+    assert h.count == 4
+    assert h.sum == pytest.approx(0.5 + 10.0 + 10.0001 + 5000.0)
+
+
+def test_histogram_merge():
+    a = Histogram(lo=1.0, hi=100.0, per_decade=1)
+    b = Histogram(lo=1.0, hi=100.0, per_decade=1)
+    for v in (0.5, 5.0):
+        a.observe(v)
+    for v in (50.0, 5000.0):
+        b.observe(v)
+    a.merge(b)
+    assert a.counts == [1, 1, 1, 1]
+    assert a.count == 4 and a.sum == pytest.approx(5055.5)
+    with pytest.raises(ValueError, match="different buckets"):
+        a.merge(Histogram(lo=1.0, hi=100.0, per_decade=2))
+
+
+def test_histogram_quantiles_known_distribution():
+    h = Histogram(lo=1e-3, hi=100.0, per_decade=5)
+    for k in range(1, 1001):                # uniform on (0, 1]
+        h.observe(k / 1000.0)
+    # bucket-resolution estimates: within the containing log bucket
+    assert 0.35 < h.quantile(0.5) < 0.66
+    assert 0.80 < h.quantile(0.99) <= 1.01
+    qs = [h.quantile(q) for q in (0.0, 0.1, 0.5, 0.9, 0.99, 1.0)]
+    assert qs == sorted(qs), "quantiles must be monotone in q"
+    assert h.mean == pytest.approx(0.5005, rel=1e-6)
+    assert Histogram().quantile(0.5) == 0.0         # empty: defined as 0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_snapshot_shape():
+    h = Histogram()
+    h.observe(0.02)
+    snap = h.snapshot()
+    assert snap["count"] == 1
+    assert set(snap) == {"count", "mean_s", "p50_s", "p90_s", "p99_s"}
+
+
+# --------------------------------------------------------------------------
+# Prometheus exposition: golden format
+# --------------------------------------------------------------------------
+
+# one exposition line: a comment, or name{labels} value
+_PROM_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+|"
+    r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^\s]+)$")
+
+
+def test_prom_renderer_golden():
+    h = Histogram(lo=1.0, hi=100.0, per_decade=1)
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    r = PromRenderer()
+    r.gauge("g_one", 3, "a gauge")
+    r.counter("c_total", 7, "a counter", labels={"kind": "x"})
+    r.histogram("h_seconds", h, "a histogram")
+    text = r.render()
+    assert text == (
+        "# HELP g_one a gauge\n"
+        "# TYPE g_one gauge\n"
+        "g_one 3\n"
+        "# HELP c_total a counter\n"
+        "# TYPE c_total counter\n"
+        'c_total{kind="x"} 7\n'
+        "# HELP h_seconds a histogram\n"
+        "# TYPE h_seconds histogram\n"
+        'h_seconds_bucket{le="1"} 1\n'
+        'h_seconds_bucket{le="10"} 2\n'
+        'h_seconds_bucket{le="100"} 3\n'
+        'h_seconds_bucket{le="+Inf"} 4\n'
+        "h_seconds_sum 555.5\n"
+        "h_seconds_count 4\n"
+    )
+    for line in text.strip().splitlines():
+        assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
+
+
+def test_prom_renderer_sanitizes_and_groups():
+    r = PromRenderer()
+    r.gauge("weird-name.x", 1, "g", labels={"a b": 'q"uote\nnl'})
+    r.gauge("weird-name.x", 2, "g", labels={"a b": "two"})
+    text = r.render()
+    # one TYPE line for the family, two samples, escaped label value
+    assert text.count("# TYPE weird_name_x gauge") == 1
+    assert 'weird_name_x{a_b="q\\"uote\\nnl"} 1' in text
+    assert 'weird_name_x{a_b="two"} 2' in text
+
+
+# --------------------------------------------------------------------------
+# Retry-After estimation
+# --------------------------------------------------------------------------
+
+def test_service_rate_estimator_retry_after():
+    est = ServiceRateEstimator()
+    assert est.retry_after_s(0, 8) == 1         # no observations: floor
+    for _ in range(20):
+        est.observe(8.0)
+    assert est.service_time_s == pytest.approx(8.0)
+    assert est.retry_after_s(0, 2) == 4         # 8s * 1 waiter / 2 slots
+    assert est.retry_after_s(1000, 2) == 60     # ceiling clamp
+    vals = [est.retry_after_s(q, 2) for q in range(0, 40, 4)]
+    assert vals == sorted(vals) and vals[-1] > vals[0], (
+        "Retry-After must grow with queue depth")
+    fast = ServiceRateEstimator()
+    fast.observe(0.01)
+    assert fast.retry_after_s(0, 8) == 1        # sub-second: 1s floor
+
+
+def test_retry_after_monotone_under_saturated_queue(params):
+    """SlotServer surface: with a fixed observed service rate, every
+    added waiter advances (never shrinks) the advertised retry — the
+    header a saturated queue sends is ordered by backlog depth. No
+    step() calls: submission-only, so no compiled programs run."""
+    srv = _srv(params)
+    srv._rate.observe(4.0)          # as if requests served in ~4s
+    seen = []
+    for i in range(12):
+        srv.submit(Request(prompt=_prompt(3, seed=i), max_new_tokens=4))
+        seen.append(srv.estimate_retry_after())
+    assert seen == sorted(seen) and seen[-1] > seen[0]
+    assert all(isinstance(v, int) and 1 <= v <= 60 for v in seen)
+
+
+# --------------------------------------------------------------------------
+# trace spans: ordering + completeness for every terminal
+# --------------------------------------------------------------------------
+
+def _span_names(comp):
+    assert comp.trace is not None, "terminated request lost its trace"
+    return [n for n, _ in comp.trace["spans"]]
+
+
+def _assert_ordered(comp):
+    ts = [t for _, t in comp.trace["spans"]]
+    assert ts == sorted(ts), f"spans out of order: {comp.trace['spans']}"
+
+
+def test_trace_lifecycle_every_terminal(params):
+    """One server, four fates: a completed request records the full
+    submitted->admitted->prefill_done->first_token->finished chain; a
+    cancelled-in-queue request ends at cancelled with no admission; an
+    expired request ends at expired; a shed request never enters the
+    queue but still reaches the sink with a submitted->shed trace."""
+    sink = []
+    srv = _srv(params, max_queue=2, trace_sink=sink.append)
+    a = Request(prompt=_prompt(5), max_new_tokens=6)
+    b = Request(prompt=_prompt(4, seed=6), max_new_tokens=4)
+    srv.submit(a)
+    srv.submit(b)                   # queue now at max_queue=2
+    shed_req = Request(prompt=_prompt(3, seed=7), max_new_tokens=4)
+    with pytest.raises(QueueFullError) as shed_exc:
+        srv.submit(shed_req)
+    # the 429 handler reads the estimate off the error — no second
+    # lock round trip on the shed fast path
+    assert 1 <= shed_exc.value.retry_after_s <= 60
+    assert srv.cancel(b.id) is True
+    expired = Request(prompt=_prompt(4, seed=8), max_new_tokens=4,
+                      deadline=-1.0)        # monotonic instant in the past
+    srv.submit(expired)
+    done = srv.run_until_drained()
+
+    comp = done[a.id]
+    assert comp.finish_reason == "length"
+    assert _span_names(comp) == ["submitted", "admitted", "prefill_done",
+                                 "first_token", "finished"]
+    _assert_ordered(comp)
+    assert comp.trace["attrs"]["n_tokens"] == len(comp.tokens) == 6
+    assert comp.trace["attrs"]["finish_reason"] == "length"
+    assert comp.trace["attrs"]["prefix_hit_blocks"] == 0
+    assert comp.trace["attrs"]["prompt_tokens"] == 5
+
+    assert _span_names(done[b.id]) == ["submitted", "cancelled"]
+    assert _span_names(done[expired.id]) == ["submitted", "expired"]
+    for rid in (b.id, expired.id):
+        _assert_ordered(done[rid])
+
+    # the shed request reached the sink even though submit() raised
+    by_id = {r["id"]: r for r in sink}
+    assert [n for n, _ in by_id[shed_req.id]["spans"]] == [
+        "submitted", "shed"]
+    assert set(by_id) == {a.id, b.id, expired.id, shed_req.id}, (
+        "every terminated request must reach the trace sink")
+
+    # histogram feed: only the served request has ttft/queue_wait/tpot,
+    # every terminal contributes an e2e observation
+    tel = srv.telemetry
+    assert tel.hist["ttft_s"].count == 1
+    assert tel.hist["queue_wait_s"].count == 1
+    assert tel.hist["tpot_s"].count == 1
+    assert tel.hist["e2e_s"].count == 4
+    assert tel.hist["decode_block_s"].count == srv.blocks_dispatched > 0
+    assert not srv._traces, "trace registry must drain with the requests"
+
+
+def test_trace_mid_decode_cancel(params):
+    """A request cancelled mid-decode still closes its trace in order:
+    the spans it earned (admission, prefill, first token) stay, the
+    terminal is cancelled, and n_tokens matches the partial output."""
+    srv = _srv(params)
+    a = Request(prompt=_prompt(4, seed=9), max_new_tokens=24)
+    c = Request(prompt=_prompt(4, seed=10), max_new_tokens=24)
+    srv.submit(a)
+    srv.submit(c)
+    for _ in range(3):
+        srv.step()
+    assert srv.cancel(a.id) is True
+    done = srv.run_until_drained()
+    comp = done[a.id]
+    assert comp.finish_reason == "cancelled"
+    names = _span_names(comp)
+    assert names[0] == "submitted" and names[-1] == "cancelled"
+    assert "admitted" in names and "prefill_done" in names
+    _assert_ordered(comp)
+    assert comp.trace["attrs"]["n_tokens"] == len(comp.tokens) > 0
+    assert _span_names(done[c.id])[-1] == "finished"
+    assert not srv._traces
+
+
+def test_reset_seals_inflight_traces(params):
+    """reset() after a loop failure must not leak traces: in-flight
+    requests' traces end at the failed terminal, queued ones survive."""
+    sink = []
+    srv = _srv(params, trace_sink=sink.append)
+    a = Request(prompt=_prompt(4, seed=11), max_new_tokens=16)
+    srv.submit(a)
+    srv.step()                          # admit + first block
+    queued = Request(prompt=_prompt(4, seed=12), max_new_tokens=4)
+    srv.submit(queued)
+    lost = srv.reset()
+    assert lost == [a.id]
+    by_id = {r["id"]: r for r in sink}
+    assert [n for n, _ in by_id[a.id]["spans"]][-1] == "failed"
+    assert queued.id in srv._traces, "queued request's trace must survive"
+    done = srv.run_until_drained()
+    assert _span_names(done[queued.id])[-1] == "finished"
+
+
+# --------------------------------------------------------------------------
+# GET /metrics: exposition golden test against a live serve instance
+# --------------------------------------------------------------------------
+
+def _parse_samples(text):
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_labels, value = line.rsplit(" ", 1)
+        out[name_labels] = float(value)
+    return out
+
+
+def test_metrics_endpoint_matches_stats(params):
+    """GET /metrics on a running serve instance: Prometheus-parseable,
+    contains the TTFT/TPOT/queue-wait histograms and every SERVING_*
+    series, histogram buckets are cumulative with _count equal to the
+    +Inf bucket, and the gauge values agree with GET /stats."""
+    srv = _srv(params)
+    app = ServeApp(srv)
+    app.start()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(app))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        comp = app.generate(_prompt(5, seed=13), 5, timeout=120)
+        assert len(comp.tokens) == 5
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=10) as r:
+            stats = json.loads(r.read())
+
+        for line in text.strip().splitlines():
+            assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
+        # every SERVING_* series named in metrics.py is present
+        for attr in dir(_metrics):
+            if attr.startswith("SERVING_"):
+                assert getattr(_metrics, attr) in text, (
+                    f"{attr} series missing from /metrics")
+        for fam in ("serving_ttft_seconds", "serving_tpot_seconds",
+                    "serving_queue_wait_seconds", "serving_e2e_seconds"):
+            assert f"# TYPE {fam} histogram" in text
+            assert f'{fam}_bucket{{le="+Inf"}}' in text
+
+        samples = _parse_samples(text)
+        # histogram buckets are cumulative and consistent with _count
+        buckets = [(nl, v) for nl, v in samples.items()
+                   if nl.startswith("serving_ttft_seconds_bucket")]
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), "buckets must be cumulative"
+        assert counts[-1] == samples["serving_ttft_seconds_count"] == 1
+        # gauges/counters agree with /stats
+        assert samples["serving_queue_depth"] == stats["queued"]
+        assert samples["serving_active_slots"] == stats["active"]
+        assert samples["serving_shed_total"] == stats["shed"]
+        assert samples["serving_retry_after_s"] == stats["retry_after_s"]
+        assert samples["serving_blocks_dispatched_total"] == (
+            stats["blocks_dispatched"])
+        # /stats grew the latency section with the same count
+        assert stats["latency"]["ttft_s"]["count"] == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
+# --------------------------------------------------------------------------
+# satellites: StepTimer clock, telemetry plumbing units
+# --------------------------------------------------------------------------
+
+def test_step_timer_uses_monotonic_clock(monkeypatch):
+    """Durations must come from time.monotonic() — a wall-clock jump
+    (NTP) used to corrupt step stats with negative durations."""
+    from tony_tpu.train import profiling
+
+    fake = {"t": 100.0}
+    monkeypatch.setattr(profiling.time, "monotonic", lambda: fake["t"])
+    timer = profiling.StepTimer(window=4)
+    timer.tick()
+    fake["t"] += 2.5
+    assert timer.tick() == pytest.approx(2.5)
+    assert timer.steps_per_sec == pytest.approx(1 / 2.5)
+
+
+def test_telemetry_trace_feed_units():
+    """observe_trace maps spans to the right histograms, including the
+    per-token TPOT division, without a model in sight."""
+    tel = ServingTelemetry()
+    tr = RequestTrace(7)
+    tr.mark("submitted", t=10.0)
+    tr.mark("admitted", t=10.5)
+    tr.mark("prefill_done", t=10.6)
+    tr.mark("first_token", t=11.0)
+    tr.attrs["n_tokens"] = 5
+    tr.mark("finished", t=11.8)
+    tel.observe_trace(tr)
+    assert tel.hist["queue_wait_s"].sum == pytest.approx(0.5)
+    assert tel.hist["prefill_s"].sum == pytest.approx(0.1)
+    assert tel.hist["ttft_s"].sum == pytest.approx(1.0)
+    assert tel.hist["e2e_s"].sum == pytest.approx(1.8)
+    assert tel.hist["tpot_s"].sum == pytest.approx(0.8 / 4)  # (n-1) steps
+    # a shed trace only feeds e2e
+    tel2 = ServingTelemetry()
+    shed = RequestTrace(8)
+    shed.mark("submitted", t=1.0)
+    shed.mark("shed", t=1.25)
+    tel2.observe_trace(shed)
+    assert tel2.hist["e2e_s"].count == 1
+    assert tel2.hist["ttft_s"].count == 0

@@ -16,9 +16,14 @@ enqueue and wait. POST /generate blocks until the request completes
 long-lived service with its proxy, tony-proxy/.../ProxyServer.java:27-39);
 GET /stats reports slot occupancy, queue depth, the prefix-cache counters
 (hits/misses/evictions, prefill tokens computed vs reused — see
-``--prefix-cache-blocks`` and docs/serving.md), and a MetricsAccumulator
-snapshot of the serving-load gauges, the same shape the portal/history
-layer renders for executor metrics.
+``--prefix-cache-blocks`` and docs/serving.md), the latency-histogram
+quantiles (TTFT/TPOT/queue wait/e2e), and a MetricsAccumulator snapshot
+of the serving-load gauges, the same shape the portal/history layer
+renders for executor metrics. GET /metrics renders the same numbers in
+Prometheus text format (histograms included) so any scraper works with
+no client library; ``--trace-dir`` additionally dumps every terminated
+request's lifecycle trace as JSONL (events/trace.py) for the portal's
+per-request timeline. See docs/observability.md.
 
 Model loading matches lm_generate: an lm_train orbax checkpoint (with the
 matching hyperparam flags), a local HF Llama/Mistral checkpoint dir, or
@@ -108,6 +113,12 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="SIGTERM/SIGINT graceful drain: how long in-"
                         "flight requests get to finish before shutdown")
+    p.add_argument("--trace-dir", default="",
+                   help="dump every terminated request's lifecycle trace "
+                        "as JSONL (requests.trace.jsonl) into this "
+                        "directory — point it at the job's history dir "
+                        "(<intermediate>/<app_id>/) and the portal "
+                        "renders a per-request timeline. Empty = off")
     return p
 
 
@@ -208,6 +219,7 @@ class ServeApp:
     def __init__(self, server, *, max_loop_restarts: int = 3,
                  loop_backoff_s: float = 0.5):
         from ..metrics import MetricsAccumulator
+        from ..train.profiling import StepTimer
 
         self.server = server            # SlotServer
         self.lock = threading.Lock()
@@ -229,6 +241,10 @@ class ServeApp:
         # snapshot rides /stats so the portal/history layer sees serving
         # load next to the resource metrics
         self.metrics = MetricsAccumulator()
+        # scheduling-turn cadence rides the SAME StepTimer the training
+        # loop uses (train/profiling.py, monotonic) and feeds the
+        # loop_turn_s histogram — one timing convention everywhere
+        self._turn_timer = StepTimer()
         self.thread = threading.Thread(
             target=self._loop, name="serve-loop", daemon=True)
 
@@ -339,6 +355,9 @@ class ServeApp:
             if done:
                 self._deliver(done)
             if not busy:
+                # idle: the next busy turn must not record this gap as a
+                # giant scheduling turn in loop_turn_s
+                self._turn_timer.reset_interval()
                 self.wake.wait(0.02)
                 self.wake.clear()
 
@@ -373,6 +392,10 @@ class ServeApp:
 
         print("serving loop failed:\n" + traceback.format_exc(),
               flush=True)
+        # the failed step + the coming backoff must not book into
+        # loop_turn_s as one giant scheduling turn (same contract as the
+        # idle-branch reset)
+        self._turn_timer.reset_interval()
         with self.lock:
             self.loop_failures += 1
             self._restart_streak += 1
@@ -485,7 +508,11 @@ class ServeApp:
 
     def _observe_load(self) -> None:
         """Feed the serving-load gauges (called under the lock, once per
-        scheduling turn — block-paced, so sampling is cheap)."""
+        scheduling turn — block-paced, so sampling is cheap). The turn
+        cadence itself lands in the loop_turn_s histogram, and the
+        histogram quantiles ride back into the accumulator as gauges so
+        the portal/history layer sees TTFT next to the resource
+        metrics without learning a new payload shape."""
         m = self.metrics
         m.observe(_metrics.SERVING_ACTIVE_SLOTS,
                   float(self.server.n_active))
@@ -503,6 +530,107 @@ class ServeApp:
                   float(getattr(self.server, "expired_requests", 0)))
         m.observe(_metrics.SERVING_LOOP_RESTARTS,
                   float(self.loop_restarts))
+        tel = getattr(self.server, "telemetry", None)
+        if tel is not None:
+            dt = self._turn_timer.tick()
+            if dt is not None:
+                tel.observe("loop_turn_s", dt)
+            ttft, tpot = tel.hist["ttft_s"], tel.hist["tpot_s"]
+            if ttft.count:
+                m.observe(_metrics.SERVING_TTFT_P50_S, ttft.quantile(0.5))
+                m.observe(_metrics.SERVING_TTFT_P99_S, ttft.quantile(0.99))
+            if tpot.count:
+                m.observe(_metrics.SERVING_TPOT_P50_S, tpot.quantile(0.5))
+                m.observe(_metrics.SERVING_TPOT_P99_S, tpot.quantile(0.99))
+        est = getattr(self.server, "estimate_retry_after", None)
+        if callable(est):
+            m.observe(_metrics.SERVING_RETRY_AFTER_S, float(est()))
+
+    def retry_after_s(self) -> int:
+        """The 429 Retry-After value: the engine's service-rate estimate
+        (seconds until a queue seat frees, [1, 60]); 1 when the engine
+        has no estimator (test stubs) or the estimate fails."""
+        est = getattr(self.server, "estimate_retry_after", None)
+        if not callable(est):
+            return 1
+        try:
+            with self.lock:
+                return max(1, min(60, int(est())))
+        except Exception:
+            return 1
+
+    def prometheus_metrics(self) -> str:
+        """The GET /metrics payload: every /stats number in Prometheus
+        text format — SERVING_* gauges/counters, loop lifecycle, the
+        latency histograms (cumulative buckets), and the
+        MetricsAccumulator snapshot as labeled gauges."""
+        from ..observability import PromRenderer, TELEMETRY_HISTOGRAMS
+
+        st = self.stats()
+        r = PromRenderer()
+        r.gauge("serving_slots", st.get("slots", 0),
+                "configured KV-cache slots")
+        r.gauge(_metrics.SERVING_ACTIVE_SLOTS, st.get("active", 0),
+                "slots holding an unfinished request")
+        r.gauge(_metrics.SERVING_QUEUE_DEPTH, st.get("queued", 0),
+                "requests waiting for a slot")
+        computed = st.get("prefill_tokens_computed", 0)
+        reused = st.get("prefill_tokens_reused", 0)
+        if computed + reused > 0:
+            r.gauge(_metrics.SERVING_PREFILL_REUSED_FRAC,
+                    reused / (computed + reused),
+                    "fraction of prefill tokens served from the prefix "
+                    "cache")
+        r.gauge(_metrics.SERVING_RETRY_AFTER_S,
+                st.get("retry_after_s", 1),
+                "current 429 Retry-After estimate (seconds until a "
+                "queue seat frees)")
+        for name, key, help_text in (
+                (_metrics.SERVING_SHED_TOTAL, "shed",
+                 "requests refused with queue full (HTTP 429)"),
+                (_metrics.SERVING_CANCELLED_TOTAL, "cancelled",
+                 "requests cancelled by their waiter"),
+                (_metrics.SERVING_EXPIRED_TOTAL, "expired",
+                 "requests whose deadline passed while queued"),
+                ("serving_engine_resets_total", "resets",
+                 "SlotServer.reset() recoveries"),
+                ("serving_blocks_dispatched_total", "blocks_dispatched",
+                 "decode blocks dispatched to the device"),
+                ("serving_admission_dispatches_total",
+                 "admission_dispatches", "prefill programs dispatched"),
+                ("serving_prefill_tokens_computed_total",
+                 "prefill_tokens_computed",
+                 "prompt tokens prefilled through the model"),
+                ("serving_prefill_tokens_reused_total",
+                 "prefill_tokens_reused",
+                 "prompt tokens copied from the prefix cache"),
+        ):
+            if key in st:
+                r.counter(name, st[key], help_text)
+        loop = st.get("loop", {})
+        r.counter(_metrics.SERVING_LOOP_RESTARTS,
+                  loop.get("restarts", self.loop_restarts),
+                  "successful serving-loop recoveries")
+        r.counter("serving_loop_failures_total",
+                  loop.get("failures", self.loop_failures),
+                  "serving-loop step failures")
+        r.gauge("serving_loop_up",
+                0 if loop.get("status", self.status) == "down" else 1,
+                "1 unless the serving loop is terminally down")
+        tel = getattr(self.server, "telemetry", None)
+        if tel is not None:
+            # render under the serving lock: the loop thread mutates the
+            # histograms under it, and a mid-observe scrape would emit
+            # buckets disagreeing with _count/_sum
+            with self.lock:
+                for name, help_text in TELEMETRY_HISTOGRAMS.items():
+                    prom = "serving_" + name[:-2] + "_seconds"
+                    r.histogram(prom, tel.hist[name], help_text)
+        for entry in st.get("metrics", []):
+            r.gauge("serving_task_metric", entry["value"],
+                    "MetricsAccumulator snapshot (max_/avg_ per gauge)",
+                    labels={"name": entry["name"]})
+        return r.render()
 
     def health(self) -> dict:
         """The /healthz payload: ``status`` is the lifecycle word
@@ -577,6 +705,15 @@ def make_handler(app: ServeApp):
                 self._send(200 if payload["healthy"] else 503, payload)
             elif self.path == "/stats":
                 self._send(200, app.stats())
+            elif self.path == "/metrics":
+                from ..observability import PROM_CONTENT_TYPE
+
+                body = app.prometheus_metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", PROM_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._send(404, {"error": "unknown path"})
 
@@ -616,9 +753,16 @@ def make_handler(app: ServeApp):
             except QueueFullError as e:
                 # shed: the queue is full. 429 + Retry-After is the
                 # load-balancer contract — retry elsewhere/later instead
-                # of queueing into a deadline miss
-                self._send(429, {"error": str(e)},
-                           headers={"Retry-After": "1"})
+                # of queueing into a deadline miss. The header is the
+                # engine's service-rate estimate of seconds until a queue
+                # seat frees (EWMA over served requests, clamped [1, 60]),
+                # not a constant — a saturated queue advertises a longer
+                # retry than a momentarily full one. The engine attaches
+                # the estimate to the error (computed under the lock the
+                # submit already held); the fallback re-asks the app.
+                ra = getattr(e, "retry_after_s", 0)
+                self._send(429, {"error": str(e)}, headers={
+                    "Retry-After": str(ra if ra else app.retry_after_s())})
                 return
             except ServingLoopError as e:
                 self._send(503, {"error": str(e)})
@@ -679,6 +823,13 @@ def main(argv=None) -> int:
         prefix_cache_blocks=args.prefix_cache_blocks,
         cache_prompts=not args.no_cache_prompts,
         max_queue=args.max_queue)
+    trace_writer = None
+    if args.trace_dir:
+        from ..events.trace import TraceWriter
+
+        trace_writer = TraceWriter(args.trace_dir)
+        slot_server.trace_sink = trace_writer.write
+        print(f"request traces -> {trace_writer.path}", flush=True)
     app = ServeApp(slot_server, max_loop_restarts=args.loop_max_restarts,
                    loop_backoff_s=args.loop_backoff_s)
     app.start()
@@ -719,6 +870,8 @@ def main(argv=None) -> int:
     finally:
         app.shutdown()      # no-op after a completed drain
         httpd.server_close()
+        if trace_writer is not None:
+            trace_writer.close()
     return 0
 
 

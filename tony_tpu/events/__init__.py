@@ -11,6 +11,7 @@ lines instead of Avro — same information, greppable, no codegen.
 
 from .types import Event, EventType
 from .handler import EventHandler
+from .trace import TRACE_FILE, TraceWriter, read_traces
 from .history import (
     history_file_name,
     parse_history_file_name,
@@ -22,6 +23,9 @@ __all__ = [
     "Event",
     "EventType",
     "EventHandler",
+    "TRACE_FILE",
+    "TraceWriter",
+    "read_traces",
     "history_file_name",
     "parse_history_file_name",
     "HistoryFileMover",

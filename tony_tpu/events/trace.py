@@ -1,0 +1,78 @@
+"""Request-trace JSONL next to the job's history events.
+
+The jhist stream (handler.py) records JOB lifecycle events; serving
+needs a parallel record at REQUEST granularity — one line per
+terminated request, carrying its lifecycle spans (observability.
+RequestTrace.to_dict()). Kept as a sibling file (``requests.trace.
+jsonl``) rather than interleaved into the jhist stream: traces are
+high-rate relative to job events, the portal renders them as their own
+timeline page, and the history mover relocates the whole job directory
+so the sibling travels with the events for free.
+
+Writes are line-buffered appends under a lock (the serving loop emits
+one record per terminated request — low rate; a queue-draining thread
+like EventHandler's would be ceremony here). Trace timestamps are host
+``time.monotonic()`` values — meaningful relative to each other within
+one server process, anchored to wall-clock by the record's
+``attrs.submitted_unix``. A restarted server APPENDS to the same file
+with a fresh monotonic epoch and a fresh request-id counter: per-record
+durations stay exact, but cross-record ordering (and id uniqueness)
+only holds within one process lifetime — use ``attrs.submitted_unix``
+to order across restarts.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+TRACE_FILE = "requests.trace.jsonl"
+
+
+class TraceWriter:
+    """Append-only JSONL sink for request trace records; thread-safe,
+    best-effort (a failed write is logged, never raised — telemetry
+    must not take down the serving loop)."""
+
+    def __init__(self, job_dir: str | Path, filename: str = TRACE_FILE):
+        self._dir = Path(job_dir)
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self.path = self._dir / filename
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a")
+
+    def write(self, record: dict) -> None:
+        try:
+            line = json.dumps(record)
+            with self._lock:
+                self._f.write(line + "\n")
+                self._f.flush()
+        except Exception:
+            log.exception("failed writing trace record")
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except Exception:
+                log.exception("failed closing trace file")
+
+
+def read_traces(path: str | Path) -> list[dict]:
+    """Parse a trace JSONL file; malformed lines are skipped (a record
+    torn by a crash must not hide every other request's trace)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                log.warning("skipping malformed trace line in %s", path)
+    return out

@@ -17,6 +17,10 @@ class EventType(str, enum.Enum):
     APPLICATION_FINISHED = "APPLICATION_FINISHED"
     TASK_STARTED = "TASK_STARTED"
     TASK_FINISHED = "TASK_FINISHED"
+    # one serving request's lifecycle spans (observability.RequestTrace);
+    # normally a sibling JSONL file (events/trace.py), but embeddable in
+    # a jhist stream when a job wants request traces in its history
+    REQUEST_TRACE = "REQUEST_TRACE"
 
 
 @dataclass
@@ -64,3 +68,8 @@ def task_finished(task_id: str, status: str, exit_code: int,
     return Event(EventType.TASK_FINISHED,
                  {"task_id": task_id, "status": status, "exit_code": exit_code,
                   "metrics": metrics or []})
+
+
+def request_trace(trace: dict[str, Any]) -> Event:
+    """``trace`` is a RequestTrace.to_dict() record (id, spans, attrs)."""
+    return Event(EventType.REQUEST_TRACE, {"trace": trace})

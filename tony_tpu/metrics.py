@@ -38,6 +38,15 @@ SERVING_SHED_TOTAL = "serving_shed_total"
 SERVING_CANCELLED_TOTAL = "serving_cancelled_total"
 SERVING_EXPIRED_TOTAL = "serving_expired_total"
 SERVING_LOOP_RESTARTS = "serving_loop_restarts"
+# latency gauges sampled from the observability histograms (tony_tpu/
+# observability.py): quantiles at observation time, host-monotonic spans.
+# The histograms themselves are exposed in full on GET /metrics; these
+# gauge snapshots exist so the /stats + portal path needs no new shape.
+SERVING_TTFT_P50_S = "serving_ttft_p50_s"
+SERVING_TTFT_P99_S = "serving_ttft_p99_s"
+SERVING_TPOT_P50_S = "serving_tpot_p50_s"
+SERVING_TPOT_P99_S = "serving_tpot_p99_s"
+SERVING_RETRY_AFTER_S = "serving_retry_after_s"
 
 
 def _proc_tree_rss_mb(root_pid: int) -> float:

@@ -144,6 +144,11 @@ import numpy as np
 from jax import lax
 
 from .. import constants as c
+from ..observability import (
+    RequestTrace,
+    ServiceRateEstimator,
+    ServingTelemetry,
+)
 
 log = logging.getLogger(__name__)
 
@@ -203,6 +208,10 @@ class Completion:
     id: int
     tokens: list[int]
     finish_reason: str    # "stop" | "length" | "cancelled" | "expired"
+    # the request's lifecycle trace (observability.RequestTrace.to_dict():
+    # host-monotonic span events + attrs) — None only for engines that
+    # don't record traces (test stubs)
+    trace: dict | None = None
 
 
 class QueueFullError(RuntimeError):
@@ -857,7 +866,7 @@ class SlotServer:
                  seed: int = 0, pipeline_depth: int = 2,
                  mesh=None, rules=None, batched_admission: bool = True,
                  prefix_cache_blocks: int = 0, cache_prompts: bool = True,
-                 max_queue: int = 0):
+                 max_queue: int = 0, trace_sink=None):
         if not cfg.causal:
             raise ValueError("serving requires a causal model")
         if isinstance(params, DecodeWeights):
@@ -915,6 +924,16 @@ class SlotServer:
         self.resets = 0                 # reset() calls (loop recoveries)
         self.blocks_dispatched = 0      # decode blocks sent to the device
         self.max_queue = int(max_queue)
+        # ---- request-level telemetry (observability.py) ----
+        # every submitted request carries a RequestTrace from submit to
+        # its terminal span; finished traces feed the latency histograms,
+        # the Retry-After service-rate EWMA, and (when set) trace_sink —
+        # a callable given each terminated trace's dict (the serve CLI
+        # wires events.trace.TraceWriter.write here). All host-side.
+        self.telemetry = ServingTelemetry()
+        self.trace_sink = trace_sink
+        self._traces: dict[int, RequestTrace] = {}
+        self._rate = ServiceRateEstimator()
         # drain support: ServeApp.shutdown(drain=True) parks admission so
         # in-flight slots finish while nothing new starts
         self.pause_admission = False
@@ -1098,6 +1117,8 @@ class SlotServer:
                 f"request needs {prompt.size} prompt + "
                 f"{request.max_new_tokens} new tokens but slots hold "
                 f"max_len={self.max_len}")
+        tr = RequestTrace(request.id)
+        tr.mark("submitted")
         if self.max_queue and len(self._queue) >= self.max_queue:
             # shed at the door: an unbounded queue converts overload into
             # unbounded latency for EVERY admitted request; a bounded one
@@ -1108,9 +1129,19 @@ class SlotServer:
             self._sweep_expired()
             if len(self._queue) >= self.max_queue:
                 self.shed_requests += 1
-                raise QueueFullError(
+                # a shed request still leaves a (two-span) trace: shedding
+                # must be as visible per-request as it is in the counters
+                self._seal_trace(tr, "shed")
+                err = QueueFullError(
                     f"queue full ({self.max_queue} waiting); request shed")
+                # ride the estimate on the error: the 429 handler already
+                # holds whatever lock guards this server — making it call
+                # back for the header would buy a second lock wait on the
+                # shed fast path, at peak load
+                err.retry_after_s = self.estimate_retry_after()
+                raise err
         request.prompt = prompt
+        self._traces[request.id] = tr
         self._queue.append(request)
         return request.id
 
@@ -1129,7 +1160,9 @@ class SlotServer:
         for req in self._queue:
             if req.deadline is not None and now > req.deadline:
                 self.expired_requests += 1
-                self._done[req.id] = Completion(req.id, [], "expired")
+                self._done[req.id] = Completion(
+                    req.id, [], "expired",
+                    trace=self._finish_trace(req.id, "expired"))
             else:
                 kept.append(req)
         self._queue = kept
@@ -1154,8 +1187,9 @@ class SlotServer:
                 del self._queue[i]      # by index: Request's array field
                 #                         makes == comparisons ambiguous
                 self.cancelled_requests += 1
-                self._done[request_id] = Completion(request_id, [],
-                                                    "cancelled")
+                self._done[request_id] = Completion(
+                    request_id, [], "cancelled",
+                    trace=self._finish_trace(request_id, "cancelled"))
                 return True
         slot = self._slot_of.get(request_id)
         if slot is None:
@@ -1183,6 +1217,8 @@ class SlotServer:
         are returned so the caller fails them upstream instead of letting
         their waiters hang."""
         failed = sorted(self._inflight)
+        for rid in failed:      # their traces end here, not in a leak
+            self._finish_trace(rid, "failed")
         self._prefix_refs.clear()
         self._init_device_state()
         if self._prefix_blocks:
@@ -1196,6 +1232,8 @@ class SlotServer:
         shutdown path: the caller owns telling their waiters why."""
         out = list(self._queue)
         self._queue.clear()
+        for req in out:
+            self._finish_trace(req.id, "failed")
         return out
 
     def _release_request(self, request_id: int) -> None:
@@ -1206,6 +1244,45 @@ class SlotServer:
         path = self._prefix_refs.pop(request_id, None)
         if path is not None:
             self._prefix_cache.release(path)
+
+    # -------------------------------------------------------------- tracing
+
+    def _seal_trace(self, tr: RequestTrace, terminal: str, *,
+                    n_tokens: int = 0, reason: str | None = None) -> dict:
+        """Close a trace with its terminal span, feed the latency
+        histograms and (for requests that actually held a slot) the
+        Retry-After service-rate EWMA, and hand the record to the sink.
+        Returns the dict that rides ``Completion.trace``."""
+        tr.attrs["n_tokens"] = n_tokens
+        tr.attrs["finish_reason"] = reason if reason is not None else terminal
+        tr.mark(terminal)
+        self.telemetry.observe_trace(tr)
+        svc = tr.dur("admitted", terminal)
+        if svc is not None and svc >= 0:
+            self._rate.observe(svc)
+        record = tr.to_dict()
+        if self.trace_sink is not None:
+            try:        # telemetry must never take down the serving loop
+                self.trace_sink(record)
+            except Exception:
+                log.exception("trace sink failed")
+        return record
+
+    def _finish_trace(self, request_id: int, terminal: str, *,
+                      n_tokens: int = 0,
+                      reason: str | None = None) -> dict | None:
+        tr = self._traces.pop(request_id, None)
+        if tr is None:          # engine driven without traces (reset races)
+            return None
+        return self._seal_trace(tr, terminal, n_tokens=n_tokens,
+                                reason=reason)
+
+    def estimate_retry_after(self) -> int:
+        """Data-driven ``Retry-After``: seconds until a queue seat frees,
+        from the EWMA service time of recently served requests and the
+        current backlog — clamped to [1, 60] integer seconds, monotone
+        in queue depth (observability.ServiceRateEstimator)."""
+        return self._rate.retry_after_s(len(self._queue), self.slots)
 
     @property
     def pending(self) -> int:
@@ -1268,6 +1345,10 @@ class SlotServer:
             "expired": self.expired_requests,
             "resets": self.resets,
             "chaos_faults_injected": self.chaos_faults_injected,
+            # latency telemetry: per-histogram count + p50/p90/p99 (host-
+            # monotonic; see docs/observability.md for the span schema)
+            "latency": self.telemetry.snapshot(),
+            "retry_after_s": self.estimate_retry_after(),
         }
         pc = self._prefix_cache
         if pc is not None:
@@ -1361,6 +1442,11 @@ class SlotServer:
                     self.prefill_tokens_reused += prefix_len
             chunk_starts = (list(range(prefix_len, body.size, C))
                             or [prefix_len])
+            tr = self._traces.get(req.id)
+            if tr is not None:
+                tr.attrs["prompt_tokens"] = int(prompt.size)
+                tr.attrs["prefix_hit_blocks"] = len(path)
+                tr.mark("admitted")
             admissions.append(_Admission(
                 slot=slot, req=req, body=body, offset=offset, target=target,
                 temp=temp, topk=topk, chunk_starts=chunk_starts,
@@ -1376,6 +1462,12 @@ class SlotServer:
         self._dispatch_prefix_insert(admissions)
         for adm in admissions:
             slot, req, body = adm.slot, adm.req, adm.body
+            tr = self._traces.get(req.id)
+            if tr is not None:
+                # host DISPATCH completion (programs are async): the span
+                # measures how long admission kept the scheduling loop,
+                # which is exactly what it costs live traffic
+                tr.mark("prefill_done")
             self._host_busy[slot] = True
             self._np_temps[slot] = adm.temp
             self._np_topks[slot] = adm.topk
@@ -1564,7 +1656,10 @@ class SlotServer:
             # the counter its optimistic True incremented
             self.cancelled_requests -= 1
             return
-        self._done[rid] = Completion(rid, self._emitted[slot], "cancelled")
+        out = self._emitted[slot]
+        self._done[rid] = Completion(
+            rid, out, "cancelled",
+            trace=self._finish_trace(rid, "cancelled", n_tokens=len(out)))
         self._requests[slot] = None
         self._emitted[slot] = []
         self._host_busy[slot] = False
@@ -1572,6 +1667,7 @@ class SlotServer:
         self._release_request(rid)
 
     def _dispatch_block(self) -> None:
+        t0 = time.monotonic()
         self._key, sub = jax.random.split(self._key)
         (self._cache, self._d_tokens, self._d_active, packed) = _decode_block(
             self._params, self._fused, self._cache,
@@ -1592,6 +1688,9 @@ class SlotServer:
             shardings=self._shardings)
         self._cursor = (self._cursor + self.block_size) % self.max_len
         self.blocks_dispatched += 1
+        # host DISPATCH time (the program runs async): what a decode
+        # block costs the scheduling loop, not device execution time
+        self.telemetry.observe("decode_block_s", time.monotonic() - t0)
         self._pipeline.append({"packed": packed, "events": []})
         if self._predictive:            # exact: no EOS can surprise us
             adv = np.minimum(self.block_size,
@@ -1621,13 +1720,25 @@ class SlotServer:
                 packed[:, :-2], packed[:, -2], packed[:, -1].astype(bool))
             for slot in np.nonzero(self._expect_active)[0]:
                 n = int(lengths[slot] - self._expect_len[slot])
+                had_tokens = bool(self._emitted[slot])
                 self._emitted[slot].extend(int(t) for t in toks[slot, :n])
+                req = self._requests[slot]
+                if not had_tokens and n > 0 and req is not None:
+                    # first emitted token OBSERVED by the host — the TTFT
+                    # span (lags the device by the processing pipeline;
+                    # trace timestamps are host-monotonic by contract)
+                    tr = self._traces.get(req.id)
+                    if tr is not None and tr.t("first_token") is None:
+                        tr.mark("first_token")
                 if not active[slot]:
-                    req = self._requests[slot]
                     out = self._emitted[slot]
                     reason = ("stop" if out and out[-1] in self.stop_tokens
                               else "length")
-                    self._done[req.id] = Completion(req.id, out, reason)
+                    self._done[req.id] = Completion(
+                        req.id, out, reason,
+                        trace=self._finish_trace(
+                            req.id, "finished", n_tokens=len(out),
+                            reason=reason))
                     self._requests[slot] = None
                     self._emitted[slot] = []
                     self._host_busy[slot] = False

@@ -1,0 +1,343 @@
+"""Request-level serving telemetry: traces, histograms, exposition.
+
+The reference TonY ships metrics/history/portal plumbing but no tracing
+subsystem (SURVEY.md §5); after continuous batching, the prefix cache,
+and the failure model, the serving stack's behavior was visible only
+through cumulative counters — no way to answer "what is p99 TTFT right
+now" or "where did request 1234's 3 seconds go". This module is the
+shared observability layer every serving component feeds:
+
+- **``RequestTrace``** — per-request lifecycle spans on the HOST
+  monotonic clock (``time.monotonic()``; never device time — decode is
+  dispatched asynchronously, so span timestamps mark when the *host*
+  observed each transition, which for ``first_token``/``finished`` is
+  the event-log replay position in ``SlotServer._process``, lagging the
+  device by up to ``pipeline_depth`` blocks). Span order for a served
+  request: ``submitted -> admitted -> prefill_done -> first_token ->
+  finished``; requests that never serve end at ``cancelled``,
+  ``expired``, ``shed``, or ``failed`` instead. Dumped as JSONL next to
+  the job's history events (events/trace.py) so the portal can render a
+  per-request waterfall.
+- **``Histogram``** — fixed log-spaced buckets, mergeable, with
+  quantile estimation. Fixed buckets (vs t-digest et al) because they
+  merge across servers by integer addition and render directly as
+  Prometheus cumulative buckets.
+- **``ServingTelemetry``** — the named latency histograms (TTFT, TPOT,
+  queue wait, e2e, prefill dispatch, decode-block dispatch, loop turn)
+  fed from trace spans; ``SlotServer.stats()`` and ``/metrics`` both
+  read it.
+- **``ServiceRateEstimator``** — EWMA of observed per-request service
+  time; turns "queue is full" into a data-driven ``Retry-After``
+  (seconds until a queue seat frees) instead of a constant 1s.
+- **``PromRenderer``** — Prometheus text exposition (``# HELP`` /
+  ``# TYPE`` format, version 0.0.4) so any scraper works with no
+  client library; ``ServeApp`` and the portal share it.
+
+See docs/observability.md for metric names, the trace schema, and a
+scrape example.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import re
+import time
+
+# terminal span names: exactly one ends every trace
+TERMINAL_SPANS = ("finished", "cancelled", "expired", "shed", "failed")
+
+
+class Histogram:
+    """Fixed log-spaced-bucket histogram of non-negative values.
+
+    ``per_decade`` buckets between successive powers of ten from ``lo``
+    to ``hi`` (values above ``hi`` land in the +Inf overflow bucket,
+    values at or below ``lo`` in the first). Bucket ``i`` counts values
+    ``v <= bounds[i]`` exclusive of earlier buckets — the same
+    upper-bound (``le``) semantics Prometheus cumulative buckets use,
+    so exposition is a running sum, no re-binning.
+
+    ``merge`` adds another histogram's counts (bounds must match) —
+    per-slot or per-server histograms aggregate by addition.
+    ``quantile`` linearly interpolates inside the containing bucket
+    (the first bucket's lower edge is 0; the overflow bucket reports
+    its lower edge, i.e. ``hi`` — the honest answer when the tail is
+    unbounded)."""
+
+    __slots__ = ("bounds", "counts", "count", "sum")
+
+    def __init__(self, lo: float = 1e-3, hi: float = 120.0,
+                 per_decade: int = 5):
+        if not (0 < lo < hi):
+            raise ValueError(f"need 0 < lo < hi, got {lo}, {hi}")
+        n = int(math.ceil(math.log10(hi / lo) * per_decade)) + 1
+        bounds = [lo * 10 ** (i / per_decade) for i in range(n)]
+        # the log series rarely lands on hi exactly; clamp so the last
+        # finite bucket ends AT hi and anything above is +Inf, as the
+        # contract above says
+        self.bounds = [b for b in bounds if b < hi] + [float(hi)]
+        self.counts = [0] * (n + 1)         # +1: the +Inf overflow bucket
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.count += 1
+        self.sum += value
+
+    def merge(self, other: "Histogram") -> None:
+        if other.bounds != self.bounds:
+            raise ValueError("cannot merge histograms with different buckets")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+
+    def quantile(self, q: float) -> float:
+        """q in [0, 1] -> estimated value; 0.0 on an empty histogram."""
+        if not 0 <= q <= 1:
+            raise ValueError(f"quantile wants q in [0, 1], got {q}")
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if seen + c >= rank and c > 0:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = (self.bounds[i] if i < len(self.bounds)
+                      else self.bounds[-1])
+                if hi <= lo:                # overflow bucket: lower edge
+                    return lo
+                return lo + (hi - lo) * max(0.0, rank - seen) / c
+            seen += c
+        return self.bounds[-1]
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def snapshot(self) -> dict:
+        """The /stats payload for one histogram: count + headline
+        quantiles (bucket-resolution estimates, see ``quantile``)."""
+        return {
+            "count": self.count,
+            "mean_s": round(self.mean, 6),
+            "p50_s": round(self.quantile(0.50), 6),
+            "p90_s": round(self.quantile(0.90), 6),
+            "p99_s": round(self.quantile(0.99), 6),
+        }
+
+
+class RequestTrace:
+    """One request's lifecycle spans: (name, t_monotonic) pairs in the
+    order the HOST observed them, plus free-form ``attrs``
+    (prefix_hit_blocks, n_tokens, finish_reason, ...). ``submitted_unix``
+    anchors the monotonic timeline to wall-clock for display only —
+    durations always come from the monotonic spans."""
+
+    __slots__ = ("id", "spans", "attrs")
+
+    def __init__(self, request_id: int):
+        self.id = request_id
+        self.spans: list[tuple[str, float]] = []
+        self.attrs: dict = {"submitted_unix": time.time()}
+
+    def mark(self, name: str, t: float | None = None) -> None:
+        self.spans.append((name, time.monotonic() if t is None else t))
+
+    def t(self, name: str) -> float | None:
+        for n, t in self.spans:
+            if n == name:
+                return t
+        return None
+
+    def dur(self, a: str, b: str) -> float | None:
+        """Seconds from span ``a`` to span ``b``; None unless both
+        were recorded."""
+        ta, tb = self.t(a), self.t(b)
+        return None if ta is None or tb is None else tb - ta
+
+    @property
+    def terminal(self) -> str | None:
+        if self.spans and self.spans[-1][0] in TERMINAL_SPANS:
+            return self.spans[-1][0]
+        return None
+
+    def to_dict(self) -> dict:
+        return {"id": self.id,
+                "spans": [[n, round(t, 6)] for n, t in self.spans],
+                "attrs": dict(self.attrs)}
+
+
+# histogram name -> HELP text; the keys are the ``ServingTelemetry``
+# vocabulary and (with _s -> _seconds) the /metrics series names
+TELEMETRY_HISTOGRAMS = {
+    "ttft_s": "time from submit to the host observing the first emitted "
+              "token (host monotonic clock; lags the device by the "
+              "processing pipeline)",
+    "tpot_s": "mean time per output token after the first, per request",
+    "queue_wait_s": "time from submit to admission into a slot",
+    "e2e_s": "time from submit to the terminal span (any finish reason)",
+    "prefill_s": "admission-burst prefill dispatch time (host-side)",
+    "decode_block_s": "host dispatch time of one decode block (async "
+                      "dispatch, not device execution time)",
+    "loop_turn_s": "one ServeApp scheduling turn",
+}
+
+
+class ServingTelemetry:
+    """The serving path's latency histograms, fed from trace spans (and
+    directly for dispatch timings). One instance per SlotServer;
+    everything here is host bookkeeping — no locks (callers serialize
+    on the serving lock) and no device interaction."""
+
+    def __init__(self):
+        self.hist = {name: Histogram() for name in TELEMETRY_HISTOGRAMS}
+
+    def observe(self, name: str, seconds: float) -> None:
+        self.hist[name].observe(seconds)
+
+    def observe_trace(self, trace: RequestTrace) -> None:
+        """Fold one finished trace into the histograms. Only spans that
+        were actually recorded contribute — a shed request feeds e2e
+        (its rejection latency) but no ttft."""
+        for name, a, b in (("queue_wait_s", "submitted", "admitted"),
+                           ("prefill_s", "admitted", "prefill_done"),
+                           ("ttft_s", "submitted", "first_token")):
+            d = trace.dur(a, b)
+            if d is not None:
+                self.hist[name].observe(max(0.0, d))
+        if trace.spans:
+            e2e = trace.spans[-1][1] - trace.spans[0][1]
+            self.hist["e2e_s"].observe(max(0.0, e2e))
+        n_tokens = trace.attrs.get("n_tokens", 0)
+        d = trace.dur("first_token", "finished")
+        if d is not None and n_tokens >= 2:
+            self.hist["tpot_s"].observe(max(0.0, d) / (n_tokens - 1))
+
+    def snapshot(self) -> dict:
+        """{histogram name: {count, mean, p50, p90, p99}} — the
+        ``SlotServer.stats()["latency"]`` payload."""
+        return {name: h.snapshot() for name, h in self.hist.items()
+                if h.count}
+
+
+class ServiceRateEstimator:
+    """EWMA of observed per-request service time (admission ->
+    slot-freeing terminal), turned into a Retry-After estimate.
+
+    With S slots serving concurrently at ~``ewma`` seconds per request,
+    slots free at S/ewma per second; a queue of Q waiting requests plus
+    the shed one drains in ewma * (Q + 1) / S seconds — monotonic in
+    queue depth, so a deeper backlog always advertises a longer (never
+    shorter) retry. Clamped to [1, 60] integer seconds: sub-second
+    estimates round up to the header's 1s floor, and past a minute the
+    estimate says "overloaded", not "come back in exactly 7 minutes"."""
+
+    __slots__ = ("_ewma", "alpha", "default_s")
+
+    def __init__(self, alpha: float = 0.2, default_s: float = 1.0):
+        self.alpha = alpha
+        self.default_s = default_s
+        self._ewma: float | None = None
+
+    def observe(self, service_s: float) -> None:
+        if service_s < 0:
+            return
+        self._ewma = (service_s if self._ewma is None
+                      else self.alpha * service_s
+                      + (1 - self.alpha) * self._ewma)
+
+    @property
+    def service_time_s(self) -> float:
+        return self._ewma if self._ewma is not None else self.default_s
+
+    def retry_after_s(self, queued: int, slots: int) -> int:
+        eta = self.service_time_s * (max(0, queued) + 1) / max(1, slots)
+        return int(min(60, max(1, math.ceil(eta))))
+
+
+# ------------------------------------------------------------- exposition
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _sanitize(name: str) -> str:
+    name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return name if _NAME_OK.match(name) else "_" + name
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _labels(labels: dict | None) -> str:
+    if not labels:
+        return ""
+    esc = {ord("\\"): "\\\\", ord('"'): '\\"', ord("\n"): "\\n"}
+    return "{" + ",".join(
+        f'{_sanitize(k)}="{str(v).translate(esc)}"'
+        for k, v in labels.items()) + "}"
+
+
+class PromRenderer:
+    """Prometheus text-format (0.0.4) builder. ``# HELP``/``# TYPE``
+    are emitted once per family, on first use; multiple label sets of
+    one family group under it. No client library — the format is three
+    line shapes and a content type."""
+
+    def __init__(self):
+        self._families: dict[str, list[str]] = {}
+        self._order: list[str] = []
+
+    def _family(self, name: str, kind: str, help_text: str) -> list[str]:
+        name = _sanitize(name)
+        fam = self._families.get(name)
+        if fam is None:
+            fam = []
+            if help_text:
+                fam.append(f"# HELP {name} {help_text}")
+            fam.append(f"# TYPE {name} {kind}")
+            self._families[name] = fam
+            self._order.append(name)
+        return fam
+
+    def gauge(self, name: str, value: float, help_text: str = "",
+              labels: dict | None = None) -> None:
+        self._sample(name, "gauge", value, help_text, labels)
+
+    def counter(self, name: str, value: float, help_text: str = "",
+                labels: dict | None = None) -> None:
+        self._sample(name, "counter", value, help_text, labels)
+
+    def _sample(self, name, kind, value, help_text, labels) -> None:
+        fam = self._family(name, kind, help_text)
+        fam.append(f"{_sanitize(name)}{_labels(labels)} {_fmt(value)}")
+
+    def histogram(self, name: str, hist: Histogram,
+                  help_text: str = "") -> None:
+        name = _sanitize(name)
+        fam = self._family(name, "histogram", help_text)
+        cum = 0
+        for bound, c in zip(hist.bounds + [math.inf], hist.counts):
+            cum += c
+            fam.append(f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
+        fam.append(f"{name}_sum {_fmt(hist.sum)}")
+        fam.append(f"{name}_count {hist.count}")
+
+    def render(self) -> str:
+        return "\n".join(
+            line for fam in self._order for line in self._families[fam]
+        ) + "\n"
+
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+__all__ = ["Histogram", "RequestTrace", "ServingTelemetry",
+           "ServiceRateEstimator", "PromRenderer", "PROM_CONTENT_TYPE",
+           "TELEMETRY_HISTOGRAMS", "TERMINAL_SPANS"]

@@ -5,6 +5,12 @@ Mirrors tony-portal (Play app): routes `/`, `/jobs/<id>`, `/config/<id>`,
 (tony-portal/app/cache/CacheWrapper.java:28-76 — here a TTL dict), and the
 mover/purger housekeeping threads (HistoryFileMover/HistoryFilePurger) run
 in-process. Stdlib http.server: no web-framework dependency.
+
+Observability additions (docs/observability.md): `/traces/<id>` renders a
+per-request timeline from the job's ``requests.trace.jsonl`` (written by
+``serve --trace-dir``, TTL-cached like the event stream), and `/metrics`
+exposes the portal's own request counters/latency in Prometheus text
+format through the same renderer the serve endpoint uses.
 """
 
 from __future__ import annotations
@@ -26,6 +32,8 @@ from ..events.history import (
     HistoryFilePurger,
     parse_history_file_name,
 )
+from ..events.trace import TRACE_FILE, read_traces
+from ..observability import PROM_CONTENT_TYPE, Histogram, PromRenderer
 
 log = logging.getLogger(__name__)
 
@@ -61,6 +69,7 @@ class HistoryIndex:
         self.staging = Path(str(conf.get(keys.STAGING_DIR)))
         self._meta_cache = _TTLCache(ttl_s=10)
         self._events_cache = _TTLCache(ttl_s=30)
+        self._trace_cache = _TTLCache(ttl_s=30)
 
     def _job_dirs(self):
         for root in (self.intermediate, self.finished):
@@ -105,6 +114,22 @@ class HistoryIndex:
             ]
 
         return self._events_cache.get(("events", app_id), load)
+
+    def traces(self, app_id: str) -> list[dict] | None:
+        """Parsed request-trace records (``requests.trace.jsonl``, written
+        by ``serve --trace-dir``) from the job's directory — TTL-cached
+        exactly like the event stream: the file grows while the server
+        runs, so the portal re-parses at most once per TTL."""
+        def load():
+            job_dir, _ = self._find_job_dir(app_id)
+            if job_dir is None:
+                return None
+            path = job_dir / TRACE_FILE
+            if not path.exists():
+                return None
+            return read_traces(path)
+
+        return self._trace_cache.get(("traces", app_id), load)
 
     def config(self, app_id: str) -> dict | None:
         for root in (self.staging,):
@@ -237,7 +262,8 @@ def _job_detail_html(app_id: str, events: list[dict]) -> str:
         f"<h3>{html.escape(app_id)}</h3>"
         f"<p><a href='/'>all jobs</a> | "
         f"<a href='/config/{html.escape(app_id)}'>config</a>"
-        f" | <a href='/logs/{html.escape(app_id)}'>logs</a></p>"
+        f" | <a href='/logs/{html.escape(app_id)}'>logs</a>"
+        f" | <a href='/traces/{html.escape(app_id)}'>requests</a></p>"
         "<h4>events</h4><table><tr><th>time</th><th>type</th><th>detail</th></tr>"
         + "".join(ev_rows) + "</table>"
     )
@@ -250,7 +276,103 @@ def _job_detail_html(app_id: str, events: list[dict]) -> str:
     return _PAGE.format(body=body)
 
 
+# waterfall segment color, keyed by the span that ENDS the segment
+_SEG_COLORS = {
+    "admitted": "#b5b5b5",      # queue wait
+    "prefill_done": "#7aa7d6",  # admission prefill dispatch
+    "first_token": "#e0a86c",   # decode to the first observed token
+    "finished": "#79b77a",      # decode to completion
+    "cancelled": "#d98080", "expired": "#d98080",
+    "shed": "#d98080", "failed": "#d98080",
+}
+
+
+def _request_timeline_html(app_id: str, traces: list[dict]) -> str:
+    """Per-request waterfall over the trace JSONL: one row per request,
+    phase durations from the monotonic spans, the bar scaled to the
+    slowest request on the page (same table style as the job pages).
+    Span timestamps are host-monotonic (docs/observability.md) — only
+    differences are meaningful, so everything renders relative. Records
+    whose spans are not [name, number] pairs are dropped, same contract
+    as read_traces' torn-line skip: one malformed record must not 500
+    every other request's timeline."""
+    def well_formed(r):
+        spans = r.get("spans")
+        return (isinstance(spans, list) and spans and all(
+            isinstance(s, (list, tuple)) and len(s) == 2
+            and isinstance(s[0], str) and isinstance(s[1], (int, float))
+            for s in spans))
+
+    recs = [r for r in traces if isinstance(r, dict) and well_formed(r)]
+    recs.sort(key=lambda r: r["spans"][0][1])
+    t_max = max((r["spans"][-1][1] - r["spans"][0][1] for r in recs),
+                default=0.0) or 1e-9
+
+    def t_of(spans, name):
+        return next((t for n, t in spans if n == name), None)
+
+    rows = []
+    for r in recs:
+        spans, attrs = r["spans"], r.get("attrs", {})
+        t0 = spans[0][1]
+        e2e = spans[-1][1] - t0
+        outcome = attrs.get("finish_reason", spans[-1][0])
+        bar = ""
+        for (pn, pt), (nn, nt) in zip(spans, spans[1:]):
+            width = max(0.3, 100.0 * (nt - pt) / t_max)
+            bar += (
+                f"<div title='{html.escape(pn)}&rarr;{html.escape(nn)} "
+                f"{nt - pt:.3f}s' style='display:inline-block;height:12px;"
+                f"width:{width:.2f}%;background:"
+                f"{_SEG_COLORS.get(nn, '#999')}'></div>")
+        t_adm, t_ft = t_of(spans, "admitted"), t_of(spans, "first_token")
+        fmt = lambda v: "" if v is None else f"{v:.3f}"
+        # every record-sourced value is escaped: the trace file is data,
+        # and anything that can append to the job dir writes it
+        rows.append(
+            f"<tr><td>{html.escape(str(r.get('id', '?')))}</td>"
+            f"<td class='{html.escape(str(outcome))}'>"
+            f"{html.escape(str(outcome))}</td>"
+            f"<td>{html.escape(str(attrs.get('n_tokens', '')))}</td>"
+            f"<td>{html.escape(str(attrs.get('prefix_hit_blocks', '')))}</td>"
+            f"<td>{fmt(None if t_adm is None else t_adm - t0)}</td>"
+            f"<td>{fmt(None if t_ft is None else t_ft - t0)}</td>"
+            f"<td>{fmt(e2e)}</td>"
+            f"<td style='min-width:240px'>{bar}</td></tr>")
+    legend = " ".join(
+        f"<span style='background:{c};padding:0 6px'>&nbsp;</span>"
+        f"{html.escape(n)}"
+        for n, c in (("queue", "#b5b5b5"), ("prefill", "#7aa7d6"),
+                     ("to first token", "#e0a86c"), ("decode", "#79b77a"),
+                     ("terminated early", "#d98080")))
+    body = (
+        f"<h3>{html.escape(app_id)} — request timeline</h3>"
+        f"<p><a href='/'>all jobs</a> | "
+        f"<a href='/jobs/{html.escape(app_id)}'>events</a></p>"
+        f"<p>{len(recs)} requests — timestamps are host-monotonic; bars "
+        f"scale to the slowest request ({t_max:.3f}s). {legend}</p>"
+        "<table><tr><th>request</th><th>outcome</th><th>tokens</th>"
+        "<th>prefix blocks</th><th>queue wait s</th><th>ttft s</th>"
+        "<th>e2e s</th><th>timeline</th></tr>"
+        + "".join(rows) + "</table>"
+    )
+    return _PAGE.format(body=body)
+
+
 def make_handler(index: HistoryIndex, token: str = ""):
+    import threading
+
+    # portal self-telemetry: request counts by route kind + handling
+    # latency, served back on /metrics through the shared renderer.
+    # Routes are a FIXED vocabulary ("other" for everything else): the
+    # label set must stay bounded — a scanner walking random paths must
+    # not grow the dict (or the /metrics cardinality) without limit.
+    # One lock: ThreadingHTTPServer handlers mutate these concurrently.
+    _KNOWN_ROUTES = ("index", "jobs", "config", "logs", "traces",
+                     "metrics")
+    http_requests: dict[str, int] = {}
+    request_hist = Histogram()
+    telemetry_lock = threading.Lock()
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
             log.debug("portal: " + fmt, *args)
@@ -302,9 +424,23 @@ def make_handler(index: HistoryIndex, token: str = ""):
             return hmac.compare_digest(supplied.encode(), token.encode())
 
         def do_GET(self):
+            t0 = time.monotonic()
+            try:
+                return self._handle_get()
+            finally:
+                with telemetry_lock:
+                    request_hist.observe(time.monotonic() - t0)
+
+        def _handle_get(self):
             url = urlparse(self.path)
             qs = parse_qs(url.query)
             parts = [p for p in url.path.split("/") if p]
+            route = parts[1] if parts and parts[0] == "api" and len(
+                parts) > 1 else (parts[0] if parts else "index")
+            if route not in _KNOWN_ROUTES:
+                route = "other"
+            with telemetry_lock:
+                http_requests[route] = http_requests.get(route, 0) + 1
             want_json = "application/json" in self.headers.get("Accept", "") \
                 or self.path.startswith("/api/")
             if parts and parts[0] == "api":
@@ -350,7 +486,32 @@ def make_handler(index: HistoryIndex, token: str = ""):
                         return self._json({"jobs": page, **info})
                     page, info = sort_page_jobs(jobs, qs)
                     return self._send(200, _jobs_html(page, info))
+                if parts[0] == "metrics":
+                    n_jobs = len(index.jobs())
+                    r = PromRenderer()
+                    with telemetry_lock:
+                        for route_name, n in sorted(http_requests.items()):
+                            r.counter("portal_http_requests_total", n,
+                                      "portal GET requests by route",
+                                      labels={"route": route_name})
+                        r.histogram("portal_request_seconds", request_hist,
+                                    "portal request handling time")
+                    r.gauge("portal_jobs_indexed", n_jobs,
+                            "jobs visible in the history index")
+                    data = r.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", PROM_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return None
                 kind, app_id = parts[0], parts[1] if len(parts) > 1 else ""
+                if kind == "traces":
+                    traces = index.traces(app_id)
+                    if want_json or traces is None:
+                        return self._json(traces)
+                    return self._send(
+                        200, _request_timeline_html(app_id, traces))
                 if kind == "jobs":
                     events = index.events(app_id)
                     if want_json or events is None:

@@ -37,7 +37,11 @@ def trace(log_dir: str | Path, enabled: bool = True):
 class StepTimer:
     """Rolling step-time stats written as JSONL next to the job's history
     events — cheap always-on tracing for launch-latency and throughput
-    regressions."""
+    regressions. Durations come from ``time.monotonic()`` — the wall
+    clock can JUMP (NTP slew, manual set) and a backward jump used to
+    corrupt step durations (negative dt poisoning the rolling window);
+    the record's ``ts`` stays wall-clock, it only labels the line. Same
+    clock contract as the serving traces (observability.RequestTrace)."""
 
     def __init__(self, out_path: str | Path | None = None, window: int = 50):
         self._out = Path(out_path) if out_path else None
@@ -48,7 +52,7 @@ class StepTimer:
 
     def tick(self, **extra) -> float | None:
         """Call once per training step; returns the last step's duration."""
-        now = time.time()
+        now = time.monotonic()
         dt = None
         if self._t_last is not None:
             dt = now - self._t_last
@@ -62,12 +66,19 @@ class StepTimer:
                 "step": self.step,
                 "mean_step_s": sum(self._times) / len(self._times),
                 "steps_per_sec": len(self._times) / sum(self._times),
-                "ts": now,
+                "ts": time.time(),
                 **extra,
             }
             with open(self._out, "a") as f:
                 f.write(json.dumps(rec) + "\n")
         return dt
+
+    def reset_interval(self) -> None:
+        """Forget the last tick instant (the rolling window survives).
+        For callers whose steps are not back-to-back — a serving loop
+        that idles between requests must not record the idle gap as one
+        giant 'step' when work resumes."""
+        self._t_last = None
 
     @property
     def steps_per_sec(self) -> float:
