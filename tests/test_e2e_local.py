@@ -497,6 +497,88 @@ def test_tpu_metrics_flow_into_task_finished(tmp_job_dirs, fixture_script,
     assert "max_memory_rss_mb" in metrics and metrics["max_memory_rss_mb"] > 0
 
 
+def test_task_traces_and_driver_metrics_e2e(tmp_job_dirs):
+    """Acceptance chain for cluster-side telemetry: a real 2-worker job
+    produces tasks.trace.jsonl with all-terminal lifecycle traces
+    (executor spans merged in), the driver's /metrics endpoint serves
+    the gang-launch + heartbeat histograms and the straggler gauges in
+    Prometheus text WHILE the job runs, the jhist stream embeds the
+    TASK_TRACE events, and the portal renders the /tasks waterfall."""
+    import urllib.request
+
+    from tony_tpu.events.trace import TASK_TRACE_FILE, read_traces
+
+    client = TonyClient(base_conf(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 2,
+           "tony.worker.command": "bash -c 'sleep 1.5'",
+           "tony.task.heartbeat-interval-ms": 100,
+           "tony.task.metrics-interval-ms": 100},
+    ), poll_interval_s=0.1)
+    client.submit()
+    # driver.json appears once prepare() ran; it advertises metrics_port
+    info_path = Path(client.job_dir) / "driver.json"
+    deadline = time.time() + 60
+    port = None
+    while time.time() < deadline and port is None:
+        if info_path.exists():
+            try:
+                port = json.loads(info_path.read_text()).get("metrics_port")
+            except ValueError:      # mid-rename torn read
+                port = None
+        time.sleep(0.05)
+    assert port, "driver never advertised its metrics port"
+    text = ""
+    want = 'driver_gang_launch_seconds_count{role="worker"} 2'
+    while time.time() < deadline and want not in text:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+        except OSError:
+            pass
+        time.sleep(0.1)
+    assert want in text, f"live /metrics never saw both registrations:\n{text[:2000]}"
+    assert "driver_heartbeat_interval_seconds_bucket" in text
+    assert 'driver_straggler_registration_s{role="worker",stat="max"}' in text
+    assert 'driver_straggler_heartbeat_s{role="worker",stat="median"}' in text
+
+    status = client.monitor()
+    assert status == JobStatus.SUCCEEDED, dump_logs(client)
+    inter = Path(tmp_job_dirs["history"]) / "intermediate" / client.app_id
+    recs = read_traces(inter / TASK_TRACE_FILE)
+    assert {r["id"] for r in recs} == {"worker:0", "worker:1"}
+    for rec in recs:
+        names = [n for n, _ in rec["spans"]]
+        assert names[-1] == "finished", names
+        for span in ("requested", "allocated", "launched", "registered",
+                     "first_heartbeat", "running", "work_dir_ready",
+                     "child_spawned"):
+            assert span in names, f"{span} missing from {names}"
+    jhist = next(iter(inter.glob("*.jhist")))
+    lines = [json.loads(l) for l in jhist.read_text().splitlines()]
+    embedded = [l for l in lines if l["type"] == "TASK_TRACE"]
+    assert {e["payload"]["trace"]["id"] for e in embedded} == {
+        "worker:0", "worker:1"}
+
+    # portal waterfall over the same history dir
+    from tony_tpu.portal.server import serve_portal
+
+    server = serve_portal(base_conf(tmp_job_dirs), port=0, block=False)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        url = (f"http://127.0.0.1:{server.server_address[1]}"
+               f"/tasks/{client.app_id}")
+        req = urllib.request.Request(url, headers={"Accept": "text/html"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = r.read().decode()
+        assert "gang-launch waterfall" in body and "worker:1" in body
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 # ------------------------------------------------------------ fault injection
 
 def test_executor_crash_before_register_fails_job(tmp_job_dirs, fixture_script):

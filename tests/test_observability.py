@@ -403,6 +403,116 @@ def test_step_timer_uses_monotonic_clock(monkeypatch):
     assert timer.steps_per_sec == pytest.approx(1 / 2.5)
 
 
+def test_histogram_state_roundtrip():
+    """state()/restore(): the persistence pair resumes cumulative
+    buckets exactly (JSON round trip included — the serve CLI persists
+    through json) and refuses mismatched bucket layouts."""
+    h = Histogram(lo=1.0, hi=100.0, per_decade=1)
+    for v in (0.5, 5.0, 5000.0):
+        h.observe(v)
+    dumped = json.loads(json.dumps(h.state()))
+    h2 = Histogram(lo=1.0, hi=100.0, per_decade=1)
+    h2.restore(dumped)
+    assert h2.counts == h.counts
+    assert h2.count == 3 and h2.sum == pytest.approx(h.sum)
+    h2.observe(5.0)                     # restored histograms keep counting
+    assert h2.count == 4
+    with pytest.raises(ValueError, match="different buckets"):
+        Histogram(lo=1.0, hi=100.0, per_decade=2).restore(dumped)
+
+
+def test_telemetry_persists_across_reset_and_restart(params):
+    """Histogram persistence (ROADMAP follow-up): SlotServer.reset()
+    must NOT zero the latency histograms, and a fresh server (process
+    restart) resumes the cumulative buckets via ServingTelemetry
+    state()/restore() — /metrics rate() windows survive a re-arm."""
+    srv = _srv(params)
+    srv.submit(Request(prompt=_prompt(4, seed=20), max_new_tokens=4))
+    srv.run_until_drained()
+    assert srv.telemetry.hist["e2e_s"].count == 1
+    ttft_sum = srv.telemetry.hist["ttft_s"].sum
+
+    lost = srv.reset()                  # loop recovery: nothing in flight
+    assert lost == []
+    assert srv.telemetry.hist["e2e_s"].count == 1, (
+        "reset() must preserve cumulative histogram buckets")
+
+    state = json.loads(json.dumps(srv.telemetry.state()))
+    srv2 = _srv(params)                 # fresh process: restore the dump
+    srv2.telemetry.restore(state)
+    assert srv2.telemetry.hist["ttft_s"].sum == pytest.approx(ttft_sum)
+    srv2.submit(Request(prompt=_prompt(4, seed=21), max_new_tokens=4))
+    srv2.run_until_drained()
+    assert srv2.telemetry.hist["e2e_s"].count == 2, (
+        "restored buckets must keep accumulating")
+    # unknown histogram names in an old dump are skipped, not fatal
+    srv2.telemetry.restore({"no_such_hist_s": {"bounds": [], "counts": [],
+                                               "count": 0, "sum": 0.0}})
+
+
+# --------------------------------------------------------------------------
+# metrics-name lint: constants <-> renderers <-> docs must agree
+# --------------------------------------------------------------------------
+
+def test_metrics_names_rendered_and_documented():
+    """Drift lint over the metric-name vocabulary: (a) every name
+    constant in tony_tpu/metrics.py is documented in
+    docs/observability.md; (b) every Prometheus-family constant
+    (serving_*/driver_*) is referenced by a renderer
+    (cli/serve.py, driver.py, portal/server.py); (c) every
+    serving_/driver_/portal_ family the doc names maps back to something
+    the code actually renders. A new constant nobody renders, a renderer
+    series nobody documents, or a doc entry for a deleted series all
+    fail here."""
+    import inspect
+    from pathlib import Path
+
+    import tony_tpu.cli.serve as serve_mod
+    import tony_tpu.driver as driver_mod
+    import tony_tpu.observability as obs
+    import tony_tpu.portal.server as portal_mod
+
+    consts = {name: val for name, val in vars(_metrics).items()
+              if name.isupper() and isinstance(val, str)}
+    assert consts, "metrics.py lost its name constants?"
+    doc = (Path(__file__).resolve().parent.parent
+           / "docs" / "observability.md").read_text()
+
+    undocumented = sorted(v for v in consts.values() if f"`{v}`" not in doc)
+    assert not undocumented, (
+        f"metrics.py names missing from docs/observability.md "
+        f"(backticked): {undocumented}")
+
+    sources = "".join(inspect.getsource(mod) for mod in
+                      (serve_mod, driver_mod, portal_mod))
+    unrendered = sorted(
+        f"{name} ({val})" for name, val in consts.items()
+        if val.startswith(("serving_", "driver_"))
+        and name not in sources and f'"{val}"' not in sources)
+    assert not unrendered, f"constants no renderer references: {unrendered}"
+
+    rendered = set(consts.values())
+    rendered |= set(re.findall(
+        r'"((?:serving|driver|portal)_[a-z0-9_]+)"', sources))
+    rendered |= {"serving_" + n[:-2] + "_seconds"
+                 for n in obs.TELEMETRY_HISTOGRAMS}
+
+    def base(name):
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in rendered:
+                return name[:-len(suffix)]
+        return name
+
+    # PERF.json section names share the serving_ prefix but are bench
+    # artifacts, not exposition families
+    rendered |= {"serving_latency", "serving_robustness"}
+    doc_names = set(re.findall(
+        r"`((?:serving|driver|portal)_[a-z0-9_]+)`", doc))
+    phantom = sorted(n for n in doc_names if base(n) not in rendered)
+    assert not phantom, (
+        f"docs/observability.md names no endpoint renders: {phantom}")
+
+
 def test_telemetry_trace_feed_units():
     """observe_trace maps spans to the right histograms, including the
     per-token TPOT division, without a model in sight."""

@@ -1,0 +1,497 @@
+"""Task lifecycle traces + driver /metrics (the cluster-side half of the
+observability layer — docs/observability.md "Task lifecycle traces").
+
+The contract under test: every task the driver manages leaves a complete,
+ordered, ALL-TERMINAL lifecycle trace in ``tasks.trace.jsonl`` (requested
+-> allocated -> launched -> registered -> first_heartbeat -> running ->
+finished|failed|killed|heartbeat_expired, with ``restarted`` marks and
+the full chain repeating per attempt); the jhist stream embeds the same
+records as TASK_TRACE events; executor-side spans shipped over
+``update_metrics`` merge into the trace; and the driver's GET /metrics
+renders gang-launch histograms, the heartbeat inter-arrival histogram,
+restart/expiry counters, and the per-role straggler gauges in parseable
+Prometheus text. Stub executors are threads speaking the real framed-JSON
+RPC (the test_gang_scale pattern) so each scenario runs in ~a second.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+import tony_tpu.constants as c
+from tony_tpu.api import JobStatus
+from tony_tpu.cluster.provisioner import ContainerHandle, Provisioner
+from tony_tpu.conf import TonyConf
+from tony_tpu.driver import Driver
+from tony_tpu.events.trace import TASK_TRACE_FILE, TraceWriter, read_traces
+from tony_tpu.observability import TASK_TERMINAL_SPANS
+from tony_tpu.rpc import RpcClient
+
+# one exposition line: a comment, or name{labels} value (same golden
+# regex as tests/test_observability.py)
+_PROM_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+|"
+    r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^\s]+)$")
+
+
+def _conf(dirs, **extra):
+    return TonyConf({
+        "tony.staging.dir": dirs["staging"],
+        "tony.history.location": dirs["history"],
+        "tony.history.intermediate": dirs["history"] + "/intermediate",
+        "tony.history.finished": dirs["history"] + "/finished",
+        "tony.am.monitor-interval-ms": 50,
+        "tony.task.registration-poll-interval-ms": 50,
+        **extra,
+    })
+
+
+def _span_names(rec):
+    return [n for n, _ in rec["spans"]]
+
+
+def _assert_ordered(rec):
+    ts = [t for _, t in rec["spans"]]
+    assert ts == sorted(ts), f"spans out of order: {rec['spans']}"
+
+
+class ScriptedProvisioner(Provisioner):
+    """launch() runs ``script(spec, index, env, handle, attempt)`` on a
+    thread — each scenario scripts its executors' behavior; ``attempt``
+    counts launches per task so restart scripts can branch."""
+
+    def __init__(self, script):
+        super().__init__()
+        self._script = script
+        self._attempts: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.launches: list[str] = []
+
+    def launch(self, spec, index, env, log_dir):
+        task_id = f"{spec.name}:{index}"
+        with self._lock:
+            attempt = self._attempts.get(task_id, 0)
+            self._attempts[task_id] = attempt + 1
+            self.launches.append(task_id)
+        handle = ContainerHandle(
+            container_id=f"stub_{task_id}_{attempt}",
+            host="127.0.0.1", role=spec.name, index=index,
+        )
+        threading.Thread(
+            target=self._run, args=(spec, index, env, handle, attempt),
+            daemon=True,
+        ).start()
+        return handle
+
+    def _run(self, spec, index, env, handle, attempt):
+        try:
+            code = self._script(spec, index, env, handle, attempt)
+        except Exception as e:                  # pragma: no cover - debug aid
+            print(f"stub executor failed: {type(e).__name__}: {e}",
+                  flush=True)
+            code = 1
+        if code is not None and self.on_completion:
+            self.on_completion(handle, code)
+
+    def stop_container(self, handle):
+        pass
+
+    def stop_all(self):
+        pass
+
+
+def _driver(dirs, tmp_path, script, **conf_extra):
+    conf = _conf(dirs, **conf_extra)
+    job_dir = tmp_path / "job"
+    job_dir.mkdir(exist_ok=True)
+    conf.write_final(job_dir)
+    driver = Driver(conf, app_id="trace_test", job_dir=str(job_dir),
+                    token="trace-secret", provisioner=ScriptedProvisioner(script))
+    driver.client_signal.set()      # no client: don't wait for the ack
+    return driver
+
+
+def _rpc_for(env):
+    return RpcClient(env[c.ENV_DRIVER_HOST], int(env[c.ENV_DRIVER_PORT]),
+                     token=env.get(c.ENV_TOKEN, ""), role="executor")
+
+
+# --------------------------------------------------------------------------
+# normal finish: full span chain, executor enrichment, live /metrics
+# --------------------------------------------------------------------------
+
+def test_task_trace_full_lifecycle_and_driver_metrics(tmp_job_dirs, tmp_path):
+    """Two workers register, heartbeat, push metrics + executor spans,
+    and finish. While they run the driver /metrics endpoint serves the
+    gang-launch histogram, the heartbeat histogram, the straggler
+    gauges, and the pushed per-task metrics; afterwards every trace in
+    tasks.trace.jsonl is terminal 'finished' with the full ordered chain
+    (executor spans merged in), and the jhist embeds TASK_TRACE events."""
+    release = threading.Event()
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        payload = rpc.call("register_worker", task_id=task_id,
+                           host="127.0.0.1", port=21000 + index)
+        while payload is None:
+            rpc.call("heartbeat", task_id=task_id)
+            time.sleep(0.03)
+            payload = rpc.call("get_cluster_spec", task_id=task_id)
+        for _ in range(3):
+            rpc.call("heartbeat", task_id=task_id)
+            time.sleep(0.03)
+        rpc.call("update_metrics", task_id=task_id,
+                 metrics=[{"name": "max_memory_rss_mb", "value": 11.5}],
+                 spans=[["work_dir_ready", time.time()],
+                        ["child_spawned", time.time()]])
+        assert release.wait(20), "test never released the stub executors"
+        rpc.call("register_execution_result", task_id=task_id, exit_code=0)
+        rpc.close()
+        return 0
+
+    driver = _driver(tmp_job_dirs, tmp_path, script,
+                     **{"tony.worker.instances": 2,
+                        "tony.worker.command": "stub",
+                        "tony.task.heartbeat-interval-ms": 100})
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    try:
+        # wait for both registrations + the metrics push to land, then
+        # scrape the live endpoint
+        deadline = time.time() + 20
+        text = ""
+        while time.time() < deadline:
+            port = driver.metrics_port
+            if port is not None:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                    assert r.status == 200
+                    assert r.headers["Content-Type"].startswith("text/plain")
+                    text = r.read().decode()
+                if ('driver_gang_launch_seconds_count{role="worker"} 2'
+                        in text
+                        and 'driver_task_metric{task="worker:0",' in text
+                        and 'driver_task_metric{task="worker:1",' in text):
+                    break
+            time.sleep(0.05)
+        assert 'driver_gang_launch_seconds_count{role="worker"} 2' in text, (
+            text[:3000])
+        for line in text.strip().splitlines():
+            assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
+        assert "driver_heartbeat_interval_seconds_bucket" in text
+        assert "driver_task_restarts_total 0" in text
+        assert "driver_heartbeat_expired_total 0" in text
+        for gauge in ("driver_straggler_registration_s",
+                      "driver_straggler_heartbeat_s"):
+            for stat in ("max", "median"):
+                assert f'{gauge}{{role="worker",stat="{stat}"}}' in text
+        assert ('driver_task_metric{task="worker:0",'
+                'name="max_memory_rss_mb"} 11.5' in text)
+        # the advertised endpoint rides driver.json next to the RPC info
+        info = json.loads((tmp_path / "job" / c.DRIVER_INFO_FILE).read_text())
+        assert info["metrics_port"] == driver.metrics_port
+    finally:
+        release.set()
+    t.join(timeout=30)
+    assert not t.is_alive(), "driver did not finish"
+    assert driver.session.status == JobStatus.SUCCEEDED, (
+        driver.session.failure_message)
+
+    inter = Path(tmp_job_dirs["history"]) / "intermediate" / "trace_test"
+    recs = read_traces(inter / TASK_TRACE_FILE)
+    assert {r["id"] for r in recs} == {"worker:0", "worker:1"}
+    for rec in recs:
+        names = _span_names(rec)
+        assert names[-1] == "finished"
+        # driver-observed chain, in order (first_heartbeat/running order
+        # is legitimately attempt-dependent: the gang's LAST registrant
+        # opens the barrier at its own registration, before any beat)
+        assert names[:4] == ["requested", "allocated", "launched",
+                             "registered"], names
+        for span in ("first_heartbeat", "running"):
+            assert names.index(span) > names.index("registered"), names
+        # executor enrichment arrived over update_metrics
+        assert "work_dir_ready" in names and "child_spawned" in names
+        _assert_ordered(rec)
+        assert rec["attrs"]["exit_code"] == 0
+        assert rec["attrs"]["restarts"] == 0
+    assert not driver.task_traces, "trace registry must drain with the tasks"
+
+    jhist = next(iter(inter.glob("*.jhist")))
+    events = [json.loads(l) for l in jhist.read_text().splitlines()]
+    embedded = [e for e in events if e["type"] == "TASK_TRACE"]
+    assert {e["payload"]["trace"]["id"] for e in embedded} == {
+        "worker:0", "worker:1"}
+
+
+# --------------------------------------------------------------------------
+# restart budget: container exits spend it, the trace shows each attempt
+# --------------------------------------------------------------------------
+
+def test_task_trace_restart_budget_path(tmp_job_dirs, tmp_path):
+    """A worker that crashes twice inside a max-restarts=2 budget, then
+    succeeds: ONE trace carrying two 'restarted' marks, the
+    requested->launched chain repeated per attempt, terminal 'finished',
+    and driver_task_restarts_total == 2."""
+
+    def script(spec, index, env, handle, attempt):
+        time.sleep(0.05)
+        return 1 if attempt < 2 else 0      # crash, crash, succeed
+
+    driver = _driver(tmp_job_dirs, tmp_path, script,
+                     **{"tony.worker.instances": 1,
+                        "tony.worker.command": "stub",
+                        "tony.worker.max-restarts": 2})
+    status = driver.run()
+    assert status == JobStatus.SUCCEEDED, driver.session.failure_message
+    assert driver.provisioner.launches == ["worker:0"] * 3
+
+    inter = Path(tmp_job_dirs["history"]) / "intermediate" / "trace_test"
+    recs = read_traces(inter / TASK_TRACE_FILE)
+    assert len(recs) == 1
+    names = _span_names(recs[0])
+    assert names.count("restarted") == 2
+    assert names.count("requested") == 3 and names.count("launched") == 3
+    assert names[-1] == "finished"
+    _assert_ordered(recs[0])
+    assert recs[0]["attrs"]["restarts"] == 2
+    text = driver.render_metrics()
+    assert "driver_task_restarts_total 2" in text
+    assert "driver_heartbeat_expired_total 0" in text
+
+
+# --------------------------------------------------------------------------
+# heartbeat expiry: budgeted restart first, then a terminal expiry
+# --------------------------------------------------------------------------
+
+def test_task_trace_heartbeat_expiry_path(tmp_job_dirs, tmp_path):
+    """Both attempts register, beat, then go silent. Attempt 1's expiry
+    spends the restart budget ('restarted' mark + a fresh chain);
+    attempt 2's expiry exhausts it — terminal 'heartbeat_expired', job
+    FAILED, and the expiry/restart counters agree."""
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        payload = rpc.call("register_worker", task_id=task_id,
+                           host="127.0.0.1", port=22000 + index)
+        while payload is None:
+            rpc.call("heartbeat", task_id=task_id)
+            time.sleep(0.03)
+            payload = rpc.call("get_cluster_spec", task_id=task_id)
+        rpc.call("heartbeat", task_id=task_id)
+        rpc.close()
+        return None         # go silent: never beats again, never exits
+
+    driver = _driver(tmp_job_dirs, tmp_path, script,
+                     **{"tony.worker.instances": 1,
+                        "tony.worker.command": "stub",
+                        "tony.worker.max-restarts": 1,
+                        "tony.task.heartbeat-interval-ms": 100,
+                        "tony.task.max-missed-heartbeats": 3})
+    status = driver.run()
+    assert status == JobStatus.FAILED
+    assert "missed 3 heartbeats" in driver.session.failure_message
+
+    inter = Path(tmp_job_dirs["history"]) / "intermediate" / "trace_test"
+    recs = read_traces(inter / TASK_TRACE_FILE)
+    assert len(recs) == 1
+    names = _span_names(recs[0])
+    assert names[-1] == "heartbeat_expired"
+    assert names.count("restarted") == 1
+    assert names.count("registered") == 2, (
+        f"both attempts must register in the same trace: {names}")
+    assert names.count("first_heartbeat") == 2
+    _assert_ordered(recs[0])
+    assert recs[0]["attrs"]["restarts"] == 1
+    text = driver.render_metrics()
+    assert "driver_heartbeat_expired_total 2" in text
+    assert "driver_task_restarts_total 1" in text
+
+
+# --------------------------------------------------------------------------
+# executor-side satellites: TaskMonitor channel, Heartbeater jitter/miss
+# --------------------------------------------------------------------------
+
+class _CapturingRpc:
+    def __init__(self):
+        self.calls = []
+
+    def call(self, method, **params):
+        self.calls.append((method, params))
+        return True
+
+
+def test_task_monitor_push_carries_spans_child_status_and_steps(tmp_path):
+    """One update_metrics push carries everything the driver needs:
+    accumulator metrics (incl. child_alive and the step-time quantiles
+    sampled from the training child's StepTimer JSONL) plus the
+    executor lifecycle spans, time-sorted."""
+    from tony_tpu.metrics import (
+        CHILD_ALIVE, STEP_TIME_MEAN_S, STEP_TIME_P99_S, TaskMonitor,
+    )
+    from tony_tpu.train.profiling import StepTimer
+
+    step_log = tmp_path / "w0.steps.jsonl"
+    timer = StepTimer(step_log, window=4)
+    for _ in range(9):      # crosses the window boundary -> one record
+        timer.tick()
+    assert step_log.exists()
+    rec = json.loads(step_log.read_text().splitlines()[-1])
+    assert "p50_s" in rec and "p99_s" in rec    # StepTimer histogram feed
+
+    class _Ctx:             # a finished child: poll() returns an exit code
+        spans = [["child_spawned", 50.0]]
+
+        class child_process:
+            pid = 1
+
+            @staticmethod
+            def poll():
+                return 0
+
+    rpc = _CapturingRpc()
+    mon = TaskMonitor(rpc, "worker:0", interval_s=60)
+    mon.set_context(_Ctx())
+    mon.set_step_log(str(step_log))
+    mon.add_span("work_dir_ready", t=40.0)
+    mon.refresh()
+    (method, params), = rpc.calls
+    assert method == "update_metrics" and params["task_id"] == "worker:0"
+    names = {m["name"] for m in params["metrics"]}
+    assert f"max_{CHILD_ALIVE}" in names
+    assert f"max_{STEP_TIME_MEAN_S}" in names
+    assert f"max_{STEP_TIME_P99_S}" in names
+    by_name = {m["name"]: m["value"] for m in params["metrics"]}
+    assert by_name[f"max_{CHILD_ALIVE}"] == 0.0     # child already exited
+    # monitor + ctx spans merged, time-sorted
+    assert params["spans"] == [["work_dir_ready", 40.0],
+                               ["child_spawned", 50.0]]
+
+
+def test_heartbeater_jitter_and_missed_counter():
+    """The heartbeat wait is jittered (never exactly the base interval,
+    bounded ±10%) and failed beats feed the monitor's missed counter."""
+    from tony_tpu.executor import Heartbeater
+    from tony_tpu.metrics import HEARTBEATS_MISSED
+
+    class _FailingClient:
+        def call(self, method, **params):
+            raise ConnectionError("driver gone")
+
+    class _Notes:
+        def __init__(self):
+            self.notes = []
+
+        def note(self, name, value):
+            self.notes.append((name, value))
+
+    notes = _Notes()
+    hb = Heartbeater(_FailingClient(), "worker:0", interval_s=0.01,
+                     max_failures=3, on_driver_lost=None, monitor=notes)
+    waits = [hb._interval * hb._rng.uniform(0.9, 1.1) for _ in range(50)]
+    assert all(0.009 <= w <= 0.011 for w in waits)
+    assert len(set(waits)) > 1, "jitter must actually vary the wait"
+    hb.start()
+    deadline = time.time() + 5
+    while hb.missed < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    hb.stop_event.set()
+    hb.join(timeout=5)
+    assert hb.missed >= 3
+    missed = [v for n, v in notes.notes if n == HEARTBEATS_MISSED]
+    assert missed and missed == sorted(missed) and missed[-1] == hb.missed
+
+
+# --------------------------------------------------------------------------
+# torn-line tolerance + portal waterfall
+# --------------------------------------------------------------------------
+
+def test_task_trace_torn_line_read(tmp_path):
+    """A record torn mid-write (crash) must not hide the other tasks'
+    traces — same contract as the request-trace reader."""
+    w = TraceWriter(tmp_path, filename=TASK_TRACE_FILE)
+    w.write({"id": "worker:0",
+             "spans": [["requested", 1.0], ["finished", 2.0]],
+             "attrs": {"restarts": 0}})
+    w.close()
+    with open(tmp_path / TASK_TRACE_FILE, "a") as f:
+        f.write('{"id": "worker:1", "spans": [["requested", 1.')  # torn
+    recs = read_traces(tmp_path / TASK_TRACE_FILE)
+    assert [r["id"] for r in recs] == ["worker:0"]
+    assert _span_names(recs[0])[-1] in TASK_TERMINAL_SPANS
+
+
+def test_portal_task_waterfall(tmp_path):
+    """/tasks/<app_id>: the gang-launch waterfall renders from
+    tasks.trace.jsonl (HTML + JSON), is linked from the job page, 404s
+    cleanly when absent, and drops malformed records instead of 500ing."""
+    import urllib.error
+
+    from tony_tpu.events.history import history_file_name
+    from tony_tpu.portal.server import serve_portal
+
+    inter = tmp_path / "hist" / "intermediate"
+    job = inter / "app_tasks"
+    job.mkdir(parents=True)
+    (job / history_file_name("app_tasks", 1000, end_ms=9000, user="u",
+                             status="SUCCEEDED")).write_text("")
+    bare = inter / "app_bare"
+    bare.mkdir(parents=True)
+    (bare / history_file_name("app_bare", 1000, end_ms=2000, user="u",
+                              status="SUCCEEDED")).write_text("")
+    w = TraceWriter(job, filename=TASK_TRACE_FILE)
+    w.write({"id": "worker:0", "spans": [
+        ["requested", 10.0], ["allocated", 10.1], ["launched", 10.15],
+        ["registered", 10.6], ["first_heartbeat", 10.7], ["running", 10.9],
+        ["finished", 12.0]], "attrs": {"restarts": 0, "exit_code": 0}})
+    w.write({"id": "worker:1", "spans": [
+        ["requested", 10.0], ["allocated", 10.1], ["launched", 10.15],
+        ["registered", 11.4], ["restarted", 11.5], ["requested", 11.5],
+        ["heartbeat_expired", 12.5]], "attrs": {"restarts": 1}})
+    w.write({"id": "bad", "spans": [["requested"]]})    # malformed shape
+    w.close()
+
+    conf = TonyConf({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.intermediate": str(inter),
+        "tony.history.finished": str(tmp_path / "hist" / "finished"),
+    })
+    server = serve_portal(conf, port=0, block=False)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        def get(path, accept="application/json"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", headers={"Accept": accept})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, resp.read().decode()
+
+        status, body = get("/tasks/app_tasks")
+        assert status == 200
+        assert [t["id"] for t in json.loads(body)] == [
+            "worker:0", "worker:1", "bad"]
+
+        status, body = get("/tasks/app_tasks", accept="text/html")
+        assert status == 200
+        assert "gang-launch waterfall" in body
+        assert "worker:0" in body and "heartbeat_expired" in body
+        assert "2 tasks" in body        # malformed record dropped
+
+        status, body = get("/jobs/app_tasks", accept="text/html")
+        assert "/tasks/app_tasks" in body
+
+        try:
+            get("/tasks/app_bare")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()

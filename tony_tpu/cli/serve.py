@@ -192,6 +192,11 @@ def load_model(args):
     return transformer.init(jax.random.PRNGKey(args.seed), cfg), cfg
 
 
+# sibling of requests.trace.jsonl under --trace-dir: the ServingTelemetry
+# histogram-bucket dump written at shutdown and restored at startup
+TELEMETRY_STATE_FILE = "telemetry.state.json"
+
+
 class ServingLoopError(RuntimeError):
     """The serving loop died; the message carries the cause."""
 
@@ -824,12 +829,32 @@ def main(argv=None) -> int:
         cache_prompts=not args.no_cache_prompts,
         max_queue=args.max_queue)
     trace_writer = None
+    telemetry_state_path = None
     if args.trace_dir:
+        from pathlib import Path
+
         from ..events.trace import TraceWriter
 
         trace_writer = TraceWriter(args.trace_dir)
         slot_server.trace_sink = trace_writer.write
         print(f"request traces -> {trace_writer.path}", flush=True)
+        # histogram persistence across serve restarts: a re-armed server
+        # resumes the cumulative /metrics buckets instead of zeroing
+        # them (docs/observability.md "Histogram persistence").
+        # SlotServer.reset() already keeps its telemetry; this covers
+        # PROCESS-level restarts pointing at the same trace dir.
+        telemetry_state_path = Path(args.trace_dir) / TELEMETRY_STATE_FILE
+        if telemetry_state_path.exists():
+            try:
+                slot_server.telemetry.restore(
+                    json.loads(telemetry_state_path.read_text()))
+                print(f"telemetry restored from {telemetry_state_path}",
+                      flush=True)
+            except (ValueError, KeyError, TypeError, AttributeError,
+                    OSError) as e:
+                # a stale/incompatible dump must not block startup —
+                # including valid JSON of the wrong shape
+                print(f"telemetry state not restored: {e}", flush=True)
     app = ServeApp(slot_server, max_loop_restarts=args.loop_max_restarts,
                    loop_backoff_s=args.loop_backoff_s)
     app.start()
@@ -870,6 +895,15 @@ def main(argv=None) -> int:
     finally:
         app.shutdown()      # no-op after a completed drain
         httpd.server_close()
+        if telemetry_state_path is not None:
+            try:
+                # tmp+rename: a crash mid-write must leave the previous
+                # dump intact, not a truncated one
+                tmp = telemetry_state_path.with_suffix(".json.tmp")
+                tmp.write_text(json.dumps(slot_server.telemetry.state()))
+                tmp.rename(telemetry_state_path)
+            except OSError as e:
+                print(f"telemetry state not persisted: {e}", flush=True)
         if trace_writer is not None:
             trace_writer.close()
     return 0

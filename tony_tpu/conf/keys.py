@@ -34,6 +34,9 @@ AM_MONITOR_INTERVAL_MS = "tony.am.monitor-interval-ms"
 AM_RPC_HOST = "tony.am.rpc-host"
 AM_REGISTRATION_TIMEOUT_MS = "tony.am.registration-timeout-ms"
 AM_ALLOCATION_TIMEOUT_MS = "tony.am.allocation-timeout-ms"  # gang-deadlock breaker
+# driver GET /metrics (Prometheus text): 0 = ephemeral port (advertised in
+# driver.json next to the RPC endpoint), -1 = disabled
+AM_METRICS_PORT = "tony.am.metrics-port"
 
 # ---------------------------------------------------------------------- tasks
 TASK_HEARTBEAT_INTERVAL_MS = "tony.task.heartbeat-interval-ms"

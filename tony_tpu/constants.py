@@ -32,6 +32,10 @@ ENV_TB_PORT = "TB_PORT"
 ENV_TASK_PORT = "TONY_TASK_PORT"  # the port this task advertised to the driver
                                   # (what clients/proxies will connect to — a
                                   # notebook server must bind it)
+ENV_STEP_LOG = "TONY_STEP_LOG"    # where the training child's StepTimer should
+                                  # write its JSONL; the executor's TaskMonitor
+                                  # samples it so per-worker step-time quantiles
+                                  # ride the metrics push to the driver
 
 # JAX runtime contract (replaces TF_CONFIG/Gloo/DMLC matrix — SURVEY.md §5):
 ENV_COORDINATOR_ADDRESS = "TONY_COORDINATOR_ADDRESS"

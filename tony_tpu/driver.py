@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import statistics
 import sys
 import threading
 import time
@@ -28,17 +29,39 @@ from .api import DistributedMode, JobStatus, TaskStatus, now_ms
 from .cluster import ContainerHandle, Provisioner, create_provisioner
 from .conf import RoleSpec, TonyConf, keys
 from .events import EventHandler
+from .events.trace import TASK_TRACE_FILE, TraceWriter
 from .events.types import (
     application_finished,
     application_inited,
     task_finished,
     task_started,
+    task_trace,
 )
+from .metrics import (
+    DRIVER_GANG_LAUNCH_SECONDS,
+    DRIVER_HEARTBEAT_EXPIRED_TOTAL,
+    DRIVER_HEARTBEAT_INTERVAL_SECONDS,
+    DRIVER_STRAGGLER_HEARTBEAT_S,
+    DRIVER_STRAGGLER_REGISTRATION_S,
+    DRIVER_TASK_METRIC,
+    DRIVER_TASK_RESTARTS_TOTAL,
+    DRIVER_TASKS,
+)
+from .observability import PROM_CONTENT_TYPE, Histogram, PromRenderer, TaskTrace
 from .rpc import RpcServer
 from .scheduler import TaskScheduler
 from .session import Session
 
 log = logging.getLogger(__name__)
+
+
+def _lag_stats(rel: list[float]) -> dict[str, float]:
+    """max/median of per-task lag values — the straggler gauge's two
+    stats. An empty list (nothing registered / beating yet) reads as
+    zero skew rather than omitting the series."""
+    if not rel:
+        return {"max": 0.0, "median": 0.0}
+    return {"max": max(rel), "median": float(statistics.median(rel))}
 
 
 class DriverService:
@@ -55,6 +78,7 @@ class DriverService:
         if task is None:
             raise ValueError(f"unknown task {task_id}")
         d.heartbeats[task_id] = time.time()
+        d._on_task_registered(task_id)
         log.info("registered %s at %s:%s (%d/%d)", task_id, host, port,
                  d.session.registered_count(), len(d.session.all_tasks()))
         # fault injection: kill listed tasks once the chief registers
@@ -74,13 +98,19 @@ class DriverService:
         d = self._d
         if not d.runtime_driver.can_start_task(d.mode, task_id):
             return None
-        return d.runtime_driver.cluster_spec_payload(task_id)
+        payload = d.runtime_driver.cluster_spec_payload(task_id)
+        d._mark_running(task_id)    # the gang barrier opened for this task
+        return payload
 
     def taskExecutorHeartbeat(self, task_id: str):  # wire name kept short below
         return self.heartbeat(task_id)
 
     def heartbeat(self, task_id: str) -> bool:
-        self._d.heartbeats[task_id] = time.time()
+        d = self._d
+        prev = d.heartbeats.get(task_id)
+        now = time.time()
+        d.heartbeats[task_id] = now
+        d._on_heartbeat(task_id, prev, now)
         return True
 
     def register_execution_result(self, task_id: str, exit_code: int) -> str:
@@ -97,8 +127,14 @@ class DriverService:
         log.info("tensorboard at %s", url)
         return True
 
-    def update_metrics(self, task_id: str, metrics: list[dict[str, Any]]) -> bool:
+    def update_metrics(self, task_id: str, metrics: list[dict[str, Any]],
+                       spans: list | None = None) -> bool:
+        """``spans`` (optional, [name, unix_ts] pairs) are executor-side
+        lifecycle spans (work_dir_ready, child_spawned, child_exited)
+        merged into the task's trace — see Driver._merge_executor_spans."""
         self._d.metrics[task_id] = metrics
+        if spans:
+            self._d._merge_executor_spans(task_id, spans)
         return True
 
     def get_metrics(self, task_id: str):
@@ -206,6 +242,27 @@ class Driver:
         self._retries_left = conf.get_int(keys.AM_RETRY_COUNT, 0)
         self._start_ms = now_ms()
 
+        # ---- task lifecycle telemetry (observability.TaskTrace) ----
+        # every task gets a host-monotonic span trace (requested ->
+        # allocated -> launched -> registered -> first_heartbeat ->
+        # running -> terminal) recorded here and enriched by executor-
+        # side spans over update_metrics; sealed traces go to
+        # tasks.trace.jsonl + a TASK_TRACE jhist event. One lock: marks
+        # come from RPC threads, watcher threads, and the monitor loop.
+        self._tt_lock = threading.Lock()
+        self.task_traces: dict[str, TaskTrace] = {}   # live (unsealed)
+        self._task_trace_writer: TraceWriter | None = None
+        self._gang_hist: dict[str, Histogram] = {}    # role -> req->reg
+        self._hb_hist = Histogram()                   # beat inter-arrival
+        self._restart_count = 0                       # budget units spent
+        self._hb_expired_count = 0                    # liveness expiries
+        self._reg_t: dict[str, float] = {}            # task -> reg monotime
+        self._barrier_open: set[str] = set()          # "running" marked
+        self._first_beat: set[str] = set()            # "first_heartbeat"
+        self._exec_spans_seen: dict[str, set] = {}    # per-attempt dedupe
+        self._attempt_wall: dict[str, float] = {}     # restart wall fence
+        self._metrics_httpd = None
+
     # ------------------------------------------------------------- lifecycle
     def run(self) -> JobStatus:
         self.prepare()
@@ -247,6 +304,11 @@ class Driver:
 
         info = {"host": self.rpc_server.address[0], "port": self.rpc_server.port,
                 "app_id": self.app_id, "pid": os.getpid()}
+        self._task_trace_writer = TraceWriter(
+            self.events.job_dir, filename=TASK_TRACE_FILE)
+        self._start_metrics_server()
+        if self.metrics_port is not None:
+            info["metrics_port"] = self.metrics_port
         tmp = self.job_dir / (c.DRIVER_INFO_FILE + ".tmp")
         tmp.write_text(json.dumps(info))
         tmp.rename(self.job_dir / c.DRIVER_INFO_FILE)
@@ -274,7 +336,10 @@ class Driver:
         import subprocess
 
         task = self.session.get_task(spec.name, 0)
+        self._trace_mark(task.task_id, "requested", role=spec.name)
+        self._trace_mark(task.task_id, "launched")
         self.session.register_task(task.task_id, self.rpc_server.address[0], -1)
+        self._on_task_registered(task.task_id)
         if self.events:
             self.events.emit(task_started(task.task_id, self.rpc_server.address[0]))
         env = {**os.environ, **self._task_env(spec, 0)}
@@ -302,6 +367,7 @@ class Driver:
             if task is None or task.status.is_terminal():
                 continue
             task.status = TaskStatus.REQUESTED
+            self._trace_mark(task.task_id, "requested", role=spec.name)
             if hold == f"{spec.name}#{index}":
                 # fault hook: this task never receives capacity (gang
                 # deadlock — broken by the allocation-timeout health check)
@@ -313,6 +379,7 @@ class Driver:
                 spec, index, env, self.job_dir / "logs"
             )
             task.status = TaskStatus.ALLOCATED
+            self._trace_mark(task.task_id, "allocated", host=handle.host)
             task.container_id = handle.container_id
             task.host = handle.host
             # per-task log URL, surfaced to the client and portal (reference
@@ -324,6 +391,7 @@ class Driver:
             )
             self._handles[task.task_id] = handle
             self._launch_ms[task.task_id] = now_ms()
+            self._trace_mark(task.task_id, "launched")
             if self.events:
                 self.events.emit(
                     task_started(task.task_id, handle.host, url=task.url)
@@ -369,6 +437,274 @@ class Driver:
                 env[k] = v
         env.update(spec.env)
         return env
+
+    # ------------------------------------------------------- task telemetry
+    def _trace_mark(self, task_id: str, span: str, **attrs) -> None:
+        """Record one lifecycle span on the task's trace (created on
+        first mark). Host-monotonic, same clock contract as the serving
+        traces (docs/observability.md)."""
+        with self._tt_lock:
+            tr = self.task_traces.get(task_id)
+            if tr is None:
+                tr = self.task_traces[task_id] = TaskTrace(task_id)
+            tr.mark(span)
+            if attrs:
+                tr.attrs.update(attrs)
+
+    def _on_task_registered(self, task_id: str) -> None:
+        """Registration: mark the span and feed the per-role gang-launch
+        histogram (capacity request -> registration, measured from the
+        newest ``requested`` so restarts time their own attempt). Once
+        per attempt: the RPC client retries transport errors, so a
+        re-delivered register_worker must not double-count the histogram
+        or duplicate the span."""
+        role = task_id.partition(":")[0]
+        with self._tt_lock:
+            if task_id in self._reg_t:
+                return
+            tr = self.task_traces.get(task_id)
+            if tr is None:
+                tr = self.task_traces[task_id] = TaskTrace(task_id)
+            t_req = tr.last_t("requested")
+            tr.mark("registered")
+            now = tr.spans[-1][1]
+            self._reg_t[task_id] = now
+            if t_req is not None:
+                h = self._gang_hist.get(role)
+                if h is None:
+                    h = self._gang_hist[role] = Histogram()
+                h.observe(max(0.0, now - t_req))
+
+    def _on_heartbeat(self, task_id: str, prev: float | None,
+                      now: float) -> None:
+        with self._tt_lock:
+            if prev is not None:
+                self._hb_hist.observe(max(0.0, now - prev))
+            # first_heartbeat only counts after registration: the
+            # executor starts its heartbeater BEFORE registering, and a
+            # beat racing ahead of register_worker must not put
+            # first_heartbeat before 'registered' in the documented chain
+            if task_id not in self._first_beat and task_id in self._reg_t:
+                tr = self.task_traces.get(task_id)
+                if tr is not None:
+                    self._first_beat.add(task_id)
+                    tr.mark("first_heartbeat")
+
+    def _mark_running(self, task_id: str) -> None:
+        """The gang barrier opened for this task (its cluster spec was
+        handed out) — once per attempt."""
+        with self._tt_lock:
+            if task_id in self._barrier_open:
+                return
+            tr = self.task_traces.get(task_id)
+            if tr is not None:
+                self._barrier_open.add(task_id)
+                tr.mark("running")
+
+    def _merge_executor_spans(self, task_id: str, spans: list) -> None:
+        """Executor-side lifecycle spans arrive as [name, unix_ts] pairs
+        (the monitor pushes its cumulative list every interval — each
+        name merges once per attempt), re-anchored from the executor's
+        wall clock onto this host's monotonic timeline. Cross-host NTP
+        skew can shift them against driver-observed spans but the
+        driver's own span order is never affected; the waterfall sorts
+        by timestamp for display."""
+        offset = time.monotonic() - time.time()
+        with self._tt_lock:
+            tr = self.task_traces.get(task_id)
+            if tr is None:
+                return
+            # a superseded attempt's executor can outlive its SIGTERM
+            # grace window and keep pushing its cumulative span list;
+            # merging those would both backdate the restarted chain and
+            # mark the names seen, suppressing the NEW attempt's spans.
+            # Spans stamped before this attempt began are the old
+            # process talking (same NTP-skew caveat as the re-anchoring
+            # above).
+            floor = self._attempt_wall.get(task_id, 0.0)
+            seen = self._exec_spans_seen.setdefault(task_id, set())
+            for item in spans:
+                try:
+                    name, unix_t = item[0], float(item[1])
+                except (TypeError, ValueError, IndexError):
+                    continue        # malformed push must not kill the RPC
+                if not isinstance(name, str) or name in seen:
+                    continue
+                if unix_t < floor:
+                    continue
+                seen.add(name)
+                tr.mark(name, t=unix_t + offset)
+
+    def _clear_attempt_state_locked(self, task_id: str) -> None:
+        """Drop the once-per-attempt markers. Caller holds _tt_lock."""
+        self._exec_spans_seen.pop(task_id, None)
+        self._barrier_open.discard(task_id)
+        self._first_beat.discard(task_id)
+        self._reg_t.pop(task_id, None)
+        self._attempt_wall.pop(task_id, None)
+
+    def _clear_attempt_state(self, task_id: str) -> None:
+        """Reset the once-per-attempt markers so a restarted task records
+        a fresh registered/first_heartbeat/running/executor-span chain,
+        and fence off the superseded attempt's late span pushes.
+        Caller holds no locks."""
+        with self._tt_lock:
+            self._clear_attempt_state_locked(task_id)
+            self._attempt_wall[task_id] = time.time()
+
+    def _seal_task_trace(self, task_id: str, terminal: str,
+                         **attrs) -> None:
+        """Close the task's trace with its terminal span, append the
+        record to tasks.trace.jsonl, and embed it in the jhist stream as
+        a TASK_TRACE event. Idempotent: a second seal (completion racing
+        heartbeat expiry) finds no live trace and is a no-op."""
+        with self._tt_lock:
+            tr = self.task_traces.pop(task_id, None)
+            if tr is None:
+                return
+            self._clear_attempt_state_locked(task_id)
+            if attrs:
+                tr.attrs.update(attrs)
+            tr.attrs.setdefault("restarts", self._restarts.get(task_id, 0))
+            tr.mark(terminal)
+            record = tr.to_dict()
+        if self._task_trace_writer is not None:
+            self._task_trace_writer.write(record)
+        if self.events:
+            self.events.emit(task_trace(record))
+
+    def _seal_remaining_traces(self) -> None:
+        """Seal every still-live trace by its task's final status — stop
+        and whole-job retry must leave only terminal traces behind."""
+        with self._tt_lock:
+            live = list(self.task_traces)
+        for task_id in live:
+            task = self.session.get_task_by_id(task_id)
+            status = task.status if task is not None else TaskStatus.KILLED
+            terminal = {TaskStatus.SUCCEEDED: "finished",
+                        TaskStatus.FAILED: "failed"}.get(status, "killed")
+            self._seal_task_trace(task_id, terminal, status=status.value)
+
+    # ------------------------------------------------------- driver /metrics
+    def _start_metrics_server(self) -> None:
+        """GET /metrics in Prometheus text 0.0.4 for the job driver —
+        the cluster-side sibling of the serve endpoint (docs/
+        observability.md "Driver metrics"). Port from
+        ``tony.am.metrics-port`` (0 = ephemeral, advertised as
+        ``metrics_port`` in driver.json; negative = disabled)."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        port = self.conf.get_int(keys.AM_METRICS_PORT, 0)
+        if port < 0:
+            return
+        driver = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug("metrics: " + fmt, *args)
+
+            def do_GET(self):
+                if self.path.partition("?")[0] != "/metrics":
+                    body, code, ctype = b"not found", 404, "text/plain"
+                else:
+                    try:
+                        body = driver.render_metrics().encode()
+                        code, ctype = 200, PROM_CONTENT_TYPE
+                    except Exception as e:   # a scrape must never 500 silently
+                        log.exception("metrics render failed")
+                        body, code, ctype = (
+                            f"error: {e}".encode(), 500, "text/plain")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        host = str(self.conf.get(keys.AM_RPC_HOST, "127.0.0.1"))
+        try:
+            self._metrics_httpd = ThreadingHTTPServer((host, port), _Handler)
+        except OSError as e:
+            # a taken port must not fail the job — telemetry is optional
+            log.error("could not bind driver metrics port %s: %s", port, e)
+            return
+        threading.Thread(target=self._metrics_httpd.serve_forever,
+                         name="driver-metrics", daemon=True).start()
+
+    @property
+    def metrics_port(self) -> int | None:
+        return (self._metrics_httpd.server_address[1]
+                if self._metrics_httpd is not None else None)
+
+    def render_metrics(self) -> str:
+        """The driver /metrics payload: per-role gang-launch histograms,
+        the heartbeat inter-arrival histogram, restart/expiry counters,
+        task-state gauges, the per-role straggler gauges (max/median
+        registration and heartbeat skew — how far the slowest task lags
+        its role's front-runner), and every executor-pushed metric as a
+        labeled gauge."""
+        r = PromRenderer()
+        roles = sorted(self.session.role_specs)
+        now_wall = time.time()
+        # same terminal/exit_code filter as the liveness monitor: a final
+        # beat racing the result unregister leaves a stale entry, and a
+        # finished task must not read as an ever-more-stale straggler
+        beats = {}
+        for task_id, last in list(self.heartbeats.items()):
+            task = self.session.get_task_by_id(task_id)
+            if (task is None or task.status.is_terminal()
+                    or task.exit_code is not None):
+                continue
+            beats[task_id] = last
+        with self._tt_lock:
+            for role in roles:
+                h = self._gang_hist.setdefault(role, Histogram())
+                r.histogram(
+                    DRIVER_GANG_LAUNCH_SECONDS, h,
+                    "capacity request -> worker registration, per role",
+                    labels={"role": role})
+            r.histogram(
+                DRIVER_HEARTBEAT_INTERVAL_SECONDS, self._hb_hist,
+                "observed heartbeat inter-arrival time across all tasks")
+            r.counter(DRIVER_TASK_RESTARTS_TOTAL, self._restart_count,
+                      "per-task restart budget units spent")
+            r.counter(DRIVER_HEARTBEAT_EXPIRED_TOTAL,
+                      self._hb_expired_count,
+                      "tasks deemed dead after missing the heartbeat "
+                      "budget")
+            reg = dict(self._reg_t)
+        counts: dict[str, int] = {}
+        for t in self.session.all_tasks():
+            counts[t.status.value] = counts.get(t.status.value, 0) + 1
+        for status in sorted(counts):
+            r.gauge(DRIVER_TASKS, counts[status], "tasks by state",
+                    labels={"state": status})
+        for role in roles:
+            rts = [v for tid, v in reg.items()
+                   if tid.partition(":")[0] == role]
+            lo = min(rts) if rts else 0.0
+            for stat, val in _lag_stats([v - lo for v in rts]).items():
+                r.gauge(DRIVER_STRAGGLER_REGISTRATION_S, val,
+                        "registration lag behind the role's first "
+                        "registrant (gang-launch straggler gauge)",
+                        labels={"role": role, "stat": stat})
+            bts = [v for tid, v in beats.items()
+                   if tid.partition(":")[0] == role]
+            hi = max(bts) if bts else now_wall
+            for stat, val in _lag_stats([hi - v for v in bts]).items():
+                r.gauge(DRIVER_STRAGGLER_HEARTBEAT_S, val,
+                        "heartbeat staleness behind the role's freshest "
+                        "beat (liveness straggler gauge)",
+                        labels={"role": role, "stat": stat})
+        for task_id in sorted(self.metrics):
+            for entry in self.metrics[task_id]:
+                name, value = entry.get("name"), entry.get("value")
+                if name is None or not isinstance(value, (int, float)):
+                    continue
+                r.gauge(DRIVER_TASK_METRIC, value,
+                        "executor-pushed metric snapshot (max_/avg_ "
+                        "per name)",
+                        labels={"task": task_id, "name": name})
+        return r.render()
 
     # ------------------------------------------------------------ completion
     def _on_container_completed(self, handle: ContainerHandle, exit_code: int) -> None:
@@ -433,6 +769,9 @@ class Driver:
         name, _, idx = task_id.partition(":")
         self.session.on_task_completed(name, int(idx), exit_code)
         if not already_terminal:
+            self._seal_task_trace(
+                task_id, "finished" if exit_code == 0 else "failed",
+                exit_code=exit_code, status=task.status.value)
             if self.events:
                 self.events.emit(
                     task_finished(
@@ -462,15 +801,26 @@ class Driver:
             task_id, cause or f"exited {exit_code}",
             used + 1, spec.max_restarts,
         )
+        # the trace keeps accumulating across attempts: a "restarted"
+        # mark (n-th budget unit), then the new attempt's full
+        # requested->registered chain repeats in the same record
+        with self._tt_lock:
+            self._restart_count += 1
+        self._clear_attempt_state(task_id)
+        self._trace_mark(task_id, "restarted", restarts=used + 1,
+                         last_cause=cause or f"exited {exit_code}")
         task = self.session.get_task_by_id(task_id)
         task.status = TaskStatus.REQUESTED
         task.exit_code = None  # re-arm heartbeat liveness for the new attempt
+        self._trace_mark(task_id, "requested")
         env = self._task_env(spec, int(idx))
         handle = self.provisioner.launch(spec, int(idx), env, self.job_dir / "logs")
         task.status = TaskStatus.ALLOCATED
         task.container_id = handle.container_id
+        self._trace_mark(task_id, "allocated", host=handle.host)
         self._handles[task_id] = handle
         self._launch_ms[task_id] = now_ms()
+        self._trace_mark(task_id, "launched")
         self.heartbeats.pop(task_id, None)
         if self.events:
             self.events.emit(task_started(task_id, handle.host))
@@ -519,6 +869,8 @@ class Driver:
                         msg = (f"task {task_id} missed {max_missed} "
                                "heartbeats; deemed dead")
                         log.error(msg)
+                        with self._tt_lock:
+                            self._hb_expired_count += 1
                         # a hung executor is a restartable failure, same
                         # as a crashed one: route it through the per-task
                         # budget BEFORE failing the whole job. Popping the
@@ -550,7 +902,11 @@ class Driver:
                     # budget spent (or none configured): record the
                     # heartbeat reason before the kill cascades into
                     # completion callbacks with a generic exit-code
-                    # message
+                    # message. The trace terminal is the expiry itself —
+                    # the dying container's later completion finds the
+                    # trace already sealed
+                    self._seal_task_trace(task_id, "heartbeat_expired",
+                                          reason=msg)
                     self.session._fail(msg)
                     self.session.on_task_completed(
                         task.name, task.index, c.EXIT_KILLED)
@@ -603,6 +959,9 @@ class Driver:
         reference reset:611-627. Provisioners that can re-discover capacity
         (a recreated spot TPU slice has new host addresses) refresh here."""
         self.provisioner.stop_all()
+        # the old attempt's traces must not leak into the new session's
+        # registry: seal whatever the completion callbacks haven't
+        self._seal_remaining_traces()
         refresh = getattr(self.provisioner, "refresh", None)
         if callable(refresh):
             try:
@@ -625,6 +984,7 @@ class Driver:
         client's finish signal so it can read terminal state, then tear down."""
         status = self.session.status
         self.provisioner.stop_all()
+        self._seal_remaining_traces()
         if self.events:
             failed = sum(
                 1 for t in self.session.all_tasks()
@@ -639,6 +999,11 @@ class Driver:
         self.client_signal.wait(timeout=10)
         if self.events:
             self.events.stop(status.value)
+        if self._task_trace_writer is not None:
+            self._task_trace_writer.close()
+        if self._metrics_httpd is not None:
+            self._metrics_httpd.shutdown()
+            self._metrics_httpd.server_close()
         self.rpc_server.stop()
         # release provisioner-owned capacity (driver-created TPU slices) —
         # after the client ack so a slow delete never delays terminal state

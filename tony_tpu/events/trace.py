@@ -31,6 +31,9 @@ from pathlib import Path
 log = logging.getLogger(__name__)
 
 TRACE_FILE = "requests.trace.jsonl"
+# task lifecycle traces (observability.TaskTrace, written by the driver) —
+# same record shape and torn-line contract, TASK granularity
+TASK_TRACE_FILE = "tasks.trace.jsonl"
 
 
 class TraceWriter:

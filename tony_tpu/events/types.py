@@ -21,6 +21,11 @@ class EventType(str, enum.Enum):
     # normally a sibling JSONL file (events/trace.py), but embeddable in
     # a jhist stream when a job wants request traces in its history
     REQUEST_TRACE = "REQUEST_TRACE"
+    # one task's lifecycle spans (observability.TaskTrace): emitted by the
+    # driver when the trace seals, so the jhist stream alone reconstructs
+    # the gang-launch waterfall (the sibling tasks.trace.jsonl carries the
+    # same records for the portal's high-rate read path)
+    TASK_TRACE = "TASK_TRACE"
 
 
 @dataclass
@@ -73,3 +78,8 @@ def task_finished(task_id: str, status: str, exit_code: int,
 def request_trace(trace: dict[str, Any]) -> Event:
     """``trace`` is a RequestTrace.to_dict() record (id, spans, attrs)."""
     return Event(EventType.REQUEST_TRACE, {"trace": trace})
+
+
+def task_trace(trace: dict[str, Any]) -> Event:
+    """``trace`` is a TaskTrace.to_dict() record (id = 'role:index')."""
+    return Event(EventType.TASK_TRACE, {"trace": trace})

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 
@@ -61,6 +62,7 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
 
     from tony_tpu import train
+    from tony_tpu.constants import ENV_STEP_LOG
     from tony_tpu.models import transformer
     from tony_tpu.parallel import (
         DP_RULES, EP_RULES, FSDP_TP_RULES, merge_rules, mesh_from_string,
@@ -195,7 +197,10 @@ def main(argv=None) -> int:
             print(f"  eval: loss {loss:.4f} ppl {math.exp(min(loss, 30)):.2f}")
         return loss
 
-    timer = StepTimer()
+    # TONY_STEP_LOG (set by the executor): step-time JSONL the
+    # TaskMonitor samples so per-worker step quantiles reach the driver's
+    # /metrics — running standalone (no executor) leaves it off
+    timer = StepTimer(os.environ.get(ENV_STEP_LOG) or None)
     losses = []
     last_eval = None
     last_eval_step = -1

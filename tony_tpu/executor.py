@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import signal
 import socket
 import sys
@@ -39,10 +40,18 @@ class Heartbeater(threading.Thread):
     skip-N-heartbeats fault hook. Doubles as the driver-death watchdog: when
     heartbeats fail `max_failures` times in a row the driver is gone, and the
     executor must not outlive it (the role YARN plays in the reference by
-    reaping containers of a dead AM)."""
+    reaping containers of a dead AM).
+
+    Each wait is jittered ±10% around the configured interval: a large
+    gang's executors all start within one barrier release, and a FIXED
+    interval keeps their heartbeat RPCs phase-locked — every beat lands
+    on the driver in one synchronized burst that serializes on the RPC
+    server instead of spreading over the period. ``monitor`` (the
+    TaskMonitor, optional) receives each beat's RPC round-trip time and
+    a missed-beat counter, so heartbeat health rides the metrics push."""
 
     def __init__(self, client: RpcClient, task_id: str, interval_s: float,
-                 max_failures: int = 30, on_driver_lost=None):
+                 max_failures: int = 30, on_driver_lost=None, monitor=None):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
@@ -50,20 +59,35 @@ class Heartbeater(threading.Thread):
         self._skip = int(os.environ.get(c.TEST_EXECUTOR_NUM_HB_MISS, "0"))
         self._max_failures = max_failures
         self._on_driver_lost = on_driver_lost
+        self._monitor = monitor
+        self._rng = random.Random()     # urandom-seeded: per-process phase
+        self.missed = 0
         self.stop_event = threading.Event()
 
+    def _note(self, name: str, value: float) -> None:
+        if self._monitor is not None:
+            self._monitor.note(name, value)
+
     def run(self) -> None:
+        from .metrics import HEARTBEAT_RTT_MS, HEARTBEATS_MISSED
+
         failures = 0
-        while not self.stop_event.wait(self._interval):
+        while not self.stop_event.wait(
+                self._interval * self._rng.uniform(0.9, 1.1)):
             if self._skip > 0:
                 self._skip -= 1
                 log.warning("fault injection: skipping heartbeat (%d left)", self._skip)
                 continue
             try:
+                t0 = time.monotonic()
                 self._client.call("heartbeat", task_id=self._task_id)
+                self._note(HEARTBEAT_RTT_MS,
+                           (time.monotonic() - t0) * 1000.0)
                 failures = 0
             except Exception as e:
                 failures += 1
+                self.missed += 1
+                self._note(HEARTBEATS_MISSED, float(self.missed))
                 log.warning("heartbeat failed (%d/%d): %s",
                             failures, self._max_failures, e)
                 if failures >= self._max_failures and self._on_driver_lost:
@@ -211,6 +235,13 @@ class Executor:
         # failed call must count as exactly one missed heartbeat. Started
         # BEFORE the gang barrier so a driver that dies mid-registration
         # still takes this executor down promptly.
+        # created (not started) before the heartbeater so beat RTT and
+        # missed-beat counts accumulate from the first heartbeat on; the
+        # sampler thread only starts once the gang barrier opens
+        monitor = TaskMonitor(
+            self.rpc, self.task_id,
+            interval_s=self.conf.get_int(keys.TASK_METRICS_INTERVAL_MS, 5000) / 1000,
+        )
         hb_token = os.environ.get(c.ENV_TOKEN, "")
         hb_rpc = RpcClient(
             self.driver_host, self.driver_port,
@@ -223,17 +254,15 @@ class Executor:
                 3, self.conf.get_int(keys.TASK_MAX_MISSED_HEARTBEATS, 25)
             ),
             on_driver_lost=_die_with_driver,
+            monitor=monitor,
         )
         heartbeater.start()
 
         payload = self.register_and_get_cluster_spec()
-        monitor = TaskMonitor(
-            self.rpc, self.task_id,
-            interval_s=self.conf.get_int(keys.TASK_METRICS_INTERVAL_MS, 5000) / 1000,
-        )
         monitor.start()
 
         work_dir = self._prepare_work_dir()
+        monitor.add_span("work_dir_ready")
 
         from .runtimes.base import TaskContext
 
@@ -253,6 +282,7 @@ class Executor:
         ctx.work_dir = work_dir
         ctx_holder["ctx"] = ctx
         monitor.set_context(ctx)
+        monitor.set_step_log(self._step_log_path())
 
         if self.tb_port is not None:
             # advertise the TB URL as the job's tracking URL (reference
@@ -286,7 +316,8 @@ class Executor:
             exit_code = 1
         finally:
             heartbeater.stop_event.set()
-            monitor.stop()
+            monitor.add_span("child_exited")
+            monitor.stop()      # final flush ships the closing span
             self._port_res.release()
             if self._tb_res is not None:
                 self._tb_res.release()
@@ -357,8 +388,19 @@ class Executor:
         )
         return replace(spec, path=candidate) if os.path.exists(candidate) else spec
 
+    def _step_log_path(self) -> str | None:
+        """Conventional StepTimer JSONL location for this task — the
+        TONY_STEP_LOG env contract: the training child writes step-time
+        records here, the TaskMonitor samples the newest one, and the
+        quantiles ride the metrics push to the driver."""
+        if not self.job_dir:
+            return None
+        return os.path.join(
+            self.job_dir, "logs",
+            f"{self.job_name}_{self.task_index}.steps.jsonl")
+
     def _base_child_env(self) -> dict[str, str]:
-        return {
+        env = {
             c.ENV_JOB_NAME: self.job_name,
             c.ENV_TASK_PORT: str(self.port),
             c.ENV_TASK_INDEX: str(self.task_index),
@@ -367,6 +409,10 @@ class Executor:
             c.ENV_APP_ID: self.app_id,
             c.ENV_JOB_DIR: self.job_dir,
         }
+        step_log = self._step_log_path()
+        if step_log:
+            env[c.ENV_STEP_LOG] = step_log
+        return env
 
 
 def main() -> int:

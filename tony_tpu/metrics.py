@@ -11,9 +11,11 @@ libtpu metrics when available (cluster/tpu_metrics.py).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
+import time
 from pathlib import Path
 from typing import Any
 
@@ -47,6 +49,33 @@ SERVING_TTFT_P99_S = "serving_ttft_p99_s"
 SERVING_TPOT_P50_S = "serving_tpot_p50_s"
 SERVING_TPOT_P99_S = "serving_tpot_p99_s"
 SERVING_RETRY_AFTER_S = "serving_retry_after_s"
+
+# driver-side cluster telemetry (rendered by Driver.render_metrics on the
+# driver's GET /metrics — docs/observability.md "Driver metrics"). Named
+# here under the same one-contract rule as the SERVING_* gauges; the
+# metrics-name lint test (tests/test_observability.py) asserts every
+# constant in this module is rendered and documented.
+DRIVER_GANG_LAUNCH_SECONDS = "driver_gang_launch_seconds"
+DRIVER_HEARTBEAT_INTERVAL_SECONDS = "driver_heartbeat_interval_seconds"
+DRIVER_TASK_RESTARTS_TOTAL = "driver_task_restarts_total"
+DRIVER_HEARTBEAT_EXPIRED_TOTAL = "driver_heartbeat_expired_total"
+DRIVER_STRAGGLER_REGISTRATION_S = "driver_straggler_registration_s"
+DRIVER_STRAGGLER_HEARTBEAT_S = "driver_straggler_heartbeat_s"
+DRIVER_TASKS = "driver_tasks"
+DRIVER_TASK_METRIC = "driver_task_metric"
+
+# executor-accumulator metric names (ride update_metrics pushes the same
+# way memory_rss_mb does; surface on the driver /metrics as
+# driver_task_metric{name="max_..."} gauges and in TASK_FINISHED events)
+HEARTBEAT_RTT_MS = "heartbeat_rtt_ms"
+HEARTBEATS_MISSED = "heartbeats_missed"
+# note()-d names that are cumulative totals, not per-event samples
+_COUNTER_NOTES = frozenset({HEARTBEATS_MISSED})
+CHILD_ALIVE = "child_alive"
+STEP_TIME_MEAN_S = "step_time_mean_s"
+STEP_TIME_P50_S = "step_time_p50_s"
+STEP_TIME_P99_S = "step_time_p99_s"
+STEPS_PER_SEC = "steps_per_sec"
 
 
 def _proc_tree_rss_mb(root_pid: int) -> float:
@@ -113,6 +142,14 @@ class MetricsAccumulator:
         self._count[name] = n + 1
         self._max[name] = max(self._max.get(name, float("-inf")), value)
 
+    def set(self, name: str, value: float) -> None:
+        """Overwrite semantics for cumulative counters: averaging a
+        monotone total's successive values yields a meaningless number,
+        so both snapshots report the latest total."""
+        self._count[name] = 1
+        self._avg[name] = value
+        self._max[name] = value
+
     def snapshot(self) -> list[dict[str, Any]]:
         out = []
         for name in sorted(self._count):
@@ -122,6 +159,17 @@ class MetricsAccumulator:
 
 
 class TaskMonitor:
+    """Executor-side sampler + the executor->driver telemetry channel.
+
+    Beyond the reference's resource sampling, each ``update_metrics``
+    push also carries (a) externally ``note()``-d metrics — the
+    Heartbeater feeds RPC round-trip time and a missed-beat counter —
+    (b) the child process's liveness (``child_alive``), (c) step-time
+    quantiles read from the training child's StepTimer JSONL
+    (``set_step_log``; TONY_STEP_LOG env contract), and (d) executor-
+    side lifecycle spans (``add_span``: work_dir_ready, child_spawned,
+    child_exited) that the driver merges into the task's TaskTrace."""
+
     def __init__(self, rpc_client, task_id: str, interval_s: float = 5.0):
         self._rpc = rpc_client
         self._task_id = task_id
@@ -130,9 +178,35 @@ class TaskMonitor:
         self._ctx = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # refresh runs on the monitor thread while note()/add_span() come
+        # from the heartbeater and the executor main thread
+        self._mlock = threading.Lock()
+        self._spans: list[list] = []        # [name, unix_ts] pairs
+        self._step_log: str | None = None
 
     def set_context(self, ctx) -> None:
         self._ctx = ctx
+
+    def set_step_log(self, path: str | None) -> None:
+        """Where the training child's StepTimer writes its JSONL; the
+        sampler folds the newest record's quantiles into the push."""
+        self._step_log = path
+
+    def note(self, name: str, value: float) -> None:
+        """Observe an externally-measured metric (heartbeat RTT, missed
+        beats) into the accumulator; rides the next push. Cumulative
+        counters take set semantics — see MetricsAccumulator.set."""
+        with self._mlock:
+            if name in _COUNTER_NOTES:
+                self._acc.set(name, value)
+            else:
+                self._acc.observe(name, value)
+
+    def add_span(self, name: str, t: float | None = None) -> None:
+        """Record an executor-side lifecycle span (wall-clock unix
+        seconds — the driver re-anchors onto its monotonic timeline)."""
+        with self._mlock:
+            self._spans.append([name, time.time() if t is None else t])
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -147,15 +221,57 @@ class TaskMonitor:
             except Exception:
                 log.exception("metrics refresh failed")
 
+    def _sample_step_log(self) -> dict[str, float]:
+        """Newest StepTimer record -> step-time metrics (per-worker step
+        skew becomes centrally visible on the driver's /metrics)."""
+        if not self._step_log:
+            return {}
+        try:
+            # only the newest record matters: read the file's tail, not
+            # the whole thing (it grows for the life of the training run)
+            with open(self._step_log, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - 8192))
+                lines = f.read().splitlines()
+        except OSError:
+            return {}
+        for raw in reversed(lines):     # torn-tail tolerant, like traces
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            out = {}
+            for src, dst in (("mean_step_s", STEP_TIME_MEAN_S),
+                             ("p50_s", STEP_TIME_P50_S),
+                             ("p99_s", STEP_TIME_P99_S),
+                             ("steps_per_sec", STEPS_PER_SEC)):
+                if isinstance(rec.get(src), (int, float)):
+                    out[dst] = float(rec[src])
+            return out
+        return {}
+
     def refresh(self) -> None:
         proc = getattr(self._ctx, "child_process", None) if self._ctx else None
-        root = proc.pid if proc is not None and proc.poll() is None else os.getpid()
-        self._acc.observe(MEMORY_RSS, _proc_tree_rss_mb(root))
-        for name, value in sample_tpu_metrics().items():
-            self._acc.observe(name, value)
+        child_alive = proc is not None and proc.poll() is None
+        root = proc.pid if child_alive else os.getpid()
+        rss = _proc_tree_rss_mb(root)
+        tpu = sample_tpu_metrics()
+        steps = self._sample_step_log()
+        with self._mlock:
+            self._acc.observe(MEMORY_RSS, rss)
+            if proc is not None:
+                self._acc.observe(CHILD_ALIVE, 1.0 if child_alive else 0.0)
+            for name, value in {**tpu, **steps}.items():
+                self._acc.observe(name, value)
+            metrics = self._acc.snapshot()
+            spans = [list(s) for s in self._spans]
+        # adapter-marked spans (child_spawned) live on the TaskContext
+        spans += [list(s) for s in getattr(self._ctx, "spans", []) or []]
+        spans.sort(key=lambda s: s[1])
         try:
             self._rpc.call(
-                "update_metrics", task_id=self._task_id, metrics=self._acc.snapshot()
+                "update_metrics", task_id=self._task_id, metrics=metrics,
+                spans=spans,
             )
         except Exception as e:
             log.warning("metrics push failed: %s", e)

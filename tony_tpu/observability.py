@@ -18,6 +18,13 @@ shared observability layer every serving component feeds:
   ``expired``, ``shed``, or ``failed`` instead. Dumped as JSONL next to
   the job's history events (events/trace.py) so the portal can render a
   per-request waterfall.
+- **``TaskTrace``** — the same span machinery at TASK granularity for
+  the job-orchestration path (driver.py): ``requested -> allocated ->
+  launched -> registered -> first_heartbeat -> running``, executor-side
+  enrichment spans shipped over the metrics RPC, ``restarted`` marks,
+  and a terminal from ``TASK_TERMINAL_SPANS``. Dumped as
+  ``tasks.trace.jsonl`` next to the job history; the portal renders the
+  gang-launch waterfall at ``/tasks/<app_id>``.
 - **``Histogram``** — fixed log-spaced buckets, mergeable, with
   quantile estimation. Fixed buckets (vs t-digest et al) because they
   merge across servers by integer addition and render directly as
@@ -46,6 +53,13 @@ import time
 
 # terminal span names: exactly one ends every trace
 TERMINAL_SPANS = ("finished", "cancelled", "expired", "shed", "failed")
+
+# terminal spans of an ORCHESTRATION task's lifecycle trace (TaskTrace):
+# the driver-side analogue of the request terminals above. "finished" =
+# container exited 0, "failed" = nonzero exit (restart budget spent),
+# "killed" = torn down with the job, "heartbeat_expired" = deemed dead
+# after missing the liveness budget with no restarts left.
+TASK_TERMINAL_SPANS = ("finished", "failed", "killed", "heartbeat_expired")
 
 
 class Histogram:
@@ -128,6 +142,26 @@ class Histogram:
             "p99_s": round(self.quantile(0.99), 6),
         }
 
+    def state(self) -> dict:
+        """Full serializable state (bounds + raw bucket counts) — the
+        persistence counterpart of ``snapshot()``'s lossy quantile view.
+        ``restore()`` on a fresh histogram resumes the cumulative buckets
+        exactly, so a server restart doesn't zero /metrics."""
+        return {"bounds": list(self.bounds), "counts": list(self.counts),
+                "count": self.count, "sum": self.sum}
+
+    def restore(self, state: dict) -> None:
+        """Adopt a ``state()`` dump. Bounds must match this histogram's
+        construction — resuming into different buckets would silently
+        re-bin history."""
+        if list(state["bounds"]) != self.bounds:
+            raise ValueError("cannot restore state with different buckets")
+        if len(state["counts"]) != len(self.counts):
+            raise ValueError("cannot restore state with different buckets")
+        self.counts = [int(c) for c in state["counts"]]
+        self.count = int(state["count"])
+        self.sum = float(state["sum"])
+
 
 class RequestTrace:
     """One request's lifecycle spans: (name, t_monotonic) pairs in the
@@ -138,7 +172,11 @@ class RequestTrace:
 
     __slots__ = ("id", "spans", "attrs")
 
-    def __init__(self, request_id: int):
+    # the span names that may end a trace of this kind; subclasses with a
+    # different lifecycle vocabulary (TaskTrace) override
+    TERMINALS = TERMINAL_SPANS
+
+    def __init__(self, request_id):
         self.id = request_id
         self.spans: list[tuple[str, float]] = []
         self.attrs: dict = {"submitted_unix": time.time()}
@@ -160,14 +198,42 @@ class RequestTrace:
 
     @property
     def terminal(self) -> str | None:
-        if self.spans and self.spans[-1][0] in TERMINAL_SPANS:
+        if self.spans and self.spans[-1][0] in type(self).TERMINALS:
             return self.spans[-1][0]
+        return None
+
+    def last_t(self, name: str) -> float | None:
+        """Newest occurrence of span ``name`` — a restarted lifecycle
+        records the same span once per attempt, and attempt-relative
+        durations must measure from the latest one."""
+        for n, t in reversed(self.spans):
+            if n == name:
+                return t
         return None
 
     def to_dict(self) -> dict:
         return {"id": self.id,
                 "spans": [[n, round(t, 6)] for n, t in self.spans],
                 "attrs": dict(self.attrs)}
+
+
+class TaskTrace(RequestTrace):
+    """One orchestration task's lifecycle spans, id = ``role:index``.
+
+    Same host-monotonic clock contract as RequestTrace, recorded on the
+    DRIVER's clock: ``requested -> allocated -> launched -> registered ->
+    first_heartbeat -> running`` (running = the gang barrier opened for
+    this task), executor-shipped enrichment spans (``work_dir_ready``,
+    ``child_spawned``, ``child_exited`` — wall-clock instants re-anchored
+    onto the driver's monotonic timeline at receipt, so cross-host NTP
+    skew shifts them but never reorders driver-observed spans), zero or
+    more ``restarted`` spans (one per spent restart-budget unit; the
+    whole requested->registered chain repeats after each), and exactly
+    one terminal from TASK_TERMINAL_SPANS."""
+
+    __slots__ = ()
+
+    TERMINALS = TASK_TERMINAL_SPANS
 
 
 # histogram name -> HELP text; the keys are the ``ServingTelemetry``
@@ -221,6 +287,23 @@ class ServingTelemetry:
         ``SlotServer.stats()["latency"]`` payload."""
         return {name: h.snapshot() for name, h in self.hist.items()
                 if h.count}
+
+    def state(self) -> dict:
+        """Full serializable bucket state of every histogram — persist
+        this across server restarts so the /metrics cumulative buckets
+        survive a re-arm (``restore()`` on the fresh instance resumes
+        them). ``SlotServer.reset()`` keeps its telemetry object, so this
+        pair is for PROCESS-level restarts (the serve CLI dumps it next
+        to the trace JSONL)."""
+        return {name: h.state() for name, h in self.hist.items()}
+
+    def restore(self, state: dict) -> None:
+        """Adopt a ``state()`` dump. Unknown histogram names are ignored
+        (an old dump must not block a newer server from starting);
+        mismatched buckets raise (see ``Histogram.restore``)."""
+        for name, h_state in state.items():
+            if name in self.hist:
+                self.hist[name].restore(h_state)
 
 
 class ServiceRateEstimator:
@@ -319,15 +402,22 @@ class PromRenderer:
         fam.append(f"{_sanitize(name)}{_labels(labels)} {_fmt(value)}")
 
     def histogram(self, name: str, hist: Histogram,
-                  help_text: str = "") -> None:
+                  help_text: str = "", labels: dict | None = None) -> None:
+        """``labels`` (e.g. {"role": "worker"}) lets one family carry a
+        histogram per label set — the per-role gang-launch histograms on
+        the driver's /metrics; ``le`` is appended after them."""
         name = _sanitize(name)
         fam = self._family(name, "histogram", help_text)
+        base = _labels(labels)[1:-1] if labels else ""
+        prefix = base + "," if base else ""
         cum = 0
         for bound, c in zip(hist.bounds + [math.inf], hist.counts):
             cum += c
-            fam.append(f'{name}_bucket{{le="{_fmt(bound)}"}} {cum}')
-        fam.append(f"{name}_sum {_fmt(hist.sum)}")
-        fam.append(f"{name}_count {hist.count}")
+            fam.append(
+                f'{name}_bucket{{{prefix}le="{_fmt(bound)}"}} {cum}')
+        suffix = "{" + base + "}" if base else ""
+        fam.append(f"{name}_sum{suffix} {_fmt(hist.sum)}")
+        fam.append(f"{name}_count{suffix} {hist.count}")
 
     def render(self) -> str:
         return "\n".join(
@@ -338,6 +428,6 @@ class PromRenderer:
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-__all__ = ["Histogram", "RequestTrace", "ServingTelemetry",
+__all__ = ["Histogram", "RequestTrace", "TaskTrace", "ServingTelemetry",
            "ServiceRateEstimator", "PromRenderer", "PROM_CONTENT_TYPE",
-           "TELEMETRY_HISTOGRAMS", "TERMINAL_SPANS"]
+           "TELEMETRY_HISTOGRAMS", "TERMINAL_SPANS", "TASK_TERMINAL_SPANS"]

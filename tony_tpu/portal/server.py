@@ -8,9 +8,11 @@ in-process. Stdlib http.server: no web-framework dependency.
 
 Observability additions (docs/observability.md): `/traces/<id>` renders a
 per-request timeline from the job's ``requests.trace.jsonl`` (written by
-``serve --trace-dir``, TTL-cached like the event stream), and `/metrics`
-exposes the portal's own request counters/latency in Prometheus text
-format through the same renderer the serve endpoint uses.
+``serve --trace-dir``, TTL-cached like the event stream), `/tasks/<id>`
+renders the gang-launch waterfall from ``tasks.trace.jsonl`` (written by
+the driver), and `/metrics` exposes the portal's own request
+counters/latency in Prometheus text format through the same renderer the
+serve endpoint uses.
 """
 
 from __future__ import annotations
@@ -32,7 +34,7 @@ from ..events.history import (
     HistoryFilePurger,
     parse_history_file_name,
 )
-from ..events.trace import TRACE_FILE, read_traces
+from ..events.trace import TASK_TRACE_FILE, TRACE_FILE, read_traces
 from ..observability import PROM_CONTENT_TYPE, Histogram, PromRenderer
 
 log = logging.getLogger(__name__)
@@ -70,6 +72,7 @@ class HistoryIndex:
         self._meta_cache = _TTLCache(ttl_s=10)
         self._events_cache = _TTLCache(ttl_s=30)
         self._trace_cache = _TTLCache(ttl_s=30)
+        self._task_trace_cache = _TTLCache(ttl_s=30)
 
     def _job_dirs(self):
         for root in (self.intermediate, self.finished):
@@ -130,6 +133,21 @@ class HistoryIndex:
             return read_traces(path)
 
         return self._trace_cache.get(("traces", app_id), load)
+
+    def task_traces(self, app_id: str) -> list[dict] | None:
+        """Parsed TASK lifecycle traces (``tasks.trace.jsonl``, written
+        by the driver) — the gang-launch waterfall's data; TTL-cached
+        like the request traces."""
+        def load():
+            job_dir, _ = self._find_job_dir(app_id)
+            if job_dir is None:
+                return None
+            path = job_dir / TASK_TRACE_FILE
+            if not path.exists():
+                return None
+            return read_traces(path)
+
+        return self._task_trace_cache.get(("tasks", app_id), load)
 
     def config(self, app_id: str) -> dict | None:
         for root in (self.staging,):
@@ -263,7 +281,8 @@ def _job_detail_html(app_id: str, events: list[dict]) -> str:
         f"<p><a href='/'>all jobs</a> | "
         f"<a href='/config/{html.escape(app_id)}'>config</a>"
         f" | <a href='/logs/{html.escape(app_id)}'>logs</a>"
-        f" | <a href='/traces/{html.escape(app_id)}'>requests</a></p>"
+        f" | <a href='/traces/{html.escape(app_id)}'>requests</a>"
+        f" | <a href='/tasks/{html.escape(app_id)}'>tasks</a></p>"
         "<h4>events</h4><table><tr><th>time</th><th>type</th><th>detail</th></tr>"
         + "".join(ev_rows) + "</table>"
     )
@@ -359,6 +378,108 @@ def _request_timeline_html(app_id: str, traces: list[dict]) -> str:
     return _PAGE.format(body=body)
 
 
+# task-waterfall segment color, keyed by the span that ENDS the segment
+# (observability.TaskTrace vocabulary)
+_TASK_SEG_COLORS = {
+    "allocated": "#b5b5b5",        # waiting for capacity
+    "launched": "#9aa7b8",         # allocation -> container launch
+    "registered": "#7aa7d6",       # launch -> worker registration
+    "first_heartbeat": "#8fc1d9",  # registration -> liveness
+    "running": "#c9d68a",          # gang barrier release
+    "work_dir_ready": "#d6c97a",   # executor-side setup
+    "child_spawned": "#e0a86c",    # user process up
+    "child_exited": "#c9a0d6",     # user process done, result in flight
+    "finished": "#79b77a",
+    "restarted": "#e0876c",
+    "failed": "#d98080", "killed": "#d98080",
+    "heartbeat_expired": "#d98080",
+}
+
+
+def _task_timeline_html(app_id: str, traces: list[dict]) -> str:
+    """Gang-launch waterfall: one row per task, phase segments between
+    consecutive lifecycle spans, bars scaled to the slowest task. Built
+    like the request timeline (same well-formedness contract — a torn or
+    malformed record is dropped, never a 500); executor-shipped spans
+    are wall-clock re-anchored by the driver, so the record's span list
+    is sorted by timestamp before segmenting."""
+    def well_formed(r):
+        spans = r.get("spans")
+        return (isinstance(spans, list) and spans and all(
+            isinstance(s, (list, tuple)) and len(s) == 2
+            and isinstance(s[0], str) and isinstance(s[1], (int, float))
+            for s in spans))
+
+    # terminal comes from RECORD order (the driver always seals last) —
+    # an NTP-skewed executor span sorted past it must not relabel the
+    # task; the sort is only for bar segmentation
+    recs = [dict(r, spans=sorted(r["spans"], key=lambda s: s[1]),
+                 terminal=r["spans"][-1][0])
+            for r in traces if isinstance(r, dict) and well_formed(r)]
+
+    def id_key(r):
+        # "worker:10" must sort after "worker:9", not after "worker:1"
+        role, _, idx = str(r.get("id", "")).partition(":")
+        return (role, int(idx)) if idx.isdigit() else (role, -1, idx)
+
+    recs.sort(key=lambda r: (id_key(r), r["spans"][0][1]))
+    t0_all = min((r["spans"][0][1] for r in recs), default=0.0)
+    t_max = max((r["spans"][-1][1] - t0_all for r in recs),
+                default=0.0) or 1e-9
+
+    def t_of(spans, name):
+        return next((t for n, t in spans if n == name), None)
+
+    rows = []
+    for r in recs:
+        spans, attrs = r["spans"], r.get("attrs", {})
+        terminal = r["terminal"]
+        restarts = attrs.get("restarts", "")
+        # bars share one origin (the job's first request): the waterfall
+        # shows gang SKEW, not just per-task phase splits
+        lead = 100.0 * (spans[0][1] - t0_all) / t_max
+        bar = (f"<div style='display:inline-block;height:12px;"
+               f"width:{lead:.2f}%'></div>") if lead > 0.01 else ""
+        for (pn, pt), (nn, nt) in zip(spans, spans[1:]):
+            width = max(0.3, 100.0 * (nt - pt) / t_max)
+            bar += (
+                f"<div title='{html.escape(pn)}&rarr;{html.escape(nn)} "
+                f"{nt - pt:.3f}s' style='display:inline-block;height:12px;"
+                f"width:{width:.2f}%;background:"
+                f"{_TASK_SEG_COLORS.get(nn, '#999')}'></div>")
+        t_reg = t_of(spans, "registered")
+        fmt = lambda v: "" if v is None else f"{v:.3f}"
+        rows.append(
+            f"<tr><td>{html.escape(str(r.get('id', '?')))}</td>"
+            f"<td class='{html.escape(str(terminal))}'>"
+            f"{html.escape(str(terminal))}</td>"
+            f"<td>{html.escape(str(restarts))}</td>"
+            f"<td>{fmt(None if t_reg is None else t_reg - spans[0][1])}</td>"
+            f"<td>{fmt(spans[-1][1] - spans[0][1])}</td>"
+            f"<td style='min-width:280px'>{bar}</td></tr>")
+    legend = " ".join(
+        f"<span style='background:{c};padding:0 6px'>&nbsp;</span>"
+        f"{html.escape(n)}"
+        for n, c in (("capacity", "#b5b5b5"), ("launch", "#9aa7b8"),
+                     ("register", "#7aa7d6"), ("liveness", "#8fc1d9"),
+                     ("barrier", "#c9d68a"), ("child up", "#e0a86c"),
+                     ("done", "#79b77a"), ("restart", "#e0876c"),
+                     ("dead", "#d98080")))
+    body = (
+        f"<h3>{html.escape(app_id)} — gang-launch waterfall</h3>"
+        f"<p><a href='/'>all jobs</a> | "
+        f"<a href='/jobs/{html.escape(app_id)}'>events</a> | "
+        f"<a href='/traces/{html.escape(app_id)}'>requests</a></p>"
+        f"<p>{len(recs)} tasks — timestamps are driver-host-monotonic; "
+        f"bars share the job's first request as origin and scale to the "
+        f"slowest task ({t_max:.3f}s). {legend}</p>"
+        "<table><tr><th>task</th><th>terminal</th><th>restarts</th>"
+        "<th>reg s</th><th>e2e s</th><th>timeline</th></tr>"
+        + "".join(rows) + "</table>"
+    )
+    return _PAGE.format(body=body)
+
+
 def make_handler(index: HistoryIndex, token: str = ""):
     import threading
 
@@ -369,7 +490,7 @@ def make_handler(index: HistoryIndex, token: str = ""):
     # not grow the dict (or the /metrics cardinality) without limit.
     # One lock: ThreadingHTTPServer handlers mutate these concurrently.
     _KNOWN_ROUTES = ("index", "jobs", "config", "logs", "traces",
-                     "metrics")
+                     "tasks", "metrics")
     http_requests: dict[str, int] = {}
     request_hist = Histogram()
     telemetry_lock = threading.Lock()
@@ -512,6 +633,12 @@ def make_handler(index: HistoryIndex, token: str = ""):
                         return self._json(traces)
                     return self._send(
                         200, _request_timeline_html(app_id, traces))
+                if kind == "tasks":
+                    traces = index.task_traces(app_id)
+                    if want_json or traces is None:
+                        return self._json(traces)
+                    return self._send(
+                        200, _task_timeline_html(app_id, traces))
                 if kind == "jobs":
                     events = index.events(app_id)
                     if want_json or events is None:

@@ -12,8 +12,13 @@ service (rpc/MetricsRpc.java), carried as framed JSON over TCP:
   register_tensorboard_url(url)        -> bool
   register_callback_info(task_id, payload) -> bool   (runtime rendezvous data)
   finish_application()                 -> bool       (client lets driver exit)
-  update_metrics(task_id, metrics)     -> bool
+  update_metrics(task_id, metrics, spans=None) -> bool
   get_metrics(task_id)                 -> [MetricSample]
+
+``update_metrics`` additionally carries executor-side lifecycle spans
+([name, unix_ts] pairs: work_dir_ready, child_spawned, child_exited) that
+the driver merges into the task's lifecycle trace (observability.
+TaskTrace) — the enrichment channel for the gang-launch waterfall.
 """
 
 from .client import RpcClient

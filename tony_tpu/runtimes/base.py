@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import subprocess
 import os
+import time
 from typing import TYPE_CHECKING, Any
 
 from ..api import DistributedMode
@@ -101,6 +102,7 @@ class TaskAdapter:
             env = {**os.environ, **contract_env}
         proc = subprocess.Popen(argv, env=env, cwd=ctx.work_dir or None)
         ctx.child_process = proc
+        ctx.note_span("child_spawned")
         try:
             return proc.wait()
         finally:
@@ -143,6 +145,13 @@ class TaskContext:
         self.work_dir: str | None = None
         self.child_process: subprocess.Popen | None = None
         self.container_name: str | None = None
+        # executor-side lifecycle spans ([name, unix_ts]) — adapters mark
+        # child_spawned here; the TaskMonitor ships them to the driver,
+        # which merges them into the task's TaskTrace
+        self.spans: list[list] = []
+
+    def note_span(self, name: str) -> None:
+        self.spans.append([name, time.time()])
 
     @property
     def cluster_spec(self) -> dict[str, list[str]]:

@@ -44,10 +44,17 @@ class StepTimer:
     clock contract as the serving traces (observability.RequestTrace)."""
 
     def __init__(self, out_path: str | Path | None = None, window: int = 50):
+        from ..observability import Histogram
+
         self._out = Path(out_path) if out_path else None
         self._window = window
         self._t_last: float | None = None
         self._times: list[float] = []
+        # cumulative step-time distribution (the rolling window forgets;
+        # skew detection needs the tail): quantiles ride the JSONL record,
+        # which the executor's TaskMonitor samples into the metrics push —
+        # per-worker step skew becomes visible on the driver's /metrics
+        self.hist = Histogram()
         self.step = 0
 
     def tick(self, **extra) -> float | None:
@@ -59,6 +66,7 @@ class StepTimer:
             self._times.append(dt)
             if len(self._times) > self._window:
                 self._times.pop(0)
+            self.hist.observe(dt)
         self._t_last = now
         self.step += 1
         if self._out and dt is not None and self.step % self._window == 0:
@@ -66,11 +74,20 @@ class StepTimer:
                 "step": self.step,
                 "mean_step_s": sum(self._times) / len(self._times),
                 "steps_per_sec": len(self._times) / sum(self._times),
+                "p50_s": round(self.hist.quantile(0.5), 6),
+                "p99_s": round(self.hist.quantile(0.99), 6),
                 "ts": time.time(),
                 **extra,
             }
-            with open(self._out, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+            # best-effort, like the rest of the telemetry path: a missing
+            # log dir (remote executor, no logs/ in the unpacked archive)
+            # or a full disk must not kill the training loop
+            try:
+                self._out.parent.mkdir(parents=True, exist_ok=True)
+                with open(self._out, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            except OSError as e:
+                log.warning("step log write failed: %s", e)
         return dt
 
     def reset_interval(self) -> None:
