@@ -51,7 +51,10 @@ parity check against the single-device completions. On CPU run it under
 PERF.json under `continuous_batching_tp`, and the timed pass's
 p50/p90/p99 TTFT/TPOT/queue-wait/e2e (from the observability
 histograms, docs/observability.md) under `serving_latency` — the
-latency baseline future perf PRs regress against.
+latency baseline future perf PRs regress against — plus a `device_time`
+section (dispatch→ready quantiles per program kind from the
+DispatchTracker, measured device lag behind host observation, and the
+XLA compile count/time for the whole bench process).
 
 `python bench.py --serving --shared-prefix` benchmarks the chunk-aligned
 prefix KV cache on the workload it exists for: N requests sharing one
@@ -216,6 +219,11 @@ def run_serving_bench() -> int:
 
     from tony_tpu.models import transformer
     from tony_tpu.models.serving import Request, SlotServer
+    from tony_tpu.observability import install_compile_telemetry
+
+    # compile-time attribution rides the same run: installed BEFORE any
+    # program compiles so the warm-up pass's compiles are counted
+    compile_telemetry = install_compile_telemetry()
 
     cfg = transformer.TransformerConfig(
         vocab_size=2048, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
@@ -251,16 +259,27 @@ def run_serving_bench() -> int:
         # so ids differ between server instances serving the same workload
         toks = {i: done[r.id].tokens for i, r in enumerate(reqs)}
         n_tokens = sum(len(t) for t in toks.values())
-        return {
+        srv.dispatch_tracker.drain(timeout=10.0)    # reaper catches up
+        out = {
             "wall_s": round(wall, 3),
             "tokens_per_sec": round(n_tokens / wall, 1),
             "useful_tokens": n_tokens,
             "admission_dispatches": srv.admission_dispatches,
             "latency": srv.telemetry.snapshot(),
-        }, toks
+            "device": srv.dispatch_tracker.snapshot(),
+        }
+        srv.shutdown()      # bench builds many servers: no thread pile-up
+        return out, toks
 
     serve(params, batched=True)                       # compile warm-up
+    # warmup line: compiles past here are RECOMPILES — the timed pass
+    # replays warm shapes, so a healthy run reads ~0 post-warm
+    compile_telemetry.mark_warm()
     batched, toks_b = serve(params, batched=True)
+    # snapshot BEFORE the per-slot/TP passes, which legitimately compile
+    # new program shapes (serial admission, sharded programs) and would
+    # drown the timed pass's recompile signal
+    compile_snap = compile_telemetry.snapshot()
     serve(params, batched=False)                      # warm per-slot too
     perslot, toks_p = serve(params, batched=False)
     assert toks_b == toks_p, "admission policy changed completions"
@@ -270,11 +289,31 @@ def run_serving_bench() -> int:
     # the PERF.json `serving_latency` section future perf PRs regress
     # against. Host-monotonic spans; the whole burst is submitted up
     # front, so queue waits here measure the saturated-backlog shape.
+    latency_full = batched.pop("latency")
     serving_latency = {
-        k: v for k, v in batched.pop("latency").items()
+        k: v for k, v in latency_full.items()
         if k in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_s")
     }
+    # device-time attribution (ISSUE 6): dispatch→ready quantiles per
+    # program kind, the measured device lag behind host observation, and
+    # the XLA compile bill of warm-up + timed pass (compile_snap was
+    # taken before the per-slot/TP passes) — the PERF.json `device_time`
+    # section future PRs track the trajectory against. The device lag is
+    # the saturated-backlog shape, same caveat as serving_latency: the
+    # burst is submitted up front and blocks go device-ready well before
+    # the host replays them.
+    device = batched.pop("device")
+    device_lag = latency_full.get("device_lag_s", {})
+    device_time = {
+        "dispatch_ready": device["dispatch_ready"],
+        "dispatches_tracked": device["tracked"],
+        "dispatch_track_dropped": device["dropped"],
+        "mean_device_lag_s": device_lag.get("mean_s", 0.0),
+        "p99_device_lag_s": device_lag.get("p99_s", 0.0),
+        "compile": compile_snap,
+    }
     perslot.pop("latency", None)
+    perslot.pop("device", None)
     out = {
         "metric": "continuous_batching_serving_tokens_per_sec",
         "value": batched["tokens_per_sec"],
@@ -284,6 +323,7 @@ def run_serving_bench() -> int:
         "prompt_lens_cycle": prompt_lens,
         "budgets_cycle": budgets,
         "serving_latency": serving_latency,
+        "device_time": device_time,
         "batched_admission": batched,
         "per_slot_admission": perslot,
         "admission_dispatch_ratio": round(
@@ -303,6 +343,7 @@ def run_serving_bench() -> int:
         serve(prep, batched=True, mesh=mesh)          # warm-up
         tp, toks_tp = serve(prep, batched=True, mesh=mesh)
         tp.pop("latency", None)
+        tp.pop("device", None)
         out["tp"] = {**tp, "mesh": dict(mesh.shape),
                      "parity_vs_single_device": toks_tp == toks_b}
     print(json.dumps(out))

@@ -222,7 +222,8 @@ def test_sample_tpu_metrics_jax_memory_stats_fallback(monkeypatch):
         def memory_stats(self):
             if self._b is None:
                 return None          # the axon tunnel reports no stats
-            return {"bytes_in_use": self._b, "peak_bytes_in_use": self._b}
+            return {"bytes_in_use": self._b,
+                    "peak_bytes_in_use": self._b * 2}
 
     fake_jax = types.ModuleType("jax")
     fake_jax.local_devices = lambda: [FakeDev(4_000_000), FakeDev(8_000_000)]
@@ -235,8 +236,20 @@ def test_sample_tpu_metrics_jax_memory_stats_fallback(monkeypatch):
     monkeypatch.delitem(sys.modules, "libtpu.sdk", raising=False)
 
     out, reason = M.sample_tpu_metrics(explain=True)
-    assert out == {M.TPU_HBM_USED: 12.0}     # SUM over chips, like the sdk
+    # SUM over chips, like the sdk — plus the peak-bytes watermark gauge
+    # (capacity planning's number) where the runtime serves it
+    assert out == {M.TPU_HBM_USED: 12.0, M.TPU_HBM_PEAK: 24.0}
     assert reason is None                     # non-empty sample: no excuse
+
+    # a runtime that serves occupancy but no watermark: the peak series
+    # is OMITTED, never rendered as zero
+    class NoPeakDev(FakeDev):
+        def memory_stats(self):
+            return {"bytes_in_use": self._b}
+
+    fake_jax.local_devices = lambda: [NoPeakDev(4_000_000)]
+    out, _ = M.sample_tpu_metrics(explain=True)
+    assert out == {M.TPU_HBM_USED: 4.0}
 
     # non-TPU devices must never masquerade as TPU memory
     fake_jax.local_devices = lambda: [FakeDev(4_000_000, platform="gpu"),

@@ -1,0 +1,584 @@
+"""Device-time attribution layer (docs/observability.md "Device timing &
+profiling").
+
+The contract under test: ``DispatchTracker`` measures dispatch→ready per
+program kind off the hot path, in dispatch order, and survives reset()
+without leaking threads or letting stale ready-instants cross the reset;
+``CompileTelemetry`` counts actual XLA backend compiles (the jax
+monitoring listener fires on a forced recompile) and flags post-warmup
+recompile storms; the on-demand profiler capture path works end to end
+with a stubbed profiler — serve's ``/debug/profile`` HTTP surface, the
+executor's ``$TONY_STEP_LOG.profile`` flag-file contract, the training
+child's StepTimer poll, the Heartbeater command relay — and the portal
+lists and serves captured profiles. Everything here uses stub buffers /
+stubbed ``jax.profiler`` entry points so the suite stays in single-digit
+seconds; real capture is behind ``@pytest.mark.slow``.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from tony_tpu import constants as c
+from tony_tpu.observability import (
+    COMPILE_TELEMETRY,
+    CompileTelemetry,
+    DispatchTracker,
+    install_compile_telemetry,
+)
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class _Buf:
+    """Stub device buffer: block_until_ready() waits on an Event (or
+    raises, for the dead-donated-buffer path)."""
+
+    def __init__(self, ready: bool = True, raises: bool = False):
+        self.ev = threading.Event()
+        if ready:
+            self.ev.set()
+        self.raises = raises
+        self.blocked = 0
+
+    def block_until_ready(self):
+        self.blocked += 1
+        if self.raises:
+            raise RuntimeError("buffer deleted (donated into a failed "
+                               "dispatch)")
+        assert self.ev.wait(10), "stub buffer never released"
+
+
+def _reaper_count():
+    return sum(1 for t in threading.enumerate()
+               if t.name == "dispatch-reaper" and t.is_alive())
+
+
+# --------------------------------------------------------------------------
+# DispatchTracker: ordering, lag math, overflow, errors, reset, shutdown
+# --------------------------------------------------------------------------
+
+def test_dispatch_tracker_orders_and_histograms_per_kind():
+    tr = DispatchTracker()
+    try:
+        bufs = [_Buf(ready=False) for _ in range(3)]
+        seqs = [tr.track("prefill", bufs[0]),
+                tr.track("decode_block", bufs[1]),
+                tr.track("decode_block", bufs[2])]
+        assert seqs == sorted(seqs), "sequence numbers must be monotone"
+        assert tr.in_flight == 3
+        for b in bufs:      # release in dispatch order — device order
+            b.ev.set()
+        assert tr.drain(timeout=10)
+        assert tr.in_flight == 0
+        assert tr.tracked_total == 3 and tr.dropped == 0
+        snap = tr.snapshot()
+        assert snap["dispatch_ready"]["prefill"]["count"] == 1
+        assert snap["dispatch_ready"]["decode_block"]["count"] == 2
+        # ready instants are recorded per seq and ordered like dispatch
+        times = [tr.ready_time(s) for s in seqs]
+        assert all(t is not None for t in times)
+        assert times == sorted(times)
+        # a consistent rendering copy matches the live counts
+        hists = tr.histograms()
+        assert hists["decode_block"].count == 2
+    finally:
+        tr.shutdown()
+
+
+def test_dispatch_tracker_ready_time_lookup_rules():
+    tr = DispatchTracker()
+    try:
+        seq = tr.track("decode_block", _Buf())
+        assert tr.drain(timeout=10)
+        t0 = tr.ready_time(seq)
+        assert t0 is not None and t0 <= time.monotonic()
+        # never-tracked seq beyond the counter: None without waiting
+        assert tr.ready_time(seq + 1000) is None
+        # eviction: the ring keeps READY_KEEP entries, older ones drop
+        tr.READY_KEEP = 4
+        seqs = [tr.track("decode_block", _Buf()) for _ in range(8)]
+        assert tr.drain(timeout=10)
+        assert tr.ready_time(seqs[0]) is None, "evicted entry must be None"
+        assert tr.ready_time(seqs[-1]) is not None
+        # the timeout path: a pending dispatch resolves while we wait
+        slow = _Buf(ready=False)
+        seq2 = tr.track("decode_block", slow)
+        threading.Timer(0.05, slow.ev.set).start()
+        assert tr.ready_time(seq2, timeout=5.0) is not None
+    finally:
+        tr.shutdown()
+
+
+def test_dispatch_tracker_overflow_drops_telemetry_only():
+    tr = DispatchTracker(max_pending=2)
+    try:
+        gate = _Buf(ready=False)        # wedges the reaper
+        tr.track("prefill", gate)
+        for _ in range(4):
+            tr.track("prefill", _Buf())
+        assert tr.dropped >= 2, "overflow must drop, not grow unboundedly"
+        assert tr.in_flight <= tr.max_pending + 1
+        gate.ev.set()
+        assert tr.drain(timeout=10)
+        assert tr.tracked_total + tr.dropped == 5
+    finally:
+        tr.shutdown()
+
+
+def test_dispatch_tracker_tolerates_dead_buffers():
+    tr = DispatchTracker()
+    try:
+        tr.track("prefill", _Buf(raises=True))
+        after = _Buf()
+        tr.track("decode_block", after)
+        assert tr.drain(timeout=10)
+        assert tr.reap_errors == 1
+        assert tr.alive, "a dead buffer must not kill the reaper"
+        assert tr.snapshot()["dispatch_ready"]["decode_block"]["count"] == 1
+        assert "prefill" not in tr.snapshot()["dispatch_ready"]
+    finally:
+        tr.shutdown()
+
+
+def test_dispatch_tracker_reset_rearms_without_blocking_or_leaking():
+    n0 = _reaper_count()
+    tr = DispatchTracker()
+    assert _reaper_count() == n0 + 1
+    thread = tr._thread
+    done = tr.track("decode_block", _Buf())
+    assert tr.drain(timeout=10)
+    assert tr.ready_time(done) is not None
+    stale = _Buf(ready=False)           # pending across the reset
+    stale_seq = tr.track("decode_block", stale)
+    t0 = time.monotonic()
+    tr.reset()                          # must NOT block on the dead buffer
+    assert time.monotonic() - t0 < 1.0
+    assert tr._thread is thread and tr.alive, (
+        "reset must re-arm the SAME reaper thread, not spawn another")
+    assert _reaper_count() == n0 + 1
+    # no stale ready-instant crosses the reset
+    assert tr.ready_time(done) is None
+    before = tr.snapshot()["dispatch_ready"].get(
+        "decode_block", {}).get("count", 0)
+    stale.ev.set()                      # pre-reset dispatch resolves late
+    # post-reset dispatches keep recording on the same thread
+    fresh = tr.track("decode_block", _Buf())
+    assert tr.drain(timeout=10)
+    assert tr.ready_time(fresh) is not None
+    assert tr.ready_time(stale_seq) is None, (
+        "a pre-reset dispatch must not record into the new generation")
+    after = tr.snapshot()["dispatch_ready"]["decode_block"]["count"]
+    assert after == before + 1, (
+        "only the post-reset dispatch may feed the histogram")
+    tr.shutdown()
+    assert _reaper_count() == n0 and not tr.alive
+
+
+def test_dispatch_tracker_shutdown_idempotent():
+    tr = DispatchTracker()
+    pending = _Buf(ready=False)
+    tr.track("prefill", pending)
+    tr.shutdown()                       # must not block on the wedge
+    assert not tr.alive
+    tr.shutdown()                       # idempotent
+    before = tr.tracked_total
+    tr.track("prefill", _Buf())         # post-shutdown: seq only, no queue
+    assert tr.tracked_total == before
+    pending.ev.set()
+
+
+# --------------------------------------------------------------------------
+# CompileTelemetry: counting, warmup line, storm warning, live listener
+# --------------------------------------------------------------------------
+
+def test_compile_telemetry_counts_and_storm_warning(caplog):
+    ct = CompileTelemetry(storm_threshold=3)
+    ct.note("/jax/core/compile/jaxpr_trace_duration", 9.0)  # not a compile
+    assert ct.compiles == 0
+    ct.note(_COMPILE_EVENT, 0.5)
+    ct.note(_COMPILE_EVENT, 1.5)
+    snap = ct.snapshot()
+    assert snap["compiles"] == 2 and not snap["warm"]
+    assert snap["compile_time_s"] == pytest.approx(2.0)
+    assert snap["recompiles_post_warm"] == 0, "pre-warm compiles are free"
+    ct.mark_warm()
+    ct.mark_warm()                      # idempotent: line drawn once
+    with caplog.at_level("WARNING", logger="tony_tpu.observability"):
+        for _ in range(3):
+            ct.note(_COMPILE_EVENT, 0.1)
+    assert ct.recompiles_post_warm == 3
+    storm = [r for r in caplog.records if "recompile storm" in r.message]
+    assert len(storm) == 1, "storm warning fires exactly once"
+    # rendering copy is consistent and independent of the live histogram
+    h = ct.hist_copy()
+    assert h.count == 5
+    h.observe(1.0)
+    assert ct.hist.count == 5
+
+
+def test_compile_listener_captures_forced_recompile():
+    """The jax.monitoring listener is live: jitting a never-seen shape
+    forces an actual XLA backend compile and the process-global
+    telemetry counts it; re-running the same shape (a cache hit)
+    counts nothing."""
+    import jax
+    import jax.numpy as jnp
+
+    ct = install_compile_telemetry()
+    assert ct is COMPILE_TELEMETRY
+    assert install_compile_telemetry() is ct     # idempotent
+
+    @jax.jit
+    def _probe(x):
+        return x * 3 + 1
+
+    before = ct.snapshot()["compiles"]
+    _probe(jnp.ones((7,))).block_until_ready()   # unique shape: compiles
+    mid = ct.snapshot()["compiles"]
+    assert mid > before, "a forced compile must reach the listener"
+    _probe(jnp.ones((7,))).block_until_ready()   # cache hit: no event
+    assert ct.snapshot()["compiles"] == mid
+
+
+def test_step_timer_compile_warm_gating():
+    """A training StepTimer draws the compile warmup line at its first
+    measured step (step 1 ran every program shape); the serving
+    loop-TURN timer must not — its turns tick before any request has
+    compiled anything, and the serving warm line belongs to the first
+    delivered completion (ServeApp._deliver)."""
+    from tony_tpu.train.profiling import StepTimer
+
+    class _Fake:
+        def __init__(self):
+            self.warm = 0
+
+        def mark_warm(self):
+            self.warm += 1
+
+    train_timer = StepTimer(window=4)
+    train_timer._compile = train_fake = _Fake()
+    train_timer.tick()
+    train_timer.tick()
+    assert train_fake.warm >= 1
+
+    turn_timer = StepTimer(window=4, compile_warm_on_step=False)
+    turn_timer._compile = turn_fake = _Fake()
+    turn_timer.tick()
+    turn_timer.tick()
+    assert turn_fake.warm == 0
+
+
+# --------------------------------------------------------------------------
+# on-demand profiler capture: StepTimer flag poll + executor relay
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def stub_profiler(monkeypatch):
+    """Stub the jax.profiler seams: start writes a fake xplane file so
+    the capture directory looks like a real dump."""
+    from pathlib import Path
+
+    from tony_tpu.train import profiling
+
+    calls = {"start": [], "stop": 0}
+
+    def _start(log_dir):
+        calls["start"].append(str(log_dir))
+        d = Path(log_dir)
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "host.xplane.pb").write_bytes(b"\x00fake-xplane")
+
+    monkeypatch.setattr(profiling, "_start_profiler", _start)
+    monkeypatch.setattr(profiling, "_stop_profiler",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+    return calls
+
+
+def test_step_timer_profile_flag_contract(tmp_path, stub_profiler):
+    """The full flag-file round trip: the executor relays a driver
+    command by writing ``$TONY_STEP_LOG.profile`` (tmp+rename), the
+    StepTimer picks it up at its record cadence, captures for the
+    requested window, and deletes the flag."""
+    from tony_tpu.executor import write_profile_flag
+    from tony_tpu.train.profiling import StepTimer
+
+    step_log = tmp_path / "logs" / "w0.steps.jsonl"
+    step_log.parent.mkdir()
+    timer = StepTimer(step_log, window=2)
+    timer.tick(); timer.tick()          # record boundary, no flag yet
+    assert stub_profiler["start"] == []
+
+    flag = write_profile_flag(str(step_log), {"seconds": 0.0})
+    assert flag == str(step_log) + c.PROFILE_REQUEST_SUFFIX
+    req = json.loads(open(flag).read())
+    assert req["seconds"] == 0.0
+    assert f"/{c.PROFILE_DIR_NAME}/" in req["out_dir"]
+
+    timer.tick(); timer.tick()          # boundary: flag consumed, capture on
+    assert stub_profiler["start"] == [req["out_dir"]]
+    assert not (tmp_path / "logs" / "w0.steps.jsonl.profile").exists(), (
+        "consumed flag must be deleted")
+    timer.tick()                        # window elapsed (0s): capture off
+    assert stub_profiler["stop"] == 1
+    # the dump landed where the portal will look for it
+    assert (tmp_path / "logs" / c.PROFILE_DIR_NAME).is_dir()
+
+    # a capture whose window outlives the loop: close() (also armed via
+    # atexit) stops it early so the dump flushes instead of vanishing
+    write_profile_flag(str(step_log), {"seconds": 60})
+    timer.tick()                        # boundary: capture starts
+    assert len(stub_profiler["start"]) == 2
+    timer.close()
+    assert stub_profiler["stop"] == 2
+    timer.close()                       # idempotent
+    assert stub_profiler["stop"] == 2
+
+
+def test_step_timer_tolerates_torn_profile_flag(tmp_path, stub_profiler):
+    from tony_tpu.train.profiling import StepTimer
+
+    step_log = tmp_path / "w0.steps.jsonl"
+    timer = StepTimer(step_log, window=2)
+    flag = step_log.with_name(step_log.name + c.PROFILE_REQUEST_SUFFIX)
+    flag.write_text('{"seconds": 1.')            # torn mid-write
+    timer.tick(); timer.tick()
+    assert stub_profiler["start"] == [], "torn request must not capture"
+    assert not flag.exists(), "torn flag must be cleared, not wedge"
+    timer.tick(); timer.tick()                   # loop is alive and well
+    assert timer.step == 4
+
+
+def test_write_profile_flag_requires_step_log():
+    from tony_tpu.executor import write_profile_flag
+
+    assert write_profile_flag(None, {"seconds": 2}) is None
+    assert write_profile_flag("", {"seconds": 2}) is None
+
+
+def test_heartbeater_relays_profile_command():
+    """A dict heartbeat response carries a driver command; the
+    Heartbeater hands it to on_command exactly once and a raising
+    callback must not stop the beat (the beat IS liveness)."""
+    from tony_tpu.executor import Heartbeater
+
+    class _Client:
+        def __init__(self):
+            self.beats = 0
+
+        def call(self, method, **params):
+            self.beats += 1
+            if self.beats == 1:
+                return {"profile": {"seconds": 2.5}}
+            return True
+
+    got = []
+
+    def on_command(cmd):
+        got.append(cmd)
+        raise RuntimeError("relay blew up")      # must not kill the thread
+
+    client = _Client()
+    hb = Heartbeater(client, "worker:0", interval_s=0.01,
+                     on_command=on_command)
+    hb.start()
+    deadline = time.time() + 5
+    while client.beats < 4 and time.time() < deadline:
+        time.sleep(0.01)
+    hb.stop_event.set()
+    hb.join(timeout=5)
+    assert client.beats >= 4, "beat must continue past a bad command"
+    assert got == [{"seconds": 2.5}]
+
+
+# --------------------------------------------------------------------------
+# serve /debug/profile: HTTP smoke against a stub engine
+# --------------------------------------------------------------------------
+
+class _StubEngine:
+    """Bare-minimum engine for ServeApp construction; the loop is never
+    started, only the profile surface is exercised."""
+
+    def shutdown(self):
+        pass
+
+
+def _profile_app(tmp_path, monkeypatch):
+    import jax
+
+    from tony_tpu.cli.serve import ServeApp
+
+    def _start(log_dir, *a, **kw):
+        from pathlib import Path
+
+        p = Path(str(log_dir))
+        p.mkdir(parents=True, exist_ok=True)
+        (p / "plugins").mkdir(exist_ok=True)
+        (p / "plugins" / "host.xplane.pb").write_bytes(b"\x00xp")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", _start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    return ServeApp(_StubEngine(), trace_dir=str(tmp_path))
+
+
+def test_debug_profile_http_smoke(tmp_path, monkeypatch):
+    from tony_tpu.cli.serve import ServeApp, make_handler
+
+    app = _profile_app(tmp_path, monkeypatch)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(app))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile?seconds=0.01",
+                timeout=10) as r:
+            out = json.loads(r.read())
+        assert out["seconds"] == 0.01
+        assert out["files"], "capture must list the dumped files"
+        assert any(f.endswith(".xplane.pb") for f in out["files"])
+        assert out["dir"].startswith(str(tmp_path))
+        assert f"/{c.PROFILE_DIR_NAME}/" in out["dir"] + "/"
+
+        # out-of-range window -> 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile?seconds=9999",
+                timeout=10)
+        assert e.value.code == 400
+
+        # concurrent capture -> 409 (jax's trace machinery is global)
+        assert app._profile_lock.acquire(blocking=False)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/profile?seconds=0.01",
+                    timeout=10)
+            assert e.value.code == 409
+        finally:
+            app._profile_lock.release()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+    # no --trace-dir: nowhere to write -> 409, not a silent no-op
+    bare = ServeApp(_StubEngine())
+    with pytest.raises(RuntimeError, match="trace-dir"):
+        bare.capture_profile(1.0)
+
+
+# --------------------------------------------------------------------------
+# portal: /profiles listing + download + traversal guard
+# --------------------------------------------------------------------------
+
+def test_portal_profiles_listing_and_download(tmp_path):
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.events.history import history_file_name
+    from tony_tpu.portal.server import serve_portal
+
+    inter = tmp_path / "hist" / "intermediate"
+    job = inter / "app_prof"
+    job.mkdir(parents=True)
+    (job / history_file_name("app_prof", 1000, end_ms=9000, user="u",
+                             status="SUCCEEDED")).write_text("")
+    # serve-side capture root (history job dir)
+    cap = job / c.PROFILE_DIR_NAME / "serve_1700_2s"
+    cap.mkdir(parents=True)
+    (cap / "host.xplane.pb").write_bytes(b"\x00serve-xplane")
+    # training-worker capture root (staging logs tree, flag-file path)
+    wcap = (tmp_path / "staging" / "app_prof" / "logs"
+            / c.PROFILE_DIR_NAME / "w0_1700")
+    wcap.mkdir(parents=True)
+    (wcap / "host.xplane.pb").write_bytes(b"\x00worker-xplane")
+    secret = tmp_path / "hist" / "secret.txt"
+    secret.write_text("not yours")
+    bare = inter / "app_bare"
+    bare.mkdir(parents=True)
+    (bare / history_file_name("app_bare", 1000, end_ms=2000, user="u",
+                              status="SUCCEEDED")).write_text("")
+
+    conf = TonyConf({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.intermediate": str(inter),
+        "tony.history.finished": str(tmp_path / "hist" / "finished"),
+    })
+    server = serve_portal(conf, port=0, block=False)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        def get(path, accept="application/json"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", headers={"Accept": accept})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, resp.read()
+
+        status, body = get("/profiles/app_prof")
+        profiles = json.loads(body)
+        assert status == 200
+        names = {p["name"] for p in profiles}
+        assert names == {"serve_1700_2s/host.xplane.pb",
+                         "w0_1700/host.xplane.pb"}, (
+            "both capture roots must be listed")
+        assert all(p["bytes"] > 0 and p["mtime"] > 0 for p in profiles)
+
+        status, body = get("/profiles/app_prof", accept="text/html")
+        html = body.decode()
+        assert status == 200 and "captured profiles" in html
+        assert "serve_1700_2s/host.xplane.pb" in html
+        assert "tensorboard --logdir" in html
+        status, body = get("/jobs/app_prof", accept="text/html")
+        assert "/profiles/app_prof" in body.decode(), (
+            "job page must link the profile listing")
+
+        status, body = get("/profiles/app_prof/serve_1700_2s/host.xplane.pb")
+        assert status == 200 and body == b"\x00serve-xplane"
+
+        for missing in ("/profiles/app_bare",            # never profiled
+                        "/profiles/app_prof/nope.pb"):   # unknown file
+            with pytest.raises(urllib.error.HTTPError) as e:
+                get(missing)
+            assert e.value.code == 404
+
+        # traversal guard: a crafted relative name must not escape the
+        # profile roots (checked at the index so every encoding that
+        # reaches it is covered)
+        from tony_tpu.portal.server import HistoryIndex
+
+        idx = HistoryIndex(conf)
+        assert idx.profile_file("app_prof", "../../secret.txt") is None
+        assert idx.profile_file(
+            "app_prof", "serve_1700_2s/../../../secret.txt") is None
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/profiles/app_prof/%2e%2e/%2e%2e/secret.txt")
+        assert e.value.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# --------------------------------------------------------------------------
+# real capture (CPU profiler) — slow-marked, tier-1 skips it
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_real_profiler_capture_produces_xplane(tmp_path):
+    """Unstubbed jax.profiler round trip through capture_profile: the
+    dump contains an actual xplane proto."""
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.cli.serve import ServeApp
+
+    app = ServeApp(_StubEngine(), trace_dir=str(tmp_path))
+    # give the profiler something to see
+    t = threading.Thread(
+        target=lambda: [jax.jit(lambda x: x @ x)(
+            jnp.ones((64, 64))).block_until_ready() for _ in range(50)])
+    t.start()
+    out = app.capture_profile(0.5)
+    t.join()
+    assert any(f.endswith(".xplane.pb") for f in out["files"]), out

@@ -295,6 +295,38 @@ def test_trace_mid_decode_cancel(params):
     assert not srv._traces
 
 
+def test_device_lag_measured_on_traces(params):
+    """Device-time attribution on the live serving path: every served
+    request's trace carries the MEASURED device lag (dispatch-tracker
+    ready instant vs host observation) where the old contract only
+    documented a pipeline_depth bound, the lag distribution feeds the
+    device_lag_s histogram, and the tracker's per-kind dispatch→ready
+    histograms cover prefill and decode blocks."""
+    srv = _srv(params)
+    try:
+        a = Request(prompt=_prompt(5, seed=30), max_new_tokens=6)
+        srv.submit(a)
+        done = srv.run_until_drained()
+        comp = done[a.id]
+        assert comp.finish_reason == "length"
+        lag = comp.trace["attrs"].get("device_lag_s")
+        lag_ft = comp.trace["attrs"].get("device_lag_first_token_s")
+        assert lag is not None and lag >= 0.0
+        assert lag_ft is not None and lag_ft >= 0.0
+        assert srv.telemetry.hist["device_lag_s"].count > 0
+        assert srv.dispatch_tracker.drain(timeout=10)
+        snap = srv.dispatch_tracker.snapshot()
+        assert snap["in_flight"] == 0 and snap["dropped"] == 0
+        assert snap["dispatch_ready"]["prefill"]["count"] >= 1
+        assert snap["dispatch_ready"]["decode_block"]["count"] >= 1
+        assert snap["tracked"] == sum(
+            h["count"] for h in snap["dispatch_ready"].values())
+        # stats() mirrors the tracker under "device"
+        assert srv.stats()["device"]["tracked"] == snap["tracked"]
+    finally:
+        srv.shutdown()
+
+
 def test_reset_seals_inflight_traces(params):
     """reset() after a loop failure must not leak traces: in-flight
     requests' traces end at the failed terminal, queued ones survive."""
@@ -342,6 +374,9 @@ def test_metrics_endpoint_matches_stats(params):
     try:
         comp = app.generate(_prompt(5, seed=13), 5, timeout=120)
         assert len(comp.tokens) == 5
+        # let the dispatch reaper catch up so the device-time series are
+        # consistent between the two scrapes below
+        assert srv.dispatch_tracker.drain(timeout=10)
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
             assert r.status == 200
@@ -359,11 +394,32 @@ def test_metrics_endpoint_matches_stats(params):
                 assert getattr(_metrics, attr) in text, (
                     f"{attr} series missing from /metrics")
         for fam in ("serving_ttft_seconds", "serving_tpot_seconds",
-                    "serving_queue_wait_seconds", "serving_e2e_seconds"):
+                    "serving_queue_wait_seconds", "serving_e2e_seconds",
+                    "serving_device_lag_seconds",
+                    "serving_xla_compile_seconds"):
             assert f"# TYPE {fam} histogram" in text
-            assert f'{fam}_bucket{{le="+Inf"}}' in text
+        # device-time attribution families: dispatch→ready per program
+        # kind, the in-flight depth gauge, and the compile counters
+        assert ('serving_dispatch_ready_seconds_bucket{kind="decode_block"'
+                in text)
+        assert 'serving_dispatch_ready_seconds_count{kind="prefill"}' in text
+        assert "# TYPE serving_inflight_dispatches gauge" in text
+        assert "# TYPE serving_xla_compiles_total counter" in text
+        assert "serving_xla_recompiles_post_warm_total" in text
 
         samples = _parse_samples(text)
+        assert samples["serving_inflight_dispatches"] == 0
+        assert samples["serving_dispatches_tracked_total"] == (
+            stats["device"]["tracked"]) > 0
+        assert samples["serving_dispatch_track_dropped_total"] == 0
+        assert samples["serving_dispatch_reap_errors_total"] == 0
+        # a delivered completion drew the warmup line; the compile
+        # snapshot on /stats matches the exposition counters
+        assert stats["compile"]["warm"] is True
+        assert samples["serving_xla_compiles_total"] == (
+            stats["compile"]["compiles"])
+        assert samples["serving_device_lag_seconds_count"] == (
+            stats["latency"]["device_lag_s"]["count"]) > 0
         # histogram buckets are cumulative and consistent with _count
         buckets = [(nl, v) for nl, v in samples.items()
                    if nl.startswith("serving_ttft_seconds_bucket")]
@@ -511,6 +567,23 @@ def test_metrics_names_rendered_and_documented():
     phantom = sorted(n for n in doc_names if base(n) not in rendered)
     assert not phantom, (
         f"docs/observability.md names no endpoint renders: {phantom}")
+
+    # the device-time/compile families are pinned EXPLICITLY (not just
+    # via the generic sweep): each must be rendered by an endpoint and
+    # documented — renaming either side without the other fails here
+    for fam in ("serving_dispatch_ready_seconds",
+                "serving_inflight_dispatches",
+                "serving_dispatches_tracked_total",
+                "serving_dispatch_track_dropped_total",
+                "serving_dispatch_reap_errors_total",
+                "serving_device_lag_seconds",
+                "serving_xla_compile_seconds",
+                "serving_xla_compiles_total",
+                "serving_xla_recompiles_post_warm_total",
+                "driver_xla_compile_seconds",
+                "driver_xla_compiles_total"):
+        assert fam in rendered, f"device/compile family unrendered: {fam}"
+        assert fam in doc_names, f"device/compile family undocumented: {fam}"
 
 
 def test_telemetry_trace_feed_units():

@@ -285,14 +285,28 @@ def test_reset_rearms_ring_fails_inflight_keeps_queue(params):
         srv.step()                              # both slots mid-decode
     b = Request(prompt=pb, max_new_tokens=6)
     srv.submit(b)                               # still queued (slots full)
+    tracker = srv.dispatch_tracker
+    reaper = tracker._thread
+    pre_reset_seqs = list(range(1, tracker.tracked_total + 1))
     lost = srv.reset()
     assert sorted(lost) == sorted([a.id, c.id])
     assert srv.pending == 1 and srv.n_active == 0
     assert srv.resets == 1
+    # reset() drained + re-armed the dispatch reaper: SAME thread (no
+    # leak per reset), nothing pending, and no stale ready-instant from
+    # a pre-reset dispatch can be read against post-reset dispatches
+    assert tracker._thread is reaper and tracker.alive
+    assert all(tracker.ready_time(s) is None for s in pre_reset_seqs)
     done = srv.run_until_drained()
     assert set(done) == {b.id}
     assert done[b.id].tokens == _solo(params, pb, 6), (
         "post-reset ring diverged from a fresh server")
+    assert tracker.drain(timeout=10), "post-reset dispatches must reap"
+    assert tracker.snapshot()["dispatch_ready"]["decode_block"]["count"] > 0
+    srv.shutdown()                              # stops the reaper thread
+    assert not tracker.alive
+    reaper.join(timeout=5)
+    assert not reaper.is_alive(), "shutdown() leaked the reaper thread"
 
 
 # --------------------------------------------------------------------------

@@ -316,6 +316,155 @@ def test_task_trace_heartbeat_expiry_path(tmp_job_dirs, tmp_path):
 
 
 # --------------------------------------------------------------------------
+# on-demand profiler command: HTTP/RPC queue -> heartbeat ride -> flag file
+# --------------------------------------------------------------------------
+
+def test_driver_profile_command_rides_heartbeat(tmp_job_dirs, tmp_path):
+    """The training-worker capture path end to end (docs/observability.md
+    "Device timing & profiling"): the operator queues a capture through
+    the client-ACL'd ``request_task_profile`` RPC (an executor key is
+    REJECTED, and with token auth on the unauthenticated metrics-server
+    /profile route refuses with 403 — it must not bypass the ACL), the
+    command rides the task's next heartbeat response exactly once (a
+    newer request replaces an unread one), and the executor relays it
+    into the ``$TONY_STEP_LOG.profile`` flag file the training child
+    polls."""
+    import urllib.error
+
+    from tony_tpu.rpc.protocol import RpcError, derive_role_key
+
+    got: dict = {}
+    registered = threading.Event()
+    queued = threading.Event()
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        payload = rpc.call("register_worker", task_id=task_id,
+                           host="127.0.0.1", port=23000 + index)
+        while payload is None:
+            rpc.call("heartbeat", task_id=task_id)
+            time.sleep(0.03)
+            payload = rpc.call("get_cluster_spec", task_id=task_id)
+        # the executor key must not be able to aim the profiler at peers
+        try:
+            rpc.call("request_task_profile", task_id=task_id, seconds=1)
+            got["acl"] = "allowed"
+        except RpcError as e:
+            got["acl"] = str(e)
+        registered.set()
+        assert queued.wait(20), "test never queued the profile command"
+        cmd, deadline = None, time.time() + 20
+        while cmd is None and time.time() < deadline:
+            res = rpc.call("heartbeat", task_id=task_id)
+            if isinstance(res, dict):
+                cmd = res.get("profile")
+            else:
+                time.sleep(0.03)
+        got["cmd"] = cmd
+        got["again"] = rpc.call("heartbeat", task_id=task_id)  # one-shot
+        if cmd:
+            from tony_tpu.executor import write_profile_flag
+            got["flag"] = write_profile_flag(
+                str(tmp_path / "w0.steps.jsonl"), cmd)
+        rpc.call("register_execution_result", task_id=task_id, exit_code=0)
+        rpc.close()
+        return 0
+
+    driver = _driver(tmp_job_dirs, tmp_path, script,
+                     **{"tony.worker.instances": 1,
+                        "tony.worker.command": "stub",
+                        "tony.task.heartbeat-interval-ms": 100})
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    try:
+        assert registered.wait(20), "worker never registered"
+        deadline = time.time() + 20
+        while driver.metrics_port is None and time.time() < deadline:
+            time.sleep(0.02)
+        port = driver.metrics_port
+
+        # with token auth ON the unauthenticated /profile HTTP route
+        # must refuse — it would otherwise hand any network peer the
+        # action the RPC ACL restricts to the client key
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/profile?task=worker:0&seconds=5",
+                timeout=10)
+        assert e.value.code == 403
+
+        # the sanctioned path: the client-signed RPC
+        cl = RpcClient("127.0.0.1", driver.rpc_server.port,
+                       token=derive_role_key("trace-secret", "client"),
+                       role="client")
+        try:
+            # unknown task -> False, out-of-range window -> error
+            assert cl.call("request_task_profile",
+                           task_id="worker:9", seconds=5) is False
+            with pytest.raises(RpcError, match="seconds"):
+                cl.call("request_task_profile",
+                        task_id="worker:0", seconds=9999)
+            # queue twice before any beat reads it: the NEWER wins
+            assert cl.call("request_task_profile",
+                           task_id="worker:0", seconds=2) is True
+            assert cl.call("request_task_profile",
+                           task_id="worker:0", seconds=3) is True
+        finally:
+            cl.close()
+        queued.set()
+    finally:
+        registered.set()
+        queued.set()
+    t.join(timeout=30)
+    assert not t.is_alive(), "driver did not finish"
+    assert driver.session.status == JobStatus.SUCCEEDED, (
+        driver.session.failure_message)
+
+    assert "authorization" in got["acl"], (
+        f"executor key must be refused: {got['acl']}")
+    assert got["cmd"] == {"seconds": 3.0}, (
+        "the replacement request must be the one delivered")
+    assert got["again"] is True, "the command is one-shot per queue"
+    flag = tmp_path / ("w0.steps.jsonl" + c.PROFILE_REQUEST_SUFFIX)
+    assert got["flag"] == str(flag) and flag.exists()
+    req = json.loads(flag.read_text())
+    assert req["seconds"] == 3.0
+    assert f"/{c.PROFILE_DIR_NAME}/" in req["out_dir"]
+    # terminal task: nothing left to profile
+    assert driver.request_profile("worker:0", 1.0) is False
+
+
+def test_driver_profile_http_route_when_auth_off(tmp_job_dirs, tmp_path):
+    """Without token auth (local dev) the metrics server's /profile
+    convenience route is live: unknown task -> 404, bad window -> 400."""
+    import urllib.error
+
+    conf = _conf(tmp_job_dirs, **{"tony.worker.instances": 1,
+                                  "tony.worker.command": "stub"})
+    job_dir = tmp_path / "job_http"
+    job_dir.mkdir()
+    conf.write_final(job_dir)
+    driver = Driver(conf, app_id="trace_http", job_dir=str(job_dir),
+                    token="", provisioner=ScriptedProvisioner(
+                        lambda *a: 0))
+    driver._start_metrics_server()
+    try:
+        port = driver.metrics_port
+        assert port is not None
+        for query, code in (("task=worker:0&seconds=5", 404),
+                            ("task=worker:0&seconds=9999", 400),
+                            ("task=worker:0&seconds=bogus", 400)):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/profile?{query}", timeout=10)
+            assert e.value.code == code, query
+    finally:
+        driver._metrics_httpd.shutdown()
+        driver._metrics_httpd.server_close()
+        driver.rpc_server.stop()
+
+
+# --------------------------------------------------------------------------
 # executor-side satellites: TaskMonitor channel, Heartbeater jitter/miss
 # --------------------------------------------------------------------------
 
@@ -334,7 +483,8 @@ def test_task_monitor_push_carries_spans_child_status_and_steps(tmp_path):
     sampled from the training child's StepTimer JSONL) plus the
     executor lifecycle spans, time-sorted."""
     from tony_tpu.metrics import (
-        CHILD_ALIVE, STEP_TIME_MEAN_S, STEP_TIME_P99_S, TaskMonitor,
+        CHILD_ALIVE, STEP_TIME_MEAN_S, STEP_TIME_P99_S, XLA_COMPILES,
+        XLA_COMPILE_TIME_S, TaskMonitor,
     )
     from tony_tpu.train.profiling import StepTimer
 
@@ -345,6 +495,9 @@ def test_task_monitor_push_carries_spans_child_status_and_steps(tmp_path):
     assert step_log.exists()
     rec = json.loads(step_log.read_text().splitlines()[-1])
     assert "p50_s" in rec and "p99_s" in rec    # StepTimer histogram feed
+    # compile telemetry rides the same record (process-global listener)
+    assert rec["xla_compiles"] >= 0 and rec["xla_compile_time_s"] >= 0.0
+    assert "xla_recompiles_post_warm" in rec
 
     class _Ctx:             # a finished child: poll() returns an exit code
         spans = [["child_spawned", 50.0]]
@@ -370,6 +523,12 @@ def test_task_monitor_push_carries_spans_child_status_and_steps(tmp_path):
     assert f"max_{STEP_TIME_P99_S}" in names
     by_name = {m["name"]: m["value"] for m in params["metrics"]}
     assert by_name[f"max_{CHILD_ALIVE}"] == 0.0     # child already exited
+    # compile totals take SET semantics (latest total, never an average
+    # of a monotone counter): max_ and avg_ agree with the record
+    assert by_name[f"max_{XLA_COMPILES}"] == rec["xla_compiles"]
+    assert by_name[f"avg_{XLA_COMPILES}"] == rec["xla_compiles"]
+    assert by_name[f"max_{XLA_COMPILE_TIME_S}"] == (
+        rec["xla_compile_time_s"])
     # monitor + ctx spans merged, time-sorted
     assert params["spans"] == [["work_dir_ready", 40.0],
                                ["child_spawned", 50.0]]

@@ -23,7 +23,10 @@ renders for executor metrics. GET /metrics renders the same numbers in
 Prometheus text format (histograms included) so any scraper works with
 no client library; ``--trace-dir`` additionally dumps every terminated
 request's lifecycle trace as JSONL (events/trace.py) for the portal's
-per-request timeline. See docs/observability.md.
+per-request timeline. GET /debug/profile?seconds=N captures a
+jax.profiler trace (xplane) of live traffic into
+``<trace-dir>/profiles/`` — the portal lists captures on
+``/profiles/<app_id>``. See docs/observability.md.
 
 Model loading matches lm_generate: an lm_train orbax checkpoint (with the
 matching hyperparam flags), a local HF Llama/Mistral checkpoint dir, or
@@ -222,14 +225,25 @@ class ServeApp:
     decode steps."""
 
     def __init__(self, server, *, max_loop_restarts: int = 3,
-                 loop_backoff_s: float = 0.5):
+                 loop_backoff_s: float = 0.5, trace_dir: str = ""):
         from ..metrics import MetricsAccumulator
+        from ..observability import install_compile_telemetry
         from ..train.profiling import StepTimer
 
         self.server = server            # SlotServer
+        self.trace_dir = trace_dir      # also hosts /debug/profile dumps
         self.lock = threading.Lock()
         self.wake = threading.Event()
         self.stop = threading.Event()
+        # XLA compile visibility (observability.CompileTelemetry): the
+        # process-global jax.monitoring listener feeds compile-duration
+        # histograms + a recompile counter into /metrics; the first
+        # DELIVERED completion marks warmup done, so later compiles count
+        # as recompiles (a steady-state serving loop that keeps compiling
+        # is leaking dynamic shapes — it logs a storm warning)
+        self.compile_telemetry = install_compile_telemetry()
+        # one capture at a time: jax.profiler has a single global trace
+        self._profile_lock = threading.Lock()
         self.status = "ok"              # "ok" | "degraded" | "down"
         self.draining = False
         self.error: str | None = None
@@ -249,7 +263,10 @@ class ServeApp:
         # scheduling-turn cadence rides the SAME StepTimer the training
         # loop uses (train/profiling.py, monotonic) and feeds the
         # loop_turn_s histogram — one timing convention everywhere
-        self._turn_timer = StepTimer()
+        # compile_warm_on_step=False: loop turns tick before the first
+        # request compiles anything — the serving warm line is the first
+        # DELIVERED completion (_deliver), not the first loop turn
+        self._turn_timer = StepTimer(compile_warm_on_step=False)
         self.thread = threading.Thread(
             target=self._loop, name="serve-loop", daemon=True)
 
@@ -296,6 +313,11 @@ class ServeApp:
         self.stop.set()
         self.wake.set()
         self.thread.join(timeout=10)
+        # stop the engine's background threads (the DispatchTracker
+        # reaper) — idempotent, and stubs without shutdown() are fine
+        engine_shutdown = getattr(self.server, "shutdown", None)
+        if callable(engine_shutdown):
+            engine_shutdown()
 
     def _fail_pending(self, exc: Exception) -> None:
         """Fail every waiting request with the loop's error — waiters get
@@ -367,6 +389,10 @@ class ServeApp:
                 self.wake.clear()
 
     def _deliver(self, done: dict) -> None:
+        # the first completed request proves every warmup program shape
+        # compiled: XLA compiles from here on are RECOMPILES (idempotent
+        # — only the first call draws the line)
+        self.compile_telemetry.mark_warm()
         # deliver under the lock so this can't interleave with a
         # waiter's timeout cleanup (event popped here, then the
         # waiter clears _results, then the store below lands and
@@ -631,6 +657,47 @@ class ServeApp:
                 for name, help_text in TELEMETRY_HISTOGRAMS.items():
                     prom = "serving_" + name[:-2] + "_seconds"
                     r.histogram(prom, tel.hist[name], help_text)
+        # device-time attribution (observability.DispatchTracker): how
+        # long the device actually spent behind each dispatched program,
+        # per program kind, plus the measured in-flight pipeline depth —
+        # the histograms are copied under the tracker's own lock (the
+        # reaper thread feeds them outside the serving lock)
+        tracker = getattr(self.server, "dispatch_tracker", None)
+        if tracker is not None:
+            for kind, h in sorted(tracker.histograms().items()):
+                r.histogram("serving_dispatch_ready_seconds", h,
+                            "dispatch -> device-ready latency per "
+                            "program kind (reaper-measured, off the "
+                            "hot path)", labels={"kind": kind})
+            r.gauge("serving_inflight_dispatches", tracker.in_flight,
+                    "device programs dispatched but not yet observed "
+                    "ready (the measured pipeline depth)")
+            r.counter("serving_dispatches_tracked_total",
+                      tracker.tracked_total,
+                      "dispatches registered with the tracker")
+            r.counter("serving_dispatch_track_dropped_total",
+                      tracker.dropped,
+                      "dispatches untracked because the reaper fell "
+                      "behind (telemetry loss, not request loss)")
+            r.counter("serving_dispatch_reap_errors_total",
+                      tracker.reap_errors,
+                      "tracked buffers whose block_until_ready raised "
+                      "(died with a failed dispatch)")
+        # XLA compile telemetry (observability.CompileTelemetry): every
+        # actual backend compile in this process, and how many happened
+        # after warmup — nonzero post-warm recompiles in steady state
+        # mean a dispatched program leaks dynamic shapes
+        ct = self.compile_telemetry
+        comp = ct.snapshot()
+        r.histogram("serving_xla_compile_seconds", ct.hist_copy(),
+                    "XLA backend compile duration per compilation "
+                    "(cache hits don't count)")
+        r.counter("serving_xla_compiles_total", comp["compiles"],
+                  "XLA backend compilations in this process")
+        r.counter("serving_xla_recompiles_post_warm_total",
+                  comp["recompiles_post_warm"],
+                  "compilations after the first served request "
+                  "(steady-state recompiles: the shape-leak signal)")
         for entry in st.get("metrics", []):
             r.gauge("serving_task_metric", entry["value"],
                     "MetricsAccumulator snapshot (max_/avg_ per gauge)",
@@ -670,7 +737,44 @@ class ServeApp:
                 "max_restarts": self.max_loop_restarts,
             }
             out["metrics"] = self.metrics.snapshot()
+            # XLA compile telemetry: compiles/compile_time_s/
+            # recompiles_post_warm — /stats mirror of the
+            # serving_xla_compile_* exposition families
+            out["compile"] = self.compile_telemetry.snapshot()
             return out
+
+    def capture_profile(self, seconds: float) -> dict:
+        """The GET /debug/profile?seconds=N implementation: capture a
+        jax.profiler trace (xplane proto) of whatever the device is
+        doing for ``seconds`` into ``<trace_dir>/profiles/<stamp>/``.
+        Runs on the HTTP handler thread — the serving loop keeps
+        dispatching, which is the point: the capture sees live traffic.
+        One capture at a time (jax's trace machinery is process-global);
+        a concurrent request gets a busy error."""
+        from pathlib import Path
+
+        from .. import constants as c
+        from ..train.profiling import trace
+
+        if not self.trace_dir:
+            raise RuntimeError(
+                "profiling needs --trace-dir (nowhere to write the "
+                "xplane dump)")
+        if not 0 < seconds <= 120:
+            raise ValueError("seconds must be in (0, 120]")
+        if not self._profile_lock.acquire(blocking=False):
+            raise BlockingIOError("a profile capture is already running")
+        try:
+            out_dir = (Path(self.trace_dir) / c.PROFILE_DIR_NAME
+                       / f"serve_{int(time.time())}_{seconds:g}s")
+            with trace(out_dir):
+                time.sleep(seconds)
+            files = sorted(str(p.relative_to(out_dir))
+                           for p in out_dir.rglob("*") if p.is_file())
+            return {"dir": str(out_dir), "seconds": seconds,
+                    "files": files}
+        finally:
+            self._profile_lock.release()
 
 
 def make_handler(app: ServeApp):
@@ -719,6 +823,30 @@ def make_handler(app: ServeApp):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path.partition("?")[0] == "/debug/profile":
+                # on-demand device profiling: blocks THIS handler thread
+                # for the capture window while the serving loop keeps
+                # dispatching; the dump lands under --trace-dir and the
+                # portal lists it on /profiles/<app_id>
+                from urllib.parse import parse_qs, urlparse
+
+                qs = parse_qs(urlparse(self.path).query)
+                try:
+                    seconds = float(qs.get("seconds", ["2"])[0])
+                    result = app.capture_profile(seconds)
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                except BlockingIOError as e:
+                    self._send(409, {"error": str(e)})
+                    return
+                except RuntimeError as e:       # no --trace-dir
+                    self._send(409, {"error": str(e)})
+                    return
+                except Exception as e:          # profiler/backend failure
+                    self._send(500, {"error": f"capture failed: {e}"})
+                    return
+                self._send(200, result)
             else:
                 self._send(404, {"error": "unknown path"})
 
@@ -856,7 +984,8 @@ def main(argv=None) -> int:
                 # including valid JSON of the wrong shape
                 print(f"telemetry state not restored: {e}", flush=True)
     app = ServeApp(slot_server, max_loop_restarts=args.loop_max_restarts,
-                   loop_backoff_s=args.loop_backoff_s)
+                   loop_backoff_s=args.loop_backoff_s,
+                   trace_dir=args.trace_dir)
     app.start()
     httpd = ThreadingHTTPServer((args.host, args.port), make_handler(app))
     print(f"serving {cfg.n_layers}L d{cfg.d_model} on "

@@ -56,6 +56,18 @@ MEGASCALE_PORT = 8080                     # libtpu's default coordinator port
 DRIVER_INFO_FILE = "driver.json"          # driver's rpc endpoint, written at prepare
                                           # (plays the YARN app-report role for the client)
 
+# on-demand profiler capture flag file (docs/observability.md "Device
+# timing & profiling"): the executor writes `$TONY_STEP_LOG<suffix>`
+# (JSON: {"seconds": N, "out_dir": path}, tmp+rename so the child never
+# reads a torn request) when the driver relays a profile command over the
+# heartbeat RPC; the training child's StepTimer polls for it at its
+# record cadence, captures a jax.profiler trace for N seconds into
+# out_dir, and deletes the flag.
+PROFILE_REQUEST_SUFFIX = ".profile"
+# subdirectory (under the job's logs dir / serve --trace-dir) where
+# captured xplane profiles land; the portal lists it on /profiles/<app>
+PROFILE_DIR_NAME = "profiles"
+
 # ---- fault-injection hooks (production code paths, keyed off env like
 # reference Constants.java:124-130 TEST_* hooks)
 TEST_DRIVER_CRASH = "TONY_TEST_DRIVER_CRASH"                # driver exits mid-run

@@ -105,13 +105,20 @@ class DriverService:
     def taskExecutorHeartbeat(self, task_id: str):  # wire name kept short below
         return self.heartbeat(task_id)
 
-    def heartbeat(self, task_id: str) -> bool:
+    def heartbeat(self, task_id: str):
+        """Returns True, or — when a profile capture is pending for this
+        task — a one-shot ``{"profile": {...}}`` command dict. The
+        heartbeat is the only driver->executor channel that already
+        exists at steady state, so commands piggyback on its response
+        (the executor's Heartbeater relays them; see
+        Driver.request_profile)."""
         d = self._d
         prev = d.heartbeats.get(task_id)
         now = time.time()
         d.heartbeats[task_id] = now
         d._on_heartbeat(task_id, prev, now)
-        return True
+        cmd = d.take_profile_command(task_id)
+        return {"profile": cmd} if cmd else True
 
     def register_execution_result(self, task_id: str, exit_code: int) -> str:
         log.info("%s reported exit code %d", task_id, exit_code)
@@ -139,6 +146,17 @@ class DriverService:
 
     def get_metrics(self, task_id: str):
         return self._d.metrics.get(task_id, [])
+
+    def request_task_profile(self, task_id: str,
+                             seconds: float = 5.0) -> bool:
+        """Queue an on-demand profiler capture for one training worker
+        (client-privileged when token auth is on): the command rides the
+        task's next heartbeat response, the executor drops the
+        ``$TONY_STEP_LOG.profile`` flag file, and the training child's
+        StepTimer captures a jax.profiler trace at its next record
+        boundary. See docs/observability.md "Device timing &
+        profiling"."""
+        return self._d.request_profile(task_id, seconds)
 
     # ---------------------------------------------------------------- client
     def get_task_infos(self):
@@ -224,7 +242,11 @@ class Driver:
                 "client": derive_role_key(token, "client"),
                 "executor": self.executor_token,
             }
-            acl = {"finish_application": {"client"}}
+            # profile commands are operator actions, like ending the
+            # job: an executor key must not be able to aim the profiler
+            # at its peers
+            acl = {"finish_application": {"client"},
+                   "request_task_profile": {"client"}}
         self.rpc_server = RpcServer(
             host=str(conf.get(keys.AM_RPC_HOST, "127.0.0.1")), token=token,
             roles=roles, acl=acl,
@@ -262,6 +284,23 @@ class Driver:
         self._exec_spans_seen: dict[str, set] = {}    # per-attempt dedupe
         self._attempt_wall: dict[str, float] = {}     # restart wall fence
         self._metrics_httpd = None
+        # pending on-demand profiler captures, task_id -> command dict;
+        # queued by request_profile (client RPC or the metrics server's
+        # /profile route), drained one-shot by the task's next heartbeat
+        self._profile_cmds: dict[str, dict] = {}
+        self._profile_lock = threading.Lock()
+        # compile visibility for code running IN the driver process
+        # (enable-preprocess / notebook jobs): the driver's /metrics
+        # carries its own compile histogram next to the compile totals
+        # training children push as task metrics. only_if_loaded: the
+        # orchestration-only driver must not pay a full jax import for
+        # this — if jax is absent no compile could have fired, and
+        # render_metrics() re-tries the install once user code brought
+        # jax in.
+        from .observability import install_compile_telemetry
+
+        self._compile_telemetry = install_compile_telemetry(
+            only_if_loaded=True)
 
     # ------------------------------------------------------------- lifecycle
     def run(self) -> JobStatus:
@@ -604,9 +643,8 @@ class Driver:
                 log.debug("metrics: " + fmt, *args)
 
             def do_GET(self):
-                if self.path.partition("?")[0] != "/metrics":
-                    body, code, ctype = b"not found", 404, "text/plain"
-                else:
+                route = self.path.partition("?")[0]
+                if route == "/metrics":
                     try:
                         body = driver.render_metrics().encode()
                         code, ctype = 200, PROM_CONTENT_TYPE
@@ -614,6 +652,44 @@ class Driver:
                         log.exception("metrics render failed")
                         body, code, ctype = (
                             f"error: {e}".encode(), 500, "text/plain")
+                elif route == "/profile":
+                    # operator convenience trigger for the same command
+                    # the client RPC queues: curl ':port/profile?task=
+                    # worker:0&seconds=5'. Available ONLY when token auth
+                    # is off (local dev): with auth on, this unauthed
+                    # HTTP route would hand any network peer — or an
+                    # executor child on the same host — the profiler
+                    # action the RPC ACL restricts to the client key, and
+                    # the metrics server binds the same possibly-routable
+                    # host the RPC does.
+                    import json as _json
+                    from urllib.parse import parse_qs, urlparse
+
+                    ctype = "application/json"
+                    if driver.token:
+                        body, code = _json.dumps(
+                            {"error": "token auth is on: use the "
+                             "client-authenticated request_task_profile "
+                             "RPC"}).encode(), 403
+                        self.send_response(code)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    qs = parse_qs(urlparse(self.path).query)
+                    task_id = qs.get("task", [""])[0]
+                    try:
+                        ok = driver.request_profile(
+                            task_id, float(qs.get("seconds", ["5"])[0]))
+                        body = _json.dumps(
+                            {"queued": ok, "task": task_id}).encode()
+                        code = 200 if ok else 404
+                    except (ValueError, TypeError) as e:
+                        body, code = _json.dumps(
+                            {"error": str(e)}).encode(), 400
+                else:
+                    body, code, ctype = b"not found", 404, "text/plain"
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -672,6 +748,21 @@ class Driver:
                       "tasks deemed dead after missing the heartbeat "
                       "budget")
             reg = dict(self._reg_t)
+        # driver-process XLA compile telemetry (preprocess/notebook jobs
+        # run user code in-process); each training CHILD's compile totals
+        # arrive as executor-pushed metrics (xla_compiles et al) and
+        # render below as driver_task_metric gauges. Re-try the install
+        # every scrape: __init__ skipped it while jax was unimported,
+        # and user code may have brought jax in since (idempotent,
+        # returns the same process-global instance)
+        from .observability import install_compile_telemetry
+
+        ct = install_compile_telemetry(only_if_loaded=True)
+        comp = ct.snapshot()
+        r.histogram("driver_xla_compile_seconds", ct.hist_copy(),
+                    "XLA backend compile duration in the driver process")
+        r.counter("driver_xla_compiles_total", comp["compiles"],
+                  "XLA backend compilations in the driver process")
         counts: dict[str, int] = {}
         for t in self.session.all_tasks():
             counts[t.status.value] = counts.get(t.status.value, 0) + 1
@@ -952,6 +1043,36 @@ class Driver:
         handle = self._handles.get(task_id)
         if handle is not None:
             self.provisioner.stop_container(handle)
+
+    # ------------------------------------------------- on-demand profiling
+    def request_profile(self, task_id: str, seconds: float = 5.0) -> bool:
+        """Queue a profiler-capture command for ``task_id``; it rides the
+        task's next heartbeat response (the executor then writes the
+        ``$TONY_STEP_LOG.profile`` flag file the training child's
+        StepTimer polls). Returns False for unknown/terminal tasks. A
+        second request before the first is picked up replaces it —
+        heartbeats arrive every ~1s, so queueing depth would only let
+        stale captures pile up."""
+        seconds = float(seconds)
+        if not 0 < seconds <= 120:
+            raise ValueError("seconds must be in (0, 120]")
+        task = self.session.get_task_by_id(task_id)
+        # NEW/REQUESTED tasks have no container, hence no heartbeat to
+        # ride: queueing would park the command forever (or fire it at
+        # whatever attempt eventually launches, long after the operator
+        # asked) — treat them like unknown tasks
+        if (task is None or task.status.is_terminal()
+                or task.status in (TaskStatus.NEW, TaskStatus.REQUESTED)):
+            return False
+        with self._profile_lock:
+            self._profile_cmds[task_id] = {"seconds": seconds}
+        log.info("queued %gs profile capture for %s", seconds, task_id)
+        return True
+
+    def take_profile_command(self, task_id: str) -> dict | None:
+        """One-shot drain of a pending profile command (heartbeat path)."""
+        with self._profile_lock:
+            return self._profile_cmds.pop(task_id, None)
 
     # ----------------------------------------------------------------- retry
     def reset(self) -> None:

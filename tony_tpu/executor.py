@@ -17,6 +17,7 @@ reference's TEST_* hooks (Constants.java:124-130, TaskExecutor.java:328-386).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import random
@@ -35,6 +36,36 @@ from .rpc import RpcClient
 log = logging.getLogger(__name__)
 
 
+def write_profile_flag(step_log: str | None, cmd: dict) -> str | None:
+    """Relay a driver profile command to the training child: write the
+    ``$TONY_STEP_LOG.profile`` flag file (tmp+rename, so the child's
+    StepTimer never reads a torn request) carrying the capture length
+    and where the xplane dump should land — ``logs/profiles/<task>_
+    <stamp>/`` next to the step log, which the portal lists on
+    ``/profiles/<app_id>``. Returns the flag path, or None when there is
+    no step log (nothing would ever poll the flag)."""
+    if not step_log:
+        log.warning("profile command dropped: no step log configured")
+        return None
+    from . import constants as c
+
+    stem = os.path.basename(step_log).partition(".")[0]
+    out_dir = os.path.join(os.path.dirname(step_log), c.PROFILE_DIR_NAME,
+                           f"{stem}_{int(time.time())}")
+    flag = step_log + c.PROFILE_REQUEST_SUFFIX
+    tmp = flag + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"seconds": float(cmd.get("seconds", 5.0)),
+                                "out_dir": out_dir}))
+        os.replace(tmp, flag)
+    except (OSError, TypeError, ValueError) as e:
+        log.warning("could not write profile flag: %s", e)
+        return None
+    log.info("profile command relayed via %s -> %s", flag, out_dir)
+    return flag
+
+
 class Heartbeater(threading.Thread):
     """Reference TaskExecutor.Heartbeater:324-364, including the
     skip-N-heartbeats fault hook. Doubles as the driver-death watchdog: when
@@ -51,7 +82,8 @@ class Heartbeater(threading.Thread):
     a missed-beat counter, so heartbeat health rides the metrics push."""
 
     def __init__(self, client: RpcClient, task_id: str, interval_s: float,
-                 max_failures: int = 30, on_driver_lost=None, monitor=None):
+                 max_failures: int = 30, on_driver_lost=None, monitor=None,
+                 on_command=None):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
@@ -60,6 +92,10 @@ class Heartbeater(threading.Thread):
         self._max_failures = max_failures
         self._on_driver_lost = on_driver_lost
         self._monitor = monitor
+        # driver->executor commands piggyback on the heartbeat RESPONSE
+        # (a dict instead of the plain True) — currently the on-demand
+        # profile capture; the callback gets the command payload
+        self._on_command = on_command
         self._rng = random.Random()     # urandom-seeded: per-process phase
         self.missed = 0
         self.stop_event = threading.Event()
@@ -80,10 +116,20 @@ class Heartbeater(threading.Thread):
                 continue
             try:
                 t0 = time.monotonic()
-                self._client.call("heartbeat", task_id=self._task_id)
+                result = self._client.call("heartbeat",
+                                           task_id=self._task_id)
                 self._note(HEARTBEAT_RTT_MS,
                            (time.monotonic() - t0) * 1000.0)
                 failures = 0
+                if isinstance(result, dict) and self._on_command:
+                    cmd = result.get("profile")
+                    if cmd:
+                        try:
+                            self._on_command(cmd)
+                        except Exception:
+                            # a bad command must not stop the beat — the
+                            # beat IS the liveness signal
+                            log.exception("heartbeat command failed")
             except Exception as e:
                 failures += 1
                 self.missed += 1
@@ -255,6 +301,10 @@ class Executor:
             ),
             on_driver_lost=_die_with_driver,
             monitor=monitor,
+            # driver profile commands -> the $TONY_STEP_LOG.profile flag
+            # file the training child's StepTimer polls
+            on_command=lambda cmd: write_profile_flag(
+                self._step_log_path(), cmd),
         )
         heartbeater.start()
 

@@ -24,6 +24,11 @@ log = logging.getLogger(__name__)
 MEMORY_RSS = "memory_rss_mb"
 TPU_DUTY_CYCLE = "tpu_duty_cycle_pct"
 TPU_HBM_USED = "tpu_hbm_used_mb"
+# device-memory watermark: peak bytes in use since client start
+# (memory_stats()["peak_bytes_in_use"]) — the number capacity planning
+# actually needs; reported only where the runtime serves stats (CPU
+# devices return None and the series is omitted, never rendered as zero)
+TPU_HBM_PEAK = "tpu_hbm_peak_mb"
 # framework-tracked live device buffers (jax.live_arrays) — reported when no
 # runtime channel serves occupancy; excludes XLA temps/executables, so it is
 # a floor on true HBM use and labeled distinctly to say so
@@ -69,13 +74,24 @@ DRIVER_TASK_METRIC = "driver_task_metric"
 # driver_task_metric{name="max_..."} gauges and in TASK_FINISHED events)
 HEARTBEAT_RTT_MS = "heartbeat_rtt_ms"
 HEARTBEATS_MISSED = "heartbeats_missed"
-# note()-d names that are cumulative totals, not per-event samples
-_COUNTER_NOTES = frozenset({HEARTBEATS_MISSED})
 CHILD_ALIVE = "child_alive"
 STEP_TIME_MEAN_S = "step_time_mean_s"
 STEP_TIME_P50_S = "step_time_p50_s"
 STEP_TIME_P99_S = "step_time_p99_s"
 STEPS_PER_SEC = "steps_per_sec"
+# compile telemetry sampled from the training child's StepTimer JSONL
+# (observability.CompileTelemetry snapshot embedded per record): how much
+# wall time XLA compilation ate in that worker, and whether it kept
+# compiling after warmup — a nonzero xla_recompiles_post_warm on a
+# steady-state training job is the shape-leak bug surfacing centrally
+XLA_COMPILES = "xla_compiles"
+XLA_COMPILE_TIME_S = "xla_compile_time_s"
+XLA_RECOMPILES_POST_WARM = "xla_recompiles_post_warm"
+# note()-d / sampled names that are cumulative totals, not per-event
+# samples: they take set semantics (latest total) in the accumulator —
+# averaging a monotone counter's successive values is meaningless
+_COUNTER_NOTES = frozenset({HEARTBEATS_MISSED, XLA_COMPILES,
+                            XLA_COMPILE_TIME_S, XLA_RECOMPILES_POST_WARM})
 
 
 def _proc_tree_rss_mb(root_pid: int) -> float:
@@ -244,7 +260,11 @@ class TaskMonitor:
             for src, dst in (("mean_step_s", STEP_TIME_MEAN_S),
                              ("p50_s", STEP_TIME_P50_S),
                              ("p99_s", STEP_TIME_P99_S),
-                             ("steps_per_sec", STEPS_PER_SEC)):
+                             ("steps_per_sec", STEPS_PER_SEC),
+                             ("xla_compiles", XLA_COMPILES),
+                             ("xla_compile_time_s", XLA_COMPILE_TIME_S),
+                             ("xla_recompiles_post_warm",
+                              XLA_RECOMPILES_POST_WARM)):
                 if isinstance(rec.get(src), (int, float)):
                     out[dst] = float(rec[src])
             return out
@@ -262,7 +282,10 @@ class TaskMonitor:
             if proc is not None:
                 self._acc.observe(CHILD_ALIVE, 1.0 if child_alive else 0.0)
             for name, value in {**tpu, **steps}.items():
-                self._acc.observe(name, value)
+                if name in _COUNTER_NOTES:
+                    self._acc.set(name, value)
+                else:
+                    self._acc.observe(name, value)
             metrics = self._acc.snapshot()
             spans = [list(s) for s in self._spans]
         # adapter-marked spans (child_spawned) live on the TaskContext
@@ -342,16 +365,27 @@ def _jax_memory_stats() -> dict[str, float]:
         return {}
     if not devices:
         return {}        # never report host/GPU memory under TPU names
-    used = []
+    used, peak = [], []
     for d in devices:
         try:
             stats = d.memory_stats()
         except Exception:
             stats = None
+        # memory_stats() returns None where the runtime serves no
+        # allocator stats (CPU devices, some tunneled chips): OMIT the
+        # series rather than render zeros a dashboard would read as
+        # "device empty"
         if stats and "bytes_in_use" in stats:
             used.append(float(stats["bytes_in_use"]))
+        if stats and "peak_bytes_in_use" in stats:
+            peak.append(float(stats["peak_bytes_in_use"]))
     if used:
-        return {TPU_HBM_USED: sum(used) / 1e6}
+        out = {TPU_HBM_USED: sum(used) / 1e6}
+        if peak:
+            # high-watermark occupancy since client start — the capacity-
+            # planning number a point-in-time gauge can't give
+            out[TPU_HBM_PEAK] = sum(peak) / 1e6
+        return out
     # last resort (the axon-tunneled chip returns memory_stats() = None):
     # framework-tracked live buffers — a floor on occupancy, honestly named
     try:

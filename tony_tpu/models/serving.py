@@ -145,6 +145,7 @@ from jax import lax
 
 from .. import constants as c
 from ..observability import (
+    DispatchTracker,
     RequestTrace,
     ServiceRateEstimator,
     ServingTelemetry,
@@ -435,7 +436,12 @@ def _copy_prefix_blocks(pool, cache, slots, blocks, chunk_idx, offsets,
             pool.v_scale[:, gb].transpose(1, 3, 0, 2), **swr)
     cache = KVCache(k=ck, v=cv, length=cache.length,
                     k_scale=ks_buf, v_scale=vs_buf)
-    return _constrain_pool(shardings, cache)[0]
+    # fence: a runtime-dependent scalar output the DispatchTracker can
+    # block_until_ready — every REAL output here is donated into a later
+    # dispatch within the same admission burst, whose donation deletes
+    # the host handle before the reaper can touch it
+    fence = jnp.sum(ring_idx).astype(jnp.int32)
+    return _constrain_pool(shardings, cache)[0], fence
 
 
 @functools.partial(
@@ -484,7 +490,10 @@ def _insert_prefix_blocks(pool, cache, slots, blocks, chunk_idx, offsets,
             v_scale=(None if pool.v_scale is None
                      else c(pool.v_scale, shardings.scale)),
         )
-    return pool
+    # dispatch-tracker fence (see _copy_prefix_blocks): the pool itself
+    # is donated into the next burst's insert
+    fence = jnp.sum(ring_idx).astype(jnp.int32)
+    return pool, fence
 
 
 @functools.partial(
@@ -588,8 +597,11 @@ def _prefill_chunk(params, cache, d_tokens, d_active, d_target, d_offsets,
         d_offsets = d_offsets.at[slot].set(offset)
         d_temps = d_temps.at[slot].set(temp)
         d_topks = d_topks.at[slot].set(topk)
-    return _constrain_pool(shardings, cache, d_tokens, d_active, d_target,
-                           d_offsets, d_temps, d_topks)
+    # dispatch-tracker fence (see _copy_prefix_blocks): every state
+    # output is donated into the next prefill/decode dispatch
+    fence = jnp.sum(new_len).astype(jnp.int32)
+    return (*_constrain_pool(shardings, cache, d_tokens, d_active, d_target,
+                             d_offsets, d_temps, d_topks), fence)
 
 
 @functools.partial(
@@ -692,8 +704,11 @@ def _prefill_batch(params, cache, d_tokens, d_active, d_target, d_offsets,
     d_offsets = d_offsets.at[commit].set(offsets, **swr)
     d_temps = d_temps.at[commit].set(temps, **swr)
     d_topks = d_topks.at[commit].set(topks, **swr)
-    return _constrain_pool(shardings, cache, d_tokens, d_active, d_target,
-                           d_offsets, d_temps, d_topks)
+    # dispatch-tracker fence (see _copy_prefix_blocks): chunk rounds
+    # dispatch back-to-back, each donating the previous round's outputs
+    fence = jnp.sum(new_len).astype(jnp.int32)
+    return (*_constrain_pool(shardings, cache, d_tokens, d_active, d_target,
+                             d_offsets, d_temps, d_topks), fence)
 
 
 @functools.partial(
@@ -934,6 +949,14 @@ class SlotServer:
         self.trace_sink = trace_sink
         self._traces: dict[int, RequestTrace] = {}
         self._rate = ServiceRateEstimator()
+        # device-time attribution (observability.DispatchTracker): every
+        # dispatched program registers an output buffer and a background
+        # reaper measures dispatch→ready per program kind off the hot
+        # path; _process turns the recorded ready instants into the
+        # measured device_lag on request traces. reset() re-arms it
+        # (stale ready-instants never cross a reset); shutdown() stops
+        # the thread.
+        self.dispatch_tracker = DispatchTracker()
         # drain support: ServeApp.shutdown(drain=True) parks admission so
         # in-flight slots finish while nothing new starts
         self.pause_admission = False
@@ -1220,12 +1243,26 @@ class SlotServer:
         for rid in failed:      # their traces end here, not in a leak
             self._finish_trace(rid, "failed")
         self._prefix_refs.clear()
+        # drop pending dispatch-tracker entries WITHOUT blocking on them
+        # (their buffers may have died with the failed dispatch) and
+        # re-arm the same reaper thread: no stale ready-instant can be
+        # attributed to a post-reset dispatch, and resets never leak
+        # threads. The cumulative dispatch→ready histograms survive,
+        # same as the latency telemetry.
+        self.dispatch_tracker.reset()
         self._init_device_state()
         if self._prefix_blocks:
             self._init_prefix_pool()
         self._init_host_state()
         self.resets += 1
         return failed
+
+    def shutdown(self) -> None:
+        """Stop the background dispatch-reaper thread (idempotent). The
+        server remains usable for host-side queries afterwards, but no
+        further dispatch→ready observations are recorded — call at
+        process teardown (``ServeApp.shutdown`` does)."""
+        self.dispatch_tracker.shutdown()
 
     def fail_queued(self) -> list[Request]:
         """Drain the wait queue (requests never admitted) — the graceful-
@@ -1349,6 +1386,10 @@ class SlotServer:
             # monotonic; see docs/observability.md for the span schema)
             "latency": self.telemetry.snapshot(),
             "retry_after_s": self.estimate_retry_after(),
+            # device-time attribution: per-kind dispatch→ready quantiles
+            # + the measured in-flight dispatch depth (the real pipeline
+            # depth, vs the host bookkeeping's documented bound)
+            "device": self.dispatch_tracker.snapshot(),
         }
         pc = self._prefix_cache
         if pc is not None:
@@ -1491,10 +1532,11 @@ class SlotServer:
                 for a in admissions for ci, n in enumerate(a.hit_path)]
         if not rows:
             return
-        self._cache = _copy_prefix_blocks(
+        self._cache, fence = _copy_prefix_blocks(
             self._pool, self._cache, *self._prefix_rows(rows, oob="slot"),
             shardings=self._shardings)
         self.prefix_copy_dispatches += 1
+        self.dispatch_tracker.track("prefix_copy", fence)
 
     def _dispatch_prefix_insert(self, admissions) -> None:
         """Phase 3 of admission: insert the burst's new full-body chunks
@@ -1515,11 +1557,12 @@ class SlotServer:
                 rows.append((a.slot, node.block, ci, a.offset))
                 created.append(node)
         if rows:
-            self._pool = _insert_prefix_blocks(
+            self._pool, fence = _insert_prefix_blocks(
                 self._pool, self._cache,
                 *self._prefix_rows(rows, oob="block"),
                 shardings=self._shardings)
             self.prefix_insert_dispatches += 1
+            self.dispatch_tracker.track("prefix_insert", fence)
         if created:     # insert-refs protected the blocks until dispatch
             self._prefix_cache.release(created)
 
@@ -1558,7 +1601,7 @@ class SlotServer:
             final = c0 == chunk_starts[-1]
             (self._cache, self._d_tokens, self._d_active,
              self._d_target, self._d_offsets,
-             self._d_temps, self._d_topks) = _prefill_chunk(
+             self._d_temps, self._d_topks, fence) = _prefill_chunk(
                 self._params, self._cache, self._d_tokens,
                 self._d_active, self._d_target, self._d_offsets,
                 self._d_temps, self._d_topks,
@@ -1569,6 +1612,7 @@ class SlotServer:
                 cfg=self.cfg, chunk=C, kv_dtype=self.kv_dtype,
                 finalize=final, shardings=self._shardings)
             self.admission_dispatches += 1
+            self.dispatch_tracker.track("prefill", fence)
             self.prefill_tokens_computed += n_valid
 
     def _prefill_burst(self, admissions) -> None:
@@ -1613,7 +1657,7 @@ class SlotServer:
                 self.prefill_tokens_computed += nv
             (self._cache, self._d_tokens, self._d_active,
              self._d_target, self._d_offsets,
-             self._d_temps, self._d_topks) = _prefill_batch(
+             self._d_temps, self._d_topks, fence) = _prefill_batch(
                 self._params, self._cache, self._d_tokens,
                 self._d_active, self._d_target, self._d_offsets,
                 self._d_temps, self._d_topks,
@@ -1625,6 +1669,7 @@ class SlotServer:
                 cfg=self.cfg, chunk=C, kv_dtype=self.kv_dtype,
                 shardings=self._shardings)
             self.admission_dispatches += 1
+            self.dispatch_tracker.track("prefill", fence)
 
     def _apply_admit(self, admit) -> None:
         slot, body_len, req = admit
@@ -1691,7 +1736,12 @@ class SlotServer:
         # host DISPATCH time (the program runs async): what a decode
         # block costs the scheduling loop, not device execution time
         self.telemetry.observe("decode_block_s", time.monotonic() - t0)
-        self._pipeline.append({"packed": packed, "events": []})
+        # device time: the reaper blocks on `packed` (never donated) off
+        # the hot path and records when the device actually finished the
+        # block; _process subtracts that from its observation instant to
+        # measure the pipeline lag this block's tokens were delivered at
+        seq = self.dispatch_tracker.track("decode_block", packed)
+        self._pipeline.append({"packed": packed, "events": [], "seq": seq})
         if self._predictive:            # exact: no EOS can surprise us
             adv = np.minimum(self.block_size,
                              self._model_target - self._model_len)
@@ -1713,9 +1763,26 @@ class SlotServer:
         else:
             flat = np.asarray(
                 jnp.concatenate([r["packed"] for r in recs], axis=1))
+        # measured device lag: the transfer above forced every block in
+        # this batch ready, so the reaper's serial walk completes in
+        # microseconds — resolving the NEWEST seq first lets every older
+        # one be read without waiting. lag = host observation instant
+        # minus the block's device-ready instant: the real number behind
+        # the documented "lags by up to pipeline_depth blocks" bound.
+        t_obs = time.monotonic()
+        tracker = self.dispatch_tracker
+        tracker.ready_time(recs[-1].get("seq", -1), timeout=0.25)
+        lags: list[float | None] = []
+        for rec in recs:
+            rt = tracker.ready_time(rec.get("seq", -1))
+            lag = max(0.0, t_obs - rt) if rt is not None else None
+            lags.append(lag)
+            if lag is not None:
+                self.telemetry.observe("device_lag_s", lag)
         w = self.block_size + 2
         for i, rec in enumerate(recs):
             packed = flat[:, i * w:(i + 1) * w]
+            lag = lags[i]
             toks, lengths, active = (
                 packed[:, :-2], packed[:, -2], packed[:, -1].astype(bool))
             for slot in np.nonzero(self._expect_active)[0]:
@@ -1726,14 +1793,24 @@ class SlotServer:
                 if not had_tokens and n > 0 and req is not None:
                     # first emitted token OBSERVED by the host — the TTFT
                     # span (lags the device by the processing pipeline;
-                    # trace timestamps are host-monotonic by contract)
+                    # trace timestamps are host-monotonic by contract).
+                    # The lag is no longer just documented: the dispatch
+                    # tracker measured when this block went ready on
+                    # device, and the difference rides the trace.
                     tr = self._traces.get(req.id)
                     if tr is not None and tr.t("first_token") is None:
                         tr.mark("first_token")
+                        if lag is not None:
+                            tr.attrs["device_lag_first_token_s"] = round(
+                                lag, 6)
                 if not active[slot]:
                     out = self._emitted[slot]
                     reason = ("stop" if out and out[-1] in self.stop_tokens
                               else "length")
+                    if lag is not None:
+                        tr = self._traces.get(req.id)
+                        if tr is not None:
+                            tr.attrs["device_lag_s"] = round(lag, 6)
                     self._done[req.id] = Completion(
                         req.id, out, reason,
                         trace=self._finish_trace(

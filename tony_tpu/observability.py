@@ -39,6 +39,19 @@ shared observability layer every serving component feeds:
 - **``PromRenderer``** — Prometheus text exposition (``# HELP`` /
   ``# TYPE`` format, version 0.0.4) so any scraper works with no
   client library; ``ServeApp`` and the portal share it.
+- **``DispatchTracker``** — device-time attribution: every dispatched
+  program registers an output buffer, and a background reaper thread
+  ``block_until_ready``s them IN DISPATCH ORDER off the hot path,
+  yielding dispatch→ready latency histograms per program kind, an
+  in-flight-dispatch depth gauge, and per-dispatch ready instants the
+  serving loop turns into a measured ``device_lag`` on request traces
+  (the host-observation lag that used to be documented only as "up to
+  ``pipeline_depth`` blocks").
+- **``CompileTelemetry``** — XLA compile-time visibility via
+  ``jax.monitoring.register_event_duration_secs_listener``: a compile
+  histogram + counter, and a post-warmup recompile-storm warning (a
+  serving loop that recompiles after warmup is silently re-paying
+  seconds per dispatch — the classic shape-leak bug).
 
 See docs/observability.md for metric names, the trace schema, and a
 scrape example.
@@ -47,9 +60,14 @@ scrape example.
 from __future__ import annotations
 
 import bisect
+import collections
+import logging
 import math
 import re
+import threading
 import time
+
+log = logging.getLogger(__name__)
 
 # terminal span names: exactly one ends every trace
 TERMINAL_SPANS = ("finished", "cancelled", "expired", "shed", "failed")
@@ -249,6 +267,10 @@ TELEMETRY_HISTOGRAMS = {
     "decode_block_s": "host dispatch time of one decode block (async "
                       "dispatch, not device execution time)",
     "loop_turn_s": "one ServeApp scheduling turn",
+    "device_lag_s": "measured lag between a decode block becoming ready "
+                    "on device and the host observing its tokens (the "
+                    "pipeline-depth lag, now measured per block instead "
+                    "of bounded on paper)",
 }
 
 
@@ -341,6 +363,337 @@ class ServiceRateEstimator:
         return int(min(60, max(1, math.ceil(eta))))
 
 
+# ---------------------------------------------------- device-time tracking
+
+
+class DispatchTracker:
+    """Dispatch→ready attribution for asynchronously dispatched device
+    programs.
+
+    Every dispatch registers one of its OUTPUT buffers (``track``); a
+    background reaper thread ``block_until_ready``s the buffers in
+    dispatch order — dispatch order is device order, so when buffer N is
+    ready every earlier one is too, and the serial walk never waits on
+    anything the device hasn't already passed — and records the ready
+    instant. That yields, off the hot path:
+
+    - a dispatch→ready latency Histogram per program ``kind`` (prefill,
+      decode_block, prefix_copy, ...): how long the device actually
+      spent behind each dispatch, which host-side dispatch timing
+      (``decode_block_s``) cannot see;
+    - an ``in_flight`` depth gauge (dispatched, not yet ready) — the
+      real pipeline depth, vs the host's bookkeeping lag bound;
+    - ``ready_time(seq)``: the recorded ready instant of one dispatch,
+      which the serving loop subtracts from its observation instant to
+      measure ``device_lag`` on request traces.
+
+    All host-side, no jax import: a tracked object only needs a
+    ``block_until_ready()`` method (every jax array has one; tests use
+    stubs). The reaper is deliberately one thread: readiness is ordered,
+    so concurrency would buy nothing and unorder the histogram feed.
+
+    ``reset()`` discards pending entries and recorded ready instants
+    WITHOUT blocking on them (after a failed dispatch the buffers may be
+    dead — ``block_until_ready`` on a deleted array raises, which the
+    reaper tolerates) and re-arms the same thread: no stale
+    ready-instants cross a reset, no thread is leaked per reset.
+    ``shutdown()`` stops the thread for good."""
+
+    # keep at most this many reaped ready-instants for ready_time();
+    # callers look up recent dispatches only (the processing pipeline is
+    # a few blocks deep), so a small ring bounds memory forever
+    READY_KEEP = 512
+
+    def __init__(self, max_pending: int = 1024):
+        self.max_pending = max_pending
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: collections.deque = collections.deque()
+        self._ready: collections.OrderedDict[int, float] = \
+            collections.OrderedDict()
+        self.hist: dict[str, Histogram] = {}
+        self._seq = 0
+        self._gen = 0               # bumped by reset(): stale entries drop
+        self._busy = False          # reaper mid-block_until_ready
+        self._busy_seq = -1         # which dispatch it is blocking on
+        self.tracked_total = 0
+        self.dropped = 0            # queue overflow (reaper fell behind)
+        self.reap_errors = 0        # block_until_ready raised (dead buffer)
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._reap, name="dispatch-reaper", daemon=True)
+        self._thread.start()
+
+    def track(self, kind: str, buf) -> int:
+        """Register one dispatched program's output buffer; returns the
+        dispatch sequence number (monotonic). The hot-path cost is one
+        lock + deque append; the blocking wait happens on the reaper."""
+        with self._cv:
+            self._seq += 1
+            seq = self._seq
+            if self._stop:
+                return seq
+            if len(self._queue) >= self.max_pending:
+                # never let a wedged reaper grow host memory unboundedly;
+                # an untracked dispatch loses telemetry, nothing else
+                self.dropped += 1
+                return seq
+            self.tracked_total += 1
+            self._queue.append((seq, kind, time.monotonic(), buf,
+                                self._gen))
+            self._cv.notify_all()
+        return seq
+
+    def _reap(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait()
+                if self._stop:
+                    return
+                seq, kind, t0, buf, gen = self._queue.popleft()
+                self._busy, self._busy_seq = True, seq
+            try:
+                buf.block_until_ready()
+                t_ready = time.monotonic()
+            except Exception:
+                # a donated buffer killed by a failed dispatch, or a
+                # stub without the method: count it, never die — the
+                # tracker outlives every individual dispatch failure
+                t_ready = None
+                with self._lock:
+                    self.reap_errors += 1
+            with self._cv:
+                if t_ready is not None and gen == self._gen:
+                    h = self.hist.get(kind)
+                    if h is None:
+                        h = self.hist[kind] = Histogram()
+                    h.observe(max(0.0, t_ready - t0))
+                    self._ready[seq] = t_ready
+                    while len(self._ready) > self.READY_KEEP:
+                        self._ready.popitem(last=False)
+                self._busy = False
+                self._cv.notify_all()
+
+    def ready_time(self, seq: int, timeout: float = 0.0) -> float | None:
+        """Recorded ready instant of dispatch ``seq``, or None if it was
+        never tracked / already evicted / not yet reaped. A small
+        ``timeout`` gives the reaper a beat to catch up — callers ask
+        right after forcing the buffer themselves, so every queued
+        ``block_until_ready`` up to ``seq`` returns immediately and the
+        wait is microseconds unless the reaper is wedged."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                t = self._ready.get(seq)
+                if t is not None or seq > self._seq:
+                    return t
+                pending = (self._busy and self._busy_seq == seq) or any(
+                    s == seq for s, *_ in self._queue)
+                if not pending:
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+
+    @property
+    def in_flight(self) -> int:
+        """Dispatches registered but not yet observed ready — the
+        measured device pipeline depth."""
+        with self._lock:
+            return len(self._queue) + (1 if self._busy else 0)
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Block until every tracked dispatch has been reaped (or the
+        timeout passes); True when fully drained."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._queue or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True
+
+    def reset(self) -> None:
+        """Discard pending entries and recorded ready-instants without
+        blocking on possibly-dead buffers; the reaper thread survives
+        and keeps serving the next generation. Histograms are cumulative
+        telemetry and deliberately survive (same contract as
+        ``ServingTelemetry`` across ``SlotServer.reset()``)."""
+        with self._cv:
+            self._gen += 1
+            self._queue.clear()
+            self._ready.clear()
+            self._cv.notify_all()
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Stop the reaper thread (idempotent). Pending entries are
+        discarded — shutdown must never block on a dead device."""
+        with self._cv:
+            self._stop = True
+            self._queue.clear()
+            self._cv.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive() and not self._stop
+
+    def snapshot(self) -> dict:
+        """The stats()/bench payload: per-kind dispatch→ready quantiles
+        + the tracker's own counters."""
+        with self._lock:
+            return {
+                "in_flight": len(self._queue) + (1 if self._busy else 0),
+                "tracked": self.tracked_total,
+                "dropped": self.dropped,
+                "reap_errors": self.reap_errors,
+                "dispatch_ready": {k: h.snapshot()
+                                   for k, h in self.hist.items()},
+            }
+
+    def histograms(self) -> dict[str, Histogram]:
+        """Consistent copies of the per-kind dispatch→ready histograms,
+        taken under the tracker lock — safe to render (bucket iteration)
+        while the reaper keeps observing into the originals."""
+        with self._lock:
+            states = {k: h.state() for k, h in self.hist.items()}
+        out = {}
+        for k, s in states.items():
+            h = Histogram()
+            h.restore(s)
+            out[k] = h
+        return out
+
+
+# the jax.monitoring event that fires once per actual XLA compilation
+# (cache hits fire nothing); the other /jax/core/compile/* events time
+# tracing/lowering stages of the same compile and would triple-count
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class CompileTelemetry:
+    """XLA compile-time visibility: a listener on
+    ``jax.monitoring.register_event_duration_secs_listener`` feeds a
+    compile-duration Histogram + counters. ``mark_warm()`` draws the
+    line after warmup (first served request / first training step):
+    compiles past it are RECOMPILES — a serving loop that recompiles in
+    steady state is silently paying seconds of latency per new shape,
+    and crossing ``storm_threshold`` post-warm compiles logs one loud
+    warning instead of letting the storm hide in p99.
+
+    ``install()`` registers the process-global listener once (jax only
+    offers clear-all, never unregister-one, so the hook is permanent);
+    the instance stays usable without jax via ``note()`` — tests feed it
+    directly."""
+
+    def __init__(self, storm_threshold: int = 8):
+        # compiles run 10ms..minutes: wider buckets than the latency
+        # histograms' 120s default ceiling
+        self.hist = Histogram(lo=1e-3, hi=600.0)
+        self.compiles = 0
+        self.compile_time_s = 0.0
+        self.storm_threshold = storm_threshold
+        self._warm_at: int | None = None
+        self._storm_warned = False
+        self._lock = threading.Lock()
+
+    def note(self, event: str, duration_s: float) -> None:
+        if event != _COMPILE_EVENT:
+            return
+        with self._lock:
+            self.compiles += 1
+            self.compile_time_s += duration_s
+            self.hist.observe(duration_s)
+            storm = (self._warm_at is not None
+                     and not self._storm_warned
+                     and self.compiles - self._warm_at
+                     >= self.storm_threshold)
+            if storm:
+                self._storm_warned = True
+        if storm:
+            log.warning(
+                "recompile storm: %d XLA compiles after warmup "
+                "(%.1fs total compile time) — a steady-state workload "
+                "should not see new program shapes; check for leaking "
+                "dynamic shapes in dispatched programs",
+                self.compiles - self._warm_at, self.compile_time_s)
+
+    def mark_warm(self) -> None:
+        """Draw the warmup line (idempotent — only the first call
+        counts): compiles after this are recompiles."""
+        with self._lock:
+            if self._warm_at is None:
+                self._warm_at = self.compiles
+
+    @property
+    def recompiles_post_warm(self) -> int:
+        with self._lock:
+            if self._warm_at is None:
+                return 0
+            return self.compiles - self._warm_at
+
+    def hist_copy(self) -> Histogram:
+        """Consistent copy of the compile-duration histogram, taken
+        under the listener lock — safe to render while jax's compile
+        threads keep feeding the original."""
+        with self._lock:
+            state = self.hist.state()
+        h = Histogram(lo=1e-3, hi=600.0)
+        h.restore(state)
+        return h
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "compile_time_s": round(self.compile_time_s, 3),
+                "recompiles_post_warm": (
+                    self.compiles - self._warm_at
+                    if self._warm_at is not None else 0),
+                "warm": self._warm_at is not None,
+            }
+
+
+# the process-global instance install() feeds; one per process because
+# jax.monitoring listeners cannot be unregistered individually
+COMPILE_TELEMETRY = CompileTelemetry()
+_compile_listener_installed = False
+
+
+def install_compile_telemetry(only_if_loaded: bool = False) -> CompileTelemetry:
+    """Register the jax.monitoring listener feeding COMPILE_TELEMETRY
+    (idempotent; returns the instance either way). Import of jax happens
+    here, not at module import — observability.py stays usable without
+    an accelerator stack.
+
+    ``only_if_loaded=True`` skips installation while jax is absent from
+    ``sys.modules`` instead of forcing the (seconds-heavy) import — for
+    processes like the driver that run no device code on the common path
+    but want the listener once user code brings jax in (no jax import
+    means no compile events were possible anyway). Call again later to
+    pick jax up once something imported it."""
+    global _compile_listener_installed
+    if not _compile_listener_installed:
+        import sys
+
+        if only_if_loaded and "jax" not in sys.modules:
+            return COMPILE_TELEMETRY
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                lambda event, duration, **kw:
+                COMPILE_TELEMETRY.note(event, duration))
+            _compile_listener_installed = True
+        except Exception:   # no jax / API drift: telemetry is optional
+            log.exception("could not install compile-telemetry listener")
+    return COMPILE_TELEMETRY
+
+
 # ------------------------------------------------------------- exposition
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -430,4 +783,6 @@ PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 __all__ = ["Histogram", "RequestTrace", "TaskTrace", "ServingTelemetry",
            "ServiceRateEstimator", "PromRenderer", "PROM_CONTENT_TYPE",
-           "TELEMETRY_HISTOGRAMS", "TERMINAL_SPANS", "TASK_TERMINAL_SPANS"]
+           "TELEMETRY_HISTOGRAMS", "TERMINAL_SPANS", "TASK_TERMINAL_SPANS",
+           "DispatchTracker", "CompileTelemetry", "COMPILE_TELEMETRY",
+           "install_compile_telemetry"]

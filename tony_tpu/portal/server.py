@@ -10,7 +10,9 @@ Observability additions (docs/observability.md): `/traces/<id>` renders a
 per-request timeline from the job's ``requests.trace.jsonl`` (written by
 ``serve --trace-dir``, TTL-cached like the event stream), `/tasks/<id>`
 renders the gang-launch waterfall from ``tasks.trace.jsonl`` (written by
-the driver), and `/metrics` exposes the portal's own request
+the driver), `/profiles/<id>` lists and serves captured jax.profiler
+xplane dumps (from serve's `/debug/profile` and the driver's
+profile-command path), and `/metrics` exposes the portal's own request
 counters/latency in Prometheus text format through the same renderer the
 serve endpoint uses.
 """
@@ -165,11 +167,68 @@ class HistoryIndex:
             return None
         out = {}
         for p in sorted(log_dir.iterdir()):
+            if p.is_dir():      # profiles/ subtree: listed on /profiles
+                continue
             try:
                 out[p.name] = p.read_text()[-20000:]
             except OSError:
                 continue
         return out
+
+    def _profile_roots(self, app_id: str) -> list[Path]:
+        """Where captured xplane profiles live for this job: the history
+        job dir's ``profiles/`` (``serve --trace-dir`` pointed at the
+        history dir + /debug/profile) and the staging ``logs/profiles/``
+        tree (training children, via the driver's profile command and
+        the ``$TONY_STEP_LOG.profile`` flag contract)."""
+        roots = []
+        job_dir, _ = self._find_job_dir(app_id)
+        if job_dir is not None:
+            roots.append(job_dir / "profiles")
+        roots.append(self.staging / app_id / "logs" / "profiles")
+        return [r for r in roots if r.is_dir()]
+
+    def profiles(self, app_id: str) -> list[dict] | None:
+        """Captured profile files for the job page: one entry per file
+        under either profile root (relative name, size, mtime). None
+        when no captures exist — the route 404s instead of rendering an
+        empty page for a job that was never profiled."""
+        roots = self._profile_roots(app_id)
+        if not roots:
+            return None
+        out = []
+        for root in roots:
+            for p in sorted(root.rglob("*")):
+                if not p.is_file():
+                    continue
+                try:
+                    st = p.stat()
+                except OSError:
+                    continue
+                out.append({"name": str(p.relative_to(root)),
+                            "bytes": st.st_size,
+                            "mtime": int(st.st_mtime)})
+        return out
+
+    def profile_file(self, app_id: str, rel: str) -> bytes | None:
+        """One captured profile's bytes (the xplane proto TensorBoard's
+        profile plugin / xprof loads). The resolved path must stay under
+        a profile root — the relative name comes off the URL and must
+        not become a directory-traversal read primitive."""
+        for root in self._profile_roots(app_id):
+            root_res = root.resolve()
+            try:
+                path = (root / rel).resolve()
+            except OSError:
+                continue
+            if root_res not in path.parents:
+                continue
+            if path.is_file():
+                try:
+                    return path.read_bytes()
+                except OSError:
+                    continue
+        return None
 
 
 _PAGE = """<!doctype html><html><head><title>tony-tpu history</title>
@@ -282,7 +341,8 @@ def _job_detail_html(app_id: str, events: list[dict]) -> str:
         f"<a href='/config/{html.escape(app_id)}'>config</a>"
         f" | <a href='/logs/{html.escape(app_id)}'>logs</a>"
         f" | <a href='/traces/{html.escape(app_id)}'>requests</a>"
-        f" | <a href='/tasks/{html.escape(app_id)}'>tasks</a></p>"
+        f" | <a href='/tasks/{html.escape(app_id)}'>tasks</a>"
+        f" | <a href='/profiles/{html.escape(app_id)}'>profiles</a></p>"
         "<h4>events</h4><table><tr><th>time</th><th>type</th><th>detail</th></tr>"
         + "".join(ev_rows) + "</table>"
     )
@@ -480,6 +540,32 @@ def _task_timeline_html(app_id: str, traces: list[dict]) -> str:
     return _PAGE.format(body=body)
 
 
+def _profiles_html(app_id: str, profiles: list[dict]) -> str:
+    """Captured-profile listing: one row per xplane/artifact file with a
+    download link; viewing instructions point at TensorBoard's profile
+    plugin (docs/observability.md "Device timing & profiling")."""
+    rows = "".join(
+        f"<tr><td><a href='/profiles/{html.escape(app_id)}/"
+        f"{html.escape(p['name'])}'>{html.escape(p['name'])}</a></td>"
+        f"<td>{p['bytes']}</td>"
+        f"<td>{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(p['mtime']))}"
+        f"</td></tr>"
+        for p in profiles
+    )
+    body = (
+        f"<h3>{html.escape(app_id)} — captured profiles</h3>"
+        f"<p><a href='/'>all jobs</a> | "
+        f"<a href='/jobs/{html.escape(app_id)}'>events</a></p>"
+        f"<p>{len(profiles)} files. View a capture with TensorBoard's "
+        "profile plugin: download the directory structure and run "
+        "<code>tensorboard --logdir &lt;capture dir&gt;</code> "
+        "(see docs/observability.md).</p>"
+        "<table><tr><th>file</th><th>bytes</th><th>captured</th></tr>"
+        + rows + "</table>"
+    )
+    return _PAGE.format(body=body)
+
+
 def make_handler(index: HistoryIndex, token: str = ""):
     import threading
 
@@ -490,7 +576,7 @@ def make_handler(index: HistoryIndex, token: str = ""):
     # not grow the dict (or the /metrics cardinality) without limit.
     # One lock: ThreadingHTTPServer handlers mutate these concurrently.
     _KNOWN_ROUTES = ("index", "jobs", "config", "logs", "traces",
-                     "tasks", "metrics")
+                     "tasks", "profiles", "metrics")
     http_requests: dict[str, int] = {}
     request_hist = Histogram()
     telemetry_lock = threading.Lock()
@@ -639,6 +725,27 @@ def make_handler(index: HistoryIndex, token: str = ""):
                         return self._json(traces)
                     return self._send(
                         200, _task_timeline_html(app_id, traces))
+                if kind == "profiles":
+                    if len(parts) > 2:
+                        # a single capture file (xplane proto et al):
+                        # binary download, traversal-guarded by the index
+                        data = index.profile_file(
+                            app_id, "/".join(parts[2:]))
+                        if data is None:
+                            return self._send(404, "not found",
+                                              "text/plain")
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/octet-stream")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                        return None
+                    profiles = index.profiles(app_id)
+                    if want_json or profiles is None:
+                        return self._json(profiles)
+                    return self._send(
+                        200, _profiles_html(app_id, profiles))
                 if kind == "jobs":
                     events = index.events(app_id)
                     if want_json or events is None:

@@ -7,13 +7,20 @@ service (rpc/MetricsRpc.java), carried as framed JSON over TCP:
   register_worker(task_id, host, port) -> cluster_spec | None   (gang barrier)
   get_cluster_spec(task_id)            -> cluster_spec | None
   get_task_infos()                     -> [TaskInfo]
-  heartbeat(task_id)                   -> bool
+  heartbeat(task_id)                   -> True | {"profile": {...}}
   register_execution_result(task_id, exit_code) -> str
   register_tensorboard_url(url)        -> bool
   register_callback_info(task_id, payload) -> bool   (runtime rendezvous data)
   finish_application()                 -> bool       (client lets driver exit)
   update_metrics(task_id, metrics, spans=None) -> bool
   get_metrics(task_id)                 -> [MetricSample]
+  request_task_profile(task_id, seconds=5.0) -> bool (client-ACL'd; queues an
+                                                      on-demand profiler capture)
+
+Driver->executor commands piggyback on the heartbeat RESPONSE: a plain
+``True`` at steady state, or a one-shot ``{"profile": {"seconds": N}}``
+dict when a capture is queued (the executor's Heartbeater relays it into
+the ``$TONY_STEP_LOG.profile`` flag file).
 
 ``update_metrics`` additionally carries executor-side lifecycle spans
 ([name, unix_ts] pairs: work_dir_ready, child_spawned, child_exited) that

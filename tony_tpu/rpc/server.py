@@ -97,7 +97,12 @@ class RpcServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # shutdown() blocks on serve_forever()'s exit handshake; if start()
+        # was never called there is no loop to exit and it would hang forever.
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=5.0)
+            self._thread = None
         self._server.server_close()
 
     # --------------------------------------------------------------- dispatch

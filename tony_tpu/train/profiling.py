@@ -34,6 +34,22 @@ def trace(log_dir: str | Path, enabled: bool = True):
         jax.profiler.stop_trace()
 
 
+def _start_profiler(log_dir: str) -> None:
+    """jax.profiler.start_trace behind one seam (tests stub the jax
+    functions; product code never needs jax imported until a capture
+    actually starts)."""
+    import jax
+
+    Path(log_dir).mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+
+
+def _stop_profiler() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
 class StepTimer:
     """Rolling step-time stats written as JSONL next to the job's history
     events — cheap always-on tracing for launch-latency and throughput
@@ -43,11 +59,20 @@ class StepTimer:
     the record's ``ts`` stays wall-clock, it only labels the line. Same
     clock contract as the serving traces (observability.RequestTrace)."""
 
-    def __init__(self, out_path: str | Path | None = None, window: int = 50):
-        from ..observability import Histogram
+    def __init__(self, out_path: str | Path | None = None, window: int = 50,
+                 compile_warm_on_step: bool = True):
+        from ..observability import Histogram, install_compile_telemetry
 
         self._out = Path(out_path) if out_path else None
         self._window = window
+        # whether a first measured step draws the process's compile
+        # warmup line. True for training loops (step 1 ran every
+        # program shape). ServeApp's loop-TURN timer passes False: its
+        # turns start ticking before any request compiled anything, and
+        # the serving warm line belongs to the first DELIVERED
+        # completion (ServeApp._deliver) — marking it here would count
+        # the legitimate warm-up compiles as a recompile storm.
+        self._compile_warm_on_step = compile_warm_on_step
         self._t_last: float | None = None
         self._times: list[float] = []
         # cumulative step-time distribution (the rolling window forgets;
@@ -56,6 +81,18 @@ class StepTimer:
         # per-worker step skew becomes visible on the driver's /metrics
         self.hist = Histogram()
         self.step = 0
+        # compile-time visibility: every StepTimer owner (training loops,
+        # the serving scheduling loop) gets the process-global
+        # jax.monitoring listener installed; the JSONL records then carry
+        # the compile snapshot so XLA compile time per worker rides the
+        # same channel as step quantiles (TaskMonitor._sample_step_log)
+        self._compile = install_compile_telemetry()
+        # on-demand profiler capture (docs/observability.md): when this
+        # timer writes a step log, `<out_path>.profile` is the flag file
+        # the executor drops to request a capture; polled at record
+        # cadence (every `window` steps — never per step)
+        self._profile_stop_t: float | None = None
+        self._atexit_armed = False
 
     def tick(self, **extra) -> float | None:
         """Call once per training step; returns the last step's duration."""
@@ -67,8 +104,15 @@ class StepTimer:
             if len(self._times) > self._window:
                 self._times.pop(0)
             self.hist.observe(dt)
+            # one full measured step means warmup compiles are behind us:
+            # compiles from here on are recompiles (idempotent; only the
+            # process's first measured step draws the line)
+            if self._compile_warm_on_step:
+                self._compile.mark_warm()
         self._t_last = now
         self.step += 1
+        if self._profile_stop_t is not None and now >= self._profile_stop_t:
+            self._finish_profile()
         if self._out and dt is not None and self.step % self._window == 0:
             rec = {
                 "step": self.step,
@@ -79,6 +123,10 @@ class StepTimer:
                 "ts": time.time(),
                 **extra,
             }
+            snap = self._compile.snapshot()
+            rec["xla_compiles"] = snap["compiles"]
+            rec["xla_compile_time_s"] = snap["compile_time_s"]
+            rec["xla_recompiles_post_warm"] = snap["recompiles_post_warm"]
             # best-effort, like the rest of the telemetry path: a missing
             # log dir (remote executor, no logs/ in the unpacked archive)
             # or a full disk must not kill the training loop
@@ -88,7 +136,79 @@ class StepTimer:
                     f.write(json.dumps(rec) + "\n")
             except OSError as e:
                 log.warning("step log write failed: %s", e)
+            self._poll_profile_flag()
         return dt
+
+    # ------------------------------------------- on-demand profiler capture
+    @property
+    def _flag_path(self) -> Path | None:
+        """`$TONY_STEP_LOG.profile` — the flag-file contract the executor
+        uses to relay a driver profile command into this process."""
+        if self._out is None:
+            return None
+        from .. import constants as c
+
+        return self._out.with_name(self._out.name + c.PROFILE_REQUEST_SUFFIX)
+
+    def _poll_profile_flag(self) -> None:
+        flag = self._flag_path
+        if flag is None or self._profile_stop_t is not None:
+            return
+        try:
+            if not flag.exists():
+                return
+            req = json.loads(flag.read_text())
+            flag.unlink()
+            # extraction stays inside the tolerant block: valid JSON
+            # that is not a dict, or a non-numeric "seconds", must be
+            # dropped like a torn flag, not crash the training loop
+            seconds = float(req.get("seconds", 5.0))
+            out_dir = str(req.get("out_dir")
+                          or self._out.parent / "profiles"
+                          / f"step{self.step}")
+        except (OSError, ValueError, TypeError, AttributeError) as e:
+            # a torn or unreadable request must not kill the training
+            # loop; drop the flag so it doesn't wedge future requests
+            log.warning("profile request unreadable: %s", e)
+            try:
+                flag.unlink()
+            except OSError:
+                pass
+            return
+        try:
+            _start_profiler(out_dir)
+        except Exception:
+            log.exception("profiler capture failed to start")
+            return
+        self._profile_stop_t = time.monotonic() + max(0.0, seconds)
+        # the training loop may END inside the capture window (job
+        # finishes, window longer than the remaining run): without a
+        # stop the xplane buffer is never flushed and the dump is
+        # silently empty. close() handles the explicit path; atexit
+        # covers loops that just return.
+        if not self._atexit_armed:
+            import atexit
+
+            atexit.register(self.close)
+            self._atexit_armed = True
+        log.info("profiler capture started (%.1fs) -> %s", seconds, out_dir)
+
+    def _finish_profile(self) -> None:
+        self._profile_stop_t = None
+        try:
+            _stop_profiler()
+            log.info("profiler capture finished")
+        except Exception:
+            log.exception("profiler capture failed to stop")
+
+    def close(self) -> None:
+        """Stop an in-progress profiler capture early so the xplane dump
+        flushes (idempotent). Called at training-loop end and via atexit
+        — a capture window outliving the job must still produce a usable
+        dump, cut short at the point the work stopped."""
+        if self._profile_stop_t is not None:
+            log.info("capture window outlived the loop: stopping early")
+            self._finish_profile()
 
     def reset_interval(self) -> None:
         """Forget the last tick instant (the rolling window survives).
