@@ -65,6 +65,16 @@ completions and reports the reused-token fraction, prefill/copy/insert
 dispatch counts, and tokens/sec for both paths. Results land in PERF.json
 under `prefix_cache`.
 
+`python bench.py --serving --fleet` benchmarks driver-orchestrated
+fleet serving (docs/serving.md "Fleet serving"): 2-3 real serve
+processes (one pinned per core, prefix caches on) behind the
+prefix-aware FleetRouter — fleet-vs-single CAPACITY (closed-loop,
+concurrency-matched, best-of-trials; asserted > 1.5x), Poisson
+open-loop passes at 1.2x measured fleet capacity, and prefix-affinity
+vs random routing on the fleet-wide trie reuse fraction (asserted
+affinity > random) and merged p99 TTFT. Results land in PERF.json
+under `serving_fleet`.
+
 `python bench.py --serving --overload --chaos` exercises the failure
 model (docs/serving.md): a burst far exceeding slots + max_queue hits a
 ServeApp whose SlotServer runs with seeded fault injection
@@ -443,6 +453,439 @@ def run_shared_prefix_bench() -> int:
     return 0
 
 
+def _scrape_ttft_hist(base_url: str):
+    """Reconstruct the serving_ttft_seconds histogram from a replica's
+    /metrics exposition (cumulative ``le`` buckets) into an
+    observability.Histogram — scraped before and after a timed pass, the
+    bucket DELTA gives that pass's quantiles with no warm-up pollution."""
+    import re as _re
+    import urllib.request
+
+    from tony_tpu.observability import Histogram
+
+    with urllib.request.urlopen(base_url + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    cum = []
+    for m in _re.finditer(
+            r'^serving_ttft_seconds_bucket\{le="([^"]+)"\} (\d+)$',
+            text, _re.M):
+        cum.append((m.group(1), int(m.group(2))))
+    h = Histogram()
+    assert len(cum) == len(h.counts), "ttft bucket layout drifted"
+    prev = 0
+    for i, (_, c) in enumerate(cum):
+        h.counts[i] = c - prev
+        prev = c
+    h.count = prev
+    return h
+
+
+def _hist_delta(before, after):
+    """after - before as a fresh Histogram (per-pass bucket deltas;
+    merge the per-replica results before taking fleet-wide quantiles —
+    max-of-per-replica-p99s would overstate the tail under uneven
+    load)."""
+    from tony_tpu.observability import Histogram
+
+    d = Histogram()
+    d.counts = [a - b for a, b in zip(after.counts, before.counts)]
+    d.count = after.count - before.count
+    return d
+
+
+def run_serving_fleet_bench() -> int:
+    """Fleet benchmark (one JSON line; ISSUE 7): a 2-3 replica
+    SlotServer fleet of real serve processes (PR 2 shape, prefix
+    caches ON — the production path) behind the FleetRouter, on
+    forced-CPU host devices with one replica pinned per core (one
+    replica per accelerator host; an unpinned XLA CPU server would
+    spread over every core and the "N replicas vs 1" comparison would
+    measure contention, not capacity). Two comparisons, enforced
+    rather than just reported:
+
+    - **capacity scaling**: closed-loop, concurrency-matched,
+      best-of-`trials` per arm after a discarded steady-state pass —
+      fleet capacity must exceed 1.5x one replica. Closed loop because
+      per-pass open-loop throughput at these wall times swings ~3x
+      with scheduler placement (every arrival-rate calibration scheme
+      measured the arrival process or the noise, not the fleet). The
+      headroom is compute AND cache capacity: the per-replica trie
+      budget holds 2/3 of the template working set, so the
+      affinity-routed fleet holds it collectively while the single
+      replica churns it through LRU eviction. Poisson OPEN-LOOP passes
+      at 1.2x the measured fleet capacity are reported alongside (the
+      lone replica collapses into deep queueing at fleet-rate
+      traffic).
+    - **prefix-affinity vs random routing**: the same open-loop
+      schedule routed sticky vs least-loaded, after an untimed
+      steady-state prepass per policy. Affinity must beat random on
+      the fleet-wide reused-token fraction. p99 TTFT (per-replica
+      serving_ttft_seconds bucket deltas over the timed pass, MERGED
+      fleet-wide) is reported for both.
+    """
+    import re as _re
+    import subprocess
+    import threading
+    import urllib.request
+    import numpy as np
+
+    sys.path.insert(0, str(REPO))
+    from tony_tpu.router import FleetRouter
+
+    # the PR 2 bench shape (d256/L4, chunk 64): heavy enough that the
+    # REPLICAS are the measured bottleneck. At toy shapes (d128) a
+    # single replica plus the router/load-generator saturate the whole
+    # host and both arms measure the client, not the fleet; and the
+    # prefix-COPY path only beats recomputing prefill once the model is
+    # this large (docs/performance.md "Fleet serving").
+    slots, max_len, chunk = 6, 512, 64
+    n_requests, max_new = 64, 8
+    trials = 3      # best-of per throughput arm: short walls on a shared
+    #                 2-core host swing; the max is the capacity
+    # enough distinct templates that rendezvous hashing balances them
+    # over 2-3 replicas (6 keys over 2 bins can land 5/1; 12 rarely do)
+    templates = 12
+    # per-replica trie budget: 2/3 of the template working set (12
+    # templates x 4 chunks = 48 blocks): an affinity-routed FLEET's
+    # per-replica share (~24 blocks) fits with headroom, while a single
+    # replica — or a randomly-routed fleet whose every replica sees
+    # every template — churns all 48 through LRU eviction and recomputes
+    # 256-token prefills. Fleet serving scales cache capacity, not just
+    # compute. (Exact-fit budgets thrash: ref-pinned in-use paths block
+    # eviction, so size the fitting arm with slack.)
+    cache_blocks = 32
+
+    def serve_args(blocks: int) -> list[str]:
+        out = [
+            sys.executable, "-m", "tony_tpu.cli.main", "serve",
+            "--port", "0", "--host", "127.0.0.1",
+            "--vocab", "2048", "--d-model", "256", "--n-layers", "4",
+            "--n-heads", "8", "--d-ff", "1024", "--dtype", "float32",
+            "--seed", "0", "--slots", str(slots),
+            "--max-len", str(max_len), "--block-size", "16",
+            "--prefill-chunk", str(chunk), "--drain-timeout-s", "2",
+        ]
+        if blocks:
+            out += ["--prefix-cache-blocks", str(blocks)]
+        return out
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)      # each replica is a single-device server
+    ncpu = os.cpu_count() or 2
+    n_fleet = 3 if ncpu >= 3 else 2
+
+    class Replica:
+        def __init__(self, name, core: int, blocks: int):
+            self.name = name
+            self.proc = subprocess.Popen(
+                serve_args(blocks), cwd=REPO, env=env, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            try:
+                os.sched_setaffinity(self.proc.pid, {core % ncpu})
+            except OSError:
+                pass        # affinity is best-effort off-Linux
+            self.port = None
+
+        def await_ready(self, timeout=180.0):
+            deadline = time.time() + timeout
+            line = ""
+            while self.port is None and time.time() < deadline:
+                line = self.proc.stdout.readline()
+                m = _re.search(r"http://[\d.]+:(\d+)", line or "")
+                if m:
+                    self.port = int(m.group(1))
+            assert self.port, f"{self.name} never printed its port: {line}"
+            # drain stdout on a thread so the serve process never blocks
+            # on a full pipe
+            threading.Thread(target=self.proc.stdout.read,
+                             daemon=True).start()
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            self.base_url + "/healthz", timeout=2) as r:
+                        if r.status == 200:
+                            return
+                except Exception:
+                    time.sleep(0.2)
+            raise AssertionError(f"{self.name} never became healthy")
+
+        @property
+        def base_url(self):
+            return f"http://127.0.0.1:{self.port}"
+
+        def stats(self):
+            with urllib.request.urlopen(self.base_url + "/stats",
+                                        timeout=10) as r:
+                return json.loads(r.read().decode())
+
+        def stop(self):
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+    rng = np.random.default_rng(5)
+    bodies = [rng.integers(0, 2048, size=4 * chunk, dtype=np.int32)
+              for _ in range(templates)]
+    prompts = [
+        np.concatenate([bodies[i % templates],
+                        rng.integers(0, 2048, size=4 + i % 9,
+                                     dtype=np.int32)]).tolist()
+        for i in range(n_requests)
+    ]
+
+    def warm(rep):
+        """Compile every program shape the timed pass will hit (batched
+        admission pads rows to powers of two: drive slots-wide bursts)
+        WITHOUT seeding the prefix trie (cache_prompt off)."""
+        def one(i):
+            body = json.dumps({
+                "prompt": rng.integers(0, 2048,
+                                       size=2 * chunk + i).tolist(),
+                "max_new_tokens": 8, "cache_prompt": False}).encode()
+            req = urllib.request.Request(rep.base_url + "/generate",
+                                         data=body)
+            with urllib.request.urlopen(req, timeout=300) as r:
+                r.read()
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(2 * slots)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+
+    def fresh_fleet(n, blocks=0):
+        """A pass gets FRESH replica processes: each pass's prefix tries
+        start cold, so reuse fractions compare routing policies, not
+        which pass inherited a warm trie."""
+        reps = [Replica(f"replica:{i}", core=i, blocks=blocks)
+                for i in range(n)]
+        for r in reps:
+            r.await_ready()
+        warmers = [threading.Thread(target=warm, args=(r,)) for r in reps]
+        for t in warmers:
+            t.start()
+        for t in warmers:
+            t.join(timeout=600)
+        return reps
+
+    def run_pass(reps, *, affinity, schedule, prepass=False):
+        # spill_queue_depth: a sticky replica 3 slot-widths deep in
+        # backlog spills to its rendezvous runner-up — affinity is worth
+        # a queued beat, not an unbounded pile-up behind one replica.
+        # Generous probe timeout + eject_after: a saturated pinned core
+        # answers /healthz slowly, and this harness must not grade
+        # health-probe churn.
+        router = FleetRouter(
+            [(r.name, "127.0.0.1", r.port) for r in reps],
+            prefill_chunk=chunk, affinity=affinity,
+            health_interval_s=0.25, spill_queue_depth=3 * slots,
+            eject_after=4, probe_timeout_s=5.0, seed=0)
+        router.start()
+
+        def fire(sched):
+            results: dict[int, object] = {}
+            t_done: dict[int, float] = {}
+
+            def call(i, at):
+                time.sleep(max(0.0, t0 + at - time.time()))
+                try:
+                    results[i] = router.generate(prompts[i],
+                                                 max_new_tokens=max_new,
+                                                 timeout_s=600)
+                    t_done[i] = time.time()
+                except Exception as exc:
+                    results[i] = exc
+            threads = [threading.Thread(target=call, args=(i, at))
+                       for i, at in enumerate(sched)]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=900)
+            failed = [i for i, r in results.items()
+                      if not isinstance(r, dict)]
+            assert not failed, f"fleet pass dropped requests: {failed}"
+            return results, t_done, t0
+
+        if prepass:
+            # un-timed steady-state pass: populate each trie THE WAY THIS
+            # ROUTING POLICY populates it, so the timed pass measures
+            # steady state instead of cold-trie insert costs
+            fire([0.0] * len(schedule))
+        before = {r.name: (r.stats(), _scrape_ttft_hist(r.base_url))
+                  for r in reps}
+        results, t_done, t0 = fire(schedule)
+        wall = max(t_done.values()) - t0
+        tokens = sum(len(r["tokens"]) for r in results.values())
+        computed = reused = 0
+        ttft_fleet = None
+        for r in reps:
+            st_b, h_b = before[r.name]
+            st_a, h_a = r.stats(), _scrape_ttft_hist(r.base_url)
+            computed += (st_a["prefill_tokens_computed"]
+                         - st_b["prefill_tokens_computed"])
+            reused += (st_a["prefill_tokens_reused"]
+                       - st_b["prefill_tokens_reused"])
+            delta = _hist_delta(h_b, h_a)
+            if ttft_fleet is None:
+                ttft_fleet = delta
+            else:
+                ttft_fleet.merge(delta)
+        ttft_p99 = ttft_fleet.quantile(0.99) if ttft_fleet else 0.0
+        st = router.stats()
+        router.shutdown()
+        return {
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(tokens / wall, 1),
+            "useful_tokens": tokens,
+            "prefill_reused_frac": round(
+                reused / max(1, computed + reused), 4),
+            "ttft_p99_s": round(ttft_p99, 4),
+            "affinity_hit_ratio": st["affinity"]["hit_ratio"],
+            "retries": sum(rep["retries"]
+                           for rep in st["replicas"].values()),
+            "shed_429": sum(rep["shed"]
+                            for rep in st["replicas"].values()),
+        }
+
+    def closed_loop_capacity(reps, concurrency):
+        """Arm capacity at a BOUNDED concurrency (2 slot-widths per
+        replica): a classic K-worker closed loop, least-loaded so the
+        work spreads. An all-at-once burst would measure the
+        deep-backlog thrash regime (64 handler threads against a pinned
+        core), not capacity."""
+        router = FleetRouter(
+            [(r.name, "127.0.0.1", r.port) for r in reps],
+            prefill_chunk=chunk, affinity=True,
+            spill_queue_depth=3 * slots, eject_after=4,
+            probe_timeout_s=5.0, seed=0)
+        it = iter(range(n_requests))
+        lock = threading.Lock()
+        tokens = [0]
+
+        def worker():
+            while True:
+                with lock:
+                    i = next(it, None)
+                if i is None:
+                    return
+                resp = router.generate(prompts[i], max_new_tokens=max_new,
+                                       timeout_s=600)
+                with lock:
+                    tokens[0] += len(resp["tokens"])
+        t0 = time.time()
+        threads = [threading.Thread(target=worker)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=900)
+        wall = time.time() - t0
+        router.shutdown()
+        return tokens[0] / wall
+
+    # ---- throughput scaling (cache ON — the production path) --------
+    # Separate replica PROCESSES per arm so each arm's prefix tries
+    # evolve under its own policy: the single arm's one replica churns
+    # the whole template working set through its half-sized trie; the
+    # affinity-routed fleet holds it collectively. Arms alternate,
+    # best-of-`trials` each — adjacent in time like the mnist bench's
+    # A/B pairs, so host noise hits both arms alike. The single-arm
+    # replica shares core 0 with one fleet replica; only one arm is
+    # ever driven at a time (an idle serve loop costs ~nothing).
+    single_arm = fresh_fleet(1, blocks=cache_blocks)
+    fleet = fresh_fleet(n_fleet, blocks=cache_blocks)
+    try:
+        # capacity = best-of-`trials` closed-loop measurements per arm,
+        # concurrency matched to each arm's slot budget, arms alternated
+        # so host noise hits both alike. The SPEEDUP is the capacity
+        # ratio: per-pass open-loop throughput on this class of host
+        # swings ~3x run to run (scheduler placement against the pinned
+        # replicas), which defeated every arrival-rate calibration
+        # scheme — closed loops self-pace and need none.
+        # one discarded closed-loop pass per arm brings each arm's tries
+        # to ITS policy's steady state before anything is measured
+        closed_loop_capacity(single_arm, concurrency=2 * slots)
+        closed_loop_capacity(fleet, concurrency=2 * slots * n_fleet)
+        single_runs, fleet_runs = [], []
+        for _ in range(trials):
+            single_runs.append(closed_loop_capacity(
+                single_arm, concurrency=2 * slots))
+            fleet_runs.append(closed_loop_capacity(
+                fleet, concurrency=2 * slots * n_fleet))
+        cap_single = max(single_runs)
+        cap_fleet = max(fleet_runs)
+        # the open-loop (Poisson) passes run at 1.2x the measured FLEET
+        # capacity: the single arm is then deeply saturated (the
+        # open-loop collapse a lone replica suffers at fleet-rate
+        # traffic), the fleet just-saturated — both walls are reported
+        interarrival = max_new / (cap_fleet * 1.2)
+        schedule = np.cumsum(rng.exponential(
+            scale=interarrival, size=n_requests)).tolist()
+        single = run_pass(single_arm, affinity=True, schedule=schedule)
+        fleet_pass = run_pass(fleet, affinity=True, schedule=schedule)
+        # affinity open-loop pass: the fleet's tries are already in the
+        # affinity-policy steady state from the capacity trials
+        affinity_pass = run_pass(fleet, affinity=True, schedule=schedule,
+                                 prepass=True)
+    finally:
+        for r in single_arm + fleet:
+            r.stop()
+    fleet = fresh_fleet(n_fleet, blocks=cache_blocks)
+    try:
+        random_pass = run_pass(fleet, affinity=False, schedule=schedule,
+                               prepass=True)
+    finally:
+        for r in fleet:
+            r.stop()
+
+    print(f"# capacity single {cap_single:.0f} {single_runs} | fleet "
+          f"{cap_fleet:.0f} {fleet_runs} | open-loop single {single} | "
+          f"fleet {fleet_pass} | affinity {affinity_pass} | "
+          f"random {random_pass}", file=sys.stderr)
+    speedup = round(cap_fleet / cap_single, 3)
+    assert speedup > 1.5, (
+        f"fleet speedup {speedup} <= 1.5x single replica")
+    assert (affinity_pass["prefill_reused_frac"]
+            > random_pass["prefill_reused_frac"]), (
+        "prefix-affinity routing must beat random routing on trie reuse")
+    out = {
+        "metric": "serving_fleet_speedup_vs_single_replica",
+        "value": speedup,
+        "unit": "x capacity (closed-loop, concurrency-matched, "
+                "best-of-trials per arm)",
+        "replicas": n_fleet,
+        "slots_per_replica": slots,
+        "n_requests": n_requests,
+        "templates": templates,
+        "max_new_tokens": max_new,
+        "prefill_chunk": chunk,
+        "poisson_interarrival_s": round(interarrival, 4),
+        "one_core_per_replica": True,
+        "throughput_trials_per_arm": trials,
+        "capacity_single_tokens_per_sec": round(cap_single, 1),
+        "capacity_fleet_tokens_per_sec": round(cap_fleet, 1),
+        "capacity_single_all_trials": [round(v, 1) for v in single_runs],
+        "capacity_fleet_all_trials": [round(v, 1) for v in fleet_runs],
+        "open_loop_single_replica": single,
+        "open_loop_fleet": fleet_pass,
+        "prefix_cache_blocks_per_replica": cache_blocks,
+        "fleet_affinity": affinity_pass,
+        "fleet_random": random_pass,
+        "affinity_gain": {
+            "reused_frac": [affinity_pass["prefill_reused_frac"],
+                            random_pass["prefill_reused_frac"]],
+            "ttft_p99_s": [affinity_pass["ttft_p99_s"],
+                           random_pass["ttft_p99_s"]],
+            "affinity_hit_ratio": affinity_pass["affinity_hit_ratio"],
+        },
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def run_serving_robustness_bench(chaos: bool) -> int:
     """Overload + chaos serving benchmark (one JSON line; see module
     docstring). The submission burst is 64 requests against 8 slots and
@@ -588,6 +1031,8 @@ def run_serving_robustness_bench(chaos: bool) -> int:
 
 def main() -> int:
     if "--serving" in sys.argv:
+        if "--fleet" in sys.argv:
+            return run_serving_fleet_bench()
         if "--overload" in sys.argv or "--chaos" in sys.argv:
             return run_serving_robustness_bench(
                 chaos="--chaos" in sys.argv)

@@ -74,6 +74,41 @@ def pytest_runtest_teardown(item, nextitem):
             faulthandler.cancel_dump_traceback_later()
 
 
+# ------------------------------------------------------------- env flakes
+# @pytest.mark.env_flaky — ONE automatic rerun on failure. Reserved for
+# tests whose failures are a known ENVIRONMENT flake, identical on an
+# unmodified checkout (the container's jax CPU gloo-collective
+# availability comes and goes across the day — ROADMAP "known flakes");
+# a genuine regression still fails both attempts and reports normally.
+# Only the final attempt's reports are logged, so pass counts stay
+# honest (one dot per test either way).
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_runtest_protocol(item, nextitem):
+    if item.get_closest_marker("env_flaky") is None:
+        return None
+    from _pytest.runner import runtestprotocol
+
+    item.ihook.pytest_runtest_logstart(nodeid=item.nodeid,
+                                       location=item.location)
+    reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    if any(r.failed for r in reports):
+        print(f"\n[env_flaky] {item.nodeid} failed; rerunning once "
+              "(known environment flake)", flush=True)
+        # drop the first attempt's (already-finalized) fixture instances
+        # so the rerun gets FRESH setup — _fillfixtures skips argnames
+        # already present in item.funcargs, which would otherwise hand
+        # the retry stale tmp dirs (pytest-rerunfailures does the same)
+        if hasattr(item, "_initrequest"):
+            item._initrequest()
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    for report in reports:
+        item.ihook.pytest_runtest_logreport(report=report)
+    item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
+                                        location=item.location)
+    return True
+
+
 @pytest.fixture
 def tmp_job_dirs(tmp_path):
     """Staging + history dirs for orchestration tests."""

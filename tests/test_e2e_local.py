@@ -873,10 +873,16 @@ def test_e2e_kill_during_await_ready_releases_slice(
         "kill during await-READY leaked the created slice"
 
 
+@pytest.mark.env_flaky
 def test_real_jax_distributed_collective(tmp_job_dirs, fixture_script):
     """2-worker job where the user processes actually join jax.distributed
     via the coordinator address the runtime emitted, and run a psum. This is
-    the end-to-end proof the bootstrap contract works (SURVEY.md §7 step 6)."""
+    the end-to-end proof the bootstrap contract works (SURVEY.md §7 step 6).
+
+    env_flaky: the container's jax CPU (gloo) collective availability
+    comes and goes across the day — identically on an unmodified
+    checkout (ROADMAP "known flakes") — so the harness reruns a failure
+    once before reporting it."""
     import tony_tpu
 
     repo_root = str(Path(tony_tpu.__file__).resolve().parent.parent)

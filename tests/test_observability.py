@@ -514,12 +514,12 @@ def test_metrics_names_rendered_and_documented():
     """Drift lint over the metric-name vocabulary: (a) every name
     constant in tony_tpu/metrics.py is documented in
     docs/observability.md; (b) every Prometheus-family constant
-    (serving_*/driver_*) is referenced by a renderer
-    (cli/serve.py, driver.py, portal/server.py); (c) every
-    serving_/driver_/portal_ family the doc names maps back to something
-    the code actually renders. A new constant nobody renders, a renderer
-    series nobody documents, or a doc entry for a deleted series all
-    fail here."""
+    (serving_*/driver_*/router_*) is referenced by a renderer
+    (cli/serve.py, driver.py, portal/server.py, router.py); (c) every
+    serving_/driver_/portal_/router_ family the doc names maps back to
+    something the code actually renders. A new constant nobody renders,
+    a renderer series nobody documents, or a doc entry for a deleted
+    series all fail here."""
     import inspect
     from pathlib import Path
 
@@ -527,6 +527,7 @@ def test_metrics_names_rendered_and_documented():
     import tony_tpu.driver as driver_mod
     import tony_tpu.observability as obs
     import tony_tpu.portal.server as portal_mod
+    import tony_tpu.router as router_mod
 
     consts = {name: val for name, val in vars(_metrics).items()
               if name.isupper() and isinstance(val, str)}
@@ -540,16 +541,16 @@ def test_metrics_names_rendered_and_documented():
         f"(backticked): {undocumented}")
 
     sources = "".join(inspect.getsource(mod) for mod in
-                      (serve_mod, driver_mod, portal_mod))
+                      (serve_mod, driver_mod, portal_mod, router_mod))
     unrendered = sorted(
         f"{name} ({val})" for name, val in consts.items()
-        if val.startswith(("serving_", "driver_"))
+        if val.startswith(("serving_", "driver_", "router_"))
         and name not in sources and f'"{val}"' not in sources)
     assert not unrendered, f"constants no renderer references: {unrendered}"
 
     rendered = set(consts.values())
     rendered |= set(re.findall(
-        r'"((?:serving|driver|portal)_[a-z0-9_]+)"', sources))
+        r'"((?:serving|driver|portal|router)_[a-z0-9_]+)"', sources))
     rendered |= {"serving_" + n[:-2] + "_seconds"
                  for n in obs.TELEMETRY_HISTOGRAMS}
 
@@ -561,9 +562,9 @@ def test_metrics_names_rendered_and_documented():
 
     # PERF.json section names share the serving_ prefix but are bench
     # artifacts, not exposition families
-    rendered |= {"serving_latency", "serving_robustness"}
+    rendered |= {"serving_latency", "serving_robustness", "serving_fleet"}
     doc_names = set(re.findall(
-        r"`((?:serving|driver|portal)_[a-z0-9_]+)`", doc))
+        r"`((?:serving|driver|portal|router)_[a-z0-9_]+)`", doc))
     phantom = sorted(n for n in doc_names if base(n) not in rendered)
     assert not phantom, (
         f"docs/observability.md names no endpoint renders: {phantom}")
@@ -584,6 +585,27 @@ def test_metrics_names_rendered_and_documented():
                 "driver_xla_compiles_total"):
         assert fam in rendered, f"device/compile family unrendered: {fam}"
         assert fam in doc_names, f"device/compile family undocumented: {fam}"
+
+    # the fleet-router + fleet-replica families are pinned EXPLICITLY
+    # the same way (ISSUE 7 lint discipline): each must be rendered by
+    # an endpoint (router /metrics, driver /metrics) and documented —
+    # renaming either side without the other fails here
+    for fam in (_metrics.ROUTER_REPLICA_UP,
+                _metrics.ROUTER_REPLICAS_LIVE,
+                _metrics.ROUTER_REQUESTS_TOTAL,
+                _metrics.ROUTER_RETRIES_TOTAL,
+                _metrics.ROUTER_SHED_TOTAL,
+                _metrics.ROUTER_FAILED_TOTAL,
+                _metrics.ROUTER_EJECTIONS_TOTAL,
+                _metrics.ROUTER_ROUTING_SECONDS,
+                _metrics.ROUTER_E2E_SECONDS,
+                _metrics.ROUTER_AFFINITY_HITS_TOTAL,
+                _metrics.ROUTER_AFFINITY_REQUESTS_TOTAL,
+                _metrics.ROUTER_AFFINITY_HIT_RATIO,
+                _metrics.DRIVER_TASK_SERVICE_PORT,
+                _metrics.DRIVER_TASK_ROLLS_TOTAL):
+        assert fam in rendered, f"fleet family unrendered: {fam}"
+        assert fam in doc_names, f"fleet family undocumented: {fam}"
 
 
 def test_telemetry_trace_feed_units():

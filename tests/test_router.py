@@ -1,0 +1,699 @@
+"""Fleet router + serving job type (docs/serving.md "Fleet serving").
+
+The contract under test, bottom-up: the FleetRouter's routing policies
+against scriptable stub replicas (least-loaded pick, prefix-affinity
+stickiness + saturation spill, 429 retry honoring Retry-After, ejection
+on failed /healthz and readmission), the driver's publish_ports /
+roll_task RPCs against a scripted provisioner, and — the acceptance
+e2e — a real driver gang-launching two TINY SlotServer replica
+processes, the router completing a burst byte-identical to a solo
+in-process server with one replica hard-killed mid-burst (restart under
+budget + router retry = latency, never a failed request).
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import tony_tpu.constants as c
+from tony_tpu.metrics import (
+    ROUTER_AFFINITY_HIT_RATIO,
+    ROUTER_REPLICA_UP,
+    ROUTER_REPLICAS_LIVE,
+    ROUTER_ROUTING_SECONDS,
+)
+from tony_tpu.router import (
+    DriverDiscovery,
+    FleetRouter,
+    FleetSaturatedError,
+    NoReplicaError,
+    make_handler,
+)
+
+# same golden exposition-line regex as the other metrics suites
+_PROM_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+|"
+    r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^\s]+)$")
+
+
+class StubReplica:
+    """A scriptable fake serve endpoint: /generate, /healthz, /stats.
+    Behavior is mutated by tests between calls (the handler reads the
+    attributes live)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.healthy = True
+        self.queued = 0
+        self.active = 0
+        self.slots = 2
+        self.max_queue = 0
+        self.retry_after = 2
+        self.shed_next = 0          # serve this many 429s first
+        self.fail_next = 0          # ... or this many 500s
+        self.delay_s = 0.0
+        self.received: list[list] = []
+        self._lock = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200 if stub.healthy else 503,
+                               {"healthy": stub.healthy})
+                elif self.path == "/stats":
+                    self._send(200, {
+                        "queued": stub.queued, "active": stub.active,
+                        "slots": stub.slots, "max_queue": stub.max_queue,
+                        "retry_after_s": stub.retry_after})
+                else:
+                    self._send(404, {})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                with stub._lock:
+                    if stub.shed_next > 0:
+                        stub.shed_next -= 1
+                        self._send(429, {"error": "queue full"}, headers={
+                            "Retry-After": str(stub.retry_after)})
+                        return
+                    if stub.fail_next > 0:
+                        stub.fail_next -= 1
+                        self._send(500, {"error": "boom"})
+                        return
+                    stub.received.append(list(payload["prompt"]))
+                if stub.delay_s:
+                    time.sleep(stub.delay_s)
+                self._send(200, {
+                    "id": len(stub.received),
+                    "tokens": [len(payload["prompt"])],
+                    "finish_reason": "length"})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def endpoint(self):
+        return (self.name, "127.0.0.1", self.port)
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def stubs():
+    created = []
+
+    def make(*names):
+        for name in names:
+            created.append(StubReplica(name))
+        return created if len(created) > 1 else created[0]
+
+    yield make
+    for s in created:
+        s.close()
+
+
+def _router(reps, **kw):
+    kw.setdefault("seed", 0)
+    # unit tests drive health_tick() by hand and expect every tick to
+    # pull /stats (production throttles to every 4th — see stats_every)
+    kw.setdefault("stats_every", 1)
+    return FleetRouter([s.endpoint for s in reps], **kw)
+
+
+# --------------------------------------------------------------------------
+# routing policies against stubs
+# --------------------------------------------------------------------------
+
+def test_least_loaded_pick(stubs):
+    """Un-keyed requests (no full prefix block) go to the replica with
+    the smallest queued+active load from its /stats."""
+    a, b = stubs("a", "b")
+    a.queued, b.queued = 5, 0
+    router = _router([a, b], prefill_chunk=8)
+    router.health_tick()            # pull /stats
+    for _ in range(3):
+        router.generate([1, 2, 3], max_new_tokens=1, timeout_s=5)
+    assert len(b.received) == 3 and not a.received
+    a.queued, b.queued = 0, 5
+    router.health_tick()
+    router.generate([4, 5], max_new_tokens=1, timeout_s=5)
+    assert len(a.received) == 1
+    st = router.stats()
+    assert st["requests"] == 4 and st["failed"] == 0
+    assert st["affinity"]["requests"] == 0      # nothing keyed
+
+
+def test_affinity_stickiness_and_spill(stubs):
+    """Requests sharing chunk-aligned prompt blocks stick to ONE replica
+    (whatever their suffixes); when the sticky replica sheds, the
+    request spills to the rendezvous second choice and counts a retry."""
+    a, b = stubs("a", "b")
+    router = _router([a, b], prefill_chunk=4)
+    template = [7, 1, 7, 2]                     # one full chunk
+    for suffix in ([9], [10], [11, 12], []):
+        router.generate(template + suffix, max_new_tokens=1, timeout_s=5)
+    sticky, other = (a, b) if a.received else (b, a)
+    assert len(sticky.received) == 4 and not other.received
+    assert router.stats()["affinity"]["hit_ratio"] == 1.0
+
+    # a different template may land elsewhere, but is itself sticky
+    other_template = [5, 5, 5, 5, 5, 5, 5, 5]
+    first = router.generate(other_template, max_new_tokens=1,
+                            timeout_s=5)["replica"]
+    again = router.generate(other_template + [1], max_new_tokens=1,
+                            timeout_s=5)["replica"]
+    assert first == again
+
+    # saturation spill: the sticky replica sheds once -> the SAME
+    # request completes on the other replica, immediately (no sleep:
+    # only the sticky replica is backpressuring)
+    sticky.shed_next = 1
+    t0 = time.monotonic()
+    resp = router.generate(template + [42], max_new_tokens=1, timeout_s=5)
+    assert time.monotonic() - t0 < 1.0
+    assert resp["replica"] == other.name and resp["retries"] == 1
+    assert other.received[-1] == template + [42]
+    st = router.stats()["replicas"]
+    assert st[sticky.name]["shed"] == 1
+    assert st[other.name]["retries"] == 1
+    # the spilled request dents the affinity hit ratio
+    assert router.stats()["affinity"]["hit_ratio"] < 1.0
+
+
+def test_429_retry_honors_retry_after(stubs):
+    """When EVERY live replica sheds, the router sleeps a jittered
+    fraction of the smallest Retry-After before re-asking — and gives up
+    with FleetSaturatedError when the deadline lands first."""
+    a, b = stubs("a", "b")
+    a.shed_next = b.shed_next = 1
+    a.retry_after = b.retry_after = 1
+    router = _router([a, b], prefill_chunk=4)
+    t0 = time.monotonic()
+    resp = router.generate([1, 2, 3, 4], max_new_tokens=1, timeout_s=10)
+    wall = time.monotonic() - t0
+    # both replicas shed once, then the jittered wait (>= 0.5 * 1s), then
+    # success on a re-pick
+    assert resp["retries"] == 2
+    assert wall >= 0.5, f"router must honor Retry-After, waited {wall:.2f}s"
+
+    # saturated past the deadline -> an honest shed with the advertised
+    # Retry-After, not a timeout
+    a.shed_next = b.shed_next = 10 ** 6
+    a.retry_after = b.retry_after = 7
+    with pytest.raises(FleetSaturatedError) as e:
+        router.generate([1, 2, 3, 4], max_new_tokens=1, timeout_s=0.5)
+    assert e.value.retry_after_s == 7
+    assert router.stats()["shed"] == 1
+
+
+def test_transport_error_ejects_and_retries(stubs):
+    """A dead endpoint (nothing listening) is ejected on first contact
+    and the request completes elsewhere — zero caller-visible failures."""
+    b = stubs("b")
+    dead = ("a", "127.0.0.1", 1)        # port 1: connection refused
+    router = FleetRouter([dead, b.endpoint], prefill_chunk=4, seed=0)
+    # un-keyed prompt -> least-loaded order, name tie-break: "a" first
+    resp = router.generate([1, 2, 3], max_new_tokens=1, timeout_s=10)
+    assert resp["replica"] == "b"
+    st = router.stats()
+    assert st["replicas"]["a"]["up"] is False
+    assert st["replicas"]["a"]["ejections"] == 1
+    assert st["failed"] == 0
+
+
+def test_ejection_on_healthz_and_readmission(stubs):
+    """The health loop ejects a replica after eject_after consecutive
+    failed /healthz probes and readmits it on the first success."""
+    a, b = stubs("a", "b")
+    router = _router([a, b], prefill_chunk=4, eject_after=2)
+    a.healthy = False
+    router.health_tick()
+    assert router.stats()["replicas"]["a"]["up"] is True    # one strike
+    router.health_tick()
+    st = router.stats()
+    assert st["replicas"]["a"]["up"] is False and st["live"] == 1
+    # keyed traffic for the ejected replica's templates flows to b
+    for suffix in range(4):
+        router.generate([3, 1, 4, 1, suffix], max_new_tokens=1, timeout_s=5)
+    assert len(b.received) == 4 and not a.received
+    a.healthy = True
+    router.health_tick()
+    assert router.stats()["replicas"]["a"]["up"] is True
+    assert router.stats()["live"] == 2
+
+
+def test_no_live_replica_raises(stubs):
+    a = stubs("a")
+    a.healthy = False
+    router = _router([a], prefill_chunk=4, eject_after=1)
+    router.health_tick()
+    with pytest.raises(NoReplicaError):
+        router.generate([1, 2, 3, 4], max_new_tokens=1, timeout_s=0.6)
+
+
+def test_router_metrics_exposition(stubs):
+    """GET /metrics parses as Prometheus text and carries the router_*
+    families with per-replica labels."""
+    a, b = stubs("a", "b")
+    router = _router([a, b], prefill_chunk=4)
+    router.health_tick()
+    for i in range(3):
+        router.generate([1, 2, 3, 4, i], max_new_tokens=1, timeout_s=5)
+    text = router.prometheus_metrics()
+    for line in text.strip().splitlines():
+        assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
+    assert f'{ROUTER_REPLICA_UP}{{replica="a"}} 1' in text
+    assert f'{ROUTER_REPLICA_UP}{{replica="b"}} 1' in text
+    assert f"{ROUTER_REPLICAS_LIVE} 2" in text
+    assert f"{ROUTER_AFFINITY_HIT_RATIO} 1" in text
+    assert f"{ROUTER_ROUTING_SECONDS}_count 3" in text
+    assert 'router_requests_total{replica=' in text
+
+
+def test_router_http_front_door(stubs):
+    """The route CLI's HTTP surface: /generate proxies, fleet-wide 429
+    maps with Retry-After, /healthz, /stats and /metrics serve."""
+    a, b = stubs("a", "b")
+    router = _router([a, b], prefill_chunk=4)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(router))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        def post(payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read().decode())
+
+        status, resp = post({"prompt": [1, 2, 3, 4], "max_new_tokens": 1})
+        assert status == 200 and resp["finish_reason"] == "length"
+        assert resp["replica"] in ("a", "b")
+
+        status, _ = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).status, None
+        assert status == 200
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=10) as r:
+            assert json.loads(r.read().decode())["live"] == 2
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert ROUTER_REPLICAS_LIVE in r.read().decode()
+
+        # malformed payload -> 400, fleet saturated -> 429 + Retry-After
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({"max_new_tokens": 1})
+        assert e.value.code == 400
+        a.shed_next = b.shed_next = 10 ** 6
+        a.retry_after = b.retry_after = 3
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({"prompt": [1, 2, 3, 4], "timeout_s": 0.4})
+        assert e.value.code == 429
+        assert e.value.headers["Retry-After"] == "3"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# --------------------------------------------------------------------------
+# driver side: publish_ports + roll_task against a scripted provisioner
+# --------------------------------------------------------------------------
+
+def test_publish_ports_and_budget_free_roll(tmp_job_dirs, tmp_path):
+    """The port-advertisement + rolling-restart contract end to end
+    against stub executors: a replica publishes named ports (they land
+    on get_task_infos, the cluster-spec payload, and the driver
+    /metrics), roll_task SIGTERM-drains and relaunches WITHOUT spending
+    the restart budget, the relaunch clears the stale ports until the
+    new attempt re-publishes, and the executor key cannot roll its
+    peers."""
+    from tony_tpu.api import JobStatus
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.driver import Driver
+    from tony_tpu.events.trace import TASK_TRACE_FILE, read_traces
+    from tony_tpu.rpc import RpcClient
+    from tony_tpu.rpc.protocol import RpcError, derive_role_key
+    from tests.test_task_trace import ScriptedProvisioner, _rpc_for
+
+    stop_events: dict[str, threading.Event] = {}
+    finish = threading.Event()
+    acl: dict = {}
+
+    class RollableProvisioner(ScriptedProvisioner):
+        def stop_container(self, handle):
+            ev = stop_events.get(handle.container_id)
+            if ev is not None:
+                ev.set()
+
+    def script(spec, index, env, handle, attempt):
+        stop_events[handle.container_id] = stopped = threading.Event()
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        payload = rpc.call("register_worker", task_id=task_id,
+                           host="127.0.0.1", port=24000 + attempt)
+        assert payload is not None      # serving runtime: no gang barrier
+        rpc.call("publish_ports", task_id=task_id,
+                 ports={"serve_port": 25000 + attempt,
+                        "metrics_port": 25000 + attempt})
+        # published ports ride the cluster-spec payload
+        spec_payload = rpc.call("get_cluster_spec", task_id=task_id)
+        assert spec_payload["service_ports"][task_id]["serve_port"] == (
+            25000 + attempt)
+        if attempt == 0:
+            try:        # the executor key must not be able to roll peers
+                rpc.call("roll_task", task_id=task_id)
+                acl["roll"] = "allowed"
+            except RpcError as e:
+                acl["roll"] = str(e)
+        # beat until the roll stops this attempt / the test finishes
+        while not (stopped.is_set() or (attempt > 0 and finish.is_set())):
+            rpc.call("heartbeat", task_id=task_id)
+            time.sleep(0.05)
+        rpc.call("register_execution_result", task_id=task_id, exit_code=0)
+        rpc.close()
+        return 0
+
+    conf = TonyConf({
+        "tony.staging.dir": tmp_job_dirs["staging"],
+        "tony.history.location": tmp_job_dirs["history"],
+        "tony.history.intermediate": tmp_job_dirs["history"] + "/intermediate",
+        "tony.history.finished": tmp_job_dirs["history"] + "/finished",
+        "tony.am.monitor-interval-ms": 50,
+        "tony.application.framework": "serving",
+        "tony.replica.instances": 1,
+        "tony.replica.command": "stub",
+        "tony.replica.max-restarts": 0,     # a roll must not need budget
+        "tony.task.heartbeat-interval-ms": 100,
+    })
+    job_dir = tmp_path / "job"
+    job_dir.mkdir()
+    conf.write_final(job_dir)
+    driver = Driver(conf, app_id="roll_test", job_dir=str(job_dir),
+                    token="roll-secret",
+                    provisioner=RollableProvisioner(script))
+    driver.client_signal.set()
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    cl = None
+    try:
+        deadline = time.time() + 20
+        while (driver.session.service_ports().get("replica:0", {}).get(
+                "serve_port") != 25000 and time.time() < deadline):
+            time.sleep(0.02)
+        assert driver.session.service_ports() == {
+            "replica:0": {"serve_port": 25000, "metrics_port": 25000}}
+        infos = {i["name"]: i for i in
+                 [t.to_dict() for t in driver.session.task_infos()]}
+        assert infos["replica"]["ports"]["serve_port"] == 25000
+        text = driver.render_metrics()
+        assert ('driver_task_service_port{task="replica:0",'
+                'name="serve_port"} 25000') in text
+        assert "driver_task_rolls_total 0" in text
+
+        cl = RpcClient("127.0.0.1", driver.rpc_server.port,
+                       token=derive_role_key("roll-secret", "client"),
+                       role="client")
+        assert cl.call("roll_task", task_id="replica:9") is False
+        with pytest.raises(RpcError):   # bad port range is rejected
+            cl.call("publish_ports", task_id="replica:0",
+                    ports={"serve_port": -4})
+        assert cl.call("roll_task", task_id="replica:0") is True
+        deadline = time.time() + 20
+        while (driver.session.service_ports().get("replica:0", {}).get(
+                "serve_port") != 25001 and time.time() < deadline):
+            time.sleep(0.02)
+        # attempt 1 is up with fresh ports; the roll spent no budget
+        assert driver.session.service_ports()["replica:0"][
+            "serve_port"] == 25001
+        assert driver.provisioner.launches == ["replica:0"] * 2
+        text = driver.render_metrics()
+        assert "driver_task_rolls_total 1" in text
+        assert "driver_task_restarts_total 0" in text
+    finally:
+        finish.set()
+        if cl is not None:
+            cl.close()
+    t.join(timeout=30)
+    assert not t.is_alive(), "driver did not finish"
+    assert driver.session.status == JobStatus.SUCCEEDED, (
+        driver.session.failure_message)
+    assert "authorization" in acl["roll"], acl
+    from pathlib import Path
+
+    recs = read_traces(Path(tmp_job_dirs["history"]) / "intermediate"
+                       / "roll_test" / TASK_TRACE_FILE)
+    assert len(recs) == 1
+    names = [n for n, _ in recs[0]["spans"]]
+    assert names.count("rolled") == 1 and "restarted" not in names
+    assert names.count("registered") == 2       # both attempts in one trace
+    assert names[-1] == "finished"
+    assert recs[0]["attrs"]["restarts"] == 0
+    assert recs[0]["attrs"]["ports"]["serve_port"] == 25001
+
+
+def test_discovery_sync_moves_and_drops_replicas(stubs):
+    """sync_replicas: a restarted replica re-points under its task_id, a
+    vanished one leaves rotation, a new one joins."""
+    a, b = stubs("a", "b")
+    router = FleetRouter(
+        [], prefill_chunk=4, seed=0,
+        discover=lambda: [("replica:0", "127.0.0.1", a.port)])
+    router.health_tick()
+    assert router.stats()["replicas"]["replica:0"]["endpoint"].endswith(
+        str(a.port))
+    # the task restarts at a new port; same identity, new endpoint
+    router.discover = lambda: [("replica:0", "127.0.0.1", b.port)]
+    router.health_tick()
+    st = router.stats()["replicas"]
+    assert list(st) == ["replica:0"]
+    assert st["replica:0"]["endpoint"].endswith(str(b.port))
+    router.generate([1, 2, 3, 4], max_new_tokens=1, timeout_s=5)
+    assert len(b.received) == 1 and not a.received
+    # mid-restart the driver clears ports: the replica drops out
+    router.discover = lambda: []
+    router.health_tick()
+    assert router.stats()["replicas"] == {}
+
+
+# --------------------------------------------------------------------------
+# acceptance e2e: real fleet, byte-identical burst, mid-burst replica kill
+# --------------------------------------------------------------------------
+
+# one TINY shape shared by the replica serve processes (CLI flags) and
+# the in-process solo reference server
+_E2E = dict(vocab=64, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+            slots=2, max_len=64, block_size=4, prefill_chunk=8)
+
+
+def test_fleet_e2e_kill_midburst_zero_failures(tmp_job_dirs, tmp_path):
+    """The fleet acceptance contract: the driver gang-launches 2 TINY
+    SlotServer replicas (serving job type — real serve processes found
+    via publish_ports + driver discovery), the router completes a paced
+    burst with results byte-identical to a solo in-process server, one
+    replica is SIGKILLed mid-burst, and the combination of router retry
+    + budgeted driver restart turns the kill into latency: zero failed
+    requests, the replica returns at a new port, and the fleet is whole
+    again."""
+    import os
+    import signal
+    import sys
+
+    import jax
+    import numpy as np
+
+    from tony_tpu.cluster.provisioner import LocalProvisioner
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.driver import Driver
+    from tony_tpu.models import transformer
+    from tony_tpu.models.serving import Request, SlotServer
+
+    e = _E2E
+    serve_cmd = (
+        f"{sys.executable} -m tony_tpu.cli.main serve "
+        "--port $TONY_SERVE_PORT --host 127.0.0.1 "
+        f"--vocab {e['vocab']} --d-model {e['d_model']} "
+        f"--n-layers {e['n_layers']} --n-heads {e['n_heads']} "
+        f"--d-ff {e['d_ff']} --dtype float32 --seed 0 "
+        f"--slots {e['slots']} --max-len {e['max_len']} "
+        f"--block-size {e['block_size']} "
+        f"--prefill-chunk {e['prefill_chunk']} "
+        "--max-queue 32 --drain-timeout-s 2")
+    import tests.conftest as _conftest
+
+    conf = TonyConf({
+        "tony.staging.dir": tmp_job_dirs["staging"],
+        "tony.history.location": tmp_job_dirs["history"],
+        "tony.history.intermediate": tmp_job_dirs["history"] + "/intermediate",
+        "tony.history.finished": tmp_job_dirs["history"] + "/finished",
+        "tony.am.monitor-interval-ms": 100,
+        "tony.application.framework": "serving",
+        "tony.replica.instances": 2,
+        "tony.replica.command": serve_cmd,
+        "tony.replica.max-restarts": 1,     # the kill spends exactly one
+        "tony.serving.healthz-interval-ms": 200,
+        "tony.task.heartbeat-interval-ms": 250,
+        # children must find the package and stay on CPU regardless of
+        # how pytest was invoked
+        "tony.execution.env": [
+            f"PYTHONPATH={_conftest.REPO_ROOT}", "JAX_PLATFORMS=cpu"],
+    })
+    job_dir = tmp_path / "job"
+    job_dir.mkdir()
+    conf.write_final(job_dir)
+    driver = Driver(conf, app_id="fleet_e2e", job_dir=str(job_dir),
+                    token="fleet-secret", provisioner=LocalProvisioner())
+    driver.client_signal.set()
+    driver_thread = threading.Thread(target=driver.run, daemon=True)
+    driver_thread.start()
+
+    discovery = DriverDiscovery(str(job_dir), role="replica",
+                                token="fleet-secret")
+    router = FleetRouter([], prefill_chunk=e["prefill_chunk"],
+                         discover=discovery, health_interval_s=0.3,
+                         eject_after=1, seed=0)
+
+    # the reference results: a solo in-process server over the SAME
+    # params (seed-0 random init, greedy) serving the same prompts
+    cfg = transformer.TransformerConfig(
+        vocab_size=e["vocab"], d_model=e["d_model"],
+        n_layers=e["n_layers"], n_heads=e["n_heads"],
+        n_kv_heads=e["n_heads"], d_ff=e["d_ff"], dtype=jax.numpy.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(11)
+    chunk = e["prefill_chunk"]
+    templates = [rng.integers(0, e["vocab"], size=chunk, dtype=np.int32),
+                 rng.integers(0, e["vocab"], size=2 * chunk,
+                              dtype=np.int32)]
+    prompts = [
+        np.concatenate([templates[i % 2],
+                        rng.integers(0, e["vocab"], size=1 + i % 3,
+                                     dtype=np.int32)]).tolist()
+        for i in range(10)
+    ]
+    max_new = 4
+    solo = SlotServer(params, cfg, slots=e["slots"], max_len=e["max_len"],
+                      block_size=e["block_size"], prefill_chunk=chunk,
+                      temperature=0.0, seed=0)
+    reqs = [Request(prompt=np.asarray(p, dtype=np.int32),
+                    max_new_tokens=max_new) for p in prompts]
+    for r in reqs:
+        solo.submit(r)
+    done = solo.run_until_drained()
+    expected = {i: done[r.id].tokens for i, r in enumerate(reqs)}
+    solo.shutdown()
+
+    results: dict[int, object] = {}
+    killed: dict = {}
+    try:
+        # both replicas serving (ports published after first healthy
+        # /healthz) — generous deadline: two jax imports + tiny compiles
+        # on a 2-core host
+        deadline = time.time() + 150
+        while time.time() < deadline:
+            router.health_tick()
+            if router.stats()["live"] == 2:
+                break
+            time.sleep(0.3)
+        assert router.stats()["live"] == 2, (
+            f"fleet never came up: {router.stats()}")
+        router.start()
+
+        def call(i):
+            try:
+                results[i] = router.generate(
+                    prompts[i], max_new_tokens=max_new, timeout_s=120)
+            except Exception as exc:    # pragma: no cover - the failure
+                results[i] = exc        # the assertion below reports
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(prompts))]
+        # two-phase burst so the kill deterministically lands MID-burst:
+        # phase 1 proves the fleet serves, then one replica dies, then
+        # the rest of the burst arrives against the degraded fleet
+        for t in threads[:5]:
+            t.start()
+            time.sleep(0.05)
+        deadline = time.time() + 120
+        while (sum(isinstance(r, dict) for r in results.values()) < 3
+               and time.time() < deadline):
+            time.sleep(0.1)
+        first = next((r for r in results.values() if isinstance(r, dict)),
+                     None)
+        assert first is not None, f"phase 1 never completed: {results}"
+        # hard-kill the replica that served the first completion
+        victim = first["replica"]
+        ep = router.stats()["replicas"][victim]["endpoint"]
+        with urllib.request.urlopen(f"http://{ep}/stats",
+                                    timeout=10) as resp:
+            pid = json.loads(resp.read().decode())["pid"]
+        os.kill(pid, signal.SIGKILL)
+        killed["task"] = victim
+        for t in threads[5:]:
+            t.start()
+            time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=150)
+        assert not any(t.is_alive() for t in threads), "a waiter hung"
+
+        # ZERO failed requests: every result is a completion...
+        failures = {i: r for i, r in results.items()
+                    if not isinstance(r, dict)}
+        assert not failures, f"requests failed across the kill: {failures}"
+        # ... and every completion is byte-identical to the solo server
+        for i, r in sorted(results.items()):
+            assert r["tokens"] == expected[i], (
+                f"request {i} diverged: {r['tokens']} vs {expected[i]} "
+                f"(served by {r['replica']})")
+
+        # the kill cost the router visible work (a retry or an ejection)
+        st = router.stats()
+        assert (sum(rep["errors"] + rep["retries"]
+                    for rep in st["replicas"].values()) >= 1), st
+
+        # ... and the driver a budgeted restart; the replica comes back
+        # at a NEW port and the fleet is whole again
+        deadline = time.time() + 150
+        while time.time() < deadline:
+            st = router.stats()
+            if st["live"] == 2 and killed["task"] in st["replicas"]:
+                break
+            time.sleep(0.5)
+        assert router.stats()["live"] == 2, (
+            f"killed replica never rejoined: {router.stats()}")
+        assert "driver_task_restarts_total 1" in driver.render_metrics()
+        # the restarted replica serves its template again
+        tail = router.generate(prompts[0], max_new_tokens=max_new,
+                               timeout_s=120)
+        assert tail["tokens"] == expected[0]
+    finally:
+        router.shutdown()
+        discovery.close()
+        driver.session.kill_all("test complete")
+        driver_thread.join(timeout=60)
+    assert not driver_thread.is_alive(), "driver did not stop"

@@ -60,6 +60,9 @@ class TaskInfo:
     port: int = -1
     url: str = ""        # log/monitor URL
     exit_code: int | None = None
+    # named service ports the task published (publish_ports RPC), e.g. a
+    # serving replica's {"serve_port": N, "metrics_port": N}
+    ports: dict[str, int] = field(default_factory=dict)
 
     @property
     def task_id(self) -> str:

@@ -167,17 +167,25 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=19886)
     p.set_defaults(fn=cmd_history)
 
-    # `serve` owns a rich argparser of its own (model source + slot-pool
-    # knobs, cli/serve.py); hand the remaining argv through untouched
+    # `serve`/`route` own rich argparsers of their own (cli/serve.py,
+    # router.py); hand the remaining argv through untouched
     sub.add_parser(
         "serve", add_help=False,
         help="serve a model over HTTP with continuous batching",
+    )
+    sub.add_parser(
+        "route", add_help=False,
+        help="front a serving fleet with the prefix-aware router",
     )
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
         from . import serve as serve_mod
 
         return serve_mod.main(argv[1:])
+    if argv and argv[0] == "route":
+        from .. import router as router_mod
+
+        return router_mod.main(argv[1:])
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -736,6 +736,11 @@ class ServeApp:
                 "failures": self.loop_failures,
                 "max_restarts": self.max_loop_restarts,
             }
+            # which process answers here — fleet tooling (and the kill-a-
+            # replica e2e) needs to map an endpoint back to its process
+            import os as _os
+
+            out["pid"] = _os.getpid()
             out["metrics"] = self.metrics.snapshot()
             # XLA compile telemetry: compiles/compile_time_s/
             # recompiles_post_warm — /stats mirror of the

@@ -136,6 +136,19 @@ TPU_NOT_FOUND_PATTERN = "tony.tpu.not-found-pattern"
 # lists can look stable briefly; more polls = stronger evidence)
 TPU_READY_STABLE_POLLS = "tony.tpu.ready-stable-polls"
 
+# ------------------------------------------------------------------ serving
+# serving job type (tony.application.framework = serving): the executor-side
+# adapter watches each replica child's /healthz and converts a terminally
+# down serving loop into a container failure the driver's restart budget
+# handles (runtimes/serving.py)
+SERVING_HEALTHZ_INTERVAL_MS = "tony.serving.healthz-interval-ms"
+# consecutive bad post-ready polls (503 down / unreachable) before the
+# adapter kills the child and exits nonzero
+SERVING_HEALTHZ_DOWN_POLLS = "tony.serving.healthz-down-polls"
+# how long a replica gets from spawn to its first healthy /healthz before
+# the adapter gives up (model load + first compile can dominate)
+SERVING_READY_TIMEOUT_MS = "tony.serving.ready-timeout-ms"
+
 # ------------------------------------------------------------------ horovod
 HOROVOD_TEST_MODE = "tony.horovod.mode.test"              # stub rendezvous server
 HOROVOD_FAST_FAIL = "tony.horovod.driver.fast-fail"       # driver exits 1 at once
@@ -163,7 +176,7 @@ ROLE_KEY_TEMPLATES = (
 _ROLE_KEY_RE = re.compile(r"^tony\.([A-Za-z][A-Za-z0-9_\-]*)\.instances$")
 _RESERVED_NON_ROLES = frozenset(
     {"application", "am", "task", "staging", "history", "cluster", "tpu",
-     "security", "execution", "horovod", "version"}
+     "security", "execution", "horovod", "version", "serving", "router"}
 )
 
 

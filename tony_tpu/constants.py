@@ -36,6 +36,11 @@ ENV_STEP_LOG = "TONY_STEP_LOG"    # where the training child's StepTimer should
                                   # write its JSONL; the executor's TaskMonitor
                                   # samples it so per-worker step-time quantiles
                                   # ride the metrics push to the driver
+ENV_SERVE_PORT = "TONY_SERVE_PORT"  # serving job type (runtimes/serving.py):
+                                  # the HTTP port a SlotServer replica child
+                                  # must bind (= the task's registered port);
+                                  # the adapter advertises it as serve_port/
+                                  # metrics_port via the publish_ports RPC
 
 # JAX runtime contract (replaces TF_CONFIG/Gloo/DMLC matrix — SURVEY.md §5):
 ENV_COORDINATOR_ADDRESS = "TONY_COORDINATOR_ADDRESS"

@@ -45,6 +45,8 @@ from .metrics import (
     DRIVER_STRAGGLER_REGISTRATION_S,
     DRIVER_TASK_METRIC,
     DRIVER_TASK_RESTARTS_TOTAL,
+    DRIVER_TASK_ROLLS_TOTAL,
+    DRIVER_TASK_SERVICE_PORT,
     DRIVER_TASKS,
 )
 from .observability import PROM_CONTENT_TYPE, Histogram, PromRenderer, TaskTrace
@@ -128,6 +130,25 @@ class DriverService:
     def register_callback_info(self, task_id: str, payload: dict[str, Any]) -> bool:
         self._d.runtime_driver.receive_callback_info(task_id, payload)
         return True
+
+    def publish_ports(self, task_id: str, ports: dict[str, int]) -> bool:
+        """A task advertises named service ports (``serve_port``,
+        ``metrics_port``, ...) — the generalization of the reference's
+        TF_CONFIG endpoint plumbing. They land on the task's Session
+        entry, ride the cluster-spec payload (``service_ports``),
+        surface on get_task_infos for clients/routers, and render as
+        ``driver_task_service_port`` gauges on the driver /metrics."""
+        return self._d.publish_task_ports(task_id, ports)
+
+    def roll_task(self, task_id: str) -> bool:
+        """Rolling restart of one RUNNING task (client-privileged when
+        token auth is on): SIGTERM the container — a serving replica
+        drains in-flight requests on it — and relaunch WITHOUT spending
+        the task's restart budget (a deliberate roll is an operator
+        action, not a failure). The serving fleet's weight-update
+        procedure: roll replicas one at a time behind the router (docs/
+        serving.md "Fleet serving")."""
+        return self._d.roll_task(task_id)
 
     def register_tensorboard_url(self, url: str) -> bool:
         self._d.tensorboard_url = url
@@ -242,11 +263,12 @@ class Driver:
                 "client": derive_role_key(token, "client"),
                 "executor": self.executor_token,
             }
-            # profile commands are operator actions, like ending the
-            # job: an executor key must not be able to aim the profiler
-            # at its peers
+            # profile/roll commands are operator actions, like ending
+            # the job: an executor key must not be able to aim the
+            # profiler at — or restart — its peers
             acl = {"finish_application": {"client"},
-                   "request_task_profile": {"client"}}
+                   "request_task_profile": {"client"},
+                   "roll_task": {"client"}}
         self.rpc_server = RpcServer(
             host=str(conf.get(keys.AM_RPC_HOST, "127.0.0.1")), token=token,
             roles=roles, acl=acl,
@@ -289,6 +311,11 @@ class Driver:
         # /profile route), drained one-shot by the task's next heartbeat
         self._profile_cmds: dict[str, dict] = {}
         self._profile_lock = threading.Lock()
+        # tasks mid-roll (roll_task RPC): their next container completion
+        # relaunches WITHOUT charging the restart budget. One completion
+        # per container, so plain set semantics suffice.
+        self._rolls: set[str] = set()
+        self._roll_count = 0
         # compile visibility for code running IN the driver process
         # (enable-preprocess / notebook jobs): the driver's /metrics
         # carries its own compile histogram next to the compile totals
@@ -747,6 +774,9 @@ class Driver:
                       self._hb_expired_count,
                       "tasks deemed dead after missing the heartbeat "
                       "budget")
+            r.counter(DRIVER_TASK_ROLLS_TOTAL, self._roll_count,
+                      "deliberate rolling restarts (roll_task RPC; "
+                      "budget-free)")
             reg = dict(self._reg_t)
         # driver-process XLA compile telemetry (preprocess/notebook jobs
         # run user code in-process); each training CHILD's compile totals
@@ -769,6 +799,12 @@ class Driver:
         for status in sorted(counts):
             r.gauge(DRIVER_TASKS, counts[status], "tasks by state",
                     labels={"state": status})
+        for task_id, ports in sorted(self.session.service_ports().items()):
+            for pname, port in sorted(ports.items()):
+                r.gauge(DRIVER_TASK_SERVICE_PORT, port,
+                        "named service ports tasks published "
+                        "(publish_ports RPC)",
+                        labels={"task": task_id, "name": pname})
         for role in roles:
             rts = [v for tid, v in reg.items()
                    if tid.partition(":")[0] == role]
@@ -849,13 +885,17 @@ class Driver:
             self.heartbeats.pop(task_id, None)
             return
         if (
-            exit_code != 0
-            and source == "container"
+            source == "container"
             and not task.status.is_terminal()
             and not self._stop_requested.is_set()
-            and self._try_restart_task(task_id, exit_code)
         ):
-            return
+            # a deliberate roll relaunches on ANY exit code (the drained
+            # serve child exits 0, its executor 137) without touching
+            # the budget; failures then fall through to the budgeted path
+            if self._discharge_roll(task_id):
+                return
+            if exit_code != 0 and self._try_restart_task(task_id, exit_code):
+                return
         already_terminal = task.status.is_terminal()
         name, _, idx = task_id.partition(":")
         self.session.on_task_completed(name, int(idx), exit_code)
@@ -886,6 +926,11 @@ class Driver:
         used = self._restarts.get(task_id, 0)
         if used >= spec.max_restarts:
             return False
+        # a FAILURE restart supersedes any pending roll: the wedged/
+        # crashed attempt is being replaced right here, and a stale
+        # ledger entry would mislabel the NEXT crash as a budget-free
+        # 'rolled' relaunch
+        self._rolls.discard(task_id)
         self._restarts[task_id] = used + 1
         log.warning(
             "task %s %s; restarting (%d/%d)",
@@ -900,12 +945,21 @@ class Driver:
         self._clear_attempt_state(task_id)
         self._trace_mark(task_id, "restarted", restarts=used + 1,
                          last_cause=cause or f"exited {exit_code}")
+        self._relaunch_task(task_id, spec, int(idx))
+        return True
+
+    def _relaunch_task(self, task_id: str, spec: RoleSpec, idx: int) -> None:
+        """Launch a fresh attempt of an existing task (restart or roll):
+        new container, fresh liveness, stale published ports dropped."""
         task = self.session.get_task_by_id(task_id)
         task.status = TaskStatus.REQUESTED
         task.exit_code = None  # re-arm heartbeat liveness for the new attempt
+        # the old attempt's published service ports are dead endpoints;
+        # consumers (the fleet router's discovery) must not route to them
+        task.ports.clear()
         self._trace_mark(task_id, "requested")
-        env = self._task_env(spec, int(idx))
-        handle = self.provisioner.launch(spec, int(idx), env, self.job_dir / "logs")
+        env = self._task_env(spec, idx)
+        handle = self.provisioner.launch(spec, idx, env, self.job_dir / "logs")
         task.status = TaskStatus.ALLOCATED
         task.container_id = handle.container_id
         self._trace_mark(task_id, "allocated", host=handle.host)
@@ -915,6 +969,67 @@ class Driver:
         self.heartbeats.pop(task_id, None)
         if self.events:
             self.events.emit(task_started(task_id, handle.host))
+
+    # ------------------------------------------------------- serving rolls
+    def publish_task_ports(self, task_id: str, ports: dict) -> bool:
+        """publish_ports RPC body: merge the named ports into the task's
+        session entry and record them on its lifecycle trace."""
+        if not self.session.set_task_ports(task_id, ports):
+            return False
+        with self._tt_lock:
+            tr = self.task_traces.get(task_id)
+            if tr is not None:
+                merged = dict(tr.attrs.get("ports", {}))
+                merged.update({str(k): int(v) for k, v in ports.items()})
+                tr.attrs["ports"] = merged
+        log.info("%s published service ports %s", task_id, dict(ports))
+        return True
+
+    def roll_task(self, task_id: str) -> bool:
+        """Deliberate rolling restart (roll_task RPC): SIGTERM the
+        container so a draining child (serving replica) finishes its
+        in-flight work, then relaunch without spending restart budget.
+        False for unknown / not-yet-running / terminal tasks.
+
+        Drain continuity relies on the EXECUTOR exiting promptly on
+        SIGTERM (it does — sys.exit in its handler): the provisioner
+        escalates to a group SIGKILL only if the executor lingers past
+        its stop wait, and THAT would take the draining serve child
+        with it. The orphaned child keeps draining up to its own
+        --drain-timeout-s either way."""
+        task = self.session.get_task_by_id(task_id)
+        if task is None or task.status != TaskStatus.RUNNING:
+            return False
+        with self._restart_lock:
+            handle = self._handles.get(task_id)
+            if handle is None:
+                return False
+            self._rolls.add(task_id)
+        log.info("rolling %s (SIGTERM drain, budget-free relaunch)", task_id)
+        # the stop can wait several seconds on a slow drain; do it off the
+        # RPC thread so the caller gets its ack immediately
+        threading.Thread(target=self.provisioner.stop_container,
+                         args=(handle,), name=f"roll-{task_id}",
+                         daemon=True).start()
+        return True
+
+    def _discharge_roll(self, task_id: str) -> bool:
+        """Container completion of a task mid-roll: relaunch without
+        charging the budget; the trace records a ``rolled`` mark and the
+        fresh attempt chain. Caller holds the restart lock (container-
+        completion path)."""
+        if task_id not in self._rolls:
+            return False
+        self._rolls.discard(task_id)
+        name, _, idx = task_id.partition(":")
+        spec = self.session.role_specs.get(name)
+        if spec is None:
+            return False
+        with self._tt_lock:
+            self._roll_count += 1
+        self._clear_attempt_state(task_id)
+        self._trace_mark(task_id, "rolled")
+        self._relaunch_task(task_id, spec, int(idx))
         return True
 
     # --------------------------------------------------------------- monitor
@@ -1097,6 +1212,7 @@ class Driver:
         self._handles.clear()
         self._launch_ms.clear()
         self._restarts.clear()
+        self._rolls.clear()
         self.metrics.clear()
 
     # ------------------------------------------------------------------ stop

@@ -63,11 +63,29 @@ SERVING_RETRY_AFTER_S = "serving_retry_after_s"
 DRIVER_GANG_LAUNCH_SECONDS = "driver_gang_launch_seconds"
 DRIVER_HEARTBEAT_INTERVAL_SECONDS = "driver_heartbeat_interval_seconds"
 DRIVER_TASK_RESTARTS_TOTAL = "driver_task_restarts_total"
+DRIVER_TASK_ROLLS_TOTAL = "driver_task_rolls_total"
 DRIVER_HEARTBEAT_EXPIRED_TOTAL = "driver_heartbeat_expired_total"
 DRIVER_STRAGGLER_REGISTRATION_S = "driver_straggler_registration_s"
 DRIVER_STRAGGLER_HEARTBEAT_S = "driver_straggler_heartbeat_s"
 DRIVER_TASKS = "driver_tasks"
 DRIVER_TASK_METRIC = "driver_task_metric"
+DRIVER_TASK_SERVICE_PORT = "driver_task_service_port"
+
+# fleet-router exposition families (rendered by tony_tpu/router.py's GET
+# /metrics; same one-contract rule — the metrics-name lint pins these to
+# the router renderer and docs/observability.md, both directions)
+ROUTER_REPLICA_UP = "router_replica_up"
+ROUTER_REPLICAS_LIVE = "router_replicas_live"
+ROUTER_REQUESTS_TOTAL = "router_requests_total"
+ROUTER_RETRIES_TOTAL = "router_retries_total"
+ROUTER_SHED_TOTAL = "router_shed_total"
+ROUTER_FAILED_TOTAL = "router_requests_failed_total"
+ROUTER_EJECTIONS_TOTAL = "router_ejections_total"
+ROUTER_ROUTING_SECONDS = "router_routing_decision_seconds"
+ROUTER_E2E_SECONDS = "router_request_seconds"
+ROUTER_AFFINITY_HITS_TOTAL = "router_affinity_hits_total"
+ROUTER_AFFINITY_REQUESTS_TOTAL = "router_affinity_requests_total"
+ROUTER_AFFINITY_HIT_RATIO = "router_affinity_hit_ratio"
 
 # executor-accumulator metric names (ride update_metrics pushes the same
 # way memory_rss_mb does; surface on the driver /metrics as

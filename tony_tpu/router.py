@@ -1,0 +1,884 @@
+"""Fleet router: the front door of a driver-orchestrated serving fleet.
+
+The reference fronts long-lived services with a dumb TCP proxy
+(tony-proxy/.../ProxyServer.java:27-39); at fleet scale the front door
+has to be smarter, because everything that makes one SlotServer fast is
+LOCAL to a replica: the prefix KV cache (PR 2) only hits if requests
+sharing a template keep landing on the same server, queue depth and
+Retry-After (PR 3/4) describe one engine's backlog, and /healthz
+describes one loop. This module composes those shipped signals into a
+load balancer:
+
+- **Prefix-affinity routing.** The first ``prefill_chunk``-aligned
+  blocks of the prompt hash to a routing key; rendezvous hashing
+  (highest-random-weight over replica NAMES, so a replica restart with
+  a new port keeps its templates and an ejection remaps only its own
+  keys) makes every request of a template sticky to one replica — the
+  replica whose trie actually holds that template's KV. When the sticky
+  replica is saturated, the request SPILLS to the next choice in
+  rendezvous order: a warm cache is worth a queued beat, not a missed
+  deadline. Prompts shorter than one chunk (nothing cacheable) route
+  least-loaded by queue depth + active slots from each replica's /stats.
+- **429-aware retry.** A shed replica's ``Retry-After`` (the engine's
+  EWMA service-rate estimate) marks it saturated for that window; the
+  router immediately tries the next candidate, and only when EVERY live
+  replica is backpressuring does it sleep — a jittered fraction of the
+  smallest advertised Retry-After — before re-ranking. Transport errors
+  and 5xx EJECT the replica on the spot and retry elsewhere with
+  jittered exponential backoff, so a replica killed mid-request costs
+  latency, never a failed request (the driver restarts it under budget;
+  discovery re-adds it at its new port).
+- **Ejection / readmission.** A health thread probes every replica's
+  /healthz (eject after ``eject_after`` consecutive failures, readmit
+  on the first success), refreshes /stats (queue depth, slots,
+  retry_after), and — when constructed over a driver (``discover``) —
+  re-syncs the replica set from ``get_task_infos``: the driver's
+  heartbeat-liveness view plus the ``serve_port`` each replica
+  published via the publish_ports RPC (runtimes/serving.py).
+- **Observability.** Per-request ``RequestTrace``s (``submitted ->
+  routed -> finished|shed|failed``, with replica/retry attrs) feed an
+  optional trace sink, and GET /metrics renders the ``router_*``
+  families (docs/observability.md "Router metrics") through the shared
+  PromRenderer.
+
+``python -m tony_tpu.cli.main route`` serves the HTTP front door:
+POST /generate (the serve contract, proxied), GET /healthz, /stats,
+/metrics. See docs/serving.md "Fleet serving".
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import itertools
+import json
+import logging
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from . import metrics as _metrics
+from .observability import (
+    PROM_CONTENT_TYPE,
+    Histogram,
+    PromRenderer,
+    RequestTrace,
+)
+
+log = logging.getLogger(__name__)
+
+
+class RouterError(RuntimeError):
+    """The router could not complete the request."""
+
+
+class NoReplicaError(RouterError):
+    """No live replica in the fleet (all ejected / none discovered)."""
+
+
+class FleetSaturatedError(RouterError):
+    """Every live replica is shedding (429); carries the smallest
+    advertised Retry-After so the front door can forward honest
+    backpressure instead of inventing a constant."""
+
+    def __init__(self, msg: str, retry_after_s: int = 1):
+        super().__init__(msg)
+        self.retry_after_s = max(1, int(retry_after_s))
+
+
+class _ReplicaShed(Exception):
+    """Internal: one replica answered 429."""
+
+    def __init__(self, retry_after_s: int):
+        super().__init__(f"shed with Retry-After {retry_after_s}s")
+        self.retry_after_s = max(1, int(retry_after_s))
+
+
+class _ReplicaUnavailable(Exception):
+    """Internal: transport error / 5xx from one replica."""
+
+
+class _ReplicaTimeout(Exception):
+    """Internal: the POST hit the CALLER's deadline. Not evidence the
+    replica is broken — a slow generation against an impatient client
+    must not eject a healthy replica from everyone's rotation."""
+
+
+class Replica:
+    """Router-side state of one backend SlotServer."""
+
+    def __init__(self, name: str, host: str, port: int):
+        self.name = name          # stable identity (task_id); the
+        self.host = host          # rendezvous-hash input, so a restart
+        self.port = port          # at a new port keeps its templates
+        self.up = True            # optimistic: discovery only hands out
+        self.consecutive_fails = 0  # endpoints that passed /healthz once
+        self.saturated_until = 0.0  # monotonic 429-backpressure window
+        self.retry_after_s = 1
+        self.queued = 0
+        self.active = 0
+        self.slots = 0
+        self.max_queue = 0
+        # posts the ROUTER currently has outstanding against this
+        # replica — exact and instantaneous, unlike the polled /stats
+        # (which lag a health interval and double-count router traffic);
+        # the load signal for least-loaded picks and saturation spill
+        self.inflight = 0
+        # counters (the per-replica /metrics families)
+        self.requests = 0         # posts attempted against this replica
+        self.retries = 0          # posts that were re-attempts
+        self.shed = 0             # 429 answers received
+        self.errors = 0           # transport errors / 5xx
+        self.ejections = 0
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def load(self) -> int:
+        """Router-outstanding posts plus the polled engine queue — the
+        queue captures traffic from OTHER clients/routers, inflight
+        captures this router's own (fresher than any poll)."""
+        return self.inflight + max(0, self.queued)
+
+
+class FleetRouter:
+    """Load balancer over N SlotServer replicas. Thread-safe: many HTTP
+    handler threads call ``generate`` concurrently; one health thread
+    (``start()``) maintains liveness, stats, and the replica set."""
+
+    def __init__(self, replicas=(), *, prefill_chunk: int = 128,
+                 affinity: bool = True, health_interval_s: float = 0.5,
+                 eject_after: int = 2, spill_queue_depth: int | None = None,
+                 probe_timeout_s: float = 2.0, stats_every: int = 4,
+                 discover=None, trace_sink=None, seed: int | None = None):
+        """``replicas``: static endpoints ("host:port" strings or
+        (name, host, port) triples). ``discover``: zero-arg callable
+        returning the current [(name, host, port)] — the driver-backed
+        fleet view (see DriverDiscovery); called from the health loop,
+        its result REPLACES the replica set. ``spill_queue_depth``: treat
+        a replica with that many queued requests as saturated even
+        before it sheds (None = only trust 429s and the replica's own
+        max_queue from /stats). ``stats_every``: refresh each replica's
+        /stats only every Nth health tick — a /stats render takes the
+        replica's serving lock and computes histogram quantiles, and
+        polling it at liveness cadence measurably steals saturated
+        replicas' cycles (the router's own in-flight counts carry the
+        fast load signal between refreshes)."""
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.affinity = affinity
+        self.health_interval_s = health_interval_s
+        self.eject_after = max(1, int(eject_after))
+        self.spill_queue_depth = spill_queue_depth
+        self.probe_timeout_s = probe_timeout_s
+        self.stats_every = max(1, int(stats_every))
+        self._tick = 0
+        self.discover = discover
+        self.trace_sink = trace_sink
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self.replicas: dict[str, Replica] = {}
+        for spec in replicas:
+            if isinstance(spec, str):
+                host, _, port = spec.rpartition(":")
+                self._add_locked(spec, host or "127.0.0.1", int(port))
+            else:
+                name, host, port = spec
+                self._add_locked(str(name), host, int(port))
+        # router-local request ids: the replica assigns its own engine
+        # ids; the router's trace needs an identity that survives retries
+        self._ids = itertools.count()
+        self.routing_hist = Histogram(lo=1e-6, hi=1.0)
+        self.e2e_hist = Histogram()
+        self.requests_total = 0
+        self.failed_total = 0
+        self.shed_total = 0           # requests the ROUTER gave up on (429)
+        self.affinity_requests = 0    # requests that had a routing key
+        self.affinity_hits = 0        # ... served by their sticky replica
+        self._stop = threading.Event()
+        self._health_thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ replica set
+    def _add_locked(self, name: str, host: str, port: int) -> Replica:
+        rep = Replica(name, host, port)
+        self.replicas[name] = rep
+        return rep
+
+    def sync_replicas(self, found: list[tuple[str, str, int]]) -> None:
+        """Adopt a discovery result: add new replicas, re-point renamed
+        endpoints (a restarted replica publishes a fresh port under the
+        same task_id), drop replicas discovery no longer lists (killed /
+        mid-restart — the driver's liveness view)."""
+        with self._lock:
+            seen = set()
+            for name, host, port in found:
+                name = str(name)
+                seen.add(name)
+                rep = self.replicas.get(name)
+                if rep is None:
+                    log.info("router: replica %s joined at %s:%d",
+                             name, host, port)
+                    self._add_locked(name, host, int(port))
+                elif (rep.host, rep.port) != (host, int(port)):
+                    log.info("router: replica %s moved %s:%d -> %s:%d",
+                             name, rep.host, rep.port, host, port)
+                    rep.host, rep.port = host, int(port)
+                    rep.up = True           # a fresh endpoint, fresh chance
+                    rep.consecutive_fails = 0
+                    rep.saturated_until = 0.0
+            for name in set(self.replicas) - seen:
+                log.info("router: replica %s left the fleet", name)
+                self.replicas.pop(name, None)
+
+    # ----------------------------------------------------------------- health
+    def start(self) -> None:
+        """Start the health/discovery loop (idempotent)."""
+        if self._health_thread is None or not self._health_thread.is_alive():
+            self._stop.clear()
+            self._health_thread = threading.Thread(
+                target=self._health_loop, name="router-health", daemon=True)
+            self._health_thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5)
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            try:
+                self.health_tick()
+            except Exception:       # the loop must outlive a bad tick
+                log.exception("router health tick failed")
+
+    def health_tick(self) -> None:
+        """One maintenance pass: discovery re-sync, then per-replica
+        /healthz probe (eject after ``eject_after`` consecutive
+        failures, readmit on the first success) + /stats refresh every
+        ``stats_every``-th tick (see __init__)."""
+        self._tick += 1
+        # the FIRST tick always refreshes (fresh routers need a baseline
+        # before any traffic), then every stats_every-th
+        refresh_stats = (self._tick % self.stats_every) == 1 \
+            or self.stats_every == 1
+        if self.discover is not None:
+            try:
+                self.sync_replicas(list(self.discover()))
+            except Exception as e:
+                # a flapping driver RPC must not tear the fleet down;
+                # the last known replica set keeps serving
+                log.warning("router discovery failed: %s", e)
+        with self._lock:
+            reps = list(self.replicas.values())
+        for rep in reps:
+            healthy = self._probe_healthz(rep)
+            with self._lock:
+                if rep.name not in self.replicas:
+                    continue        # discovery removed it mid-probe
+                if healthy:
+                    rep.consecutive_fails = 0
+                    if not rep.up:
+                        log.info("router: readmitting %s", rep.name)
+                        rep.up = True
+                else:
+                    rep.consecutive_fails += 1
+                    if rep.up and rep.consecutive_fails >= self.eject_after:
+                        self._eject_locked(rep, "healthz")
+            if healthy and refresh_stats:
+                self._refresh_stats(rep)
+
+    def _probe_healthz(self, rep: Replica) -> bool:
+        try:
+            with urllib.request.urlopen(rep.base_url + "/healthz",
+                                        timeout=self.probe_timeout_s) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    def _refresh_stats(self, rep: Replica) -> None:
+        """Pull the load signals the picker uses (best-effort)."""
+        try:
+            with urllib.request.urlopen(rep.base_url + "/stats",
+                                        timeout=self.probe_timeout_s) as r:
+                st = json.loads(r.read().decode())
+        except Exception:
+            return
+        with self._lock:
+            rep.queued = int(st.get("queued", 0) or 0)
+            rep.active = int(st.get("active", 0) or 0)
+            rep.slots = int(st.get("slots", 0) or 0)
+            rep.max_queue = int(st.get("max_queue", 0) or 0)
+            rep.retry_after_s = int(st.get("retry_after_s", 1) or 1)
+
+    def _eject_locked(self, rep: Replica, reason: str) -> None:
+        if rep.up:
+            rep.up = False
+            rep.ejections += 1
+            log.warning("router: ejecting %s (%s)", rep.name, reason)
+
+    # ---------------------------------------------------------------- routing
+    def route_key(self, prompt) -> bytes | None:
+        """The affinity key: a digest of the prompt's leading
+        ``prefill_chunk``-aligned blocks — exactly the granularity the
+        prefix cache stores (PR 2), so requests that would share trie
+        blocks share a key. None when affinity is off or the prompt has
+        no full block (nothing cacheable to be sticky about)."""
+        n = (len(prompt) // self.prefill_chunk) * self.prefill_chunk
+        if not self.affinity or n <= 0:
+            return None
+        body = ",".join(str(int(t)) for t in prompt[:n]).encode()
+        return hashlib.sha1(body).digest()
+
+    def _ranked_locked(self, key: bytes | None) -> list[Replica]:
+        live = [r for r in self.replicas.values() if r.up]
+        if key is None:
+            # least-loaded from the freshest /stats; name tie-break so
+            # equal-load picks are deterministic
+            return sorted(live, key=lambda r: (r.load, r.name))
+        return sorted(
+            live,
+            key=lambda r: hashlib.sha1(key + r.name.encode()).digest(),
+            reverse=True)
+
+    def _saturated_locked(self, rep: Replica, now: float) -> bool:
+        if rep.saturated_until > now:
+            return True
+        if rep.max_queue and rep.queued >= rep.max_queue:
+            return True
+        return (self.spill_queue_depth is not None
+                and max(rep.queued, rep.inflight - max(0, rep.slots))
+                >= self.spill_queue_depth)
+
+    def _pick(self, key: bytes | None) -> Replica | None:
+        """Choose a replica: rendezvous-sticky (or least-loaded) with
+        spill past saturated candidates; when everything is saturated,
+        the first choice anyway — the caller handles its 429."""
+        now = time.monotonic()
+        with self._lock:
+            ranked = self._ranked_locked(key)
+            if not ranked:
+                return None
+            for rep in ranked:
+                if not self._saturated_locked(rep, now):
+                    return rep
+            return ranked[0]
+
+    # ------------------------------------------------------------- the request
+    def generate(self, prompt, max_new_tokens: int = 64,
+                 timeout_s: float = 600.0, temperature: float | None = None,
+                 top_k: int | None = None,
+                 cache_prompt: bool | None = None) -> dict:
+        """Route one generation request; returns the replica's response
+        dict (id/tokens/finish_reason) plus routing attrs. Raises
+        NoReplicaError / FleetSaturatedError / RouterError / TimeoutError
+        — never returns a half-answer."""
+        rid = next(self._ids)
+        tr = RequestTrace(rid)
+        tr.mark("submitted")
+        key = self.route_key(prompt)
+        with self._lock:
+            self.requests_total += 1
+            if key is not None:
+                self.affinity_requests += 1
+        deadline = time.monotonic() + timeout_s
+        payload = {"prompt": [int(t) for t in prompt],
+                   "max_new_tokens": int(max_new_tokens)}
+        if temperature is not None:
+            payload["temperature"] = float(temperature)
+        if top_k is not None:
+            payload["top_k"] = int(top_k)
+        if cache_prompt is not None:
+            payload["cache_prompt"] = bool(cache_prompt)
+        attempts = 0
+        min_retry_after: int | None = None
+        last_err = "no replica available"
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._seal(tr, "failed", error="deadline", retries=attempts)
+                raise TimeoutError(
+                    f"request {rid} exhausted its {timeout_s}s budget after "
+                    f"{attempts} attempts (last: {last_err})")
+            t0 = time.monotonic()
+            rep = self._pick(key)
+            dt = time.monotonic() - t0
+            with self._lock:    # Histogram is not thread-safe
+                self.routing_hist.observe(dt)
+            if rep is None:
+                # nothing live: give health/discovery a beat to find one
+                last_err = "no live replica"
+                if self._sleep(min(0.25, remaining), deadline):
+                    continue        # still time: re-pick
+                self._seal(tr, "failed", error="no_replica",
+                           retries=attempts)
+                raise NoReplicaError(
+                    "no live replica in the fleet (all ejected or none "
+                    "discovered)")
+            with self._lock:
+                rep.requests += 1
+                rep.inflight += 1
+                if attempts:
+                    rep.retries += 1
+            tr.mark("routed")
+            tr.attrs.update(replica=rep.name, attempt=attempts + 1)
+            # the replica enforces the same deadline: a request the
+            # router would abandon must not keep decoding downstream
+            payload["timeout_s"] = max(0.05, remaining)
+            try:
+                try:
+                    resp = self._post_generate(rep, payload, remaining)
+                finally:
+                    with self._lock:
+                        rep.inflight -= 1
+            except _ReplicaShed as e:
+                attempts += 1
+                now = time.monotonic()
+                with self._lock:
+                    rep.shed += 1
+                    rep.retry_after_s = e.retry_after_s
+                    # backpressure window, capped: Retry-After is an ETA
+                    # for ONE seat, not a ban — re-probe within a beat
+                    rep.saturated_until = now + min(e.retry_after_s, 30)
+                    all_saturated = all(
+                        self._saturated_locked(r, now)
+                        for r in self.replicas.values() if r.up)
+                min_retry_after = (e.retry_after_s if min_retry_after is None
+                                   else min(min_retry_after, e.retry_after_s))
+                last_err = f"{rep.name} shed (Retry-After {e.retry_after_s}s)"
+                if not all_saturated:
+                    continue        # spill immediately to the next choice
+                # the whole fleet is backpressuring: honor the smallest
+                # advertised Retry-After (jittered so synchronized callers
+                # don't stampede back in one wave), or give up if the
+                # deadline lands first
+                wait = min_retry_after * self._rng.uniform(0.5, 1.0)
+                if time.monotonic() + wait >= deadline:
+                    with self._lock:
+                        self.shed_total += 1
+                    self._seal(tr, "shed", retries=attempts,
+                               retry_after_s=min_retry_after)
+                    raise FleetSaturatedError(
+                        f"every live replica is shedding (request {rid}, "
+                        f"{attempts} attempts)", min_retry_after)
+                self._sleep(wait, deadline)
+            except _ReplicaTimeout as e:
+                # the CALLER's deadline expired mid-generation: fail this
+                # attempt only — ejection is for replica faults, and the
+                # health loop will catch a genuinely dead server
+                attempts += 1
+                with self._lock:
+                    rep.errors += 1
+                last_err = f"{rep.name} timed out: {e}"
+                continue        # top-of-loop deadline check ends it
+            except _ReplicaUnavailable as e:
+                attempts += 1
+                with self._lock:
+                    rep.errors += 1
+                    self._eject_locked(rep, str(e))
+                last_err = f"{rep.name}: {e}"
+                # jittered exponential backoff before re-ranking — the
+                # survivors absorb the traffic; the health loop readmits
+                # the ejected replica when it comes back
+                backoff = (min(0.05 * (2 ** min(attempts, 6)), 2.0)
+                           * self._rng.uniform(0.5, 1.5))
+                self._sleep(min(backoff, max(0.0, deadline
+                                             - time.monotonic())), deadline)
+            else:
+                with self._lock:
+                    ranked = (self._ranked_locked(key)
+                              if key is not None else [])
+                    hit = bool(ranked and ranked[0] is rep)
+                    if hit:
+                        self.affinity_hits += 1
+                self._seal(tr, "finished", retries=attempts,
+                           affinity_hit=bool(hit),
+                           n_tokens=len(resp.get("tokens", [])))
+                resp["replica"] = rep.name
+                resp["retries"] = attempts
+                return resp
+
+    def _sleep(self, seconds: float, deadline: float) -> bool:
+        """Bounded wait; True if the deadline survived it."""
+        if seconds > 0:
+            time.sleep(seconds)
+        return time.monotonic() < deadline
+
+    def _post_generate(self, rep: Replica, payload: dict,
+                       timeout: float) -> dict:
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            rep.base_url + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=max(0.05,
+                                                         timeout)) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                try:
+                    ra = int(e.headers.get("Retry-After", "1") or "1")
+                except ValueError:
+                    ra = 1
+                raise _ReplicaShed(ra) from None
+            raise _ReplicaUnavailable(f"HTTP {e.code}") from None
+        except Exception as e:      # URLError, socket timeout, reset, ...
+            reason = getattr(e, "reason", None)
+            if isinstance(e, TimeoutError) or isinstance(reason,
+                                                         TimeoutError):
+                raise _ReplicaTimeout(f"{type(e).__name__}: {e}") \
+                    from None
+            raise _ReplicaUnavailable(
+                f"{type(e).__name__}: {e}") from None
+
+    def _seal(self, tr: RequestTrace, terminal: str, **attrs) -> None:
+        tr.attrs.update(attrs)
+        tr.mark(terminal)
+        e2e = tr.spans[-1][1] - tr.spans[0][1]
+        with self._lock:
+            self.e2e_hist.observe(max(0.0, e2e))
+            if terminal == "failed":
+                self.failed_total += 1
+        sink = self.trace_sink
+        if sink is not None:
+            try:
+                sink(tr.to_dict())
+            except Exception:
+                log.exception("router trace sink failed")
+
+    # ---------------------------------------------------------- observability
+    def stats(self) -> dict:
+        with self._lock:
+            reps = {
+                r.name: {
+                    "endpoint": f"{r.host}:{r.port}", "up": r.up,
+                    "queued": r.queued, "active": r.active,
+                    "inflight": r.inflight,
+                    "slots": r.slots, "requests": r.requests,
+                    "retries": r.retries, "shed": r.shed,
+                    "errors": r.errors, "ejections": r.ejections,
+                } for r in self.replicas.values()}
+            return {
+                "replicas": reps,
+                "live": sum(r.up for r in self.replicas.values()),
+                "requests": self.requests_total,
+                "failed": self.failed_total,
+                "shed": self.shed_total,
+                "affinity": {
+                    "enabled": self.affinity,
+                    "requests": self.affinity_requests,
+                    "hits": self.affinity_hits,
+                    "hit_ratio": round(
+                        self.affinity_hits / self.affinity_requests, 4)
+                    if self.affinity_requests else None,
+                },
+                "routing_decision_s": self.routing_hist.snapshot(),
+                "request_s": self.e2e_hist.snapshot(),
+            }
+
+    def prometheus_metrics(self) -> str:
+        """GET /metrics: the router_* families (docs/observability.md
+        "Router metrics")."""
+        r = PromRenderer()
+        with self._lock:
+            reps = list(self.replicas.values())
+            live = sum(rep.up for rep in reps)
+            for rep in sorted(reps, key=lambda x: x.name):
+                lab = {"replica": rep.name}
+                r.gauge(_metrics.ROUTER_REPLICA_UP, 1 if rep.up else 0,
+                        "1 while the replica is in rotation, 0 while "
+                        "ejected", labels=lab)
+                r.counter(_metrics.ROUTER_REQUESTS_TOTAL, rep.requests,
+                          "generate attempts posted per replica",
+                          labels=lab)
+                r.counter(_metrics.ROUTER_RETRIES_TOTAL, rep.retries,
+                          "posts that were re-attempts of a request",
+                          labels=lab)
+                r.counter(_metrics.ROUTER_SHED_TOTAL, rep.shed,
+                          "429 answers received per replica", labels=lab)
+                r.counter(_metrics.ROUTER_EJECTIONS_TOTAL, rep.ejections,
+                          "times the replica was ejected from rotation",
+                          labels=lab)
+            r.gauge(_metrics.ROUTER_REPLICAS_LIVE, live,
+                    "replicas currently in rotation")
+            r.counter(_metrics.ROUTER_FAILED_TOTAL, self.failed_total,
+                      "requests the router could not complete "
+                      "(deadline / no replica)")
+            r.counter(_metrics.ROUTER_AFFINITY_HITS_TOTAL,
+                      self.affinity_hits,
+                      "keyed requests served by their sticky replica")
+            r.counter(_metrics.ROUTER_AFFINITY_REQUESTS_TOTAL,
+                      self.affinity_requests,
+                      "requests that carried a prefix-affinity key")
+            if self.affinity_requests:
+                r.gauge(_metrics.ROUTER_AFFINITY_HIT_RATIO,
+                        self.affinity_hits / self.affinity_requests,
+                        "affinity_hits / affinity_requests — how often "
+                        "the sticky replica actually served (spills and "
+                        "ejections lower it)")
+            r.histogram(_metrics.ROUTER_ROUTING_SECONDS, self.routing_hist,
+                        "routing-decision latency (pick only, no I/O)")
+            r.histogram(_metrics.ROUTER_E2E_SECONDS, self.e2e_hist,
+                        "request time through the router, submit to "
+                        "terminal, retries included")
+        return r.render()
+
+    def healthy(self) -> bool:
+        with self._lock:
+            return any(r.up for r in self.replicas.values())
+
+
+class DriverDiscovery:
+    """The driver-backed fleet view: reads ``driver.json`` for the RPC
+    endpoint, then serves ``get_task_infos`` filtered down to RUNNING
+    tasks that published a ``serve_port`` (runtimes/serving.py publishes
+    it only after the replica's first healthy /healthz). A replica mid-
+    restart has no ports (the driver clears them at relaunch) and drops
+    out of the result until its new attempt is serving again."""
+
+    def __init__(self, job_dir: str, role: str | None = None,
+                 token: str = ""):
+        from pathlib import Path
+
+        self.job_dir = Path(job_dir)
+        self.role = role
+        self._token = token
+        self._rpc = None
+
+    def _client(self):
+        if self._rpc is None:
+            from . import constants as c
+            from .rpc import RpcClient
+            from .rpc.protocol import derive_role_key
+
+            info = json.loads(
+                (self.job_dir / c.DRIVER_INFO_FILE).read_text())
+            self._rpc = RpcClient(
+                info["host"], info["port"],
+                token=derive_role_key(self._token, "client")
+                if self._token else "",
+                role="client" if self._token else "", max_retries=2)
+        return self._rpc
+
+    def __call__(self) -> list[tuple[str, str, int]]:
+        try:
+            infos = self._client().call("get_task_infos")
+        except Exception:
+            self.close()            # re-resolve driver.json next tick
+            raise
+        out = []
+        for info in infos:
+            if self.role is not None and info.get("name") != self.role:
+                continue
+            if info.get("status") != "RUNNING":
+                continue
+            serve = (info.get("ports") or {}).get("serve_port")
+            if not serve:
+                continue
+            task_id = f"{info['name']}:{info['index']}"
+            out.append((task_id, info.get("host") or "127.0.0.1",
+                        int(serve)))
+        return out
+
+    def close(self) -> None:
+        if self._rpc is not None:
+            self._rpc.close()
+            self._rpc = None
+
+
+# ------------------------------------------------------------- HTTP front door
+
+def make_handler(router: FleetRouter):
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, obj: dict,
+                  headers: dict | None = None) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                # deliberately NOT router.stats(): probers hit this at
+                # liveness cadence, and the full stats payload computes
+                # histogram quantiles under the routing lock
+                with router._lock:
+                    live = sum(r.up for r in router.replicas.values())
+                self._send(200 if live else 503,
+                           {"healthy": bool(live), "live": live})
+            elif self.path == "/stats":
+                self._send(200, router.stats())
+            elif self.path == "/metrics":
+                body = router.prometheus_metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", PROM_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._send(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, {"error": "unknown path"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                # coerce HERE so a malformed prompt ({"prompt": 123},
+                # strings, nested junk) is a 400, not an unhandled
+                # exception out of route_key on the handler thread
+                prompt = [int(t) for t in payload["prompt"]]
+                kwargs = {
+                    "max_new_tokens": int(payload.get("max_new_tokens",
+                                                      64)),
+                    "timeout_s": float(payload.get("timeout_s", 600.0)),
+                }
+                if not 0 < kwargs["timeout_s"] < float("inf"):
+                    raise ValueError(
+                        "timeout_s must be a positive finite number")
+                for k, cast in (("temperature", float), ("top_k", int)):
+                    if payload.get(k) is not None:
+                        kwargs[k] = cast(payload[k])
+                if payload.get("cache_prompt") is not None:
+                    if not isinstance(payload["cache_prompt"], bool):
+                        raise ValueError(
+                            "cache_prompt must be a JSON boolean")
+                    kwargs["cache_prompt"] = payload["cache_prompt"]
+            except (KeyError, ValueError, TypeError) as e:
+                self._send(400, {"error": str(e)})
+                return
+            try:
+                resp = router.generate(prompt, **kwargs)
+            except FleetSaturatedError as e:
+                self._send(429, {"error": str(e)},
+                           headers={"Retry-After": str(e.retry_after_s)})
+                return
+            except NoReplicaError as e:
+                self._send(503, {"error": str(e)})
+                return
+            except TimeoutError as e:
+                self._send(504, {"error": str(e)})
+                return
+            except RouterError as e:
+                self._send(502, {"error": str(e)})
+                return
+            self._send(200, resp)
+
+    return Handler
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tony-tpu route")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="HOST:PORT",
+                   help="static replica endpoint, repeatable (skip for "
+                        "--job-dir discovery)")
+    p.add_argument("--job-dir", default="",
+                   help="a serving job's dir: discover replicas from the "
+                        "driver (driver.json -> get_task_infos + the "
+                        "serve_port each replica published)")
+    p.add_argument("--role", default="",
+                   help="with --job-dir: route only this role's tasks "
+                        "(default: any task publishing a serve_port)")
+    p.add_argument("--prefill-chunk", type=int, default=128,
+                   help="the fleet's serve --prefill-chunk: affinity "
+                        "keys hash chunk-ALIGNED prompt blocks, so this "
+                        "must match for sticky routing to line up with "
+                        "the replicas' prefix caches")
+    p.add_argument("--no-affinity", action="store_true",
+                   help="disable prefix-affinity: always least-loaded")
+    p.add_argument("--health-interval-s", type=float, default=0.5)
+    p.add_argument("--eject-after", type=int, default=2,
+                   help="consecutive failed /healthz probes before a "
+                        "replica is ejected from rotation")
+    p.add_argument("--probe-timeout-s", type=float, default=2.0,
+                   help="per-probe /healthz//stats timeout; raise it on "
+                        "saturated replicas (a busy server answering "
+                        "slowly must not read as dead)")
+    p.add_argument("--spill-queue-depth", type=int, default=0,
+                   help="treat a replica this many requests deep in "
+                        "backlog as saturated (affinity spills to the "
+                        "rendezvous runner-up); 0 = only trust 429s "
+                        "and the replica's own max_queue")
+    p.add_argument("--stats-every", type=int, default=4,
+                   help="refresh each replica's /stats only every Nth "
+                        "health tick (a /stats render takes the "
+                        "replica's serving lock)")
+    p.add_argument("--trace-dir", default="",
+                   help="dump router request traces as JSONL "
+                        "(requests.trace.jsonl) into this directory")
+    return p
+
+
+def main(argv=None) -> int:
+    import os
+    from http.server import ThreadingHTTPServer
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s router %(name)s: %(message)s")
+    args = build_argparser().parse_args(argv)
+    if not args.replica and not args.job_dir:
+        raise SystemExit("need --replica endpoints or a --job-dir to "
+                         "discover them from")
+    discover = None
+    if args.job_dir:
+        from . import constants as c
+
+        discover = DriverDiscovery(
+            args.job_dir, role=args.role or None,
+            token=os.environ.get(c.ENV_TOKEN, ""))
+    trace_writer = None
+    trace_sink = None
+    if args.trace_dir:
+        from .events.trace import TraceWriter
+
+        trace_writer = TraceWriter(args.trace_dir)
+        trace_sink = trace_writer.write
+        print(f"router traces -> {trace_writer.path}", flush=True)
+    router = FleetRouter(
+        args.replica, prefill_chunk=args.prefill_chunk,
+        affinity=not args.no_affinity,
+        health_interval_s=args.health_interval_s,
+        eject_after=args.eject_after,
+        probe_timeout_s=args.probe_timeout_s,
+        spill_queue_depth=args.spill_queue_depth or None,
+        stats_every=args.stats_every, discover=discover,
+        trace_sink=trace_sink)
+    router.start()
+    httpd = ThreadingHTTPServer((args.host, args.port),
+                                make_handler(router))
+    print(f"routing on http://{args.host}:{httpd.server_address[1]} "
+          f"({len(router.replicas)} static replicas"
+          + (", driver discovery on" if discover else "") + ")",
+          flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        router.shutdown()
+        if discover is not None:
+            discover.close()
+        if trace_writer is not None:
+            trace_writer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,6 +21,7 @@ from .jax_runtime import JaxDriverAdapter, JaxTaskAdapter
 from .mxnet import MXNetDriverAdapter, MXNetTaskAdapter
 from .pytorch import PyTorchDriverAdapter, PyTorchTaskAdapter
 from .ray import RayDriverAdapter, RayTaskAdapter
+from .serving import ServingDriverAdapter, ServingTaskAdapter
 from .tensorflow import TFDriverAdapter, TFTaskAdapter
 
 
@@ -51,6 +52,7 @@ for _name, _d, _t in (
     ("mxnet", MXNetDriverAdapter, MXNetTaskAdapter),
     ("horovod", HorovodDriverAdapter, HorovodTaskAdapter),
     ("ray", RayDriverAdapter, RayTaskAdapter),
+    ("serving", ServingDriverAdapter, ServingTaskAdapter),
     ("standalone", StandaloneDriverAdapter, StandaloneTaskAdapter),
     ("generic", GenericDriverAdapter, GenericTaskAdapter),
 ):

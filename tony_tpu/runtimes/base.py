@@ -42,10 +42,17 @@ class DriverAdapter:
 
     def cluster_spec_payload(self, task_id: str) -> dict[str, Any]:
         """What register_worker/get_cluster_spec returns once the barrier
-        opens. Base payload is the role->addresses map; runtimes add their
-        rendezvous data (reference constructClusterSpec)."""
+        opens. Base payload is the role->addresses map plus any named
+        service ports tasks have published (publish_ports RPC — the
+        generalization of the reference's TF_CONFIG endpoint plumbing);
+        runtimes add their rendezvous data (reference
+        constructClusterSpec)."""
         assert self.session is not None
-        return {"cluster": self.session.cluster_spec()}
+        payload: dict[str, Any] = {"cluster": self.session.cluster_spec()}
+        ports = self.session.service_ports()
+        if ports:
+            payload["service_ports"] = ports
+        return payload
 
     def is_healthy(self, conf: "TonyConf") -> bool:
         """Periodic health check from the driver monitor loop (reference

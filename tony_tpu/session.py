@@ -29,6 +29,12 @@ class Task:
     url: str = ""
     exit_code: int | None = None
     container_id: str = ""   # provisioner-assigned handle
+    # named service ports this task published over the publish_ports RPC
+    # (e.g. a serving replica's {"serve_port": N, "metrics_port": N}) —
+    # the generalization of the reference's single TF_CONFIG rendezvous
+    # port: any task can advertise any number of named endpoints, and
+    # they ride the cluster-spec payload + TaskInfo to every consumer
+    ports: dict[str, int] = field(default_factory=dict)
 
     @property
     def task_id(self) -> str:
@@ -42,6 +48,7 @@ class Task:
         return TaskInfo(
             name=self.name, index=self.index, status=self.status.value,
             host=self.host, port=self.port, url=self.url, exit_code=self.exit_code,
+            ports=dict(self.ports),
         )
 
 
@@ -131,6 +138,33 @@ class Session:
     def registered_count(self) -> int:
         with self._lock:
             return len(self._registered)
+
+    # ---------------------------------------------------------- service ports
+    def set_task_ports(self, task_id: str, ports: dict[str, int]) -> bool:
+        """Merge named service ports a task published (publish_ports RPC).
+        Values must be ints in the TCP port range — a task must not be able
+        to poison the cluster spec with arbitrary payloads."""
+        clean = {}
+        for name, port in (ports or {}).items():
+            if not isinstance(name, str) or not name:
+                raise ValueError(f"bad service-port name: {name!r}")
+            port = int(port)
+            if not 0 < port < 65536:
+                raise ValueError(f"bad service port {name}={port}")
+            clean[name] = port
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if task is None:
+                return False
+            task.ports.update(clean)
+            return True
+
+    def service_ports(self) -> dict[str, dict[str, int]]:
+        """task_id -> published named service ports, for every task that
+        advertised any — the cluster-spec payload's ``service_ports``."""
+        with self._lock:
+            return {t.task_id: dict(t.ports)
+                    for t in self.all_tasks() if t.ports}
 
     def all_registered(self, roles: Iterable[str] | None = None) -> bool:
         """The gang barrier predicate (reference MLGenericRuntime.java:80-98:
