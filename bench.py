@@ -75,6 +75,17 @@ vs random routing on the fleet-wide trie reuse fraction (asserted
 affinity > random) and merged p99 TTFT. Results land in PERF.json
 under `serving_fleet`.
 
+`python bench.py --elastic` exercises the TRAINING failure model
+(docs/training-robustness.md): a real 2-worker local job running the
+elastic_train drill under the driver's seeded chaos harness
+(TONY_TEST_DRIVER_{KILL_RATE,PREEMPT_AT_STEP,CHAOS_SEED}) — random
+container SIGKILLs plus one relayed preemption drain, with elasticity
+on. The bench asserts ZERO failed jobs, ≤ save_interval steps recomputed
+per recovery with no silent step skips (from the per-step StepTimer
+JSONLs), and reports each loss→running recovery wall time from
+tasks.trace.jsonl. Results land in PERF.json under
+`training_robustness`.
+
 `python bench.py --serving --overload --chaos` exercises the failure
 model (docs/serving.md): a burst far exceeding slots + max_queue hits a
 ServeApp whose SlotServer runs with seeded fault injection
@@ -1029,7 +1040,173 @@ def run_serving_robustness_bench(chaos: bool) -> int:
     return 0
 
 
+def run_elastic_bench() -> int:
+    """Elastic-training robustness benchmark (docs/training-robustness.md):
+    a real 2-worker local job runs examples/elastic_train.py (tiny
+    deterministic jitted update, overlapped orbax checkpoints every
+    SAVE_INTERVAL steps, full preemption-drain contract) while the
+    driver's seeded chaos harness SIGKILLs containers at KILL_RATE per
+    monitor tick and fires one preemption drain when the gang reaches
+    PREEMPT_AT_STEP. Elasticity is ON with a restart budget, so every
+    loss is either a budgeted restart, a budget-free preempt relaunch,
+    or a gang resize — never a failed job.
+
+    The bench ENFORCES the acceptance invariants rather than just
+    reporting them: the job must SUCCEED (zero failed jobs), at least
+    one chaos kill and the preemption must actually have fired, every
+    worker's StepTimer JSONL must show ≤ SAVE_INTERVAL recomputed steps
+    per recovery and NO silent step skips, and each recovery's
+    loss→running wall time is read off tasks.trace.jsonl."""
+    import tempfile as _tempfile
+    import threading as _threading
+
+    sys.path.insert(0, str(REPO))
+    from tony_tpu import constants as c
+    from tony_tpu.api import JobStatus
+    from tony_tpu.client import TonyClient
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.events.trace import TASK_TRACE_FILE, read_traces
+
+    SAVE_INTERVAL = 5
+    TOTAL_STEPS = 150
+    STEP_MS = 50
+    KILL_RATE = 0.006           # per 100ms monitor tick; E[kills] ~ 2
+    PREEMPT_AT = 60
+    SEED = 1234
+    workers = 2
+
+    chaos_env = {
+        c.TEST_DRIVER_KILL_RATE: str(KILL_RATE),
+        c.TEST_DRIVER_PREEMPT_AT_STEP: str(PREEMPT_AT),
+        c.TEST_DRIVER_CHAOS_SEED: str(SEED),
+    }
+    td = _tempfile.mkdtemp(prefix="tony-elastic-bench-")
+    root = Path(td)
+    cmd = (f"{sys.executable} -m tony_tpu.examples.elastic_train "
+           f"--steps {TOTAL_STEPS} --save-interval {SAVE_INTERVAL} "
+           f"--ckpt-dir {root}/ckpt_$TONY_TASK_INDEX")
+    conf = TonyConf({
+        "tony.staging.dir": str(root / "staging"),
+        "tony.history.location": str(root / "history"),
+        "tony.history.intermediate": str(root / "history/intermediate"),
+        "tony.history.finished": str(root / "history/finished"),
+        "tony.am.monitor-interval-ms": 100,
+        "tony.task.registration-poll-interval-ms": 100,
+        "tony.task.heartbeat-interval-ms": 250,
+        "tony.task.metrics-interval-ms": 500,
+        "tony.task.preempt-grace-ms": 4000,
+        "tony.worker.instances": workers,
+        "tony.worker.command": cmd,
+        "tony.worker.max-restarts": 3,
+        "tony.train.elastic-enabled": True,
+        "tony.train.elastic-min-instances": 1,
+        "tony.train.rescale-retry-ms": 3000,
+        "tony.execution.env": " ".join(
+            [f"ELASTIC_TRAIN_STEP_MS={STEP_MS}", "JAX_PLATFORMS=cpu"]
+            + [f"{k}={v}" for k, v in chaos_env.items()]),
+    })
+    # the chaos knobs must reach the DRIVER process (it reads them at
+    # construction); the client launches the driver with its own env
+    old_env = {k: os.environ.get(k) for k in chaos_env}
+    os.environ.update(chaos_env)
+    t0 = time.time()
+    try:
+        client = TonyClient(conf, poll_interval_s=0.2)
+        client.submit()
+        status = client.monitor()
+    finally:
+        for k, v in old_env.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+    wall = time.time() - t0
+
+    assert status == JobStatus.SUCCEEDED, (
+        f"elastic job FAILED under chaos: {client.final_state}")
+
+    # ---- recovery forensics from the task traces
+    inter = (root / "history/intermediate" / client.app_id)
+    recs = {r["id"]: r for r in read_traces(inter / TASK_TRACE_FILE)}
+    kills = preempts = resizes = 0
+    recoveries = []     # (task, kind, loss->running seconds)
+    for task_id, rec in recs.items():
+        spans = rec["spans"]
+        resizes = max(resizes, sum(1 for n, _ in spans if n == "resized"))
+        for i, (name, t_mark) in enumerate(spans):
+            if name not in ("restarted", "preempted", "resized"):
+                continue
+            if name == "restarted":
+                kills += 1
+            elif name == "preempted":
+                preempts += 1
+            t_run = next((t for n, t in spans[i + 1:] if n == "running"),
+                         None)
+            if t_run is not None:
+                recoveries.append(
+                    {"task": task_id, "kind": name,
+                     "loss_to_running_s": round(t_run - t_mark, 3)})
+    assert preempts >= 1, "the seeded preemption never fired"
+    assert kills + preempts + resizes >= 2, (
+        f"chaos too quiet to gate on (kills={kills} preempts={preempts} "
+        f"resizes={resizes}); raise KILL_RATE")
+
+    # ---- recompute bound + continuity from the per-step StepTimer JSONLs
+    per_worker = {}
+    for w in range(workers):
+        log_path = Path(client.job_dir) / "logs" / f"worker_{w}.steps.jsonl"
+        steps = []
+        for line in log_path.read_text().splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec.get("train_step"), int):
+                steps.append(rec["train_step"])
+        recomputed, worst = 0, 0
+        for prev, cur in zip(steps, steps[1:]):
+            if cur <= prev:
+                recomputed += prev - cur + 1
+                worst = max(worst, prev - cur + 1)
+            else:
+                assert cur == prev + 1, (
+                    f"worker_{w}: silent step skip {prev}->{cur}")
+        assert worst <= SAVE_INTERVAL, (
+            f"worker_{w} recomputed {worst} steps in one recovery "
+            f"> save_interval {SAVE_INTERVAL}")
+        per_worker[f"worker_{w}"] = {
+            "records": len(steps),
+            "last_step": steps[-1] if steps else None,
+            "recomputed_steps_total": recomputed,
+            "worst_single_recovery_recompute": worst,
+        }
+    survivors_finished = [w for w, d in per_worker.items()
+                          if d["last_step"] == TOTAL_STEPS - 1]
+    assert survivors_finished, "no worker reached the final step"
+
+    rec_times = [r["loss_to_running_s"] for r in recoveries]
+    out = {
+        "metric": "training_robustness_elastic_chaos",
+        "value": round(max(rec_times), 3) if rec_times else None,
+        "unit": "worst loss->running recovery seconds under seeded chaos",
+        "job_status": status.value,
+        "failed_jobs": 0,
+        "chaos": {"kill_rate_per_tick": KILL_RATE,
+                  "preempt_at_step": PREEMPT_AT, "seed": SEED},
+        "total_steps": TOTAL_STEPS,
+        "save_interval": SAVE_INTERVAL,
+        "step_ms": STEP_MS,
+        "budgeted_restarts": kills,
+        "preemptions": preempts,
+        "gang_resizes": resizes,
+        "recoveries": recoveries,
+        "per_worker": per_worker,
+        "wall_s": round(wall, 1),
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def main() -> int:
+    if "--elastic" in sys.argv:
+        return run_elastic_bench()
     if "--serving" in sys.argv:
         if "--fleet" in sys.argv:
             return run_serving_fleet_bench()

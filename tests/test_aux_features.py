@@ -406,6 +406,10 @@ def test_tpu_provisioner_host_count_mismatch():
         "tony.cluster.static-hosts": "h1,h2,h3",
         "tony.tpu.accelerator-type": "v5litepod-16",  # expects 4 hosts
         "tony.worker.instances": 1,
+        # the mismatch is re-probed discover-retries times; the default
+        # 10s inter-attempt poll made this unit ~20s of pure sleep
+        # (ROADMAP tier-1 budget item)
+        "tony.tpu.create-poll-interval-s": 0,
     })
     with _pytest.raises(ValueError, match="hosts"):
         TpuPodProvisioner(conf)
@@ -706,6 +710,10 @@ def test_tpu_provisioner_refresh_rediscovers_hosts(tmp_path):
     conf = TonyConf({
         "tony.tpu.discover-command": f"cat {state}",
         "tony.tpu.accelerator-type": "v5litepod-16",
+        # no inter-retry sleeps: the partial-recreate refresh below is
+        # retried discover-retries times and the default 10s poll made
+        # this unit ~20s of pure sleep (ROADMAP tier-1 budget item)
+        "tony.tpu.create-poll-interval-s": 0,
     })
     prov = TpuPodProvisioner(conf)
     assert prov.hosts == ["old-a", "old-b", "old-c", "old-d"]

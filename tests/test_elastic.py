@@ -1,0 +1,891 @@
+"""Elastic, preemption-tolerant training (docs/training-robustness.md).
+
+The contract under test, driver to training step: a preemption notice
+relayed over the heartbeat command channel drains the task (checkpoint at
+the step boundary, exit EXIT_PREEMPTED) and relaunches it BUDGET-FREE
+(trace mark ``preempted``); a worker lost beyond its restart budget
+detaches from the gang instead of failing the job — survivors drain and
+re-register into a new gang generation at the smaller world size (trace
+mark ``resized``), and the slot rejoins when capacity returns; a straggler
+whose step p50 lags the gang median beyond the configured factor gets a
+budget-charged restart; and the killed container's completion can never
+double-spend against any of those paths. Scripted-provisioner stubs speak
+the real framed-JSON RPC (the test_task_trace pattern) so each scenario
+runs in ~a second; one TINY e2e runs the real stack.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+import tony_tpu.constants as c
+from tony_tpu.api import JobStatus
+from tony_tpu.cluster.provisioner import ContainerHandle, Provisioner
+from tony_tpu.conf import TonyConf
+from tony_tpu.driver import Driver
+from tony_tpu.events.trace import TASK_TRACE_FILE, read_traces
+from tony_tpu.rpc import RpcClient
+from tony_tpu.rpc.protocol import RpcError, derive_role_key
+
+
+def _conf(dirs, **extra):
+    return TonyConf({
+        "tony.staging.dir": dirs["staging"],
+        "tony.history.location": dirs["history"],
+        "tony.history.intermediate": dirs["history"] + "/intermediate",
+        "tony.history.finished": dirs["history"] + "/finished",
+        "tony.am.monitor-interval-ms": 50,
+        "tony.task.registration-poll-interval-ms": 50,
+        **extra,
+    })
+
+
+def _span_names(rec):
+    return [n for n, _ in rec["spans"]]
+
+
+class ScriptedProvisioner(Provisioner):
+    """launch() runs ``script(spec, index, env, handle, attempt)`` on a
+    thread; ``attempt`` counts launches per task so restart scripts can
+    branch. stop_container() sets ``handle.extra["stop"]`` (an Event) so
+    a script can model a draining child instead of ignoring the stop."""
+
+    def __init__(self, script):
+        super().__init__()
+        self._script = script
+        self._attempts: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.launches: list[str] = []
+        self.stops: list[str] = []
+
+    def launch(self, spec, index, env, log_dir):
+        task_id = f"{spec.name}:{index}"
+        with self._lock:
+            attempt = self._attempts.get(task_id, 0)
+            self._attempts[task_id] = attempt + 1
+            self.launches.append(task_id)
+        handle = ContainerHandle(
+            container_id=f"stub_{task_id}_{attempt}",
+            host="127.0.0.1", role=spec.name, index=index,
+        )
+        handle.extra["stop"] = threading.Event()
+        threading.Thread(
+            target=self._run, args=(spec, index, env, handle, attempt),
+            daemon=True,
+        ).start()
+        return handle
+
+    def _run(self, spec, index, env, handle, attempt):
+        try:
+            code = self._script(spec, index, env, handle, attempt)
+        except Exception as e:                  # pragma: no cover - debug aid
+            print(f"stub executor failed: {type(e).__name__}: {e}",
+                  flush=True)
+            code = 1
+        if code is not None and self.on_completion:
+            self.on_completion(handle, code)
+
+    def stop_container(self, handle):
+        with self._lock:
+            self.stops.append(handle.container_id)
+        handle.extra["stop"].set()
+
+    def stop_all(self):
+        pass
+
+
+def _driver(dirs, tmp_path, script, name="elastic_test", **conf_extra):
+    conf = _conf(dirs, **conf_extra)
+    job_dir = tmp_path / f"job_{name}"
+    job_dir.mkdir(exist_ok=True)
+    conf.write_final(job_dir)
+    driver = Driver(conf, app_id=name, job_dir=str(job_dir),
+                    token="elastic-secret",
+                    provisioner=ScriptedProvisioner(script))
+    driver.client_signal.set()      # no client: don't wait for the ack
+    return driver
+
+
+def _rpc_for(env):
+    return RpcClient(env[c.ENV_DRIVER_HOST], int(env[c.ENV_DRIVER_PORT]),
+                     token=env.get(c.ENV_TOKEN, ""), role="executor")
+
+
+def _client_rpc(driver):
+    return RpcClient("127.0.0.1", driver.rpc_server.port,
+                     token=derive_role_key("elastic-secret", "client"),
+                     role="client")
+
+
+def _trace_records(dirs, app_id):
+    inter = Path(dirs["history"]) / "intermediate" / app_id
+    return read_traces(inter / TASK_TRACE_FILE)
+
+
+def _register_and_barrier(rpc, task_id, port):
+    payload = rpc.call("register_worker", task_id=task_id,
+                       host="127.0.0.1", port=port)
+    while payload is None:
+        rpc.call("heartbeat", task_id=task_id)
+        time.sleep(0.03)
+        payload = rpc.call("get_cluster_spec", task_id=task_id)
+    return payload
+
+
+# --------------------------------------------------------------------------
+# preemption drain: heartbeat command -> drained exit -> budget-free relaunch
+# --------------------------------------------------------------------------
+
+def test_preempt_drain_budget_free(tmp_job_dirs, tmp_path):
+    """The client relays a preemption for worker:0; the notice rides the
+    heartbeat response exactly once, the 'drained' stub exits
+    EXIT_PREEMPTED, and the relaunch spends NO restart budget. The trace
+    carries preempting -> preempted and a fresh attempt chain; an
+    executor key may not call preempt_task (ACL)."""
+    registered = threading.Event()
+    got: dict = {}
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        _register_and_barrier(rpc, task_id, 24000 + index)
+        if attempt == 0:
+            try:    # executor key must not be able to drain peers
+                rpc.call("preempt_task", task_id=task_id)
+                got["acl"] = "allowed"
+            except RpcError as e:
+                got["acl"] = str(e)
+            registered.set()
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                res = rpc.call("heartbeat", task_id=task_id)
+                if isinstance(res, dict) and res.get("preempt"):
+                    got["cmd"] = res["preempt"]
+                    break
+                time.sleep(0.03)
+            got["again"] = rpc.call("heartbeat", task_id=task_id)
+            rpc.call("register_execution_result", task_id=task_id,
+                     exit_code=c.EXIT_PREEMPTED)
+            rpc.close()
+            return c.EXIT_PREEMPTED     # drained at a step boundary
+        rpc.call("register_execution_result", task_id=task_id, exit_code=0)
+        rpc.close()
+        return 0
+
+    driver = _driver(tmp_job_dirs, tmp_path, script, name="preempt",
+                     **{"tony.worker.instances": 1,
+                        "tony.worker.command": "stub",
+                        "tony.worker.max-restarts": 1,
+                        "tony.task.heartbeat-interval-ms": 100})
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    try:
+        assert registered.wait(20), "worker never registered"
+        cl = _client_rpc(driver)
+        try:
+            assert cl.call("preempt_task", task_id="worker:9") is False
+            # a registration can race the driver's launch bookkeeping by
+            # a few ms; the RPC contract is retry-friendly (False = not
+            # preemptible *yet*)
+            deadline = time.time() + 5
+            ok = cl.call("preempt_task", task_id="worker:0")
+            while ok is not True and time.time() < deadline:
+                time.sleep(0.05)
+                ok = cl.call("preempt_task", task_id="worker:0")
+            assert ok is True
+        finally:
+            cl.close()
+    finally:
+        registered.set()
+    t.join(timeout=30)
+    assert not t.is_alive(), "driver did not finish"
+    assert driver.session.status == JobStatus.SUCCEEDED, (
+        driver.session.failure_message)
+
+    assert "authorization" in got["acl"], got["acl"]
+    assert got["cmd"]["grace_ms"] == 3000       # conf default rides the wire
+    assert got["again"] is True, "the preempt command is one-shot"
+    assert driver.provisioner.launches == ["worker:0"] * 2
+    text = driver.render_metrics()
+    assert "driver_preemptions_total 1" in text
+    assert "driver_task_restarts_total 0" in text
+    recs = _trace_records(tmp_job_dirs, "preempt")
+    assert len(recs) == 1
+    names = _span_names(recs[0])
+    assert "preempting" in names and "preempted" in names
+    assert names.count("requested") == 2, names
+    assert names[-1] == "finished"
+    assert recs[0]["attrs"]["restarts"] == 0
+
+
+def test_self_reported_preemption_and_uncommanded_drain(tmp_job_dirs,
+                                                        tmp_path):
+    """Both executor-initiated flavors are budget-free: worker:0 calls
+    notify_preemption (the SIGTERM relay path) and dies 137; worker:1
+    just exits EXIT_PREEMPTED (its child saw the notice first). Each
+    relaunch is budget-free and the job succeeds."""
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        _register_and_barrier(rpc, task_id, 24100 + index)
+        if attempt == 0:
+            if index == 0:
+                rpc.call("notify_preemption", task_id=task_id)
+                rpc.close()
+                return c.EXIT_KILLED    # host reclaimed mid-drain
+            rpc.close()
+            return c.EXIT_PREEMPTED     # drained without driver notice
+        rpc.call("register_execution_result", task_id=task_id, exit_code=0)
+        rpc.close()
+        return 0
+
+    driver = _driver(tmp_job_dirs, tmp_path, script, name="selfpreempt",
+                     **{"tony.worker.instances": 2,
+                        "tony.worker.command": "stub",
+                        "tony.task.heartbeat-interval-ms": 100})
+    status = driver.run()
+    assert status == JobStatus.SUCCEEDED, driver.session.failure_message
+    assert sorted(driver.provisioner.launches) == ["worker:0"] * 2 + [
+        "worker:1"] * 2
+    text = driver.render_metrics()
+    assert "driver_preemptions_total 2" in text
+    assert "driver_task_restarts_total 0" in text
+    for rec in _trace_records(tmp_job_dirs, "selfpreempt"):
+        names = _span_names(rec)
+        assert "preempted" in names, names
+        assert names[-1] == "finished"
+        assert rec["attrs"]["restarts"] == 0
+
+
+# --------------------------------------------------------------------------
+# budget-accounting guard: preempt relaunch vs racing completion/expiry
+# --------------------------------------------------------------------------
+
+def test_preempt_expiry_race_single_spend(tmp_job_dirs, tmp_path):
+    """The killed container's completion races heartbeat expiry (the
+    delayed-completion fault hook): the preempted task goes silent, its
+    completion is held 700ms, and expiry fires first. Exactly ONE
+    relaunch happens and at most one budget unit is spent — the delayed
+    completion reads as superseded and must not relaunch or spend
+    again (the PR 7 guard extended to the preempt path)."""
+    preempt_seen = threading.Event()
+    registered = threading.Event()
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        _register_and_barrier(rpc, task_id, 24200 + index)
+        if attempt == 0:
+            registered.set()
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                res = rpc.call("heartbeat", task_id=task_id)
+                if isinstance(res, dict) and res.get("preempt"):
+                    preempt_seen.set()
+                    break
+                time.sleep(0.03)
+            rpc.close()
+            # goes SILENT (no more beats); the drained exit's completion
+            # is delayed by TONY_TEST_COMPLETION_NOTIFICATION_DELAY_MS,
+            # so heartbeat expiry (0.3s) wins the race
+            return c.EXIT_PREEMPTED
+        rpc.call("register_execution_result", task_id=task_id, exit_code=0)
+        rpc.close()
+        return 0
+
+    os.environ[c.TEST_COMPLETION_DELAY_MS] = "700"
+    try:
+        driver = _driver(tmp_job_dirs, tmp_path, script, name="preemptrace",
+                         **{"tony.worker.instances": 1,
+                            "tony.worker.command": "stub",
+                            "tony.worker.max-restarts": 2,
+                            "tony.task.heartbeat-interval-ms": 100,
+                            "tony.task.max-missed-heartbeats": 3})
+        t = threading.Thread(target=driver.run, daemon=True)
+        t.start()
+        try:
+            assert registered.wait(20)
+            cl = _client_rpc(driver)
+            try:
+                deadline = time.time() + 5
+                ok = cl.call("preempt_task", task_id="worker:0")
+                while ok is not True and time.time() < deadline:
+                    time.sleep(0.05)
+                    ok = cl.call("preempt_task", task_id="worker:0")
+                assert ok is True
+            finally:
+                cl.close()
+            assert preempt_seen.wait(20), "notice never delivered"
+        finally:
+            registered.set()
+        t.join(timeout=30)
+    finally:
+        del os.environ[c.TEST_COMPLETION_DELAY_MS]
+    assert not t.is_alive(), "driver did not finish"
+    assert driver.session.status == JobStatus.SUCCEEDED, (
+        driver.session.failure_message)
+    # the core guarantee: one replacement, never two, and the budget was
+    # charged at most once (whichever path won the race)
+    assert driver.provisioner.launches == ["worker:0"] * 2
+    recs = _trace_records(tmp_job_dirs, "preemptrace")
+    assert len(recs) == 1
+    assert recs[0]["attrs"]["restarts"] <= 1
+    names = _span_names(recs[0])
+    assert names[-1] == "finished"
+    assert names.count("requested") == 2, names
+
+
+# --------------------------------------------------------------------------
+# elastic gang resize: down on loss past budget, up when capacity returns
+# --------------------------------------------------------------------------
+
+def test_resize_down_then_up(tmp_job_dirs, tmp_path):
+    """worker:1 crashes with NO restart budget: instead of failing the
+    job the driver detaches it, drains worker:0, and re-forms the gang
+    at world size 1 (generation 1). When the rescale timer fires the
+    slot rejoins: another drain, generation 2, world size 2, and the
+    whole job finishes clean — two resizes, zero budget units."""
+    release = threading.Event()
+    payloads: dict = {}
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        payload = _register_and_barrier(rpc, task_id, 24300 + index)
+        payloads[(index, attempt)] = payload
+        if index == 1 and attempt == 0:
+            # crash only after the survivor cleared the barrier — a stub
+            # thread stuck polling get_cluster_spec can't be SIGTERMed
+            # out of the poll the way a real executor process would be
+            deadline = time.time() + 10
+            while (0, 0) not in payloads and time.time() < deadline:
+                time.sleep(0.02)
+            rpc.close()
+            return 1        # crash; budget 0 -> resize, not job failure
+        stop = handle.extra["stop"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if stop.is_set():           # resize drain: checkpoint + exit
+                rpc.close()
+                return c.EXIT_PREEMPTED
+            if release.is_set():
+                rpc.call("register_execution_result", task_id=task_id,
+                         exit_code=0)
+                rpc.close()
+                return 0
+            try:
+                rpc.call("heartbeat", task_id=task_id)
+            except Exception:
+                pass
+            time.sleep(0.05)
+        rpc.close()
+        return 1
+
+    driver = _driver(tmp_job_dirs, tmp_path, script, name="resize",
+                     **{"tony.worker.instances": 2,
+                        "tony.worker.command": "stub",
+                        "tony.worker.max-restarts": 0,
+                        "tony.train.elastic-enabled": True,
+                        "tony.train.elastic-min-instances": 1,
+                        "tony.train.rescale-retry-ms": 500,
+                        "tony.task.heartbeat-interval-ms": 100})
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    try:
+        # wait until the gang is re-formed at FULL size in generation 2:
+        # worker:0's third attempt and worker:1's second saw the barrier
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            if (1, 1) in payloads and (0, 2) in payloads:
+                break
+            time.sleep(0.05)
+        assert (0, 1) in payloads, f"resize-down relaunch missing: {payloads}"
+        assert (1, 1) in payloads and (0, 2) in payloads, (
+            f"rescale-up never completed: {sorted(payloads)}")
+    finally:
+        release.set()
+    t.join(timeout=30)
+    assert not t.is_alive(), "driver did not finish"
+    assert driver.session.status == JobStatus.SUCCEEDED, (
+        driver.session.failure_message)
+
+    # formation history: full gang (gen 0, world 2) -> survivors-only
+    # (gen 1, world 1) -> restored (gen 2, world 2)
+    assert len(payloads[(0, 0)]["cluster"]["worker"]) == 2
+    assert payloads[(0, 0)]["gang_generation"] == 0
+    assert len(payloads[(0, 1)]["cluster"]["worker"]) == 1
+    assert payloads[(0, 1)]["gang_generation"] == 1
+    assert len(payloads[(0, 2)]["cluster"]["worker"]) == 2
+    assert payloads[(0, 2)]["gang_generation"] == 2
+    assert payloads[(1, 1)]["gang_generation"] == 2
+
+    text = driver.render_metrics()
+    assert "driver_gang_resizes_total 2" in text
+    assert "driver_task_restarts_total 0" in text
+    assert 'driver_tasks{state="detached"} 0' in text
+    recs = {r["id"]: r for r in _trace_records(tmp_job_dirs, "resize")}
+    assert set(recs) == {"worker:0", "worker:1"}
+    for rec in recs.values():
+        names = _span_names(rec)
+        assert "resized" in names, names
+        assert names[-1] == "finished"
+        assert rec["attrs"]["restarts"] == 0
+    # worker:0 was drained twice (down + up): three attempts in one trace
+    assert _span_names(recs["worker:0"]).count("requested") == 3
+
+
+def test_resize_down_stays_down_without_capacity(tmp_job_dirs, tmp_path):
+    """With a rescale timer that never fires inside the test window, the
+    job finishes at the SMALLER world size: the detached task is not
+    tracked, the survivor's success completes the job, and the detached
+    trace seals 'killed' at stop."""
+    barrier_cleared = threading.Event()
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        payload = _register_and_barrier(rpc, task_id, 24400 + index)
+        if index == 0 and attempt == 0:
+            barrier_cleared.set()
+        if index == 1:
+            barrier_cleared.wait(10)    # see test_resize_down_then_up
+            rpc.close()
+            return 1                    # lost for good
+        if attempt == 0:                # survivor: beat until drained (a
+            deadline = time.time() + 20  # registered stub that stops
+            while (time.time() < deadline   # beating would expire)
+                   and not handle.extra["stop"].is_set()):
+                try:
+                    rpc.call("heartbeat", task_id=task_id)
+                except Exception:
+                    pass
+                time.sleep(0.05)
+            rpc.close()
+            return c.EXIT_PREEMPTED
+        assert len(payload["cluster"]["worker"]) == 1
+        rpc.call("register_execution_result", task_id=task_id, exit_code=0)
+        rpc.close()
+        return 0
+
+    driver = _driver(tmp_job_dirs, tmp_path, script, name="resizedown",
+                     **{"tony.worker.instances": 2,
+                        "tony.worker.command": "stub",
+                        "tony.worker.max-restarts": 0,
+                        "tony.train.elastic-enabled": True,
+                        "tony.train.rescale-retry-ms": 600000,
+                        "tony.task.heartbeat-interval-ms": 100})
+    status = driver.run()
+    assert status == JobStatus.SUCCEEDED, driver.session.failure_message
+    assert driver.provisioner.launches.count("worker:1") == 1, (
+        "no capacity returned: the lost slot must not relaunch")
+    recs = {r["id"]: r for r in _trace_records(tmp_job_dirs, "resizedown")}
+    assert _span_names(recs["worker:0"])[-1] == "finished"
+    assert "resized" in _span_names(recs["worker:1"])
+    assert _span_names(recs["worker:1"])[-1] == "killed"
+    text = driver.render_metrics()
+    assert "driver_gang_resizes_total 1" in text
+
+
+def test_chief_loss_is_still_fatal(tmp_job_dirs, tmp_path):
+    """Elasticity must not mask a chief death: worker:0 (the chief when
+    no chief role exists) crashing past its budget fails the job."""
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        _register_and_barrier(rpc, task_id, 24500 + index)
+        if index == 0:
+            rpc.close()
+            return 1
+        deadline = time.time() + 20
+        while (time.time() < deadline
+               and not handle.extra["stop"].is_set()):
+            try:
+                rpc.call("heartbeat", task_id=task_id)
+            except Exception:
+                pass
+            time.sleep(0.05)
+        rpc.close()
+        return c.EXIT_KILLED
+
+    driver = _driver(tmp_job_dirs, tmp_path, script, name="chiefloss",
+                     **{"tony.worker.instances": 2,
+                        "tony.worker.command": "stub",
+                        "tony.worker.max-restarts": 0,
+                        "tony.train.elastic-enabled": True,
+                        "tony.application.fail-on-worker-failure-enabled":
+                            True,
+                        "tony.task.heartbeat-interval-ms": 100})
+    status = driver.run()
+    assert status == JobStatus.FAILED
+    assert "worker:0" in driver.session.failure_message
+
+
+# --------------------------------------------------------------------------
+# straggler action: pushed step p50 lagging the role median -> restart
+# --------------------------------------------------------------------------
+
+def test_straggler_restart_budget_charged(tmp_job_dirs, tmp_path):
+    """Three workers push step-time p50s; worker:2 reports 10x the
+    median and is restarted through the normal budget with a
+    'straggler' cause. Its replacement (fast) finishes with the rest."""
+    release = threading.Event()
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        _register_and_barrier(rpc, task_id, 24600 + index)
+        p50 = 1.0 if (index == 2 and attempt == 0) else 0.1
+        rpc.call("update_metrics", task_id=task_id,
+                 metrics=[{"name": "max_step_time_p50_s", "value": p50}])
+        deadline = time.time() + 30
+        while time.time() < deadline and not release.is_set():
+            if handle.extra["stop"].is_set():
+                rpc.close()
+                return c.EXIT_KILLED    # stopped for the restart
+            try:
+                rpc.call("heartbeat", task_id=task_id)
+            except Exception:
+                pass
+            time.sleep(0.05)
+        rpc.call("register_execution_result", task_id=task_id, exit_code=0)
+        rpc.close()
+        return 0
+
+    driver = _driver(tmp_job_dirs, tmp_path, script, name="straggler",
+                     **{"tony.worker.instances": 3,
+                        "tony.worker.command": "stub",
+                        "tony.worker.max-restarts": 1,
+                        "tony.train.straggler-restart-factor": 3,
+                        "tony.train.straggler-grace-checks": 1,
+                        "tony.task.heartbeat-interval-ms": 100})
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 20
+        while (time.time() < deadline
+               and driver.provisioner.launches.count("worker:2") < 2):
+            time.sleep(0.05)
+        assert driver.provisioner.launches.count("worker:2") == 2, (
+            "straggler was never restarted")
+    finally:
+        release.set()
+    t.join(timeout=30)
+    assert not t.is_alive(), "driver did not finish"
+    assert driver.session.status == JobStatus.SUCCEEDED, (
+        driver.session.failure_message)
+    assert driver.provisioner.launches.count("worker:0") == 1
+    assert driver.provisioner.launches.count("worker:1") == 1
+    text = driver.render_metrics()
+    assert "driver_task_restarts_total 1" in text
+    recs = {r["id"]: r for r in _trace_records(tmp_job_dirs, "straggler")}
+    names = _span_names(recs["worker:2"])
+    assert names.count("restarted") == 1
+    assert "straggler" in recs["worker:2"]["attrs"]["last_cause"]
+    assert names[-1] == "finished"
+
+
+# --------------------------------------------------------------------------
+# chaos knobs: seeded heartbeat drop + step-triggered preemption
+# --------------------------------------------------------------------------
+
+def test_chaos_heartbeat_drop_knob(tmp_job_dirs, tmp_path, monkeypatch):
+    """At drop rate 1.0 every heartbeat RPC errors (the executor counts a
+    miss); the knob is read once at construction and seeded."""
+    from tony_tpu.driver import DriverService
+
+    monkeypatch.setenv(c.TEST_DRIVER_HEARTBEAT_DROP_RATE, "1.0")
+    driver = _driver(tmp_job_dirs, tmp_path, lambda *a: 0, name="hbdrop",
+                     **{"tony.worker.instances": 1,
+                        "tony.worker.command": "stub"})
+    svc = DriverService(driver)
+    with pytest.raises(RuntimeError, match="chaos"):
+        svc.heartbeat("worker:0")
+    assert "worker:0" not in driver.heartbeats, "a dropped beat records nothing"
+    driver.rpc_server.stop()
+    if driver._metrics_httpd is not None:   # pragma: no cover
+        driver._metrics_httpd.shutdown()
+
+
+def test_chaos_preempt_at_step(tmp_job_dirs, tmp_path, monkeypatch):
+    """TONY_TEST_DRIVER_PREEMPT_AT_STEP: once the gang's pushed
+    train_step reaches the trigger, exactly one seeded preemption drain
+    fires; the drained stub relaunches budget-free and finishes."""
+    monkeypatch.setenv(c.TEST_DRIVER_PREEMPT_AT_STEP, "5")
+    monkeypatch.setenv(c.TEST_DRIVER_CHAOS_SEED, "7")
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        _register_and_barrier(rpc, task_id, 24700 + index)
+        if attempt == 0:
+            rpc.call("update_metrics", task_id=task_id,
+                     metrics=[{"name": "max_train_step", "value": 9}])
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                res = rpc.call("heartbeat", task_id=task_id)
+                if isinstance(res, dict) and res.get("preempt"):
+                    rpc.close()
+                    return c.EXIT_PREEMPTED
+                time.sleep(0.03)
+            rpc.close()
+            return 1
+        rpc.call("register_execution_result", task_id=task_id, exit_code=0)
+        rpc.close()
+        return 0
+
+    driver = _driver(tmp_job_dirs, tmp_path, script, name="chaospreempt",
+                     **{"tony.worker.instances": 1,
+                        "tony.worker.command": "stub",
+                        "tony.task.heartbeat-interval-ms": 100})
+    status = driver.run()
+    assert status == JobStatus.SUCCEEDED, driver.session.failure_message
+    assert driver.provisioner.launches == ["worker:0"] * 2
+    assert driver._chaos_preempt_fired is True
+    text = driver.render_metrics()
+    assert "driver_preemptions_total 1" in text
+    assert "driver_task_restarts_total 0" in text
+
+
+# --------------------------------------------------------------------------
+# executor/train units: flag files, StepTimer poll, overlapped checkpoints
+# --------------------------------------------------------------------------
+
+def test_write_preempt_flag_and_steptimer_poll(tmp_path):
+    """The executor's drain relay meets the training child's poll: the
+    tmp+renamed flag makes preempt_requested stick and is consumed."""
+    from tony_tpu.executor import write_preempt_flag
+    from tony_tpu.train.profiling import StepTimer
+
+    assert write_preempt_flag(None, {"grace_ms": 10}) is None
+    step_log = tmp_path / "w0.steps.jsonl"
+    timer = StepTimer(step_log, window=2)
+    timer.tick()
+    assert timer.preempt_requested is False
+    flag = write_preempt_flag(str(step_log), {"grace_ms": 1500})
+    assert flag == str(step_log) + c.PREEMPT_REQUEST_SUFFIX
+    req = json.loads(Path(flag).read_text())
+    assert req["grace_ms"] == 1500.0
+    # the poll is time-gated at ~0.25s; wait past the gate then tick
+    time.sleep(0.3)
+    timer.tick()
+    assert timer.preempt_requested is True
+    assert not Path(flag).exists(), "the notice is consumed"
+
+
+def test_heartbeater_relays_preempt_command():
+    """A dict heartbeat response carrying 'preempt' reaches on_preempt
+    exactly once (and the profile callback stays untouched)."""
+    from tony_tpu.executor import Heartbeater
+
+    class _Client:
+        def __init__(self):
+            self.beats = 0
+
+        def call(self, method, **params):
+            self.beats += 1
+            if self.beats == 1:
+                return {"preempt": {"grace_ms": 700}}
+            return True
+
+    pre, prof = [], []
+    client = _Client()
+    hb = Heartbeater(client, "worker:0", interval_s=0.01,
+                     on_command=prof.append, on_preempt=pre.append)
+    hb.start()
+    deadline = time.time() + 5
+    while client.beats < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    hb.stop_event.set()
+    hb.join(timeout=5)
+    assert pre == [{"grace_ms": 700}]
+    assert prof == []
+
+
+def test_checkpoint_manager_overlapped_save(tmp_path):
+    """save_async returns immediately after the host snapshot, the
+    background writer finalizes atomically (wait() drains), the newest
+    step wins, and restore round-trips."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from tony_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), save_interval=1)
+    assert mgr.last_saved_step is None
+    mgr.save_async(2, {"w": jnp.arange(4.0), "n": jnp.float32(1)})
+    mgr.save_async(4, {"w": jnp.arange(4.0) * 3, "n": jnp.float32(9)})
+    mgr.wait()
+    assert mgr.last_saved_step == 4
+    assert mgr.latest_step() == 4
+    restored = mgr.restore(template={"w": jnp.zeros(4), "n": jnp.float32(0)})
+    assert float(restored["n"]) == 9.0
+    assert float(restored["w"][2]) == 6.0
+    mgr.close()
+
+
+def test_jax_ranks_follow_real_task_identity_after_resize():
+    """A resized gang's cluster spec is COMPACTED; rank assignment must
+    key off real task ids, not list positions — otherwise the survivor
+    above the detached slot gets no rank entry and falls back to a
+    process_id >= num_processes, and the re-formed gang can never
+    initialize jax.distributed."""
+    from tony_tpu.runtimes.jax_runtime import JaxDriverAdapter
+    from tony_tpu.session import Session
+
+    conf = TonyConf({"tony.worker.instances": 3,
+                     "tony.worker.command": "stub"})
+    s = Session(conf)
+    for i in range(3):
+        assert s.register_task(f"worker:{i}", "h", 100 + i) is not None
+    adapter = JaxDriverAdapter()
+    adapter.set_session(s)
+    full = adapter.cluster_spec_payload("worker:0")
+    assert full["ranks"] == {"worker:0": 0, "worker:1": 1, "worker:2": 2}
+
+    s.detach_task("worker:1")           # lost past its budget
+    s.begin_generation()
+    assert s.register_task("worker:0", "h", 100) is not None
+    assert s.register_task("worker:2", "h", 102) is not None
+    payload = adapter.cluster_spec_payload("worker:0")
+    assert payload["ranks"] == {"worker:0": 0, "worker:2": 1}, payload
+    assert payload["num_processes"] == 2
+    assert payload["coordinator_address"] == "h:100"
+    assert payload["cluster"]["worker"] == ["h:100", "h:102"]
+    assert payload["gang_generation"] == 1
+
+
+def test_session_detach_semantics():
+    """Session-level resize contract: detached slots leave the barrier
+    predicate, the cluster spec, registration, and the tracked set; a
+    generation bump forces full re-registration."""
+    from tony_tpu.session import Session
+
+    conf = TonyConf({"tony.worker.instances": 2,
+                     "tony.worker.command": "stub"})
+    s = Session(conf)
+    assert s.register_task("worker:0", "h", 1) is not None
+    assert s.register_task("worker:1", "h", 2) is not None
+    assert s.all_registered()
+    assert s.detach_task("worker:1")
+    assert s.all_registered(), "detached slots are not gang-gated"
+    assert s.cluster_spec() == {"worker": ["h:1"]}
+    assert [t.task_id for t in s.tracked_tasks()] == ["worker:0"]
+    assert s.register_task("worker:1", "h", 3) is None, (
+        "a detached slot's zombie may not re-register")
+    gen = s.begin_generation()
+    assert gen == 1 and not s.all_registered()
+    assert s.reattach_task("worker:1")
+    assert s.register_task("worker:1", "h", 4) is not None
+    assert not s.all_registered()       # worker:0 must re-register too
+    assert s.register_task("worker:0", "h", 1) is not None
+    assert s.all_registered()
+
+
+# --------------------------------------------------------------------------
+# TINY e2e: SIGKILL mid-train -> resize -> checkpoint resume, step-continuous
+# --------------------------------------------------------------------------
+
+def _step_sequence(step_log: Path) -> list[int]:
+    steps = []
+    for line in step_log.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec.get("train_step"), int):
+            steps.append(rec["train_step"])
+    return steps
+
+
+def _continuity(steps: list[int]) -> int:
+    """Recomputed-step count over a multi-attempt StepTimer sequence;
+    asserts there is never a silent skip."""
+    recomputed = 0
+    for prev, cur in zip(steps, steps[1:]):
+        if cur <= prev:     # attempt boundary: resumed from a checkpoint
+            recomputed += prev - cur + 1
+        else:
+            assert cur == prev + 1, (
+                f"silent step skip: {prev} -> {cur} in {steps}")
+    return recomputed
+
+
+def test_e2e_sigkill_resize_checkpoint_resume(tmp_job_dirs, tmp_path):
+    """The acceptance scenario end to end on the real stack: a 2-worker
+    elastic job runs the elastic_train drill; worker:1's child SIGKILLs
+    itself at step 12 on EVERY attempt with a 1-restart budget. Kill #1
+    spends the budget and the relaunch REWINDS to the latest checkpoint
+    (a real recompute, bounded by save_interval, asserted from the
+    StepTimer JSONL); kill #2 exhausts the budget and the driver resizes
+    the gang down instead of failing the job — the survivor drains on
+    the SIGTERM (checkpoint at the step boundary), relaunches budget-
+    free at world size 1, and finishes. Both the resize and the restart
+    are visible in tasks.trace.jsonl; no log shows a silent step skip."""
+    import sys
+
+    from tony_tpu.client import TonyClient
+
+    ckpt_root = tmp_path / "ckpts"
+    ckpt_root.mkdir()
+    save_interval = 5
+    total_steps = 40
+    cmd = (f"{sys.executable} -m tony_tpu.examples.elastic_train "
+           f"--steps {total_steps} --save-interval {save_interval} "
+           f"--ckpt-dir {ckpt_root}/w$TONY_TASK_INDEX")
+    conf = _conf(
+        tmp_job_dirs,
+        **{"tony.worker.instances": 2,
+           "tony.worker.command": cmd,
+           "tony.worker.max-restarts": 1,
+           "tony.train.elastic-enabled": True,
+           "tony.train.elastic-min-instances": 1,
+           "tony.train.rescale-retry-ms": 600000,   # stay resized down
+           "tony.task.preempt-grace-ms": 4000,
+           "tony.task.heartbeat-interval-ms": 250,
+           "tony.task.metrics-interval-ms": 500,
+           "tony.execution.env": " ".join([
+               "ELASTIC_TRAIN_STEP_MS=60",
+               "ELASTIC_TRAIN_KILL=1:12",     # fires on every attempt
+               "JAX_PLATFORMS=cpu",
+           ])})
+    client = TonyClient(conf, poll_interval_s=0.2)
+    client.submit()
+    status = client.monitor()
+    logs = "\n".join(
+        f"==== {p} ====\n{p.read_text()[-2500:]}"
+        for p in sorted(Path(client.job_dir).rglob("*.std*")))
+    assert status == JobStatus.SUCCEEDED, logs
+
+    # gang resize + the budgeted restart are visible in the task traces
+    inter = Path(tmp_job_dirs["history"]) / "intermediate" / client.app_id
+    recs = {r["id"]: r for r in read_traces(inter / TASK_TRACE_FILE)}
+    w0, w1 = _span_names(recs["worker:0"]), _span_names(recs["worker:1"])
+    assert "resized" in w0 and "resized" in w1, (w0, w1)
+    assert w0[-1] == "finished"
+    assert recs["worker:0"]["attrs"]["restarts"] == 0, (
+        "the survivor's drain relaunch must be budget-free")
+    assert w1.count("restarted") == 1, w1
+    assert recs["worker:1"]["attrs"]["restarts"] == 1
+
+    # step-counter continuity from the StepTimer JSONLs. worker:1's
+    # budgeted restart is a REAL rewind: it resumed from the latest
+    # checkpoint, recomputing at least one and at most save_interval
+    # steps. worker:0's drain checkpointed at the exit boundary, so its
+    # relaunch recomputes nothing. Neither log may skip a step.
+    w1_steps = _step_sequence(
+        Path(client.job_dir) / "logs" / "worker_1.steps.jsonl")
+    assert w1_steps, "worker:1 left no step records"
+    w1_recomputed = _continuity(w1_steps)
+    assert 1 <= w1_recomputed <= save_interval, (w1_recomputed, w1_steps)
+
+    w0_steps = _step_sequence(
+        Path(client.job_dir) / "logs" / "worker_0.steps.jsonl")
+    assert w0_steps, "worker:0 left no step records"
+    assert _continuity(w0_steps) <= save_interval, w0_steps
+    assert w0_steps[0] == 0 and w0_steps[-1] == total_steps - 1, w0_steps

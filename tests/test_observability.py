@@ -607,6 +607,16 @@ def test_metrics_names_rendered_and_documented():
         assert fam in rendered, f"fleet family unrendered: {fam}"
         assert fam in doc_names, f"fleet family undocumented: {fam}"
 
+    # the elastic-training families are pinned EXPLICITLY the same way
+    # (ISSUE 9 lint discipline): each must be rendered by the driver
+    # /metrics endpoint and documented — renaming either side without
+    # the other fails here
+    for fam in (_metrics.DRIVER_PREEMPTIONS_TOTAL,
+                _metrics.DRIVER_GANG_RESIZES_TOTAL,
+                _metrics.DRIVER_CHECKPOINT_AGE_S):
+        assert fam in rendered, f"elastic family unrendered: {fam}"
+        assert fam in doc_names, f"elastic family undocumented: {fam}"
+
 
 def test_telemetry_trace_feed_units():
     """observe_trace maps spans to the right histograms, including the

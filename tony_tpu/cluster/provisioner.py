@@ -61,6 +61,14 @@ class Provisioner:
     def stop_container(self, handle: ContainerHandle) -> None:
         raise NotImplementedError
 
+    def kill_container(self, handle: ContainerHandle) -> None:
+        """Hard-kill one container with NO drain grace — the driver's
+        chaos harness and tests use it to model abrupt host death
+        (SIGKILL), unlike stop_container's SIGTERM-then-escalate.
+        Default falls back to the graceful stop for provisioners without
+        a harder hammer."""
+        self.stop_container(handle)
+
     def stop_all(self) -> None:
         raise NotImplementedError
 
@@ -74,6 +82,15 @@ class Provisioner:
 class LocalProvisioner(Provisioner):
     """Executors as local subprocesses; per-task stdout/stderr files mirror
     YARN container log dirs."""
+
+    # how long stop_container waits for the SIGTERM'd executor before
+    # escalating to a group SIGKILL. NOTE: for driver-initiated drains
+    # (rolls, elastic resize) this also bounds the EFFECTIVE preemption
+    # grace — a child still checkpointing when the window closes is
+    # SIGKILLed with its executor (docs/training-robustness.md) — so a
+    # deployment raising tony.task.preempt-grace-ms past this should
+    # raise it too.
+    stop_wait_s = 5.0
 
     def __init__(self) -> None:
         super().__init__()
@@ -139,12 +156,24 @@ class LocalProvisioner(Provisioner):
         except (ProcessLookupError, PermissionError):
             return
         try:
-            proc.wait(timeout=5)
+            proc.wait(timeout=self.stop_wait_s)
         except subprocess.TimeoutExpired:
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+
+    def kill_container(self, handle: ContainerHandle) -> None:
+        """SIGKILL the whole process group immediately (abrupt host
+        death for the chaos harness); the watcher thread reports the
+        completion like any crash."""
+        proc = handle.process
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
     def stop_all(self) -> None:
         with self._lock:
@@ -225,6 +254,10 @@ class StaticHostProvisioner(Provisioner):
 
     def stop_container(self, handle: ContainerHandle) -> None:
         self._local.stop_container(handle)
+
+    def kill_container(self, handle: ContainerHandle) -> None:
+        # kills the local ssh client; sshd tears down the remote session
+        self._local.kill_container(handle)
 
     def stop_all(self) -> None:
         self._local.stop_all()

@@ -47,6 +47,10 @@ TASK_EXECUTOR_EXECUTION_TIMEOUT_MS = "tony.task.executor.execution-timeout-ms"
 TASK_PORT_REUSE_ENABLED = "tony.task.port-reuse-enabled"      # SO_REUSEPORT rendezvous port
 TASK_TB_PORT_REUSE_ENABLED = "tony.task.tb-port-reuse-enabled"  # SO_REUSEPORT TB port
 TASK_MAX_TOTAL_INSTANCES = "tony.task.max-total-instances"
+# drain grace for a preemption notice (heartbeat "preempting" command or
+# an executor-received SIGTERM): how long the executor gives the training
+# child to checkpoint at a step boundary before killing it
+TASK_PREEMPT_GRACE_MS = "tony.task.preempt-grace-ms"
 TASK_MAX_TOTAL_MEMORY_MB = "tony.task.max-total-memory-mb"
 TASK_MAX_TOTAL_CHIPS = "tony.task.max-total-chips"
 
@@ -149,6 +153,27 @@ SERVING_HEALTHZ_DOWN_POLLS = "tony.serving.healthz-down-polls"
 # the adapter gives up (model load + first compile can dominate)
 SERVING_READY_TIMEOUT_MS = "tony.serving.ready-timeout-ms"
 
+# ------------------------------------------------------------------ training
+# elastic, preemption-tolerant training (docs/training-robustness.md):
+# with elastic enabled, a worker lost beyond its restart budget detaches
+# from the gang instead of failing the job — the driver bumps the gang
+# generation, survivors drain (checkpoint) and re-register at the new
+# world size, and the detached slot is retried every rescale-retry-ms
+# until capacity returns (then the gang resizes back up).
+TRAIN_ELASTIC_ENABLED = "tony.train.elastic-enabled"
+# floor on the surviving world size: a resize that would drop the role
+# below this (or lose the chief) fails the job like before
+TRAIN_ELASTIC_MIN_INSTANCES = "tony.train.elastic-min-instances"
+TRAIN_RESCALE_RETRY_MS = "tony.train.rescale-retry-ms"
+# straggler action: a worker whose pushed step-time p50 exceeds
+# factor x the role median gets a budget-charged restart through the
+# normal _try_restart_task path. 0 disables (observation-only, the PR 5
+# behavior); sane values start around 2-3.
+TRAIN_STRAGGLER_RESTART_FACTOR = "tony.train.straggler-restart-factor"
+# consecutive monitor checks a task must look slow before the restart
+# fires (one noisy push must not cost a budget unit)
+TRAIN_STRAGGLER_GRACE_CHECKS = "tony.train.straggler-grace-checks"
+
 # ------------------------------------------------------------------ horovod
 HOROVOD_TEST_MODE = "tony.horovod.mode.test"              # stub rendezvous server
 HOROVOD_FAST_FAIL = "tony.horovod.driver.fast-fail"       # driver exits 1 at once
@@ -176,7 +201,8 @@ ROLE_KEY_TEMPLATES = (
 _ROLE_KEY_RE = re.compile(r"^tony\.([A-Za-z][A-Za-z0-9_\-]*)\.instances$")
 _RESERVED_NON_ROLES = frozenset(
     {"application", "am", "task", "staging", "history", "cluster", "tpu",
-     "security", "execution", "horovod", "version", "serving", "router"}
+     "security", "execution", "horovod", "version", "serving", "router",
+     "train"}
 )
 
 

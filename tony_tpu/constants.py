@@ -42,6 +42,13 @@ ENV_SERVE_PORT = "TONY_SERVE_PORT"  # serving job type (runtimes/serving.py):
                                   # the adapter advertises it as serve_port/
                                   # metrics_port via the publish_ports RPC
 
+ENV_GANG_GENERATION = "TONY_GANG_GENERATION"  # which gang formation this
+                                  # attempt belongs to: bumped by every
+                                  # elastic resize (worker lost past its
+                                  # budget / capacity returned), so a
+                                  # training child can label its stream
+                                  # and tooling can tell formations apart
+
 # JAX runtime contract (replaces TF_CONFIG/Gloo/DMLC matrix — SURVEY.md §5):
 ENV_COORDINATOR_ADDRESS = "TONY_COORDINATOR_ADDRESS"
 ENV_PROCESS_ID = "TONY_PROCESS_ID"
@@ -69,6 +76,14 @@ DRIVER_INFO_FILE = "driver.json"          # driver's rpc endpoint, written at pr
 # record cadence, captures a jax.profiler trace for N seconds into
 # out_dir, and deletes the flag.
 PROFILE_REQUEST_SUFFIX = ".profile"
+# preemption-drain flag file (docs/training-robustness.md): the executor
+# writes `$TONY_STEP_LOG<suffix>` (JSON: {"grace_ms": N}, tmp+rename)
+# when the driver relays a "preempting" notice over the heartbeat RPC —
+# or when the executor itself receives the cloud's SIGTERM. The training
+# child's StepTimer polls for it (time-gated, every ~0.25s of steps),
+# the loop checkpoints at the next step boundary and exits
+# EXIT_PREEMPTED; the driver relaunches WITHOUT spending restart budget.
+PREEMPT_REQUEST_SUFFIX = ".preempt"
 # subdirectory (under the job's logs dir / serve --trace-dir) where
 # captured xplane profiles land; the portal lists it on /profiles/<app>
 PROFILE_DIR_NAME = "profiles"
@@ -95,6 +110,22 @@ TEST_SERVING_STEP_DELAY_MS = "TONY_TEST_SERVING_STEP_DELAY_MS"
 #   added latency per scheduling turn: makes a fast test backend behave
 #   like a slow device so overload/shedding paths actually engage
 TEST_SERVING_CHAOS_SEED = "TONY_TEST_SERVING_CHAOS_SEED"
+
+# driver-side chaos hooks (driver.py monitor loop; read once at
+# construction, seeded so a chaos run's fault sequence is reproducible —
+# the cluster-side mirror of the serving knobs above, exercised by
+# `bench.py --elastic`):
+TEST_DRIVER_KILL_RATE = "TONY_TEST_DRIVER_KILL_RATE"
+#   probability per monitor tick that one random RUNNING task's container
+#   is SIGKILLed (abrupt crash — spends restart budget / triggers resize)
+TEST_DRIVER_PREEMPT_AT_STEP = "TONY_TEST_DRIVER_PREEMPT_AT_STEP"
+#   once the gang's max observed training step (pushed StepTimer
+#   metrics) reaches N, one seeded-random task receives a preemption
+#   drain notice (budget-free, like a real spot reclaim with notice)
+TEST_DRIVER_HEARTBEAT_DROP_RATE = "TONY_TEST_DRIVER_HEARTBEAT_DROP_RATE"
+#   probability that an incoming heartbeat RPC errors instead of being
+#   recorded — a lossy control plane; exercises liveness margins
+TEST_DRIVER_CHAOS_SEED = "TONY_TEST_DRIVER_CHAOS_SEED"
 TEST_ALLOCATION_HOLD = "TONY_TEST_ALLOCATION_HOLD"          # "role#idx" never gets
 #   capacity: the driver skips its launch so the gang waits — exercises the
 #   allocation-timeout deadlock breaker (reference MLGenericRuntime.java:110-147)
@@ -107,3 +138,6 @@ TEST_ALLOCATION_HOLD = "TONY_TEST_ALLOCATION_HOLD"          # "role#idx" never g
 EXIT_SUCCESS = 0
 EXIT_FAILURE = 1
 EXIT_KILLED = 137
+# a training child that drained on a preemption notice (checkpointed at
+# the step boundary, then exited) — the driver relaunches budget-free
+EXIT_PREEMPTED = 79

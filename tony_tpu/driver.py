@@ -38,9 +38,12 @@ from .events.types import (
     task_trace,
 )
 from .metrics import (
+    DRIVER_CHECKPOINT_AGE_S,
     DRIVER_GANG_LAUNCH_SECONDS,
+    DRIVER_GANG_RESIZES_TOTAL,
     DRIVER_HEARTBEAT_EXPIRED_TOTAL,
     DRIVER_HEARTBEAT_INTERVAL_SECONDS,
+    DRIVER_PREEMPTIONS_TOTAL,
     DRIVER_STRAGGLER_HEARTBEAT_S,
     DRIVER_STRAGGLER_REGISTRATION_S,
     DRIVER_TASK_METRIC,
@@ -108,19 +111,31 @@ class DriverService:
         return self.heartbeat(task_id)
 
     def heartbeat(self, task_id: str):
-        """Returns True, or — when a profile capture is pending for this
-        task — a one-shot ``{"profile": {...}}`` command dict. The
-        heartbeat is the only driver->executor channel that already
-        exists at steady state, so commands piggyback on its response
-        (the executor's Heartbeater relays them; see
-        Driver.request_profile)."""
+        """Returns True, or — when a command is pending for this task — a
+        one-shot dict: ``{"profile": {...}}`` (on-demand capture) and/or
+        ``{"preempt": {...}}`` (drain notice: checkpoint at the next step
+        boundary and exit). The heartbeat is the only driver->executor
+        channel that already exists at steady state, so commands
+        piggyback on its response (the executor's Heartbeater relays
+        them; see Driver.request_profile / Driver.preempt_task)."""
         d = self._d
+        if d._chaos_hb_drop and d._chaos_rng.random() < d._chaos_hb_drop:
+            # fault injection: the beat is lost in transit — the caller
+            # sees an RPC error and counts a miss, the driver records
+            # nothing (a dropped packet updates no one's clock)
+            raise RuntimeError("chaos: heartbeat dropped")
         prev = d.heartbeats.get(task_id)
         now = time.time()
         d.heartbeats[task_id] = now
         d._on_heartbeat(task_id, prev, now)
-        cmd = d.take_profile_command(task_id)
-        return {"profile": cmd} if cmd else True
+        cmd: dict[str, Any] = {}
+        prof = d.take_profile_command(task_id)
+        if prof:
+            cmd["profile"] = prof
+        pre = d.take_preempt_command(task_id)
+        if pre:
+            cmd["preempt"] = pre
+        return cmd or True
 
     def register_execution_result(self, task_id: str, exit_code: int) -> str:
         log.info("%s reported exit code %d", task_id, exit_code)
@@ -149,6 +164,24 @@ class DriverService:
         procedure: roll replicas one at a time behind the router (docs/
         serving.md "Fleet serving")."""
         return self._d.roll_task(task_id)
+
+    def preempt_task(self, task_id: str) -> bool:
+        """Relay a preemption notice to one RUNNING task (client-
+        privileged when token auth is on): the operator/cloud knows the
+        task's capacity is about to be reclaimed. The notice rides the
+        task's next heartbeat response, the executor drops the
+        ``$TONY_STEP_LOG.preempt`` flag, the training child checkpoints
+        at its next step boundary and exits, and the driver relaunches
+        WITHOUT spending restart budget (trace mark ``preempted``). See
+        docs/training-robustness.md."""
+        return self._d.preempt_task(task_id)
+
+    def notify_preemption(self, task_id: str) -> bool:
+        """An executor reports that IT received the preemption signal
+        (cloud SIGTERM to its host): the driver marks the task mid-
+        preempt so the coming container exit relaunches budget-free —
+        the executor-initiated half of the drain contract."""
+        return self._d.note_preemption(task_id, source="executor")
 
     def register_tensorboard_url(self, url: str) -> bool:
         self._d.tensorboard_url = url
@@ -263,12 +296,16 @@ class Driver:
                 "client": derive_role_key(token, "client"),
                 "executor": self.executor_token,
             }
-            # profile/roll commands are operator actions, like ending
-            # the job: an executor key must not be able to aim the
-            # profiler at — or restart — its peers
+            # profile/roll/preempt commands are operator actions, like
+            # ending the job: an executor key must not be able to aim
+            # the profiler at — or restart/drain — its peers.
+            # notify_preemption stays executor-callable: it only declares
+            # the CALLER's own fate (the wire method rejects nothing an
+            # executor couldn't do by exiting EXIT_PREEMPTED anyway)
             acl = {"finish_application": {"client"},
                    "request_task_profile": {"client"},
-                   "roll_task": {"client"}}
+                   "roll_task": {"client"},
+                   "preempt_task": {"client"}}
         self.rpc_server = RpcServer(
             host=str(conf.get(keys.AM_RPC_HOST, "127.0.0.1")), token=token,
             roles=roles, acl=acl,
@@ -278,11 +315,14 @@ class Driver:
         self._handles: dict[str, ContainerHandle] = {}  # task_id -> handle
         self._launch_ms: dict[str, int] = {}            # task_id -> launch time
         self._restarts: dict[str, int] = {}             # task_id -> restarts used
-        # serializes the two restart paths — container completion (watcher
-        # threads) and heartbeat expiry (monitor thread) — so a crash that
-        # coincides with heartbeat death can't double-spend the budget or
-        # kill the replacement the other path just launched
-        self._restart_lock = threading.Lock()
+        # serializes the restart/preempt/resize paths — container
+        # completion (watcher threads), heartbeat expiry (monitor
+        # thread), and elastic resize — so a crash that coincides with
+        # heartbeat death can't double-spend the budget or kill the
+        # replacement the other path just launched. Reentrant: a
+        # completion handled under the lock may escalate into a resize
+        # that takes it again.
+        self._restart_lock = threading.RLock()
         self._retries_left = conf.get_int(keys.AM_RETRY_COUNT, 0)
         self._start_ms = now_ms()
 
@@ -316,6 +356,66 @@ class Driver:
         # per container, so plain set semantics suffice.
         self._rolls: set[str] = set()
         self._roll_count = 0
+        # ---- elastic, preemption-tolerant training state ----
+        # (docs/training-robustness.md). Tasks mid-preemption-drain: the
+        # driver relayed (or was told of) a "preempting" notice; the
+        # container's exit relaunches budget-free, trace-marked
+        # 'preempted'. Same ledger discipline as rolls.
+        self._preempts: set[str] = set()
+        self._preempt_count = 0
+        self._preempt_cmds: set[str] = set()     # pending heartbeat relays
+        # survivors mid-resize-drain: their exits relaunch budget-free
+        # into the new gang generation
+        self._resizes: set[str] = set()
+        self._resize_count = 0
+        self._detach_t: dict[str, float] = {}    # task -> detach monotime
+        # stops the DRIVER itself initiated (fault-injection kill,
+        # heartbeat-expiry stop, straggler stop): the dying executor's
+        # SIGTERM handler will dutifully report a "preemption", and
+        # honoring it would relabel a deliberate kill as budget-free.
+        # Cleared when the task's next attempt launches.
+        self._driver_stops: set[str] = set()
+        self._elastic = conf.get_bool(keys.TRAIN_ELASTIC_ENABLED, False)
+        self._elastic_min = conf.get_int(keys.TRAIN_ELASTIC_MIN_INSTANCES, 1)
+        self._rescale_retry_s = conf.get_int(
+            keys.TRAIN_RESCALE_RETRY_MS, 30000) / 1000
+        # straggler action: consecutive slow strikes per task, plus a
+        # once-per-condition log guard for budgetless stragglers
+        self._straggler_factor = float(
+            conf.get(keys.TRAIN_STRAGGLER_RESTART_FACTOR, 0) or 0)
+        self._straggler_grace = max(
+            1, conf.get_int(keys.TRAIN_STRAGGLER_GRACE_CHECKS, 3))
+        self._straggler_strikes: dict[str, int] = {}
+        self._straggler_check_t = 0.0
+        # seeded driver chaos (TONY_TEST_DRIVER_*, constants.py) — the
+        # cluster-side mirror of the serving chaos knobs; read once so a
+        # run's fault sequence is reproducible from the seed
+        import random as _random
+
+        def _rate(name):
+            try:
+                return min(1.0, max(0.0, float(os.environ.get(name, "0"))))
+            except ValueError:
+                log.error("bad %s value; chaos knob disabled", name)
+                return 0.0
+
+        self._chaos_kill_rate = _rate(c.TEST_DRIVER_KILL_RATE)
+        self._chaos_hb_drop = _rate(c.TEST_DRIVER_HEARTBEAT_DROP_RATE)
+        try:
+            self._chaos_preempt_at = int(
+                os.environ.get(c.TEST_DRIVER_PREEMPT_AT_STEP, "0"))
+        except ValueError:
+            log.error("bad %s value; chaos knob disabled",
+                      c.TEST_DRIVER_PREEMPT_AT_STEP)
+            self._chaos_preempt_at = 0
+        self._chaos_preempt_fired = False
+        self._chaos_rng = _random.Random(
+            int(os.environ.get(c.TEST_DRIVER_CHAOS_SEED, "0") or 0))
+        if self._chaos_kill_rate or self._chaos_hb_drop or self._chaos_preempt_at:
+            log.warning(
+                "driver chaos armed: kill_rate=%s hb_drop=%s "
+                "preempt_at_step=%s", self._chaos_kill_rate,
+                self._chaos_hb_drop, self._chaos_preempt_at)
         # compile visibility for code running IN the driver process
         # (enable-preprocess / notebook jobs): the driver's /metrics
         # carries its own compile histogram next to the compile totals
@@ -441,12 +541,21 @@ class Driver:
                          task.task_id)
                 continue
             env = self._task_env(spec, index)
-            handle = self.provisioner.launch(
-                spec, index, env, self.job_dir / "logs"
-            )
-            task.status = TaskStatus.ALLOCATED
+            # launch + handle publication are atomic vs the completion
+            # callback (which takes the same lock): a container that
+            # exits faster than this thread stores its handle would
+            # otherwise read as "superseded" and its completion would be
+            # silently dropped, orphaning the task. The ALLOCATED
+            # transition is upgrade-only for the sibling race (a fast
+            # executor REGISTERING before this bookkeeping finishes must
+            # not be stomped back from RUNNING).
+            with self._restart_lock:
+                handle = self.provisioner.launch(
+                    spec, index, env, self.job_dir / "logs"
+                )
+                self._handles[task.task_id] = handle
+            self.session.note_allocated(task.task_id, handle.container_id)
             self._trace_mark(task.task_id, "allocated", host=handle.host)
-            task.container_id = handle.container_id
             task.host = handle.host
             # per-task log URL, surfaced to the client and portal (reference
             # prints each container's log URL, util/Utils.java:220-235). The
@@ -455,7 +564,6 @@ class Driver:
             task.url = handle.extra.get("log_path") or str(
                 self.job_dir / "logs" / f"{spec.name}_{index}.stdout"
             )
-            self._handles[task.task_id] = handle
             self._launch_ms[task.task_id] = now_ms()
             self._trace_mark(task.task_id, "launched")
             if self.events:
@@ -472,7 +580,12 @@ class Driver:
             c.ENV_JOB_NAME: spec.name,
             c.ENV_TASK_INDEX: str(index),
             c.ENV_TASK_NUM: str(spec.instances),
-            c.ENV_NUM_TOTAL_TASKS: str(len(self.session.all_tasks())),
+            # ACTIVE complement: an elastically-resized gang launches its
+            # attempts with the formation it is actually forming (the
+            # authoritative world size still arrives with the cluster
+            # spec at barrier time)
+            c.ENV_NUM_TOTAL_TASKS: str(len(self.session.active_tasks())),
+            c.ENV_GANG_GENERATION: str(self.session.gang_generation),
             c.ENV_IS_CHIEF: str(self.session.is_chief(spec.name, index)).lower(),
             c.ENV_SESSION_ID: str(self.session.session_id),
             c.ENV_DISTRIBUTED_MODE: self.mode.value,
@@ -755,7 +868,8 @@ class Driver:
         for task_id, last in list(self.heartbeats.items()):
             task = self.session.get_task_by_id(task_id)
             if (task is None or task.status.is_terminal()
-                    or task.exit_code is not None):
+                    or task.exit_code is not None
+                    or task_id in self.session.detached):
                 continue
             beats[task_id] = last
         with self._tt_lock:
@@ -777,6 +891,12 @@ class Driver:
             r.counter(DRIVER_TASK_ROLLS_TOTAL, self._roll_count,
                       "deliberate rolling restarts (roll_task RPC; "
                       "budget-free)")
+            r.counter(DRIVER_PREEMPTIONS_TOTAL, self._preempt_count,
+                      "preemption drains relayed or reported "
+                      "(budget-free relaunches)")
+            r.counter(DRIVER_GANG_RESIZES_TOTAL, self._resize_count,
+                      "elastic gang re-formations (down on worker loss "
+                      "past its budget, up when capacity returned)")
             reg = dict(self._reg_t)
         # driver-process XLA compile telemetry (preprocess/notebook jobs
         # run user code in-process); each training CHILD's compile totals
@@ -796,9 +916,27 @@ class Driver:
         counts: dict[str, int] = {}
         for t in self.session.all_tasks():
             counts[t.status.value] = counts.get(t.status.value, 0) + 1
+        # detached is a formation state, not a task status: a slot can be
+        # RUNNING *and* detached mid-drain — render it as its own series
+        counts["detached"] = len(self.session.detached)
         for status in sorted(counts):
             r.gauge(DRIVER_TASKS, counts[status], "tasks by state",
                     labels={"state": status})
+        # checkpoint recency per task (pushed ckpt_unix_ts from the
+        # training child's StepTimer records): how many seconds of
+        # training this worker would recompute if it died right now.
+        # Cross-host NTP skew shifts it like every executor wall-clock
+        # sample; the bound it guards is seconds-scale, skew is ms-scale.
+        from .metrics import CKPT_UNIX_TS
+
+        for task_id in sorted(self.metrics):
+            ts = self._pushed_metric(task_id, f"max_{CKPT_UNIX_TS}")
+            if ts:
+                r.gauge(DRIVER_CHECKPOINT_AGE_S,
+                        round(max(0.0, now_wall - ts), 3),
+                        "age of the newest checkpoint each worker "
+                        "reported (StepTimer note_checkpoint)",
+                        labels={"task": task_id})
         for task_id, ports in sorted(self.session.service_ports().items()):
             for pname, port in sorted(ports.items()):
                 r.gauge(DRIVER_TASK_SERVICE_PORT, port,
@@ -891,10 +1029,22 @@ class Driver:
         ):
             # a deliberate roll relaunches on ANY exit code (the drained
             # serve child exits 0, its executor 137) without touching
-            # the budget; failures then fall through to the budgeted path
+            # the budget; so do a preemption drain and a resize drain —
+            # all three are ledgered, deliberate exits, not failures.
+            # Failures then fall through to the budgeted path, and a
+            # budget-exhausted loss tries the elastic resize before the
+            # completion policy gets to fail the job.
             if self._discharge_roll(task_id):
                 return
+            if self._discharge_resize(task_id):
+                return
+            if self._discharge_preempt(task_id, exit_code):
+                return
             if exit_code != 0 and self._try_restart_task(task_id, exit_code):
+                return
+            if (exit_code != 0 and self._elastic_candidate(task_id)
+                    and self._resize_down(task_id,
+                                          cause=f"exited {exit_code}")):
                 return
         already_terminal = task.status.is_terminal()
         name, _, idx = task_id.partition(":")
@@ -926,11 +1076,15 @@ class Driver:
         used = self._restarts.get(task_id, 0)
         if used >= spec.max_restarts:
             return False
-        # a FAILURE restart supersedes any pending roll: the wedged/
-        # crashed attempt is being replaced right here, and a stale
-        # ledger entry would mislabel the NEXT crash as a budget-free
-        # 'rolled' relaunch
+        # a FAILURE restart supersedes any pending roll/preempt/resize
+        # ledger entry: the wedged/crashed attempt is being replaced
+        # right here, and a stale entry would mislabel the NEXT crash as
+        # a budget-free relaunch
         self._rolls.discard(task_id)
+        self._preempts.discard(task_id)
+        self._preempt_cmds.discard(task_id)
+        self._resizes.discard(task_id)
+        self._straggler_strikes.pop(task_id, None)
         self._restarts[task_id] = used + 1
         log.warning(
             "task %s %s; restarting (%d/%d)",
@@ -954,16 +1108,27 @@ class Driver:
         task = self.session.get_task_by_id(task_id)
         task.status = TaskStatus.REQUESTED
         task.exit_code = None  # re-arm heartbeat liveness for the new attempt
+        # fresh attempt, clean slate: a deliberate-stop marker or a LATE
+        # preemption report from the superseded attempt (the executor's
+        # notify can straggle behind its own exit) must not leak onto
+        # the replacement — a stale _preempts entry would let the new
+        # attempt's first genuine crash escape the restart budget
+        self._driver_stops.discard(task_id)
+        self._preempts.discard(task_id)
+        self._preempt_cmds.discard(task_id)
         # the old attempt's published service ports are dead endpoints;
         # consumers (the fleet router's discovery) must not route to them
         task.ports.clear()
         self._trace_mark(task_id, "requested")
         env = self._task_env(spec, idx)
-        handle = self.provisioner.launch(spec, idx, env, self.job_dir / "logs")
-        task.status = TaskStatus.ALLOCATED
-        task.container_id = handle.container_id
+        # same launch/handle atomicity as _request_role (reentrant: the
+        # discharge paths already hold the lock)
+        with self._restart_lock:
+            handle = self.provisioner.launch(
+                spec, idx, env, self.job_dir / "logs")
+            self._handles[task_id] = handle
+        self.session.note_allocated(task_id, handle.container_id)
         self._trace_mark(task_id, "allocated", host=handle.host)
-        self._handles[task_id] = handle
         self._launch_ms[task_id] = now_ms()
         self._trace_mark(task_id, "launched")
         self.heartbeats.pop(task_id, None)
@@ -1032,6 +1197,278 @@ class Driver:
         self._relaunch_task(task_id, spec, int(idx))
         return True
 
+    # -------------------------------------------------- preemption drain
+    def preempt_task(self, task_id: str) -> bool:
+        """Relay a preemption notice (preempt_task RPC / chaos): queue a
+        one-shot ``preempt`` command on the task's heartbeat response.
+        The executor drops the drain flag, the training child checkpoints
+        at its next step boundary and exits, and the completion relaunches
+        budget-free with a ``preempted`` trace mark. False for unknown /
+        not-yet-running / terminal tasks."""
+        task = self.session.get_task_by_id(task_id)
+        if task is None or task.status != TaskStatus.RUNNING:
+            return False
+        with self._restart_lock:
+            if task_id not in self._handles:
+                return False
+            first = task_id not in self._preempts
+            self._preempts.add(task_id)
+            self._preempt_cmds.add(task_id)
+        if first:
+            with self._tt_lock:
+                self._preempt_count += 1
+            self._trace_mark(task_id, "preempting", preempt_source="driver")
+        log.warning("preempting %s: drain notice queued on its heartbeat",
+                    task_id)
+        return True
+
+    def note_preemption(self, task_id: str, source: str = "executor") -> bool:
+        """The task's own executor reports an external preemption signal
+        (cloud SIGTERM): no command to relay — the executor is already
+        draining its child — just mark the pending exit budget-free."""
+        task = self.session.get_task_by_id(task_id)
+        if (task is None or task.status.is_terminal()
+                or self._stop_requested.is_set()):
+            return False
+        with self._restart_lock:
+            if (task_id in self._resizes or task_id in self._rolls
+                    or task_id in self._driver_stops):
+                # the driver initiated this SIGTERM itself (resize drain,
+                # roll, or a deliberate kill); the exit is already
+                # accounted for and must not relabel as a preemption
+                return True
+            first = task_id not in self._preempts
+            self._preempts.add(task_id)
+        if first:
+            with self._tt_lock:
+                self._preempt_count += 1
+            self._trace_mark(task_id, "preempting", preempt_source=source)
+            log.warning("%s reports preemption (%s); its exit is budget-free",
+                        task_id, source)
+        return True
+
+    def take_preempt_command(self, task_id: str) -> dict | None:
+        """One-shot drain of a pending preempt relay (heartbeat path)."""
+        with self._restart_lock:
+            if task_id not in self._preempt_cmds:
+                return None
+            self._preempt_cmds.discard(task_id)
+        return {"grace_ms": self.conf.get_int(
+            keys.TASK_PREEMPT_GRACE_MS, 3000)}
+
+    def _discharge_preempt(self, task_id: str, exit_code: int) -> bool:
+        """Container completion of a preempted task (commanded drain, a
+        self-reported external preemption, or an uncommanded
+        EXIT_PREEMPTED — the child drained on its own notice): relaunch
+        without charging the budget, trace-marked ``preempted``. Caller
+        holds the restart lock. The superseded-container guard in
+        _on_container_completed already ensured this completion belongs
+        to the current attempt, so a racing heartbeat-expiry restart
+        cannot double-spend (its relaunch would have replaced the
+        handle, making this completion read as superseded)."""
+        commanded = task_id in self._preempts
+        if not commanded and (exit_code != c.EXIT_PREEMPTED
+                              or task_id in self._driver_stops):
+            # not preempted: either an ordinary exit, or a child that
+            # "drained" because the DRIVER deliberately killed it
+            return False
+        if exit_code == 0:
+            # the child finished training before (or despite) the notice:
+            # that is a real completion, not a drain — clear the ledger
+            # so the finish is final
+            self._preempts.discard(task_id)
+            self._preempt_cmds.discard(task_id)
+            return False
+        if not commanded:
+            # self-initiated drain: count it (the commanded paths counted
+            # at notice time)
+            with self._tt_lock:
+                self._preempt_count += 1
+        self._preempts.discard(task_id)
+        self._preempt_cmds.discard(task_id)
+        name, _, idx = task_id.partition(":")
+        spec = self.session.role_specs.get(name)
+        if spec is None:
+            return False
+        self._clear_attempt_state(task_id)
+        self._trace_mark(task_id, "preempted", exit_code=exit_code)
+        log.info("relaunching preempted %s (budget-free)", task_id)
+        self._relaunch_task(task_id, spec, int(idx))
+        return True
+
+    # ------------------------------------------------ elastic gang resize
+    def _elastic_candidate(self, task_id: str) -> bool:
+        """May this lost-beyond-budget task be detached instead of
+        failing the job? Elastic must be on, the job still live, the
+        task a tracked non-chief, and the surviving role population at
+        or above the configured floor."""
+        if not self._elastic or self._stop_requested.is_set():
+            return False
+        task = self.session.get_task_by_id(task_id)
+        if task is None or task.task_id in self.session.detached:
+            return False
+        if task.name in self.session.untracked:
+            return False
+        if self.session.is_chief(task.name, task.index):
+            # the chief carries the completion policy and (for jax) rank
+            # 0's coordinator — its loss stays fatal
+            return False
+        survivors = [t for t in self.session.active_tasks()
+                     if t.name == task.name and t.task_id != task_id
+                     and not t.status.is_terminal()]
+        return len(survivors) >= self._elastic_min
+
+    def _resize_down(self, task_id: str, cause: str) -> bool:
+        """A worker is gone past its restart budget: detach it, bump the
+        gang generation, and drain every surviving RUNNING task so the
+        gang re-forms from the latest checkpoints at the smaller world
+        size (survivor relaunches are budget-free). The detached slot is
+        retried every rescale-retry-ms (_try_rescale_up)."""
+        with self._restart_lock:
+            if self._stop_requested.is_set():
+                return False
+            if not self.session.detach_task(task_id):
+                return False
+            old = self._handles.pop(task_id, None)
+            self.heartbeats.pop(task_id, None)
+            self._preempts.discard(task_id)
+            self._preempt_cmds.discard(task_id)
+            self._detach_t[task_id] = time.monotonic()
+            gen = self.session.begin_generation()
+            with self._tt_lock:
+                self._resize_count += 1
+            survivors = [
+                t.task_id for t in self.session.active_tasks()
+                if t.status == TaskStatus.RUNNING and t.task_id != task_id
+            ]
+            handles = []
+            for tid in survivors:
+                self._resizes.add(tid)
+                self.heartbeats.pop(tid, None)
+                h = self._handles.get(tid)
+                if h is not None:
+                    handles.append(h)
+            # the straggler ledger is attempt-scoped: a drained survivor
+            # must not inherit its predecessor's strikes
+            self._straggler_strikes.clear()
+        log.warning(
+            "elastic resize DOWN to generation %d: %s lost (%s); draining "
+            "%d survivors to re-form at the smaller world size",
+            gen, task_id, cause, len(survivors))
+        self._trace_mark(task_id, "resized", gang_generation=gen,
+                         resize="detached", resize_cause=cause)
+        for tid in survivors:
+            self._trace_mark(tid, "resized", gang_generation=gen,
+                             resize="down", lost=task_id)
+            self.metrics.pop(tid, None)   # stale step stats must not
+            #                               re-flag the fresh attempt
+        # stops happen OFF the lock and on their own threads: a slow or
+        # SIGTERM-ignoring process costs its own grace window, not a
+        # stall of every other completion (same discipline as rolls)
+        if old is not None:
+            threading.Thread(target=self.provisioner.stop_container,
+                             args=(old,), name=f"resize-stop-{task_id}",
+                             daemon=True).start()
+        for h in handles:
+            threading.Thread(target=self.provisioner.stop_container,
+                             args=(h,), name=f"resize-drain-{h.role}",
+                             daemon=True).start()
+        return True
+
+    def _discharge_resize(self, task_id: str) -> bool:
+        """Container completion of a survivor draining for a resize:
+        budget-free relaunch into the new gang generation. Caller holds
+        the restart lock."""
+        if task_id not in self._resizes:
+            return False
+        self._resizes.discard(task_id)
+        # a drain SIGTERM looks like a cloud preemption to the executor,
+        # which dutifully reports it — the resize ledger owns this exit,
+        # and a stale preempt entry would relaunch the NEXT (real)
+        # completion too
+        self._preempts.discard(task_id)
+        self._preempt_cmds.discard(task_id)
+        name, _, idx = task_id.partition(":")
+        spec = self.session.role_specs.get(name)
+        if spec is None:
+            return False
+        self._clear_attempt_state(task_id)
+        self._relaunch_task(task_id, spec, int(idx))
+        return True
+
+    def _try_rescale_up(self) -> None:
+        """Monitor-loop hook: a detached slot whose retry timer elapsed
+        is re-attached — survivors drain again and the whole gang
+        re-registers at the restored world size. If the provisioner
+        still cannot place it (launch raises), the slot detaches again
+        and the timer re-arms."""
+        if not self._detach_t or self._stop_requested.is_set():
+            return
+        now = time.monotonic()
+        candidate = None
+        for task_id, t0 in self._detach_t.items():
+            if now - t0 >= self._rescale_retry_s:
+                candidate = task_id
+                break
+        if candidate is None:
+            return
+        task_id = candidate
+        name, _, idx = task_id.partition(":")
+        spec = self.session.role_specs.get(name)
+        if spec is None:
+            self._detach_t.pop(task_id, None)
+            return
+        with self._restart_lock:
+            if self._stop_requested.is_set():
+                return
+            self._detach_t.pop(task_id, None)
+            if not self.session.reattach_task(task_id):
+                return
+            gen = self.session.begin_generation()
+            with self._tt_lock:
+                self._resize_count += 1
+            # the returned slot is fresh capacity: its crash-loop budget
+            # starts over (the spent budget belonged to the lost host)
+            self._restarts.pop(task_id, None)
+            survivors = [
+                t.task_id for t in self.session.active_tasks()
+                if t.status == TaskStatus.RUNNING and t.task_id != task_id
+            ]
+            handles = []
+            for tid in survivors:
+                self._resizes.add(tid)
+                self.heartbeats.pop(tid, None)
+                h = self._handles.get(tid)
+                if h is not None:
+                    handles.append(h)
+            self._straggler_strikes.clear()
+        log.warning(
+            "elastic resize UP to generation %d: re-adding %s; draining "
+            "%d survivors to re-form at the restored world size",
+            gen, task_id, len(survivors))
+        self._trace_mark(task_id, "resized", gang_generation=gen,
+                         resize="rejoined")
+        for tid in survivors:
+            self._trace_mark(tid, "resized", gang_generation=gen,
+                             resize="up", rejoined=task_id)
+            self.metrics.pop(tid, None)
+        try:
+            with self._restart_lock:
+                self._relaunch_task(task_id, spec, int(idx))
+        except Exception as e:
+            # capacity still gone: fall back to the smaller formation —
+            # survivors are already draining and will re-register into
+            # the current generation, which excludes the re-detached slot
+            log.warning("rescale-up launch of %s failed (%s); staying "
+                        "at the smaller world size", task_id, e)
+            with self._restart_lock:
+                self.session.detach_task(task_id)
+                self._detach_t[task_id] = time.monotonic()
+        for h in handles:
+            threading.Thread(target=self.provisioner.stop_container,
+                             args=(h,), name=f"resize-drain-{h.role}",
+                             daemon=True).start()
+
     # --------------------------------------------------------------- monitor
     def monitor(self) -> JobStatus:
         """The driver hot loop — reference monitor:633-728 and its exit
@@ -1060,6 +1497,12 @@ class Driver:
             for task_id, last in list(self.heartbeats.items()):
                 task = self.session.get_task_by_id(task_id)
                 if task is None or task.status.is_terminal() or task.exit_code is not None:
+                    continue
+                if task_id in self.session.detached:
+                    # a detached slot's zombie executor may still beat on
+                    # its way down; it is no longer liveness-tracked and
+                    # its silence must not fail the job
+                    self.heartbeats.pop(task_id, None)
                     continue
                 if now - last > hb_expiry_s:
                     with self._restart_lock:
@@ -1091,6 +1534,7 @@ class Driver:
                         # completion handling.
                         old = self._handles.pop(task_id, None)
                         self.heartbeats.pop(task_id, None)
+                        self._driver_stops.add(task_id)
                     # stop BEFORE launching the replacement — the hung
                     # process still holds the device; a replacement racing
                     # it to chip init would exit device-busy and burn the
@@ -1105,17 +1549,36 @@ class Driver:
                     )
                     if restarted:
                         continue
-                    # budget spent (or none configured): record the
-                    # heartbeat reason before the kill cascades into
-                    # completion callbacks with a generic exit-code
-                    # message. The trace terminal is the expiry itself —
-                    # the dying container's later completion finds the
-                    # trace already sealed
+                    # budget spent (or none configured): an elastic job
+                    # re-forms the gang from the survivors instead of
+                    # dying — worker loss becomes a latency cost
+                    if (self._elastic_candidate(task_id)
+                            and self._resize_down(task_id, cause=msg)):
+                        continue
+                    # record the heartbeat reason before the kill
+                    # cascades into completion callbacks with a generic
+                    # exit-code message. The trace terminal is the expiry
+                    # itself — the dying container's later completion
+                    # finds the trace already sealed
                     self._seal_task_trace(task_id, "heartbeat_expired",
                                           reason=msg)
                     self.session._fail(msg)
                     self.session.on_task_completed(
                         task.name, task.index, c.EXIT_KILLED)
+
+            # 2b. straggler action: a worker whose step p50 lags the
+            # gang median beyond the configured factor is restarted
+            # through the normal budget (docs/training-robustness.md)
+            self._check_stragglers(now)
+
+            # 2c. elastic scale-up: retry detached slots whose timer
+            # elapsed (capacity may have returned)
+            if self._elastic:
+                self._try_rescale_up()
+
+            # 2d. seeded chaos (TONY_TEST_DRIVER_*): random container
+            # kills and the one-shot step-triggered preemption
+            self._chaos_tick()
 
             # 3. registration timeout (reference :1314-1334)
             for task_id, launched in list(self._launch_ms.items()):
@@ -1126,9 +1589,15 @@ class Driver:
                     task.status == TaskStatus.ALLOCATED
                     and now_ms() - launched > reg_timeout_ms
                 ):
-                    self.session._fail(
-                        f"task {task_id} failed to register within {reg_timeout_ms}ms"
-                    )
+                    reg_msg = (f"task {task_id} failed to register within "
+                               f"{reg_timeout_ms}ms")
+                    # elastic: capacity that launches but never answers
+                    # (half-dead host) detaches like any other loss
+                    if (self._elastic_candidate(task_id)
+                            and self._resize_down(task_id, cause=reg_msg)):
+                        self._launch_ms.pop(task_id, None)
+                        continue
+                    self.session._fail(reg_msg)
 
             # 4. runtime health (gang allocation deadlock breaker)
             if not self.runtime_driver.is_healthy(self.conf):
@@ -1157,7 +1626,125 @@ class Driver:
     def _kill_task(self, task_id: str) -> None:
         handle = self._handles.get(task_id)
         if handle is not None:
+            self._driver_stops.add(task_id)
             self.provisioner.stop_container(handle)
+
+    # ------------------------------------------------- straggler action
+    def _pushed_metric(self, task_id: str, name: str) -> float | None:
+        for entry in self.metrics.get(task_id, []):
+            if entry.get("name") == name and isinstance(
+                    entry.get("value"), (int, float)):
+                return float(entry["value"])
+        return None
+
+    def _check_stragglers(self, now: float) -> None:
+        """Act on the PR 5 skew telemetry: per role, compare each RUNNING
+        task's pushed step-time p50 against the role median; a task slow
+        beyond ``tony.train.straggler-restart-factor`` for
+        ``straggler-grace-checks`` consecutive checks gets a budget-
+        charged restart through the normal _try_restart_task path (its
+        replacement lands on fresh capacity / a fresh process — the
+        standard cure for a degraded host). Chief excluded: restarting
+        rank 0 would tear down the rendezvous for everyone. 0 disables
+        (observation-only, the PR 5 behavior)."""
+        if self._straggler_factor <= 1.0 or self._stop_requested.is_set():
+            return
+        if now - self._straggler_check_t < 2.0:   # push cadence is ~5s;
+            return                                 # checking faster is noise
+        self._straggler_check_t = now
+        from .metrics import STEP_TIME_P50_S
+
+        metric = f"max_{STEP_TIME_P50_S}"
+        for role in self.session.role_specs:
+            p50s: dict[str, float] = {}
+            for t in self.session.active_tasks():
+                if t.name != role or t.status != TaskStatus.RUNNING:
+                    continue
+                v = self._pushed_metric(t.task_id, metric)
+                if v is not None and v > 0:
+                    p50s[t.task_id] = v
+            if len(p50s) < 2:
+                continue
+            median = float(statistics.median(p50s.values()))
+            if median <= 0:
+                continue
+            for task_id, p50 in p50s.items():
+                name, _, idx = task_id.partition(":")
+                if p50 <= self._straggler_factor * median:
+                    self._straggler_strikes.pop(task_id, None)
+                    continue
+                if self.session.is_chief(name, int(idx)):
+                    continue
+                strikes = self._straggler_strikes.get(task_id, 0) + 1
+                self._straggler_strikes[task_id] = strikes
+                if strikes < self._straggler_grace:
+                    continue
+                spec = self.session.role_specs.get(name)
+                used = self._restarts.get(task_id, 0)
+                if spec is None or used >= spec.max_restarts:
+                    continue    # no budget left: tolerate the laggard
+                cause = (f"straggler: step p50 {p50:.3f}s vs role median "
+                         f"{median:.3f}s (factor {self._straggler_factor})")
+                # the whole stop+restart runs under the restart lock so a
+                # concurrent container-exit restart can't interleave and
+                # strand a stopped task (rare path; the up-to-5s stop
+                # wait is acceptable here, unlike the hot expiry loop)
+                with self._restart_lock:
+                    used = self._restarts.get(task_id, 0)
+                    if used >= spec.max_restarts:
+                        continue
+                    old = self._handles.pop(task_id, None)
+                    self.heartbeats.pop(task_id, None)
+                    self._driver_stops.add(task_id)
+                    # stale quantiles must not condemn the replacement
+                    self.metrics.pop(task_id, None)
+                    self._straggler_strikes.pop(task_id, None)
+                    if old is not None:
+                        self.provisioner.stop_container(old)
+                    self._try_restart_task(task_id, c.EXIT_KILLED,
+                                           cause=cause)
+                return      # at most one straggler restart per check:
+                #             the median moves once a member leaves
+
+    # --------------------------------------------------- driver chaos
+    def _chaos_tick(self) -> None:
+        """Seeded fault injection, one decision per monitor tick
+        (TONY_TEST_DRIVER_*, constants.py): random SIGKILL of a running
+        container, and a one-shot preemption drain once the gang's max
+        observed training step reaches the trigger."""
+        if self._stop_requested.is_set():
+            return
+        from .metrics import TRAIN_STEP
+
+        if self._chaos_kill_rate and (
+                self._chaos_rng.random() < self._chaos_kill_rate):
+            with self._restart_lock:
+                live = [t.task_id for t in self.session.active_tasks()
+                        if t.status == TaskStatus.RUNNING
+                        and t.task_id in self._handles
+                        and t.task_id not in self._resizes]
+                victim = (self._chaos_rng.choice(sorted(live))
+                          if live else None)
+                handle = self._handles.get(victim) if victim else None
+            if handle is not None:
+                log.warning("chaos: SIGKILLing %s (%s)", victim,
+                            handle.container_id)
+                self.provisioner.kill_container(handle)
+        if (self._chaos_preempt_at and not self._chaos_preempt_fired):
+            steps = [self._pushed_metric(t.task_id, f"max_{TRAIN_STEP}")
+                     for t in self.session.active_tasks()]
+            top = max((s for s in steps if s is not None), default=0)
+            if top >= self._chaos_preempt_at:
+                live = sorted(
+                    t.task_id for t in self.session.active_tasks()
+                    if t.status == TaskStatus.RUNNING
+                    and t.task_id in self._handles)
+                if live:
+                    victim = self._chaos_rng.choice(live)
+                    self._chaos_preempt_fired = True
+                    log.warning("chaos: preempting %s at observed step %d",
+                                victim, int(top))
+                    self.preempt_task(victim)
 
     # ------------------------------------------------- on-demand profiling
     def request_profile(self, task_id: str, seconds: float = 5.0) -> bool:
@@ -1213,6 +1800,12 @@ class Driver:
         self._launch_ms.clear()
         self._restarts.clear()
         self._rolls.clear()
+        self._preempts.clear()
+        self._preempt_cmds.clear()
+        self._resizes.clear()
+        self._detach_t.clear()
+        self._driver_stops.clear()
+        self._straggler_strikes.clear()
         self.metrics.clear()
 
     # ------------------------------------------------------------------ stop

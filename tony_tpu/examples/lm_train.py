@@ -201,6 +201,31 @@ def main(argv=None) -> int:
     # TaskMonitor samples so per-worker step quantiles reach the driver's
     # /metrics — running standalone (no executor) leaves it off
     timer = StepTimer(os.environ.get(ENV_STEP_LOG) or None)
+
+    # preemption drain (docs/training-robustness.md): a SIGTERM to this
+    # process — the cloud reclaiming the host, or the driver draining the
+    # gang for an elastic resize — checkpoints at the NEXT step boundary
+    # and exits EXIT_PREEMPTED so the relaunch is budget-free and resumes
+    # at most one step behind. The executor-relayed notice arrives the
+    # same way via timer.preempt_requested (the .preempt flag file).
+    import signal as _signal
+
+    preempted = {"flag": False}
+    _signal.signal(_signal.SIGTERM,
+                   lambda *_: preempted.__setitem__("flag", True))
+
+    def _drain_exit(step_i: int) -> int:
+        from tony_tpu.constants import EXIT_PREEMPTED
+
+        if mgr is not None:
+            mgr.save_async(step_i, {"params": params, "opt_state": opt_state})
+            timer.note_checkpoint(step_i)
+            mgr.wait()
+            mgr.close()
+        timer.close()
+        print(f"preempted: checkpointed step {step_i}, exiting")
+        return EXIT_PREEMPTED
+
     losses = []
     last_eval = None
     last_eval_step = -1
@@ -212,7 +237,9 @@ def main(argv=None) -> int:
                 params, opt_state, metrics = bundle.step_fn(
                     params, opt_state, tokens, targets
                 )
-                timer.tick()
+                timer.tick(train_step=step_i)
+                if preempted["flag"] or timer.preempt_requested:
+                    return _drain_exit(step_i)
                 if step_i % 20 == 0:
                     loss = float(metrics["loss"])  # sync point
                     losses.append(loss)
@@ -220,7 +247,11 @@ def main(argv=None) -> int:
                         print(f"step {step_i}: loss {loss:.4f} "
                               f"({timer.steps_per_sec:.2f} steps/s)")
                 if mgr is not None and step_i % args.checkpoint_every == 0 and step_i > 0:
-                    mgr.save(step_i, {"params": params, "opt_state": opt_state})
+                    # overlapped: the host snapshot happens here, the disk
+                    # write happens behind the next steps
+                    mgr.save_async(step_i,
+                                   {"params": params, "opt_state": opt_state})
+                    timer.note_checkpoint(step_i)
                 if (loader is not None and args.eval_every > 0
                         and step_i > start_step
                         and step_i % args.eval_every == 0):
@@ -236,8 +267,9 @@ def main(argv=None) -> int:
             and last_eval_step != start_step + args.steps - 1):
         last_eval = run_eval(params)
     if mgr is not None:
-        mgr.save(start_step + args.steps - 1,
-                 {"params": params, "opt_state": opt_state})
+        mgr.save_async(start_step + args.steps - 1,
+                       {"params": params, "opt_state": opt_state})
+        timer.note_checkpoint(start_step + args.steps - 1)
         mgr.wait()
         mgr.close()
 

@@ -36,10 +36,27 @@ from .rpc import RpcClient
 log = logging.getLogger(__name__)
 
 
+def _write_flag_file(step_log: str, suffix: str, payload: dict,
+                     label: str) -> str | None:
+    """Shared driver-command relay: write ``<step_log><suffix>``
+    tmp+rename so the training child's StepTimer never reads a torn
+    request. One writer for every flag kind — the write/rename/error
+    contract must not drift between them."""
+    flag = step_log + suffix
+    tmp = flag + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(json.dumps(payload))
+        os.replace(tmp, flag)
+    except (OSError, TypeError, ValueError) as e:
+        log.warning("could not write %s flag: %s", label, e)
+        return None
+    return flag
+
+
 def write_profile_flag(step_log: str | None, cmd: dict) -> str | None:
     """Relay a driver profile command to the training child: write the
-    ``$TONY_STEP_LOG.profile`` flag file (tmp+rename, so the child's
-    StepTimer never reads a torn request) carrying the capture length
+    ``$TONY_STEP_LOG.profile`` flag file carrying the capture length
     and where the xplane dump should land — ``logs/profiles/<task>_
     <stamp>/`` next to the step log, which the portal lists on
     ``/profiles/<app_id>``. Returns the flag path, or None when there is
@@ -52,17 +69,42 @@ def write_profile_flag(step_log: str | None, cmd: dict) -> str | None:
     stem = os.path.basename(step_log).partition(".")[0]
     out_dir = os.path.join(os.path.dirname(step_log), c.PROFILE_DIR_NAME,
                            f"{stem}_{int(time.time())}")
-    flag = step_log + c.PROFILE_REQUEST_SUFFIX
-    tmp = flag + ".tmp"
     try:
-        with open(tmp, "w") as f:
-            f.write(json.dumps({"seconds": float(cmd.get("seconds", 5.0)),
-                                "out_dir": out_dir}))
-        os.replace(tmp, flag)
-    except (OSError, TypeError, ValueError) as e:
+        payload = {"seconds": float(cmd.get("seconds", 5.0)),
+                   "out_dir": out_dir}
+    except (TypeError, ValueError) as e:
         log.warning("could not write profile flag: %s", e)
         return None
-    log.info("profile command relayed via %s -> %s", flag, out_dir)
+    flag = _write_flag_file(step_log, c.PROFILE_REQUEST_SUFFIX, payload,
+                            "profile")
+    if flag:
+        log.info("profile command relayed via %s -> %s", flag, out_dir)
+    return flag
+
+
+def write_preempt_flag(step_log: str | None, cmd: dict) -> str | None:
+    """Relay a preemption drain notice to the training child via the
+    ``$TONY_STEP_LOG.preempt`` flag file. The child checkpoints at its
+    next step boundary and exits EXIT_PREEMPTED; the driver relaunches
+    it budget-free. Returns the flag path, or None when there is no step
+    log (nothing would ever poll the flag — the grace watchdog then does
+    the draining)."""
+    if not step_log:
+        log.warning("preempt notice: no step log configured; relying on "
+                    "the grace watchdog")
+        return None
+    from . import constants as c
+
+    try:
+        payload = {"grace_ms": float(cmd.get("grace_ms", 3000)),
+                   "ts": time.time()}
+    except (TypeError, ValueError) as e:
+        log.warning("could not write preempt flag: %s", e)
+        return None
+    flag = _write_flag_file(step_log, c.PREEMPT_REQUEST_SUFFIX, payload,
+                            "preempt")
+    if flag:
+        log.info("preempt notice relayed via %s", flag)
     return flag
 
 
@@ -83,7 +125,7 @@ class Heartbeater(threading.Thread):
 
     def __init__(self, client: RpcClient, task_id: str, interval_s: float,
                  max_failures: int = 30, on_driver_lost=None, monitor=None,
-                 on_command=None):
+                 on_command=None, on_preempt=None):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
@@ -93,9 +135,11 @@ class Heartbeater(threading.Thread):
         self._on_driver_lost = on_driver_lost
         self._monitor = monitor
         # driver->executor commands piggyback on the heartbeat RESPONSE
-        # (a dict instead of the plain True) — currently the on-demand
-        # profile capture; the callback gets the command payload
+        # (a dict instead of the plain True): ``profile`` (on-demand
+        # capture; on_command gets the payload) and ``preempt`` (drain
+        # notice; on_preempt gets the payload)
         self._on_command = on_command
+        self._on_preempt = on_preempt
         self._rng = random.Random()     # urandom-seeded: per-process phase
         self.missed = 0
         self.stop_event = threading.Event()
@@ -121,15 +165,17 @@ class Heartbeater(threading.Thread):
                 self._note(HEARTBEAT_RTT_MS,
                            (time.monotonic() - t0) * 1000.0)
                 failures = 0
-                if isinstance(result, dict) and self._on_command:
-                    cmd = result.get("profile")
-                    if cmd:
-                        try:
-                            self._on_command(cmd)
-                        except Exception:
-                            # a bad command must not stop the beat — the
-                            # beat IS the liveness signal
-                            log.exception("heartbeat command failed")
+                if isinstance(result, dict):
+                    for key, cb in (("profile", self._on_command),
+                                    ("preempt", self._on_preempt)):
+                        cmd = result.get(key)
+                        if cmd and cb:
+                            try:
+                                cb(cmd)
+                            except Exception:
+                                # a bad command must not stop the beat —
+                                # the beat IS the liveness signal
+                                log.exception("heartbeat command failed")
             except Exception as e:
                 failures += 1
                 self.missed += 1
@@ -193,7 +239,11 @@ class Executor:
         from .runtimes import get_runtime
 
         framework = str(self.conf.get(keys.APPLICATION_FRAMEWORK, "jax"))
+        self.framework = framework
         self.adapter = get_runtime(framework).task_adapter()
+        # preemption drain state: the watchdog that enforces the grace
+        # window arms at most once per attempt
+        self._drain_armed = False
 
         # the port this task advertises for its framework's rendezvous
         # (coordination port for jax, TF server port for tensorflow, c10d port
@@ -260,6 +310,66 @@ class Executor:
         except ValueError:
             log.error("bad skew spec: %s", spec)
 
+    # -------------------------------------------------------- preempt drain
+    def _arm_drain_watchdog(self, ctx_holder: dict, grace_s: float) -> None:
+        """Give the training child ``grace_s`` to checkpoint at a step
+        boundary and exit on its own; kill it after. Armed once per
+        attempt — a repeated notice must not stack timers."""
+        if self._drain_armed:
+            return
+        self._drain_armed = True
+
+        def _enforce():
+            proc = getattr(ctx_holder.get("ctx"), "child_process", None)
+            if proc is not None and proc.poll() is None:
+                log.warning("preempt drain grace (%.1fs) expired; "
+                            "terminating the child", grace_s)
+                proc.terminate()
+                try:
+                    proc.wait(timeout=2)
+                except Exception:
+                    proc.kill()
+
+        t = threading.Timer(grace_s, _enforce)
+        t.daemon = True
+        t.start()
+
+    def _on_preempt_notice(self, ctx_holder: dict, cmd: dict,
+                           notify_driver: bool = False) -> None:
+        """Drain on a preemption notice (heartbeat ``preempt`` command,
+        or a cloud SIGTERM to this executor): drop the flag file the
+        training child's StepTimer polls — it checkpoints at its next
+        step boundary and exits EXIT_PREEMPTED — and arm the grace
+        watchdog for children that never poll. With ``notify_driver``
+        (the SIGTERM path, where the driver does not yet know) the
+        executor reports the preemption so its coming exit is relaunched
+        budget-free."""
+        try:
+            grace_s = max(0.1, float(cmd.get("grace_ms", 3000)) / 1000)
+        except (TypeError, ValueError):
+            grace_s = 3.0
+        write_preempt_flag(self._step_log_path(), cmd)
+        self._arm_drain_watchdog(ctx_holder, grace_s)
+        if notify_driver:
+            def _notify():
+                # dedicated FAST client: the shared client retries for
+                # ~a minute, and a notify that straggles long past this
+                # executor's own exit could mislabel the REPLACEMENT
+                # attempt as preempting (the driver also fences this on
+                # relaunch; the bound keeps the window honest)
+                nrpc = RpcClient(
+                    self.driver_host, self.driver_port,
+                    token=os.environ.get(c.ENV_TOKEN, ""), max_retries=3,
+                    role="executor" if os.environ.get(c.ENV_TOKEN) else "")
+                try:
+                    nrpc.call("notify_preemption", task_id=self.task_id)
+                except Exception as e:
+                    log.warning("could not report preemption: %s", e)
+                finally:
+                    nrpc.close()
+            threading.Thread(target=_notify, name="preempt-notify",
+                             daemon=True).start()
+
     # -------------------------------------------------------------------- run
     def run(self) -> int:
         if os.environ.get(c.TEST_TASK_EXECUTOR_CRASH):
@@ -305,8 +415,43 @@ class Executor:
             # file the training child's StepTimer polls
             on_command=lambda cmd: write_profile_flag(
                 self._step_log_path(), cmd),
+            # driver preemption notices -> the .preempt flag + grace
+            # watchdog (the driver already knows: no notify back)
+            on_preempt=lambda cmd: self._on_preempt_notice(
+                ctx_holder, cmd if isinstance(cmd, dict) else {}),
         )
         heartbeater.start()
+
+        # cloud preemption relay: a SIGTERM that reaches THIS process
+        # while a training child runs becomes a drain (flag file +
+        # notify_preemption + grace watchdog) instead of an instant
+        # exit, so the checkpoint is at most one step boundary old.
+        # Serving keeps the prompt-exit handler: its child drains itself
+        # on the group SIGTERM and the roll path relies on the executor
+        # exiting quickly (runtimes/serving.py).
+        if self.framework != "serving":
+            grace_ms = self.conf.get_int(keys.TASK_PREEMPT_GRACE_MS, 3000)
+
+            def _on_term(signum, frame):
+                proc = getattr(ctx_holder.get("ctx"), "child_process", None)
+                if proc is None or proc.poll() is not None:
+                    sys.exit(c.EXIT_KILLED)     # nothing to drain
+                log.warning("SIGTERM: draining the training child "
+                            "(preemption relay, %.1fs grace)",
+                            grace_ms / 1000)
+                self._on_preempt_notice(ctx_holder, {"grace_ms": grace_ms},
+                                        notify_driver=True)
+                # no exit: run() returns with the child's code once the
+                # drain completes (or the watchdog enforces the grace)
+
+            try:
+                signal.signal(signal.SIGTERM, _on_term)
+            except ValueError:
+                # not the main thread (embedded/test use): keep the
+                # process-default handler; the drain still works via the
+                # heartbeat command path
+                log.warning("cannot install SIGTERM drain handler off "
+                            "the main thread")
 
         payload = self.register_and_get_cluster_spec()
         monitor.start()

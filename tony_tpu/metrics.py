@@ -70,6 +70,14 @@ DRIVER_STRAGGLER_HEARTBEAT_S = "driver_straggler_heartbeat_s"
 DRIVER_TASKS = "driver_tasks"
 DRIVER_TASK_METRIC = "driver_task_metric"
 DRIVER_TASK_SERVICE_PORT = "driver_task_service_port"
+# elastic / preemption-tolerant training (docs/training-robustness.md):
+# preemption drains relayed (budget-free relaunches, like rolls but
+# fault-initiated), gang resizes (down on a worker lost past its budget,
+# up when capacity returns), and per-task checkpoint recency — how many
+# seconds of training each worker would lose if it died right now
+DRIVER_PREEMPTIONS_TOTAL = "driver_preemptions_total"
+DRIVER_GANG_RESIZES_TOTAL = "driver_gang_resizes_total"
+DRIVER_CHECKPOINT_AGE_S = "driver_checkpoint_age_s"
 
 # fleet-router exposition families (rendered by tony_tpu/router.py's GET
 # /metrics; same one-contract rule — the metrics-name lint pins these to
@@ -105,11 +113,19 @@ STEPS_PER_SEC = "steps_per_sec"
 XLA_COMPILES = "xla_compiles"
 XLA_COMPILE_TIME_S = "xla_compile_time_s"
 XLA_RECOMPILES_POST_WARM = "xla_recompiles_post_warm"
+# training progress + checkpoint recency sampled from the same JSONL
+# records (StepTimer ``tick(train_step=...)`` / ``note_checkpoint``):
+# the driver's chaos/straggler/elastic machinery keys off train_step,
+# and ckpt_unix_ts renders centrally as driver_checkpoint_age_s
+TRAIN_STEP = "train_step"
+CKPT_STEP = "ckpt_step"
+CKPT_UNIX_TS = "ckpt_unix_ts"
 # note()-d / sampled names that are cumulative totals, not per-event
 # samples: they take set semantics (latest total) in the accumulator —
 # averaging a monotone counter's successive values is meaningless
 _COUNTER_NOTES = frozenset({HEARTBEATS_MISSED, XLA_COMPILES,
-                            XLA_COMPILE_TIME_S, XLA_RECOMPILES_POST_WARM})
+                            XLA_COMPILE_TIME_S, XLA_RECOMPILES_POST_WARM,
+                            TRAIN_STEP, CKPT_STEP, CKPT_UNIX_TS})
 
 
 def _proc_tree_rss_mb(root_pid: int) -> float:
@@ -282,7 +298,10 @@ class TaskMonitor:
                              ("xla_compiles", XLA_COMPILES),
                              ("xla_compile_time_s", XLA_COMPILE_TIME_S),
                              ("xla_recompiles_post_warm",
-                              XLA_RECOMPILES_POST_WARM)):
+                              XLA_RECOMPILES_POST_WARM),
+                             ("train_step", TRAIN_STEP),
+                             ("last_ckpt_step", CKPT_STEP),
+                             ("last_ckpt_ts", CKPT_UNIX_TS)):
                 if isinstance(rec.get(src), (int, float)):
                     out[dst] = float(rec[src])
             return out

@@ -246,8 +246,12 @@ class TaskTrace(RequestTrace):
     onto the driver's monotonic timeline at receipt, so cross-host NTP
     skew shifts them but never reorders driver-observed spans), zero or
     more ``restarted`` spans (one per spent restart-budget unit; the
-    whole requested->registered chain repeats after each), and exactly
-    one terminal from TASK_TERMINAL_SPANS."""
+    whole requested->registered chain repeats after each), zero or more
+    budget-FREE relaunch marks — ``rolled`` (deliberate roll),
+    ``preempting``/``preempted`` (preemption drain), ``resized``
+    (elastic gang re-formation, attrs carry the generation) — each also
+    followed by a fresh attempt chain, and exactly one terminal from
+    TASK_TERMINAL_SPANS."""
 
     __slots__ = ()
 

@@ -451,6 +451,10 @@ _TASK_SEG_COLORS = {
     "child_exited": "#c9a0d6",     # user process done, result in flight
     "finished": "#79b77a",
     "restarted": "#e0876c",
+    "rolled": "#8fd0c9",           # deliberate budget-free relaunch
+    "preempting": "#d6b35c",       # drain notice relayed
+    "preempted": "#d6b35c",        # drained + budget-free relaunch
+    "resized": "#9a7fd0",          # elastic gang re-formation
     "failed": "#d98080", "killed": "#d98080",
     "heartbeat_expired": "#d98080",
 }
@@ -524,7 +528,8 @@ def _task_timeline_html(app_id: str, traces: list[dict]) -> str:
                      ("register", "#7aa7d6"), ("liveness", "#8fc1d9"),
                      ("barrier", "#c9d68a"), ("child up", "#e0a86c"),
                      ("done", "#79b77a"), ("restart", "#e0876c"),
-                     ("dead", "#d98080")))
+                     ("roll", "#8fd0c9"), ("preempt", "#d6b35c"),
+                     ("resize", "#9a7fd0"), ("dead", "#d98080")))
     body = (
         f"<h3>{html.escape(app_id)} — gang-launch waterfall</h3>"
         f"<p><a href='/'>all jobs</a> | "

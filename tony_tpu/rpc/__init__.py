@@ -7,7 +7,7 @@ service (rpc/MetricsRpc.java), carried as framed JSON over TCP:
   register_worker(task_id, host, port) -> cluster_spec | None   (gang barrier)
   get_cluster_spec(task_id)            -> cluster_spec | None
   get_task_infos()                     -> [TaskInfo]
-  heartbeat(task_id)                   -> True | {"profile": {...}}
+  heartbeat(task_id)                   -> True | {"profile": ..., "preempt": ...}
   register_execution_result(task_id, exit_code) -> str
   register_tensorboard_url(url)        -> bool
   register_callback_info(task_id, payload) -> bool   (runtime rendezvous data)
@@ -16,11 +16,20 @@ service (rpc/MetricsRpc.java), carried as framed JSON over TCP:
   get_metrics(task_id)                 -> [MetricSample]
   request_task_profile(task_id, seconds=5.0) -> bool (client-ACL'd; queues an
                                                       on-demand profiler capture)
+  preempt_task(task_id)                -> bool (client-ACL'd; queues a drain
+                                                notice — checkpoint at the next
+                                                step boundary, budget-free
+                                                relaunch)
+  notify_preemption(task_id)           -> bool (executor reports an external
+                                                preemption signal so its coming
+                                                exit relaunches budget-free)
 
 Driver->executor commands piggyback on the heartbeat RESPONSE: a plain
-``True`` at steady state, or a one-shot ``{"profile": {"seconds": N}}``
-dict when a capture is queued (the executor's Heartbeater relays it into
-the ``$TONY_STEP_LOG.profile`` flag file).
+``True`` at steady state, or a one-shot dict carrying ``"profile":
+{"seconds": N}`` (capture — relayed into the ``$TONY_STEP_LOG.profile``
+flag file) and/or ``"preempt": {"grace_ms": N}`` (drain notice — relayed
+into ``$TONY_STEP_LOG.preempt``; the training child checkpoints at its
+next step boundary and exits EXIT_PREEMPTED).
 
 ``update_metrics`` additionally carries executor-side lifecycle spans
 ([name, unix_ts] pairs: work_dir_ready, child_spawned, child_exited) that

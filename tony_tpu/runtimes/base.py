@@ -49,6 +49,10 @@ class DriverAdapter:
         constructClusterSpec)."""
         assert self.session is not None
         payload: dict[str, Any] = {"cluster": self.session.cluster_spec()}
+        # which elastic gang formation this spec describes — bumped by
+        # every resize, so an executor/tooling can tell a re-formed
+        # (smaller or restored) gang from the one it first joined
+        payload["gang_generation"] = self.session.gang_generation
         ports = self.session.service_ports()
         if ports:
             payload["service_ports"] = ports

@@ -59,6 +59,8 @@ class GenericTaskAdapter(TaskAdapter):
 
         env = {
             c.ENV_CLUSTER_SPEC: json.dumps(ctx.cluster_spec),
+            c.ENV_GANG_GENERATION: str(
+                ctx.cluster_payload.get("gang_generation", 0)),
         }
         if ctx.tb_port is not None:
             env[c.ENV_TB_PORT] = str(ctx.tb_port)

@@ -35,15 +35,23 @@ class JaxDriverAdapter(GenericDriverAdapter):
         ranks: dict[str, int] = {}
         rank = 0
         coordinator = None
+        # rank by REAL task identity, not list position: an elastically
+        # resized gang's address lists are COMPACTED (detached slots
+        # removed), so for e.g. workers {0, 2} the position-keyed scheme
+        # would label worker:2's entry "worker:1" and leave worker:2
+        # falling back to a rank >= num_processes — the re-formed gang
+        # could never initialize. registered_tasks() walks the same
+        # index order cluster_spec() used, so rank i is address i.
         for role in sorted(spec):
-            for i, addr in enumerate(spec[role]):
-                ranks[f"{role}:{i}"] = rank
+            for t in self.session.registered_tasks(role):
+                ranks[t.task_id] = rank
                 if rank == 0:
-                    coordinator = addr
+                    coordinator = t.address
                 rank += 1
         payload["ranks"] = ranks
         payload["num_processes"] = rank
         payload["coordinator_address"] = coordinator
+        payload["gang_generation"] = self.session.gang_generation
         return payload
 
 

@@ -69,6 +69,14 @@ class Session:
             for s in self.role_specs.values()
         }
         self._registered: set[str] = set()
+        # elastic resize state (docs/training-robustness.md): which gang
+        # formation is current (bumped per resize; every active task must
+        # re-register into the new generation before the barrier opens)
+        # and which task slots are DETACHED — lost beyond their restart
+        # budget and awaiting capacity. Detached tasks are invisible to
+        # the cluster spec, the gang barrier, and the completion policy.
+        self.gang_generation = 0
+        self.detached: set[str] = set()
 
         self.untracked: set[str] = conf.untracked_roles()
         self.stop_on_failure: set[str] = set(
@@ -96,7 +104,18 @@ class Session:
         return [t for ts in self.tasks.values() for t in ts]
 
     def tracked_tasks(self) -> list[Task]:
-        return [t for t in self.all_tasks() if t.name not in self.untracked]
+        """Tasks the completion policy watches. A detached (elastically
+        removed) task is excluded: the job's outcome is decided by the
+        formation that is actually training."""
+        return [t for t in self.all_tasks()
+                if t.name not in self.untracked
+                and t.task_id not in self.detached]
+
+    def active_tasks(self) -> list[Task]:
+        """Non-detached tasks — the current gang formation's membership
+        (terminal tasks included; callers filter by status)."""
+        return [t for t in self.all_tasks()
+                if t.task_id not in self.detached]
 
     def total_tracked(self) -> int:
         """Reference getTotalTrackedTasks (TonySession.java:182-185)."""
@@ -129,6 +148,10 @@ class Session:
             task = self.get_task_by_id(task_id)
             if task is None:
                 return None
+            if task_id in self.detached:
+                # a detached slot's zombie executor (host being reclaimed)
+                # must not register itself back into the gang
+                return None
             task.host, task.port = host, port
             if not task.status.is_terminal():
                 task.status = TaskStatus.RUNNING
@@ -138,6 +161,51 @@ class Session:
     def registered_count(self) -> int:
         with self._lock:
             return len(self._registered)
+
+    def note_allocated(self, task_id: str, container_id: str) -> None:
+        """Record that capacity was granted — an UPGRADE-only transition
+        (NEW/REQUESTED -> ALLOCATED) taken under the session lock: a
+        fast executor can register (-> RUNNING) before the driver thread
+        finishes its post-launch bookkeeping, and an unconditional
+        assignment would stomp RUNNING back to ALLOCATED."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if task is None:
+                return
+            task.container_id = container_id
+            if task.status in (TaskStatus.NEW, TaskStatus.REQUESTED):
+                task.status = TaskStatus.ALLOCATED
+
+    # ------------------------------------------------------- elastic resize
+    def begin_generation(self) -> int:
+        """Start a new gang formation: every active task must re-register
+        before the barrier opens again (the driver drains + relaunches
+        survivors around this). Returns the new generation."""
+        with self._lock:
+            self.gang_generation += 1
+            self._registered.clear()
+            return self.gang_generation
+
+    def detach_task(self, task_id: str) -> bool:
+        """Remove a lost task from the gang without failing the job: it
+        leaves the cluster spec, the barrier predicate, and the tracked
+        set until capacity returns (reattach_task)."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if task is None:
+                return False
+            self.detached.add(task_id)
+            self._registered.discard(task_id)
+            return True
+
+    def reattach_task(self, task_id: str) -> bool:
+        """Bring a detached slot back into the gang (capacity returned);
+        the caller relaunches it and bumps the generation."""
+        with self._lock:
+            if task_id not in self.detached:
+                return False
+            self.detached.discard(task_id)
+            return True
 
     # ---------------------------------------------------------- service ports
     def set_task_ports(self, task_id: str, ports: dict[str, int]) -> bool:
@@ -173,6 +241,8 @@ class Session:
             names = set(roles) if roles is not None else set(self.tasks)
             for name in names:
                 for task in self.tasks.get(name, []):
+                    if task.task_id in self.detached:
+                        continue    # elastically removed: not gang-gated
                     if task.task_id not in self._registered:
                         return False
             return True
@@ -195,6 +265,16 @@ class Session:
                     spec[name] = addrs
             return spec
 
+    def registered_tasks(self, role: str) -> list[Task]:
+        """The registered tasks of one role in index order — the
+        identity-preserving companion of cluster_spec(): a resized gang's
+        address list is COMPACTED (detached slots removed), so position
+        in it is not the task index, and rank assignment must key off
+        real task ids (runtimes/jax_runtime.py)."""
+        with self._lock:
+            return [t for t in self.tasks.get(role, [])
+                    if t.task_id in self._registered]
+
     # --------------------------------------------------------------- completion
     def is_chief(self, name: str, index: int) -> bool:
         """chief:0, or worker:0 when no chief role exists — reference
@@ -213,6 +293,10 @@ class Session:
             task.exit_code = exit_code
             task.status = TaskStatus.SUCCEEDED if exit_code == 0 else TaskStatus.FAILED
             if exit_code == 0:
+                return
+            if task.task_id in self.detached:
+                # an elastically-removed slot's late container exit is
+                # already accounted for by the resize — no short-circuit
                 return
             # Failure short-circuits:
             if name in self.untracked:

@@ -3,12 +3,18 @@
 Exceeds the reference bar on purpose: TonY has no framework-level
 checkpointing at all (SURVEY.md §5 — "delegated entirely to user code";
 AM retry restarts from the user's own checkpoints). Here driver retry +
-``latest_step`` + async orbax saves give resumable training out of the box.
+``latest_step`` + async orbax saves give resumable training out of the box,
+and ``save_async`` overlaps the disk write with training so elastic
+resize/preemption recovery (docs/training-robustness.md) always finds a
+checkpoint at most ``save_interval`` steps old without the loop ever
+stalling on I/O.
 """
 
 from __future__ import annotations
 
 import logging
+import queue
+import threading
 from pathlib import Path
 from typing import Any
 
@@ -31,7 +37,24 @@ def sharded_restore_template(abstract_tree: Any, shardings: Any) -> Any:
 
 
 class CheckpointManager:
-    """Thin orbax wrapper: async save every N steps, restore-latest."""
+    """Thin orbax wrapper: async save every N steps, restore-latest.
+
+    Two save flavors:
+
+    - ``save(step, state)`` — orbax's own async machinery; correct when
+      the caller does NOT donate ``state`` into the next step.
+    - ``save_async(step, state)`` — the overlapped path for real training
+      loops, whose jitted step DONATES params/opt_state (train/step.py):
+      the device buffers are snapshotted to host *synchronously* (they
+      are invalid the moment the next step runs), then a single
+      background writer thread performs the orbax save + finalize off
+      the step path. Orbax finalizes into a tmp directory and renames,
+      so a crash mid-write never leaves a torn "latest" checkpoint —
+      ``latest_step()`` after a kill is always a complete save. The
+      queue holds ONE pending save: a third save arriving while one
+      writes blocks until the writer drains (backpressure keeps "newest
+      checkpoint ≤ save_interval steps old" true even on a slow disk).
+    """
 
     def __init__(self, directory: str, max_to_keep: int = 3, save_interval: int = 1):
         import orbax.checkpoint as ocp
@@ -46,11 +69,64 @@ class CheckpointManager:
                 enable_async_checkpointing=True,
             ),
         )
+        self.save_interval = save_interval
+        # overlapped-save state: one writer thread, depth-1 queue
+        self._q: queue.Queue | None = None
+        self._writer: threading.Thread | None = None
+        self._writer_err: Exception | None = None
+        self.last_saved_step: int | None = self._mgr.latest_step()
 
     def save(self, step: int, state: Any) -> bool:
         import orbax.checkpoint as ocp
 
-        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+        ok = self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if ok:
+            self.last_saved_step = step
+        return ok
+
+    # ------------------------------------------------- overlapped save
+    def _writer_loop(self) -> None:
+        import orbax.checkpoint as ocp
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            step, host_state = item
+            try:
+                if self._mgr.save(step, args=ocp.args.StandardSave(host_state)):
+                    # wait here (the background thread), not in the loop:
+                    # finalize must complete before last_saved_step may
+                    # promise the checkpoint exists on disk
+                    self._mgr.wait_until_finished()
+                    self.last_saved_step = step
+            except Exception as e:  # surfaced on the next save_async/wait
+                log.exception("overlapped checkpoint save of step %d failed",
+                              step)
+                self._writer_err = e
+            finally:
+                self._q.task_done()
+
+    def save_async(self, step: int, state: Any) -> bool:
+        """Overlapped, donation-safe save: snapshot ``state`` to host now
+        (cheap D2H next to a training step), hand the write to the
+        background thread, return. Raises the previous save's error, if
+        any — silent checkpoint loss would void the recovery bound."""
+        import jax
+
+        if self._writer_err is not None:
+            err, self._writer_err = self._writer_err, None
+            raise err
+        host_state = jax.device_get(state)
+        if self._q is None:
+            self._q = queue.Queue(maxsize=1)
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="ckpt-writer", daemon=True)
+            self._writer.start()
+        self._q.put((step, host_state))   # blocks only when one save is
+        #                                   already queued behind the
+        #                                   in-flight one (backpressure)
+        return True
 
     def restore(self, step: int | None = None, template: Any = None) -> Any:
         import orbax.checkpoint as ocp
@@ -68,7 +144,21 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def wait(self) -> None:
+        """Drain the overlapped-save queue AND orbax's async commit, so a
+        clean exit (including a preemption drain) never abandons a
+        checkpoint mid-write."""
+        if self._q is not None:
+            self._q.join()
         self._mgr.wait_until_finished()
+        if self._writer_err is not None:
+            err, self._writer_err = self._writer_err, None
+            raise err
 
     def close(self) -> None:
+        if self._q is not None:
+            self._q.join()
+            self._q.put(None)
+            if self._writer is not None:
+                self._writer.join(timeout=30)
+            self._q = None
         self._mgr.close()

@@ -93,6 +93,18 @@ class StepTimer:
         # cadence (every `window` steps — never per step)
         self._profile_stop_t: float | None = None
         self._atexit_armed = False
+        # preemption drain (docs/training-robustness.md): the executor
+        # drops `<out_path>.preempt` when the driver relays a notice (or
+        # the executor itself is SIGTERMed); the poll is TIME-gated
+        # (every ~0.25s, not per step — a 50k-steps/s loop must not pay
+        # 50k stat() calls) and `preempt_requested` tells the training
+        # loop to checkpoint at this step boundary and exit.
+        self.preempt_requested = False
+        self._preempt_poll_t = 0.0
+        # checkpoint recency (note_checkpoint): rides the JSONL records
+        # so the driver can render driver_checkpoint_age_s centrally
+        self._ckpt_step: int | None = None
+        self._ckpt_ts: float | None = None
 
     def tick(self, **extra) -> float | None:
         """Call once per training step; returns the last step's duration."""
@@ -113,6 +125,9 @@ class StepTimer:
         self.step += 1
         if self._profile_stop_t is not None and now >= self._profile_stop_t:
             self._finish_profile()
+        if now - self._preempt_poll_t >= 0.25:
+            self._preempt_poll_t = now
+            self._poll_preempt_flag()
         if self._out and dt is not None and self.step % self._window == 0:
             rec = {
                 "step": self.step,
@@ -127,6 +142,9 @@ class StepTimer:
             rec["xla_compiles"] = snap["compiles"]
             rec["xla_compile_time_s"] = snap["compile_time_s"]
             rec["xla_recompiles_post_warm"] = snap["recompiles_post_warm"]
+            if self._ckpt_step is not None:
+                rec["last_ckpt_step"] = self._ckpt_step
+                rec["last_ckpt_ts"] = self._ckpt_ts
             # best-effort, like the rest of the telemetry path: a missing
             # log dir (remote executor, no logs/ in the unpacked archive)
             # or a full disk must not kill the training loop
@@ -138,6 +156,41 @@ class StepTimer:
                 log.warning("step log write failed: %s", e)
             self._poll_profile_flag()
         return dt
+
+    def note_checkpoint(self, step: int) -> None:
+        """Tell the timer a checkpoint for ``step`` just finished (or was
+        handed to the async writer): the next JSONL record carries
+        ``last_ckpt_step``/``last_ckpt_ts`` so checkpoint recency is
+        centrally visible as ``driver_checkpoint_age_s``."""
+        self._ckpt_step = int(step)
+        self._ckpt_ts = time.time()
+
+    # --------------------------------------------------- preemption drain
+    def _poll_preempt_flag(self) -> None:
+        """Check for the executor's ``<out>.preempt`` drain notice
+        (tmp+rename written, so never torn). Sticky once seen: the loop
+        reads ``preempt_requested`` at its step boundary, checkpoints,
+        and exits constants.EXIT_PREEMPTED."""
+        if self.preempt_requested or self._out is None:
+            return
+        from .. import constants as c
+
+        flag = self._out.with_name(self._out.name + c.PREEMPT_REQUEST_SUFFIX)
+        try:
+            present = flag.exists()
+        except OSError:
+            return
+        if not present:
+            return
+        try:
+            flag.unlink()
+        except OSError:
+            # presence IS the signal; a failed unlink only risks a
+            # second (idempotent) notice
+            pass
+        log.warning("preemption notice received: checkpoint-and-exit at "
+                    "this step boundary")
+        self.preempt_requested = True
 
     # ------------------------------------------- on-demand profiler capture
     @property

@@ -68,6 +68,13 @@ def create_train_step(
     nontrivial `seq` axis: "ring" (K/V ppermute ring) or "ulysses" (all-to-all
     head sharding). Defaults to "ring"; `use_ring_attention` is the older
     boolean form of the same switch.
+
+    Checkpointing contract: the jitted step DONATES params/opt_state
+    (donate_argnums), so the previous step's buffers are dead the moment
+    the next step dispatches — checkpoint through
+    ``CheckpointManager.save_async`` (train/checkpoint.py), which
+    snapshots to host synchronously before overlapping the write, never
+    by handing live device arrays to a background saver.
     """
     rules = dict(rules if rules is not None else shlib.FSDP_TP_RULES)
     if sp_impl is None:
