@@ -75,6 +75,17 @@ vs random routing on the fleet-wide trie reuse fraction (asserted
 affinity > random) and merged p99 TTFT. Results land in PERF.json
 under `serving_fleet`.
 
+`python bench.py --launch-path` measures the warm-executor-pool launch
+story (docs/performance.md "Launch path"): the same 1-worker mnist job
+submitted three ways in one run — cold (first-ever: cold XLA disk
+cache, cold child), warm (resubmit, pool off), adopted (resubmit,
+`tony.warmpool.size=1`: the task adopts a pre-warmed standby that
+prepaid jax import + backend init + the warmup hook's staging and
+train-block compile). Asserts the adopted arm adopted, the others did
+not, and training results are identical across arms; results land in
+PERF.json under `launch_path` with value = cold/adopted speedup (the
+>=3x acceptance gate).
+
 `python bench.py --elastic` exercises the TRAINING failure model
 (docs/training-robustness.md): a real 2-worker local job running the
 elastic_train drill under the driver's seeded chaos harness
@@ -1041,24 +1052,53 @@ def run_serving_robustness_bench(chaos: bool) -> int:
 
 
 def run_elastic_bench() -> int:
-    """Elastic-training robustness benchmark (docs/training-robustness.md):
-    a real 2-worker local job runs examples/elastic_train.py (tiny
-    deterministic jitted update, overlapped orbax checkpoints every
-    SAVE_INTERVAL steps, full preemption-drain contract) while the
-    driver's seeded chaos harness SIGKILLs containers at KILL_RATE per
-    monitor tick and fires one preemption drain when the gang reaches
-    PREEMPT_AT_STEP. Elasticity is ON with a restart budget, so every
-    loss is either a budgeted restart, a budget-free preempt relaunch,
-    or a gang resize — never a failed job.
+    """Elastic-training robustness benchmark (docs/training-robustness.md),
+    run TWICE — warm pool off, then on — so the recovery bound shows what
+    adoption buys: a real 2-worker local job runs
+    examples/elastic_train.py (tiny deterministic jitted update,
+    overlapped orbax checkpoints every SAVE_INTERVAL steps, full
+    preemption-drain contract) while the driver's seeded chaos harness
+    SIGKILLs containers at KILL_RATE per monitor tick and fires one
+    preemption drain when the gang reaches PREEMPT_AT_STEP. Elasticity
+    is ON with a restart budget, so every loss is either a budgeted
+    restart, a budget-free preempt relaunch, or a gang resize — never a
+    failed job.
 
-    The bench ENFORCES the acceptance invariants rather than just
+    Each arm ENFORCES the acceptance invariants rather than just
     reporting them: the job must SUCCEED (zero failed jobs), at least
     one chaos kill and the preemption must actually have fired, every
     worker's StepTimer JSONL must show ≤ SAVE_INTERVAL recomputed steps
     per recovery and NO silent step skips, and each recovery's
-    loss→running wall time is read off tasks.trace.jsonl."""
+    loss→running wall time is read off tasks.trace.jsonl. On top, the
+    per-recovery loss→first-step-after-relaunch gap is read off the
+    per-step JSONL wall clocks (the gap across each step REWIND), and
+    the pool-on arm must show at least one adopted relaunch
+    (child_adopted in the traces) — the adopted relaunch skips the
+    child's import/backend bill (`backend_and_data_s` in the launch
+    waterfall), which is exactly the step-gap delta between the arms."""
+    off = _run_elastic_arm(warm_pool=False)
+    on = _run_elastic_arm(warm_pool=True)
+    assert on["adopted_relaunches"] >= 1, (
+        "the pool-on arm never adopted a relaunch; warm pool broken?")
+    out = {
+        "metric": "training_robustness_elastic_chaos",
+        "value": off["value"],
+        "unit": off["unit"],
+        "job_status": "SUCCEEDED",
+        "failed_jobs": 0,
+        "chaos": off["chaos"],
+        "total_steps": off["total_steps"],
+        "save_interval": off["save_interval"],
+        "step_ms": off["step_ms"],
+        "warm_pool_off": off,
+        "warm_pool_on": on,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def _run_elastic_arm(warm_pool: bool) -> dict:
     import tempfile as _tempfile
-    import threading as _threading
 
     sys.path.insert(0, str(REPO))
     from tony_tpu import constants as c
@@ -1101,8 +1141,16 @@ def run_elastic_bench() -> int:
         "tony.train.elastic-enabled": True,
         "tony.train.elastic-min-instances": 1,
         "tony.train.rescale-retry-ms": 3000,
+        # pool-on: every relaunch (budgeted restart, preempt, resize)
+        # adopts a pre-warmed standby instead of paying the cold child
+        # bill again — the driver seeds the pool at prepare and the
+        # executors replenish after each adoption
+        "tony.warmpool.size": workers if warm_pool else 0,
         "tony.execution.env": " ".join(
             [f"ELASTIC_TRAIN_STEP_MS={STEP_MS}", "JAX_PLATFORMS=cpu"]
+            # chaos kills arrive seconds apart: replenish fast enough
+            # that back-to-back recoveries still find a standby
+            + (["TONY_WARMPOOL_REPLENISH_DELAY_S=1"] if warm_pool else [])
             + [f"{k}={v}" for k, v in chaos_env.items()]),
     })
     # the chaos knobs must reach the DRIVER process (it reads them at
@@ -1125,11 +1173,19 @@ def run_elastic_bench() -> int:
     # ---- recovery forensics from the task traces
     inter = (root / "history/intermediate" / client.app_id)
     recs = {r["id"]: r for r in read_traces(inter / TASK_TRACE_FILE)}
-    kills = preempts = resizes = 0
+    kills = preempts = resizes = adopted = 0
     recoveries = []     # (task, kind, loss->running seconds)
     for task_id, rec in recs.items():
         spans = rec["spans"]
-        resizes = max(resizes, sum(1 for n, _ in spans if n == "resized"))
+        resizes = max(resizes, sum(1 for n, *_ in spans if n == "resized"))
+        # adopted RELAUNCHES only: a first-attempt adoption (the driver
+        # seeds the pool at prepare) must not satisfy the recovery gate
+        names = [n for n, *_ in spans]
+        first_loss = next((i for i, n in enumerate(names) if n in
+                           ("restarted", "preempted", "resized")),
+                          len(names))
+        adopted += sum(1 for n in names[first_loss:]
+                       if n == "child_adopted")
         for i, (name, t_mark) in enumerate(spans):
             if name not in ("restarted", "preempted", "resized"):
                 continue
@@ -1148,8 +1204,13 @@ def run_elastic_bench() -> int:
         f"chaos too quiet to gate on (kills={kills} preempts={preempts} "
         f"resizes={resizes}); raise KILL_RATE")
 
-    # ---- recompute bound + continuity from the per-step StepTimer JSONLs
+    # ---- recompute bound + continuity from the per-step StepTimer JSONLs,
+    # plus the loss->first-step-after-relaunch gap: consecutive per-step
+    # records share one worker wall clock, so the ts delta across each
+    # step REWIND is the full recovery — kill detection, relaunch, child
+    # startup (the part adoption removes), restore, first new step
     per_worker = {}
+    step_gaps = []
     for w in range(workers):
         log_path = Path(client.job_dir) / "logs" / f"worker_{w}.steps.jsonl"
         steps = []
@@ -1159,35 +1220,40 @@ def run_elastic_bench() -> int:
             except ValueError:
                 continue
             if isinstance(rec.get("train_step"), int):
-                steps.append(rec["train_step"])
+                steps.append((rec["train_step"], rec.get("ts")))
         recomputed, worst = 0, 0
-        for prev, cur in zip(steps, steps[1:]):
+        gaps = []
+        for (prev, prev_ts), (cur, cur_ts) in zip(steps, steps[1:]):
             if cur <= prev:
                 recomputed += prev - cur + 1
                 worst = max(worst, prev - cur + 1)
+                if isinstance(prev_ts, (int, float)) and isinstance(
+                        cur_ts, (int, float)):
+                    gaps.append(round(cur_ts - prev_ts, 3))
             else:
                 assert cur == prev + 1, (
                     f"worker_{w}: silent step skip {prev}->{cur}")
         assert worst <= SAVE_INTERVAL, (
             f"worker_{w} recomputed {worst} steps in one recovery "
             f"> save_interval {SAVE_INTERVAL}")
+        step_gaps += gaps
         per_worker[f"worker_{w}"] = {
             "records": len(steps),
-            "last_step": steps[-1] if steps else None,
+            "last_step": steps[-1][0] if steps else None,
             "recomputed_steps_total": recomputed,
             "worst_single_recovery_recompute": worst,
+            "recovery_step_gaps_s": gaps,
         }
     survivors_finished = [w for w, d in per_worker.items()
                           if d["last_step"] == TOTAL_STEPS - 1]
     assert survivors_finished, "no worker reached the final step"
 
     rec_times = [r["loss_to_running_s"] for r in recoveries]
-    out = {
-        "metric": "training_robustness_elastic_chaos",
+    return {
         "value": round(max(rec_times), 3) if rec_times else None,
         "unit": "worst loss->running recovery seconds under seeded chaos",
+        "warm_pool": warm_pool,
         "job_status": status.value,
-        "failed_jobs": 0,
         "chaos": {"kill_rate_per_tick": KILL_RATE,
                   "preempt_at_step": PREEMPT_AT, "seed": SEED},
         "total_steps": TOTAL_STEPS,
@@ -1196,15 +1262,156 @@ def run_elastic_bench() -> int:
         "budgeted_restarts": kills,
         "preemptions": preempts,
         "gang_resizes": resizes,
+        "adopted_relaunches": adopted,
         "recoveries": recoveries,
+        "loss_to_first_step_s_worst": max(step_gaps) if step_gaps else None,
+        "loss_to_first_step_s_all": sorted(step_gaps),
         "per_worker": per_worker,
         "wall_s": round(wall, 1),
     }
-    print(json.dumps(out))
+
+
+def run_launch_path_bench() -> int:
+    """Launch-path benchmark (docs/performance.md "Launch path"): the
+    same 1-worker mnist job submitted three ways, all in one run on one
+    host, waterfalls split the same way as `launch_cold`/`launch_warm`:
+
+      cold     first-ever submit: cold XLA disk cache, cold child
+               (pays import + backend init + data staging + compile)
+      warm     resubmit, pool OFF: warm disk caches, still a cold child
+      adopted  resubmit, pool ON: the task ADOPTS a pre-warmed standby
+               (jax imported, backend up, warmup hook ran) from a
+               host-level pool seeded before submit
+
+    Asserts the adopted arm actually adopted (child_adopted in the task
+    trace), that training results are identical to the cold child
+    (same final loss + accuracy — adoption must not change the math),
+    and reports cold/adopted speedup — the PERF.json `launch_path`
+    gate. The warmup hook (`tony.warmpool.warmup-module`) is
+    examples/warmup_mnist: the standby also prepays optax/model imports
+    and one staged device transfer, the data-staging half of the bill."""
+    import shutil
+    import tempfile as _tempfile
+
+    sys.path.insert(0, str(REPO))
+    from tony_tpu import warmpool
+    from tony_tpu.client import TonyClient
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.events.trace import TASK_TRACE_FILE, read_traces
+
+    # TINY first block: on CPU the 1000-step scan of the main bench puts
+    # ~10s of block EXECUTION inside compile_first_block_s, drowning the
+    # launch signal this bench exists to measure (on the TPU bench shape
+    # the block is milliseconds); 20 steps keeps the phase ~pure compile
+    STEPS, SPC, BATCH_ = 80, 20, 256
+    td = Path(_tempfile.mkdtemp(prefix="tony-launch-bench-"))
+    cache = td / "xla-cache"
+    pool_dir = td / "warmpool"
+
+    def run_arm(name: str, pool: bool) -> dict:
+        out = td / f"{name}.json"
+        conf = TonyConf({
+            "tony.staging.dir": str(td / f"staging-{name}"),
+            "tony.history.location": str(td / "hist"),
+            "tony.history.intermediate": str(td / "hist/intermediate"),
+            "tony.history.finished": str(td / "hist/finished"),
+            "tony.am.monitor-interval-ms": 50,
+            "tony.task.registration-poll-interval-ms": 50,
+            "tony.worker.instances": 1,
+            "tony.worker.command": (
+                f"{sys.executable} -m tony_tpu.examples.mnist_jax "
+                f"--steps {STEPS} --steps-per-call {SPC} "
+                f"--batch-size {BATCH_} --metrics-out {out} "
+                f"--compile-cache {cache}"),
+            "tony.warmpool.size": 1 if pool else 0,
+            "tony.warmpool.dir": str(pool_dir) if pool else "",
+            "tony.warmpool.warmup-module": "tony_tpu.examples.warmup_mnist",
+        })
+        client = TonyClient(conf, poll_interval_s=0.05)
+        t_submit = time.time()
+        client.submit()
+        status = client.monitor()
+        if status.value != "SUCCEEDED":
+            for p in sorted(Path(client.job_dir).rglob("*.std*")):
+                print(f"==== {p} ====\n{p.read_text()[-2000:]}",
+                      file=sys.stderr)
+            raise RuntimeError(f"{name} arm finished {status}")
+        m = json.loads(out.read_text())
+        bd = _launch_breakdown(m, t_submit)
+        recs = read_traces(td / "hist/intermediate" / client.app_id
+                           / TASK_TRACE_FILE)
+        bd["adopted"] = any(
+            n == "child_adopted" for r in recs for n, *_ in r["spans"])
+        bd["final_loss"] = m["final_loss"]
+        bd["accuracy"] = round(m["accuracy"], 4)
+        return bd
+
+    try:
+        cold = run_arm("cold", pool=False)
+        warm = run_arm("warm", pool=False)
+        # pre-warm a HOST-level pool (what an operator keeps running),
+        # then let the job adopt from it — this is the path every
+        # relaunch/resize/roll takes with a per-job pool too
+        pool = warmpool.WarmPool(
+            pool_dir, size=1,
+            warmup_module="tony_tpu.examples.warmup_mnist",
+            # the hook prepays the workload's own staging AND train-block
+            # compile (mnist_jax.build_train_block) at the job's shapes,
+            # into the job's shared persistent cache
+            spawn_env={"TONY_WARMUP_MNIST_SPC": str(SPC),
+                       "TONY_WARMUP_MNIST_BATCH": str(BATCH_),
+                       "TONY_WARMUP_MNIST_CACHE": str(cache)})
+        pool.ensure()
+        deadline = time.time() + 300
+        while warmpool.count_ready(pool_dir) < 1:
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "standby never became ready; see "
+                    + (pool_dir / "spawn.log").read_text()[-2000:])
+            time.sleep(0.2)
+        adopted = run_arm("adopted", pool=True)
+        assert adopted["adopted"], "the adopted arm never adopted"
+        assert not cold["adopted"] and not warm["adopted"]
+        # adoption must not change the training math
+        assert adopted["final_loss"] == cold["final_loss"], (
+            cold["final_loss"], adopted["final_loss"])
+        assert adopted["accuracy"] == cold["accuracy"]
+        speedup = (cold["total_submit_to_first_step_s"]
+                   / adopted["total_submit_to_first_step_s"])
+        # the acceptance gate, enforced like the fleet bench's 1.5x:
+        # adoption must prepay enough of the cold bill to be >=3x
+        assert speedup >= 3.0, (
+            f"adopted path only {speedup:.2f}x vs cold (gate: 3x); "
+            f"cold={cold} adopted={adopted}")
+        print(
+            f"# launch path: cold "
+            f"{cold['total_submit_to_first_step_s']:.1f}s | warm "
+            f"{warm['total_submit_to_first_step_s']:.1f}s | adopted "
+            f"{adopted['total_submit_to_first_step_s']:.1f}s "
+            f"({speedup:.2f}x vs cold)", file=sys.stderr)
+        print(json.dumps({
+            "metric": "launch_path",
+            "value": round(speedup, 2),
+            "unit": "cold/adopted submit->first-step speedup",
+            "cold": cold,
+            "warm": warm,
+            "adopted": adopted,
+            "warmup_module": "tony_tpu.examples.warmup_mnist",
+            "workload": {"steps": STEPS, "steps_per_call": SPC,
+                         "batch": BATCH_},
+        }))
+    finally:
+        try:
+            warmpool.WarmPool(pool_dir, size=0).reap()
+        except Exception:
+            pass
+        shutil.rmtree(td, ignore_errors=True)
     return 0
 
 
 def main() -> int:
+    if "--launch-path" in sys.argv:
+        return run_launch_path_bench()
     if "--elastic" in sys.argv:
         return run_elastic_bench()
     if "--serving" in sys.argv:

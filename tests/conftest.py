@@ -109,6 +109,35 @@ def pytest_runtest_protocol(item, nextitem):
     return True
 
 
+# ------------------------------------------------------ tier-1 wall budget
+# Per-test call durations, collected for the wall-budget guard
+# (tests/test_budget_lint.py): a single non-slow test creeping past the
+# per-test ceiling is how the 870s tier-1 gate historically overflowed
+# (ROADMAP "budget is VERY thin"), and this surfaces the offender by
+# NAME instead of as a mysterious whole-gate timeout. The lint test is
+# reordered to run LAST so it sees every test of the session; durations
+# cover the call phase (fixtures excluded — parallel to --durations).
+
+TEST_DURATIONS: dict[str, float] = {}
+SLOW_NODEIDS: set[str] = set()
+
+
+@pytest.hookimpl
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        TEST_DURATIONS[report.nodeid] = report.duration
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.get_closest_marker("slow"):
+            SLOW_NODEIDS.add(item.nodeid)
+    tail = [i for i in items if "test_tier1_wall_budget" in i.nodeid]
+    if tail:
+        head = [i for i in items if "test_tier1_wall_budget" not in i.nodeid]
+        items[:] = head + tail
+
+
 @pytest.fixture
 def tmp_job_dirs(tmp_path):
     """Staging + history dirs for orchestration tests."""

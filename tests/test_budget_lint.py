@@ -1,0 +1,40 @@
+"""Tier-1 wall-budget guard.
+
+The tier-1 gate (ROADMAP "Tier-1 verify") runs every non-slow test under
+one 870s timeout, and the budget is VERY thin: historically a single
+test creeping to ~28s (the hf-import parity cluster) ate the headroom
+silently until the whole gate flirted with the cap. This lint fails the
+SPECIFIC offender by name instead: conftest.py records every test's
+call-phase duration and reorders this test to run last, so any non-slow
+test that exceeded the per-test ceiling in THIS session fails the run
+with its measured time.
+
+Ceiling: ``TONY_TIER1_TEST_BUDGET_S`` (seconds, default 45). Raise it
+per-run for slow hosts; a test that legitimately needs more than the
+ceiling belongs in ``@pytest.mark.slow`` (run with ``-m slow``), not in
+tier-1.
+"""
+
+import os
+
+import conftest
+
+
+def test_tier1_wall_budget():
+    try:
+        budget_s = float(os.environ.get("TONY_TIER1_TEST_BUDGET_S", "45"))
+    except ValueError:
+        budget_s = 45.0
+    if budget_s <= 0:       # 0/negative disables (debug runs)
+        return
+    offenders = {
+        nodeid: round(duration, 1)
+        for nodeid, duration in conftest.TEST_DURATIONS.items()
+        if duration > budget_s
+        and nodeid not in conftest.SLOW_NODEIDS
+        and "test_tier1_wall_budget" not in nodeid
+    }
+    assert not offenders, (
+        f"non-slow tests exceeded the {budget_s:.0f}s per-test budget "
+        f"(mark them @pytest.mark.slow or shrink them; override with "
+        f"TONY_TIER1_TEST_BUDGET_S): {offenders}")

@@ -617,6 +617,16 @@ def test_metrics_names_rendered_and_documented():
         assert fam in rendered, f"elastic family unrendered: {fam}"
         assert fam in doc_names, f"elastic family undocumented: {fam}"
 
+    # the warm-pool families are pinned EXPLICITLY the same way
+    # (ISSUE 10 lint discipline): each must be rendered by the driver
+    # /metrics endpoint and documented — renaming either side without
+    # the other fails here
+    for fam in (_metrics.DRIVER_WARM_POOL_SIZE,
+                _metrics.DRIVER_WARM_POOL_ADOPTIONS_TOTAL,
+                _metrics.DRIVER_WARM_POOL_MISSES_TOTAL):
+        assert fam in rendered, f"warm-pool family unrendered: {fam}"
+        assert fam in doc_names, f"warm-pool family undocumented: {fam}"
+
 
 def test_telemetry_trace_feed_units():
     """observe_trace maps spans to the right histograms, including the

@@ -1,0 +1,370 @@
+"""Warm executor pool (tony_tpu/warmpool.py): adoption protocol units +
+the e2e acceptance contracts — a launch adopts a pre-warmed standby, a
+pool miss degrades to the cold spawn (never a failed launch), and no
+teardown path orphans a standby.
+
+Standbys here run with TONY_TEST_WARMPOOL_SKIP_WARMUP: the jax
+import/backend warmup is the part the bench measures (PERF.json
+``launch_path``); the tests pin the PROTOCOL, and a blank standby boots
+in ~100ms so the whole file stays inside the tier-1 budget."""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from tony_tpu import constants as c
+from tony_tpu.warmpool import (
+    AdoptedChild,
+    WarmPool,
+    _pid_alive,
+    count_ready,
+    env_compatible,
+    parse_python_command,
+)
+
+PY = sys.executable
+
+
+@pytest.fixture(autouse=True)
+def _skip_warmup(monkeypatch):
+    monkeypatch.setenv(c.TEST_WARMPOOL_SKIP_WARMUP, "1")
+
+
+def _wait_ready(pool_dir, n, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while count_ready(pool_dir) < n:
+        assert time.monotonic() < deadline, (
+            f"pool never reached {n} ready standbys; "
+            f"{(Path(pool_dir) / 'spawn.log').read_text() if (Path(pool_dir) / 'spawn.log').exists() else 'no spawn log'}")
+        time.sleep(0.05)
+
+
+def _wait_dead(pid, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and _pid_alive(pid):
+        time.sleep(0.05)
+    assert not _pid_alive(pid), f"pid {pid} still alive"
+
+
+# ------------------------------------------------------------ command parsing
+
+def test_parse_python_command_forms():
+    assert parse_python_command("python -m tony_tpu.examples.mnist_jax "
+                                "--steps 5")["module"] == \
+        "tony_tpu.examples.mnist_jax"
+    spec = parse_python_command(f"{PY} train.py --lr 0.1")
+    assert spec["script"] == "train.py" and spec["args"] == ["--lr", "0.1"]
+    spec = parse_python_command("FOO=1 BAR=x python3 -u -m mod a b")
+    assert spec["env"] == {"FOO": "1", "BAR": "x"}
+    assert spec["module"] == "mod" and spec["args"] == ["a", "b"]
+    # plain $VAR references survive (expanded at adoption like bash would)
+    spec = parse_python_command("python t.py --out /x/ckpt_$TONY_TASK_INDEX")
+    assert spec["args"] == ["--out", "/x/ckpt_$TONY_TASK_INDEX"]
+
+
+def test_parse_python_command_rejects_shell_and_non_python():
+    for cmd in ("python a.py && python b.py",   # compound
+                "python a.py | tee log",        # pipeline
+                "python a.py > out.txt",        # redirect
+                "python -c 'print(1)'",         # -c payload
+                "echo hi",                      # not python
+                "./run.sh --x",                 # not python
+                "tony-tpu serve --port 1",      # console script
+                "python $(which x)",            # substitution
+                ""):
+        assert parse_python_command(cmd) is None, cmd
+
+
+def test_env_fingerprint_compatibility():
+    warmed = {"warmup": {"backend": "cpu"},
+              "env_fingerprint": {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}}
+    assert env_compatible(warmed, {"JAX_PLATFORMS": "cpu"})
+    # a warmed standby must not adopt a contract for a different backend
+    assert not env_compatible(warmed, {"JAX_PLATFORMS": "tpu"})
+    assert not env_compatible(warmed, {"JAX_PLATFORMS": "cpu",
+                                       "XLA_FLAGS": "--foo"})
+    # a blank (skip-warmup / failed-warmup) standby takes anything
+    assert env_compatible({}, {"JAX_PLATFORMS": "tpu"})
+
+
+# ------------------------------------------------------------- protocol units
+
+@pytest.fixture
+def pool(tmp_path):
+    p = WarmPool(tmp_path / "pool", size=2)
+    yield p
+    p.reap()
+
+
+def test_adopt_runs_entrypoint_with_contract(pool, tmp_path):
+    """The adopted child applies the contract env, runs in the contract
+    cwd, propagates the entrypoint's exit code, and frees its pool slot
+    (claim files cleaned) — while the pool replenishes on demand."""
+    pool.ensure()
+    _wait_ready(pool.dir, 2)
+    script = tmp_path / "task.py"
+    script.write_text(
+        "import os, sys, json, pathlib\n"
+        "pathlib.Path('out.txt').write_text(json.dumps(\n"
+        "    {'var': os.environ.get('MY_VAR'), 'cwd': os.getcwd()}))\n"
+        "sys.exit(7)\n")
+    workdir = tmp_path / "work"
+    workdir.mkdir()
+    env = {**os.environ, "MY_VAR": "hello"}
+    child = pool.adopt(f"python {script}", env, cwd=str(workdir))
+    assert child is not None
+    assert child.wait(timeout=15) == 7
+    out = json.loads((workdir / "out.txt").read_text())
+    assert out == {"var": "hello", "cwd": str(workdir)}
+    # slot freed: one standby left, no claim litter
+    assert count_ready(pool.dir) == 1
+    assert not list(pool.dir.glob("*.claimed"))
+    # replenish restores the target size
+    pool.ensure()
+    _wait_ready(pool.dir, 2)
+
+
+def test_adopt_miss_paths(pool):
+    """Every miss is a clean None (the caller cold-spawns): empty pool,
+    non-adoptable command, claim race."""
+    env = dict(os.environ)
+    # empty pool
+    assert pool.adopt("python x.py", env) is None
+    pool.ensure()
+    _wait_ready(pool.dir, 2)
+    # non-adoptable command leaves the standbys unclaimed
+    assert pool.adopt("./run.sh", env) is None
+    assert count_ready(pool.dir) == 2
+    # two claims of a 2-standby pool both succeed; a third misses — the
+    # rename claim is first-winner-takes-it, never a double adoption
+    sleeper = pool.dir.parent / "sleep.py"
+    sleeper.write_text("import time\ntime.sleep(30)\n")
+    a = pool.adopt(f"python {sleeper}", env)
+    b = pool.adopt(f"python {sleeper}", env)
+    assert a is not None and b is not None and a.pid != b.pid
+    assert pool.adopt(f"python {sleeper}", env) is None
+    a.kill()
+    b.kill()
+
+
+def test_adopted_child_dies_with_adopter(pool, tmp_path):
+    """Control-pipe EOF = adopter death: the adopted child SIGKILLs
+    itself — the moral equivalent of the process-group kill a cold
+    in-group child would have received from a chaos kill."""
+    pool.ensure()
+    _wait_ready(pool.dir, 2)
+    sleeper = tmp_path / "sleep.py"
+    sleeper.write_text("import time\ntime.sleep(60)\n")
+    child = pool.adopt(f"python {sleeper}", dict(os.environ))
+    assert child is not None
+    time.sleep(0.2)
+    child._sock.close()     # the adopter vanishes
+    _wait_dead(child.pid)
+
+
+def test_adopted_child_sigkill_reports_exit_killed(pool, tmp_path):
+    """A standby killed without an exit report reads as EXIT_KILLED —
+    the code the provisioner's group SIGKILL gives a cold child."""
+    pool.ensure()
+    _wait_ready(pool.dir, 1)
+    sleeper = tmp_path / "sleep.py"
+    sleeper.write_text("import time\ntime.sleep(60)\n")
+    child = pool.adopt(f"python {sleeper}", dict(os.environ))
+    assert child is not None
+    os.kill(child.pid, signal.SIGKILL)
+    assert child.wait(timeout=5) == c.EXIT_KILLED
+
+
+def test_standby_self_reaps_on_pool_dir_removal(tmp_path):
+    """Teardown on shared filesystems: removing the pool dir is enough —
+    every standby notices its entry is gone and exits."""
+    import shutil
+
+    pool = WarmPool(tmp_path / "pool", size=1)
+    pool.ensure()
+    _wait_ready(pool.dir, 1)
+    info = json.loads(next(pool.dir.glob("sb_*.json")).read_text())
+    shutil.rmtree(pool.dir)
+    _wait_dead(info["pid"])
+
+
+def test_reap_kills_standbys_and_removes_dir(tmp_path):
+    pool = WarmPool(tmp_path / "pool", size=2)
+    pool.ensure()
+    _wait_ready(pool.dir, 2)
+    pids = [json.loads(p.read_text())["pid"]
+            for p in pool.dir.glob("sb_*.json")]
+    assert len(pids) == 2
+    pool.reap()
+    for pid in pids:
+        _wait_dead(pid, timeout=3)
+    assert not pool.dir.exists()
+
+
+def test_preempt_style_exit_code_propagates(pool, tmp_path):
+    """EXIT_PREEMPTED from an adopted training child reaches the adopter
+    exactly — the driver's budget-free preempt relaunch keys off it."""
+    pool.ensure()
+    _wait_ready(pool.dir, 1)
+    script = tmp_path / "drain.py"
+    script.write_text(f"import sys\nsys.exit({c.EXIT_PREEMPTED})\n")
+    child = pool.adopt(f"python {script}", dict(os.environ))
+    assert child is not None
+    assert child.wait(timeout=10) == c.EXIT_PREEMPTED
+
+
+# --------------------------------------------------------------- e2e contract
+
+def _wait(predicate, timeout, msg):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise AssertionError(msg)
+
+
+def test_e2e_adopted_launch_metrics_and_clean_teardown(
+        tmp_job_dirs, tmp_path, monkeypatch):
+    """Acceptance e2e (in-process driver + real executors): a restarted
+    worker ADOPTS a pre-warmed standby; the trace carries child_adopted
+    with the pool-hit attr, driver /metrics counts the adoption, the
+    TaskInfo reports launch_path, and after driver stop no standby
+    survives (pool dir reaped) — executor SIGTERMs and chaos kills
+    included in the chain."""
+    import tests.conftest as _conftest
+    from tony_tpu.cluster.provisioner import LocalProvisioner
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.driver import Driver
+    from tony_tpu.events.trace import TASK_TRACE_FILE, read_traces
+
+    monkeypatch.setenv(c.TEST_WARMPOOL_SKIP_WARMUP, "1")
+    marker = tmp_path / "failed_once"
+    script = tmp_path / "fail_once.py"
+    script.write_text(
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').write('x')\n"
+        "    sys.exit(1)\n"
+        "sys.exit(0)\n")
+    conf = TonyConf({
+        "tony.staging.dir": tmp_job_dirs["staging"],
+        "tony.history.location": tmp_job_dirs["history"],
+        "tony.history.intermediate": tmp_job_dirs["history"] + "/intermediate",
+        "tony.history.finished": tmp_job_dirs["history"] + "/finished",
+        "tony.am.monitor-interval-ms": 100,
+        "tony.task.registration-poll-interval-ms": 100,
+        "tony.task.metrics-interval-ms": 300,
+        "tony.worker.instances": 1,
+        "tony.worker.command": f"{PY} {script}",
+        "tony.worker.max-restarts": 1,
+        "tony.warmpool.size": 1,
+        # replenish immediately so the restarted attempt finds the
+        # replacement standby (the production default defers it off the
+        # adopted child's compile window)
+        "tony.execution.env": [f"PYTHONPATH={_conftest.REPO_ROOT}",
+                               f"{c.TEST_WARMPOOL_SKIP_WARMUP}=1",
+                               "TONY_WARMPOOL_REPLENISH_DELAY_S=0"],
+    })
+    job_dir = tmp_path / "job"
+    job_dir.mkdir()
+    conf.write_final(job_dir)
+    driver = Driver(conf, app_id="warm_e2e", job_dir=str(job_dir),
+                    provisioner=LocalProvisioner())
+    driver.client_signal.set()
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive(), "driver never finished"
+    assert driver.session.status.value == "SUCCEEDED", (
+        driver.session.failure_message)
+
+    # the restarted attempt adopted (the pool was seeded at prepare and
+    # replenished after any first-attempt adoption)
+    inter = Path(tmp_job_dirs["history"]) / "intermediate" / "warm_e2e"
+    recs = read_traces(inter / TASK_TRACE_FILE)
+    assert recs, "no task trace sealed"
+    spans = [n for r in recs for n, *_ in r["spans"]]
+    assert "restarted" in spans
+    assert "child_adopted" in spans, spans
+    attrs = {k: v for r in recs for k, v in r.get("attrs", {}).items()}
+    assert attrs.get("warm_pool") == "hit"
+    assert driver._warm_adoptions >= 1
+    body = driver.render_metrics()
+    assert "driver_warm_pool_adoptions_total" in body
+    assert "driver_warm_pool_size" in body
+    assert "driver_warm_pool_misses_total" in body
+    infos = {t_.task_id: t_ for t_ in driver.session.task_infos()}
+    assert infos["worker:0"].launch_path == "adopted"
+
+    # teardown reaped the per-job pool: directory gone, no standby alive
+    pool_dir = job_dir / c.WARMPOOL_DIR_NAME
+    _wait(lambda: not pool_dir.exists(), 5, "pool dir survived teardown")
+    for proc_dir in Path("/proc").iterdir():
+        if not proc_dir.name.isdigit():
+            continue
+        try:
+            cmdline = (proc_dir / "cmdline").read_bytes().decode()
+        except OSError:
+            continue
+        assert str(pool_dir) not in cmdline, (
+            f"orphaned standby: pid {proc_dir.name}")
+
+
+def test_e2e_pool_miss_falls_back_cold(tmp_job_dirs, tmp_path, monkeypatch):
+    """A configured pool with a NON-adoptable command must not change the
+    outcome: the launch spawns cold, the job succeeds, the trace records
+    the miss, and the driver counts it."""
+    import tests.conftest as _conftest
+    from tony_tpu.cluster.provisioner import LocalProvisioner
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.driver import Driver
+    from tony_tpu.events.trace import TASK_TRACE_FILE, read_traces
+
+    monkeypatch.setenv(c.TEST_WARMPOOL_SKIP_WARMUP, "1")
+    script = tmp_path / "ok.py"
+    script.write_text("import sys\nsys.exit(0)\n")
+    conf = TonyConf({
+        "tony.staging.dir": tmp_job_dirs["staging"],
+        "tony.history.location": tmp_job_dirs["history"],
+        "tony.history.intermediate": tmp_job_dirs["history"] + "/intermediate",
+        "tony.history.finished": tmp_job_dirs["history"] + "/finished",
+        "tony.am.monitor-interval-ms": 100,
+        "tony.task.registration-poll-interval-ms": 100,
+        "tony.task.metrics-interval-ms": 300,
+        "tony.worker.instances": 1,
+        # the shell operator makes this non-adoptable by design
+        "tony.worker.command": f"{PY} {script} && true",
+        "tony.warmpool.size": 1,
+        "tony.execution.env": [f"PYTHONPATH={_conftest.REPO_ROOT}",
+                               f"{c.TEST_WARMPOOL_SKIP_WARMUP}=1"],
+    })
+    job_dir = tmp_path / "job"
+    job_dir.mkdir()
+    conf.write_final(job_dir)
+    driver = Driver(conf, app_id="warm_miss", job_dir=str(job_dir),
+                    provisioner=LocalProvisioner())
+    driver.client_signal.set()
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive(), "driver never finished"
+    assert driver.session.status.value == "SUCCEEDED", (
+        driver.session.failure_message)
+    inter = Path(tmp_job_dirs["history"]) / "intermediate" / "warm_miss"
+    recs = read_traces(inter / TASK_TRACE_FILE)
+    spans = [n for r in recs for n, *_ in r["spans"]]
+    assert "child_spawned" in spans and "child_adopted" not in spans
+    attrs = {k: v for r in recs for k, v in r.get("attrs", {}).items()}
+    assert attrs.get("warm_pool") == "miss"
+    assert driver._warm_misses >= 1 and driver._warm_adoptions == 0
+    infos = {t_.task_id: t_ for t_ in driver.session.task_infos()}
+    assert infos["worker:0"].launch_path == "cold"
+    assert not (job_dir / c.WARMPOOL_DIR_NAME).exists()

@@ -63,6 +63,9 @@ class TaskInfo:
     # named service ports the task published (publish_ports RPC), e.g. a
     # serving replica's {"serve_port": N, "metrics_port": N}
     ports: dict[str, int] = field(default_factory=dict)
+    # "adopted" when this attempt's child came from the warm executor
+    # pool, "cold" for a fresh spawn, "" before the executor reported
+    launch_path: str = ""
 
     @property
     def task_id(self) -> str:

@@ -174,6 +174,22 @@ TRAIN_STRAGGLER_RESTART_FACTOR = "tony.train.straggler-restart-factor"
 # fires (one noisy push must not cost a budget unit)
 TRAIN_STRAGGLER_GRACE_CHECKS = "tony.train.straggler-grace-checks"
 
+# ----------------------------------------------------------------- warm pool
+# warm executor pool (tony_tpu/warmpool.py, docs/performance.md "Launch
+# path"): N standby python children per host that have already imported
+# jax + initialized the backend; a task launch ADOPTS one instead of
+# cold-spawning, cutting submit->first-step, relaunch, resize, and roll
+# latency by the prepaid bill (BENCH r05: 23.6s of a 29.3s cold start).
+# 0 disables (every launch spawns cold).
+WARMPOOL_SIZE = "tony.warmpool.size"
+# optional dotted module imported during standby warmup; its warmup()
+# (if defined) runs after the default jax warmup — the hook for
+# pre-staging data / prepaying heavyweight imports the role command needs
+WARMPOOL_WARMUP_MODULE = "tony.warmpool.warmup-module"
+# where the pool lives; "" = <job dir>/warmpool (per-job pool). Point
+# several jobs at one host-level dir to share standbys across submits.
+WARMPOOL_DIR = "tony.warmpool.dir"
+
 # ------------------------------------------------------------------ horovod
 HOROVOD_TEST_MODE = "tony.horovod.mode.test"              # stub rendezvous server
 HOROVOD_FAST_FAIL = "tony.horovod.driver.fast-fail"       # driver exits 1 at once
@@ -202,7 +218,7 @@ _ROLE_KEY_RE = re.compile(r"^tony\.([A-Za-z][A-Za-z0-9_\-]*)\.instances$")
 _RESERVED_NON_ROLES = frozenset(
     {"application", "am", "task", "staging", "history", "cluster", "tpu",
      "security", "execution", "horovod", "version", "serving", "router",
-     "train"}
+     "train", "warmpool"}
 )
 
 

@@ -87,6 +87,9 @@ PREEMPT_REQUEST_SUFFIX = ".preempt"
 # subdirectory (under the job's logs dir / serve --trace-dir) where
 # captured xplane profiles land; the portal lists it on /profiles/<app>
 PROFILE_DIR_NAME = "profiles"
+# default warm-pool directory under the job dir (tony.warmpool.dir="")
+# — standby advertisement files + control sockets (tony_tpu/warmpool.py)
+WARMPOOL_DIR_NAME = "warmpool"
 
 # ---- fault-injection hooks (production code paths, keyed off env like
 # reference Constants.java:124-130 TEST_* hooks)
@@ -126,6 +129,9 @@ TEST_DRIVER_HEARTBEAT_DROP_RATE = "TONY_TEST_DRIVER_HEARTBEAT_DROP_RATE"
 #   probability that an incoming heartbeat RPC errors instead of being
 #   recorded — a lossy control plane; exercises liveness margins
 TEST_DRIVER_CHAOS_SEED = "TONY_TEST_DRIVER_CHAOS_SEED"
+TEST_WARMPOOL_SKIP_WARMUP = "TONY_TEST_WARMPOOL_SKIP_WARMUP"
+#   standbys skip the jax import/backend warmup (tests: a blank standby
+#   boots in ~100ms and the adoption protocol is what's under test)
 TEST_ALLOCATION_HOLD = "TONY_TEST_ALLOCATION_HOLD"          # "role#idx" never gets
 #   capacity: the driver skips its launch so the gang waits — exercises the
 #   allocation-timeout deadlock breaker (reference MLGenericRuntime.java:110-147)

@@ -51,6 +51,9 @@ from .metrics import (
     DRIVER_TASK_ROLLS_TOTAL,
     DRIVER_TASK_SERVICE_PORT,
     DRIVER_TASKS,
+    DRIVER_WARM_POOL_ADOPTIONS_TOTAL,
+    DRIVER_WARM_POOL_MISSES_TOTAL,
+    DRIVER_WARM_POOL_SIZE,
 )
 from .observability import PROM_CONTENT_TYPE, Histogram, PromRenderer, TaskTrace
 from .rpc import RpcServer
@@ -356,6 +359,24 @@ class Driver:
         # per container, so plain set semantics suffice.
         self._rolls: set[str] = set()
         self._roll_count = 0
+        # ---- warm executor pool (tony_tpu/warmpool.py) ----
+        # pool-aware relaunch: EVERY launch path — first launch, budgeted
+        # restart, budget-free preempt/resize/roll relaunch — runs the
+        # executor-side adoption (runtimes/base.spawn_or_adopt), so a
+        # recovery skips the prepaid jax-import/backend/data bill. The
+        # driver seeds the local pool at prepare() (standbys warm while
+        # the first gang launches), counts adoptions/misses from the
+        # merged child_adopted/child_spawned spans, and reaps the pool at
+        # stop() so teardown never orphans a standby.
+        from .warmpool import WarmPool
+
+        # standbys warm under the same execution env the task children
+        # get (_task_env applies the same pairs), so the env fingerprint
+        # matches at adoption
+        self._warm_pool = WarmPool.from_conf(
+            conf, str(self.job_dir), spawn_env=self._execution_env())
+        self._warm_adoptions = 0
+        self._warm_misses = 0
         # ---- elastic, preemption-tolerant training state ----
         # (docs/training-robustness.md). Tasks mid-preemption-drain: the
         # driver relayed (or was told of) a "preempting" notice; the
@@ -478,6 +499,27 @@ class Driver:
         tmp = self.job_dir / (c.DRIVER_INFO_FILE + ".tmp")
         tmp.write_text(json.dumps(info))
         tmp.rename(self.job_dir / c.DRIVER_INFO_FILE)
+        # seed the warm pool on THIS host for local capacity: standbys
+        # prepay the jax/backend bill while the first gang launches, so
+        # even the first relaunch adopts. Remote hosts seed their own
+        # pools (each executor tops up its host's pool at startup).
+        from .cluster.provisioner import LocalProvisioner
+
+        if (self._warm_pool is not None
+                and isinstance(self.provisioner, LocalProvisioner)):
+            try:
+                # per-job pools bind their standbys to this driver's pid
+                # (orphan self-reaping if the driver dies without stop());
+                # an explicit host-level pool outlives jobs by design
+                if Path(self._warm_pool.dir).resolve().is_relative_to(
+                        self.job_dir.resolve()):
+                    self._warm_pool.watch_pid = os.getpid()
+                n = self._warm_pool.ensure()
+                if n:
+                    log.info("seeded warm pool with %d standby(s) in %s",
+                             n, self._warm_pool.dir)
+            except Exception:
+                log.exception("warm pool seeding failed; launches stay cold")
 
     def start_session(self) -> None:
         """Build scheduler and request capacity — reference start:577-608.
@@ -610,11 +652,19 @@ class Driver:
                 env[c.ENV_JOB_ARCHIVE_SHA256] = digest
         if self.conf.get_bool(keys.TASK_LOCALIZE, False):
             env[c.ENV_LOCALIZE] = "true"
+        env.update(self._execution_env())
+        env.update(spec.env)
+        return env
+
+    def _execution_env(self) -> dict[str, str]:
+        """``tony.execution.env`` K=V pairs — ONE parse shared by the
+        task launch env and the warm-pool standby spawn env, so standbys
+        always warm under the env the children they'll adopt for get."""
+        env: dict[str, str] = {}
         for kv in self.conf.get_list(keys.EXECUTION_ENV):
             if "=" in kv:
                 k, v = kv.split("=", 1)
                 env[k] = v
-        env.update(spec.env)
         return env
 
     # ------------------------------------------------------- task telemetry
@@ -682,12 +732,16 @@ class Driver:
 
     def _merge_executor_spans(self, task_id: str, spans: list) -> None:
         """Executor-side lifecycle spans arrive as [name, unix_ts] pairs
-        (the monitor pushes its cumulative list every interval — each
-        name merges once per attempt), re-anchored from the executor's
-        wall clock onto this host's monotonic timeline. Cross-host NTP
-        skew can shift them against driver-observed spans but the
-        driver's own span order is never affected; the waterfall sorts
-        by timestamp for display."""
+        — optionally [name, unix_ts, attrs] (the warm-pool hit/miss
+        marks carry a ``warm_pool`` attr) — the monitor pushes its
+        cumulative list every interval; each name merges once per
+        attempt, re-anchored from the executor's wall clock onto this
+        host's monotonic timeline. Cross-host NTP skew can shift them
+        against driver-observed spans but the driver's own span order is
+        never affected; the waterfall sorts by timestamp for display.
+        ``child_adopted`` / pool-missed ``child_spawned`` feed the
+        driver_warm_pool_{adoptions,misses}_total counters and the
+        task's wire-visible ``launch_path``."""
         offset = time.monotonic() - time.time()
         with self._tt_lock:
             tr = self.task_traces.get(task_id)
@@ -712,7 +766,33 @@ class Driver:
                 if unix_t < floor:
                     continue
                 seen.add(name)
+                attrs = (item[2] if len(item) > 2
+                         and isinstance(item[2], dict) else {})
+                for k, v in attrs.items():
+                    if isinstance(k, str) and isinstance(
+                            v, (str, int, float, bool)):
+                        tr.attrs[k] = v
                 tr.mark(name, t=unix_t + offset)
+                if name in ("child_adopted", "child_spawned"):
+                    self._note_launch_path(
+                        task_id, name, attrs.get("warm_pool"))
+
+    def _note_launch_path(self, task_id: str, span: str,
+                          warm_pool) -> None:
+        """Warm-pool accounting off the merged launch span (caller holds
+        _tt_lock; once per attempt via the span-dedupe set): adoption
+        and configured-pool-miss counters plus the task's wire-visible
+        launch_path ("adopted"/"cold" on TaskInfo)."""
+        task = self.session.get_task_by_id(task_id)
+        if span == "child_adopted":
+            self._warm_adoptions += 1
+            if task is not None:
+                task.launch_path = "adopted"
+        else:
+            if warm_pool == "miss":
+                self._warm_misses += 1
+            if task is not None:
+                task.launch_path = "cold"
 
     def _clear_attempt_state_locked(self, task_id: str) -> None:
         """Drop the once-per-attempt markers. Caller holds _tt_lock."""
@@ -897,7 +977,21 @@ class Driver:
             r.counter(DRIVER_GANG_RESIZES_TOTAL, self._resize_count,
                       "elastic gang re-formations (down on worker loss "
                       "past its budget, up when capacity returned)")
+            r.counter(DRIVER_WARM_POOL_ADOPTIONS_TOTAL,
+                      self._warm_adoptions,
+                      "task launches that adopted a pre-warmed standby "
+                      "(child_adopted spans)")
+            r.counter(DRIVER_WARM_POOL_MISSES_TOTAL, self._warm_misses,
+                      "launches with the warm pool configured that fell "
+                      "back to a cold spawn")
             reg = dict(self._reg_t)
+        from .warmpool import count_ready
+
+        r.gauge(DRIVER_WARM_POOL_SIZE,
+                count_ready(self._warm_pool.dir
+                            if self._warm_pool is not None else None),
+                "ready (adoptable) standbys in the driver host's warm "
+                "pool; 0 when the pool is off")
         # driver-process XLA compile telemetry (preprocess/notebook jobs
         # run user code in-process); each training CHILD's compile totals
         # arrive as executor-pushed metrics (xla_compiles et al) and
@@ -1119,6 +1213,7 @@ class Driver:
         # the old attempt's published service ports are dead endpoints;
         # consumers (the fleet router's discovery) must not route to them
         task.ports.clear()
+        task.launch_path = ""   # the NEW attempt reports its own path
         self._trace_mark(task_id, "requested")
         env = self._task_env(spec, idx)
         # same launch/handle atomicity as _request_role (reentrant: the
@@ -1814,6 +1909,20 @@ class Driver:
         client's finish signal so it can read terminal state, then tear down."""
         status = self.session.status
         self.provisioner.stop_all()
+        # reap the warm pool AFTER the containers: an adopted child dies
+        # with its executor (control-pipe EOF), and idle standbys must
+        # not outlive the job — reap() signals same-host pids and removes
+        # the pool dir, which shared-FS standbys on other hosts notice
+        # and self-exit on. Only the default per-job pool is reaped: an
+        # explicit tony.warmpool.dir is a HOST-level pool the operator
+        # shares across submits, and this job does not own its standbys.
+        if self._warm_pool is not None:
+            try:
+                if Path(self._warm_pool.dir).resolve().is_relative_to(
+                        self.job_dir.resolve()):
+                    self._warm_pool.reap()
+            except Exception:
+                log.exception("warm pool reap failed")
         self._seal_remaining_traces()
         if self.events:
             failed = sum(

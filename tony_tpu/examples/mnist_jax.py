@@ -26,9 +26,48 @@ per-step device cost; the wall rate is still reported alongside.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import statistics
 import time
+
+
+@functools.lru_cache(maxsize=8)
+def build_train_block(n_steps: int, nb: int, lr: float = 1e-3):
+    """The jitted ``n_steps``-step train scan over a staged ``(nb, bs,
+    ...)`` dataset. Module-level (not a main() closure) so the warm-pool
+    warmup hook (examples/warmup_mnist.py) can build the IDENTICAL
+    program and prepay its backend compile into the persistent
+    compilation cache before a task is ever adopted — the adopted
+    entrypoint's compile is then a cache hit. (The adopted run executes
+    this file afresh via runpy as ``__main__``, a new module namespace,
+    so the jit OBJECT itself does not carry over and tracing is still
+    paid; the memoization only dedupes builds within one namespace.)"""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tony_tpu.models.mnist import loss_fn
+
+    opt = optax.adam(lr)
+
+    @jax.jit
+    def run_block(params, opt_state, xb_all, yb_all, start):
+        def body(carry, i):
+            params, opt_state = carry
+            j = (start + i) % nb
+            xb = jax.lax.dynamic_index_in_dim(xb_all, j, keepdims=False)
+            yb = jax.lax.dynamic_index_in_dim(yb_all, j, keepdims=False)
+            loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+            updates, opt_state = opt.update(grads, opt_state)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), jnp.arange(n_steps)
+        )
+        return params, opt_state, losses[-1]
+
+    return run_block
 
 
 def main(argv=None) -> int:
@@ -51,7 +90,7 @@ def main(argv=None) -> int:
     import optax
 
     from tony_tpu import train
-    from tony_tpu.models.mnist import accuracy, init_mlp, loss_fn, synthetic_mnist
+    from tony_tpu.models.mnist import accuracy, init_mlp, synthetic_mnist
     from tony_tpu.parallel import MeshSpec, build_mesh
 
     t_import = time.time()
@@ -91,27 +130,10 @@ def main(argv=None) -> int:
     # seconds of executable load over a tunneled backend — the entire
     # "warm relaunch still compiles 13s" mystery of the round-3 bench.
     # As an argument the program is ~1MB and a warm relaunch loads fast.
-    def make_block(n):
-        @jax.jit
-        def run_block(params, opt_state, xb_all, yb_all, start):
-            def body(carry, i):
-                params, opt_state = carry
-                j = (start + i) % nb
-                xb = jax.lax.dynamic_index_in_dim(xb_all, j, keepdims=False)
-                yb = jax.lax.dynamic_index_in_dim(yb_all, j, keepdims=False)
-                loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
-                updates, opt_state = opt.update(grads, opt_state)
-                return (optax.apply_updates(params, updates), opt_state), loss
-
-            (params, opt_state), losses = jax.lax.scan(
-                body, (params, opt_state), jnp.arange(n)
-            )
-            return params, opt_state, losses[-1]
-
-        return run_block
-
-    run_long = make_block(spc)
-    run_short = make_block(spc_short)
+    # (Builder hoisted to module level — build_train_block — so the
+    # warm-pool warmup hook can prepay the identical program's compile.)
+    run_long = build_train_block(spc, nb, args.lr)
+    run_short = build_train_block(spc_short, nb, args.lr)
 
     # warm-up/compile call (excluded from throughput, included in launch
     # latency — the block runs spc steps, but compile dominates its cost).

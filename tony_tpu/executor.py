@@ -379,6 +379,32 @@ class Executor:
         hb_interval = self.conf.get_int(keys.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000
         ctx_holder: dict = {}
 
+        # warm pool lifecycle (tony_tpu/warmpool.py): top the host pool up
+        # FIRST so standbys warm while this executor registers and waits
+        # on the gang barrier — this attempt may then adopt one, and the
+        # replenishment after adoption keeps the NEXT attempt (restart,
+        # resize, roll) warm too. Standbys outlive this process by design
+        # (they are host capacity, not attempt state); the driver reaps
+        # the pool at teardown and standbys self-exit when their pool
+        # entry vanishes, so a SIGTERM/SIGKILL of this executor never
+        # orphans them. Container mode stays cold.
+        self._warm_pool = None
+        try:
+            from .utils import containers
+            from .warmpool import WarmPool
+
+            # container mode always spawns cold, and serving replicas
+            # never adopt (the drain contract — runtimes/serving.py), so
+            # neither should pay for standbys it can't use
+            if (self.framework != "serving"
+                    and not containers.container_enabled(self.conf)):
+                self._warm_pool = WarmPool.from_conf(self.conf, self.job_dir)
+            if self._warm_pool is not None:
+                self._warm_pool.ensure()
+        except Exception:
+            log.exception("warm pool setup failed; launches stay cold")
+            self._warm_pool = None
+
         def _die_with_driver() -> None:
             proc = getattr(ctx_holder.get("ctx"), "child_process", None)
             if proc is not None and proc.poll() is None:

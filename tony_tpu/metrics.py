@@ -78,6 +78,13 @@ DRIVER_TASK_SERVICE_PORT = "driver_task_service_port"
 DRIVER_PREEMPTIONS_TOTAL = "driver_preemptions_total"
 DRIVER_GANG_RESIZES_TOTAL = "driver_gang_resizes_total"
 DRIVER_CHECKPOINT_AGE_S = "driver_checkpoint_age_s"
+# warm executor pool (tony_tpu/warmpool.py, docs/performance.md "Launch
+# path"): ready standbys on the driver host's pool, task launches that
+# ADOPTED a pre-warmed child (child_adopted spans), and launches that
+# had the pool configured but fell back to a cold spawn
+DRIVER_WARM_POOL_SIZE = "driver_warm_pool_size"
+DRIVER_WARM_POOL_ADOPTIONS_TOTAL = "driver_warm_pool_adoptions_total"
+DRIVER_WARM_POOL_MISSES_TOTAL = "driver_warm_pool_misses_total"
 
 # fleet-router exposition families (rendered by tony_tpu/router.py's GET
 # /metrics; same one-contract rule — the metrics-name lint pins these to
@@ -231,7 +238,7 @@ class TaskMonitor:
         # refresh runs on the monitor thread while note()/add_span() come
         # from the heartbeater and the executor main thread
         self._mlock = threading.Lock()
-        self._spans: list[list] = []        # [name, unix_ts] pairs
+        self._spans: list[list] = []        # [name, unix_ts] (+ attrs)
         self._step_log: str | None = None
 
     def set_context(self, ctx) -> None:

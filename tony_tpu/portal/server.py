@@ -447,7 +447,10 @@ _TASK_SEG_COLORS = {
     "first_heartbeat": "#8fc1d9",  # registration -> liveness
     "running": "#c9d68a",          # gang barrier release
     "work_dir_ready": "#d6c97a",   # executor-side setup
-    "child_spawned": "#e0a86c",    # user process up
+    "child_spawned": "#e0a86c",    # user process up (cold spawn)
+    "child_adopted": "#6cbfe0",    # user process up via warm-pool
+    #                                adoption (the prepaid launch path —
+    #                                attrs carry warm_pool hit/miss)
     "child_exited": "#c9a0d6",     # user process done, result in flight
     "finished": "#79b77a",
     "restarted": "#e0876c",
@@ -527,6 +530,7 @@ def _task_timeline_html(app_id: str, traces: list[dict]) -> str:
         for n, c in (("capacity", "#b5b5b5"), ("launch", "#9aa7b8"),
                      ("register", "#7aa7d6"), ("liveness", "#8fc1d9"),
                      ("barrier", "#c9d68a"), ("child up", "#e0a86c"),
+                     ("adopted", "#6cbfe0"),
                      ("done", "#79b77a"), ("restart", "#e0876c"),
                      ("roll", "#8fd0c9"), ("preempt", "#d6b35c"),
                      ("resize", "#9a7fd0"), ("dead", "#d98080")))

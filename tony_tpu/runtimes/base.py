@@ -10,8 +10,10 @@ FrameworkRuntimeProvider.java:30-67).
 
 from __future__ import annotations
 
+import logging
 import subprocess
 import os
+import threading
 import time
 from typing import TYPE_CHECKING, Any
 
@@ -20,6 +22,65 @@ from ..api import DistributedMode
 if TYPE_CHECKING:
     from ..conf import TonyConf
     from ..session import Session
+
+log = logging.getLogger(__name__)
+
+
+def spawn_or_adopt(ctx: "TaskContext",
+                   contract_env: dict[str, str]) -> Any:
+    """Start the user process for ``ctx``: adopt a pre-warmed standby
+    from the host's warm pool (tony_tpu/warmpool.py) when one is ready
+    and the command is a single python invocation, else cold-``Popen``
+    through a shell. Adoption marks ``child_adopted`` on the task trace
+    (pool hit); a configured-but-missed pool marks ``child_spawned``
+    with a ``warm_pool: miss`` attr — the driver counts both into
+    ``driver_warm_pool_{adoptions,misses}_total``. A successful
+    adoption replenishes the pool in the background so the NEXT launch
+    (relaunch, resize, roll) finds a warm standby too. Any adoption
+    problem degrades to the cold path, never to a failed launch."""
+    from ..warmpool import WarmPool
+
+    pool = None
+    try:
+        pool = WarmPool.from_context(ctx)
+    except Exception:
+        log.exception("warm pool unavailable; spawning cold")
+    if pool is not None:
+        child = None
+        try:
+            child = pool.adopt(ctx.command,
+                               {**os.environ, **contract_env},
+                               cwd=ctx.work_dir)
+        except Exception:
+            log.exception("warm pool adoption failed; spawning cold")
+        if child is not None:
+            ctx.child_process = child
+            ctx.note_span("child_adopted",
+                          attrs={"warm_pool": "hit",
+                                 "standby_warmed_s": child.warmed_s})
+
+            def _replenish():
+                # deferred: an immediate respawn's warmup would compete
+                # with the adopted child's own first-step compile
+                from ..warmpool import replenish_delay_s
+
+                time.sleep(replenish_delay_s())
+                try:
+                    pool.ensure()
+                except Exception:
+                    log.exception("warm pool replenish failed")
+
+            threading.Thread(target=_replenish, name="warmpool-replenish",
+                             daemon=True).start()
+            return child
+    proc = subprocess.Popen(
+        ["bash", "-c", ctx.command],
+        env={**os.environ, **contract_env}, cwd=ctx.work_dir or None,
+    )
+    ctx.child_process = proc
+    ctx.note_span("child_spawned",
+                  attrs={"warm_pool": "miss"} if pool is not None else None)
+    return proc
 
 
 class DriverAdapter:
@@ -82,9 +143,12 @@ class TaskAdapter:
         """Default: fork the user command through a shell with the built env,
         stream output, return its exit code (reference
         Utils.executeShell:299-328 — minus the hadoop-classpath preamble,
-        which has no TPU equivalent). With `tony.docker.enabled` the command
-        runs inside the configured image instead (reference Docker-on-YARN,
-        HadoopCompatibleAdapter.java:45-159)."""
+        which has no TPU equivalent) — or, when the warm pool has a ready
+        standby (``tony.warmpool.size``), ADOPT it instead of cold-spawning
+        (spawn_or_adopt; docs/performance.md "Launch path"). With
+        `tony.docker.enabled` the command runs inside the configured image
+        instead (reference Docker-on-YARN, HadoopCompatibleAdapter.java:
+        45-159); container mode always spawns cold."""
         from .. import constants as c
         from ..utils import containers
 
@@ -107,13 +171,14 @@ class TaskAdapter:
                 name=name,
             )
             ctx.container_name = name
-            env = dict(os.environ)
+            proc = subprocess.Popen(
+                argv, env=dict(os.environ), cwd=ctx.work_dir or None)
+            ctx.child_process = proc
+            ctx.note_span("child_spawned")
         else:
-            argv = ["bash", "-c", ctx.command]
-            env = {**os.environ, **contract_env}
-        proc = subprocess.Popen(argv, env=env, cwd=ctx.work_dir or None)
-        ctx.child_process = proc
-        ctx.note_span("child_spawned")
+            # bare tasks may adopt a pre-warmed standby (container mode
+            # stays cold: the warm interpreter lives outside the image)
+            proc = spawn_or_adopt(ctx, contract_env)
         try:
             return proc.wait()
         finally:
@@ -156,13 +221,18 @@ class TaskContext:
         self.work_dir: str | None = None
         self.child_process: subprocess.Popen | None = None
         self.container_name: str | None = None
-        # executor-side lifecycle spans ([name, unix_ts]) — adapters mark
-        # child_spawned here; the TaskMonitor ships them to the driver,
-        # which merges them into the task's TaskTrace
+        # executor-side lifecycle spans ([name, unix_ts] or
+        # [name, unix_ts, attrs]) — adapters mark child_spawned /
+        # child_adopted here; the TaskMonitor ships them to the driver,
+        # which merges them into the task's TaskTrace (span attrs land
+        # on the trace's attrs dict)
         self.spans: list[list] = []
 
-    def note_span(self, name: str) -> None:
-        self.spans.append([name, time.time()])
+    def note_span(self, name: str, attrs: dict | None = None) -> None:
+        span: list = [name, time.time()]
+        if attrs:
+            span.append(dict(attrs))
+        self.spans.append(span)
 
     @property
     def cluster_spec(self) -> dict[str, list[str]]:

@@ -165,6 +165,13 @@ class ServingTaskAdapter(TaskAdapter):
                       "serving job type yet; run replicas bare or use "
                       "the generic runtime")
             return 1
+        # serving replicas deliberately do NOT adopt from the warm pool:
+        # the provisioner's process-group SIGTERM is how a replica learns
+        # to DRAIN (rolls, teardown — see _kill_tree's docstring), and an
+        # adopted child lives in its own session where that signal never
+        # arrives; its adopter-EOF watchdog would SIGKILL it mid-drain
+        # instead, dropping in-flight requests on every roll. Until the
+        # drain signal is relayed adoption-aware, replicas spawn cold.
         proc = subprocess.Popen(
             ["bash", "-c", ctx.command],
             env={**os.environ, **contract_env}, cwd=ctx.work_dir or None)

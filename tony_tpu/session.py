@@ -35,6 +35,11 @@ class Task:
     # port: any task can advertise any number of named endpoints, and
     # they ride the cluster-spec payload + TaskInfo to every consumer
     ports: dict[str, int] = field(default_factory=dict)
+    # how this attempt's user process came up: "adopted" (warm-pool
+    # standby, tony_tpu/warmpool.py), "cold" (fresh spawn), "" before
+    # the executor reports either — set by the driver from the
+    # child_adopted/child_spawned trace spans, cleared per attempt
+    launch_path: str = ""
 
     @property
     def task_id(self) -> str:
@@ -48,7 +53,7 @@ class Task:
         return TaskInfo(
             name=self.name, index=self.index, status=self.status.value,
             host=self.host, port=self.port, url=self.url, exit_code=self.exit_code,
-            ports=dict(self.ports),
+            ports=dict(self.ports), launch_path=self.launch_path,
         )
 
 
