@@ -108,6 +108,17 @@ restart budget — and reports goodput, shed/cancelled/expired counts,
 recovery counters, and the p50 latency of admitted requests. Results
 land in PERF.json under `serving_robustness` (`--overload` alone runs
 the same burst with injection off).
+
+`python bench.py --serving --replay` gates the request-durability layer
+(docs/serving.md "Request durability & replay"): a deterministic
+mid-decode loop crash (TONY_TEST_SERVING_CRASH_AT_BLOCKS) and a replica
+SIGKILL mid-burst behind the FleetRouter must both finish with ZERO
+failed requests and byte-identical completions vs an uninterrupted run
+(replay recompute bounded by one prompt+emitted-prefix re-prefill per
+replay; the journal-off path must preserve today's fail-fast
+behavior), and the SIGKILLed replica restarted against the same
+--trace-dir must recover its file journal and finish the orphaned
+requests. Results land in PERF.json under `serving_replay`.
 """
 
 from __future__ import annotations
@@ -1051,6 +1062,387 @@ def run_serving_robustness_bench(chaos: bool) -> int:
     return 0
 
 
+def run_serving_replay_bench() -> int:
+    """Request-durability gate (one JSON line -> PERF.json
+    `serving_replay`; docs/serving.md "Request durability & replay").
+    Three arms, invariants ENFORCED rather than reported:
+
+    A) **Loop-crash replay** (in-process): an uninterrupted run is the
+       byte-reference; a second run eats two DETERMINISTIC mid-decode
+       loop crashes (TONY_TEST_SERVING_CRASH_AT_BLOCKS) and must
+       deliver ZERO failed requests with byte-identical completions,
+       with replay recompute bounded by one re-prefill of
+       prompt+emitted per replay (the prefix is never re-decoded).
+    B) **Fail-fast preserved**: the same crash with replay disabled
+       must FAIL the in-flight set (the pre-journal contract) — the
+       journal-off path keeps its semantics.
+    C) **Fleet SIGKILL failover + journal recovery** (subprocess): two
+       TINY serve replicas with file journals behind a FleetRouter;
+       one replica is SIGKILLed with requests in flight — zero failed
+       requests, byte-identical to an in-process reference, at least
+       one resume-carrying failover — and the killed replica
+       RESTARTED against the same --trace-dir recovers its journal and
+       finishes the orphaned requests (stats replays >= 1,
+       attrs.recovered_from in its trace file).
+    """
+    import re as _re
+    import signal as _signal
+    import subprocess
+    import threading
+    import urllib.request
+
+    sys.path.insert(0, str(REPO))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tony_tpu import constants as c
+    from tony_tpu.models import transformer
+    from tony_tpu.models.serving import Completion, Request, SlotServer
+
+    # ---- arm A/B: in-process loop-crash replay (robustness shape) ----
+    cfg = transformer.TransformerConfig(
+        vocab_size=2048, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
+        d_ff=1024, max_seq_len=512,
+        dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+        else jnp.float32,
+    )
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    slots, max_len, n_requests = 8, 512, 16
+    rng = np.random.default_rng(7)
+    prompt_lens = [16, 48, 96]
+    # MIXED budgets: short requests complete early, which forces the
+    # open-loop pipeline to process — so the journal holds PARTIAL
+    # emitted prefixes for the long requests when the crash lands, and
+    # the replay arm demonstrably carries tokens across the boundary
+    budgets = [16, 64, 32, 48]
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=prompt_lens[i % len(prompt_lens)],
+                            dtype=np.int32)
+               for i in range(n_requests)]
+    srv_kw = dict(slots=slots, max_len=max_len, block_size=16,
+                  prefill_chunk=64)
+
+    def run_arm(extra_env: dict, replay: bool):
+        from tony_tpu.cli.serve import ServeApp, ServingLoopError
+
+        saved = {k: os.environ.get(k) for k in extra_env}
+        os.environ.update(extra_env)
+        try:
+            srv = SlotServer(params, cfg, replay=replay, **srv_kw)
+            app = ServeApp(srv, max_loop_restarts=16, loop_backoff_s=0.02)
+            app.start()
+            results: dict[int, object] = {}
+
+            def call(i):
+                try:
+                    results[i] = app.generate(
+                        prompts[i], budgets[i % len(budgets)],
+                        timeout=600)
+                except Exception as e:
+                    results[i] = e
+
+            t0 = time.time()
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(n_requests)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=900)
+            wall = time.time() - t0
+            assert not any(t.is_alive() for t in threads), "hung waiters"
+            app.shutdown()
+            return srv, app, results, wall
+        finally:
+            for k, v in saved.items():
+                (os.environ.pop(k, None) if v is None
+                 else os.environ.update({k: v}))
+
+    # byte-reference: uninterrupted
+    ref_srv, _, ref_results, ref_wall = run_arm({}, replay=True)
+    assert all(isinstance(r, Completion) for r in ref_results.values())
+    refs = {i: ref_results[i].tokens for i in range(n_requests)}
+
+    # arm A: two mid-decode crashes, journal ON — ordinals deep enough
+    # that short requests have completed (their processing revealed the
+    # long requests' partial prefixes to the journal)
+    srv, app, results, crash_wall = run_arm(
+        {c.TEST_SERVING_CRASH_AT_BLOCKS: "3,7"}, replay=True)
+    failed = [i for i, r in results.items()
+              if not isinstance(r, Completion)]
+    assert not failed, f"replay arm failed requests: {failed}"
+    mismatched = [i for i in range(n_requests)
+                  if results[i].tokens != refs[i]]
+    assert not mismatched, f"replay diverged on requests: {mismatched}"
+    assert srv.chaos_faults_injected == 2 and app.loop_restarts >= 1
+    assert srv.replays >= 1, "crashes hit in-flight work; must replay"
+    # recompute bound: the extra prefill vs the uninterrupted run is at
+    # most one prompt+prefix re-prefill per replay — the emitted prefix
+    # re-prefills, it is NEVER re-decoded
+    extra_prefill = (srv.prefill_tokens_computed
+                     - ref_srv.prefill_tokens_computed)
+    bound = srv.replays * max(len(p) for p in prompts) \
+        + srv.replayed_tokens
+    assert extra_prefill <= bound, (
+        f"replay recompute {extra_prefill} exceeds the "
+        f"prompt+emitted-prefix bound {bound}")
+
+    # arm B: same crash, replay OFF -> fail-fast preserved
+    from tony_tpu.cli.serve import ServingLoopError
+
+    srv_off, app_off, results_off, _ = run_arm(
+        {c.TEST_SERVING_CRASH_AT_BLOCKS: "2"}, replay=False)
+    failed_off = [i for i, r in results_off.items()
+                  if isinstance(r, ServingLoopError)]
+    assert failed_off, (
+        "journal-off crash must fail the in-flight set (fail-fast)")
+    assert srv_off.replays == 0
+
+    # ---- arm C: fleet SIGKILL failover + journal recovery ----
+    import tempfile as _tempfile
+
+    from tony_tpu.router import FleetRouter
+
+    tiny = dict(vocab=256, d_model=64, n_layers=2, n_heads=4, d_ff=128)
+    t_slots, t_max_len, t_chunk, t_block = 4, 128, 8, 4
+    t_requests = 12
+    # mixed budgets: early completions force the open-loop pipeline to
+    # process, revealing the long requests' partial prefixes to the
+    # journal (same trick as arm A) — so the /progress polls have real
+    # prefixes to journal before the kill
+    t_budgets = [16, 48, 32, 64]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           # slow each scheduling turn so the burst stays in flight
+           # long enough for progress polls + a mid-decode kill (the
+           # TINY model would otherwise drain the burst in a beat)
+           "TONY_TEST_SERVING_STEP_DELAY_MS": "25"}
+    env.pop("XLA_FLAGS", None)
+
+    tiny_cfg = transformer.TransformerConfig(
+        vocab_size=tiny["vocab"], d_model=tiny["d_model"],
+        n_layers=tiny["n_layers"], n_heads=tiny["n_heads"],
+        n_kv_heads=tiny["n_heads"], d_ff=tiny["d_ff"],
+        dtype=jnp.float32)
+    tiny_params = transformer.init(jax.random.PRNGKey(0), tiny_cfg)
+    t_rng = np.random.default_rng(11)
+    template = t_rng.integers(0, tiny["vocab"], size=t_chunk,
+                              dtype=np.int32)
+    t_prompts = [np.concatenate(
+        [template, t_rng.integers(0, tiny["vocab"], size=2 + i % 5,
+                                  dtype=np.int32)]).tolist()
+        for i in range(t_requests)]
+    ref2_srv = SlotServer(tiny_params, tiny_cfg, slots=t_slots,
+                          max_len=t_max_len, block_size=t_block,
+                          prefill_chunk=t_chunk)
+    ref2_reqs = [Request(prompt=p,
+                         max_new_tokens=t_budgets[i % len(t_budgets)])
+                 for i, p in enumerate(t_prompts)]
+    for r in ref2_reqs:
+        ref2_srv.submit(r)
+    ref2_done = ref2_srv.run_until_drained()
+    t_refs = [ref2_done[r.id].tokens for r in ref2_reqs]
+
+    class Srv:
+        def __init__(self, name, trace_dir):
+            self.name, self.trace_dir = name, trace_dir
+            self.proc = self.port = None
+            self.spawn()
+
+        def spawn(self):
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "tony_tpu.cli.main", "serve",
+                 "--port", "0", "--vocab", str(tiny["vocab"]),
+                 "--d-model", str(tiny["d_model"]),
+                 "--n-layers", str(tiny["n_layers"]),
+                 "--n-heads", str(tiny["n_heads"]),
+                 "--d-ff", str(tiny["d_ff"]), "--dtype", "float32",
+                 "--seed", "0", "--slots", str(t_slots),
+                 "--max-len", str(t_max_len),
+                 "--block-size", str(t_block),
+                 "--prefill-chunk", str(t_chunk),
+                 "--trace-dir", self.trace_dir],
+                cwd=REPO, env=env, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            self.port = None
+
+        def await_ready(self, timeout=240.0):
+            deadline = time.time() + timeout
+            while self.port is None and time.time() < deadline:
+                line = self.proc.stdout.readline()
+                m = _re.search(r"http://[\d.]+:(\d+)", line or "")
+                if m:
+                    self.port = int(m.group(1))
+            assert self.port, f"{self.name} never printed its port"
+            threading.Thread(target=self.proc.stdout.read,
+                             daemon=True).start()
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{self.port}/healthz",
+                            timeout=2) as r:
+                        if r.status == 200:
+                            return
+                except Exception:
+                    time.sleep(0.2)
+            raise AssertionError(f"{self.name} never became healthy")
+
+        def stats(self):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}/stats",
+                    timeout=10) as r:
+                return json.loads(r.read().decode())
+
+        def stop(self):
+            if self.proc.poll() is None:
+                self.proc.kill()
+            self.proc.wait(timeout=15)
+
+    td = _tempfile.mkdtemp(prefix="tony-replay-bench-")
+    reps = [Srv("a", os.path.join(td, "a")),
+            Srv("b", os.path.join(td, "b"))]
+    router = None
+    try:
+        for rep in reps:
+            rep.await_ready()
+        router = FleetRouter(
+            [(rep.name, "127.0.0.1", rep.port) for rep in reps],
+            prefill_chunk=t_chunk, health_interval_s=0.15,
+            stats_every=2, seed=0)
+        router.start()
+        fleet_results: dict[int, object] = {}
+
+        def call2(i):
+            try:
+                fleet_results[i] = router.generate(
+                    t_prompts[i],
+                    max_new_tokens=t_budgets[i % len(t_budgets)],
+                    timeout_s=300)
+            except Exception as e:
+                fleet_results[i] = e
+
+        t0 = time.time()
+        threads = [threading.Thread(target=call2, args=(i,))
+                   for i in range(t_requests)]
+        for t in threads:
+            t.start()
+            time.sleep(0.03)
+        # kill the affinity-sticky replica once it genuinely has this
+        # burst's requests in flight (the template keys every request to
+        # ONE replica, so the kill always interrupts real decode work)
+        # ... ideally once the health loop's /progress polls have also
+        # journaled a nonempty emitted prefix, so the failover
+        # demonstrably CARRIES tokens — bounded wait; having ANY
+        # outstanding work is the hard requirement, the prefix is
+        # opportunistic (compile warm-up emits nothing for a while)
+        victim = None
+        deadline = time.time() + 60
+        prefix_deadline = time.time() + 20
+        while time.time() < deadline:
+            with router._lock:
+                names = set(router._outstanding.values())
+                have_prefix = any(router._resume.values())
+            cand = next((rep for rep in reps if rep.name in names), None)
+            if cand is not None:
+                victim = cand
+                if have_prefix or time.time() >= prefix_deadline:
+                    break
+            time.sleep(0.02)
+        assert victim is not None, "no request ever went in flight"
+        victim_pid = victim.stats()["pid"]
+        os.kill(victim_pid, _signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=600)
+        fleet_wall = time.time() - t0
+        assert not any(t.is_alive() for t in threads), "hung callers"
+        fleet_failed = [i for i, r in fleet_results.items()
+                        if not isinstance(r, dict)]
+        assert not fleet_failed, (
+            f"fleet SIGKILL arm failed requests: "
+            f"{[(i, fleet_results[i]) for i in fleet_failed]}")
+        fleet_mismatch = [i for i in range(t_requests)
+                          if fleet_results[i]["tokens"] != t_refs[i]]
+        assert not fleet_mismatch, (
+            f"fleet failover diverged on requests: {fleet_mismatch}")
+        rstats = router.stats()
+        assert rstats["failed"] == 0
+        assert rstats["failovers"] >= 1, (
+            "the SIGKILL interrupted in-flight work; failover must fire")
+
+        # the killed replica restarts against the SAME trace dir and
+        # finishes the orphaned requests from its file journal
+        victim.stop()
+        victim.spawn()
+        victim.await_ready()
+        deadline = time.time() + 300
+        recovered_stats = None
+        while time.time() < deadline:
+            st = victim.stats()
+            if (st.get("replays", 0) >= 1
+                    and st.get("journal", {}).get("entries", 1) == 0
+                    and st.get("active", 1) == 0):
+                recovered_stats = st
+                break
+            time.sleep(0.25)
+        assert recovered_stats is not None, (
+            "restarted replica never finished its journal recovery")
+        from tony_tpu.events.trace import read_traces
+
+        recs = read_traces(os.path.join(victim.trace_dir,
+                                        "requests.trace.jsonl"))
+        recovered = [r for r in recs
+                     if r["attrs"].get("recovered_from") is not None
+                     and r["spans"] and r["spans"][-1][0] == "finished"]
+        assert recovered, "no recovered_from trace in the restarted replica"
+    finally:
+        if router is not None:
+            router.shutdown()
+        for rep in reps:
+            try:
+                rep.stop()
+            except Exception:
+                pass
+
+    out = {
+        "metric": "serving_replay_zero_failed_requests",
+        "value": 0,
+        "unit": "failed requests across loop-crash and replica-SIGKILL "
+                "arms (byte-identical completions enforced)",
+        "loop_crash": {
+            "requests": n_requests,
+            "crashes_injected": srv.chaos_faults_injected,
+            "loop_restarts": app.loop_restarts,
+            "replays": srv.replays,
+            "replayed_tokens": srv.replayed_tokens,
+            "byte_identical": True,
+            "replay_recompute_prefill_tokens": int(extra_prefill),
+            "replay_recompute_bound": int(bound),
+            "extra_decode_blocks": int(srv.blocks_dispatched
+                                       - ref_srv.blocks_dispatched),
+            "uninterrupted_wall_s": round(ref_wall, 3),
+            "crash_wall_s": round(crash_wall, 3),
+            "replay_catchup_p99_s": round(
+                srv.telemetry.hist["replay_catchup_s"].quantile(0.99), 3),
+        },
+        "fail_fast_preserved": {
+            "replay_off_failed_requests": len(failed_off),
+            "replays": srv_off.replays,
+        },
+        "fleet_sigkill": {
+            "requests": t_requests,
+            "failed": 0,
+            "byte_identical": True,
+            "router_failovers": rstats["failovers"],
+            "resumed_tokens": rstats["resumed_tokens"],
+            "wall_s": round(fleet_wall, 3),
+            "restart_recovered_requests": len(recovered),
+            "restart_replays": recovered_stats["replays"],
+        },
+        "num_devices": jax.device_count(),
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def run_elastic_bench() -> int:
     """Elastic-training robustness benchmark (docs/training-robustness.md),
     run TWICE — warm pool off, then on — so the recovery bound shows what
@@ -1415,6 +1807,8 @@ def main() -> int:
     if "--elastic" in sys.argv:
         return run_elastic_bench()
     if "--serving" in sys.argv:
+        if "--replay" in sys.argv:
+            return run_serving_replay_bench()
         if "--fleet" in sys.argv:
             return run_serving_fleet_bench()
         if "--overload" in sys.argv or "--chaos" in sys.argv:

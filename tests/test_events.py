@@ -79,6 +79,110 @@ def test_trace_writer_roundtrip_and_torn_line(tmp_path):
     assert read_traces(w.path) == recs
 
 
+def test_request_journal_roundtrip_and_torn_line(tmp_path):
+    """The durability record behind serving replay (docs/serving.md
+    "Request durability & replay"): submit/emit/end round-trip through
+    the file, a crash-torn tail line is skipped, an emit for an unknown
+    id is skipped, and a finished request's entry never resurfaces."""
+    from tony_tpu.events.journal import (
+        JOURNAL_FILE, RequestJournal, read_journal,
+    )
+
+    path = tmp_path / JOURNAL_FILE
+    j = RequestJournal(path)
+    j.submit(1, [5, 6, 7], 8, temperature=0.5, top_k=3, seed=42)
+    j.submit(2, [9], 4)
+    j.emit(1, [10, 11])
+    j.emit(1, [12])
+    j.emit(999, [1])            # unknown id: ignored in-memory too
+    j.finish(2)                 # delivered: sealed
+    j.finish(2)                 # idempotent
+    assert len(j) == 1
+    entry = j.get(1)
+    assert entry.emitted == [10, 11, 12] and entry.prompt == [5, 6, 7]
+    assert j.get(2) is None
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"op": "emit", "id": 1, "tok')      # crash-torn tail
+    entries = read_journal(path)
+    assert [e.id for e in entries] == [1]
+    e = entries[0]
+    assert (e.prompt, e.emitted, e.max_new_tokens) == ([5, 6, 7],
+                                                       [10, 11, 12], 8)
+    assert (e.temperature, e.top_k, e.seed) == (0.5, 3, 42)
+
+
+def test_request_journal_steady_state_compaction(tmp_path):
+    """The file journal must not grow for the life of the process:
+    every compact_every sealed entries it rewrites down to the LIVE
+    set (tmp+rename), and the post-compaction file still round-trips —
+    including a live entry's emitted prefix."""
+    from tony_tpu.events.journal import (
+        JOURNAL_FILE, RequestJournal, read_journal,
+    )
+
+    path = tmp_path / JOURNAL_FILE
+    j = RequestJournal(path, compact_every=8)
+    j.submit(1000, [1, 2, 3], 16)       # stays live across compactions
+    j.emit(1000, [4, 5])
+    for rid in range(20):               # 20 sealed -> 2 compactions
+        j.submit(rid, [7] * 4, 4)
+        j.emit(rid, [9, 9])
+        j.finish(rid)
+    assert j.compactions == 2 and j.write_errors == 0
+    text = path.read_text()
+    assert text.count('"op": "submit"') <= 1 + (20 % 8) * 1 + 1, (
+        "dead records must not survive a compaction")
+    # the live entry survives compaction with its prefix, and further
+    # appends after the handle swap still land
+    j.emit(1000, [6])
+    j.close()
+    entries = read_journal(path)
+    live = {e.id: e for e in entries}
+    assert live[1000].emitted == [4, 5, 6]
+    assert all(rid not in live for rid in range(20))
+
+
+def test_request_journal_recover_never_loses_then_compacts(tmp_path):
+    """recover() hands back the dead process's unfinished entries but
+    deliberately does NOT drop their records yet: until the
+    resubmission is journaled, they are the only copy — a crash in the
+    gap must double-replay, never lose. compact() (which
+    SlotServer.recover_journal calls after resubmitting) then rewrites
+    the file down to the live set, so a later recovery sees exactly
+    the resubmitted entries. In-memory journals (path=None) support
+    the same ops with no file."""
+    from tony_tpu.events.journal import JOURNAL_FILE, RequestJournal
+
+    path = tmp_path / JOURNAL_FILE
+    j = RequestJournal(path)
+    j.submit(7, [1, 2], 6)
+    j.emit(7, [3])
+    j.close()                   # simulated process death
+    j2, entries = RequestJournal.recover(path)
+    assert [(e.id, e.emitted) for e in entries] == [(7, [3])]
+    # the dead record is still on disk: a crash BEFORE the
+    # resubmission lands replays it again instead of losing it
+    _, still_there = RequestJournal.recover(path)
+    assert [(e.id, e.emitted) for e in still_there] == [(7, [3])]
+    # a resumed resubmission pre-seeds the emitted record; compact()
+    # then drops the dead process's records atomically
+    j2.submit(0, entries[0].prompt, entries[0].max_new_tokens,
+              emitted=entries[0].emitted)
+    assert j2.get(0).emitted == [3]
+    j2.compact()
+    j2.close()
+    _, again = RequestJournal.recover(path)
+    assert [(e.id, e.emitted) for e in again] == [(0, [3])]
+    mem = RequestJournal()
+    mem.submit(1, [4], 2)
+    mem.emit(1, [5])
+    assert mem.get(1).emitted == [5] and mem.path is None
+    mem.finish(1)
+    mem.compact()               # no file: a no-op, never an error
+    assert len(mem) == 0
+
+
 def test_mover_moves_finished_and_finalizes_orphans(tmp_path):
     inter = tmp_path / "intermediate"
     fin = tmp_path / "finished"

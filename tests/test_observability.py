@@ -328,10 +328,10 @@ def test_device_lag_measured_on_traces(params):
 
 
 def test_reset_seals_inflight_traces(params):
-    """reset() after a loop failure must not leak traces: in-flight
+    """reset() with replay OFF must not leak traces: in-flight
     requests' traces end at the failed terminal, queued ones survive."""
     sink = []
-    srv = _srv(params, trace_sink=sink.append)
+    srv = _srv(params, trace_sink=sink.append, replay=False)
     a = Request(prompt=_prompt(4, seed=11), max_new_tokens=16)
     srv.submit(a)
     srv.step()                          # admit + first block
@@ -344,6 +344,27 @@ def test_reset_seals_inflight_traces(params):
     assert queued.id in srv._traces, "queued request's trace must survive"
     done = srv.run_until_drained()
     assert _span_names(done[queued.id])[-1] == "finished"
+
+
+def test_reset_replay_trace_continuity(params):
+    """reset() with replay ON (default): the in-flight request's trace
+    is NOT sealed — it gains a 'replayed' mark, repeats the admission
+    chain, terminates once, and feeds the replay-catchup histogram."""
+    sink = []
+    srv = _srv(params, trace_sink=sink.append)
+    a = Request(prompt=_prompt(4, seed=13), max_new_tokens=16)
+    srv.submit(a)
+    srv.step()                          # admit + first block
+    assert srv.reset() == []
+    assert not sink, "a replayed request's trace must not be sealed"
+    done = srv.run_until_drained()
+    names = _span_names(done[a.id])
+    assert "replayed" in names and names[-1] == "finished"
+    assert names.count("admitted") == 2, "the admission chain repeats"
+    assert names.count("finished") == 1
+    assert done[a.id].trace["attrs"]["replays"] == 1
+    assert len(sink) == 1, "exactly one sealed record per request"
+    assert srv.telemetry.hist["replay_catchup_s"].count == 1
 
 
 # --------------------------------------------------------------------------
@@ -626,6 +647,81 @@ def test_metrics_names_rendered_and_documented():
                 _metrics.DRIVER_WARM_POOL_MISSES_TOTAL):
         assert fam in rendered, f"warm-pool family unrendered: {fam}"
         assert fam in doc_names, f"warm-pool family undocumented: {fam}"
+
+    # the request-durability/replay families are pinned EXPLICITLY the
+    # same way (ISSUE 11 lint discipline): each must be rendered by an
+    # endpoint (serve /metrics, router /metrics) and documented —
+    # renaming either side without the other fails here
+    for fam in (_metrics.SERVING_REPLAYS_TOTAL,
+                _metrics.SERVING_REPLAYED_TOKENS_TOTAL,
+                _metrics.ROUTER_FAILOVERS_TOTAL,
+                "serving_replay_catchup_seconds"):
+        assert fam in rendered, f"replay family unrendered: {fam}"
+        assert fam in doc_names, f"replay family undocumented: {fam}"
+
+
+def test_finish_reason_vocabulary_pinned():
+    """Lint over the finish_reason vocabulary, both directions: the
+    constants in models/serving.py are the single source of truth, the
+    code actually produces every value, docs/serving.md documents every
+    value, the trace terminal set stays consistent with it, and the
+    HTTP error mapping (shed -> 429, failed -> 503, router fleet-
+    saturation -> 429) is still wired. A new terminal added to code
+    without the enum/docs — or documented without being produced —
+    fails here."""
+    import inspect
+
+    import tony_tpu.cli.serve as serve_mod
+    import tony_tpu.models.serving as serving_mod
+    import tony_tpu.router as router_mod
+    from tony_tpu.models.serving import (
+        COMPLETION_FINISH_REASONS, FINISH_REASONS,
+    )
+
+    # the pinned sets themselves (a rename/removal is a doc+router
+    # migration, not a drive-by)
+    assert COMPLETION_FINISH_REASONS == ("stop", "length", "cancelled",
+                                         "expired")
+    assert FINISH_REASONS == COMPLETION_FINISH_REASONS + ("shed", "failed")
+    # trace terminals <-> finish reasons: "finished" carries the
+    # stop/length reason in attrs; every other terminal IS its reason
+    from tony_tpu.observability import TERMINAL_SPANS
+
+    assert set(TERMINAL_SPANS) - {"finished"} == \
+        set(FINISH_REASONS) - set(("stop", "length"))
+    assert "replayed" not in TERMINAL_SPANS, (
+        "replay is a mid-life mark, never a terminal")
+
+    serving_src = inspect.getsource(serving_mod)
+    serve_src = inspect.getsource(serve_mod)
+    router_src = inspect.getsource(router_mod)
+    from pathlib import Path
+
+    doc = (Path(__file__).resolve().parent.parent
+           / "docs" / "serving.md").read_text()
+    for reason in FINISH_REASONS:
+        assert f'"{reason}"' in serving_src, (
+            f"finish reason {reason!r} is in the enum but the engine "
+            "source never names it")
+        assert f'"{reason}"' in doc or f"`{reason}`" in doc, (
+            f"finish reason {reason!r} undocumented in docs/serving.md")
+    # the engine source names no finish_reason outside the enum: every
+    # Completion(...) literal reason and _finish_trace terminal must be
+    # in FINISH_REASONS (+ the trace-only "finished" wrapper)
+    produced = set(re.findall(
+        r'Completion\(\s*[\w.\[\]]+,\s*[\w.\[\]() ]+,\s*"(\w+)"',
+        serving_src))
+    produced |= set(re.findall(r'_finish_trace\([^)]*"(\w+)"', serving_src))
+    produced |= set(re.findall(r'_seal_trace\([^)]*"(\w+)"', serving_src))
+    unknown = produced - set(FINISH_REASONS) - {"finished"}
+    assert not unknown, f"finish reasons outside the enum: {unknown}"
+    assert {"cancelled", "expired", "failed", "shed"} <= produced, (
+        f"enum reasons the engine no longer produces: {produced}")
+    # HTTP mapping, both layers: shed -> 429 (serve QueueFullError, the
+    # router's fleet saturation), failed/down -> 503
+    assert "QueueFullError" in serve_src and "429" in serve_src
+    assert "ServingLoopError" in serve_src and "503" in serve_src
+    assert "FleetSaturatedError" in router_src and "429" in router_src
 
 
 def test_telemetry_trace_feed_units():

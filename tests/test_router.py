@@ -58,7 +58,14 @@ class StubReplica:
         self.shed_next = 0          # serve this many 429s first
         self.fail_next = 0          # ... or this many 500s
         self.delay_s = 0.0
+        # mid-request death: sleep, then sever the connection with no
+        # response (what a SIGKILL looks like to the router's POST)
+        self.abort_after_s = 0.0
         self.received: list[list] = []
+        self.payloads: list[dict] = []      # full /generate payloads
+        # /progress: emitted-so-far tokens served for ANY polled key
+        # (None = pretend no live request, the endpoint returns {})
+        self.progress_tokens: list[int] | None = None
         self._lock = threading.Lock()
         stub = self
 
@@ -85,6 +92,18 @@ class StubReplica:
                         "queued": stub.queued, "active": stub.active,
                         "slots": stub.slots, "max_queue": stub.max_queue,
                         "retry_after_s": stub.retry_after})
+                elif self.path.partition("?")[0] == "/progress":
+                    # serve-contract shape: {key: {tokens, prompt_tokens}}
+                    from urllib.parse import parse_qs, urlparse
+
+                    qs = parse_qs(urlparse(self.path).query)
+                    keys = [k for ks in qs.get("keys", [])
+                            for k in ks.split(",") if k]
+                    with stub._lock:
+                        toks = stub.progress_tokens
+                    self._send(200, {} if toks is None else {
+                        k: {"tokens": list(toks), "prompt_tokens": 1}
+                        for k in keys})
                 else:
                     self._send(404, {})
 
@@ -102,11 +121,19 @@ class StubReplica:
                         self._send(500, {"error": "boom"})
                         return
                     stub.received.append(list(payload["prompt"]))
+                    stub.payloads.append(dict(payload))
+                if stub.abort_after_s:
+                    time.sleep(stub.abort_after_s)
+                    self.connection.close()     # died mid-request
+                    return
                 if stub.delay_s:
                     time.sleep(stub.delay_s)
+                # serve-contract resume semantics: the response tokens
+                # INCLUDE the teacher-forced prefix
                 self._send(200, {
                     "id": len(stub.received),
-                    "tokens": [len(payload["prompt"])],
+                    "tokens": list(payload.get("resume_tokens", []))
+                    + [len(payload["prompt"])],
                     "finish_reason": "length"})
 
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
@@ -244,6 +271,141 @@ def test_transport_error_ejects_and_retries(stubs):
     assert st["replicas"]["a"]["up"] is False
     assert st["replicas"]["a"]["ejections"] == 1
     assert st["failed"] == 0
+    # connection REFUSED = the request never reached the replica: an
+    # ordinary re-route, NOT a mid-request failover — the failover
+    # counter stays an honest mid-stream-recovery signal
+    assert st["failovers"] == 0 and st["resumed_tokens"] == 0
+
+
+def test_failover_resumes_with_emitted_prefix(stubs):
+    """Replay-aware failover (docs/serving.md "Request durability &
+    replay"): a replica that 5xxes mid-request is re-asked for its
+    /progress, and the resubmission to the rendezvous runner-up carries
+    the emitted prefix as resume_tokens — the caller's tokens include
+    the prefix (no restart from scratch), router_failovers_total and
+    the trace record it."""
+    a, b = stubs("a", "b")
+    router = _router([a, b], prefill_chunk=4)
+    template = [7, 1, 7, 2]                     # keyed -> sticky replica
+    router.generate(template + [1], max_new_tokens=1, timeout_s=5)
+    sticky, other = (a, b) if a.received else (b, a)
+    # every routed request carries a progress handle for the polls
+    assert "progress_key" in sticky.payloads[-1]
+    sticky.fail_next = 1
+    sticky.progress_tokens = [41, 42, 43]       # what it emitted pre-death
+    resp = router.generate(template + [2], max_new_tokens=8, timeout_s=10)
+    assert resp["replica"] == other.name and resp["retries"] == 1
+    # the resubmission carried the prefix; the response includes it
+    assert other.payloads[-1]["resume_tokens"] == [41, 42, 43]
+    assert resp["tokens"][:3] == [41, 42, 43]
+    st = router.stats()
+    assert st["failovers"] == 1 and st["resumed_tokens"] == 3
+    assert st["failed"] == 0
+    assert "router_failovers_total 1" in router.prometheus_metrics()
+    # health-tick progress polling journals prefixes for OUTSTANDING
+    # requests only; a terminal request's key is dropped
+    assert not router._outstanding and not router._resume
+
+
+def test_failover_health_poll_prefix_survives_dead_replica(stubs):
+    """A SIGKILLed replica can't answer the failover-time /progress
+    re-ask — the prefix journaled by the health loop's LAST poll is
+    what the resubmission carries. Staged: the health tick polls the
+    in-flight request's progress, then the replica drops dead (connection
+    refused), and the retry still resumes from the polled prefix."""
+    a, b = stubs("a", "b")
+    router = _router([a, b], prefill_chunk=4)
+    template = [7, 1, 7, 2]
+    router.generate(template, max_new_tokens=1, timeout_s=5)
+    sticky, other = (a, b) if a.received else (b, a)
+    sticky.progress_tokens = [9, 8]
+    # the in-flight request dies mid-decode: the POST's connection is
+    # severed with no response after a beat (a SIGKILL, as the router
+    # sees it) — but first the health loop gets a poll in
+    sticky.abort_after_s = 1.5
+    res = {}
+
+    def call():
+        try:
+            res["r"] = router.generate(template + [5], max_new_tokens=8,
+                                       timeout_s=20)
+        except Exception as e:          # pragma: no cover
+            res["r"] = e
+
+    t = threading.Thread(target=call)
+    t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not router._outstanding:
+        time.sleep(0.01)
+    router.health_tick()                # journals the polled prefix
+    with router._lock:
+        polled = dict(router._resume)
+    assert list(polled.values()) == [[9, 8]], polled
+    # the dead replica answers nothing at failover time: the re-ask
+    # yields no info and the journaled prefix stands
+    sticky.progress_tokens = None
+    t.join(timeout=30)
+    assert not t.is_alive()
+    resp = res["r"]
+    assert isinstance(resp, dict), resp
+    assert resp["replica"] == other.name
+    assert other.payloads[-1]["resume_tokens"] == [9, 8]
+    assert resp["tokens"][:2] == [9, 8]
+    assert router.stats()["failovers"] >= 1
+
+
+def test_router_own_healthz_distinct_from_replicas(stubs):
+    """The router-level /healthz (the ROADMAP router-HA slice): 200
+    while the router can route — replicas in rotation AND the
+    maintenance loop (once started) alive — 503 when the fleet is gone
+    or the router is wedged/stopped, so an upstream LB ejects a dead
+    ROUTER exactly like a dead replica."""
+    a = stubs("a")
+    router = _router([a], prefill_chunk=4, eject_after=1)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(router))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def healthz():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    try:
+        # statically configured, loop not started: routable, and the
+        # payload says the maintenance loop isn't running
+        status, payload = healthz()
+        assert status == 200 and payload["healthy"] is True
+        assert payload["health_loop_alive"] is None
+        router.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            status, payload = healthz()
+            if payload["health_loop_alive"] is True:
+                break
+            time.sleep(0.01)
+        assert status == 200 and payload["health_loop_alive"] is True
+        # fleet gone -> 503 (the router cannot complete a request)
+        a.healthy = False
+        router.health_tick()
+        status, payload = healthz()
+        assert status == 503 and payload["live"] == 0
+        a.healthy = True
+        router.health_tick()
+        assert healthz()[0] == 200
+        # a stopped/wedged router is out of rotation even with a live
+        # fleet behind it
+        router.shutdown()
+        status, payload = healthz()
+        assert status == 503 and payload["health_loop_alive"] is False
+        assert payload["live"] == 1, "replicas are fine; the ROUTER died"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        router.shutdown()
 
 
 def test_ejection_on_healthz_and_readmission(stubs):

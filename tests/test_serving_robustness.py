@@ -270,11 +270,13 @@ def test_submit_sheds_when_queue_full(params):
         assert done[r.id].tokens == _solo(params, p, 4)
 
 
-def test_reset_rearms_ring_fails_inflight_keeps_queue(params):
-    """reset() = loop recovery's engine half: admitted requests are lost
-    (returned so the caller can fail them), QUEUED requests survive, and
-    the re-armed ring serves them token-identical to a fresh server —
-    without rebuilding the SlotServer or reloading weights."""
+def test_reset_replays_inflight_keeps_queue(params):
+    """reset() = loop recovery's engine half, journal ON (the default):
+    admitted requests are REPLAYED (re-queued ahead of the never-started
+    queue with their journaled prompt + emitted prefix), QUEUED requests
+    survive untouched, and the re-armed ring serves everything
+    token-identical to an uninterrupted server — without rebuilding the
+    SlotServer or reloading weights. Zero lost requests."""
     pa, pc, pb = _prompts(3, key=241)
     srv = _srv(params)
     a = Request(prompt=pa, max_new_tokens=20)
@@ -289,8 +291,10 @@ def test_reset_rearms_ring_fails_inflight_keeps_queue(params):
     reaper = tracker._thread
     pre_reset_seqs = list(range(1, tracker.tracked_total + 1))
     lost = srv.reset()
-    assert sorted(lost) == sorted([a.id, c.id])
-    assert srv.pending == 1 and srv.n_active == 0
+    assert lost == [], "journaled in-flight requests must replay, not fail"
+    # replays queue AHEAD of the never-started request
+    assert srv.pending == 3 and srv.n_active == 0
+    assert [r.id for r in srv._queue] == [a.id, c.id, b.id]
     assert srv.resets == 1
     # reset() drained + re-armed the dispatch reaper: SAME thread (no
     # leak per reset), nothing pending, and no stale ready-instant from
@@ -298,15 +302,346 @@ def test_reset_rearms_ring_fails_inflight_keeps_queue(params):
     assert tracker._thread is reaper and tracker.alive
     assert all(tracker.ready_time(s) is None for s in pre_reset_seqs)
     done = srv.run_until_drained()
-    assert set(done) == {b.id}
-    assert done[b.id].tokens == _solo(params, pb, 6), (
-        "post-reset ring diverged from a fresh server")
+    assert set(done) == {a.id, b.id, c.id}
+    for req, p, budget in ((a, pa, 20), (c, pc, 20), (b, pb, 6)):
+        assert done[req.id].tokens == _solo(params, p, budget), (
+            "post-reset replay diverged from an uninterrupted server")
+    assert srv.replays == 2 and srv.stats()["replays"] == 2
     assert tracker.drain(timeout=10), "post-reset dispatches must reap"
     assert tracker.snapshot()["dispatch_ready"]["decode_block"]["count"] > 0
     srv.shutdown()                              # stops the reaper thread
     assert not tracker.alive
     reaper.join(timeout=5)
     assert not reaper.is_alive(), "shutdown() leaked the reaper thread"
+
+
+def test_reset_replay_off_fails_inflight_keeps_queue(params):
+    """replay=False preserves the pre-journal fail-fast contract:
+    admitted requests are lost (returned so the caller can fail them),
+    queued requests survive, and the re-armed ring serves them
+    token-identical to a fresh server."""
+    pa, pc, pb = _prompts(3, key=241)
+    srv = _srv(params, replay=False)
+    a = Request(prompt=pa, max_new_tokens=20)
+    c = Request(prompt=pc, max_new_tokens=20)
+    srv.submit(a)
+    srv.submit(c)
+    for _ in range(2):
+        srv.step()                              # both slots mid-decode
+    b = Request(prompt=pb, max_new_tokens=6)
+    srv.submit(b)                               # still queued (slots full)
+    lost = srv.reset()
+    assert sorted(lost) == sorted([a.id, c.id])
+    assert srv.pending == 1 and srv.n_active == 0
+    assert srv.resets == 1 and srv.replays == 0
+    done = srv.run_until_drained()
+    assert set(done) == {b.id}
+    assert done[b.id].tokens == _solo(params, pb, 6), (
+        "post-reset ring diverged from a fresh server")
+    srv.shutdown()
+
+
+# --------------------------------------------------------------------------
+# request durability + replay (docs/serving.md "Request durability & replay")
+# --------------------------------------------------------------------------
+
+def test_reset_replay_resumes_from_emitted_prefix(params):
+    """THE replay contract: a request interrupted mid-decode with tokens
+    already processed replays via teacher-forced re-prefill of
+    prompt+emitted and resumes decoding — the delivered completion is
+    byte-identical to an uninterrupted run, the trace carries the
+    'replayed' mark, and the recompute is bounded: the known prefix
+    re-PREFILLS (one admission), only the continuation re-decodes."""
+    pa = _prompts(1, key=311)[0]
+    srv = _srv(params)
+    a = Request(prompt=pa, max_new_tokens=20)
+    srv.submit(a)
+    for _ in range(3):
+        srv.step()
+    srv.drain_completed()       # processes the pipeline: prefix is known
+    prefix = list(srv._journal.get(a.id).emitted)
+    assert 0 < len(prefix) < 20, "setup: need a partial emitted prefix"
+    blocks_before = srv.blocks_dispatched
+    assert srv.reset() == []
+    done = srv.run_until_drained()
+    ref = _solo(params, pa, 20)
+    assert done[a.id].tokens == ref, "replay diverged from solo stream"
+    assert done[a.id].tokens[:len(prefix)] == prefix
+    assert srv.replays == 1 and srv.replayed_tokens == len(prefix)
+    spans = [s for s, _ in
+             [(n, t) for n, t in done[a.id].trace["spans"]]]
+    assert "replayed" in spans and spans[-1] == "finished"
+    assert done[a.id].trace["attrs"]["replayed_tokens"] == len(prefix)
+    # replay recompute bound: the continuation re-decodes, the prefix
+    # does NOT — post-reset decode blocks cover only the remaining
+    # budget (+1 block of admission slack), not the whole stream
+    replay_blocks = srv.blocks_dispatched - blocks_before
+    remaining = 20 - len(prefix)
+    assert replay_blocks <= -(-remaining // srv.block_size) + 1, (
+        f"replay re-decoded the prefix: {replay_blocks} blocks for "
+        f"{remaining} remaining tokens")
+    # the crash's latency cost is measured: replayed -> finished
+    assert srv.telemetry.hist["replay_catchup_s"].count == 1
+
+
+def test_cancel_of_replayed_request_targets_new_slot(params):
+    """Cancel composes with replay: after a reset, a replayed id is
+    cancellable both while RE-QUEUED (completion carries the journaled
+    prefix — delivered work, not queue residue) and while RE-ADMITTED
+    into its new slot (the dead slot's mapping died with the reset;
+    partial tokens stay an exact solo-stream prefix)."""
+    pa, pc = _prompts(2, key=313)
+    srv = _srv(params)
+    a = Request(prompt=pa, max_new_tokens=30)
+    c = Request(prompt=pc, max_new_tokens=30)
+    srv.submit(a)
+    srv.submit(c)
+    for _ in range(2):
+        srv.step()
+    srv.drain_completed()
+    pre_a = list(srv._journal.get(a.id).emitted)
+    pre_c = list(srv._journal.get(c.id).emitted)
+    assert pre_a and pre_c
+    assert srv.reset() == []
+    # both replays are queued, nothing admitted: the old slot mappings
+    # are gone — cancel must find the QUEUED replay
+    assert a.id not in srv._slot_of and c.id not in srv._slot_of
+    assert srv.cancel(c.id) is True
+    srv.step()                  # re-admits a into a fresh slot
+    assert a.id in srv._slot_of
+    assert srv.cancel(a.id) is True, "cancel must target the NEW slot"
+    done = srv.run_until_drained()
+    assert done[c.id].finish_reason == "cancelled"
+    assert done[c.id].tokens == pre_c, (
+        "a queued replay's cancel must return its journaled prefix")
+    assert done[a.id].finish_reason == "cancelled"
+    got = done[a.id].tokens
+    assert got[:len(pre_a)] == pre_a
+    assert got == _solo(params, pa, 30)[:len(got)], (
+        "cancelled replay's tokens diverged from its solo stream")
+    assert srv._journal.get(a.id) is None, "cancel must seal the journal"
+
+
+def test_replay_byte_identical_prefix_cache_on_and_off(params):
+    """Replay determinism is prefix-cache-invariant: the teacher-forced
+    re-prefill rides the cache when enabled (the replayed context's
+    chunks are ordinary trie blocks) and recomputes when not — both
+    byte-identical to the uninterrupted stream."""
+    pa, pc = _prompts(2, key=317, lo=10, hi=14)  # >= 1 full chunk each
+    for blocks in (0, 8):
+        srv = _srv(params, prefix_cache_blocks=blocks)
+        a = Request(prompt=pa, max_new_tokens=24)
+        c = Request(prompt=pc, max_new_tokens=24)
+        srv.submit(a)
+        srv.submit(c)
+        for _ in range(3):
+            srv.step()
+        srv.drain_completed()
+        assert srv.reset() == []
+        done = srv.run_until_drained()
+        assert done[a.id].tokens == _solo(params, pa, 24), f"cache={blocks}"
+        assert done[c.id].tokens == _solo(params, pc, 24), f"cache={blocks}"
+        assert srv.replays == 2
+        srv.shutdown()
+
+
+def test_replay_int8_kv_tolerance(params):
+    """Replay across int8 KV (the ROADMAP int8 carve-out, extended to
+    replay): the resume prefix is preserved VERBATIM (teacher-forced,
+    never re-sampled), while the continuation agrees with an
+    uninterrupted int8 serving run at quantization tolerance — replayed
+    positions re-prefill through the quantized cache where the
+    uninterrupted run decode-wrote them, so a near-tie can flip a
+    greedy token. Majority agreement is the regression bar; exactness
+    claims belong to the native-dtype tests above."""
+    prompts = _prompts(4, key=331)
+    kw = dict(kv_dtype="int8", weight_dtype="int8")
+    ref_srv = _srv(params, **kw)
+    ref_reqs = [Request(prompt=p, max_new_tokens=12) for p in prompts]
+    for r in ref_reqs:
+        ref_srv.submit(r)
+    ref_done = ref_srv.run_until_drained()
+    refs = [ref_done[r.id].tokens for r in ref_reqs]
+    srv = _srv(params, **kw)
+    reqs = [Request(prompt=p, max_new_tokens=12) for p in prompts]
+    for r in reqs:
+        srv.submit(r)
+    for _ in range(2):
+        srv.step()
+    srv.drain_completed()
+    prefixes = {r.id: list(e.emitted)
+                for r in reqs
+                if (e := srv._journal.get(r.id)) is not None}
+    assert any(prefixes.values()), "setup: need partial prefixes"
+    assert srv.reset() == []
+    done = srv.run_until_drained()
+    for r in reqs:
+        pre = prefixes.get(r.id)
+        if pre:
+            assert done[r.id].tokens[:len(pre)] == pre, (
+                "the resume prefix must be preserved verbatim")
+    got = [done[r.id].tokens for r in reqs]
+    agree = sum(t == s for t, s in zip(got, refs))
+    assert agree * 2 >= len(refs), (got, refs)
+
+
+def test_journal_recovery_across_server_instances(tmp_path, params):
+    """Process-restart recovery (the serve CLI's startup path, without
+    processes): a file-backed journal written by one SlotServer is
+    recovered by a FRESH one, which finishes the dead server's
+    unfinished requests byte-identical to solo — a replica SIGKILL +
+    restart costs latency, not requests. The recovered file is
+    compacted, lineage rides attrs.recovered_from."""
+    from tony_tpu.events.journal import JOURNAL_FILE, RequestJournal
+
+    path = tmp_path / JOURNAL_FILE
+    pa, pb = _prompts(2, key=337)
+    srv1 = _srv(params, journal=RequestJournal(path))
+    a = Request(prompt=pa, max_new_tokens=20)
+    b = Request(prompt=pb, max_new_tokens=18)
+    srv1.submit(a)
+    srv1.submit(b)
+    for _ in range(2):
+        srv1.step()
+    srv1.drain_completed()      # prefixes are journaled to disk
+    # simulated SIGKILL: srv1 is abandoned mid-flight, never drained
+    j2, entries = RequestJournal.recover(path)
+    assert sorted(e.id for e in entries) == sorted([a.id, b.id])
+    assert all(e.emitted for e in entries)
+    # max_queue=1 must NOT shed recovered entries: the dead process
+    # already accepted them all, and a shed here would be compacted
+    # out of the only durable copy — recovery is exempt from the bound
+    srv2 = _srv(params, journal=j2, max_queue=1)
+    assert srv2.recover_journal(entries) == 2
+    assert srv2.max_queue == 1, "the bound must be restored after"
+    done = srv2.run_until_drained()
+    by_origin = {c.trace["attrs"]["recovered_from"]: c
+                 for c in done.values()}
+    assert by_origin[a.id].tokens == _solo(params, pa, 20)
+    assert by_origin[b.id].tokens == _solo(params, pb, 18)
+    assert srv2.replays == 2 and srv2.replayed_tokens > 0
+    assert len(j2) == 0, "finished recoveries must seal their entries"
+    srv1.shutdown()
+    srv2.shutdown()
+
+
+def test_checkpoint_progress_advances_journal_without_stall(params):
+    """The durability checkpoint: a SOLO open-loop request normally
+    processes nothing until completion — its journal prefix (and
+    /progress answer) would stay empty for its entire decode.
+    checkpoint_progress() processes the pipeline down to
+    pipeline_depth: the journal advances mid-request, the dispatch
+    runway survives, and the final stream is untouched."""
+    pa = _prompts(1, key=347)[0]
+    srv = _srv(params)
+    a = Request(prompt=pa, max_new_tokens=24)
+    srv.submit(a)
+    for _ in range(5):
+        srv.step()              # open-loop: blocks pile up unprocessed
+    assert srv._journal.get(a.id).emitted == [], (
+        "setup: solo predictive traffic must not have processed yet")
+    assert len(srv._pipeline) > srv.pipeline_depth
+    srv.checkpoint_progress()
+    mid = list(srv._journal.get(a.id).emitted)
+    assert mid, "checkpoint must advance the journaled prefix"
+    assert len(srv._pipeline) == srv.pipeline_depth, (
+        "checkpoint must keep pipeline_depth blocks of runway in flight")
+    assert srv.progress(a.id)["tokens"] == mid
+    done = srv.run_until_drained()
+    ref = _solo(params, pa, 24)
+    assert done[a.id].tokens == ref and mid == ref[:len(mid)]
+
+
+def test_fail_pending_seals_journal_entries(params):
+    """A terminal delivered upstream IS the terminal: when ServeApp
+    fails its waiters (restart-budget exhaustion / drain timeout),
+    their journal entries must be sealed — a later restart's recovery
+    must not resurrect and decode requests whose clients were already
+    told 'failed'."""
+    srv = _srv(params)
+    app = ServeApp(srv)                 # loop never started: direct unit
+    a = Request(prompt=[3, 1, 4], max_new_tokens=6)
+    app._events[a.id] = threading.Event()
+    srv.submit(a)
+    assert srv._journal.get(a.id) is not None
+    app._fail_pending(RuntimeError("budget exhausted"))
+    assert srv._journal.get(a.id) is None, (
+        "_fail_pending must seal the failed request's journal entry")
+    assert app._events == {} and a.id in app._results
+
+
+def test_expired_queued_replay_keeps_emitted_prefix(params):
+    """A queued REPLAY whose deadline passes before re-admission still
+    owns its emitted prefix (same contract as the queued-cancel path):
+    the expired completion carries the delivered decode work, not an
+    empty token list."""
+    srv = _srv(params)
+    r = Request(prompt=[3, 1, 4], max_new_tokens=8,
+                resume_tokens=[9, 2, 6],
+                deadline=time.monotonic() - 1.0)
+    srv.submit(r)
+    done = srv.run_until_drained()
+    assert done[r.id].finish_reason == "expired"
+    assert done[r.id].tokens == [9, 2, 6], (
+        "an expired replay must keep its journaled prefix")
+    assert srv._journal.get(r.id) is None
+
+
+def test_resume_already_satisfied_completes_without_slot(params):
+    """A resume prefix that already satisfies the request — budget
+    reached, or it ends in a stop token (a failover racing a finished
+    stream) — completes immediately: no slot, no prefill, no decode."""
+    srv = _srv(params, stop_tokens=(9,), pad_id=255)
+    r1 = Request(prompt=[1, 2], max_new_tokens=3, resume_tokens=[4, 5, 6])
+    r2 = Request(prompt=[1, 2], max_new_tokens=8, resume_tokens=[4, 9])
+    srv.submit(r1)
+    srv.submit(r2)
+    done = srv.drain_completed()
+    assert done[r1.id].tokens == [4, 5, 6]
+    assert done[r1.id].finish_reason == "length"
+    assert done[r2.id].tokens == [4, 9]
+    assert done[r2.id].finish_reason == "stop"
+    assert srv.blocks_dispatched == 0 and srv.admission_dispatches == 0
+    assert srv.replays == 2
+    assert srv.idle
+
+
+def test_crash_at_blocks_chaos_zero_failed_requests(params, monkeypatch):
+    """The deterministic mid-decode crash injection point
+    (TONY_TEST_SERVING_CRASH_AT_BLOCKS) through the full ServeApp
+    recovery path: two injected loop crashes, and every request still
+    completes byte-identical to solo generate — zero failed waiters,
+    recovery + replay visible in the counters."""
+    monkeypatch.setenv("TONY_TEST_SERVING_CRASH_AT_BLOCKS", "2, 5")
+    prompts = _prompts(4, key=341)
+    srv = _srv(params, max_queue=8)
+    app = ServeApp(srv, max_loop_restarts=10, loop_backoff_s=0.01)
+    app.start()
+    try:
+        results = {}
+
+        def call(i):
+            try:
+                results[i] = app.generate(prompts[i], 10, timeout=90)
+            except Exception as e:      # pragma: no cover
+                results[i] = e
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "hung waiters"
+        for i, r in results.items():
+            assert isinstance(r, Completion), f"request {i} failed: {r!r}"
+            assert r.tokens == _solo(params, prompts[i], 10), (
+                f"request {i} diverged through crash+replay")
+        assert srv.chaos_faults_injected >= 1, "injection never fired"
+        assert app.loop_restarts >= 1 and srv.replays >= 1
+        assert app.status != "down"
+    finally:
+        app.shutdown()
 
 
 # --------------------------------------------------------------------------
@@ -606,7 +941,8 @@ def test_http_overload_sheds_429_with_retry_after(params):
 
         def post(i, p, budget):
             body = json.dumps({"prompt": [int(x) for x in p],
-                               "max_new_tokens": budget}).encode()
+                               "max_new_tokens": budget,
+                               "progress_key": f"k{i}"}).encode()
             try:
                 with urllib.request.urlopen(
                         f"http://127.0.0.1:{port}/generate", data=body,
@@ -621,6 +957,16 @@ def test_http_overload_sheds_429_with_retry_after(params):
         while time.monotonic() < deadline and srv.pending < 1:
             time.sleep(0.002)
         assert srv.pending == 1, "first request never queued"
+        # the failover-resume progress endpoint: the queued request's
+        # journal entry is readable under its caller-chosen key (no
+        # tokens yet — it hasn't been admitted); unknown keys are absent
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/progress?keys=k0,nope",
+                timeout=10) as r:
+            prog = json.loads(r.read())
+        assert prog["k0"]["tokens"] == []
+        assert prog["k0"]["prompt_tokens"] == len(prompts[0])
+        assert "nope" not in prog
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/generate",
@@ -707,6 +1053,103 @@ def test_chaos_seeded_every_request_terminates(params, monkeypatch):
         assert st["loop"]["failures"] == srv.chaos_faults_injected
     finally:
         app.shutdown()
+
+
+# --------------------------------------------------------------------------
+# serve CLI: SIGKILL + restart finishes the dead process's requests
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serve_cli_sigkill_restart_recovers_journal(tmp_path):
+    """The process-death arm of the durability contract, through the
+    real CLI: a serve process with a file journal SIGKILLs itself
+    mid-decode (TONY_TEST_SERVING_SIGKILL_AT_BLOCK), and a restarted
+    process pointing at the same --trace-dir recovers the journal and
+    FINISHES the orphaned request — visible in /stats (replays, empty
+    journal) and as a finished attrs.recovered_from trace record.
+    Slow-marked: two jax process startups; the in-process recovery
+    contract stays in the tier-1 gate
+    (test_journal_recovery_across_server_instances)."""
+    import json as _json
+    import os
+    import re as _re
+    import signal
+    import subprocess
+    import sys
+    import urllib.request
+
+    from tony_tpu.events.trace import read_traces
+
+    args = [sys.executable, "-m", "tony_tpu.cli.main", "serve",
+            "--port", "0", "--vocab", "256", "--d-model", "64",
+            "--n-layers", "2", "--n-heads", "4", "--d-ff", "128",
+            "--dtype", "float32", "--slots", "2", "--max-len", "64",
+            "--block-size", "4", "--prefill-chunk", "8",
+            "--trace-dir", str(tmp_path)]
+
+    def spawn(extra_env):
+        return subprocess.Popen(
+            args, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu", **extra_env})
+
+    def await_port(proc, timeout=240):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            m = _re.search(r"http://[\d.]+:(\d+)", line or "")
+            if m:
+                threading.Thread(target=proc.stdout.read,
+                                 daemon=True).start()
+                return int(m.group(1))
+        raise AssertionError("serve never printed its port")
+
+    proc = spawn({"TONY_TEST_SERVING_SIGKILL_AT_BLOCK": "2"})
+    try:
+        port = await_port(proc)
+        body = _json.dumps({"prompt": [3, 1, 4, 1, 5],
+                            "max_new_tokens": 20}).encode()
+        # the process SIGKILLs itself at decode block 2: the POST dies
+        # with the connection
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/generate", data=body,
+                timeout=300).read()
+        assert proc.wait(timeout=60) == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    journal = tmp_path / "requests.journal.jsonl"
+    assert journal.exists() and journal.read_text().strip(), (
+        "the dead process left no journal to recover")
+    proc2 = spawn({})
+    try:
+        port2 = await_port(proc2)
+        deadline = time.monotonic() + 120
+        st = None
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port2}/stats", timeout=10) as r:
+                st = _json.loads(r.read())
+            if (st["replays"] >= 1 and st["journal"]["entries"] == 0
+                    and st["active"] == 0 and st["queued"] == 0):
+                break
+            time.sleep(0.25)
+        assert st is not None and st["replays"] >= 1, st
+        assert st["journal"]["entries"] == 0, (
+            "recovery must drain and seal the journal")
+        recovered = [
+            r for r in read_traces(tmp_path / "requests.trace.jsonl")
+            if r["attrs"].get("recovered_from") is not None
+            and r["spans"] and r["spans"][-1][0] == "finished"]
+        assert recovered, "no finished recovered_from trace record"
+        assert recovered[0]["attrs"]["n_tokens"] == 20
+    finally:
+        proc2.send_signal(signal.SIGTERM)
+        try:
+            proc2.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
 
 
 # --------------------------------------------------------------------------

@@ -121,7 +121,26 @@ def build_argparser() -> argparse.ArgumentParser:
                         "as JSONL (requests.trace.jsonl) into this "
                         "directory — point it at the job's history dir "
                         "(<intermediate>/<app_id>/) and the portal "
-                        "renders a per-request timeline. Empty = off")
+                        "renders a per-request timeline. Also makes the "
+                        "request journal FILE-backed "
+                        "(requests.journal.jsonl): a killed process's "
+                        "unfinished requests are recovered and finished "
+                        "by the restarted one. Empty = off")
+    p.add_argument("--no-replay", action="store_true",
+                   help="disable the request journal + replay: a loop "
+                        "crash fails in-flight requests (the pre-journal "
+                        "fail-fast contract) and process restarts "
+                        "recover nothing")
+    p.add_argument("--journal-checkpoint-s", type=float, default=1.0,
+                   help="durability-checkpoint cadence: process the "
+                        "open-loop pipeline down to pipeline_depth this "
+                        "often so the journal's emitted prefixes (what "
+                        "replay and router failover resume from) stay "
+                        "fresh for sparse traffic. Costs one packed "
+                        "device->host transfer per checkpoint (~0.1-0.2s "
+                        "on a tunneled dev chip, microseconds "
+                        "host-local). 0 = only at natural processing "
+                        "points")
     return p
 
 
@@ -225,7 +244,8 @@ class ServeApp:
     decode steps."""
 
     def __init__(self, server, *, max_loop_restarts: int = 3,
-                 loop_backoff_s: float = 0.5, trace_dir: str = ""):
+                 loop_backoff_s: float = 0.5, trace_dir: str = "",
+                 journal_checkpoint_s: float = 1.0):
         from ..metrics import MetricsAccumulator
         from ..observability import install_compile_telemetry
         from ..train.profiling import StepTimer
@@ -249,11 +269,29 @@ class ServeApp:
         self.error: str | None = None
         self.max_loop_restarts = max_loop_restarts
         self.loop_backoff_s = loop_backoff_s
+        # durability-checkpoint cadence: every this-many seconds of busy
+        # serving, process the open-loop pipeline down to pipeline_depth
+        # (SlotServer.checkpoint_progress) so the journal's emitted
+        # prefixes — what replay and router failover resume from — stay
+        # fresh even for sparse traffic that would otherwise only
+        # process at completion. 0 disables (journal advances at natural
+        # processing points only).
+        self.journal_checkpoint_s = journal_checkpoint_s
+        self._last_checkpoint = 0.0
         self.loop_failures = 0          # step exceptions, cumulative
         self.loop_restarts = 0          # successful reset+restart cycles
         self._restart_streak = 0        # consecutive failures (the budget)
         self._events: dict[int, threading.Event] = {}
         self._results: dict[int, object] = {}
+        # client progress keys -> engine request ids (GET /progress): a
+        # router polls these to journal emitted prefixes for failover
+        # resume. Bounded FIFO — terminal requests' keys age out instead
+        # of needing a reverse index on every completion.
+        import collections as _collections
+
+        self._progress_keys: "_collections.OrderedDict[str, int]" = \
+            _collections.OrderedDict()
+        self._progress_keys_cap = 4096
         # serving-load gauges (active slots, queue depth, reused-token
         # fraction, shed/cancelled/expired/restart counters) accumulated
         # the same way TaskMonitor accumulates executor metrics —
@@ -321,11 +359,17 @@ class ServeApp:
 
     def _fail_pending(self, exc: Exception) -> None:
         """Fail every waiting request with the loop's error — waiters get
-        a ServingLoopError instead of hanging to their timeouts."""
+        a ServingLoopError instead of hanging to their timeouts. Their
+        journal entries are SEALED: the client was told 'failed', so a
+        later restart's journal recovery must not resurrect the request
+        and decode it for nobody (the terminal is the terminal)."""
+        seal = getattr(self.server, "seal_journal", None)
         for rid, ev in list(self._events.items()):
             self._results[rid] = ServingLoopError(
                 f"serving loop failed: {exc!r}")
             self._events.pop(rid, None)
+            if callable(seal):
+                seal(rid)
             ev.set()
 
     def _loop(self):
@@ -369,6 +413,22 @@ class ServeApp:
                     # would serialize compute with the host round trip
                     if self.server.completions_ready:
                         done = self.server.drain_completed()
+                    elif self.journal_checkpoint_s:
+                        # durability checkpoint (bounded cadence): keep
+                        # the journal's emitted prefixes fresh for
+                        # replay/failover without draining the dispatch
+                        # runway (see SlotServer.checkpoint_progress)
+                        now = time.monotonic()
+                        if now - self._last_checkpoint \
+                                >= self.journal_checkpoint_s:
+                            ckpt = getattr(self.server,
+                                           "checkpoint_progress", None)
+                            if callable(ckpt):
+                                ckpt()
+                                done = self.server.drain_completed() \
+                                    if self.server.completions_ready \
+                                    else {}
+                            self._last_checkpoint = now
                     self._observe_load()
                 if has_ctrs:
                     attests = dispatch_ctrs() != pre
@@ -475,16 +535,23 @@ class ServeApp:
                      timeout: float = 600.0,
                      temperature: float | None = None,
                      top_k: int | None = None,
-                     cache_prompt: bool | None = None):
+                     cache_prompt: bool | None = None,
+                     resume_tokens: list | None = None,
+                     progress_key: str | None = None):
         """Admission half of generate(): returns (request_id, event). The
         request carries ``timeout`` as its queue deadline — if it is
         still queued when the waiter would have given up, admission skips
-        it instead of decoding for nobody."""
+        it instead of decoding for nobody. ``resume_tokens`` teacher-
+        forces an already-emitted prefix (router failover resume — the
+        completion's tokens include it); ``progress_key`` registers a
+        caller-chosen key for GET /progress so a router can journal
+        this request's emitted prefix while it runs."""
         from ..models.serving import Request
 
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k,
                       cache_prompt=cache_prompt,
+                      resume_tokens=resume_tokens,
                       deadline=time.monotonic() + timeout)
         ev = threading.Event()
         try:
@@ -500,11 +567,54 @@ class ServeApp:
                         "server is draining; not accepting requests")
                 self._events[req.id] = ev
                 self.server.submit(req)     # may shed: QueueFullError
+                if progress_key:
+                    self._progress_keys[str(progress_key)] = req.id
+                    if len(self._progress_keys) > self._progress_keys_cap:
+                        self._evict_progress_keys_locked()
         except Exception:
             self._events.pop(req.id, None)   # rejected: no waiter to leak
             raise
         self.wake.set()
         return req.id, ev
+
+    def _evict_progress_keys_locked(self) -> None:
+        """Shrink the progress-key map to its cap, evicting TERMINAL
+        requests' keys first (oldest first; the engine journal says
+        which rids are still live). Evicting purely by age would drop a
+        long-running decode's key — exactly the request with the most
+        work invested — while dead keys sat resident. Live requests are
+        bounded by slots+queue, far under the cap, so the blind
+        oldest-first fallback only fires for engines without a
+        journal."""
+        prog = getattr(self.server, "progress", None)
+        if callable(prog):
+            for key in list(self._progress_keys):
+                if len(self._progress_keys) <= self._progress_keys_cap:
+                    return
+                if prog(self._progress_keys[key]) is None:  # terminal
+                    del self._progress_keys[key]
+        while len(self._progress_keys) > self._progress_keys_cap:
+            self._progress_keys.popitem(last=False)
+
+    def progress(self, keys) -> dict:
+        """The GET /progress payload: per requested key, the live
+        request's replay state ({tokens, prompt_tokens}) from the
+        engine journal — keys that are unknown or whose request is
+        already terminal are simply absent (the caller treats absence
+        as 'no information', keeping whatever prefix it last saw)."""
+        out = {}
+        prog = getattr(self.server, "progress", None)
+        if not callable(prog):
+            return out
+        with self.lock:
+            for key in keys:
+                rid = self._progress_keys.get(key)
+                if rid is None:
+                    continue
+                p = prog(rid)
+                if p is not None:
+                    out[key] = p
+        return out
 
     def take_result(self, request_id: int):
         res = self._results.pop(request_id)
@@ -625,6 +735,14 @@ class ServeApp:
                  "requests whose deadline passed while queued"),
                 ("serving_engine_resets_total", "resets",
                  "SlotServer.reset() recoveries"),
+                (_metrics.SERVING_REPLAYS_TOTAL, "replays",
+                 "requests resumed from a journaled/teacher-forced "
+                 "prefix instead of failing (reset replay, journal "
+                 "recovery, router-failover resume)"),
+                (_metrics.SERVING_REPLAYED_TOKENS_TOTAL,
+                 "replayed_tokens",
+                 "emitted tokens carried across a death boundary by "
+                 "replay (teacher-forced, re-prefilled not re-decoded)"),
                 ("serving_blocks_dispatched_total", "blocks_dispatched",
                  "decode blocks dispatched to the device"),
                 ("serving_admission_dispatches_total",
@@ -828,6 +946,20 @@ def make_handler(app: ServeApp):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path.partition("?")[0] == "/progress":
+                # failover-resume support: a router polls its routed
+                # requests' emitted prefixes (?keys=a,b or ?key=a) so a
+                # replica death mid-request resumes elsewhere from the
+                # last known prefix instead of from scratch
+                from urllib.parse import parse_qs, urlparse
+
+                qs = parse_qs(urlparse(self.path).query)
+                keys = []
+                for k in qs.get("key", []):
+                    keys.append(k)
+                for ks in qs.get("keys", []):
+                    keys.extend(x for x in ks.split(",") if x)
+                self._send(200, app.progress(keys))
             elif self.path.partition("?")[0] == "/debug/profile":
                 # on-demand device profiling: blocks THIS handler thread
                 # for the capture window while the serving loop keeps
@@ -883,11 +1015,22 @@ def make_handler(app: ServeApp):
                 if not 0 < timeout < float("inf"):
                     raise ValueError(
                         "timeout_s must be a positive finite number")
+                resume = payload.get("resume_tokens")
+                if resume is not None:
+                    if not isinstance(resume, list):
+                        raise ValueError(
+                            "resume_tokens must be a JSON list of ints")
+                    resume = [int(t) for t in resume]
+                progress_key = payload.get("progress_key")
+                if progress_key is not None and not isinstance(
+                        progress_key, str):
+                    raise ValueError("progress_key must be a string")
                 rid, ev = app.submit_async(
                     prompt, max_new, timeout=timeout,
                     temperature=None if temp is None else float(temp),
                     top_k=None if top_k is None else int(top_k),
-                    cache_prompt=cache_prompt)
+                    cache_prompt=cache_prompt,
+                    resume_tokens=resume, progress_key=progress_key)
             except QueueFullError as e:
                 # shed: the queue is full. 429 + Retry-After is the
                 # load-balancer contract — retry elsewhere/later instead
@@ -950,6 +1093,20 @@ def main(argv=None) -> int:
         # server then holds a single sharded copy of the model
         params = prepare_decode(params, cfg, weight_dtype=args.weight_dtype,
                                 mesh=mesh)
+    # request durability: file-backed journal under --trace-dir (a
+    # SIGKILLed process's unfinished requests are recovered below and
+    # FINISHED by this one); in-memory otherwise (loop-crash replay
+    # only). --no-replay restores the fail-fast contract end to end.
+    journal = None
+    recovered_entries = []
+    if not args.no_replay and args.trace_dir:
+        from pathlib import Path as _Path
+
+        from ..events.journal import JOURNAL_FILE, RequestJournal
+
+        journal, recovered_entries = RequestJournal.recover(
+            _Path(args.trace_dir) / JOURNAL_FILE)
+        print(f"request journal -> {journal.path}", flush=True)
     slot_server = SlotServer(
         params, cfg, slots=args.slots, max_len=args.max_len,
         block_size=args.block_size, prefill_chunk=args.prefill_chunk,
@@ -960,7 +1117,12 @@ def main(argv=None) -> int:
         batched_admission=not args.per_slot_admission,
         prefix_cache_blocks=args.prefix_cache_blocks,
         cache_prompts=not args.no_cache_prompts,
-        max_queue=args.max_queue)
+        max_queue=args.max_queue,
+        journal=journal, replay=not args.no_replay)
+    if recovered_entries:
+        n = slot_server.recover_journal(recovered_entries)
+        print(f"journal recovery: resumed {n} unfinished request(s) "
+              "from the previous process", flush=True)
     trace_writer = None
     telemetry_state_path = None
     if args.trace_dir:
@@ -990,18 +1152,20 @@ def main(argv=None) -> int:
                 print(f"telemetry state not restored: {e}", flush=True)
     app = ServeApp(slot_server, max_loop_restarts=args.loop_max_restarts,
                    loop_backoff_s=args.loop_backoff_s,
-                   trace_dir=args.trace_dir)
+                   trace_dir=args.trace_dir,
+                   journal_checkpoint_s=(0.0 if args.no_replay
+                                         else args.journal_checkpoint_s))
     app.start()
     httpd = ThreadingHTTPServer((args.host, args.port), make_handler(app))
-    print(f"serving {cfg.n_layers}L d{cfg.d_model} on "
-          f"http://{args.host}:{httpd.server_address[1]} "
-          f"({args.slots} slots x {args.max_len} tokens)", flush=True)
 
     # graceful drain on SIGTERM/SIGINT: a supervisor's TERM must finish
     # in-flight requests instead of killing them mid-decode. A foreground
     # ^C reaches the same path; a SECOND signal force-exits. The drain
     # runs on a helper thread — httpd.shutdown() deadlocks if called from
     # the serve_forever thread, and signal handlers must return fast.
+    # Handlers install BEFORE the readiness print: a supervisor that
+    # TERMs the instant it sees the serving line must hit the drain
+    # path, not the default-action kill (the old order lost that race).
     import os as _os
     import signal as _signal
 
@@ -1022,6 +1186,9 @@ def main(argv=None) -> int:
 
     _signal.signal(_signal.SIGTERM, _on_signal)
     _signal.signal(_signal.SIGINT, _on_signal)
+    print(f"serving {cfg.n_layers}L d{cfg.d_model} on "
+          f"http://{args.host}:{httpd.server_address[1]} "
+          f"({args.slots} slots x {args.max_len} tokens)", flush=True)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:

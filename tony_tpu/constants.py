@@ -113,6 +113,15 @@ TEST_SERVING_STEP_DELAY_MS = "TONY_TEST_SERVING_STEP_DELAY_MS"
 #   added latency per scheduling turn: makes a fast test backend behave
 #   like a slow device so overload/shedding paths actually engage
 TEST_SERVING_CHAOS_SEED = "TONY_TEST_SERVING_CHAOS_SEED"
+TEST_SERVING_CRASH_AT_BLOCKS = "TONY_TEST_SERVING_CRASH_AT_BLOCKS"
+#   comma/space-separated decode-block ordinals at which the serving
+#   loop raises (each fires once) — a DETERMINISTIC mid-decode crash,
+#   the injection point behind the replay gate (bench.py --serving
+#   --replay): in-flight requests must survive via journal replay
+TEST_SERVING_SIGKILL_AT_BLOCK = "TONY_TEST_SERVING_SIGKILL_AT_BLOCK"
+#   the serving PROCESS SIGKILLs itself at that decode block — the
+#   replica-death injection point for router-failover and journal-
+#   recovery e2e tests (0/unset = off)
 
 # driver-side chaos hooks (driver.py monitor loop; read once at
 # construction, seeded so a chaos run's fault sequence is reproducible —

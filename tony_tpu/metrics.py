@@ -54,6 +54,12 @@ SERVING_TTFT_P99_S = "serving_ttft_p99_s"
 SERVING_TPOT_P50_S = "serving_tpot_p50_s"
 SERVING_TPOT_P99_S = "serving_tpot_p99_s"
 SERVING_RETRY_AFTER_S = "serving_retry_after_s"
+# request durability (events/journal.py + SlotServer replay — docs/
+# serving.md "Request durability & replay"): admissions that resumed
+# from a journaled/teacher-forced prefix instead of failing, and the
+# emitted tokens carried across the death boundary
+SERVING_REPLAYS_TOTAL = "serving_replays_total"
+SERVING_REPLAYED_TOKENS_TOTAL = "serving_replayed_tokens_total"
 
 # driver-side cluster telemetry (rendered by Driver.render_metrics on the
 # driver's GET /metrics — docs/observability.md "Driver metrics"). Named
@@ -101,6 +107,10 @@ ROUTER_E2E_SECONDS = "router_request_seconds"
 ROUTER_AFFINITY_HITS_TOTAL = "router_affinity_hits_total"
 ROUTER_AFFINITY_REQUESTS_TOTAL = "router_affinity_requests_total"
 ROUTER_AFFINITY_HIT_RATIO = "router_affinity_hit_ratio"
+# replay-aware failover: mid-request resubmissions to another replica
+# after a transport failure/ejection, carrying the emitted prefix the
+# router last learned from /progress (resume_tokens)
+ROUTER_FAILOVERS_TOTAL = "router_failovers_total"
 
 # executor-accumulator metric names (ride update_metrics pushes the same
 # way memory_rss_mb does; surface on the driver /metrics as

@@ -134,6 +134,7 @@ import itertools
 import logging
 import os
 import random
+import signal
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -144,6 +145,7 @@ import numpy as np
 from jax import lax
 
 from .. import constants as c
+from ..events.journal import RequestJournal
 from ..observability import (
     DispatchTracker,
     RequestTrace,
@@ -152,6 +154,20 @@ from ..observability import (
 )
 
 log = logging.getLogger(__name__)
+
+# What a DELIVERED Completion.finish_reason can say. "stop"/"length" are
+# the natural endings (trace terminal "finished"); "cancelled"/"expired"
+# are early exits that still build a Completion (empty or partial
+# tokens).
+COMPLETION_FINISH_REASONS = ("stop", "length", "cancelled", "expired")
+# The full trace-level finish_reason vocabulary: "shed" (refused at the
+# door — surfaces as QueueFullError / HTTP 429, never a Completion) and
+# "failed" (in-flight state lost with no replay — ServingLoopError /
+# HTTP 503) terminate a request's TRACE without ever building a
+# Completion. Pinned against code, docstrings, docs/serving.md, and the
+# router's HTTP mapping by tests/test_observability.py's finish-reason
+# lint.
+FINISH_REASONS = COMPLETION_FINISH_REASONS + ("shed", "failed")
 
 from .generate import (
     DecodeShardings,
@@ -194,13 +210,27 @@ class Request:
     finish_reason "expired" instead of burning prefill+decode for a
     client that already gave up. (A request already decoding is stopped
     via ``SlotServer.cancel``, the caller's job — the server cannot know
-    the waiter left.) None = no deadline."""
+    the waiter left.) None = no deadline.
+
+    ``resume_tokens`` teacher-forces an already-emitted prefix: the
+    server admits with effective context ``prompt + resume_tokens``
+    (riding the normal chunked-prefill path, prefix-cache eligible),
+    resumes decoding with the remaining ``max_new_tokens -
+    len(resume_tokens)`` budget, and the delivered Completion's tokens
+    are ``resume_tokens`` + the continuation — for a greedy request,
+    byte-identical to the uninterrupted stream. This is the replay
+    primitive behind ``SlotServer.reset()`` recovery, ``serve`` journal
+    recovery, and the router's mid-request failover (docs/serving.md
+    "Request durability & replay"). A prefix that already satisfies the
+    request (budget reached, or it ends in a stop token) completes
+    immediately without taking a slot."""
     prompt: Any
     max_new_tokens: int
     temperature: float | None = None
     top_k: int | None = None
     cache_prompt: bool | None = None
     deadline: float | None = None
+    resume_tokens: list | None = None
     id: int = field(default_factory=itertools.count().__next__)
 
 
@@ -208,7 +238,10 @@ class Request:
 class Completion:
     id: int
     tokens: list[int]
-    finish_reason: str    # "stop" | "length" | "cancelled" | "expired"
+    finish_reason: str    # one of COMPLETION_FINISH_REASONS:
+    #                       "stop" | "length" | "cancelled" | "expired"
+    #                       (shed/failed requests never build a
+    #                       Completion — see FINISH_REASONS)
     # the request's lifecycle trace (observability.RequestTrace.to_dict():
     # host-monotonic span events + attrs) — None only for engines that
     # don't record traces (test stubs)
@@ -237,6 +270,7 @@ class _Admission:
     temp: float
     topk: int
     chunk_starts: list
+    last: int = 0               # the first fed token: full context's last
     prefix_len: int = 0
     hit_path: list = field(default_factory=list)
 
@@ -865,13 +899,23 @@ class SlotServer:
       re-admission rewrites the ring from scratch (tested).
     - ``reset()`` re-arms every serving buffer (KV ring, slot state,
       prefix pool) WITHOUT touching the weights after a loop failure;
-      queued requests survive, admitted ones are returned as lost so
-      the caller can fail them upstream.
+      queued requests survive, and — with the journal on (the default) —
+      admitted requests are REPLAYED instead of failed: each is
+      re-queued with its journaled prompt + emitted-so-far prefix as
+      ``resume_tokens``, so a loop crash costs latency, not requests
+      (greedy continuations are byte-identical; see ``RequestJournal``).
+      ``replay=False`` (or ``journal=None`` with ``replay=False``)
+      preserves the fail-fast contract: admitted ids are returned as
+      lost so the caller fails them upstream.
     - Chaos hooks (``TONY_TEST_SERVING_DISPATCH_FAIL_RATE`` /
-      ``_STEP_DELAY_MS`` / ``_CHAOS_SEED`` env, read at construction,
-      seeded for reproducibility) inject step failures/latency into
-      production code paths, same contract as the driver's ``TEST_*``
-      knobs (constants.py)."""
+      ``_STEP_DELAY_MS`` / ``_CHAOS_SEED`` /
+      ``_CRASH_AT_BLOCKS`` (comma-separated decode-block ordinals at
+      which the loop crashes mid-decode, each once) /
+      ``_SIGKILL_AT_BLOCK`` (the PROCESS SIGKILLs itself at that decode
+      block — the replica-death injection point) env, read at
+      construction, seeded for reproducibility) inject failures/latency
+      into production code paths, same contract as the driver's
+      ``TEST_*`` knobs (constants.py)."""
 
     def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
                  max_len: int = 2048, block_size: int = 16,
@@ -881,7 +925,9 @@ class SlotServer:
                  seed: int = 0, pipeline_depth: int = 2,
                  mesh=None, rules=None, batched_admission: bool = True,
                  prefix_cache_blocks: int = 0, cache_prompts: bool = True,
-                 max_queue: int = 0, trace_sink=None):
+                 max_queue: int = 0, trace_sink=None,
+                 journal: RequestJournal | None = None,
+                 replay: bool = True):
         if not cfg.causal:
             raise ValueError("serving requires a causal model")
         if isinstance(params, DecodeWeights):
@@ -939,6 +985,18 @@ class SlotServer:
         self.resets = 0                 # reset() calls (loop recoveries)
         self.blocks_dispatched = 0      # decode blocks sent to the device
         self.max_queue = int(max_queue)
+        # ---- request durability (events/journal.py) ----
+        # the journal records every accepted request's replay state
+        # (prompt, sampling params, emitted-so-far); reset() replays
+        # journaled in-flight requests instead of failing them, and a
+        # file-backed journal (serve --trace-dir) survives process death
+        # for recover_journal(). replay=False keeps the pre-journal
+        # fail-fast reset contract.
+        self.replay = bool(replay)
+        self._journal = (journal if journal is not None
+                         else (RequestJournal() if self.replay else None))
+        self.replays = 0                # admissions with a resume prefix
+        self.replayed_tokens = 0        # teacher-forced resume tokens
         # ---- request-level telemetry (observability.py) ----
         # every submitted request carries a RequestTrace from submit to
         # its terminal span; finished traces feed the latency histograms,
@@ -970,6 +1028,20 @@ class SlotServer:
         self._chaos_rng = random.Random(
             int(self._env_float(c.TEST_SERVING_CHAOS_SEED)))
         self.chaos_faults_injected = 0
+        # deterministic injection points for the replay harness: crash
+        # the loop (or the whole process) at exact decode-block ordinals
+        # — mid-decode by construction, reproducible by construction
+        self._chaos_crash_blocks: set[int] = set()
+        raw = os.environ.get(c.TEST_SERVING_CRASH_AT_BLOCKS, "")
+        if raw:
+            try:
+                self._chaos_crash_blocks = {
+                    int(x) for x in raw.replace(",", " ").split()}
+            except ValueError:
+                log.error("bad %s value %r; ignoring",
+                          c.TEST_SERVING_CRASH_AT_BLOCKS, raw)
+        self._chaos_sigkill_block = int(
+            self._env_float(c.TEST_SERVING_SIGKILL_AT_BLOCK))
         self.cfg = moe_dropfree(cfg)
         self.slots = slots
         self.max_len = max_len
@@ -981,6 +1053,8 @@ class SlotServer:
         self.top_k = top_k
         self.stop_tokens = tuple(int(t) for t in stop_tokens)
         self.pad_id = int(pad_id)
+        self._seed = int(seed)          # journaled: the sampling stream's
+        #                                 origin (replay determinism doc)
         self._key = jax.random.PRNGKey(seed)
 
         self.pipeline_depth = pipeline_depth
@@ -1140,8 +1214,35 @@ class SlotServer:
                 f"request needs {prompt.size} prompt + "
                 f"{request.max_new_tokens} new tokens but slots hold "
                 f"max_len={self.max_len}")
+        resume = request.resume_tokens
+        if resume is not None:
+            resume = [int(t) for t in np.asarray(resume, np.int32)]
+            request.resume_tokens = resume
         tr = RequestTrace(request.id)
         tr.mark("submitted")
+        if resume:
+            tr.attrs["resume_tokens"] = len(resume)
+            # a prefix that already satisfies the request (budget
+            # reached, or it ends in a stop token) is a finished
+            # completion someone failed to deliver — deliver it now,
+            # without a slot, a prefill, or a decode step
+            stop_end = bool(self.stop_tokens) and resume[-1] in \
+                self.stop_tokens
+            if len(resume) >= request.max_new_tokens or stop_end:
+                toks = resume[:request.max_new_tokens]
+                reason = "stop" if stop_end and toks and \
+                    toks[-1] in self.stop_tokens else "length"
+                self.replays += 1
+                self.replayed_tokens += len(toks)
+                self._traces[request.id] = tr
+                self._done[request.id] = Completion(
+                    request.id, toks, reason,
+                    trace=self._finish_trace(request.id, "finished",
+                                             n_tokens=len(toks),
+                                             reason=reason))
+                if self._journal is not None:
+                    self._journal.finish(request.id)
+                return request.id
         if self.max_queue and len(self._queue) >= self.max_queue:
             # shed at the door: an unbounded queue converts overload into
             # unbounded latency for EVERY admitted request; a bounded one
@@ -1165,6 +1266,15 @@ class SlotServer:
                 raise err
         request.prompt = prompt
         self._traces[request.id] = tr
+        if self._journal is not None:
+            # the journal entry's prompt is the ORIGINAL prompt; a
+            # resume prefix pre-seeds the emitted record, so a second
+            # failure replays from the full known prefix
+            self._journal.submit(
+                request.id, prompt.tolist(), request.max_new_tokens,
+                temperature=request.temperature, top_k=request.top_k,
+                cache_prompt=request.cache_prompt, seed=self._seed,
+                deadline=request.deadline, emitted=resume)
         self._queue.append(request)
         return request.id
 
@@ -1183,9 +1293,16 @@ class SlotServer:
         for req in self._queue:
             if req.deadline is not None and now > req.deadline:
                 self.expired_requests += 1
+                # a queued REPLAY still owns its emitted prefix (same
+                # contract as the queued-cancel path): those tokens were
+                # delivered decode work, not queue residue
+                out = [int(t) for t in (req.resume_tokens or ())]
                 self._done[req.id] = Completion(
-                    req.id, [], "expired",
-                    trace=self._finish_trace(req.id, "expired"))
+                    req.id, out, "expired",
+                    trace=self._finish_trace(req.id, "expired",
+                                             n_tokens=len(out)))
+                if self._journal is not None:
+                    self._journal.finish(req.id)
             else:
                 kept.append(req)
         self._queue = kept
@@ -1210,9 +1327,15 @@ class SlotServer:
                 del self._queue[i]      # by index: Request's array field
                 #                         makes == comparisons ambiguous
                 self.cancelled_requests += 1
+                # a queued REPLAY still owns its emitted prefix: those
+                # tokens were delivered work, not queue residue
+                out = [int(t) for t in (req.resume_tokens or [])]
                 self._done[request_id] = Completion(
-                    request_id, [], "cancelled",
-                    trace=self._finish_trace(request_id, "cancelled"))
+                    request_id, out, "cancelled",
+                    trace=self._finish_trace(request_id, "cancelled",
+                                             n_tokens=len(out)))
+                if self._journal is not None:
+                    self._journal.finish(request_id)
                 return True
         slot = self._slot_of.get(request_id)
         if slot is None:
@@ -1235,13 +1358,59 @@ class SlotServer:
         the weights: fresh KV ring + slot-state buffers (a failed dispatch
         may have killed the donated old ones), fresh prefix pool + trie,
         pipeline and slot bookkeeping cleared. Queued requests survive —
-        they were never started. Admitted-but-undelivered requests cannot
-        be recovered (their cache state died with the ring); their ids
-        are returned so the caller fails them upstream instead of letting
-        their waiters hang."""
-        failed = sorted(self._inflight)
-        for rid in failed:      # their traces end here, not in a leak
-            self._finish_trace(rid, "failed")
+        they were never started.
+
+        Admitted-but-undelivered requests are REPLAYED when the journal
+        is on (the default): their cache state died with the ring, but
+        the journal holds everything an exact continuation needs — the
+        prompt and the emitted-so-far prefix — so each is re-queued
+        (ahead of the never-started queue, preserving admission order)
+        with ``resume_tokens`` for a teacher-forced re-prefill + resumed
+        decode. Unprocessed in-flight blocks re-decode (replay recompute
+        is bounded by one re-prefill of the known prefix plus the
+        pipeline-lag re-decode); greedy continuations are byte-identical
+        to an uninterrupted run. Only ids with no journal entry (or with
+        ``replay=False``) are returned as lost so the caller can fail
+        them upstream instead of letting their waiters hang."""
+        failed: list[int] = []
+        replay_reqs: list[Request] = []
+        for rid in sorted(self._inflight):
+            entry = (self._journal.get(rid)
+                     if self.replay and self._journal is not None else None)
+            if entry is None:
+                failed.append(rid)  # traces end here, not in a leak
+                self._finish_trace(rid, "failed")
+                if self._journal is not None:
+                    self._journal.finish(rid)
+                continue
+            emitted = list(entry.emitted)
+            stop_end = bool(self.stop_tokens) and bool(emitted) and \
+                emitted[-1] in self.stop_tokens
+            if len(emitted) >= entry.max_new_tokens or stop_end:
+                # fully emitted but undelivered (the crash landed between
+                # the finishing block's processing and delivery): deliver
+                # the journaled stream, don't re-decode past the budget
+                toks = emitted[:entry.max_new_tokens]
+                self.replays += 1
+                self.replayed_tokens += len(toks)
+                self._done[rid] = Completion(
+                    rid, toks, "stop" if stop_end else "length",
+                    trace=self._finish_trace(
+                        rid, "finished", n_tokens=len(toks),
+                        reason="stop" if stop_end else "length"))
+                self._journal.finish(rid)
+                continue
+            tr = self._traces.get(rid)
+            if tr is not None:
+                tr.mark("replayed")
+                tr.attrs["replays"] = int(tr.attrs.get("replays", 0)) + 1
+                tr.attrs["replayed_tokens"] = len(entry.emitted)
+            replay_reqs.append(Request(
+                prompt=np.asarray(entry.prompt, np.int32),
+                max_new_tokens=entry.max_new_tokens,
+                temperature=entry.temperature, top_k=entry.top_k,
+                cache_prompt=entry.cache_prompt, deadline=entry.deadline,
+                resume_tokens=list(entry.emitted), id=rid))
         self._prefix_refs.clear()
         # drop pending dispatch-tracker entries WITHOUT blocking on them
         # (their buffers may have died with the failed dispatch) and
@@ -1254,8 +1423,75 @@ class SlotServer:
         if self._prefix_blocks:
             self._init_prefix_pool()
         self._init_host_state()
+        # replays go AHEAD of the never-started queue: they were
+        # admitted first, and their waiters have been waiting longest
+        for req in reversed(replay_reqs):
+            self._queue.appendleft(req)
         self.resets += 1
         return failed
+
+    def recover_journal(self, entries) -> int:
+        """Resubmit another process's unfinished journal entries (see
+        ``RequestJournal.recover``) as fresh requests resuming from
+        their recorded prefixes — ``serve`` startup calls this so a
+        SIGKILLed replica's budgeted restart finishes the dead
+        process's requests. Fresh ids (the dead process's id namespace
+        is gone with its waiters); ``attrs.recovered_from`` keeps the
+        lineage on the trace. Returns how many were resubmitted;
+        entries the bounded queue or validation refuses are logged and
+        dropped, never fatal to startup. Once the resubmissions are
+        journaled, the file compacts down to the live set — the dead
+        process's records were the only copy until now, so dropping
+        them earlier would lose requests on a crash mid-restart
+        (post-compaction a double fault replays twice, never loses).
+
+        Note the deliberate trade-off behind a router: the failover
+        path may ALREADY have resumed these requests on another
+        replica, so the restarted one can duplicate that decode work —
+        completions with no waiter are recorded (traces/metrics/
+        journal seal) and dropped. The journal cannot know whether a
+        front door exists; finishing the recovered set is the
+        durability contract, and it is bounded by the dead process's
+        in-flight+queued set."""
+        n = 0
+        # recovery is exempt from max_queue: these requests were ALL
+        # accepted by the dead process (its own queue bound admitted
+        # them), so re-accepting them restores prior state rather than
+        # taking new load — shedding here would drop up to `slots`
+        # entries and the compaction below would erase the only durable
+        # copy. Transient overshoot is bounded by the dead process's
+        # slots and self-drains.
+        saved_max_queue = self.max_queue
+        self.max_queue = 0
+        try:
+            for entry in entries:
+                req = Request(
+                    prompt=np.asarray(entry.prompt, np.int32),
+                    max_new_tokens=entry.max_new_tokens,
+                    temperature=entry.temperature, top_k=entry.top_k,
+                    cache_prompt=entry.cache_prompt,
+                    resume_tokens=list(entry.emitted))
+                try:
+                    rid = self.submit(req)
+                except ValueError as e:
+                    # malformed beyond serving (shape drift across a
+                    # version boundary): no future recovery could serve
+                    # it either — dropping it from the compacted file
+                    # is correct, but say so loudly
+                    log.error("journal recovery dropped request %s "
+                              "(unservable): %s", entry.id, e)
+                    continue
+                tr = self._traces.get(rid)
+                if tr is not None:
+                    tr.attrs["recovered_from"] = entry.id
+                n += 1
+        finally:
+            self.max_queue = saved_max_queue
+        if self._journal is not None:
+            # the resubmitted live set is durable: drop the dead
+            # process's records now (see RequestJournal.compact)
+            self._journal.compact()
+        return n
 
     def shutdown(self) -> None:
         """Stop the background dispatch-reaper thread (idempotent). The
@@ -1263,6 +1499,18 @@ class SlotServer:
         further dispatch→ready observations are recorded — call at
         process teardown (``ServeApp.shutdown`` does)."""
         self.dispatch_tracker.shutdown()
+        if self._journal is not None:   # flush+close a file-backed journal
+            self._journal.close()
+
+    def seal_journal(self, request_id: int) -> None:
+        """Seal a request's journal entry WITHOUT a completion: the
+        caller delivered a terminal error upstream (restart-budget
+        exhaustion, drain-timeout — the trace/HTTP 'failed' contract),
+        so a later journal recovery must not resurrect and re-decode a
+        request its client already saw fail. Idempotent; no-op with the
+        journal off. (``ServeApp._fail_pending`` calls this.)"""
+        if self._journal is not None:
+            self._journal.finish(request_id)
 
     def fail_queued(self) -> list[Request]:
         """Drain the wait queue (requests never admitted) — the graceful-
@@ -1271,16 +1519,21 @@ class SlotServer:
         self._queue.clear()
         for req in out:
             self._finish_trace(req.id, "failed")
+            if self._journal is not None:
+                self._journal.finish(req.id)
         return out
 
     def _release_request(self, request_id: int) -> None:
         """Drop the dispatch-side tracking of a finished/cancelled
-        request and unpin its matched prefix-cache path."""
+        request, unpin its matched prefix-cache path, and seal its
+        journal entry (no replay after a delivered terminal)."""
         self._slot_of.pop(request_id, None)
         self._inflight.discard(request_id)
         path = self._prefix_refs.pop(request_id, None)
         if path is not None:
             self._prefix_cache.release(path)
+        if self._journal is not None:
+            self._journal.finish(request_id)
 
     # -------------------------------------------------------------- tracing
 
@@ -1313,6 +1566,21 @@ class SlotServer:
             return None
         return self._seal_trace(tr, terminal, n_tokens=n_tokens,
                                 reason=reason)
+
+    def progress(self, request_id: int) -> dict | None:
+        """Replay-state snapshot of a LIVE request — the serve
+        ``GET /progress`` payload a router's failover resume rides:
+        the emitted-so-far prefix (host-processed tokens) plus the
+        prompt length. None for unknown/terminal ids (the journal
+        entry is sealed at the terminal) or with the journal off.
+        Call under the serving lock (``ServeApp`` does)."""
+        if self._journal is None:
+            return None
+        entry = self._journal.get(request_id)
+        if entry is None:
+            return None
+        return {"tokens": list(entry.emitted),
+                "prompt_tokens": len(entry.prompt)}
 
     def estimate_retry_after(self) -> int:
         """Data-driven ``Retry-After``: seconds until a queue seat frees,
@@ -1381,6 +1649,11 @@ class SlotServer:
             "cancelled": self.cancelled_requests,
             "expired": self.expired_requests,
             "resets": self.resets,
+            # request durability: how often death became latency instead
+            # of a failed request, and how many emitted tokens were
+            # carried across the boundary
+            "replays": self.replays,
+            "replayed_tokens": self.replayed_tokens,
             "chaos_faults_injected": self.chaos_faults_injected,
             # latency telemetry: per-histogram count + p50/p90/p99 (host-
             # monotonic; see docs/observability.md for the span schema)
@@ -1391,6 +1664,13 @@ class SlotServer:
             # depth, vs the host bookkeeping's documented bound)
             "device": self.dispatch_tracker.snapshot(),
         }
+        if self._journal is not None:
+            out["journal"] = {
+                "entries": len(self._journal),
+                "durable": self._journal.path is not None,
+                "write_errors": self._journal.write_errors,
+                "replay": self.replay,
+            }
         pc = self._prefix_cache
         if pc is not None:
             out["prefix_cache"] = {
@@ -1457,18 +1737,34 @@ class SlotServer:
             self._slot_of[req.id] = slot
             self._inflight.add(req.id)
             prompt = req.prompt
+            resume = req.resume_tokens
+            if resume is not None:
+                # replay/failover resume (possibly with an empty prefix
+                # — a crash before any token was processed still rides
+                # the replay machinery): teacher-force the known prefix
+                # through the normal chunked-prefill path (prefix-cache
+                # eligible) — the effective context is prompt + emitted,
+                # and only the REMAINING budget decodes
+                self.replays += 1
+                self.replayed_tokens += len(resume)
+            if resume:
+                full = np.concatenate(
+                    [prompt, np.asarray(resume, np.int32)])
+            else:
+                full = prompt
             # all but the last token is prefilled; the last becomes the
             # slot's first fed token so the first sample falls out of the
             # normal decode step
-            body = prompt[:-1]
+            body = full[:-1]
             # ring alignment: the slot's first decode write must land at
             # the cursor as of its first block, i.e. the current cursor
             # (admission dispatches after every block dispatched so far)
             offset = (self._cursor - body.size) % self.max_len
             # each active step advances length by 1 and emits 1 token, so
-            # max_new emissions end at body + max_new (the last emitted
-            # token is never fed/written, same as generate)
-            target = body.size + req.max_new_tokens
+            # the remaining emissions end at body + remaining budget —
+            # for a fresh request exactly body + max_new (the last
+            # emitted token is never fed/written, same as generate)
+            target = body.size + req.max_new_tokens - len(resume or ())
             temp = (self.temperature if req.temperature is None
                     else float(req.temperature))
             topk = (self.top_k if req.top_k is None else int(req.top_k))
@@ -1491,7 +1787,7 @@ class SlotServer:
             admissions.append(_Admission(
                 slot=slot, req=req, body=body, offset=offset, target=target,
                 temp=temp, topk=topk, chunk_starts=chunk_starts,
-                prefix_len=prefix_len, hit_path=path))
+                last=int(full[-1]), prefix_len=prefix_len, hit_path=path))
         if not admissions:
             return
         self._dispatch_prefix_copy(admissions)
@@ -1607,7 +1903,7 @@ class SlotServer:
                 self._d_temps, self._d_topks,
                 jnp.asarray(chunk), jnp.int32(adm.slot), jnp.int32(c0),
                 jnp.int32(adm.offset), jnp.int32(n_valid),
-                jnp.int32(int(adm.req.prompt[-1])), jnp.int32(adm.target),
+                jnp.int32(adm.last), jnp.int32(adm.target),
                 jnp.float32(adm.temp), jnp.int32(adm.topk),
                 cfg=self.cfg, chunk=C, kv_dtype=self.kv_dtype,
                 finalize=final, shardings=self._shardings)
@@ -1649,7 +1945,7 @@ class SlotServer:
                 starts[row] = c0
                 offsets[row] = adm.offset
                 n_valids[row] = nv
-                lasts[row] = int(adm.req.prompt[-1])
+                lasts[row] = adm.last
                 targets[row] = adm.target
                 temps[row] = adm.temp
                 topks[row] = adm.topk
@@ -1676,7 +1972,10 @@ class SlotServer:
         self._expect_len[slot] = body_len
         self._expect_active[slot] = True
         self._requests[slot] = req
-        self._emitted[slot] = []
+        # a resumed request's completion owes the caller the FULL stream:
+        # seed the tally with the teacher-forced prefix (those positions
+        # were prefilled, not decoded — only the continuation appends)
+        self._emitted[slot] = [int(t) for t in (req.resume_tokens or ())]
         # re-arm busy at the replay position: when this slot was
         # re-admitted before its PREDECESSOR's completion was processed,
         # that processing (replayed just before this admit) cleared
@@ -1748,6 +2047,21 @@ class SlotServer:
             self._model_len = self._model_len + np.where(
                 self._model_active, adv, 0).astype(np.int32)
             self._model_active &= self._model_len < self._model_target
+        # deterministic chaos (constants.py TEST_SERVING_*): crash the
+        # loop — or the whole process — at exact decode-block ordinals,
+        # i.e. mid-decode by construction. The block above was really
+        # dispatched: recovery has genuine in-flight work to replay.
+        if (self._chaos_sigkill_block
+                and self.blocks_dispatched >= self._chaos_sigkill_block):
+            log.error("chaos: SIGKILLing this process at decode block %d",
+                      self.blocks_dispatched)
+            os.kill(os.getpid(), signal.SIGKILL)
+        if self.blocks_dispatched in self._chaos_crash_blocks:
+            self._chaos_crash_blocks.discard(self.blocks_dispatched)
+            self.chaos_faults_injected += 1
+            raise RuntimeError(
+                "chaos: injected mid-decode loop crash at block "
+                f"{self.blocks_dispatched}")
 
     def _process(self, count: int) -> None:
         """Sync + bookkeep the oldest ``count`` in-flight blocks with ONE
@@ -1790,6 +2104,12 @@ class SlotServer:
                 had_tokens = bool(self._emitted[slot])
                 self._emitted[slot].extend(int(t) for t in toks[slot, :n])
                 req = self._requests[slot]
+                if n > 0 and req is not None and self._journal is not None:
+                    # durability point: the journaled prefix advances at
+                    # processing time (host-known tokens only — replay
+                    # from any true prefix is exact, the pipeline lag
+                    # just re-decodes)
+                    self._journal.emit(req.id, toks[slot, :n])
                 if not had_tokens and n > 0 and req is not None:
                     # first emitted token OBSERVED by the host — the TTFT
                     # span (lags the device by the processing pipeline;
@@ -1883,6 +2203,25 @@ class SlotServer:
             self._process(len(self._pipeline) - depth)
             self._admit()
 
+    def checkpoint_progress(self) -> None:
+        """Durability checkpoint: process every in-flight block EXCEPT
+        the newest ``pipeline_depth``, advancing the journal's emitted
+        prefixes (and delivering any finished-but-unprocessed
+        completions) without draining the dispatch runway — on an
+        open-loop backlog the processed blocks went device-ready long
+        ago, so the cost is one packed device->host transfer, never a
+        stall. Without this, sparse predictive traffic only processes
+        at completion, leaving a solo request's journal/ /progress
+        prefix empty for its whole decode — a failover would restart
+        it from scratch. ``ServeApp`` calls this on a
+        ``journal_checkpoint_s`` cadence (serve
+        ``--journal-checkpoint-s``; the transfer costs ~0.1-0.2s on a
+        tunneled dev chip, microseconds host-local — tune or disable
+        accordingly)."""
+        n = len(self._pipeline) - self.pipeline_depth
+        if n > 0:
+            self._process(n)
+
     def drain_completed(self) -> dict[int, Completion]:
         if self._predictive and self._pipeline and not self._done:
             self._process(len(self._pipeline))
@@ -1901,4 +2240,5 @@ class SlotServer:
 
 
 __all__ = ["Request", "Completion", "SlotServer", "PrefixCache",
-           "QueueFullError"]
+           "QueueFullError", "RequestJournal",
+           "COMPLETION_FINISH_REASONS", "FINISH_REASONS"]

@@ -15,7 +15,11 @@ shared observability layer every serving component feeds:
   device by up to ``pipeline_depth`` blocks). Span order for a served
   request: ``submitted -> admitted -> prefill_done -> first_token ->
   finished``; requests that never serve end at ``cancelled``,
-  ``expired``, ``shed``, or ``failed`` instead. Dumped as JSONL next to
+  ``expired``, ``shed``, or ``failed`` instead. A request that survived
+  a loop crash via journal replay carries a mid-life ``replayed`` mark
+  (attrs ``replays``/``replayed_tokens``) followed by a fresh
+  admitted/prefill chain — the request-level analogue of TaskTrace's
+  ``restarted`` repeat-chain. Dumped as JSONL next to
   the job's history events (events/trace.py) so the portal can render a
   per-request waterfall.
 - **``TaskTrace``** — the same span machinery at TASK granularity for
@@ -275,6 +279,10 @@ TELEMETRY_HISTOGRAMS = {
                     "on device and the host observing its tokens (the "
                     "pipeline-depth lag, now measured per block instead "
                     "of bounded on paper)",
+    "replay_catchup_s": "time from a reset-replay requeue (the "
+                        "'replayed' span) to the request's terminal — "
+                        "what a loop crash actually cost the request in "
+                        "latency instead of failing it",
 }
 
 
@@ -303,6 +311,13 @@ class ServingTelemetry:
         if trace.spans:
             e2e = trace.spans[-1][1] - trace.spans[0][1]
             self.hist["e2e_s"].observe(max(0.0, e2e))
+            # replay catch-up: the NEWEST 'replayed' mark (a request can
+            # be replayed more than once) to the terminal — the latency
+            # a loop crash cost instead of a failed request
+            rt = trace.last_t("replayed")
+            if rt is not None:
+                self.hist["replay_catchup_s"].observe(
+                    max(0.0, trace.spans[-1][1] - rt))
         n_tokens = trace.attrs.get("n_tokens", 0)
         d = trace.dur("first_token", "finished")
         if d is not None and n_tokens >= 2:

@@ -28,6 +28,19 @@ load balancer:
   jittered exponential backoff, so a replica killed mid-request costs
   latency, never a failed request (the driver restarts it under budget;
   discovery re-adds it at its new port).
+- **Replay-aware failover.** Every routed request carries a
+  ``progress_key``; the health loop batch-polls each replica's
+  ``GET /progress`` for the router's outstanding requests and journals
+  the emitted-so-far prefix per request. On a transport failure/5xx
+  mid-request the router re-asks the failed replica once (a 5xx
+  replica is often still alive; a SIGKILLed one refuses fast and the
+  journaled prefix stands) and resubmits to the rendezvous runner-up
+  with ``resume_tokens`` — the replacement replica teacher-forces the
+  prefix through its prefill path and resumes decoding, so the client
+  still receives the FULL stream (byte-identical for greedy requests)
+  and the dead replica's decode work is not re-decoded from scratch
+  (docs/serving.md "Request durability & replay";
+  ``router_failovers_total``).
 - **Ejection / readmission.** A health thread probes every replica's
   /healthz (eject after ``eject_after`` consecutive failures, readmit
   on the first success), refreshes /stats (queue depth, slots,
@@ -97,7 +110,15 @@ class _ReplicaShed(Exception):
 
 
 class _ReplicaUnavailable(Exception):
-    """Internal: transport error / 5xx from one replica."""
+    """Internal: transport error / 5xx from one replica.
+    ``never_sent`` marks a connection REFUSED — the request never
+    reached the replica, so the retry is an ordinary re-route, not a
+    mid-request failover (the distinction keeps
+    ``router_failovers_total`` an honest mid-stream-recovery count)."""
+
+    def __init__(self, msg: str, never_sent: bool = False):
+        super().__init__(msg)
+        self.never_sent = never_sent
 
 
 class _ReplicaTimeout(Exception):
@@ -198,7 +219,22 @@ class FleetRouter:
         self.shed_total = 0           # requests the ROUTER gave up on (429)
         self.affinity_requests = 0    # requests that had a routing key
         self.affinity_hits = 0        # ... served by their sticky replica
+        # replay-aware failover state: which replica each in-flight
+        # request is posted to, and the freshest emitted prefix the
+        # /progress polls have journaled for it (module docstring).
+        # The nonce namespaces this router INSTANCE's progress keys so
+        # a restarted router (or a shared-nothing peer) can't read
+        # another router's requests — it must be unique per instance,
+        # so it comes from OS entropy, never from ``seed`` (two routers
+        # built with the same seed would otherwise collide key-for-key
+        # and could splice each other's tokens into a failover resume).
+        self._outstanding: dict[int, str] = {}      # rid -> replica name
+        self._resume: dict[int, list[int]] = {}     # rid -> emitted prefix
+        self._nonce = f"{random.SystemRandom().getrandbits(48):012x}"
+        self.failovers_total = 0      # mid-request resubmissions elsewhere
+        self.resumed_tokens_total = 0  # prefix tokens carried by failovers
         self._stop = threading.Event()
+        self._health_started = False
         self._health_thread: threading.Thread | None = None
 
     # ------------------------------------------------------------ replica set
@@ -238,6 +274,7 @@ class FleetRouter:
         """Start the health/discovery loop (idempotent)."""
         if self._health_thread is None or not self._health_thread.is_alive():
             self._stop.clear()
+            self._health_started = True
             self._health_thread = threading.Thread(
                 target=self._health_loop, name="router-health", daemon=True)
             self._health_thread.start()
@@ -289,6 +326,58 @@ class FleetRouter:
                         self._eject_locked(rep, "healthz")
             if healthy and refresh_stats:
                 self._refresh_stats(rep)
+        self._refresh_progress(reps)
+
+    def _pkey(self, rid: int) -> str:
+        return f"{self._nonce}:{rid}"
+
+    def _refresh_progress(self, reps) -> None:
+        """Journal the emitted-so-far prefix of every request this
+        router has outstanding (batched GET /progress per replica,
+        best-effort — a replica without the endpoint just yields
+        nothing). The journaled prefix is what a failover resume
+        carries when the serving replica dies mid-request; staleness
+        only costs re-decode of the gap, never correctness (any true
+        prefix replays exactly)."""
+        with self._lock:
+            by_rep: dict[str, list[int]] = {}
+            for rid, name in self._outstanding.items():
+                by_rep.setdefault(name, []).append(rid)
+        for rep in reps:
+            rids = by_rep.get(rep.name)
+            if not rids:
+                continue
+            got = self._fetch_progress(rep, [self._pkey(r) for r in rids])
+            if not got:
+                continue
+            with self._lock:
+                for rid in rids:
+                    if self._outstanding.get(rid) != rep.name:
+                        # finished while we polled (a write would leak
+                        # the entry _seal already popped), or failed
+                        # over to ANOTHER replica mid-poll (a stale
+                        # answer from the abandoned replica could
+                        # contain a diverging sampled continuation —
+                        # only the CURRENT replica's stream is a true
+                        # prefix)
+                        continue
+                    toks = (got.get(self._pkey(rid)) or {}).get("tokens")
+                    if toks and len(toks) > len(self._resume.get(rid, ())):
+                        self._resume[rid] = [int(t) for t in toks]
+
+    def _fetch_progress(self, rep: Replica, keys,
+                        timeout: float | None = None) -> dict:
+        """Best-effort GET /progress?keys=... against one replica."""
+        if not keys:
+            return {}
+        url = rep.base_url + "/progress?keys=" + ",".join(keys)
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=timeout or self.probe_timeout_s) as r:
+                got = json.loads(r.read().decode())
+                return got if isinstance(got, dict) else {}
+        except Exception:
+            return {}
 
     def _probe_healthz(self, rep: Replica) -> bool:
         try:
@@ -385,7 +474,12 @@ class FleetRouter:
                 self.affinity_requests += 1
         deadline = time.monotonic() + timeout_s
         payload = {"prompt": [int(t) for t in prompt],
-                   "max_new_tokens": int(max_new_tokens)}
+                   "max_new_tokens": int(max_new_tokens),
+                   # failover-resume handle: the health loop polls the
+                   # serving replica's /progress under this key so a
+                   # mid-request death resumes elsewhere from the last
+                   # journaled prefix instead of from scratch
+                   "progress_key": self._pkey(rid)}
         if temperature is not None:
             payload["temperature"] = float(temperature)
         if top_k is not None:
@@ -394,6 +488,7 @@ class FleetRouter:
             payload["cache_prompt"] = bool(cache_prompt)
         attempts = 0
         min_retry_after: int | None = None
+        failover_pending = False    # a failover counts when it POSTS
         last_err = "no replica available"
         while True:
             remaining = deadline - time.monotonic()
@@ -422,6 +517,16 @@ class FleetRouter:
                 rep.inflight += 1
                 if attempts:
                     rep.retries += 1
+                self._outstanding[rid] = rep.name
+                if failover_pending:
+                    # the resubmission is actually happening: THIS is
+                    # the failover (counting in the error handler would
+                    # overcount requests that then die on the deadline
+                    # without ever re-posting)
+                    failover_pending = False
+                    self.failovers_total += 1
+                    self.resumed_tokens_total += len(
+                        payload.get("resume_tokens", ()))
             tr.mark("routed")
             tr.attrs.update(replica=rep.name, attempt=attempts + 1)
             # the replica enforces the same deadline: a request the
@@ -478,6 +583,30 @@ class FleetRouter:
                 with self._lock:
                     rep.errors += 1
                     self._eject_locked(rep, str(e))
+                # replay-aware failover: re-ask the failed replica for
+                # the freshest emitted prefix (a 5xx replica is usually
+                # still alive; a SIGKILLed one refuses in microseconds
+                # and the health loop's last poll stands), then carry
+                # the best-known prefix on the resubmission so the next
+                # replica resumes instead of restarting from scratch.
+                # A REFUSED connection means the request never reached
+                # the replica: plain re-route, nothing in flight there
+                # to ask about, and not a failover for the counter.
+                if not e.never_sent:
+                    pkey = self._pkey(rid)
+                    fresh = (self._fetch_progress(
+                        rep, [pkey],
+                        timeout=min(0.5, self.probe_timeout_s))
+                        .get(pkey) or {}).get("tokens")
+                    with self._lock:
+                        if fresh and len(fresh) > len(
+                                self._resume.get(rid, ())):
+                            self._resume[rid] = [int(t) for t in fresh]
+                        known = list(self._resume.get(rid, ()))
+                    failover_pending = True
+                    if known:
+                        payload["resume_tokens"] = known
+                        tr.attrs["resumed_tokens"] = len(known)
                 last_err = f"{rep.name}: {e}"
                 # jittered exponential backoff before re-ranking — the
                 # survivors absorb the traffic; the health loop readmits
@@ -530,8 +659,10 @@ class FleetRouter:
                                                          TimeoutError):
                 raise _ReplicaTimeout(f"{type(e).__name__}: {e}") \
                     from None
+            refused = isinstance(e, ConnectionRefusedError) or \
+                isinstance(reason, ConnectionRefusedError)
             raise _ReplicaUnavailable(
-                f"{type(e).__name__}: {e}") from None
+                f"{type(e).__name__}: {e}", never_sent=refused) from None
 
     def _seal(self, tr: RequestTrace, terminal: str, **attrs) -> None:
         tr.attrs.update(attrs)
@@ -541,6 +672,10 @@ class FleetRouter:
             self.e2e_hist.observe(max(0.0, e2e))
             if terminal == "failed":
                 self.failed_total += 1
+            # terminal: stop progress-polling this request and drop its
+            # journaled prefix
+            self._outstanding.pop(tr.id, None)
+            self._resume.pop(tr.id, None)
         sink = self.trace_sink
         if sink is not None:
             try:
@@ -566,6 +701,10 @@ class FleetRouter:
                 "requests": self.requests_total,
                 "failed": self.failed_total,
                 "shed": self.shed_total,
+                # replay-aware failover: mid-request resubmissions and
+                # the emitted tokens they carried instead of re-decoding
+                "failovers": self.failovers_total,
+                "resumed_tokens": self.resumed_tokens_total,
                 "affinity": {
                     "enabled": self.affinity,
                     "requests": self.affinity_requests,
@@ -606,6 +745,11 @@ class FleetRouter:
             r.counter(_metrics.ROUTER_FAILED_TOTAL, self.failed_total,
                       "requests the router could not complete "
                       "(deadline / no replica)")
+            r.counter(_metrics.ROUTER_FAILOVERS_TOTAL,
+                      self.failovers_total,
+                      "mid-request resubmissions to another replica "
+                      "after a transport failure/5xx, carrying the "
+                      "journaled emitted prefix (resume_tokens)")
             r.counter(_metrics.ROUTER_AFFINITY_HITS_TOTAL,
                       self.affinity_hits,
                       "keyed requests served by their sticky replica")
@@ -628,6 +772,30 @@ class FleetRouter:
     def healthy(self) -> bool:
         with self._lock:
             return any(r.up for r in self.replicas.values())
+
+    def health(self) -> dict:
+        """The router's OWN ``GET /healthz`` payload — distinct from
+        per-replica health (which this router probes): an upstream load
+        balancer fronting N shared-nothing routers uses it to eject a
+        dead/wedged ROUTER exactly as this router ejects a dead
+        replica. Unhealthy (503) when no replica is in rotation — the
+        router cannot complete a request — or when the maintenance
+        (health/discovery) loop was started and has died/stopped: a
+        router with no liveness view serves a stale fleet and must
+        leave rotation. ``health_loop_alive`` is None until ``start()``
+        (a statically-configured router that never started the loop is
+        still routable)."""
+        with self._lock:
+            live = sum(r.up for r in self.replicas.values())
+            total = len(self.replicas)
+        loop_alive = None
+        if self._health_started:
+            loop_alive = (self._health_thread is not None
+                          and self._health_thread.is_alive()
+                          and not self._stop.is_set())
+        return {"healthy": bool(live) and loop_alive is not False,
+                "live": live, "replicas": total,
+                "health_loop_alive": loop_alive}
 
 
 class DriverDiscovery:
@@ -710,13 +878,12 @@ def make_handler(router: FleetRouter):
 
         def do_GET(self):
             if self.path == "/healthz":
+                # the ROUTER's own health (FleetRouter.health) —
                 # deliberately NOT router.stats(): probers hit this at
                 # liveness cadence, and the full stats payload computes
                 # histogram quantiles under the routing lock
-                with router._lock:
-                    live = sum(r.up for r in router.replicas.values())
-                self._send(200 if live else 503,
-                           {"healthy": bool(live), "live": live})
+                payload = router.health()
+                self._send(200 if payload["healthy"] else 503, payload)
             elif self.path == "/stats":
                 self._send(200, router.stats())
             elif self.path == "/metrics":
