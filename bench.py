@@ -119,6 +119,22 @@ replay; the journal-off path must preserve today's fail-fast
 behavior), and the SIGKILLed replica restarted against the same
 --trace-dir must recover its file journal and finish the orphaned
 requests. Results land in PERF.json under `serving_replay`.
+
+`python bench.py --driver-failover` gates the CONTROL-PLANE recovery
+layer (docs/training-robustness.md "Control-plane recovery") with two
+arms. Training: a real 2-worker elastic_train job whose driver SIGKILLs
+itself mid-job (TONY_TEST_DRIVER_SIGKILL_AT_STEP); the bench relaunches
+`tony-tpu driver --recover`, which replays driver.journal.jsonl and
+re-adopts both live workers — the job must SUCCEED with ZERO
+outage-attributable worker restarts and ZERO recomputed steps (the
+children never stopped stepping), and each worker's recovery→first
+re-attached heartbeat is read off its `readopted` trace and bounded.
+Fleet: a driver-orchestrated 2-replica serving fleet behind the
+FleetRouter answers a paced burst while the driver is SIGKILLed and
+recovered mid-burst — the router must serve the whole burst from its
+last-known fleet (router_discovery_stale observed high, then clear)
+with ZERO failed requests and zero replica restarts. Results land in
+PERF.json under `control_plane_robustness`.
 """
 
 from __future__ import annotations
@@ -1801,7 +1817,387 @@ def run_launch_path_bench() -> int:
     return 0
 
 
+def run_driver_failover_bench() -> int:
+    """Control-plane robustness gate (module docstring; one JSON line ->
+    PERF.json `control_plane_robustness`): driver death must be a
+    latency cost for BOTH workload kinds — training keeps stepping and
+    re-adopts, serving keeps answering from the router's last-known
+    fleet."""
+    training = _failover_training_arm()
+    fleet = _failover_fleet_arm()
+    out = {
+        "metric": "control_plane_robustness",
+        "value": training["recovery_to_first_heartbeat_s_worst"],
+        "unit": "worst driver-recovery -> first re-attached worker "
+                "heartbeat seconds (training arm)",
+        "job_status": "SUCCEEDED",
+        "outage_attributable_worker_restarts": 0,
+        "training": training,
+        "fleet": fleet,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def _wait_recovered_terminal(job_dir: Path, rec_proc, token: str,
+                             timeout_s: float = 180.0) -> dict:
+    """Poll the RECOVERED driver (through the rewritten driver.json) to
+    a terminal application state, then ack finish_application so it can
+    exit. Returns the final state dict."""
+    from tony_tpu import constants as c
+    from tony_tpu.rpc import RpcClient
+    from tony_tpu.rpc.protocol import derive_role_key
+
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if rec_proc.poll() is not None:
+            raise AssertionError(
+                f"recovered driver exited early (code {rec_proc.returncode})"
+                f"; see {job_dir / 'driver.log'}")
+        try:
+            info = json.loads((job_dir / c.DRIVER_INFO_FILE).read_text())
+            if info.get("pid") != rec_proc.pid:
+                time.sleep(0.3)
+                continue
+            rpc = RpcClient(info["host"], info["port"],
+                            token=derive_role_key(token, "client"),
+                            role="client", max_retries=2)
+            state = rpc.call("get_application_state")
+            if state["status"] in ("SUCCEEDED", "FAILED", "KILLED"):
+                rpc.call("finish_application")
+                rpc.close()
+                return state
+            rpc.close()
+        except Exception:
+            pass
+        time.sleep(0.3)
+    raise AssertionError("recovered driver never reached a terminal state")
+
+
+def _spawn_recovered_driver(job_dir: Path, strip_env: list[str]):
+    """Relaunch the driver with --recover (journal replay), WITHOUT the
+    chaos knob that killed its predecessor."""
+    env = {k: v for k, v in os.environ.items() if k not in strip_env}
+    pkg = str(REPO)
+    env["PYTHONPATH"] = pkg + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    logf = open(job_dir / "driver.log", "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-S", "-m", "tony_tpu.driver",
+         "--job-dir", str(job_dir), "--recover"],
+        env=env, stdout=logf, stderr=subprocess.STDOUT,
+        start_new_session=True)
+    return proc, logf
+
+
+def _failover_training_arm() -> dict:
+    import tempfile as _tempfile
+
+    sys.path.insert(0, str(REPO))
+    from tony_tpu import constants as c
+    from tony_tpu.client import TonyClient
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.events.driver_journal import load_state
+    from tony_tpu.events.trace import TASK_TRACE_FILE, read_traces
+
+    SAVE_INTERVAL = 5
+    TOTAL_STEPS = 200
+    STEP_MS = 50
+    SIGKILL_AT = 40
+    workers = 2
+
+    td = _tempfile.mkdtemp(prefix="tony-failover-bench-")
+    root = Path(td)
+    cmd = (f"{sys.executable} -m tony_tpu.examples.elastic_train "
+           f"--steps {TOTAL_STEPS} --save-interval {SAVE_INTERVAL} "
+           f"--ckpt-dir {root}/ckpt_$TONY_TASK_INDEX")
+    conf = TonyConf({
+        "tony.staging.dir": str(root / "staging"),
+        "tony.history.location": str(root / "history"),
+        "tony.history.intermediate": str(root / "history/intermediate"),
+        "tony.history.finished": str(root / "history/finished"),
+        "tony.am.monitor-interval-ms": 100,
+        "tony.task.registration-poll-interval-ms": 100,
+        "tony.task.heartbeat-interval-ms": 250,
+        "tony.task.metrics-interval-ms": 500,
+        # the whole point: executors must outlive the driver by far more
+        # than the kill->recover gap
+        "tony.task.driver-outage-grace-ms": 60000,
+        "tony.worker.instances": workers,
+        "tony.worker.command": cmd,
+        "tony.worker.max-restarts": 1,
+        "tony.execution.env": " ".join(
+            [f"ELASTIC_TRAIN_STEP_MS={STEP_MS}", "JAX_PLATFORMS=cpu"]),
+    })
+    # the SIGKILL knob must reach the DRIVER process only; the recovered
+    # driver is spawned with it stripped (or it would re-fire: the gang
+    # is already past the trigger step)
+    os.environ[c.TEST_DRIVER_SIGKILL_AT_STEP] = str(SIGKILL_AT)
+    t0 = time.time()
+    try:
+        client = TonyClient(conf, poll_interval_s=0.2)
+        client.submit()
+        client._driver_proc.wait(timeout=180)
+    finally:
+        os.environ.pop(c.TEST_DRIVER_SIGKILL_AT_STEP, None)
+    t_kill = time.time()
+    assert client._driver_proc.returncode == -9, (
+        f"driver did not SIGKILL itself (rc "
+        f"{client._driver_proc.returncode})")
+    job_dir = Path(client.job_dir)
+
+    rec, logf = _spawn_recovered_driver(
+        job_dir, strip_env=[c.TEST_DRIVER_SIGKILL_AT_STEP])
+    try:
+        final = _wait_recovered_terminal(job_dir, rec, client.token)
+        rec.wait(timeout=60)
+    finally:
+        if rec.poll() is None:
+            import signal as _signal
+
+            os.killpg(rec.pid, _signal.SIGKILL)
+        logf.close()
+    wall = time.time() - t0
+    assert final["status"] == "SUCCEEDED", final
+
+    # ---- forensics: re-adoption, zero outage-attributable restarts
+    inter = root / "history/intermediate" / client.app_id
+    last = {}
+    all_spans = []
+    for rec_ in read_traces(inter / TASK_TRACE_FILE):
+        last[rec_["id"]] = rec_
+        all_spans += [n for n, *_ in rec_["spans"]]
+    assert all_spans.count("readopted") == workers, (
+        f"expected {workers} readopted tasks, spans: {all_spans}")
+    for bad in ("restarted", "preempted", "resized"):
+        assert bad not in all_spans, (
+            f"outage-attributable '{bad}' relaunch: {all_spans}")
+    recoveries = []
+    for tid, rec_ in last.items():
+        spans = rec_["spans"]
+        names = [n for n, *_ in spans]
+        assert names[0] == "readopted" and names[-1] == "finished", names
+        t_adopt = spans[0][1]
+        t_beat = next(t for n, t in spans[1:] if n == "first_heartbeat")
+        recoveries.append(
+            {"task": tid,
+             "readopt_to_first_heartbeat_s": round(t_beat - t_adopt, 3)})
+    worst = max(r["readopt_to_first_heartbeat_s"] for r in recoveries)
+    assert worst <= 15.0, (
+        f"recovery->first-heartbeat {worst}s exceeds the bound")
+
+    # ---- zero recompute: the children never stopped stepping
+    per_worker = {}
+    for w in range(workers):
+        log_path = job_dir / "logs" / f"worker_{w}.steps.jsonl"
+        steps = []
+        for line in log_path.read_text().splitlines():
+            try:
+                rec_ = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec_.get("train_step"), int):
+                steps.append(rec_["train_step"])
+        for prev, cur in zip(steps, steps[1:]):
+            assert cur == prev + 1, (
+                f"worker_{w}: step discontinuity {prev}->{cur} — the "
+                f"outage cost training work")
+        assert steps and steps[-1] == TOTAL_STEPS - 1, (
+            f"worker_{w} never reached the final step")
+        per_worker[f"worker_{w}"] = {"records": len(steps),
+                                     "last_step": steps[-1]}
+    state = load_state(job_dir / "driver.journal.jsonl")
+    assert state is not None and state.recoveries >= 1
+
+    return {
+        "job_status": final["status"],
+        "sigkill_at_step": SIGKILL_AT,
+        "total_steps": TOTAL_STEPS,
+        "step_ms": STEP_MS,
+        "save_interval": SAVE_INTERVAL,
+        "tasks_readopted": workers,
+        "worker_restarts": 0,
+        "recomputed_steps": 0,
+        "recoveries": recoveries,
+        "recovery_to_first_heartbeat_s_worst": worst,
+        "kill_to_job_success_s": round(time.time() - t_kill, 1),
+        "per_worker": per_worker,
+        "wall_s": round(wall, 1),
+    }
+
+
+def _failover_fleet_arm() -> dict:
+    import signal as _signal
+    import tempfile as _tempfile
+    import threading
+
+    sys.path.insert(0, str(REPO))
+    from tony_tpu import constants as c
+    from tony_tpu.client import TonyClient
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.events.driver_journal import load_state
+    from tony_tpu.router import DriverDiscovery, FleetRouter
+
+    # the TINY shape the router e2e uses: the gate is request survival
+    # across a control-plane outage, not model throughput
+    e = dict(vocab=64, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+             slots=2, max_len=96, block_size=4, prefill_chunk=8)
+    REQUESTS = 48
+    MAX_NEW = 24
+    td = _tempfile.mkdtemp(prefix="tony-failover-fleet-")
+    root = Path(td)
+    serve_cmd = (
+        f"{sys.executable} -m tony_tpu.cli.main serve "
+        "--port $TONY_SERVE_PORT --host 127.0.0.1 "
+        f"--vocab {e['vocab']} --d-model {e['d_model']} "
+        f"--n-layers {e['n_layers']} --n-heads {e['n_heads']} "
+        f"--d-ff {e['d_ff']} --dtype float32 --seed 0 "
+        f"--slots {e['slots']} --max-len {e['max_len']} "
+        f"--block-size {e['block_size']} "
+        f"--prefill-chunk {e['prefill_chunk']} "
+        "--max-queue 64 --drain-timeout-s 2")
+    conf = TonyConf({
+        "tony.staging.dir": str(root / "staging"),
+        "tony.history.location": str(root / "history"),
+        "tony.history.intermediate": str(root / "history/intermediate"),
+        "tony.history.finished": str(root / "history/finished"),
+        "tony.am.monitor-interval-ms": 100,
+        "tony.application.framework": "serving",
+        "tony.task.heartbeat-interval-ms": 250,
+        "tony.task.driver-outage-grace-ms": 60000,
+        "tony.serving.healthz-interval-ms": 200,
+        "tony.replica.instances": 2,
+        "tony.replica.command": serve_cmd,
+        "tony.replica.max-restarts": 1,
+        # slow each scheduling turn so the burst genuinely spans the
+        # driver's death + recovery window
+        "tony.execution.env": " ".join([
+            f"PYTHONPATH={REPO}", "JAX_PLATFORMS=cpu",
+            f"{c.TEST_SERVING_STEP_DELAY_MS}=10"]),
+    })
+    client = TonyClient(conf, poll_interval_s=0.2)
+    client.submit()
+    job_dir = Path(client.job_dir)
+    router = FleetRouter(
+        [], prefill_chunk=e["prefill_chunk"],
+        discover=DriverDiscovery(str(job_dir), role="replica",
+                                 token=client.token),
+        health_interval_s=0.3, eject_after=2, stats_every=2, seed=0)
+    results: dict[int, object] = {}
+    stale_seen = {"high": False, "cleared_after_high": False}
+    rec = logf = None
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            router.health_tick()
+            if router.stats()["live"] == 2:
+                break
+            time.sleep(0.3)
+        assert router.stats()["live"] == 2, (
+            f"fleet never came up: {router.stats()}")
+        router.start()
+
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        chunk = e["prefill_chunk"]
+        templates = [rng.integers(0, e["vocab"], size=chunk,
+                                  dtype=np.int32),
+                     rng.integers(0, e["vocab"], size=2 * chunk,
+                                  dtype=np.int32)]
+        prompts = [np.concatenate(
+            [templates[i % 2],
+             rng.integers(0, e["vocab"], size=1 + i % 3,
+                          dtype=np.int32)]).tolist()
+            for i in range(REQUESTS)]
+
+        def call(i):
+            try:
+                results[i] = router.generate(
+                    prompts[i], max_new_tokens=MAX_NEW, timeout_s=300)
+            except Exception as exc:
+                results[i] = exc
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(REQUESTS)]
+        t_burst = time.time()
+        for i, t in enumerate(threads):
+            t.start()
+            time.sleep(0.08)
+            if i == REQUESTS // 3:
+                # mid-burst: SIGKILL the driver. The replicas (own
+                # sessions) keep serving; the router flies blind on its
+                # last-known fleet until the recovered driver answers.
+                os.kill(client._driver_proc.pid, _signal.SIGKILL)
+                client._driver_proc.wait(timeout=10)
+                t_kill = time.time()
+            if i == REQUESTS // 3 + 4:
+                # a few requests into the outage: discovery must be
+                # marked stale while requests keep completing
+                router.health_tick()
+                stale_seen["high"] = router.stats()["discovery_stale"]
+                rec, logf = _spawn_recovered_driver(job_dir, strip_env=[])
+        for t in threads:
+            t.join(timeout=300)
+        t_done = time.time()
+        # recovered driver up + discovery clear again
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = router.stats()
+            if not st["discovery_stale"] and st["live"] == 2:
+                stale_seen["cleared_after_high"] = True
+                break
+            time.sleep(0.3)
+        failed = {i: r for i, r in results.items()
+                  if not isinstance(r, dict)}
+        assert not failed, (
+            f"{len(failed)} requests failed across the driver outage: "
+            f"{dict(list(failed.items())[:3])}")
+        assert len(results) == REQUESTS
+        assert stale_seen["high"], (
+            "router never marked discovery stale during the outage")
+        assert stale_seen["cleared_after_high"], (
+            "discovery never recovered after the driver came back")
+        state = load_state(job_dir / "driver.journal.jsonl")
+        restarts = sum(t.restarts for t in state.tasks.values())
+        assert restarts == 0, (
+            f"replicas restarted across the outage: {restarts}")
+        by_replica: dict[str, int] = {}
+        for r in results.values():
+            by_replica[r["replica"]] = by_replica.get(r["replica"], 0) + 1
+        return {
+            "requests": REQUESTS,
+            "failed_requests": 0,
+            "replica_restarts": 0,
+            "discovery_stale_observed": True,
+            "discovery_recovered": True,
+            "kill_to_burst_done_s": round(t_done - t_kill, 1),
+            "burst_wall_s": round(t_done - t_burst, 1),
+            "per_replica_requests": by_replica,
+            "driver_recoveries": state.recoveries,
+        }
+    finally:
+        router.shutdown()
+        # teardown: SIGTERM the recovered driver (its signal path stops
+        # every container, adopted handles included), then hard-reap
+        for proc in (rec, client._driver_proc):
+            if proc is not None and proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, _signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        if rec is not None:
+            try:
+                rec.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                os.killpg(rec.pid, _signal.SIGKILL)
+        if logf is not None:
+            logf.close()
+
+
 def main() -> int:
+    if "--driver-failover" in sys.argv:
+        return run_driver_failover_bench()
     if "--launch-path" in sys.argv:
         return run_launch_path_bench()
     if "--elastic" in sys.argv:

@@ -1,0 +1,885 @@
+"""Control-plane journal + driver recovery (ISSUE 12, docs/
+training-robustness.md "Control-plane recovery").
+
+The contract under test: the driver journals its authoritative state to
+``driver.journal.jsonl`` (append+flush, torn-line-tolerant read,
+tmp+rename compaction), a replacement driver (``Driver.recover`` /
+``tony-tpu driver --recover``) replays it, rewrites driver.json with a
+bumped ``driver_generation``, and RE-ADOPTS live tasks — surviving
+executors' heartbeats re-attach by task id + attempt, zombie
+registrations from superseded attempts are refused by the attempt
+fence, and dead-while-orphaned tasks relaunch under the journaled
+restart budget. The edges tolerate the outage instead of amplifying
+it: the Heartbeater rides a bounded grace window (re-resolving the
+recovered driver's endpoint from driver.json, without inflating
+``heartbeats_missed``), and the fleet router keeps serving its
+last-known fleet while discovery is blind (``router_discovery_stale``).
+
+Stub executors are threads speaking the real framed-JSON RPC (the
+test_task_trace pattern) that deliberately SURVIVE the first driver's
+death and re-resolve driver.json — exactly what a real executor's
+outage-grace path does — so the whole recovery cycle runs in ~seconds.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+import tony_tpu.constants as c
+from tony_tpu.api import JobStatus
+from tony_tpu.cluster.provisioner import ContainerHandle, Provisioner
+from tony_tpu.conf import TonyConf
+from tony_tpu.driver import Driver
+from tony_tpu.events.driver_journal import (
+    DriverJournal,
+    load_state,
+    rewrite_journal,
+)
+from tony_tpu.events.trace import TASK_TRACE_FILE, read_traces
+from tony_tpu.rpc import RpcClient, RpcError
+
+
+# --------------------------------------------------------------------------
+# harness (test_task_trace pattern, death-surviving variant)
+# --------------------------------------------------------------------------
+
+def _conf(dirs, **extra):
+    return TonyConf({
+        "tony.staging.dir": dirs["staging"],
+        "tony.history.location": dirs["history"],
+        "tony.history.intermediate": dirs["history"] + "/intermediate",
+        "tony.history.finished": dirs["history"] + "/finished",
+        "tony.am.monitor-interval-ms": 50,
+        "tony.task.registration-poll-interval-ms": 50,
+        **extra,
+    })
+
+
+class ScriptedProvisioner(Provisioner):
+    """launch() runs ``script(spec, index, env, handle, attempt)`` on a
+    thread; a script returning None reports no container completion
+    (the adopted-handle situation: the spawning driver is dead)."""
+
+    def __init__(self, script):
+        super().__init__()
+        self._script = script
+        self._attempts: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.launches: list[str] = []
+
+    def launch(self, spec, index, env, log_dir):
+        task_id = f"{spec.name}:{index}"
+        with self._lock:
+            attempt = self._attempts.get(task_id, 0)
+            self._attempts[task_id] = attempt + 1
+            self.launches.append(task_id)
+        handle = ContainerHandle(
+            container_id=f"stub_{task_id}_{attempt}",
+            host="127.0.0.1", role=spec.name, index=index,
+        )
+        threading.Thread(
+            target=self._run, args=(spec, index, env, handle, attempt),
+            daemon=True,
+        ).start()
+        return handle
+
+    def _run(self, spec, index, env, handle, attempt):
+        try:
+            code = self._script(spec, index, env, handle, attempt)
+        except Exception as e:                  # pragma: no cover - debug aid
+            print(f"stub executor failed: {type(e).__name__}: {e}",
+                  flush=True)
+            code = 1
+        if code is not None and self.on_completion:
+            self.on_completion(handle, code)
+
+    def stop_container(self, handle):
+        pass
+
+    def stop_all(self):
+        pass
+
+
+def _make_driver(dirs, job_dir, script, **conf_extra):
+    conf = _conf(dirs, **conf_extra)
+    job_dir.mkdir(exist_ok=True)
+    conf.write_final(job_dir)
+    driver = Driver(conf, app_id="recover_test", job_dir=str(job_dir),
+                    token="recover-secret",
+                    provisioner=ScriptedProvisioner(script))
+    driver.client_signal.set()      # no client: don't wait for the ack
+    return driver
+
+
+def _abrupt_death(driver, thread):
+    """Simulate driver death for the in-process tests: stop the monitor
+    loop and tear the RPC endpoint down WITHOUT completing any task —
+    exactly the state a SIGKILL leaves behind (live executors, live
+    journal, no terminal records). The scripted provisioner's stops are
+    no-ops, so no container is touched, and the callbacks are
+    disconnected so the corpse can't react to late completions."""
+    driver._stop_requested.set()
+    thread.join(timeout=20)
+    assert not thread.is_alive(), "first driver did not wind down"
+    driver.provisioner.on_completion = None
+    # a SIGKILL severs established RPC connections, but the in-process
+    # stand-in can't kill the corpse's lingering per-connection handler
+    # threads (ThreadingTCPServer.shutdown only stops the accept loop) —
+    # make them REFUSE instead, so persistent clients fail over to the
+    # recovered endpoint exactly as they would on a reset connection
+    driver.rpc_server._handlers.clear()
+
+
+def _resolving_stub(job_dir, release, ports_base=22000, exit_code=0,
+                    hold=None):
+    """A death-surviving stub executor: registers (echoing its launch
+    attempt), heartbeats, and on ANY transport failure re-resolves the
+    driver endpoint from driver.json — the thread-stub equivalent of the
+    executor's outage-grace path. Reports exit over the RPC once
+    ``release`` is set. Returns None so the scripted provisioner never
+    reports a container completion (the first driver is dead by then;
+    the recovered driver treats the executor report as authoritative)."""
+
+    def stub(spec, index, env, handle, attempt):
+        task_id = f"{spec.name}:{index}"
+        if hold is not None and not hold.wait(30):
+            return None
+
+        def fresh_client():
+            info = json.loads(
+                (job_dir / c.DRIVER_INFO_FILE).read_text())
+            return RpcClient(info["host"], info["port"],
+                             token=env[c.ENV_TOKEN], role="executor",
+                             max_retries=1)
+
+        rpc = fresh_client()
+        payload = rpc.call(
+            "register_worker", task_id=task_id, host="127.0.0.1",
+            port=ports_base + index,
+            attempt=int(env[c.ENV_TASK_ATTEMPT]))
+        deadline = time.time() + 30
+        while payload is None and time.time() < deadline:
+            time.sleep(0.05)
+            payload = rpc.call("get_cluster_spec", task_id=task_id)
+        while not release.is_set() and time.time() < deadline:
+            try:
+                rpc.call("heartbeat", task_id=task_id)
+            except Exception:
+                rpc.close()
+                time.sleep(0.05)
+                try:
+                    rpc = fresh_client()
+                except Exception:
+                    pass
+            time.sleep(0.05)
+        for _ in range(100):
+            try:
+                rpc.call("register_execution_result", task_id=task_id,
+                         exit_code=exit_code)
+                break
+            except Exception:
+                rpc.close()
+                time.sleep(0.1)
+                try:
+                    rpc = fresh_client()
+                except Exception:
+                    pass
+        rpc.close()
+        return None
+
+    return stub
+
+
+def _last_trace_per_id(path):
+    recs = {}
+    for rec in read_traces(path):
+        recs[rec["id"]] = rec       # later records win (recovery appends)
+    return recs
+
+
+# --------------------------------------------------------------------------
+# journal unit: replay, torn lines, compaction
+# --------------------------------------------------------------------------
+
+def test_journal_replay_roundtrip(tmp_path):
+    """Every op kind replays; a new launch clears the old attempt's
+    registration/ports/ledgers; meta takes last-wins."""
+    p = tmp_path / "driver.journal.jsonl"
+    j = DriverJournal(p)
+    j.record("meta", app_id="app1", token="tok", session_id=0,
+             rpc_port=41001, driver_generation=0)
+    j.record("launch", task="worker:0", attempt=1, container_id="c0",
+             pid=111, host="h0", t=10.0, log_path="l0")
+    j.record("register", task="worker:0", host="h0", port=9001)
+    j.record("ports", task="worker:0", ports={"serve_port": 8080})
+    j.record("ledger", kind="preempt", task="worker:0", cmd=True)
+    j.record("restarts", task="worker:0", used=1)
+    j.record("launch", task="worker:0", attempt=2, container_id="c1",
+             pid=112, host="h0", t=20.0, log_path="l1")
+    j.record("launch", task="worker:1", attempt=1, container_id="c2",
+             pid=113, host="h1", t=11.0, log_path="l2")
+    j.record("register", task="worker:1", host="h1", port=9002)
+    j.record("terminal", task="worker:1", status="SUCCEEDED", exit_code=0)
+    j.record("generation", gen=3)
+    j.record("detach", task="worker:2")
+    j.record("meta", app_id="app1", token="tok", session_id=0,
+             rpc_port=41002, driver_generation=1)
+    j.close()
+
+    s = load_state(p)
+    assert s is not None
+    assert (s.app_id, s.token, s.rpc_port) == ("app1", "tok", 41002)
+    assert s.driver_generation == 1 and s.gang_generation == 3
+    w0 = s.tasks["worker:0"]
+    # the second launch superseded everything the first attempt was
+    assert w0.attempt == 2 and w0.pid == 112 and w0.restarts == 1
+    assert not w0.registered and w0.ports == {} and not w0.terminal
+    assert "worker:0" not in s.preempts
+    w1 = s.tasks["worker:1"]
+    assert w1.terminal and w1.status == "SUCCEEDED" and w1.exit_code == 0
+    assert s.detached == {"worker:2"}
+
+
+def test_journal_torn_line_and_missing_meta(tmp_path):
+    """A record torn by SIGKILL mid-write is dropped, not fatal; a file
+    with no meta record (or no file at all) is not recoverable."""
+    p = tmp_path / "driver.journal.jsonl"
+    j = DriverJournal(p)
+    j.record("meta", app_id="app1", token="t", session_id=0,
+             rpc_port=1, driver_generation=0)
+    j.record("launch", task="worker:0", attempt=1, container_id="c0",
+             pid=1, host="h", t=1.0)
+    j.close()
+    with open(p, "a") as f:
+        f.write('{"op": "launch", "task": "worker:1", "atte')   # torn
+    s = load_state(p)
+    assert s is not None and list(s.tasks) == ["worker:0"]
+
+    assert load_state(tmp_path / "nope.jsonl") is None
+    metaless = tmp_path / "metaless.jsonl"
+    metaless.write_text(
+        '{"op": "launch", "task": "worker:0", "attempt": 1}\n')
+    assert load_state(metaless) is None
+
+
+def test_journal_rewrite_compacts_to_live_state(tmp_path):
+    """rewrite_journal collapses an op stream down to its replayed
+    state (tmp+rename) and the compacted file replays identically."""
+    p = tmp_path / "driver.journal.jsonl"
+    j = DriverJournal(p)
+    j.record("meta", app_id="a", token="t", session_id=0, rpc_port=5,
+             driver_generation=0)
+    for attempt in range(1, 21):
+        j.record("launch", task="worker:0", attempt=attempt,
+                 container_id=f"c{attempt}", pid=100 + attempt, host="h",
+                 t=float(attempt))
+        j.record("register", task="worker:0", host="h", port=9000)
+    j.close()
+    before = load_state(p)
+    assert len(p.read_text().splitlines()) == 41
+    rewrite_journal(p, before)
+    after = load_state(p)
+    assert len(p.read_text().splitlines()) == 3     # meta+launch+register
+    assert after.tasks["worker:0"].attempt == 20
+    assert after.tasks["worker:0"].registered
+    assert after.tasks["worker:0"].pid == 120
+
+
+# --------------------------------------------------------------------------
+# attempt fence: zombie registrations refused
+# --------------------------------------------------------------------------
+
+def test_register_worker_refuses_stale_attempt(tmp_job_dirs, tmp_path):
+    """A superseded attempt's executor (zombie from before a recovery /
+    restart) registering with its old attempt ordinal is refused; the
+    current attempt — and fence-less legacy callers (attempt=-1) —
+    register fine."""
+    release = threading.Event()
+    job_dir = tmp_path / "job"
+    envs = {}
+
+    def stub(spec, index, env, handle, attempt):
+        envs[attempt] = dict(env)
+        release.wait(20)
+        return None
+
+    driver = _make_driver(
+        tmp_job_dirs, job_dir, stub,
+        **{"tony.worker.instances": 1, "tony.worker.command": "stub"})
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 10
+        while 0 not in envs and time.time() < deadline:
+            time.sleep(0.05)
+        env = envs[0]
+        assert env[c.ENV_TASK_ATTEMPT] == "1"
+        assert env[c.ENV_DRIVER_GENERATION] == "0"
+        rpc = RpcClient(env[c.ENV_DRIVER_HOST], int(env[c.ENV_DRIVER_PORT]),
+                        token=env[c.ENV_TOKEN], role="executor")
+        with pytest.raises(RpcError, match="stale attempt"):
+            rpc.call("register_worker", task_id="worker:0",
+                     host="127.0.0.1", port=23000, attempt=0)
+        # the real attempt and a legacy (fence-less) caller both pass
+        assert rpc.call("register_worker", task_id="worker:0",
+                        host="127.0.0.1", port=23000, attempt=1) is not None
+        assert rpc.call("register_worker", task_id="worker:0",
+                        host="127.0.0.1", port=23000) is not None
+        rpc.close()
+    finally:
+        release.set()
+        driver._stop_requested.set()
+        t.join(timeout=20)
+
+
+# --------------------------------------------------------------------------
+# the core: recovery re-adopts live workers, zero extra restarts
+# --------------------------------------------------------------------------
+
+def test_recover_readopts_live_stub_workers(tmp_job_dirs, tmp_path):
+    """Driver #1 launches 2 workers and dies abruptly mid-job (no
+    terminal records, executors alive). Driver.recover() replays the
+    journal, bumps driver_generation in driver.json, re-adopts both
+    workers (readopted spans + driver_tasks_readopted_total), their
+    heartbeats re-attach through the rewritten driver.json, the job
+    finishes SUCCEEDED with ZERO task restarts and zero relaunches, and
+    the journal was compacted on the way."""
+    release = threading.Event()
+    job_dir = tmp_path / "job"
+    stub = _resolving_stub(job_dir, release)
+
+    d1 = _make_driver(
+        tmp_job_dirs, job_dir, stub,
+        **{"tony.worker.instances": 2, "tony.worker.command": "stub",
+           "tony.worker.max-restarts": 1,
+           "tony.task.heartbeat-interval-ms": 100})
+    t1 = threading.Thread(target=d1.run, daemon=True)
+    t1.start()
+    deadline = time.time() + 15
+    while d1.session.registered_count() < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert d1.session.registered_count() == 2, "workers never registered"
+    journal_lines = (job_dir / c.DRIVER_JOURNAL_FILE).read_text()
+    assert '"op": "launch"' in journal_lines
+    _abrupt_death(d1, t1)
+
+    # ---- recovery: a provisioner whose launch() would flag the bug
+    relaunches = []
+
+    def must_not_launch(spec, index, env, handle, attempt):
+        relaunches.append(f"{spec.name}:{index}")
+        return 1
+
+    d2 = Driver.recover(str(job_dir),
+                        provisioner=ScriptedProvisioner(must_not_launch))
+    d2.client_signal.set()
+    assert d2._recoveries == 1 and d2._readopted == 2
+    assert d2.driver_generation == 1
+    assert dict(d2._attempts) == {"worker:0": 1, "worker:1": 1}
+    t2 = threading.Thread(target=d2.run, daemon=True)
+    t2.start()
+    try:
+        # the rewritten driver.json is what the stubs re-resolve
+        deadline = time.time() + 15
+        info = {}
+        while time.time() < deadline:
+            info = json.loads((job_dir / c.DRIVER_INFO_FILE).read_text())
+            if info.get("pid") == os.getpid() and info.get(
+                    "driver_generation") == 1:
+                break
+            time.sleep(0.05)
+        assert info.get("driver_generation") == 1, info
+        # both survivors re-attach: fresh beats land on the new driver
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            with d2._tt_lock:
+                attached = {tid for tid in ("worker:0", "worker:1")
+                            if tid in d2._first_beat}
+            if len(attached) == 2:
+                break
+            time.sleep(0.05)
+        assert len(attached) == 2, f"heartbeats never re-attached: {attached}"
+        # live /metrics carries the recovery counters
+        port = d2.metrics_port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "driver_recoveries_total 1" in text
+        assert "driver_tasks_readopted_total 2" in text
+        assert "driver_task_restarts_total 0" in text
+    finally:
+        release.set()
+        t2.join(timeout=30)
+    assert not t2.is_alive(), "recovered driver did not finish"
+    assert d2.session.status == JobStatus.SUCCEEDED, (
+        d2.session.failure_message)
+    assert relaunches == [], "recovery relaunched a live worker"
+    assert d2._restarts == {}, "recovery charged the restart budget"
+
+    inter = Path(tmp_job_dirs["history"]) / "intermediate" / "recover_test"
+    recs = _last_trace_per_id(inter / TASK_TRACE_FILE)
+    for tid in ("worker:0", "worker:1"):
+        names = [n for n, *_ in recs[tid]["spans"]]
+        assert names[0] == "readopted", names
+        assert "first_heartbeat" in names, names
+        assert names[-1] == "finished", names
+        assert "restarted" not in names, names
+        assert recs[tid]["attrs"]["driver_generation"] == 1
+
+    # the journal was compacted at recovery and re-stamped: one meta
+    # with the new endpoint, a recovered record, no duplicate launches
+    state = load_state(job_dir / c.DRIVER_JOURNAL_FILE)
+    assert state.recoveries >= 1
+    assert state.tasks["worker:0"].terminal
+    assert state.tasks["worker:1"].terminal
+
+
+def test_recover_relaunches_dead_orphan_under_journaled_budget(
+        tmp_job_dirs, tmp_path):
+    """A worker whose journaled pid is provably DEAD at recovery is not
+    re-adopted: its liveness clock comes back pre-expired, the first
+    monitor ticks route it through the NORMAL budgeted-restart path,
+    and the relaunch carries the next attempt ordinal. The journaled
+    budget is respected: restarts already spent stay spent."""
+    release = threading.Event()
+    job_dir = tmp_path / "job"
+    attempts_seen = []
+
+    def stub(spec, index, env, handle, attempt):
+        env_attempt = int(env[c.ENV_TASK_ATTEMPT])
+        attempts_seen.append(env_attempt)
+        if env_attempt == 1:
+            return None         # first attempt: registers elsewhere below
+        # the relaunched attempt (the DRIVER's ordinal, not the fresh
+        # provisioner's) finishes the job; unlike a re-adopted handle it
+        # has a live container watcher, so return a real exit code
+        real = _resolving_stub(job_dir, release)
+        real(spec, index, env, handle, attempt)
+        return 0
+
+    d1 = _make_driver(
+        tmp_job_dirs, job_dir, stub,
+        **{"tony.worker.instances": 1, "tony.worker.command": "stub",
+           "tony.worker.max-restarts": 2,
+           "tony.task.heartbeat-interval-ms": 100,
+           "tony.task.max-missed-heartbeats": 3})
+    # attempt 1 registers via a short-lived client, then 'dies': give the
+    # journal a registered task whose pid is a real dead process
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    t1 = threading.Thread(target=d1.run, daemon=True)
+    t1.start()
+    deadline = time.time() + 10
+    while not attempts_seen and time.time() < deadline:
+        time.sleep(0.05)
+    env1 = None
+    deadline = time.time() + 10
+    while env1 is None and time.time() < deadline:
+        try:
+            info = json.loads((job_dir / c.DRIVER_INFO_FILE).read_text())
+            env1 = info
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    rpc = RpcClient(env1["host"], env1["port"],
+                    token=d1.executor_token, role="executor")
+    rpc.call("register_worker", task_id="worker:0", host="127.0.0.1",
+             port=24000, attempt=1)
+    rpc.close()
+    _abrupt_death(d1, t1)
+    # rewrite the journaled pid to the provably-dead one (the scripted
+    # provisioner has no real pids; a real driver journals the Popen pid)
+    state = load_state(job_dir / c.DRIVER_JOURNAL_FILE)
+    state.tasks["worker:0"].pid = dead.pid
+    rewrite_journal(job_dir / c.DRIVER_JOURNAL_FILE, state)
+
+    prov2 = ScriptedProvisioner(stub)
+    d2 = Driver.recover(str(job_dir), provisioner=prov2)
+    d2.client_signal.set()
+    assert d2._readopted == 0, "a dead pid must not count as re-adopted"
+    t2 = threading.Thread(target=d2.run, daemon=True)
+    t2.start()
+    try:
+        deadline = time.time() + 20
+        while not prov2.launches and time.time() < deadline:
+            time.sleep(0.05)
+        assert prov2.launches == ["worker:0"], "orphan was not relaunched"
+    finally:
+        release.set()
+        t2.join(timeout=30)
+    assert d2.session.status == JobStatus.SUCCEEDED, (
+        d2.session.failure_message)
+    assert d2._restarts.get("worker:0") == 1, "budget not charged"
+    assert attempts_seen[-1] == 2, attempts_seen
+
+    inter = Path(tmp_job_dirs["history"]) / "intermediate" / "recover_test"
+    recs = _last_trace_per_id(inter / TASK_TRACE_FILE)
+    names = [n for n, *_ in recs["worker:0"]["spans"]]
+    assert "restarted" in names and names[-1] == "finished", names
+
+
+def test_recover_launches_partially_launched_roles_missing_tasks(
+        tmp_job_dirs, tmp_path):
+    """The driver can die INSIDE _request_role: some of a role's tasks
+    journaled-launched, the rest never requested. The recovered driver
+    must launch the missing siblings itself — the role is marked
+    scheduled (so the DAG won't re-request it wholesale), and a
+    never-journaled task otherwise has no liveness entry, no
+    registration timeout, and no request coming (review finding)."""
+    release = threading.Event()
+    release.set()           # stubs run to completion immediately
+    job_dir = tmp_path / "job"
+    job_dir.mkdir()
+    conf = _conf(tmp_job_dirs,
+                 **{"tony.worker.instances": 2,
+                    "tony.worker.command": "stub",
+                    "tony.worker.max-restarts": 1,
+                    "tony.task.heartbeat-interval-ms": 100,
+                    "tony.task.max-missed-heartbeats": 3})
+    conf.write_final(job_dir)
+    # hand-craft the dead driver's journal: worker:0 launched (pid
+    # provably dead -> expiry relaunch) and registered; worker:1 NEVER
+    # launched — the mid-_request_role death shape
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    j = DriverJournal(job_dir / c.DRIVER_JOURNAL_FILE)
+    j.record("meta", app_id="recover_test", token="recover-secret",
+             session_id=0, rpc_port=0, driver_generation=0)
+    j.record("launch", task="worker:0", attempt=1, container_id="c0",
+             pid=dead.pid, host="127.0.0.1", t=time.time())
+    j.record("register", task="worker:0", host="127.0.0.1", port=25000)
+    j.close()
+
+    def stub(spec, index, env, handle, attempt):
+        real = _resolving_stub(job_dir, release, ports_base=25100)
+        real(spec, index, env, handle, attempt)
+        return 0
+
+    prov = ScriptedProvisioner(stub)
+    d2 = Driver.recover(str(job_dir), provisioner=prov)
+    d2.client_signal.set()
+    t2 = threading.Thread(target=d2.run, daemon=True)
+    t2.start()
+    t2.join(timeout=30)
+    assert not t2.is_alive(), "recovered driver never finished"
+    assert d2.session.status == JobStatus.SUCCEEDED, (
+        d2.session.failure_message)
+    # worker:1 launched by the recovery gap-fill, worker:0 relaunched by
+    # the expiry path under the journaled budget
+    assert sorted(prov.launches) == ["worker:0", "worker:1"], prov.launches
+    assert d2._attempts["worker:1"] == 1
+    assert d2._attempts["worker:0"] == 2
+
+
+# --------------------------------------------------------------------------
+# Heartbeater: outage window semantics
+# --------------------------------------------------------------------------
+
+class _Notes:
+    def __init__(self):
+        self.notes = []
+
+    def note(self, name, value):
+        self.notes.append((name, value))
+
+
+def test_heartbeater_outage_reattaches_without_missed_inflation():
+    """Transport failures open the outage window: the endpoint resolver
+    runs per failed beat, the client is re-pointed, and once the beat
+    lands again the outage closes — with heartbeats_missed NEVER
+    incremented (the satellite contract: an outage must not read as
+    this worker going missing, nor trip stale-sample detectors on
+    reconnect)."""
+    from tony_tpu.executor import Heartbeater
+    from tony_tpu.metrics import HEARTBEATS_MISSED
+
+    class _Client:
+        def __init__(self):
+            self.addr = ("old", 1)
+            self.calls = 0
+
+        def call(self, method, **params):
+            self.calls += 1
+            if self.addr == ("old", 1):
+                raise ConnectionError("driver gone")
+            return True
+
+        def set_address(self, host, port):
+            self.addr = (host, port)
+
+    client = _Client()
+    resolved = []
+
+    def resolver():
+        resolved.append(1)
+        # the 'recovered driver' publishes its endpoint on the 3rd look
+        return ("new", 2) if len(resolved) >= 3 else ("old", 1)
+
+    notes = _Notes()
+    hb = Heartbeater(client, "worker:0", interval_s=0.01,
+                     max_failures=3, monitor=notes,
+                     outage_grace_s=10.0, endpoint_resolver=resolver,
+                     on_outage=lambda: pytest.fail("grace must not expire"))
+    hb.start()
+    deadline = time.time() + 5
+    while client.addr == ("old", 1) and time.time() < deadline:
+        time.sleep(0.01)
+    # wait for a successful beat on the new endpoint (outage closes)
+    deadline = time.time() + 5
+    while hb.in_outage and time.time() < deadline:
+        time.sleep(0.01)
+    hb.stop_event.set()
+    hb.join(timeout=5)
+    assert client.addr == ("new", 2)
+    assert not hb.in_outage and hb.outage_beats >= 3
+    assert hb.missed == 0, "outage beats must not count as missed"
+    assert not [v for n, v in notes.notes if n == HEARTBEATS_MISSED]
+
+
+def test_heartbeater_outage_grace_exhaustion_fires_drain():
+    """A driver that never comes back: on_outage fires once the grace
+    runs dry (the executor checkpoint-drains), on_driver_lost does not,
+    and missed stays 0."""
+    from tony_tpu.executor import Heartbeater
+
+    class _DeadClient:
+        def call(self, method, **params):
+            raise ConnectionError("refused")
+
+        def set_address(self, host, port):
+            pass
+
+    drained = threading.Event()
+    hb = Heartbeater(
+        _DeadClient(), "worker:0", interval_s=0.01, max_failures=3,
+        on_driver_lost=lambda: pytest.fail(
+            "transport outage must not route to on_driver_lost"),
+        outage_grace_s=0.15, endpoint_resolver=lambda: None,
+        on_outage=drained.set)
+    hb.start()
+    assert drained.wait(5), "outage drain never fired"
+    hb.join(timeout=5)
+    assert not hb.is_alive()
+    assert hb.missed == 0
+
+
+def test_heartbeater_refusal_closes_the_outage_window():
+    """An in-contact refusal (RpcError) proves transport is BACK: it
+    must close an open outage window, or a lossy control plane
+    (alternating refused/transport-failed beats) would let one later
+    transport blip 'exhaust' a long-stale grace clock instantly and
+    drain a worker the driver can see (review finding)."""
+    from tony_tpu.executor import Heartbeater
+    from tony_tpu.rpc import RpcError
+
+    seq = {"n": 0}
+
+    class _FlappingClient:
+        def call(self, method, **params):
+            seq["n"] += 1
+            if seq["n"] % 2:
+                raise ConnectionError("transport blip")
+            raise RpcError("refused")       # the driver ANSWERED
+
+        def set_address(self, host, port):
+            pass
+
+    hb = Heartbeater(
+        _FlappingClient(), "worker:0", interval_s=0.02,
+        max_failures=10_000,
+        outage_grace_s=0.2, endpoint_resolver=lambda: None,
+        on_outage=lambda: pytest.fail(
+            "alternating refusal/transport beats must never exhaust "
+            "the outage grace — each refusal resets the clock"))
+    hb.start()
+    time.sleep(1.0)     # ~50 beats: many grace windows' worth
+    alive = hb.is_alive()
+    hb.stop_event.set()
+    hb.join(timeout=5)
+    assert alive, "heartbeater died despite the driver answering"
+    assert hb.missed >= 5       # the refusals still count as missed
+    assert hb.outage_beats >= 5  # ... and the blips rode the window
+    # (no assertion on the FINAL in_outage: it legitimately reflects
+    # whichever half of the flap the last beat landed on)
+
+
+# --------------------------------------------------------------------------
+# router: discovery outage keeps the last-known fleet + stale gauge
+# --------------------------------------------------------------------------
+
+def _stub_replica_http():
+    """A minimal live 'replica': answers /healthz 200 and /stats {}."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b"{}" if self.path.startswith(
+                ("/stats", "/progress")) else b'{"status": "ok"}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_router_discovery_outage_keeps_fleet_and_sets_stale_gauge():
+    """Driver death mid-serving: discovery RAISES (RPC refused) — the
+    router keeps serving its last-known fleet, router_discovery_stale
+    reads 1 on /metrics and stats, and a recovered driver's working
+    discovery clears it."""
+    from tony_tpu.router import FleetRouter
+
+    srv = _stub_replica_http()
+    port = srv.server_address[1]
+    calls = {"mode": "ok"}
+
+    def discover():
+        if calls["mode"] == "dead":
+            raise ConnectionRefusedError("driver.json points at a corpse")
+        return [("replica:0", "127.0.0.1", port)]
+
+    router = FleetRouter([], prefill_chunk=4, seed=0, discover=discover)
+    try:
+        router.health_tick()
+        assert list(router.stats()["replicas"]) == ["replica:0"]
+        assert router.stats()["discovery_stale"] is False
+        assert "router_discovery_stale 0" in router.prometheus_metrics()
+
+        calls["mode"] = "dead"              # the driver is SIGKILLed
+        for _ in range(3):
+            router.health_tick()
+        st = router.stats()
+        assert list(st["replicas"]) == ["replica:0"], (
+            "outage dropped the fleet")
+        assert st["replicas"]["replica:0"]["up"] is True
+        assert st["discovery_stale"] is True
+        assert "router_discovery_stale 1" in router.prometheus_metrics()
+
+        calls["mode"] = "ok"                # recovered driver answers
+        router.health_tick()
+        assert router.stats()["discovery_stale"] is False
+        assert "router_discovery_stale 0" in router.prometheus_metrics()
+    finally:
+        router.shutdown()
+        srv.shutdown()
+        srv.server_close()
+
+
+# --------------------------------------------------------------------------
+# subprocess e2e: real SIGKILL, real executors, --recover entrypoint
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_driver_sigkill_recover_e2e(tmp_path):
+    """The full control-plane death cycle with REAL processes: a
+    2-worker local job, the driver process SIGKILLed mid-job (executors
+    orphaned but alive, riding the outage grace), `python -m
+    tony_tpu.driver --recover` replays the journal in a fresh process,
+    both workers re-adopt, and the job SUCCEEDS with zero task
+    restarts."""
+    from tony_tpu.client import TonyClient
+
+    root = tmp_path
+    steps_file = root / "steps"
+    # a worker that takes ~8s: long enough to span kill + recovery
+    cmd = (f"{sys.executable} -c \""
+           "import time\n"
+           "for i in range(80): time.sleep(0.1)\n"
+           "\"")
+    conf = TonyConf({
+        "tony.staging.dir": str(root / "staging"),
+        "tony.history.location": str(root / "history"),
+        "tony.history.intermediate": str(root / "history/intermediate"),
+        "tony.history.finished": str(root / "history/finished"),
+        "tony.am.monitor-interval-ms": 100,
+        "tony.task.registration-poll-interval-ms": 100,
+        "tony.task.heartbeat-interval-ms": 200,
+        "tony.task.driver-outage-grace-ms": 30000,
+        "tony.worker.instances": 2,
+        "tony.worker.command": cmd,
+        "tony.worker.max-restarts": 1,
+    })
+    client = TonyClient(conf, poll_interval_s=0.2)
+    client.submit()
+    job_dir = Path(client.job_dir)
+    # wait until both workers are registered (journal has the state)
+    deadline = time.time() + 60
+    registered = False
+    while time.time() < deadline and not registered:
+        try:
+            state = load_state(job_dir / c.DRIVER_JOURNAL_FILE)
+            registered = (state is not None and sum(
+                1 for t in state.tasks.values() if t.registered) == 2)
+        except Exception:
+            pass
+        time.sleep(0.2)
+    assert registered, "workers never registered"
+    driver_pid = client._driver_proc.pid
+    os.kill(driver_pid, signal.SIGKILL)
+    client._driver_proc.wait(timeout=10)
+    time.sleep(1.0)     # let the executors notice and enter the outage
+
+    env = {**os.environ}
+    env["PYTHONPATH"] = str(Path(__file__).resolve().parent.parent) + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    rec_log = open(job_dir / "driver.log", "ab")
+    rec = subprocess.Popen(
+        [sys.executable, "-S", "-m", "tony_tpu.driver",
+         "--job-dir", str(job_dir), "--recover"],
+        env=env, stdout=rec_log, stderr=subprocess.STDOUT,
+        start_new_session=True)
+    try:
+        # the recovered driver advertises a bumped generation; poll its
+        # state to terminal through the rewritten driver.json
+        from tony_tpu.rpc.protocol import derive_role_key
+
+        deadline = time.time() + 60
+        final = None
+        while time.time() < deadline and final is None:
+            try:
+                info = json.loads(
+                    (job_dir / c.DRIVER_INFO_FILE).read_text())
+                if info.get("pid") != rec.pid:
+                    time.sleep(0.2)
+                    continue
+                rpc = RpcClient(
+                    info["host"], info["port"],
+                    token=derive_role_key(client.token, "client"),
+                    role="client", max_retries=2)
+                state = rpc.call("get_application_state")
+                if state["status"] in ("SUCCEEDED", "FAILED", "KILLED"):
+                    final = state
+                    rpc.call("finish_application")
+                rpc.close()
+            except Exception:
+                pass
+            time.sleep(0.3)
+        assert final is not None, "recovered driver never went terminal"
+        assert final["status"] == "SUCCEEDED", final
+        rec.wait(timeout=30)
+    finally:
+        if rec.poll() is None:
+            os.killpg(rec.pid, signal.SIGKILL)
+        rec_log.close()
+
+    inter = (root / "history/intermediate" / client.app_id)
+    recs = _last_trace_per_id(inter / TASK_TRACE_FILE)
+    for tid in ("worker:0", "worker:1"):
+        names = [n for n, *_ in recs[tid]["spans"]]
+        assert names[0] == "readopted", names
+        assert names[-1] == "finished", names
+        assert "restarted" not in names, (
+            f"{tid} restarted across the outage: {names}")

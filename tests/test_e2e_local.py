@@ -1075,9 +1075,13 @@ def test_driver_crash_reported_to_client(tmp_job_dirs, fixture_script):
 
 
 def test_executor_dies_with_driver(tmp_job_dirs, fixture_script):
-    """Executors must not outlive a hard-killed driver: the heartbeater's
-    driver-loss watchdog kills the user process and exits (the role YARN
-    plays in the reference by reaping a dead AM's containers)."""
+    """Executors must not outlive a hard-killed driver PAST THE OUTAGE
+    GRACE: since the control-plane recovery work (ISSUE 12), a driver
+    transport outage is first ridden for tony.task.driver-outage-grace-ms
+    (the window a `--recover` relaunch re-adopts through — executors
+    keep working and re-resolve driver.json); only when no recovered
+    driver appears do they drain the user process and exit (the role
+    YARN plays in the reference by reaping a dead AM's containers)."""
     import signal as _signal
     import subprocess
 
@@ -1087,7 +1091,10 @@ def test_executor_dies_with_driver(tmp_job_dirs, fixture_script):
             **{"tony.worker.instances": 1,
                "tony.worker.command": f"{PY} {fixture_script('sleep_long.py')}",
                "tony.task.heartbeat-interval-ms": 100,
-               "tony.task.max-missed-heartbeats": 5},
+               "tony.task.max-missed-heartbeats": 5,
+               # short grace: this test IS the no-recovery-arrived path
+               "tony.task.driver-outage-grace-ms": 1500,
+               "tony.task.preempt-grace-ms": 1500},
         ),
         poll_interval_s=0.1,
     )
@@ -1105,8 +1112,15 @@ def test_executor_dies_with_driver(tmp_job_dirs, fixture_script):
     executors = _job_executors(client.app_id)
     assert executors, "no executor process found"
     os.kill(client._driver_proc.pid, _signal.SIGKILL)
-    # watchdog: 5 missed beats at 100ms + fast-fail rpc -> seconds, not minutes
-    deadline = time.time() + 20
+    t_kill = time.time()
+    # the executor must SURVIVE the early outage window (a recovered
+    # driver would re-adopt it here) ...
+    time.sleep(0.8)
+    assert _job_executors(client.app_id), (
+        "executor gave up inside the outage grace")
+    # ... then drain and exit once the grace (1.5s) + the child's drain
+    # window run dry — seconds, not minutes
+    deadline = t_kill + 20
     while time.time() < deadline and _job_executors(client.app_id):
         time.sleep(0.5)
     leftover = _job_executors(client.app_id)

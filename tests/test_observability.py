@@ -659,6 +659,16 @@ def test_metrics_names_rendered_and_documented():
         assert fam in rendered, f"replay family unrendered: {fam}"
         assert fam in doc_names, f"replay family undocumented: {fam}"
 
+    # the control-plane-recovery families are pinned EXPLICITLY the
+    # same way (ISSUE 12 lint discipline): each must be rendered by an
+    # endpoint (driver /metrics, router /metrics) and documented —
+    # renaming either side without the other fails here
+    for fam in (_metrics.DRIVER_RECOVERIES_TOTAL,
+                _metrics.DRIVER_TASKS_READOPTED_TOTAL,
+                _metrics.ROUTER_DISCOVERY_STALE):
+        assert fam in rendered, f"recovery family unrendered: {fam}"
+        assert fam in doc_names, f"recovery family undocumented: {fam}"
+
 
 def test_finish_reason_vocabulary_pinned():
     """Lint over the finish_reason vocabulary, both directions: the

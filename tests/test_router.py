@@ -657,10 +657,20 @@ def test_discovery_sync_moves_and_drops_replicas(stubs):
     assert st["replica:0"]["endpoint"].endswith(str(b.port))
     router.generate([1, 2, 3, 4], max_new_tokens=1, timeout_s=5)
     assert len(b.received) == 1 and not a.received
-    # mid-restart the driver clears ports: the replica drops out
+    # mid-restart the driver clears ports — but an EMPTY fleet while the
+    # replica still answers its own probes is DISTRUSTED for the
+    # discovery grace (a dead/recovering driver must not drop a serving
+    # fleet; ISSUE 12), then honored once the driver insists
     router.discover = lambda: []
+    router.discovery_grace_s = 0.05
+    router.health_tick()
+    st = router.stats()
+    assert st["discovery_stale"] is True
+    assert list(st["replicas"]) == ["replica:0"]
+    time.sleep(0.06)
     router.health_tick()
     assert router.stats()["replicas"] == {}
+    assert router.stats()["discovery_stale"] is False
 
 
 # --------------------------------------------------------------------------

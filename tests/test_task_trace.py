@@ -543,13 +543,17 @@ def test_task_monitor_push_carries_spans_child_status_and_steps(tmp_path):
 
 def test_heartbeater_jitter_and_missed_counter():
     """The heartbeat wait is jittered (never exactly the base interval,
-    bounded ±10%) and failed beats feed the monitor's missed counter."""
+    bounded ±10%) and REFUSED beats (the driver answered and said no —
+    an RpcError, not a transport failure, which since the control-plane
+    recovery work rides the driver-outage grace instead) feed the
+    monitor's missed counter."""
     from tony_tpu.executor import Heartbeater
     from tony_tpu.metrics import HEARTBEATS_MISSED
+    from tony_tpu.rpc import RpcError
 
     class _FailingClient:
         def call(self, method, **params):
-            raise ConnectionError("driver gone")
+            raise RpcError("heartbeat refused")
 
     class _Notes:
         def __init__(self):

@@ -194,6 +194,45 @@ def test_standby_self_reaps_on_pool_dir_removal(tmp_path):
     _wait_dead(info["pid"])
 
 
+def test_standby_survives_driver_restart_via_driver_json(tmp_path):
+    """Control-plane recovery keeps the pool WARM: a standby watching a
+    driver pid does not self-reap the moment that pid dies — it rides
+    the outage grace, re-resolves the RECOVERED driver's pid from the
+    rewritten driver.json, and keeps standing by (ISSUE 12). Removing
+    its pool entry still reaps it (the normal teardown contract)."""
+    import subprocess
+
+    driver_json = tmp_path / "driver.json"
+    # 'driver' incarnation 1: a short-lived real process
+    proc = subprocess.Popen([PY, "-c", "import time; time.sleep(2)"])
+    driver_json.write_text(json.dumps(
+        {"host": "127.0.0.1", "port": 1, "pid": proc.pid,
+         "driver_generation": 0}))
+    pool = WarmPool(tmp_path / "pool", size=1,
+                    watch_pid=proc.pid, driver_json=str(driver_json),
+                    outage_grace_s=20.0)
+    pool.ensure()
+    _wait_ready(pool.dir, 1)
+    info = json.loads(next(pool.dir.glob("sb_*.json")).read_text())
+    proc.kill()
+    proc.wait()
+    # the 'recovered' driver rewrites driver.json with ITS pid (use this
+    # test process: provably alive and local)
+    driver_json.write_text(json.dumps(
+        {"host": "127.0.0.1", "port": 1, "pid": os.getpid(),
+         "driver_generation": 1}))
+    # old behavior self-reaped within one ~1s poll; the standby must now
+    # outlive the watched pid's death by several polls
+    time.sleep(3.0)
+    assert _pid_alive(info["pid"]), (
+        "standby self-reaped across a recoverable driver restart")
+    assert count_ready(pool.dir) == 1
+    # normal teardown still works: entry gone -> standby exits
+    for p in pool.dir.glob("sb_*.json"):
+        p.unlink()
+    _wait_dead(info["pid"])
+
+
 def test_reap_kills_standbys_and_removes_dir(tmp_path):
     pool = WarmPool(tmp_path / "pool", size=2)
     pool.ensure()

@@ -167,8 +167,9 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=19886)
     p.set_defaults(fn=cmd_history)
 
-    # `serve`/`route` own rich argparsers of their own (cli/serve.py,
-    # router.py); hand the remaining argv through untouched
+    # `serve`/`route`/`driver` own rich argparsers of their own
+    # (cli/serve.py, router.py, driver.py); hand the remaining argv
+    # through untouched
     sub.add_parser(
         "serve", add_help=False,
         help="serve a model over HTTP with continuous batching",
@@ -176,6 +177,12 @@ def main(argv=None) -> int:
     sub.add_parser(
         "route", add_help=False,
         help="front a serving fleet with the prefix-aware router",
+    )
+    sub.add_parser(
+        "driver", add_help=False,
+        help="run a job driver in place; `driver --recover --job-dir D` "
+             "replays D/driver.journal.jsonl and re-adopts a dead "
+             "driver's live tasks",
     )
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
@@ -186,6 +193,10 @@ def main(argv=None) -> int:
         from .. import router as router_mod
 
         return router_mod.main(argv[1:])
+    if argv and argv[0] == "driver":
+        from .. import driver as driver_mod
+
+        return driver_mod.main(argv[1:])
 
     args = parser.parse_args(argv)
     return args.fn(args)

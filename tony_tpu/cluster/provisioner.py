@@ -24,6 +24,7 @@ import signal
 import subprocess
 import sys
 import threading
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable
@@ -147,31 +148,79 @@ class LocalProvisioner(Provisioner):
             except Exception:
                 log.exception("completion callback failed for %s", handle.container_id)
 
+    def adopt_container(self, container_id: str, host: str, role: str,
+                        index: int, pid: int,
+                        log_path: str = "") -> ContainerHandle:
+        """Re-adopt a PREVIOUS driver incarnation's executor by pid
+        (control-plane recovery, events/driver_journal.py): a
+        Popen-less handle whose process this provisioner never spawned.
+        Deliberately no watcher thread — a non-child pid has no
+        waitable exit status; the re-adopted task's authoritative
+        completion is its executor's own register_execution_result (the
+        recovered driver routes it through the container path), and a
+        silently dead orphan is detected by heartbeat expiry. Signals
+        still work: the executor runs in its own session, so its pid is
+        its process-group id."""
+        handle = ContainerHandle(
+            container_id=container_id, host=host, role=role, index=index,
+            process=None,
+            extra={"adopted": True, "pid": int(pid), "log_path": log_path},
+        )
+        with self._lock:
+            self._handles[container_id] = handle
+        return handle
+
+    @staticmethod
+    def _group_pid(handle: ContainerHandle) -> int:
+        """The process-group id to signal: the spawned child's pid, or a
+        re-adopted handle's journaled pid (0 = nothing to signal). Both
+        kinds were started with start_new_session, so pid == pgid."""
+        if handle.process is not None:
+            return handle.process.pid if handle.process.poll() is None else 0
+        pid = handle.extra.get("pid", 0)
+        if not isinstance(pid, int) or pid <= 0:
+            return 0
+        from ..warmpool import _pid_alive
+
+        return pid if _pid_alive(pid) else 0
+
     def stop_container(self, handle: ContainerHandle) -> None:
-        proc = handle.process
-        if proc is None or proc.poll() is not None:
+        pid = self._group_pid(handle)
+        if not pid:
             return
         try:
-            os.killpg(proc.pid, signal.SIGTERM)
+            os.killpg(pid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
             return
-        try:
-            proc.wait(timeout=self.stop_wait_s)
-        except subprocess.TimeoutExpired:
+        if handle.process is not None:
             try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
+                handle.process.wait(timeout=self.stop_wait_s)
+                return
+            except subprocess.TimeoutExpired:
                 pass
+        else:
+            # adopted (non-child) pid: poll liveness for the same grace
+            from ..warmpool import _pid_alive
+
+            deadline = time.monotonic() + self.stop_wait_s
+            while time.monotonic() < deadline:
+                if not _pid_alive(pid):
+                    return
+                time.sleep(0.05)
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
     def kill_container(self, handle: ContainerHandle) -> None:
         """SIGKILL the whole process group immediately (abrupt host
         death for the chaos harness); the watcher thread reports the
         completion like any crash."""
-        proc = handle.process
-        if proc is None or proc.poll() is not None:
+        pid = self._group_pid(handle)
+        if not pid:
             return
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
+            os.killpg(pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
 

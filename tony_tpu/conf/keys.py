@@ -51,6 +51,15 @@ TASK_MAX_TOTAL_INSTANCES = "tony.task.max-total-instances"
 # an executor-received SIGTERM): how long the executor gives the training
 # child to checkpoint at a step boundary before killing it
 TASK_PREEMPT_GRACE_MS = "tony.task.preempt-grace-ms"
+# driver-outage window (docs/training-robustness.md "Control-plane
+# recovery"): how long an executor whose heartbeat RPCs fail at the
+# TRANSPORT level keeps its training child stepping — re-resolving the
+# driver endpoint from the rewritten driver.json each beat — before it
+# gives up, checkpoint-drains the child, and exits. Warm-pool standbys
+# honor the same window before self-reaping on a dead watched driver
+# pid. In-contact refusals (the driver answered and said no) stay on
+# the max-missed-heartbeats budget.
+TASK_DRIVER_OUTAGE_GRACE_MS = "tony.task.driver-outage-grace-ms"
 TASK_MAX_TOTAL_MEMORY_MB = "tony.task.max-total-memory-mb"
 TASK_MAX_TOTAL_CHIPS = "tony.task.max-total-chips"
 

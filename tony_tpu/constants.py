@@ -48,6 +48,16 @@ ENV_GANG_GENERATION = "TONY_GANG_GENERATION"  # which gang formation this
                                   # budget / capacity returned), so a
                                   # training child can label its stream
                                   # and tooling can tell formations apart
+ENV_TASK_ATTEMPT = "TONY_TASK_ATTEMPT"  # monotonically increasing launch
+                                  # ordinal of this task attempt; echoed
+                                  # back on register_worker so a recovered
+                                  # driver's generation fence can refuse a
+                                  # superseded attempt's zombie executor
+ENV_DRIVER_GENERATION = "TONY_DRIVER_GENERATION"  # which driver
+                                  # incarnation launched this attempt:
+                                  # bumped by every control-plane recovery
+                                  # (driver.journal.jsonl replay), also
+                                  # advertised in driver.json
 
 # JAX runtime contract (replaces TF_CONFIG/Gloo/DMLC matrix — SURVEY.md §5):
 ENV_COORDINATOR_ADDRESS = "TONY_COORDINATOR_ADDRESS"
@@ -67,6 +77,14 @@ MEGASCALE_PORT = 8080                     # libtpu's default coordinator port
 # ---- well-known files in the job dir
 DRIVER_INFO_FILE = "driver.json"          # driver's rpc endpoint, written at prepare
                                           # (plays the YARN app-report role for the client)
+DRIVER_JOURNAL_FILE = "driver.journal.jsonl"  # control-plane journal
+                                          # (events/driver_journal.py): the
+                                          # authoritative state a restarted
+                                          # driver replays to re-adopt live
+                                          # tasks (`tony-tpu driver --recover`)
+                                          # — the reproduction of YARN's
+                                          # keep-containers-across-attempts
+                                          # AM recovery
 
 # on-demand profiler capture flag file (docs/observability.md "Device
 # timing & profiling"): the executor writes `$TONY_STEP_LOG<suffix>`
@@ -137,6 +155,12 @@ TEST_DRIVER_PREEMPT_AT_STEP = "TONY_TEST_DRIVER_PREEMPT_AT_STEP"
 TEST_DRIVER_HEARTBEAT_DROP_RATE = "TONY_TEST_DRIVER_HEARTBEAT_DROP_RATE"
 #   probability that an incoming heartbeat RPC errors instead of being
 #   recorded — a lossy control plane; exercises liveness margins
+TEST_DRIVER_SIGKILL_AT_STEP = "TONY_TEST_DRIVER_SIGKILL_AT_STEP"
+#   once the gang's max observed training step (pushed StepTimer
+#   metrics) reaches N, the DRIVER SIGKILLs itself — the control-plane
+#   death injection behind `bench.py --driver-failover`: executors ride
+#   their outage grace, `--recover` re-adopts them, and the job must
+#   still SUCCEED with zero outage-attributable worker restarts
 TEST_DRIVER_CHAOS_SEED = "TONY_TEST_DRIVER_CHAOS_SEED"
 TEST_WARMPOOL_SKIP_WARMUP = "TONY_TEST_WARMPOOL_SKIP_WARMUP"
 #   standbys skip the jax import/backend warmup (tests: a blank standby

@@ -37,6 +37,7 @@ from .events.types import (
     task_started,
     task_trace,
 )
+from .events.driver_journal import DriverJournal, DriverState, load_state
 from .metrics import (
     DRIVER_CHECKPOINT_AGE_S,
     DRIVER_GANG_LAUNCH_SECONDS,
@@ -44,6 +45,7 @@ from .metrics import (
     DRIVER_HEARTBEAT_EXPIRED_TOTAL,
     DRIVER_HEARTBEAT_INTERVAL_SECONDS,
     DRIVER_PREEMPTIONS_TOTAL,
+    DRIVER_RECOVERIES_TOTAL,
     DRIVER_STRAGGLER_HEARTBEAT_S,
     DRIVER_STRAGGLER_REGISTRATION_S,
     DRIVER_TASK_METRIC,
@@ -51,6 +53,7 @@ from .metrics import (
     DRIVER_TASK_ROLLS_TOTAL,
     DRIVER_TASK_SERVICE_PORT,
     DRIVER_TASKS,
+    DRIVER_TASKS_READOPTED_TOTAL,
     DRIVER_WARM_POOL_ADOPTIONS_TOTAL,
     DRIVER_WARM_POOL_MISSES_TOTAL,
     DRIVER_WARM_POOL_SIZE,
@@ -61,6 +64,15 @@ from .scheduler import TaskScheduler
 from .session import Session
 
 log = logging.getLogger(__name__)
+
+
+def _handle_pid(handle: ContainerHandle) -> int:
+    """The executor pid behind a container handle (0 = unknown): a
+    spawned handle's Popen pid, or a re-adopted handle's journaled pid."""
+    if handle.process is not None:
+        return handle.process.pid
+    pid = handle.extra.get("pid", 0)
+    return pid if isinstance(pid, int) else 0
 
 
 def _lag_stats(rel: list[float]) -> dict[str, float]:
@@ -80,11 +92,25 @@ class DriverService:
         self._d = driver
 
     # ------------------------------------------------------------- executors
-    def register_worker(self, task_id: str, host: str, port: int):
+    def register_worker(self, task_id: str, host: str, port: int,
+                        attempt: int = -1):
         d = self._d
+        # attempt fence: a superseded attempt's zombie executor (orphaned
+        # across a driver recovery, or lingering past its SIGTERM grace)
+        # must not register itself over the replacement the current
+        # driver launched. ``attempt`` echoes the launch env's
+        # TONY_TASK_ATTEMPT; -1 (absent) skips the fence for executors
+        # that predate it.
+        if attempt >= 0:
+            current = d._attempts.get(task_id)
+            if current is not None and attempt != current:
+                raise ValueError(
+                    f"stale attempt {attempt} of {task_id}: the current "
+                    f"attempt is {current} (zombie registration refused)")
         task = d.session.register_task(task_id, host, port)
         if task is None:
             raise ValueError(f"unknown task {task_id}")
+        d._jrec("register", task=task_id, host=host, port=port)
         d.heartbeats[task_id] = time.time()
         d._on_task_registered(task_id)
         log.info("registered %s at %s:%s (%d/%d)", task_id, host, port,
@@ -253,6 +279,7 @@ class Driver:
         token: str = "",
         user: str = "",
         provisioner: Provisioner | None = None,
+        rpc_port: int = 0,
     ):
         self.conf = conf
         self.app_id = app_id
@@ -309,15 +336,43 @@ class Driver:
                    "request_task_profile": {"client"},
                    "roll_task": {"client"},
                    "preempt_task": {"client"}}
-        self.rpc_server = RpcServer(
-            host=str(conf.get(keys.AM_RPC_HOST, "127.0.0.1")), token=token,
-            roles=roles, acl=acl,
-        )
+        rpc_host = str(conf.get(keys.AM_RPC_HOST, "127.0.0.1"))
+        try:
+            # recovery asks for the journaled port back so clients that
+            # cached the old endpoint reconnect without re-resolving;
+            # executors re-resolve driver.json either way
+            self.rpc_server = RpcServer(
+                host=rpc_host, port=rpc_port, token=token,
+                roles=roles, acl=acl,
+            )
+        except OSError as e:
+            if rpc_port == 0:
+                raise
+            log.warning("could not rebind recovered RPC port %d (%s); "
+                        "taking an ephemeral port — executors re-resolve "
+                        "driver.json", rpc_port, e)
+            self.rpc_server = RpcServer(
+                host=rpc_host, port=0, token=token, roles=roles, acl=acl,
+            )
         self.rpc_server.register_service(DriverService(self))
         self.events: EventHandler | None = None
         self._handles: dict[str, ContainerHandle] = {}  # task_id -> handle
         self._launch_ms: dict[str, int] = {}            # task_id -> launch time
         self._restarts: dict[str, int] = {}             # task_id -> restarts used
+        # ---- control-plane journal + recovery (events/driver_journal.py,
+        # docs/training-robustness.md "Control-plane recovery") ----
+        # per-task launch ordinal (monotonic across budget-free relaunches
+        # too, unlike _restarts): echoed back on register_worker so a
+        # superseded attempt's zombie executor is refused by the fence.
+        # driver_generation counts this job's driver incarnations; a
+        # recovered driver bumps it, rewrites driver.json with it, and
+        # stamps it into every relaunch env.
+        self._attempts: dict[str, int] = {}
+        self._journal: DriverJournal | None = None
+        self._recovered_state: DriverState | None = None
+        self.driver_generation = 0
+        self._recoveries = 0            # driver_recoveries_total
+        self._readopted = 0             # driver_tasks_readopted_total
         # serializes the restart/preempt/resize paths — container
         # completion (watcher threads), heartbeat expiry (monitor
         # thread), and elastic resize — so a crash that coincides with
@@ -422,21 +477,29 @@ class Driver:
 
         self._chaos_kill_rate = _rate(c.TEST_DRIVER_KILL_RATE)
         self._chaos_hb_drop = _rate(c.TEST_DRIVER_HEARTBEAT_DROP_RATE)
-        try:
-            self._chaos_preempt_at = int(
-                os.environ.get(c.TEST_DRIVER_PREEMPT_AT_STEP, "0"))
-        except ValueError:
-            log.error("bad %s value; chaos knob disabled",
-                      c.TEST_DRIVER_PREEMPT_AT_STEP)
-            self._chaos_preempt_at = 0
+
+        def _at_step(name):
+            try:
+                return int(os.environ.get(name, "0"))
+            except ValueError:
+                log.error("bad %s value; chaos knob disabled", name)
+                return 0
+
+        self._chaos_preempt_at = _at_step(c.TEST_DRIVER_PREEMPT_AT_STEP)
         self._chaos_preempt_fired = False
+        # driver suicide keyed off the gang's pushed train step — the
+        # control-plane death injection behind bench.py --driver-failover
+        self._chaos_sigkill_at = _at_step(c.TEST_DRIVER_SIGKILL_AT_STEP)
+        self._chaos_sigkill_fired = False
         self._chaos_rng = _random.Random(
             int(os.environ.get(c.TEST_DRIVER_CHAOS_SEED, "0") or 0))
-        if self._chaos_kill_rate or self._chaos_hb_drop or self._chaos_preempt_at:
+        if (self._chaos_kill_rate or self._chaos_hb_drop
+                or self._chaos_preempt_at or self._chaos_sigkill_at):
             log.warning(
                 "driver chaos armed: kill_rate=%s hb_drop=%s "
-                "preempt_at_step=%s", self._chaos_kill_rate,
-                self._chaos_hb_drop, self._chaos_preempt_at)
+                "preempt_at_step=%s sigkill_at_step=%s",
+                self._chaos_kill_rate, self._chaos_hb_drop,
+                self._chaos_preempt_at, self._chaos_sigkill_at)
         # compile visibility for code running IN the driver process
         # (enable-preprocess / notebook jobs): the driver's /metrics
         # carries its own compile histogram next to the compile totals
@@ -490,7 +553,11 @@ class Driver:
         import json
 
         info = {"host": self.rpc_server.address[0], "port": self.rpc_server.port,
-                "app_id": self.app_id, "pid": os.getpid()}
+                "app_id": self.app_id, "pid": os.getpid(),
+                # consumers (executors riding an outage, warm-pool
+                # standbys, router discovery) use the generation bump to
+                # tell "the same driver" from "its recovered successor"
+                "driver_generation": self.driver_generation}
         self._task_trace_writer = TraceWriter(
             self.events.job_dir, filename=TASK_TRACE_FILE)
         self._start_metrics_server()
@@ -499,6 +566,18 @@ class Driver:
         tmp = self.job_dir / (c.DRIVER_INFO_FILE + ".tmp")
         tmp.write_text(json.dumps(info))
         tmp.rename(self.job_dir / c.DRIVER_INFO_FILE)
+        # control-plane journal: opened append (recovery compacted it
+        # before construction), meta re-stamped last-wins so the journal
+        # always names the CURRENT endpoint + generation
+        self._journal = DriverJournal(self.job_dir / c.DRIVER_JOURNAL_FILE)
+        self._jrec("meta", app_id=self.app_id, token=self.token,
+                   session_id=self.session.session_id,
+                   rpc_port=self.rpc_server.port,
+                   driver_generation=self.driver_generation)
+        if self._recovered_state is not None:
+            self._jrec("recovered",
+                       driver_generation=self.driver_generation,
+                       t=time.time())
         # seed the warm pool on THIS host for local capacity: standbys
         # prepay the jax/backend bill while the first gang launches, so
         # even the first relaunch adopts. Remote hosts seed their own
@@ -538,6 +617,39 @@ class Driver:
         self.scheduler = TaskScheduler(
             self.conf, list(self.session.role_specs.values()), self._request_role
         )
+        if self._recovered_state is not None:
+            # roles the dead driver already launched must not be
+            # re-requested wholesale (their tasks were re-adopted or are
+            # being relaunched one at a time through the expiry path);
+            # journaled completions replay into the DAG so dependents of
+            # finished roles still get scheduled
+            launched = {tid.partition(":")[0]
+                        for tid, rec in self._recovered_state.tasks.items()
+                        if rec.attempt > 0}
+            self.scheduler.restore(launched)
+            for tid, rec in self._recovered_state.tasks.items():
+                if rec.terminal:
+                    self.scheduler.on_task_completed(
+                        tid.partition(":")[0], rec.exit_code == 0)
+            # a role can be PARTIALLY launched (the driver died inside
+            # _request_role): its journaled tasks were restored, but a
+            # never-journaled sibling has no liveness entry, no
+            # registration-timeout entry, and — with the role marked
+            # scheduled — no request coming either. Launch the missing
+            # instances individually or the gang barrier waits forever.
+            for role in sorted(launched):
+                spec = self.session.role_specs.get(role)
+                if spec is None:
+                    continue
+                for task in self.session.tasks.get(role, []):
+                    rec = self._recovered_state.tasks.get(task.task_id)
+                    if ((rec is None or rec.attempt == 0)
+                            and not task.status.is_terminal()):
+                        log.warning(
+                            "recovery: %s of partially-launched role %s "
+                            "was never launched by the dead driver; "
+                            "launching it now", task.task_id, role)
+                        self._relaunch_task(task.task_id, spec, task.index)
         self.scheduler.schedule()
 
     def _run_in_driver(self, spec: RoleSpec) -> None:
@@ -583,6 +695,8 @@ class Driver:
                          task.task_id)
                 continue
             env = self._task_env(spec, index)
+            env[c.ENV_TASK_ATTEMPT] = str(
+                self._bump_attempt(task.task_id))
             # launch + handle publication are atomic vs the completion
             # callback (which takes the same lock): a container that
             # exits faster than this thread stores its handle would
@@ -597,6 +711,7 @@ class Driver:
                 )
                 self._handles[task.task_id] = handle
             self.session.note_allocated(task.task_id, handle.container_id)
+            self._journal_launch(task.task_id, handle)
             self._trace_mark(task.task_id, "allocated", host=handle.host)
             task.host = handle.host
             # per-task log URL, surfaced to the client and portal (reference
@@ -628,6 +743,7 @@ class Driver:
             # spec at barrier time)
             c.ENV_NUM_TOTAL_TASKS: str(len(self.session.active_tasks())),
             c.ENV_GANG_GENERATION: str(self.session.gang_generation),
+            c.ENV_DRIVER_GENERATION: str(self.driver_generation),
             c.ENV_IS_CHIEF: str(self.session.is_chief(spec.name, index)).lower(),
             c.ENV_SESSION_ID: str(self.session.session_id),
             c.ENV_DISTRIBUTED_MODE: self.mode.value,
@@ -666,6 +782,14 @@ class Driver:
                 k, v = kv.split("=", 1)
                 env[k] = v
         return env
+
+    # -------------------------------------------------- control-plane journal
+    def _jrec(self, op: str, **fields) -> None:
+        """Best-effort journal append (no-op before prepare / after
+        close): the journal must never be able to take the driver
+        down."""
+        if self._journal is not None:
+            self._journal.record(op, **fields)
 
     # ------------------------------------------------------- task telemetry
     def _trace_mark(self, task_id: str, span: str, **attrs) -> None:
@@ -984,6 +1108,12 @@ class Driver:
             r.counter(DRIVER_WARM_POOL_MISSES_TOTAL, self._warm_misses,
                       "launches with the warm pool configured that fell "
                       "back to a cold spawn")
+            r.counter(DRIVER_RECOVERIES_TOTAL, self._recoveries,
+                      "driver restarts that recovered this job's "
+                      "control plane from driver.journal.jsonl")
+            r.counter(DRIVER_TASKS_READOPTED_TOTAL, self._readopted,
+                      "live tasks a recovered driver re-adopted "
+                      "(heartbeats re-attached) instead of relaunching")
             reg = dict(self._reg_t)
         from .warmpool import count_ready
 
@@ -1115,6 +1245,16 @@ class Driver:
             # the reference's HB-unregister handling covers, AM:1075-1087)
             task.exit_code = exit_code
             self.heartbeats.pop(task_id, None)
+            # ...EXCEPT for a RE-ADOPTED container (driver recovery): the
+            # old driver's Popen watcher died with it, so no container
+            # callback will ever come — the executor's own report IS the
+            # completion. Run it through the container path under the
+            # restart lock, like the watcher would have.
+            with self._restart_lock:
+                handle = self._handles.get(task_id)
+                if handle is not None and handle.extra.get("adopted"):
+                    self.on_task_result(task_id, exit_code,
+                                        source="container")
             return
         if (
             source == "container"
@@ -1144,6 +1284,8 @@ class Driver:
         name, _, idx = task_id.partition(":")
         self.session.on_task_completed(name, int(idx), exit_code)
         if not already_terminal:
+            self._jrec("terminal", task=task_id, status=task.status.value,
+                       exit_code=exit_code)
             self._seal_task_trace(
                 task_id, "finished" if exit_code == 0 else "failed",
                 exit_code=exit_code, status=task.status.value)
@@ -1180,6 +1322,7 @@ class Driver:
         self._resizes.discard(task_id)
         self._straggler_strikes.pop(task_id, None)
         self._restarts[task_id] = used + 1
+        self._jrec("restarts", task=task_id, used=used + 1)
         log.warning(
             "task %s %s; restarting (%d/%d)",
             task_id, cause or f"exited {exit_code}",
@@ -1195,6 +1338,22 @@ class Driver:
                          last_cause=cause or f"exited {exit_code}")
         self._relaunch_task(task_id, spec, int(idx))
         return True
+
+    def _bump_attempt(self, task_id: str) -> int:
+        """Next launch ordinal for a task — stamped into the attempt's
+        env and journaled with the launch, so zombie registrations from
+        superseded attempts are refusable by number."""
+        att = self._attempts.get(task_id, 0) + 1
+        self._attempts[task_id] = att
+        return att
+
+    def _journal_launch(self, task_id: str, handle: ContainerHandle) -> None:
+        self._jrec("launch", task=task_id,
+                   attempt=self._attempts.get(task_id, 0),
+                   container_id=handle.container_id,
+                   pid=_handle_pid(handle), host=handle.host,
+                   t=time.time(),
+                   log_path=str(handle.extra.get("log_path", "")))
 
     def _relaunch_task(self, task_id: str, spec: RoleSpec, idx: int) -> None:
         """Launch a fresh attempt of an existing task (restart or roll):
@@ -1216,6 +1375,7 @@ class Driver:
         task.launch_path = ""   # the NEW attempt reports its own path
         self._trace_mark(task_id, "requested")
         env = self._task_env(spec, idx)
+        env[c.ENV_TASK_ATTEMPT] = str(self._bump_attempt(task_id))
         # same launch/handle atomicity as _request_role (reentrant: the
         # discharge paths already hold the lock)
         with self._restart_lock:
@@ -1223,6 +1383,7 @@ class Driver:
                 spec, idx, env, self.job_dir / "logs")
             self._handles[task_id] = handle
         self.session.note_allocated(task_id, handle.container_id)
+        self._journal_launch(task_id, handle)
         self._trace_mark(task_id, "allocated", host=handle.host)
         self._launch_ms[task_id] = now_ms()
         self._trace_mark(task_id, "launched")
@@ -1236,6 +1397,8 @@ class Driver:
         session entry and record them on its lifecycle trace."""
         if not self.session.set_task_ports(task_id, ports):
             return False
+        self._jrec("ports", task=task_id,
+                   ports={str(k): int(v) for k, v in (ports or {}).items()})
         with self._tt_lock:
             tr = self.task_traces.get(task_id)
             if tr is not None:
@@ -1265,6 +1428,7 @@ class Driver:
             if handle is None:
                 return False
             self._rolls.add(task_id)
+        self._jrec("ledger", kind="roll", task=task_id)
         log.info("rolling %s (SIGTERM drain, budget-free relaunch)", task_id)
         # the stop can wait several seconds on a slow drain; do it off the
         # RPC thread so the caller gets its ack immediately
@@ -1309,6 +1473,7 @@ class Driver:
             first = task_id not in self._preempts
             self._preempts.add(task_id)
             self._preempt_cmds.add(task_id)
+        self._jrec("ledger", kind="preempt", task=task_id, cmd=True)
         if first:
             with self._tt_lock:
                 self._preempt_count += 1
@@ -1334,6 +1499,7 @@ class Driver:
                 return True
             first = task_id not in self._preempts
             self._preempts.add(task_id)
+        self._jrec("ledger", kind="preempt", task=task_id, cmd=False)
         if first:
             with self._tt_lock:
                 self._preempt_count += 1
@@ -1446,6 +1612,10 @@ class Driver:
             # the straggler ledger is attempt-scoped: a drained survivor
             # must not inherit its predecessor's strikes
             self._straggler_strikes.clear()
+        self._jrec("detach", task=task_id)
+        self._jrec("generation", gen=gen)
+        for tid in survivors:
+            self._jrec("ledger", kind="resize", task=tid)
         log.warning(
             "elastic resize DOWN to generation %d: %s lost (%s); draining "
             "%d survivors to re-form at the smaller world size",
@@ -1537,6 +1707,10 @@ class Driver:
                 if h is not None:
                     handles.append(h)
             self._straggler_strikes.clear()
+        self._jrec("reattach", task=task_id)
+        self._jrec("generation", gen=gen)
+        for tid in survivors:
+            self._jrec("ledger", kind="resize", task=tid)
         log.warning(
             "elastic resize UP to generation %d: re-adding %s; draining "
             "%d survivors to re-form at the restored world size",
@@ -1559,6 +1733,7 @@ class Driver:
             with self._restart_lock:
                 self.session.detach_task(task_id)
                 self._detach_t[task_id] = time.monotonic()
+            self._jrec("detach", task=task_id)
         for h in handles:
             threading.Thread(target=self.provisioner.stop_container,
                              args=(h,), name=f"resize-drain-{h.role}",
@@ -1660,6 +1835,9 @@ class Driver:
                     self.session._fail(msg)
                     self.session.on_task_completed(
                         task.name, task.index, c.EXIT_KILLED)
+                    self._jrec("terminal", task=task_id,
+                               status=task.status.value,
+                               exit_code=c.EXIT_KILLED)
 
             # 2b. straggler action: a worker whose step p50 lags the
             # gang median beyond the configured factor is restarted
@@ -1825,6 +2003,21 @@ class Driver:
                 log.warning("chaos: SIGKILLing %s (%s)", victim,
                             handle.container_id)
                 self.provisioner.kill_container(handle)
+        if self._chaos_sigkill_at and not self._chaos_sigkill_fired:
+            steps = [self._pushed_metric(t.task_id, f"max_{TRAIN_STEP}")
+                     for t in self.session.active_tasks()]
+            top = max((s for s in steps if s is not None), default=0)
+            if top >= self._chaos_sigkill_at:
+                self._chaos_sigkill_fired = True
+                import signal as _signal
+
+                log.error("chaos: driver SIGKILLing ITSELF at observed "
+                          "step %d — recover with `tony-tpu driver "
+                          "--recover --job-dir %s`", int(top), self.job_dir)
+                # a real SIGKILL, not os._exit: the signal path is what
+                # production sees, and nothing below may run (no stop(),
+                # no container teardown — that asymmetry is the point)
+                os.kill(os.getpid(), _signal.SIGKILL)
         if (self._chaos_preempt_at and not self._chaos_preempt_fired):
             steps = [self._pushed_metric(t.task_id, f"max_{TRAIN_STEP}")
                      for t in self.session.active_tasks()]
@@ -1871,6 +2064,163 @@ class Driver:
         with self._profile_lock:
             return self._profile_cmds.pop(task_id, None)
 
+    # ------------------------------------------------- control-plane recovery
+    @classmethod
+    def recover(cls, job_dir: str, provisioner: Provisioner | None = None,
+                app_id: str = "") -> "Driver":
+        """Build a replacement driver from a dead one's journal — the
+        reproduction of YARN AM restart with
+        ``keep-containers-across-application-attempts``: replay
+        ``driver.journal.jsonl``, rebind RPC (the journaled port when
+        still free), bump ``driver_generation``, and RE-ADOPT the live
+        tasks — surviving executors' heartbeats re-attach by task id +
+        attempt, dead-while-orphaned tasks fall to the normal heartbeat
+        expiry path and relaunch under the journaled restart budget.
+        ``run()`` afterwards behaves exactly like a first driver's: it
+        rewrites driver.json (so outage-riding executors, warm-pool
+        standbys, and router discovery re-resolve the new endpoint) and
+        monitors to the job's terminal state."""
+        from .events.driver_journal import rewrite_journal
+
+        job_path = Path(job_dir)
+        journal_path = job_path / c.DRIVER_JOURNAL_FILE
+        state = load_state(journal_path)
+        if state is None or not state.app_id:
+            raise RuntimeError(
+                f"no recoverable control-plane journal in {job_dir} "
+                f"({journal_path.name} missing or without a meta record)")
+        if app_id and app_id != state.app_id:
+            raise RuntimeError(
+                f"journal belongs to {state.app_id}, not {app_id}")
+        conf = TonyConf.from_final(str(job_dir))
+        driver = cls(conf, app_id=state.app_id, job_dir=str(job_dir),
+                     token=state.token, provisioner=provisioner,
+                     rpc_port=state.rpc_port)
+        driver._restore(state)
+        # compact the journal down to the restored state BEFORE prepare()
+        # re-opens it for appends: one file must not accrete every
+        # incarnation's event stream. tmp+rename — a crash right here
+        # leaves the previous journal intact.
+        try:
+            rewrite_journal(journal_path, state)
+        except OSError:
+            log.exception("journal compaction failed; recovering off the "
+                          "uncompacted file")
+        return driver
+
+    def _restore(self, state: DriverState) -> None:
+        """Adopt a journaled control-plane state wholesale (no locks:
+        runs before any thread exists). Live tasks get re-adopted
+        handles + fresh liveness clocks; tasks whose journaled pid is
+        provably dead get an already-EXPIRED clock so the first monitor
+        tick routes them through the normal budgeted-restart path."""
+        from .warmpool import _pid_alive
+
+        self._recovered_state = state
+        self.driver_generation = state.driver_generation + 1
+        self._recoveries = state.recoveries + 1
+        self.session.restore_formation(
+            session_id=state.session_id,
+            gang_generation=state.gang_generation,
+            detached=state.detached)
+        self._preempts = set(state.preempts)
+        self._preempt_cmds = set(state.preempt_cmds)
+        self._rolls = set(state.rolls)
+        self._resizes = set(state.resizes)
+        now = time.time()
+        hb_expiry_s = (self.conf.get_int(keys.TASK_HEARTBEAT_INTERVAL_MS,
+                                         1000)
+                       * max(3, self.conf.get_int(
+                           keys.TASK_MAX_MISSED_HEARTBEATS, 25)) / 1000)
+        adopt = getattr(self.provisioner, "adopt_container", None)
+        for task_id, rec in sorted(state.tasks.items()):
+            task = self.session.get_task_by_id(task_id)
+            if task is None:
+                log.warning("journaled task %s no longer in the config; "
+                            "skipping", task_id)
+                continue
+            self._attempts[task_id] = rec.attempt
+            if rec.restarts:
+                self._restarts[task_id] = rec.restarts
+            if rec.terminal:
+                task.status = TaskStatus(rec.status)
+                task.exit_code = rec.exit_code
+                continue
+            if rec.attempt == 0:
+                continue        # never launched: scheduling covers it
+            task.host = rec.host
+            task.container_id = rec.container_id
+            if rec.log_path:
+                task.url = rec.log_path
+            if task_id in state.detached:
+                # a detached slot stays detached; the rescale timer
+                # re-arms so capacity retries resume on schedule
+                self._detach_t[task_id] = time.monotonic()
+                continue
+            if rec.registered:
+                self.session.register_task(task_id, rec.reg_host,
+                                           rec.reg_port)
+                task.status = TaskStatus.RUNNING
+                if rec.ports:
+                    try:
+                        self.session.set_task_ports(task_id, rec.ports)
+                    except ValueError:
+                        log.warning("journaled ports of %s malformed; "
+                                    "dropped", task_id)
+            else:
+                task.status = TaskStatus.ALLOCATED
+                # re-arm the registration timeout for the new incarnation
+                self._launch_ms[task_id] = now_ms()
+            # re-adopted handle: pid-identified, no Popen. The executor's
+            # own register_execution_result is its authoritative
+            # completion (on_task_result); a silently dead orphan is
+            # caught by heartbeat expiry below.
+            if callable(adopt):
+                handle = self.provisioner.adopt_container(
+                    container_id=rec.container_id or f"readopted_{task_id}",
+                    host=rec.host or "127.0.0.1",
+                    role=task.name, index=task.index, pid=rec.pid,
+                    log_path=rec.log_path)
+            else:
+                handle = ContainerHandle(
+                    container_id=rec.container_id or f"readopted_{task_id}",
+                    host=rec.host or "127.0.0.1",
+                    role=task.name, index=task.index,
+                    extra={"adopted": True, "pid": rec.pid,
+                           "log_path": rec.log_path})
+            self._handles[task_id] = handle
+            pid_live = rec.pid <= 0 or _pid_alive(rec.pid)
+            if pid_live:
+                # optimistic re-adoption: the liveness clock starts NOW;
+                # a survivor's next heartbeat re-attaches it, a zombie
+                # that never beats expires on the normal budget path
+                self.heartbeats[task_id] = now
+                self._readopted += 1
+                with self._tt_lock:
+                    self._reg_t[task_id] = time.monotonic()
+                    self._attempt_wall[task_id] = rec.launch_t
+                self._trace_mark(task_id, "readopted",
+                                 attempt=rec.attempt,
+                                 driver_generation=self.driver_generation,
+                                 **({"pid": rec.pid} if rec.pid else {}))
+                log.info("re-adopted %s (attempt %d%s)", task_id,
+                         rec.attempt,
+                         f", pid {rec.pid}" if rec.pid else "")
+            else:
+                # provably dead while orphaned: pre-expire its clock so
+                # the first monitor tick relaunches it under the
+                # journaled budget instead of waiting a full window
+                self.heartbeats[task_id] = now - 10 * hb_expiry_s
+                with self._tt_lock:
+                    self._reg_t[task_id] = time.monotonic()
+                log.warning("journaled pid %d of %s is dead; routing "
+                            "through the expiry/restart path", rec.pid,
+                            task_id)
+        log.warning("recovered control plane of %s as driver generation "
+                    "%d: %d task(s) re-adopted, %d restart unit(s) "
+                    "already spent", self.app_id, self.driver_generation,
+                    self._readopted, sum(self._restarts.values()))
+
     # ----------------------------------------------------------------- retry
     def reset(self) -> None:
         """Stop everything, rebuild the session with session_id+1 —
@@ -1890,6 +2240,24 @@ class Driver:
         self.session = Session(self.conf, session_id=old.session_id + 1)
         self.runtime_driver = self._runtime.driver_adapter()
         self.runtime_driver.set_session(self.session)
+        # a whole-job retry starts from scratch: the old session's
+        # journaled launches describe containers stop_all just killed,
+        # and recovering THEM would resurrect a formation that no longer
+        # exists — truncate and re-stamp the meta. _attempts stays: the
+        # fence must keep refusing the previous session's zombies.
+        self._recovered_state = None
+        if self._journal is not None:
+            self._journal.close()
+            try:
+                (self.job_dir / c.DRIVER_JOURNAL_FILE).write_text("")
+            except OSError:
+                log.exception("could not truncate the driver journal")
+            self._journal = DriverJournal(
+                self.job_dir / c.DRIVER_JOURNAL_FILE)
+            self._jrec("meta", app_id=self.app_id, token=self.token,
+                       session_id=self.session.session_id,
+                       rpc_port=self.rpc_server.port,
+                       driver_generation=self.driver_generation)
         self.heartbeats.clear()
         self._handles.clear()
         self._launch_ms.clear()
@@ -1940,6 +2308,8 @@ class Driver:
             self.events.stop(status.value)
         if self._task_trace_writer is not None:
             self._task_trace_writer.close()
+        if self._journal is not None:
+            self._journal.close()
         if self._metrics_httpd is not None:
             self._metrics_httpd.shutdown()
             self._metrics_httpd.server_close()
@@ -1959,8 +2329,16 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser = argparse.ArgumentParser(description="tony-tpu job driver")
     parser.add_argument("--job-dir", required=True)
-    parser.add_argument("--app-id", required=True)
+    parser.add_argument("--app-id", default="")
+    parser.add_argument(
+        "--recover", action="store_true",
+        help="replay <job-dir>/driver.journal.jsonl and re-adopt the "
+             "dead driver's live tasks instead of starting a fresh job "
+             "(docs/training-robustness.md 'Control-plane recovery'); "
+             "--app-id is then optional and only cross-checked")
     args = parser.parse_args(argv)
+    if not args.recover and not args.app_id:
+        parser.error("--app-id is required (unless --recover)")
 
     # fault injection: driver crash mid-run (reference TEST_AM_CRASH,
     # ApplicationMaster.java:382-393) — handled after first task launch via env
@@ -2012,8 +2390,14 @@ def main(argv: list[str] | None = None) -> int:
         conf, on_constructing=lambda p: holder.__setitem__("provisioner", p)
     )
     holder["provisioner"] = prov  # non-lifecycle kinds never call back
-    driver = Driver(conf, app_id=args.app_id, job_dir=args.job_dir,
-                    token=token, provisioner=prov)
+    if args.recover:
+        # auth root + endpoint come from the journal, not the env — the
+        # supervisor relaunching a dead driver may not hold the secret
+        driver = Driver.recover(args.job_dir, provisioner=prov,
+                                app_id=args.app_id)
+    else:
+        driver = Driver(conf, app_id=args.app_id, job_dir=args.job_dir,
+                        token=token, provisioner=prov)
     holder["driver"] = driver
 
     if os.environ.get(c.TEST_DRIVER_CRASH):

@@ -1,0 +1,315 @@
+"""Durable control-plane journal: what a restarted driver must remember
+to re-adopt a running job instead of killing it.
+
+The reference survives ApplicationMaster death because YARN preserves the
+running task containers across AM attempts
+(``keep-containers-across-application-attempts``) and the new attempt
+re-registers them. Our driver owns that ledger itself: every piece of
+authoritative control-plane state — the task registry and attempt
+chains, launch handles (pids), registrations, the restart budget
+already spent, gang generation, the roll/preempt/resize ledgers,
+published service ports, and the RPC auth root — is appended here as it
+changes, so a SIGKILLed driver's replacement (``tony-tpu driver
+--recover <job_dir>`` / ``Driver.recover()``) can replay the file,
+rebind RPC, and re-adopt the surviving executors by task id + attempt
+(docs/training-robustness.md "Control-plane recovery").
+
+File discipline mirrors ``events/journal.py`` (the serving request
+journal): append-only JSONL flushed per record, torn/malformed trailing
+lines skipped on read (a record torn by SIGKILL must not hide the
+rest), and recovery compacts the file via tmp+rename — a crash
+mid-compaction leaves the previous journal intact. Journal writes are
+best-effort on the control-plane hot path (a failed write is logged
+and counted, never raised: durability must not take down the driver).
+
+The journal holds the job's RPC auth ROOT token (the recovered driver
+must derive the same per-role keys or the surviving executors' signed
+heartbeats would all fail verification). The job dir is already the
+trust boundary holding ``driver.json`` and the frozen config; the
+journal adds no new exposure beyond it.
+
+Record vocabulary (one JSON object per line)::
+
+    {"op": "meta", "app_id": ..., "token": ..., "session_id": 0,
+     "rpc_port": 4xxxx, "driver_generation": 0}
+    {"op": "launch", "task": "worker:0", "attempt": 1,
+     "container_id": ..., "pid": 12345, "host": ..., "t": wall,
+     "log_path": ...}
+    {"op": "register", "task": "worker:0", "host": ..., "port": N}
+    {"op": "restarts", "task": "worker:0", "used": 1}
+    {"op": "ports", "task": "replica:0", "ports": {"serve_port": N}}
+    {"op": "generation", "gen": 2}
+    {"op": "detach", "task": "worker:1"} / {"op": "reattach", ...}
+    {"op": "ledger", "kind": "preempt|roll|resize", "task": ...,
+     "cmd": bool}
+    {"op": "terminal", "task": "worker:0", "status": "SUCCEEDED",
+     "exit_code": 0}
+    {"op": "recovered", "driver_generation": 1, "t": wall}
+
+Replay semantics worth pinning: a ``launch`` op starts a fresh attempt
+— it clears the task's registration, published ports, terminal state,
+and any roll/preempt/resize ledger entry (every budget-free discharge
+path ends in a relaunch, and the driver clears those ledgers exactly
+there); ``meta`` takes last-wins so a recovered driver's re-appended
+meta supersedes the original.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+# sibling of driver.json in the job dir (see also constants.py)
+DRIVER_JOURNAL_FILE = "driver.journal.jsonl"
+
+_TERMINAL_STATUSES = frozenset({"SUCCEEDED", "FAILED", "KILLED"})
+
+
+@dataclass
+class TaskRecord:
+    """One task slot's journaled control-plane state."""
+
+    task_id: str
+    attempt: int = 0            # monotonically increasing launch ordinal
+    container_id: str = ""
+    pid: int = 0                # executor pid (0 = unknown/non-local)
+    host: str = ""
+    log_path: str = ""
+    launch_t: float = 0.0       # wall clock of the newest launch
+    registered: bool = False
+    reg_host: str = ""
+    reg_port: int = -1
+    restarts: int = 0           # budget units spent
+    ports: dict = field(default_factory=dict)
+    status: str = ""            # terminal status value, "" while live
+    exit_code: int | None = None
+
+    @property
+    def terminal(self) -> bool:
+        return self.status in _TERMINAL_STATUSES
+
+
+@dataclass
+class DriverState:
+    """A replayed journal: everything Driver.recover() restores."""
+
+    app_id: str = ""
+    token: str = ""
+    session_id: int = 0
+    rpc_port: int = 0
+    driver_generation: int = 0
+    gang_generation: int = 0
+    recoveries: int = 0         # how many times this job recovered already
+    tasks: dict[str, TaskRecord] = field(default_factory=dict)
+    detached: set = field(default_factory=set)
+    preempts: set = field(default_factory=set)
+    preempt_cmds: set = field(default_factory=set)
+    rolls: set = field(default_factory=set)
+    resizes: set = field(default_factory=set)
+
+    def task(self, task_id: str) -> TaskRecord:
+        rec = self.tasks.get(task_id)
+        if rec is None:
+            rec = self.tasks[task_id] = TaskRecord(task_id)
+        return rec
+
+
+class DriverJournal:
+    """Append-only writer over the journal file. Thread-safe: records
+    come from RPC threads, provisioner watcher threads, and the monitor
+    loop. Every write is flushed — the journal's whole point is
+    surviving an unclean death."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._lock = threading.Lock()
+        self.write_errors = 0
+        self._f = None
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._f = open(self.path, "a")
+        except OSError:
+            self.write_errors += 1
+            log.exception("could not open driver journal %s", self.path)
+
+    def record(self, op: str, **fields) -> None:
+        """Best-effort append of one op (never raises)."""
+        if self._f is None:
+            return
+        try:
+            line = json.dumps({"op": op, **fields})
+        except (TypeError, ValueError):
+            self.write_errors += 1
+            log.exception("unserializable journal record %s", op)
+            return
+        with self._lock:
+            try:
+                self._f.write(line + "\n")
+                self._f.flush()
+            except Exception:
+                self.write_errors += 1
+                log.exception("driver journal write failed")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+
+def _apply(state: DriverState, rec: dict) -> None:
+    """Fold one journal record into the state (replay step)."""
+    op = rec["op"]
+    if op == "meta":
+        state.app_id = str(rec.get("app_id", state.app_id))
+        state.token = str(rec.get("token", state.token))
+        state.session_id = int(rec.get("session_id", state.session_id))
+        state.rpc_port = int(rec.get("rpc_port", state.rpc_port))
+        state.driver_generation = int(
+            rec.get("driver_generation", state.driver_generation))
+    elif op == "launch":
+        t = state.task(str(rec["task"]))
+        t.attempt = int(rec.get("attempt", t.attempt + 1))
+        t.container_id = str(rec.get("container_id", ""))
+        t.pid = int(rec.get("pid", 0) or 0)
+        t.host = str(rec.get("host", ""))
+        t.log_path = str(rec.get("log_path", ""))
+        t.launch_t = float(rec.get("t", 0.0) or 0.0)
+        # a fresh attempt: stale registration/ports/terminal state and
+        # every budget-free ledger entry die with the old attempt
+        # (mirrors Driver._relaunch_task + _try_restart_task clearing)
+        t.registered = False
+        t.reg_host, t.reg_port = "", -1
+        t.ports = {}
+        t.status, t.exit_code = "", None
+        for ledger in (state.preempts, state.preempt_cmds, state.rolls,
+                       state.resizes):
+            ledger.discard(t.task_id)
+    elif op == "register":
+        t = state.task(str(rec["task"]))
+        t.registered = True
+        t.reg_host = str(rec.get("host", ""))
+        t.reg_port = int(rec.get("port", -1))
+    elif op == "restarts":
+        state.task(str(rec["task"])).restarts = int(rec.get("used", 0))
+    elif op == "ports":
+        ports = rec.get("ports") or {}
+        if isinstance(ports, dict):
+            state.task(str(rec["task"])).ports.update(
+                {str(k): int(v) for k, v in ports.items()})
+    elif op == "generation":
+        state.gang_generation = int(rec["gen"])
+    elif op == "detach":
+        state.detached.add(str(rec["task"]))
+    elif op == "reattach":
+        state.detached.discard(str(rec["task"]))
+    elif op == "ledger":
+        task_id = str(rec["task"])
+        kind = rec.get("kind")
+        if kind == "preempt":
+            state.preempts.add(task_id)
+            if rec.get("cmd"):
+                state.preempt_cmds.add(task_id)
+        elif kind == "roll":
+            state.rolls.add(task_id)
+        elif kind == "resize":
+            state.resizes.add(task_id)
+    elif op == "terminal":
+        t = state.task(str(rec["task"]))
+        t.status = str(rec.get("status", ""))
+        code = rec.get("exit_code")
+        t.exit_code = int(code) if isinstance(code, (int, float)) else None
+    elif op == "recovered":
+        state.recoveries += 1
+        state.driver_generation = int(
+            rec.get("driver_generation", state.driver_generation))
+    # unknown ops are skipped silently: an older driver reading a newer
+    # journal must degrade, not crash
+
+
+def load_state(path: str | Path) -> DriverState | None:
+    """Replay a journal file into a DriverState. Returns None when the
+    file is missing or holds no ``meta`` record (nothing recoverable).
+    Malformed / torn lines (SIGKILL mid-write) are skipped — one torn
+    record must not hide the rest."""
+    path = Path(path)
+    if not path.exists():
+        return None
+    state = DriverState()
+    saw_meta = False
+    try:
+        lines = path.read_text().splitlines()
+    except OSError:
+        log.exception("could not read driver journal %s", path)
+        return None
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict) or "op" not in rec:
+                raise ValueError("not a journal record")
+            if rec["op"] == "meta":
+                saw_meta = True
+            _apply(state, rec)
+        except (ValueError, KeyError, TypeError):
+            log.warning("skipping malformed driver-journal line in %s", path)
+    return state if saw_meta else None
+
+
+def rewrite_journal(path: str | Path, state: DriverState) -> None:
+    """Compact the journal down to ``state`` via tmp+rename (recovery
+    runs this BEFORE re-opening the file for appends, so one journal
+    never accretes every previous incarnation's event stream). A crash
+    mid-rewrite leaves the previous journal intact — double-replaying
+    an op is harmless, losing one is not."""
+    path = Path(path)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "w") as f:
+        def w(op, **fields):
+            f.write(json.dumps({"op": op, **fields}) + "\n")
+
+        w("meta", app_id=state.app_id, token=state.token,
+          session_id=state.session_id, rpc_port=state.rpc_port,
+          driver_generation=state.driver_generation)
+        if state.gang_generation:
+            w("generation", gen=state.gang_generation)
+        for task_id in sorted(state.tasks):
+            t = state.tasks[task_id]
+            if t.attempt:
+                w("launch", task=task_id, attempt=t.attempt,
+                  container_id=t.container_id, pid=t.pid, host=t.host,
+                  t=t.launch_t, log_path=t.log_path)
+            if t.registered:
+                w("register", task=task_id, host=t.reg_host,
+                  port=t.reg_port)
+            if t.restarts:
+                w("restarts", task=task_id, used=t.restarts)
+            if t.ports:
+                w("ports", task=task_id, ports=t.ports)
+            if t.terminal:
+                w("terminal", task=task_id, status=t.status,
+                  exit_code=t.exit_code)
+        for task_id in sorted(state.detached):
+            w("detach", task=task_id)
+        for task_id in sorted(state.preempts):
+            w("ledger", kind="preempt", task=task_id,
+              cmd=task_id in state.preempt_cmds)
+        for task_id in sorted(state.rolls):
+            w("ledger", kind="roll", task=task_id)
+        for task_id in sorted(state.resizes):
+            w("ledger", kind="resize", task=task_id)
+        for _ in range(state.recoveries):
+            w("recovered", driver_generation=state.driver_generation,
+              t=time.time())
+    tmp.rename(path)

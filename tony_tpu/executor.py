@@ -110,10 +110,25 @@ def write_preempt_flag(step_log: str | None, cmd: dict) -> str | None:
 
 class Heartbeater(threading.Thread):
     """Reference TaskExecutor.Heartbeater:324-364, including the
-    skip-N-heartbeats fault hook. Doubles as the driver-death watchdog: when
-    heartbeats fail `max_failures` times in a row the driver is gone, and the
-    executor must not outlive it (the role YARN plays in the reference by
-    reaping containers of a dead AM).
+    skip-N-heartbeats fault hook. Doubles as the driver-death watchdog,
+    but a TWO-TIER one (docs/training-robustness.md "Control-plane
+    recovery"):
+
+    - An in-contact refusal (the driver answered and said no — auth
+      failure, unknown task) counts toward ``max_failures`` and trips
+      ``on_driver_lost`` like before: the driver is alive and has
+      disowned this executor.
+    - A TRANSPORT failure (connection refused/reset/timeout — the
+      driver process is gone) opens a bounded OUTAGE WINDOW
+      (``tony.task.driver-outage-grace-ms``) instead: the training
+      child keeps stepping, each beat re-resolves the driver endpoint
+      via ``endpoint_resolver`` (a recovered driver rewrites
+      driver.json), and only on grace exhaustion does ``on_outage``
+      fire — the executor checkpoint-drains and exits. Outage beats do
+      NOT count into ``missed``/``heartbeats_missed``: the pushed
+      counter means "beats the driver and I disagreed about", and a
+      driver that is briefly dead is a latency event, not a liveness
+      verdict on this worker.
 
     Each wait is jittered ±10% around the configured interval: a large
     gang's executors all start within one barrier release, and a FIXED
@@ -125,7 +140,9 @@ class Heartbeater(threading.Thread):
 
     def __init__(self, client: RpcClient, task_id: str, interval_s: float,
                  max_failures: int = 30, on_driver_lost=None, monitor=None,
-                 on_command=None, on_preempt=None):
+                 on_command=None, on_preempt=None,
+                 outage_grace_s: float = 30.0, endpoint_resolver=None,
+                 on_outage=None):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
@@ -140,8 +157,16 @@ class Heartbeater(threading.Thread):
         # notice; on_preempt gets the payload)
         self._on_command = on_command
         self._on_preempt = on_preempt
+        self._outage_grace_s = max(0.0, float(outage_grace_s))
+        # zero-arg callable returning the CURRENT (host, port) from
+        # driver.json, or None; called per failed beat so a recovered
+        # driver's rewritten endpoint is picked up within one interval
+        self._endpoint_resolver = endpoint_resolver
+        self._on_outage = on_outage
         self._rng = random.Random()     # urandom-seeded: per-process phase
         self.missed = 0
+        self.outage_beats = 0       # transport-failed beats (not "missed")
+        self.in_outage = False
         self.stop_event = threading.Event()
 
     def _note(self, name: str, value: float) -> None:
@@ -150,8 +175,10 @@ class Heartbeater(threading.Thread):
 
     def run(self) -> None:
         from .metrics import HEARTBEAT_RTT_MS, HEARTBEATS_MISSED
+        from .rpc import RpcError
 
         failures = 0
+        outage_t: float | None = None
         while not self.stop_event.wait(
                 self._interval * self._rng.uniform(0.9, 1.1)):
             if self._skip > 0:
@@ -165,6 +192,13 @@ class Heartbeater(threading.Thread):
                 self._note(HEARTBEAT_RTT_MS,
                            (time.monotonic() - t0) * 1000.0)
                 failures = 0
+                if outage_t is not None:
+                    log.warning(
+                        "driver re-attached after a %.1fs outage (%d "
+                        "beats rode the grace window)",
+                        time.monotonic() - outage_t, self.outage_beats)
+                    outage_t = None
+                    self.in_outage = False
                 if isinstance(result, dict):
                     for key, cb in (("profile", self._on_command),
                                     ("preempt", self._on_preempt)):
@@ -176,16 +210,57 @@ class Heartbeater(threading.Thread):
                                 # a bad command must not stop the beat —
                                 # the beat IS the liveness signal
                                 log.exception("heartbeat command failed")
-            except Exception as e:
+            except RpcError as e:
+                # the driver ANSWERED and refused: liveness is not in
+                # question, this executor is — the classic budget. An
+                # answer also ENDS any open outage window (transport is
+                # back); leaving the stale clock running would let the
+                # next transient transport blip "exhaust" the grace
+                # instantly and drain a worker the driver can see.
+                if outage_t is not None:
+                    log.warning(
+                        "driver answering again after a %.1fs transport "
+                        "outage (beat refused)",
+                        time.monotonic() - outage_t)
+                    outage_t = None
+                    self.in_outage = False
                 failures += 1
                 self.missed += 1
                 self._note(HEARTBEATS_MISSED, float(self.missed))
-                log.warning("heartbeat failed (%d/%d): %s",
+                log.warning("heartbeat refused (%d/%d): %s",
                             failures, self._max_failures, e)
                 if failures >= self._max_failures and self._on_driver_lost:
-                    log.error("driver unreachable for %d heartbeats; giving up",
+                    log.error("driver refused %d heartbeats; giving up",
                               failures)
                     self._on_driver_lost()
+                    return
+            except Exception as e:
+                # transport failure: the driver process is unreachable —
+                # ride the outage window, re-resolving the endpoint (a
+                # recovered driver rewrites driver.json with its new
+                # port) instead of counting this worker as missing
+                self.outage_beats += 1
+                if outage_t is None:
+                    outage_t = time.monotonic()
+                    self.in_outage = True
+                    log.warning(
+                        "driver unreachable (%s); riding the %.1fs "
+                        "outage grace — the child keeps working",
+                        e, self._outage_grace_s)
+                if self._endpoint_resolver is not None:
+                    try:
+                        ep = self._endpoint_resolver()
+                    except Exception:
+                        ep = None
+                    if ep:
+                        self._client.set_address(*ep)
+                if time.monotonic() - outage_t > self._outage_grace_s:
+                    log.error(
+                        "driver unreachable for %.1fs (> outage grace); "
+                        "draining", time.monotonic() - outage_t)
+                    cb = self._on_outage or self._on_driver_lost
+                    if cb:
+                        cb()
                     return
 
 
@@ -205,6 +280,13 @@ class Executor:
         self.job_dir = env.get(c.ENV_JOB_DIR, "")
         self.command = env.get(c.ENV_TASK_COMMAND, "")
         self.task_id = f"{self.job_name}:{self.task_index}"
+        # launch ordinal of this attempt, echoed on register_worker so a
+        # recovered driver's fence can refuse a superseded attempt's
+        # zombie (-1 = launched by a driver that predates the fence)
+        try:
+            self.attempt = int(env.get(c.ENV_TASK_ATTEMPT, "-1") or -1)
+        except ValueError:
+            self.attempt = -1
 
         # remote-host localization: when the client's job dir isn't visible
         # here (no shared FS) — or localization is forced — fetch + unpack
@@ -269,6 +351,33 @@ class Executor:
             )
             self.tb_port = self._tb_res.port
 
+    def _resolve_driver_endpoint(self) -> tuple[str, int] | None:
+        """Re-read the driver endpoint from the job dir's driver.json: a
+        RECOVERED driver (control-plane recovery) rewrites it with a
+        fresh port + bumped driver_generation, and executors riding the
+        outage grace must follow it rather than hammer the dead one.
+        Re-points the shared RPC client (registration/metrics/result
+        path) as a side effect; the Heartbeater re-points its own
+        fast-fail client from the returned endpoint."""
+        if not self.job_dir:
+            return None
+        try:
+            info = json.loads(
+                open(os.path.join(self.job_dir, c.DRIVER_INFO_FILE)).read())
+        except (OSError, ValueError):
+            return None
+        host, port = info.get("host"), info.get("port")
+        if not isinstance(host, str) or not isinstance(port, int):
+            return None
+        if (host, port) != (self.driver_host, self.driver_port):
+            log.warning(
+                "driver endpoint moved %s:%d -> %s:%d (driver generation "
+                "%s); re-pointing", self.driver_host, self.driver_port,
+                host, port, info.get("driver_generation"))
+            self.driver_host, self.driver_port = host, port
+            self.rpc.set_address(host, port)
+        return host, port
+
     def _my_host(self) -> str:
         # route-based local address discovery; falls back to loopback for the
         # single-host mini-cluster
@@ -289,7 +398,8 @@ class Executor:
         self._maybe_skew()
         poll_s = self.conf.get_int(keys.TASK_REGISTRATION_POLL_MS, 250) / 1000
         payload = self.rpc.call(
-            "register_worker", task_id=self.task_id, host=self.host, port=self.port
+            "register_worker", task_id=self.task_id, host=self.host,
+            port=self.port, attempt=self.attempt,
         )
         while payload is None:
             time.sleep(poll_s)
@@ -411,6 +521,28 @@ class Executor:
                 proc.kill()
             os._exit(c.EXIT_KILLED)
 
+        preempt_grace_ms = self.conf.get_int(keys.TASK_PREEMPT_GRACE_MS,
+                                             3000)
+
+        def _outage_drain() -> None:
+            # the driver stayed unreachable past the outage grace: this
+            # executor is orphaned for real. Checkpoint-drain the child
+            # (preempt flag + grace watchdog — the same contract as a
+            # preemption notice) so at most one step boundary of work is
+            # lost, instead of the old hard kill; run() then returns
+            # with the child's exit code (EXIT_PREEMPTED). The teardown
+            # RPCs (final metrics flush, result report) become bounded
+            # best-effort: nothing is listening, and the process must
+            # exit within seconds, not a minute of reconnect backoff.
+            self.rpc.set_max_retries(2)
+            proc = getattr(ctx_holder.get("ctx"), "child_process", None)
+            if proc is None or proc.poll() is not None:
+                os._exit(c.EXIT_KILLED)     # nothing to drain
+            log.error("driver outage grace exhausted; checkpoint-draining "
+                      "the child before exiting")
+            self._on_preempt_notice(ctx_holder,
+                                    {"grace_ms": preempt_grace_ms})
+
         # dedicated fast-fail client: the shared client retries each call for
         # ~a minute (and serializes with the metrics monitor on its lock),
         # which would stretch the watchdog by orders of magnitude — here one
@@ -445,6 +577,14 @@ class Executor:
             # watchdog (the driver already knows: no notify back)
             on_preempt=lambda cmd: self._on_preempt_notice(
                 ctx_holder, cmd if isinstance(cmd, dict) else {}),
+            # driver-death tolerance: transport failures ride a bounded
+            # outage window, re-resolving a recovered driver's endpoint
+            # from the rewritten driver.json each beat; only grace
+            # exhaustion drains this executor
+            outage_grace_s=self.conf.get_int(
+                keys.TASK_DRIVER_OUTAGE_GRACE_MS, 30000) / 1000,
+            endpoint_resolver=self._resolve_driver_endpoint,
+            on_outage=_outage_drain,
         )
         heartbeater.start()
 

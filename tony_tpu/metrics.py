@@ -91,6 +91,13 @@ DRIVER_CHECKPOINT_AGE_S = "driver_checkpoint_age_s"
 DRIVER_WARM_POOL_SIZE = "driver_warm_pool_size"
 DRIVER_WARM_POOL_ADOPTIONS_TOTAL = "driver_warm_pool_adoptions_total"
 DRIVER_WARM_POOL_MISSES_TOTAL = "driver_warm_pool_misses_total"
+# control-plane recovery (docs/training-robustness.md "Control-plane
+# recovery"): how many times this job's driver was restarted from its
+# journal (driver.journal.jsonl replay), and how many live tasks those
+# recoveries RE-ADOPTED (heartbeats re-attached by task id + attempt)
+# instead of relaunching — the AM-restart "worker restarts = 0" bound
+DRIVER_RECOVERIES_TOTAL = "driver_recoveries_total"
+DRIVER_TASKS_READOPTED_TOTAL = "driver_tasks_readopted_total"
 
 # fleet-router exposition families (rendered by tony_tpu/router.py's GET
 # /metrics; same one-contract rule — the metrics-name lint pins these to
@@ -111,6 +118,11 @@ ROUTER_AFFINITY_HIT_RATIO = "router_affinity_hit_ratio"
 # after a transport failure/ejection, carrying the emitted prefix the
 # router last learned from /progress (resume_tokens)
 ROUTER_FAILOVERS_TOTAL = "router_failovers_total"
+# 1 while driver discovery is flying blind (driver.json missing/stale,
+# the RPC endpoint refusing, or an implausible empty fleet inside the
+# drop grace) and the router is serving its LAST-KNOWN fleet — the
+# control-plane-outage visibility gauge (0 with a live driver view)
+ROUTER_DISCOVERY_STALE = "router_discovery_stale"
 
 # executor-accumulator metric names (ride update_metrics pushes the same
 # way memory_rss_mb does; surface on the driver /metrics as

@@ -458,6 +458,10 @@ _TASK_SEG_COLORS = {
     "preempting": "#d6b35c",       # drain notice relayed
     "preempted": "#d6b35c",        # drained + budget-free relaunch
     "resized": "#9a7fd0",          # elastic gang re-formation
+    "readopted": "#67c5a8",        # re-adopted by a RECOVERED driver
+    #                                (control-plane recovery — the task
+    #                                never stopped; attrs carry the new
+    #                                driver_generation)
     "failed": "#d98080", "killed": "#d98080",
     "heartbeat_expired": "#d98080",
 }
@@ -533,7 +537,8 @@ def _task_timeline_html(app_id: str, traces: list[dict]) -> str:
                      ("adopted", "#6cbfe0"),
                      ("done", "#79b77a"), ("restart", "#e0876c"),
                      ("roll", "#8fd0c9"), ("preempt", "#d6b35c"),
-                     ("resize", "#9a7fd0"), ("dead", "#d98080")))
+                     ("resize", "#9a7fd0"), ("readopted", "#67c5a8"),
+                     ("dead", "#d98080")))
     body = (
         f"<h3>{html.escape(app_id)} — gang-launch waterfall</h3>"
         f"<p><a href='/'>all jobs</a> | "

@@ -175,12 +175,21 @@ class FleetRouter:
                  affinity: bool = True, health_interval_s: float = 0.5,
                  eject_after: int = 2, spill_queue_depth: int | None = None,
                  probe_timeout_s: float = 2.0, stats_every: int = 4,
-                 discover=None, trace_sink=None, seed: int | None = None):
+                 discover=None, trace_sink=None, seed: int | None = None,
+                 discovery_grace_s: float = 10.0):
         """``replicas``: static endpoints ("host:port" strings or
         (name, host, port) triples). ``discover``: zero-arg callable
         returning the current [(name, host, port)] — the driver-backed
         fleet view (see DriverDiscovery); called from the health loop,
-        its result REPLACES the replica set. ``spill_queue_depth``: treat
+        its result REPLACES the replica set — except during a
+        control-plane outage: a discovery FAILURE (driver.json missing,
+        RPC refused — the driver is dead or mid-recovery) keeps the
+        last-known fleet serving and raises the
+        ``router_discovery_stale`` gauge, and an implausible EMPTY
+        result while live replicas still answer their own probes is
+        distrusted for ``discovery_grace_s`` before the drop is
+        honored (a freshly recovered driver may answer before its
+        state is whole). ``spill_queue_depth``: treat
         a replica with that many queued requests as saturated even
         before it sheds (None = only trust 429s and the replica's own
         max_queue from /stats). ``stats_every``: refresh each replica's
@@ -198,6 +207,12 @@ class FleetRouter:
         self.stats_every = max(1, int(stats_every))
         self._tick = 0
         self.discover = discover
+        self.discovery_grace_s = float(discovery_grace_s)
+        # control-plane-outage visibility: True while the router serves
+        # its LAST-KNOWN fleet because discovery is failing (or handed
+        # back an implausible empty set inside the grace window)
+        self.discovery_stale = False
+        self._discovery_empty_since: float | None = None
         self.trace_sink = trace_sink
         self._lock = threading.Lock()
         self._rng = random.Random(seed)
@@ -302,12 +317,7 @@ class FleetRouter:
         refresh_stats = (self._tick % self.stats_every) == 1 \
             or self.stats_every == 1
         if self.discover is not None:
-            try:
-                self.sync_replicas(list(self.discover()))
-            except Exception as e:
-                # a flapping driver RPC must not tear the fleet down;
-                # the last known replica set keeps serving
-                log.warning("router discovery failed: %s", e)
+            self._discovery_tick()
         with self._lock:
             reps = list(self.replicas.values())
         for rep in reps:
@@ -327,6 +337,51 @@ class FleetRouter:
             if healthy and refresh_stats:
                 self._refresh_stats(rep)
         self._refresh_progress(reps)
+
+    def _discovery_tick(self) -> None:
+        """Re-sync the replica set from discovery, tolerating a
+        control-plane outage: a failed call (driver dead / driver.json
+        stale / RPC refused) keeps the last-known fleet serving — the
+        replicas are still answering their own /healthz probes — and an
+        EMPTY result while live replicas exist is distrusted for
+        ``discovery_grace_s`` (a recovering driver can answer before
+        its journal replay restored the published ports). Either way
+        ``discovery_stale`` (and the ``router_discovery_stale`` gauge)
+        says the router is flying blind."""
+        try:
+            found = list(self.discover())
+        except Exception as e:
+            # a flapping/dead driver RPC must not tear the fleet down;
+            # the last known replica set keeps serving
+            if not self.discovery_stale:
+                log.warning("router discovery failed (%s); serving the "
+                            "last-known fleet", e)
+            self.discovery_stale = True
+            return
+        with self._lock:
+            live = sum(r.up for r in self.replicas.values())
+        if not found and live:
+            now = time.monotonic()
+            if self._discovery_empty_since is None:
+                self._discovery_empty_since = now
+            if now - self._discovery_empty_since < self.discovery_grace_s:
+                if not self.discovery_stale:
+                    log.warning(
+                        "router discovery reports an EMPTY fleet while "
+                        "%d replica(s) still answer; distrusting it for "
+                        "%.1fs", live, self.discovery_grace_s)
+                self.discovery_stale = True
+                return
+            # the driver has insisted for the whole grace: honor it
+            log.warning("router discovery empty past the %.1fs grace; "
+                        "dropping the fleet", self.discovery_grace_s)
+        else:
+            self._discovery_empty_since = None
+        self.sync_replicas(found)
+        if self.discovery_stale:
+            log.info("router discovery recovered (%d replica(s))",
+                     len(found))
+        self.discovery_stale = False
 
     def _pkey(self, rid: int) -> str:
         return f"{self._nonce}:{rid}"
@@ -698,6 +753,10 @@ class FleetRouter:
             return {
                 "replicas": reps,
                 "live": sum(r.up for r in self.replicas.values()),
+                # True while driver discovery is failing/distrusted and
+                # the router serves its last-known fleet (control-plane
+                # outage; docs/training-robustness.md)
+                "discovery_stale": self.discovery_stale,
                 "requests": self.requests_total,
                 "failed": self.failed_total,
                 "shed": self.shed_total,
@@ -742,6 +801,11 @@ class FleetRouter:
                           labels=lab)
             r.gauge(_metrics.ROUTER_REPLICAS_LIVE, live,
                     "replicas currently in rotation")
+            r.gauge(_metrics.ROUTER_DISCOVERY_STALE,
+                    1 if self.discovery_stale else 0,
+                    "1 while driver discovery is failing/distrusted and "
+                    "the router serves its last-known fleet (the "
+                    "operator's control-plane-outage signal)")
             r.counter(_metrics.ROUTER_FAILED_TOTAL, self.failed_total,
                       "requests the router could not complete "
                       "(deadline / no replica)")
@@ -804,7 +868,14 @@ class DriverDiscovery:
     tasks that published a ``serve_port`` (runtimes/serving.py publishes
     it only after the replica's first healthy /healthz). A replica mid-
     restart has no ports (the driver clears them at relaunch) and drops
-    out of the result until its new attempt is serving again."""
+    out of the result until its new attempt is serving again.
+
+    On any failure the cached RPC client is dropped so the NEXT call
+    re-resolves driver.json — a RECOVERED driver (control-plane
+    recovery) rewrites it with a fresh endpoint and restores the
+    journaled ports, so discovery heals without a replica bounce; the
+    router's ``_discovery_tick`` keeps the last-known fleet serving in
+    the meantime (``router_discovery_stale``)."""
 
     def __init__(self, job_dir: str, role: str | None = None,
                  token: str = ""):
@@ -985,6 +1056,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="refresh each replica's /stats only every Nth "
                         "health tick (a /stats render takes the "
                         "replica's serving lock)")
+    p.add_argument("--discovery-grace-s", type=float, default=10.0,
+                   help="distrust an EMPTY discovery result this long "
+                        "while live replicas still answer their own "
+                        "probes (a dead or freshly recovered driver "
+                        "must not drop a serving fleet); failed "
+                        "discovery always keeps the last-known fleet")
     p.add_argument("--trace-dir", default="",
                    help="dump router request traces as JSONL "
                         "(requests.trace.jsonl) into this directory")
@@ -1025,7 +1102,8 @@ def main(argv=None) -> int:
         probe_timeout_s=args.probe_timeout_s,
         spill_queue_depth=args.spill_queue_depth or None,
         stats_every=args.stats_every, discover=discover,
-        trace_sink=trace_sink)
+        trace_sink=trace_sink,
+        discovery_grace_s=args.discovery_grace_s)
     router.start()
     httpd = ThreadingHTTPServer((args.host, args.port),
                                 make_handler(router))

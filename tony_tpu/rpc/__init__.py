@@ -4,7 +4,13 @@ The service contract mirrors the reference's 8-method TonyClusterService
 (src/main/proto/tony_cluster_service_protos.proto:11-20) plus the MetricsRpc
 service (rpc/MetricsRpc.java), carried as framed JSON over TCP:
 
-  register_worker(task_id, host, port) -> cluster_spec | None   (gang barrier)
+  register_worker(task_id, host, port, attempt=-1)
+                                       -> cluster_spec | None   (gang barrier;
+                                          `attempt` echoes the launch env's
+                                          TONY_TASK_ATTEMPT so a recovered
+                                          driver's fence can refuse a
+                                          superseded attempt's zombie; -1
+                                          skips the fence)
   get_cluster_spec(task_id)            -> cluster_spec | None
   get_task_infos()                     -> [TaskInfo]
   heartbeat(task_id)                   -> True | {"profile": ..., "preempt": ...}

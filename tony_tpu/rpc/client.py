@@ -31,8 +31,32 @@ class RpcClient:
         self._connect_timeout = connect_timeout
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
+        # endpoint handoff (driver recovery rewrites driver.json with a
+        # fresh host:port): set from any thread, consumed at the next
+        # (re)connect so an in-flight call keeps its socket
+        self._pending_addr: tuple[str, int] | None = None
+
+    def set_address(self, host: str, port: int) -> None:
+        """Re-point the client at a new endpoint (driver failover);
+        takes effect on the next connect attempt — callers mid-retry
+        pick it up on their next attempt without extra locking."""
+        self._pending_addr = (host, int(port))
+
+    def set_max_retries(self, n: int) -> None:
+        """Shrink (or grow) the per-call retry budget. The executor uses
+        this once its driver-outage grace is exhausted: the teardown
+        calls (final metrics flush, result report) become bounded
+        best-effort attempts instead of a minute of reconnect backoff
+        against a control plane that is known dead."""
+        self._max_retries = max(1, int(n))
 
     def _connect(self) -> socket.socket:
+        pend = self._pending_addr
+        if pend is not None:
+            self._pending_addr = None
+            if pend != self._addr:
+                self._addr = pend
+                self._close()
         if self._sock is None:
             sock = socket.create_connection(self._addr, timeout=self._connect_timeout)
             sock.settimeout(60)

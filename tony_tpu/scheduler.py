@@ -88,6 +88,16 @@ class TaskScheduler:
         with self._lock:
             return role not in self._scheduled
 
+    def restore(self, scheduled_roles) -> None:
+        """Driver recovery: mark roles a previous driver incarnation
+        already requested as scheduled, so ``schedule()`` does not
+        re-launch whole roles whose live tasks were just re-adopted.
+        Journaled completions replay through ``on_task_completed`` as
+        usual to release dependents."""
+        with self._lock:
+            self._scheduled.update(
+                r for r in scheduled_roles if r in self._specs)
+
     def on_task_completed(self, role: str, succeeded: bool) -> None:
         """One instance of `role` finished. When every instance of `role` has
         finished successfully, drop it from dependents' pending sets and

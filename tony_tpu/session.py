@@ -181,6 +181,20 @@ class Session:
             if task.status in (TaskStatus.NEW, TaskStatus.REQUESTED):
                 task.status = TaskStatus.ALLOCATED
 
+    # -------------------------------------------------- control-plane recovery
+    def restore_formation(self, *, session_id: int, gang_generation: int,
+                          detached) -> None:
+        """Adopt a journaled formation wholesale (driver recovery,
+        events/driver_journal.py): the session id, the current gang
+        generation, and which slots were elastically detached. Task
+        registrations/statuses are replayed separately by the driver —
+        this restores only the formation-level facts the task table
+        cannot carry."""
+        with self._lock:
+            self.session_id = int(session_id)
+            self.gang_generation = int(gang_generation)
+            self.detached = {str(t) for t in detached}
+
     # ------------------------------------------------------- elastic resize
     def begin_generation(self) -> int:
         """Start a new gang formation: every active task must re-register

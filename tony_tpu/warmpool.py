@@ -312,21 +312,27 @@ class AdoptedChild:
 
 
 # --------------------------------------------------------------------- pool
-def _driver_watch_pid(job_dir: str) -> int:
-    """The driver pid from driver.json, usable as a liveness watch ONLY
-    when the driver runs on this host (loopback RPC endpoint) — a remote
-    pid number would alias an unrelated local process."""
-    if not job_dir:
-        return 0
+def _driver_json_pid(path: str | Path) -> int:
+    """The driver pid advertised by a driver.json file, usable as a
+    liveness watch ONLY when the driver runs on this host (loopback RPC
+    endpoint) — a remote pid number would alias an unrelated local
+    process."""
     try:
-        info = json.loads(
-            (Path(job_dir) / c.DRIVER_INFO_FILE).read_text())
+        info = json.loads(Path(path).read_text())
     except (OSError, ValueError):
         return 0
     if info.get("host") not in ("127.0.0.1", "localhost", "::1"):
         return 0
     pid = info.get("pid")
     return pid if isinstance(pid, int) and pid > 0 else 0
+
+
+def _driver_watch_pid(job_dir: str) -> int:
+    """The driver pid from the job dir's driver.json (see
+    ``_driver_json_pid``)."""
+    if not job_dir:
+        return 0
+    return _driver_json_pid(Path(job_dir) / c.DRIVER_INFO_FILE)
 
 
 def count_ready(pool_dir: str | Path | None) -> int:
@@ -356,12 +362,19 @@ class WarmPool:
 
     def __init__(self, pool_dir: str | Path, size: int,
                  warmup_module: str = "", watch_pid: int = 0,
-                 spawn_env: dict[str, str] | None = None):
+                 spawn_env: dict[str, str] | None = None,
+                 driver_json: str = "", outage_grace_s: float = 30.0):
         self.dir = Path(pool_dir)
         self.size = int(size)
         self.warmup_module = warmup_module
         self.watch_pid = int(watch_pid)
         self.spawn_env = dict(spawn_env or {})
+        # driver-outage tolerance for per-job pools: when the watched
+        # driver pid dies, standbys re-resolve this driver.json for the
+        # RECOVERED driver's pid for outage_grace_s before self-reaping
+        # — a recovered driver finds its pool warm instead of cold
+        self.driver_json = str(driver_json or "")
+        self.outage_grace_s = float(outage_grace_s)
         # Popen handles of standbys THIS process spawned: polled on every
         # scan so exited standbys are reaped instead of lingering as
         # zombies under a long-lived spawner (the driver)
@@ -382,19 +395,31 @@ class WarmPool:
             return None
         pool_dir = str(conf.get(keys.WARMPOOL_DIR, "") or "")
         watch_pid = 0
+        driver_json = ""
         if not pool_dir:
             if not job_dir:
                 return None
             pool_dir = os.path.join(str(job_dir), c.WARMPOOL_DIR_NAME)
             # per-JOB pool: standbys die with the job's driver; an
             # explicit tony.warmpool.dir is host-level capacity shared
-            # across submits and must outlive any one driver
+            # across submits and must outlive any one driver. The
+            # driver.json path lets standbys survive a driver RESTART:
+            # they re-resolve the recovered driver's pid from it for the
+            # outage grace before self-reaping.
             watch_pid = _driver_watch_pid(str(job_dir))
+            driver_json = os.path.join(str(job_dir), c.DRIVER_INFO_FILE)
+        try:
+            grace_s = conf.get_int(keys.TASK_DRIVER_OUTAGE_GRACE_MS,
+                                   30000) / 1000
+        except (TypeError, ValueError):
+            grace_s = 30.0
         return cls(
             pool_dir, size,
             warmup_module=str(conf.get(keys.WARMPOOL_WARMUP_MODULE, "") or ""),
             watch_pid=watch_pid,
             spawn_env=spawn_env,
+            driver_json=driver_json,
+            outage_grace_s=grace_s,
         )
 
     @classmethod
@@ -478,6 +503,9 @@ class WarmPool:
             argv += ["--warmup-module", self.warmup_module]
         if self.watch_pid:
             argv += ["--watch-pid", str(self.watch_pid)]
+        if self.driver_json:
+            argv += ["--driver-json", self.driver_json,
+                     "--outage-grace-s", str(self.outage_grace_s)]
         env = {**os.environ, **self.spawn_env}
         # the standby must import tony_tpu no matter the spawner's cwd
         # (the executor may run from a localized work dir)
@@ -813,6 +841,13 @@ def standby_main(argv: list[str] | None = None) -> int:
     parser.add_argument("--pool-dir", required=True)
     parser.add_argument("--warmup-module", default="")
     parser.add_argument("--watch-pid", type=int, default=0)
+    parser.add_argument(
+        "--driver-json", default="",
+        help="path to the job's driver.json: when the watched pid dies, "
+             "re-resolve a RECOVERED driver's pid from it for the outage "
+             "grace before self-reaping (keeps the pool warm across a "
+             "driver restart)")
+    parser.add_argument("--outage-grace-s", type=float, default=30.0)
     args = parser.parse_args(argv)
 
     # a standby's warmup is BACKGROUND work and must yield the CPU to
@@ -868,6 +903,7 @@ def standby_main(argv: list[str] | None = None) -> int:
     listener.settimeout(1.0)
     conn = None
     claim_seen_t: float | None = None
+    outage_t: float | None = None       # watched-driver death instant
     while conn is None:
         try:
             conn, _ = listener.accept()
@@ -898,10 +934,34 @@ def standby_main(argv: list[str] | None = None) -> int:
             else:
                 claim_seen_t = None
             if args.watch_pid and not _pid_alive(args.watch_pid):
+                # driver-outage grace: a SIGKILLed driver's recovered
+                # successor rewrites driver.json with ITS pid — adopt it
+                # as the new watch target so the pool stays warm across
+                # the restart; self-reap only once the grace runs dry
+                new_pid = (_driver_json_pid(args.driver_json)
+                           if args.driver_json else 0)
+                if (new_pid and new_pid != args.watch_pid
+                        and _pid_alive(new_pid)):
+                    log.warning(
+                        "watched driver %d died; re-watching recovered "
+                        "driver %d (driver.json)", args.watch_pid, new_pid)
+                    args.watch_pid = new_pid
+                    outage_t = None
+                    continue
+                if outage_t is None and args.driver_json:
+                    outage_t = time.monotonic()
+                    log.warning(
+                        "watched pid %d gone; standby %d riding the "
+                        "%.1fs driver-outage grace", args.watch_pid, me,
+                        args.outage_grace_s)
+                if (outage_t is not None and time.monotonic() - outage_t
+                        <= args.outage_grace_s):
+                    continue
                 log.info("watched pid %d gone; standby %d exiting",
                          args.watch_pid, me)
                 _cleanup_standby_files(pool_dir, stem)
                 return 0
+            outage_t = None
         except OSError as e:
             log.error("control socket failed: %s", e)
             _cleanup_standby_files(pool_dir, stem)
