@@ -120,6 +120,18 @@ behavior), and the SIGKILLed replica restarted against the same
 --trace-dir must recover its file journal and finish the orphaned
 requests. Results land in PERF.json under `serving_replay`.
 
+`python bench.py --serving --spec` gates speculative decoding inside
+continuous batching (docs/serving.md "Speculative decoding &
+multi-model serving"): a target and a 12x-smaller draft trained on the
+same Markov corpus (real acceptance, the bench_transformer speculative
+methodology) serve the identical burst spec-off and spec-on — the
+bench asserts byte-identical completions and >= 1.3x tokens/s, reports
+the measured acceptance + autotuned gamma + the acceptance-0 floor
+(random draft), and a multi-model arm rolls a two-model serve process
+mid-burst (SIGTERM drain -> relaunch with one checkpoint swapped under
+the same name + journal dir) asserting zero failed requests. Results
+land in PERF.json under `speculative_serving`.
+
 `python bench.py --driver-failover` gates the CONTROL-PLANE recovery
 layer (docs/training-robustness.md "Control-plane recovery") with two
 arms. Training: a real 2-worker elastic_train job whose driver SIGKILLs
@@ -930,6 +942,295 @@ def run_serving_fleet_bench() -> int:
                            random_pass["ttft_p99_s"]],
             "affinity_hit_ratio": affinity_pass["affinity_hit_ratio"],
         },
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def run_serving_spec_bench() -> int:
+    """Speculative decoding inside continuous batching + multi-model
+    hot-swap (one JSON line -> PERF.json `speculative_serving`).
+
+    Arm A/B — spec off vs on, REAL acceptance: a target and a 12x-
+    smaller draft are trained on the same Markov corpus (the bench_
+    transformer speculative methodology: same-distribution alignment,
+    not a modeled parameter), then the identical request burst serves
+    through a plain SlotServer and a draft-speculating one. Gates:
+    byte-identical completions (speculation is never a numerics
+    change), >= 1.3x tokens/s, acceptance histogram populated.
+
+    Arm C — multi-model + roll hot-swap: a serve subprocess registers
+    TWO models, takes a concurrent two-model burst, and is SIGTERM-
+    drained mid-burst (the PR 7 roll path) and relaunched with one
+    model's checkpoint SWAPPED under the same name + the same journal
+    dir. Clients retry through the roll; the gate is zero failed
+    requests and both models serving after the swap."""
+    import re as _re
+    import signal as _signal
+    import threading
+    import urllib.request
+
+    sys.path.insert(0, str(REPO))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench_transformer import _markov_batch
+    from tony_tpu.models import transformer
+    from tony_tpu.models.generate import prepare_decode
+    from tony_tpu.models.serving import Request, SlotServer
+    from tony_tpu.parallel import MeshSpec, build_mesh
+    from tony_tpu.train import create_train_step
+
+    V = 1024
+    # d512/L6: deep enough into the weight-streaming regime that the
+    # (gamma+1)-wide verify genuinely amortizes the stream even on CPU
+    # (at d384 the verify is compute-bound and the measured speedup sat
+    # within noise of the 1.3x gate; at d512 the acceptance-0 floor
+    # alone measures ~0.49x, putting full-acceptance headroom near 2x)
+    cfg = transformer.TransformerConfig(
+        vocab_size=V, d_model=512, n_layers=6, n_heads=8, n_kv_heads=8,
+        d_ff=2048, max_seq_len=256, dtype=jnp.float32)
+    draft_cfg = transformer.TransformerConfig(
+        vocab_size=V, d_model=128, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=512, max_seq_len=256, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    # 0.9-primary chain: predictable enough that a trained draft's
+    # greedy continuation tracks the trained target's (the condition a
+    # production draft/target pair has), noisy enough that nothing is
+    # memorized verbatim
+    succ = rng.integers(0, V, (V, 2)).astype(np.int32)
+
+    def markov(r, batch, seq):
+        x = np.empty((batch, seq + 1), np.int32)
+        x[:, 0] = r.integers(0, V, batch)
+        for t in range(seq):
+            pick = r.random(batch) < 0.9
+            x[:, t + 1] = np.where(pick, succ[x[:, t], 0],
+                                   succ[x[:, t], 1])
+        return x[:, :-1], x[:, 1:]
+
+    def train(model_cfg, steps, seed):
+        mesh = build_mesh(MeshSpec(data=-1, fsdp=1))
+        bundle = create_train_step(model_cfg, mesh,
+                                   key=jax.random.PRNGKey(seed))
+        params, opt = bundle.params, bundle.opt_state
+        r = np.random.default_rng(seed)
+        m = None
+        for chunk in range(steps // 50):
+            for _ in range(50):
+                tk, tg = markov(r, 8, 64)
+                params, opt, m = bundle.step_fn(
+                    params, opt, jnp.asarray(tk), jnp.asarray(tg))
+            float(m["loss"])            # sync per 50-step window
+        return params, float(m["loss"])
+
+    t0 = time.time()
+    tp_raw, t_loss = train(cfg, 300, seed=0)
+    dp_raw, d_loss = train(draft_cfg, 300, seed=1)
+    train_s = time.time() - t0
+    tp = prepare_decode(tp_raw, cfg)
+    dp = prepare_decode(dp_raw, draft_cfg)
+    del tp_raw, dp_raw
+
+    # held-out prompts from the same chain
+    er = np.random.default_rng(99)
+    prompts = [markov(er, 1, 32)[0][0] for _ in range(24)]
+    budget = 48
+
+    def serve_arm(draft=None, spec_gamma=0):
+        kw = {}
+        if draft is not None:
+            # gamma ceiling 8: at the measured ~0.99 acceptance the
+            # autotuner rides the ceiling, and the wider window is
+            # where the weight-stream amortization pays (knob sweep:
+            # 1.58x at gamma_max 4 -> 2.2x at 8). pipeline_depth 1:
+            # speculation runs the sync (EOS-style) scheduler, where a
+            # freed slot waits a full pipeline lag for re-admission —
+            # at ~5 tokens/round that lag is whole requests, and CPU
+            # compute is serial anyway so the deeper runway buys
+            # nothing (plain predictive serving keeps its default).
+            kw = dict(draft=draft, draft_cfg=draft_cfg,
+                      spec_gamma=spec_gamma, spec_gamma_max=8,
+                      pipeline_depth=1)
+        srv = SlotServer(tp, cfg, slots=8, max_len=128, block_size=8,
+                         prefill_chunk=32, **kw)
+
+        def one_pass():
+            reqs = [Request(prompt=p, max_new_tokens=budget)
+                    for p in prompts]
+            for r in reqs:
+                srv.submit(r)
+            t0 = time.time()
+            done = srv.run_until_drained()
+            wall = time.time() - t0
+            toks = {i: done[r.id].tokens for i, r in enumerate(reqs)}
+            n = sum(len(t) for t in toks.values())
+            return n / wall, wall, toks
+
+        one_pass()                      # compile + autotune warm-up
+        best, best_wall, toks = 0.0, 0.0, None
+        for _ in range(3):
+            rate, wall, t = one_pass()
+            if rate > best:
+                best, best_wall, toks = rate, wall, t
+        st = srv.stats()
+        srv.shutdown()
+        return {"tokens_per_sec": round(best, 1),
+                "wall_s": round(best_wall, 3)}, toks, st
+
+    plain, toks_plain, _ = serve_arm()
+    spec, toks_spec, spec_st = serve_arm(draft=dp)
+    assert toks_plain == toks_spec, (
+        "speculation changed completions — the byte-identity contract "
+        "is broken")
+    speedup = round(spec["tokens_per_sec"] / plain["tokens_per_sec"], 3)
+    sstats = spec_st["speculative"]
+    assert sstats["acceptance"]["count"] > 0, (
+        "acceptance histogram empty — the gate has nothing to stand on")
+    assert speedup >= 1.3, (
+        f"speculative serving speedup {speedup} < 1.3x gate "
+        f"(acceptance_ewma {sstats['acceptance_ewma']})")
+    # the honest worst case alongside: a random draft (~0 acceptance)
+    # pays gamma draft steps per correction token — still byte-exact,
+    # gamma pinned so the autotuner can't rescue the number
+    dp0 = prepare_decode(
+        jax.jit(lambda k: transformer.init(k, draft_cfg))(
+            jax.random.PRNGKey(7)), draft_cfg)
+    floor, toks_floor, floor_st = serve_arm(draft=dp0, spec_gamma=4)
+    assert toks_floor == toks_plain, "floor arm broke byte-identity"
+
+    # ---- arm C: multi-model serve + roll hot-swap, zero failed ----
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def spawn_serve(port, trace_dir, main_spec):
+        args = [sys.executable, "-m", "tony_tpu.cli.main", "serve",
+                "--port", str(port), "--vocab", "256",
+                "--d-model", "64", "--n-layers", "2", "--n-heads", "4",
+                "--d-ff", "128", "--dtype", "float32",
+                "--slots", "4", "--max-len", "64", "--block-size", "4",
+                "--prefill-chunk", "8",
+                "--model", f"main={main_spec}",
+                "--model", "alt=random:7",
+                "--trace-dir", str(trace_dir),
+                "--drain-timeout-s", "60"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if _re.search(r"http://[\d.]+:\d+", line or ""):
+                threading.Thread(target=proc.stdout.read,
+                                 daemon=True).start()
+                return proc
+        raise RuntimeError("serve never became ready")
+
+    with tempfile.TemporaryDirectory(prefix="tony-spec-bench-") as td:
+        port = free_port()
+        proc = spawn_serve(port, td, "random:0")
+        n_req, failed, succeeded = 24, [], []
+        client_retries = [0]
+        lock = threading.Lock()
+
+        def call(i):
+            model = "main" if i % 2 == 0 else "alt"
+            body = json.dumps({
+                "prompt": [(i * 7 + j) % 256 for j in range(6)],
+                "max_new_tokens": 8, "model": model,
+                "timeout_s": 240}).encode()
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                try:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/generate", data=body)
+                    with urllib.request.urlopen(req, timeout=240) as r:
+                        json.loads(r.read())
+                        with lock:
+                            succeeded.append(i)
+                        return
+                except Exception:
+                    # the roll window: refused/5xx/cut mid-request —
+                    # the router would retry elsewhere; the bench
+                    # client retries the same (only) endpoint
+                    with lock:
+                        client_retries[0] += 1
+                    time.sleep(0.3)
+            with lock:
+                failed.append(i)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(n_req)]
+        t_roll0 = time.time()
+        for i, t in enumerate(threads):
+            t.start()
+            if i == n_req // 3:
+                # mid-burst: the roll (PR 7 semantics = SIGTERM drain;
+                # in-flight finish, then the process exits cleanly)
+                proc.send_signal(_signal.SIGTERM)
+        proc.wait(timeout=300)
+        # relaunch with main's checkpoint SWAPPED under the same name,
+        # same journal dir (recovery finishes anything the drain cut)
+        proc2 = spawn_serve(port, td, "random:5")
+        for t in threads:
+            t.join(timeout=300)
+        roll_wall = time.time() - t_roll0
+        # both models serve after the swap
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=10) as r:
+            st2 = json.loads(r.read())
+        proc2.terminate()
+        proc2.wait(timeout=60)
+        assert not failed, f"roll dropped requests: {failed}"
+        assert len(succeeded) == n_req
+        assert set(st2["models"]) == {"main", "alt"}, st2.get("models")
+
+    out = {
+        "metric": "speculative_serving_speedup",
+        "value": speedup,
+        "unit": "x tokens/s vs spec-off serving",
+        "target_params_m": round(
+            transformer.num_params(tp.params) / 1e6, 1),
+        "draft_params_m": round(
+            transformer.num_params(dp.params) / 1e6, 1),
+        "trained_on": f"markov chain V={V} (0.9 primary), 300 steps "
+                      f"each (losses {t_loss:.3f} / {d_loss:.3f}, "
+                      f"{train_s:.0f}s)",
+        "byte_identical": True,
+        "slots": 8,
+        "n_requests": len(prompts),
+        "budget": budget,
+        "plain": plain,
+        "speculative": spec,
+        "gamma": sstats["gamma"],
+        "gamma_autotuned": not sstats["gamma_pinned"],
+        "acceptance_ewma": sstats["acceptance_ewma"],
+        "accepted_tokens": sstats["accepted_tokens"],
+        "proposed_tokens": sstats["proposed_tokens"],
+        "verify_rounds": sstats["rounds"],
+        "acceptance_zero_floor": {
+            **floor,
+            "ratio_vs_plain": round(
+                floor["tokens_per_sec"] / plain["tokens_per_sec"], 3),
+            "acceptance_ewma": floor_st["speculative"]["acceptance_ewma"],
+        },
+        "multi_model": {
+            "requests": n_req,
+            "failed": 0,
+            "client_retries_through_roll": client_retries[0],
+            "roll_wall_s": round(roll_wall, 1),
+            "models_after_swap": sorted(st2["models"]),
+            "swapped": "main random:0 -> random:5 (same name, same "
+                       "journal dir, SIGTERM drain between)",
+        },
+        "num_devices": jax.device_count(),
     }
     print(json.dumps(out))
     return 0
@@ -2203,6 +2504,8 @@ def main() -> int:
     if "--elastic" in sys.argv:
         return run_elastic_bench()
     if "--serving" in sys.argv:
+        if "--spec" in sys.argv:
+            return run_serving_spec_bench()
         if "--replay" in sys.argv:
             return run_serving_replay_bench()
         if "--fleet" in sys.argv:

@@ -409,9 +409,13 @@ def test_metrics_endpoint_matches_stats(params):
 
         for line in text.strip().splitlines():
             assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
-        # every SERVING_* series named in metrics.py is present
+        # every SERVING_* series named in metrics.py is present — except
+        # the speculative families, which render only for spec-enabled
+        # engines (this server has no draft; their live rendering is
+        # asserted in tests/test_spec_serving.py's metrics-labels test)
         for attr in dir(_metrics):
-            if attr.startswith("SERVING_"):
+            if attr.startswith("SERVING_") and \
+                    not attr.startswith("SERVING_SPEC_"):
                 assert getattr(_metrics, attr) in text, (
                     f"{attr} series missing from /metrics")
         for fam in ("serving_ttft_seconds", "serving_tpot_seconds",
@@ -441,11 +445,15 @@ def test_metrics_endpoint_matches_stats(params):
             stats["compile"]["compiles"])
         assert samples["serving_device_lag_seconds_count"] == (
             stats["latency"]["device_lag_s"]["count"]) > 0
-        # histogram buckets are cumulative and consistent with _count
+        # histogram buckets are cumulative and consistent with _count —
+        # the UNLABELED (process-aggregate) series; the {model=...}
+        # partition interleaves its own cumulative series in the same
+        # family (asserted in tests/test_spec_serving.py)
         buckets = [(nl, v) for nl, v in samples.items()
-                   if nl.startswith("serving_ttft_seconds_bucket")]
+                   if nl.startswith('serving_ttft_seconds_bucket{le=')]
         counts = [v for _, v in buckets]
-        assert counts == sorted(counts), "buckets must be cumulative"
+        assert counts and counts == sorted(counts), (
+            "buckets must be cumulative")
         assert counts[-1] == samples["serving_ttft_seconds_count"] == 1
         # gauges/counters agree with /stats
         assert samples["serving_queue_depth"] == stats["queued"]
@@ -668,6 +676,28 @@ def test_metrics_names_rendered_and_documented():
                 _metrics.ROUTER_DISCOVERY_STALE):
         assert fam in rendered, f"recovery family unrendered: {fam}"
         assert fam in doc_names, f"recovery family undocumented: {fam}"
+
+    # the speculative-decoding + multi-model families are pinned
+    # EXPLICITLY the same way (ISSUE 13 lint discipline): each must be
+    # rendered by serve /metrics and documented — renaming either side
+    # without the other fails here
+    for fam in (_metrics.SERVING_MODELS,
+                _metrics.SERVING_SPEC_ROUNDS_TOTAL,
+                _metrics.SERVING_SPEC_PROPOSED_TOKENS_TOTAL,
+                _metrics.SERVING_SPEC_ACCEPTED_TOKENS_TOTAL,
+                _metrics.SERVING_SPEC_GAMMA,
+                _metrics.SERVING_SPEC_ACCEPTANCE_RATE,
+                _metrics.SERVING_SPEC_VERIFY_ROUNDS):
+        assert fam in rendered, f"spec/model family unrendered: {fam}"
+        assert fam in doc_names, f"spec/model family undocumented: {fam}"
+    # the model-labeled partition is a rendered contract too: the serve
+    # renderer must attach {model=...} labels somewhere (the per-model
+    # block) and the doc must describe the label
+    serve_src = inspect.getsource(serve_mod)
+    assert '{"model": name}' in serve_src, (
+        "serve /metrics lost its per-model label partition")
+    assert "Per-model labels" in doc, (
+        "docs/observability.md lost the per-model-labels section")
 
 
 def test_finish_reason_vocabulary_pinned():

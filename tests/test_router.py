@@ -57,6 +57,10 @@ class StubReplica:
         self.retry_after = 2
         self.shed_next = 0          # serve this many 429s first
         self.fail_next = 0          # ... or this many 500s
+        self.client_error_next = 0  # ... or this many 400s
+        # advertised model registry (the serve /stats "models" keys);
+        # None = legacy replica without the field
+        self.models: list | None = None
         self.delay_s = 0.0
         # mid-request death: sleep, then sever the connection with no
         # response (what a SIGKILL looks like to the router's POST)
@@ -88,10 +92,13 @@ class StubReplica:
                     self._send(200 if stub.healthy else 503,
                                {"healthy": stub.healthy})
                 elif self.path == "/stats":
-                    self._send(200, {
+                    payload = {
                         "queued": stub.queued, "active": stub.active,
                         "slots": stub.slots, "max_queue": stub.max_queue,
-                        "retry_after_s": stub.retry_after})
+                        "retry_after_s": stub.retry_after}
+                    if stub.models is not None:
+                        payload["models"] = {m: {} for m in stub.models}
+                    self._send(200, payload)
                 elif self.path.partition("?")[0] == "/progress":
                     # serve-contract shape: {key: {tokens, prompt_tokens}}
                     from urllib.parse import parse_qs, urlparse
@@ -119,6 +126,10 @@ class StubReplica:
                     if stub.fail_next > 0:
                         stub.fail_next -= 1
                         self._send(500, {"error": "boom"})
+                        return
+                    if stub.client_error_next > 0:
+                        stub.client_error_next -= 1
+                        self._send(400, {"error": "unknown model"})
                         return
                     stub.received.append(list(payload["prompt"]))
                     stub.payloads.append(dict(payload))
@@ -193,6 +204,56 @@ def test_least_loaded_pick(stubs):
     st = router.stats()
     assert st["requests"] == 4 and st["failed"] == 0
     assert st["affinity"]["requests"] == 0      # nothing keyed
+
+
+def test_model_aware_routing(stubs):
+    """Requests naming a model route (and spill) ONLY among replicas
+    advertising it on /stats; a replica without the field (legacy)
+    serves anything; a model nobody advertises fails with a clear
+    NoReplicaError after the deadline."""
+    from tony_tpu.router import RouterClientError
+
+    a, b, legacy = stubs("a", "b", "legacy")
+    a.models, b.models = ["alpha"], ["beta", "alpha"]
+    a.queued, b.queued, legacy.queued = 0, 0, 0
+    router = _router([a, b, legacy], prefill_chunk=8)
+    router.health_tick()
+    # beta lives only on b — every beta request lands there, regardless
+    # of load ordering
+    a.queued = 0
+    for _ in range(3):
+        resp = router.generate([1, 2, 3], max_new_tokens=1, timeout_s=5,
+                               model="beta")
+        assert resp["replica"] == "b"
+    assert all(p.get("model") == "beta" for p in b.payloads)
+    # alpha lives on a and b: least-loaded picks among exactly those +
+    # the legacy (advertises nothing = serves anything)
+    got = {router.generate([9, 9], max_new_tokens=1, timeout_s=5,
+                           model="alpha")["replica"] for _ in range(6)}
+    assert got <= {"a", "b", "legacy"}
+    # spill respects the model dimension: alpha's pick saturated ->
+    # next ALPHA-capable candidate, never a beta-only replica
+    # (construct: advertise alpha only on a, saturate a)
+    b.models = ["beta"]
+    router.health_tick()
+    a.shed_next = 1
+    resp = router.generate([2, 4, 6], max_new_tokens=1, timeout_s=5,
+                           model="alpha")
+    assert resp["replica"] == "legacy", resp
+    # a model nobody advertises: fast, clear failure
+    legacy.models = ["alpha", "beta"]
+    router.health_tick()
+    with pytest.raises(NoReplicaError, match="ghost"):
+        router.generate([1], max_new_tokens=1, timeout_s=0.6,
+                        model="ghost")
+    # replica 400s (stale advertisement): no retry, no ejection,
+    # surfaced as a client error
+    b.client_error_next = 1
+    with pytest.raises(RouterClientError, match="unknown model"):
+        router.generate([5, 5], max_new_tokens=1, timeout_s=5,
+                        model="beta")
+    assert router.replicas["b"].up, "a 4xx must not eject the replica"
+    assert router.stats()["replicas"]["b"]["models"] == ["beta"]
 
 
 def test_affinity_stickiness_and_spill(stubs):

@@ -1,0 +1,712 @@
+"""Speculative decoding in continuous batching + the multi-model
+registry (models/serving.py `_spec_block`, models/registry.py).
+
+The contracts under test:
+
+- **Byte-identity**: a greedy request served with a draft model
+  speculating is token-for-token identical to spec-off serving AND to a
+  solo generate() run — for a random draft (acceptance ~0, every round
+  exercises the correction path) and a self-draft (acceptance ~1, the
+  all-accept/bonus path). Speculation is a pure latency/throughput
+  optimization, never a numerics change.
+- **Event-log discipline survives speculation**: loop-crash replay is
+  byte-identical greedy (rejected draft tokens never reach the journal
+  — the journaled prefix at any instant is a true prefix of the final
+  stream), and cancel-mid-verify returns an exact solo-stream prefix
+  with the freed slot's next occupant token-identical (the PR 3
+  contract).
+- **Gamma autotune**: the per-slot acceptance EWMA drives the draft
+  window up under an agreeing draft and down to 1 under a random one;
+  --spec-gamma pins it.
+- **Multi-model**: a ServeApp over {name -> SlotServer} engines serves
+  two models concurrently with correct per-model outputs, routes
+  model= to the right engine, 400s unknown names, and labels /stats
+  and /metrics per model (the `serving_models` info gauge + model-
+  labeled families).
+
+All shapes are TINY and shared across tests so the compiled program
+set stays within the tier-1 budget.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.models import transformer
+from tony_tpu.models.generate import generate
+from tony_tpu.models.registry import ModelRegistry
+from tony_tpu.models.serving import Request, SlotServer
+
+TINY = transformer.TransformerConfig(
+    vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq_len=128, dtype=jnp.float32,
+)
+DRAFT = transformer.TransformerConfig(
+    vocab_size=256, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+    d_ff=64, max_seq_len=128, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init(jax.random.PRNGKey(0), TINY)
+
+
+@pytest.fixture(scope="module")
+def dparams():
+    return transformer.init(jax.random.PRNGKey(1), DRAFT)
+
+
+def _prompts(n, key=3, lo=2, hi=14):
+    k = jax.random.PRNGKey(key)
+    out = []
+    for _ in range(n):
+        k, a, b = jax.random.split(k, 3)
+        lp = int(jax.random.randint(a, (), lo, hi))
+        out.append(np.asarray(
+            jax.random.randint(b, (lp,), 0, TINY.vocab_size), np.int32))
+    return out
+
+
+def _solo(params, prompt, max_new, **kw):
+    out = generate(params, TINY, jnp.asarray(prompt)[None], max_new, **kw)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _srv(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prefill_chunk", 8)
+    return SlotServer(params, TINY, **kw)
+
+
+def _serve_burst(srv, prompts, budgets):
+    reqs = [Request(prompt=p, max_new_tokens=b)
+            for p, b in zip(prompts, budgets)]
+    for r in reqs:
+        srv.submit(r)
+    done = srv.run_until_drained()
+    return reqs, done
+
+
+# --------------------------------------------------------------------------
+# model registry
+# --------------------------------------------------------------------------
+
+def test_model_registry_unit():
+    reg = ModelRegistry()
+    with pytest.raises(KeyError):
+        reg.default
+    e1 = reg.register("target", {"w": 1}, TINY, source="random:0")
+    assert e1.generation == 0 and reg.default is e1
+    assert "target" in reg and len(reg) == 1
+    # re-registration (in-process hot swap) bumps the generation
+    e2 = reg.register("target", {"w": 2}, TINY, source="random:9")
+    assert e2.generation == 1 and reg.get("target").weights == {"w": 2}
+    # draft pairing resolves through the registry; dangling names fail
+    # at resolution, not registration
+    reg.register("mini", {"w": 3}, DRAFT)
+    reg.get("target").draft = "mini"
+    assert reg.resolve_draft("target").name == "mini"
+    assert reg.resolve_draft("mini") is None
+    reg.get("target").draft = "ghost"
+    with pytest.raises(KeyError, match="ghost"):
+        reg.resolve_draft("target")
+    with pytest.raises(KeyError, match="unknown model"):
+        reg.get("nope")
+    with pytest.raises(ValueError):
+        reg.register("self", {"w": 4}, TINY, draft="self")
+    assert reg.names() == ["target", "mini"], (
+        "a rejected registration must not half-register")
+
+
+def test_slot_server_builds_internal_registry(params, dparams):
+    """The classic (params, cfg) constructor still works and now exposes
+    the registry surface: the weights are a named entry, an inline
+    draft registers as a second entry, and the pairing is recorded."""
+    srv = _srv(params, draft=dparams, draft_cfg=DRAFT, spec_gamma=2)
+    try:
+        assert srv.model == "default"
+        assert set(srv.registry.names()) == {"default", "draft"}
+        assert srv.registry.get("default").draft == "draft"
+        assert srv.registry.resolve_draft("default").cfg is DRAFT
+        # registry-first construction serves the same entry
+        srv2 = SlotServer(registry=srv.registry, model="default",
+                          slots=2, max_len=64, block_size=4,
+                          prefill_chunk=8)
+        assert srv2._spec and srv2.draft_model == "draft"
+        srv2.shutdown()
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------------------------------------
+# byte-identity: spec on == spec off == solo, both acceptance regimes
+# --------------------------------------------------------------------------
+
+def test_spec_parity_random_draft(params, dparams):
+    """A random draft agrees with the target almost never — every round
+    exercises the rejection/correction path — and the output is STILL
+    byte-identical to spec-off serving and solo generate (a broken
+    draft can only cost speed)."""
+    prompts = _prompts(8)
+    budgets = [6 + (i % 5) for i in range(8)]
+    plain = _srv(params)
+    _, done_p = _serve_burst(plain, prompts, budgets)
+    spec = _srv(params, draft=dparams, draft_cfg=DRAFT, spec_gamma=2)
+    reqs, done_s = _serve_burst(spec, prompts, budgets)
+    for i, r in enumerate(reqs):
+        want = _solo(params, prompts[i], budgets[i])
+        assert done_s[r.id].tokens == want, f"request {i} diverged"
+    st = spec.stats()["speculative"]
+    assert st["rounds"] > 0 and st["proposed_tokens"] > 0
+    assert st["acceptance"]["count"] > 0, "acceptance histogram empty"
+    assert st["acceptance_ewma"] < 0.3, "random draft should rarely agree"
+    # trace attrs carry the per-request speculation tallies
+    tr = done_s[reqs[0].id].trace
+    assert tr["attrs"]["spec_rounds"] >= 1
+    plain.shutdown()
+    spec.shutdown()
+
+
+def test_spec_parity_self_draft_accepts_everything(params):
+    """Draft == target: every proposal verifies (acceptance ~1, the
+    all-accept + bonus-token path), output still byte-identical, and
+    the verify-round count is well under one-round-per-token."""
+    prompts = _prompts(6, key=5)
+    budgets = [8] * 6
+    spec = _srv(params, draft=params, draft_cfg=TINY, spec_gamma=2)
+    reqs, done = _serve_burst(spec, prompts, budgets)
+    for i, r in enumerate(reqs):
+        assert done[r.id].tokens == _solo(params, prompts[i], budgets[i])
+    st = spec.stats()["speculative"]
+    assert st["acceptance_ewma"] > 0.8
+    assert st["accepted_tokens"] > 0
+    # with gamma=2 and full acceptance, each round delivers up to 3
+    # tokens: the per-request verify-round histogram must sit well
+    # under the budget of 8
+    assert st["verify_rounds_per_request"]["count"] == len(reqs)
+    assert st["verify_rounds_per_request"]["p90_s"] <= 5
+    spec.shutdown()
+
+
+def test_spec_eos_matches_generate(params, dparams):
+    """Stop tokens end requests mid-round: the emitted stream (stop
+    token kept, nothing after) matches generate(stop_tokens=...) for
+    every request, under speculation."""
+    prompts = _prompts(6, key=11)
+    solo = [_solo(params, p, 10) for p in prompts]
+    stop = solo[0][4]
+    spec = _srv(params, draft=dparams, draft_cfg=DRAFT, spec_gamma=2,
+                stop_tokens=(stop,))
+    reqs, done = _serve_burst(spec, prompts, [10] * 6)
+    stopped = 0
+    for i, r in enumerate(reqs):
+        want = _solo(params, prompts[i], 10, stop_tokens=(stop,))
+        if stop in want:                # generate pads past the stop
+            want = want[:want.index(stop) + 1]
+            stopped += 1
+        assert done[r.id].tokens == want, f"request {i} diverged"
+        assert done[r.id].finish_reason == (
+            "stop" if want[-1] == stop else "length")
+    assert stopped >= 1, "stop token never fired; test is vacuous"
+    spec.shutdown()
+
+
+# --------------------------------------------------------------------------
+# event-log discipline × speculation
+# --------------------------------------------------------------------------
+
+def test_spec_cancel_mid_verify_token_identical(params, dparams):
+    """Cancel between verify rounds: the partial is an EXACT prefix of
+    the solo stream, and the freed slot's next occupant is
+    token-identical to a fresh server (the PR 3 cancel contract,
+    unchanged by speculation)."""
+    prompts = _prompts(4, key=7, lo=4, hi=10)
+    srv = SlotServer(params, TINY, slots=1, max_len=64, block_size=4,
+                     prefill_chunk=8, draft=dparams, draft_cfg=DRAFT,
+                     spec_gamma=2)
+    a = Request(prompt=prompts[0], max_new_tokens=12)
+    b = Request(prompt=prompts[1], max_new_tokens=6)
+    srv.submit(a)
+    srv.submit(b)
+    for _ in range(3):                  # a is mid-decode, b queued
+        srv.step()
+    assert srv.cancel(a.id)
+    done = srv.run_until_drained()
+    ca = done[a.id]
+    assert ca.finish_reason == "cancelled"
+    full = _solo(params, prompts[0], 12)
+    assert ca.tokens == full[:len(ca.tokens)], "partial not a true prefix"
+    assert done[b.id].tokens == _solo(params, prompts[1], 6), (
+        "the freed slot's next occupant diverged")
+    srv.shutdown()
+
+
+def test_spec_crash_replay_byte_identical(params, dparams, monkeypatch):
+    """Loop crash mid-speculation (deterministic chaos at a spec-round
+    ordinal) -> reset() replays the journaled prefixes; completions are
+    byte-identical greedy, nothing is lost, and the journaled prefix
+    at the crash instant was a TRUE prefix of the final stream
+    (rejected draft tokens never reached the journal)."""
+    monkeypatch.setenv("TONY_TEST_SERVING_CRASH_AT_BLOCKS", "3")
+    prompts = _prompts(6, key=13)
+    srv = _srv(params, draft=dparams, draft_cfg=DRAFT, spec_gamma=2)
+    reqs = [Request(prompt=p, max_new_tokens=8) for p in prompts]
+    for r in reqs:
+        srv.submit(r)
+    crashed, out, crash_prefixes = False, {}, {}
+    while not srv.idle:
+        try:
+            srv.step()
+        except RuntimeError:
+            crashed = True
+            # snapshot the journal AT the crash: these prefixes must be
+            # true prefixes of the final streams
+            for r in reqs:
+                entry = srv._journal.get(r.id)
+                if entry is not None and entry.emitted:
+                    crash_prefixes[r.id] = list(entry.emitted)
+            lost = srv.reset()
+            assert lost == [], f"journal replay lost requests: {lost}"
+        out.update(srv.drain_completed())
+    out.update(srv.drain_completed())
+    assert crashed, "the chaos crash never fired; test is vacuous"
+    assert srv.replays >= 1
+    for i, r in enumerate(reqs):
+        want = _solo(params, prompts[i], 8)
+        assert out[r.id].tokens == want, f"request {i} diverged"
+        pre = crash_prefixes.get(r.id)
+        if pre:
+            assert want[:len(pre)] == pre, (
+                "journal held tokens the final stream disowns — a "
+                "rejected draft leaked into the journal")
+    srv.shutdown()
+
+
+# --------------------------------------------------------------------------
+# gamma autotune
+# --------------------------------------------------------------------------
+
+def test_spec_gamma_autotune_and_pin(params, dparams):
+    """The acceptance EWMA steers gamma: an agreeing (self) draft
+    drives it to the max, a random draft drives it to 1; a pinned
+    gamma never moves."""
+    prompts = _prompts(4, key=17)
+    up = _srv(params, draft=params, draft_cfg=TINY, spec_gamma_max=4)
+    _serve_burst(up, prompts, [10] * 4)
+    assert up._current_gamma() == 4, (
+        f"full acceptance should max gamma, got {up._current_gamma()}")
+    up.shutdown()
+    down = _srv(params, draft=dparams, draft_cfg=DRAFT, spec_gamma_max=4)
+    _serve_burst(down, prompts, [10] * 4)
+    assert down._current_gamma() == 1, (
+        f"random draft should shrink gamma to 1, got "
+        f"{down._current_gamma()}")
+    down.shutdown()
+    pinned = _srv(params, draft=params, draft_cfg=TINY, spec_gamma=2)
+    _serve_burst(pinned, prompts, [6] * 4)
+    assert pinned._current_gamma() == 2
+    assert pinned.stats()["speculative"]["gamma_pinned"] is True
+    pinned.shutdown()
+
+
+def test_spec_rejects_invalid_configs(params, dparams):
+    with pytest.raises(ValueError, match="greedy-only"):
+        SlotServer(params, TINY, draft=dparams, draft_cfg=DRAFT,
+                   temperature=0.7)
+    with pytest.raises(ValueError, match="draft_cfg"):
+        SlotServer(params, TINY, draft=dparams)
+    bad_vocab = transformer.TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=128, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="vocabulary"):
+        SlotServer(params, TINY, draft=dparams, draft_cfg=bad_vocab)
+    srv = _srv(params, draft=dparams, draft_cfg=DRAFT, spec_gamma=2)
+    with pytest.raises(ValueError, match="greedy-only"):
+        srv.submit(Request(prompt=[1, 2, 3], max_new_tokens=4,
+                           temperature=0.5))
+    # a greedy request with an explicit temperature of 0 is fine
+    srv.submit(Request(prompt=[1, 2, 3], max_new_tokens=2,
+                       temperature=0.0))
+    srv.run_until_drained()
+    srv.shutdown()
+
+
+# --------------------------------------------------------------------------
+# multi-model ServeApp
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def params_b():
+    return transformer.init(jax.random.PRNGKey(9), TINY)
+
+
+def _two_model_app(params, params_b, **engine_kw):
+    from tony_tpu.cli.serve import ServeApp
+
+    reg = ModelRegistry()
+    reg.register("alpha", params, TINY, source="random:0")
+    reg.register("beta", params_b, TINY, source="random:9")
+    engines = {
+        n: SlotServer(registry=reg, model=n, slots=2, max_len=64,
+                      block_size=4, prefill_chunk=8, **engine_kw)
+        for n in ("alpha", "beta")}
+    app = ServeApp(engines)
+    app.start()
+    return app
+
+
+def test_multi_model_concurrent_and_unknown(params, params_b):
+    """Two engines behind one app: concurrent requests to both models
+    return each model's own (distinct) greedy stream; nameless requests
+    get the default (first) model; unknown names raise."""
+    from tony_tpu.cli.serve import UnknownModelError
+
+    app = _two_model_app(params, params_b)
+    try:
+        prompt = [3, 5, 7, 9, 11]
+        wa = _solo(params, np.asarray(prompt, np.int32), 6)
+        wb = _solo(params_b, np.asarray(prompt, np.int32), 6)
+        assert wa != wb, "seeds collided; test is vacuous"
+        results = {}
+
+        def call(model):
+            results[model] = app.generate(prompt, 6, timeout=120,
+                                          model=model)
+
+        ts = [threading.Thread(target=call, args=(m,))
+              for m in ("alpha", "beta")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert results["alpha"].tokens == wa
+        assert results["beta"].tokens == wb
+        assert app.generate(prompt, 6, timeout=120).tokens == wa
+        with pytest.raises(UnknownModelError, match="nope"):
+            app.generate(prompt, 4, timeout=10, model="nope")
+        st = app.stats()
+        assert set(st["models"]) == {"alpha", "beta"}
+        assert st["slots"] == 4, "multi-model /stats aggregates load"
+        assert st["models"]["beta"]["model"] == "beta"
+    finally:
+        app.shutdown()
+
+
+def test_multi_model_metrics_labels(params, params_b, dparams):
+    """/metrics carries the serving_models info gauge, model-labeled
+    partitions of the serving families, and — for a spec-enabled
+    engine — the serving_spec_* families."""
+    from tony_tpu.cli.serve import ServeApp, make_handler
+
+    reg = ModelRegistry()
+    reg.register("alpha", params, TINY, source="random:0")
+    reg.register("mini", dparams, DRAFT, source="random:1")
+    reg.get("alpha").draft = "mini"
+    engines = {"alpha": SlotServer(registry=reg, model="alpha", slots=2,
+                                   max_len=64, block_size=4,
+                                   prefill_chunk=8, spec_gamma=2),
+               "beta": SlotServer(params_b, TINY, model="beta", slots=2,
+                                  max_len=64, block_size=4,
+                                  prefill_chunk=8)}
+    assert engines["alpha"]._spec, "registry draft pairing not resolved"
+    app = ServeApp(engines)
+    app.start()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(app))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        app.generate([1, 2, 3, 4], 4, timeout=120, model="alpha")
+        app.generate([1, 2, 3, 4], 4, timeout=120, model="beta")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for needle in (
+                'serving_models{model="alpha"} 1',
+                'serving_models{model="beta"} 1',
+                'serving_active_slots{model="alpha"}',
+                'serving_queue_depth{model="beta"}',
+                'serving_ttft_seconds_bucket{model="beta"',
+                'serving_spec_rounds_total{model="alpha"}',
+                'serving_spec_proposed_tokens_total{model="alpha"}',
+                'serving_spec_accepted_tokens_total{model="alpha"}',
+                'serving_spec_gamma{model="alpha"}',
+                'serving_spec_acceptance_rate_bucket{model="alpha"',
+                'serving_spec_verify_rounds_count{model="alpha"}'):
+            assert needle in text, f"missing from /metrics: {needle}"
+        # the spec families are per-model: the non-spec engine has none
+        assert 'serving_spec_rounds_total{model="beta"}' not in text
+        # /stats carries the spec section under the right model
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=10) as r:
+            st = json.loads(r.read())
+        assert st["models"]["alpha"]["speculative"]["rounds"] > 0
+        assert "speculative" not in st["models"]["beta"]
+    finally:
+        app.shutdown()
+        httpd.server_close()
+
+
+def test_multi_model_drained_completions_survive_other_engines_crash(
+        params, params_b):
+    """Round-robin stepping: completions engine A drained this turn are
+    DELIVERED even when engine B's step() raises right after — draining
+    popped them from A and sealed their journal entries, so dropping
+    them would strand their waiters unrecoverably (review finding on
+    the multi-engine loop)."""
+    from tony_tpu.cli.serve import ServeApp
+
+    app = _two_model_app(params, params_b)
+    try:
+        # engine beta's FIRST step blows up (armed before the threads
+        # start, so there is no race against the loop finishing beta's
+        # request first); alpha's request proceeds normally — in loop
+        # turns where both are busy, alpha (first in dict order) steps
+        # and may drain before beta's step raises
+        beta = app.engines["beta"]
+        orig_step = beta.step
+        state = {"fired": False}
+
+        def boom():
+            if not state["fired"]:
+                state["fired"] = True
+                raise RuntimeError("chaos: beta step died")
+            return orig_step()
+
+        beta.step = boom
+        prompt = [3, 5, 7, 9, 11]
+        wa = _solo(params, np.asarray(prompt, np.int32), 4)
+        results = {}
+
+        def call(model):
+            try:
+                results[model] = app.generate(prompt, 4, timeout=120,
+                                              model=model)
+            except Exception as e:           # beta may fail its request
+                results[model] = e
+
+        ts = [threading.Thread(target=call, args=(m,))
+              for m in ("alpha", "beta")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=150)
+        assert state["fired"], "the injected failure never fired"
+        # alpha's completion was delivered (not stranded to timeout);
+        # beta either replayed to success (journal on) or failed loudly
+        ra = results["alpha"]
+        assert not isinstance(ra, Exception), ra
+        assert ra.tokens == wa
+        assert not isinstance(results["beta"], TimeoutError)
+    finally:
+        app.shutdown()
+
+
+# --------------------------------------------------------------------------
+# journal model tagging
+# --------------------------------------------------------------------------
+
+def test_journal_model_field_roundtrip(tmp_path):
+    """Journal entries carry the serving model name through the file,
+    compaction, and recovery — multi-model restarts resubmit each
+    request to the engine that owns its weights."""
+    from tony_tpu.events.journal import RequestJournal
+
+    path = tmp_path / "requests.journal.jsonl"
+    j = RequestJournal(path=path)
+    j.submit(1, [1, 2], 8, model="alpha")
+    j.emit(1, [5])
+    j.submit(2, [3], 4, model="beta")
+    j.submit(3, [4], 4)                 # legacy shape: no model
+    j.close()
+    j2, entries = RequestJournal.recover(path)
+    by_id = {e.id: e for e in entries}
+    assert by_id[1].model == "alpha" and by_id[1].emitted == [5]
+    assert by_id[2].model == "beta"
+    assert by_id[3].model is None
+    j2.close()
+
+
+@pytest.mark.slow
+def test_spec_byte_identity_heavy_shape():
+    """Heavy variant of the byte-identity gate (the tier-1 tests pin it
+    at TINY shapes): a bench-like shape — deeper model, longer prompts
+    and budgets, prefix cache on, stop tokens live, gamma autotuned to
+    its ceiling — still serves byte-identical spec-on vs spec-off.
+    Slow: compiles a full extra program set."""
+    big = transformer.TransformerConfig(
+        vocab_size=1024, d_model=256, n_layers=4, n_heads=8,
+        n_kv_heads=8, d_ff=1024, max_seq_len=256, dtype=jnp.float32)
+    small = transformer.TransformerConfig(
+        vocab_size=1024, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=256, max_seq_len=256, dtype=jnp.float32)
+    bp = transformer.init(jax.random.PRNGKey(0), big)
+    sp = transformer.init(jax.random.PRNGKey(1), small)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 1024, size=int(n), dtype=np.int32)
+               for n in rng.integers(24, 80, size=12)]
+    budgets = [int(b) for b in rng.integers(32, 96, size=12)]
+    stops = (17,)
+
+    def run(**kw):
+        srv = SlotServer(bp, big, slots=4, max_len=192, block_size=8,
+                         prefill_chunk=32, prefix_cache_blocks=16,
+                         stop_tokens=stops, **kw)
+        reqs, done = _serve_burst(srv, prompts, budgets)
+        out = [(done[r.id].tokens, done[r.id].finish_reason)
+               for r in reqs]
+        st = srv.stats()
+        srv.shutdown()
+        return out, st
+
+    plain, _ = run()
+    spec, st = run(draft=sp, draft_cfg=small, spec_gamma_max=8)
+    assert spec == plain, "heavy-shape speculation changed completions"
+    assert st["speculative"]["rounds"] > 0
+
+
+@pytest.mark.slow
+def test_shared_journal_recovery_compacts_once(tmp_path, params, params_b):
+    """Multi-engine recovery of ONE shared journal file: the first
+    engine's resubmission must NOT compact the file (that would erase
+    the only durable copy of the other engine's still-unrecovered
+    entries — a crash in the window would silently lose them); the
+    single deferred compaction keeps every resubmitted entry durable
+    (review finding on the per-engine recovery loop)."""
+    from tony_tpu.events.journal import RequestJournal, read_journal
+
+    path = tmp_path / "requests.journal.jsonl"
+    dead = RequestJournal(path=path)
+    dead.submit(9001, [1, 2, 3], 8, model="alpha")
+    dead.emit(9001, [5, 6])
+    dead.submit(9002, [4, 5, 6], 8, model="beta")
+    dead.emit(9002, [7])
+    dead.close()
+
+    journal, entries = RequestJournal.recover(path)
+    engines = {
+        n: SlotServer(p, TINY, slots=2, max_len=64, block_size=4,
+                      prefill_chunk=8, journal=journal)
+        for n, p in (("alpha", params), ("beta", params_b))}
+    try:
+        a_entries = [e for e in entries if e.model == "alpha"]
+        assert engines["alpha"].recover_journal(a_entries,
+                                                compact=False) == 1
+        # beta's dead-process record must still be on disk: nothing
+        # compacted yet
+        on_disk = {e.id for e in read_journal(path)}
+        assert 9002 in on_disk, (
+            "first engine's recovery erased the other engine's only "
+            "durable copy")
+        b_entries = [e for e in entries if e.model == "beta"]
+        assert engines["beta"].recover_journal(b_entries,
+                                               compact=False) == 1
+        journal.compact()
+        # post-compaction: exactly the two LIVE resubmissions survive,
+        # with their emitted prefixes carried
+        live = read_journal(path)
+        assert len(live) == 2
+        assert all(e.emitted for e in live)
+        for eng in engines.values():
+            assert eng.run_until_drained(), "recovered request unserved"
+    finally:
+        for eng in engines.values():
+            eng.shutdown()
+        journal.close()
+
+
+@pytest.mark.slow
+def test_spec_sigkill_recovery_subprocess(tmp_path):
+    """SIGKILL a serve process mid-speculation (chaos at a spec-round
+    ordinal); the restarted process recovers the file journal and
+    finishes the orphaned requests. Slow: two subprocess serve
+    launches with compile bills."""
+    import os
+    import re
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TONY_TEST_SERVING_SIGKILL_AT_BLOCK="3")
+    args = [sys.executable, "-m", "tony_tpu.cli.main", "serve",
+            "--port", "0", "--vocab", "256", "--d-model", "64",
+            "--n-layers", "2", "--n-heads", "4", "--d-ff", "128",
+            "--dtype", "float32", "--slots", "2", "--max-len", "64",
+            "--block-size", "4", "--prefill-chunk", "8",
+            "--draft-model", "random:1",
+            "--draft-d-model", "32", "--draft-n-layers", "1",
+            "--draft-n-heads", "2", "--draft-d-ff", "64",
+            "--spec-gamma", "2",
+            "--trace-dir", str(tmp_path)]
+    proc = subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    port = None
+    deadline = time.time() + 240
+    while port is None and time.time() < deadline:
+        line = proc.stdout.readline()
+        m = re.search(r"http://[\d.]+:(\d+)", line or "")
+        if m:
+            port = int(m.group(1))
+    assert port, "serve never printed its port"
+    threading.Thread(target=proc.stdout.read, daemon=True).start()
+
+    prompt = list(range(2, 10))
+    body = json.dumps({"prompt": prompt, "max_new_tokens": 12,
+                       "timeout_s": 300}).encode()
+
+    def post():
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate", data=body)
+            with urllib.request.urlopen(req, timeout=300):
+                pass
+        except Exception:
+            pass                        # the process dies mid-request
+
+    t = threading.Thread(target=post, daemon=True)
+    t.start()
+    proc.wait(timeout=240)
+    assert proc.returncode == -signal.SIGKILL
+    # the journal survived the kill with a live entry
+    from tony_tpu.events.journal import JOURNAL_FILE, read_journal
+
+    entries = read_journal(tmp_path / JOURNAL_FILE)
+    assert entries, "no journaled in-flight request survived the kill"
+    # restart WITHOUT chaos: recovery finishes the orphaned request
+    env2 = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc2 = subprocess.Popen(args, env=env2, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 240
+        recovered = False
+        while time.time() < deadline:
+            line = proc2.stdout.readline()
+            if "journal recovery: resumed" in (line or ""):
+                recovered = True
+            if re.search(r"http://[\d.]+:(\d+)", line or ""):
+                break
+        assert recovered, "restart did not recover the journal"
+        # drain until the recovered request seals (its waiter is gone;
+        # the completion is recorded and dropped) — the journal file
+        # compacting to empty is the observable terminal
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if not read_journal(tmp_path / JOURNAL_FILE):
+                break
+            time.sleep(0.5)
+        assert not read_journal(tmp_path / JOURNAL_FILE), (
+            "recovered request never finished")
+    finally:
+        proc2.kill()
+        proc2.wait(timeout=30)

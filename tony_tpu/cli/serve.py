@@ -131,6 +131,35 @@ def build_argparser() -> argparse.ArgumentParser:
                         "crash fails in-flight requests (the pre-journal "
                         "fail-fast contract) and process restarts "
                         "recover nothing")
+    p.add_argument("--model", action="append", default=[],
+                   metavar="NAME=SPEC",
+                   help="register a named model (repeatable; multi-model "
+                        "serving: each gets its own engine/slot pool and "
+                        "requests route by their 'model' field). SPEC is "
+                        "'random[:seed]' (random init at the CLI dims), "
+                        "'hf:<dir>' (HF checkpoint), or an orbax "
+                        "checkpoint dir (optionally 'ckpt:<dir>'). "
+                        "Omitted: the classic single-model flags load "
+                        "one model named 'default'")
+    p.add_argument("--draft-model", default="",
+                   metavar="NAME-or-SPEC",
+                   help="enable speculative decoding on the default "
+                        "model: a registered model NAME (from --model) "
+                        "or a SPEC loaded at the --draft-* dims. The "
+                        "draft proposes --spec-gamma tokens per verify "
+                        "round; completions stay byte-identical to "
+                        "spec-off greedy serving")
+    p.add_argument("--spec-gamma", type=int, default=0,
+                   help="pin the speculative draft window (tokens "
+                        "proposed per verify round); 0 = autotune from "
+                        "the measured acceptance-rate EWMA, clamped to "
+                        "--spec-gamma-max")
+    p.add_argument("--spec-gamma-max", type=int, default=4,
+                   help="autotune ceiling for the draft window")
+    p.add_argument("--draft-d-model", type=int, default=64)
+    p.add_argument("--draft-n-layers", type=int, default=2)
+    p.add_argument("--draft-n-heads", type=int, default=4)
+    p.add_argument("--draft-d-ff", type=int, default=256)
     p.add_argument("--journal-checkpoint-s", type=float, default=1.0,
                    help="durability-checkpoint cadence: process the "
                         "open-loop pipeline down to pipeline_depth this "
@@ -181,37 +210,59 @@ def build_serving_mesh(spec_str: str):
 
 
 def load_model(args):
-    """(params, cfg) from the configured source — same sources as
-    lm_generate (examples/lm_generate.py)."""
+    """(params, cfg) from the classic single-model flags — same sources
+    as lm_generate (examples/lm_generate.py). Thin front for
+    ``load_named_model`` (the ``--model NAME=SPEC`` loader), so the
+    hf/orbax/random paths exist exactly once."""
+    if args.hf_checkpoint and args.checkpoint_dir:
+        raise SystemExit("--hf-checkpoint and --checkpoint-dir are exclusive")
+    if args.hf_checkpoint:
+        return load_named_model("hf:" + args.hf_checkpoint, args)
+    if args.checkpoint_dir:
+        return load_named_model("ckpt:" + args.checkpoint_dir, args)
+    return load_named_model("random", args)
+
+
+def load_named_model(spec: str, args, dims: dict | None = None):
+    """(params, cfg) for one ``--model NAME=SPEC`` / ``--draft-model``
+    entry. SPEC: ``random[:seed]`` (random init at the CLI dims —
+    smoke/bench), ``hf:<dir>`` (HF Llama/Mistral), or an orbax
+    checkpoint dir (optionally ``ckpt:<dir>``). ``dims`` overrides the
+    CLI dims (the draft model's smaller shape)."""
     import jax
     import jax.numpy as jnp
 
     from ..models import transformer
 
-    if args.hf_checkpoint and args.checkpoint_dir:
-        raise SystemExit("--hf-checkpoint and --checkpoint-dir are exclusive")
-    if args.hf_checkpoint:
+    if spec.startswith("hf:"):
         from ..models.hf_import import load_hf
 
-        return load_hf(args.hf_checkpoint, dtype=getattr(jnp, args.dtype))
+        return load_hf(spec[3:], dtype=getattr(jnp, args.dtype))
+    d = dict(d_model=args.d_model, n_layers=args.n_layers,
+             n_heads=args.n_heads, d_ff=args.d_ff)
+    if dims:
+        d.update(dims)
     cfg = transformer.TransformerConfig(
-        vocab_size=args.vocab, d_model=args.d_model, n_layers=args.n_layers,
-        n_heads=args.n_heads, n_kv_heads=args.n_heads, d_ff=args.d_ff,
-        dtype=getattr(jnp, args.dtype),
-    )
-    if args.checkpoint_dir:
-        from ..train.checkpoint import CheckpointManager
-        from ..train.step import make_optimizer
+        vocab_size=args.vocab, d_model=d["d_model"],
+        n_layers=d["n_layers"], n_heads=d["n_heads"],
+        n_kv_heads=d["n_heads"], d_ff=d["d_ff"],
+        dtype=getattr(jnp, args.dtype))
+    if spec == "random" or spec.startswith("random:"):
+        _, _, seedtxt = spec.partition(":")
+        seed = int(seedtxt) if seedtxt else args.seed
+        return transformer.init(jax.random.PRNGKey(seed), cfg), cfg
+    path = spec[5:] if spec.startswith("ckpt:") else spec
+    from ..train.checkpoint import CheckpointManager
+    from ..train.step import make_optimizer
 
-        mgr = CheckpointManager(args.checkpoint_dir)
-        if mgr.latest_step() is None:
-            raise SystemExit(f"no checkpoint found in {args.checkpoint_dir}")
-        p0 = transformer.init(jax.random.PRNGKey(args.seed), cfg)
-        restored = mgr.restore(
-            template={"params": p0, "opt_state": make_optimizer().init(p0)})
-        mgr.close()
-        return restored["params"], cfg
-    return transformer.init(jax.random.PRNGKey(args.seed), cfg), cfg
+    mgr = CheckpointManager(path)
+    if mgr.latest_step() is None:
+        raise SystemExit(f"no checkpoint found in {path}")
+    p0 = transformer.init(jax.random.PRNGKey(args.seed), cfg)
+    restored = mgr.restore(
+        template={"params": p0, "opt_state": make_optimizer().init(p0)})
+    mgr.close()
+    return restored["params"], cfg
 
 
 # sibling of requests.trace.jsonl under --trace-dir: the ServingTelemetry
@@ -223,10 +274,26 @@ class ServingLoopError(RuntimeError):
     """The serving loop died; the message carries the cause."""
 
 
+class UnknownModelError(ValueError):
+    """The request names a model this process does not serve (HTTP
+    400 — the model-aware router only posts to replicas advertising
+    the model, so reaching this means a stale advertisement or a
+    client talking to the wrong fleet)."""
+
+
 class ServeApp:
     """The serving loop + request rendezvous. One lock guards the
-    SlotServer (it is not thread-safe); HTTP threads enqueue under it and
-    block on a per-request event the loop thread sets at completion.
+    engines (a SlotServer is not thread-safe); HTTP threads enqueue
+    under it and block on a per-request event the loop thread sets at
+    completion.
+
+    Multi-model serving: construct with a ``{name: SlotServer}`` dict
+    (one engine per registry entry — cache shapes are per-config, so
+    each model owns its own slot pool) and requests route by their
+    ``model=`` field; the single loop thread steps every busy engine
+    round-robin, so two models genuinely serve concurrently from one
+    process. A bare SlotServer keeps the classic single-model shape
+    (it becomes the one engine, under its registry name).
 
     Failure model (docs/serving.md "Failure model"): a step failure is
     NOT terminal. The loop fails only the requests whose in-flight work
@@ -250,7 +317,22 @@ class ServeApp:
         from ..observability import install_compile_telemetry
         from ..train.profiling import StepTimer
 
-        self.server = server            # SlotServer
+        # engines: {model name -> SlotServer}; the first entry is the
+        # default model a nameless request gets. A bare engine is
+        # wrapped as the single entry under its own registry name.
+        if isinstance(server, dict):
+            if not server:
+                raise ValueError("ServeApp needs at least one engine")
+            self.engines = dict(server)
+        else:
+            self.engines = {
+                str(getattr(server, "model", None) or "default"): server}
+        self.default_model = next(iter(self.engines))
+        self.server = self.engines[self.default_model]  # default engine
+        # which engine serves each live request id (routing for cancel/
+        # progress/journal-seal; pruned at delivery and failure)
+        self._rid_engine: dict[int, object] = {}
+        self._stepping = None           # engine inside step() (recovery)
         self.trace_dir = trace_dir      # also hosts /debug/profile dumps
         self.lock = threading.Lock()
         self.wake = threading.Event()
@@ -326,21 +408,25 @@ class ServeApp:
         if drain and self.thread.is_alive() and self.status != "down":
             with self.lock:
                 self.draining = True
-                if hasattr(self.server, "pause_admission"):
-                    self.server.pause_admission = True
-                fail_queued = getattr(self.server, "fail_queued", None)
-                for req in (fail_queued() if callable(fail_queued) else []):
-                    ev = self._events.pop(req.id, None)
-                    if ev is not None:
-                        self._results[req.id] = ServingLoopError(
-                            f"request {req.id} failed: server shutting "
-                            "down before it was admitted")
-                        ev.set()
+                for eng in self.engines.values():
+                    if hasattr(eng, "pause_admission"):
+                        eng.pause_admission = True
+                    fail_queued = getattr(eng, "fail_queued", None)
+                    for req in (fail_queued() if callable(fail_queued)
+                                else []):
+                        ev = self._events.pop(req.id, None)
+                        self._rid_engine.pop(req.id, None)
+                        if ev is not None:
+                            self._results[req.id] = ServingLoopError(
+                                f"request {req.id} failed: server "
+                                "shutting down before it was admitted")
+                            ev.set()
             deadline = time.monotonic() + drain_timeout_s
             while time.monotonic() < deadline:
                 with self.lock:
-                    if (not self._events
-                            and getattr(self.server, "n_active", 0) == 0):
+                    if (not self._events and all(
+                            getattr(e, "n_active", 0) == 0
+                            for e in self.engines.values())):
                         break
                 time.sleep(0.05)
             with self.lock:
@@ -351,11 +437,12 @@ class ServeApp:
         self.stop.set()
         self.wake.set()
         self.thread.join(timeout=10)
-        # stop the engine's background threads (the DispatchTracker
+        # stop the engines' background threads (the DispatchTracker
         # reaper) — idempotent, and stubs without shutdown() are fine
-        engine_shutdown = getattr(self.server, "shutdown", None)
-        if callable(engine_shutdown):
-            engine_shutdown()
+        for eng in self.engines.values():
+            engine_shutdown = getattr(eng, "shutdown", None)
+            if callable(engine_shutdown):
+                engine_shutdown()
 
     def _fail_pending(self, exc: Exception) -> None:
         """Fail every waiting request with the loop's error — waiters get
@@ -363,11 +450,12 @@ class ServeApp:
         journal entries are SEALED: the client was told 'failed', so a
         later restart's journal recovery must not resurrect the request
         and decode it for nobody (the terminal is the terminal)."""
-        seal = getattr(self.server, "seal_journal", None)
         for rid, ev in list(self._events.items()):
             self._results[rid] = ServingLoopError(
                 f"serving loop failed: {exc!r}")
             self._events.pop(rid, None)
+            eng = self._rid_engine.pop(rid, self.server)
+            seal = getattr(eng, "seal_journal", None)
             if callable(seal):
                 seal(rid)
             ev.set()
@@ -392,55 +480,93 @@ class ServeApp:
         # without ever exhausting the budget (or flipping /healthz).
         # Engines without the counters (test stubs) fall back to "had
         # work to do" (active slots or a queue) observed pre-step.
-        has_ctrs = hasattr(self.server, "blocks_dispatched")
+        has_ctrs = any(hasattr(e, "blocks_dispatched")
+                       for e in self.engines.values())
 
         def dispatch_ctrs():
-            return (getattr(self.server, "admission_dispatches", 0),
-                    getattr(self.server, "blocks_dispatched", 0))
+            return tuple(
+                (getattr(e, "admission_dispatches", 0),
+                 getattr(e, "blocks_dispatched", 0))
+                for e in self.engines.values())
 
         while not self.stop.is_set():
             with self.lock:
-                busy = not self.server.idle
-                attests = (getattr(self.server, "n_active", 1) > 0
-                           or getattr(self.server, "pending", 1) > 0)
+                busy = False
+                attests = False
                 pre = dispatch_ctrs()
                 done = {}
-                if busy:
-                    self.server.step()
-                    # only drain when something is (or is known to be)
-                    # finished: in predictive mode drain_completed
-                    # forces a device sync, which called every tick
-                    # would serialize compute with the host round trip
-                    if self.server.completions_ready:
-                        done = self.server.drain_completed()
-                    elif self.journal_checkpoint_s:
-                        # durability checkpoint (bounded cadence): keep
-                        # the journal's emitted prefixes fresh for
-                        # replay/failover without draining the dispatch
-                        # runway (see SlotServer.checkpoint_progress)
-                        now = time.monotonic()
-                        if now - self._last_checkpoint \
-                                >= self.journal_checkpoint_s:
-                            ckpt = getattr(self.server,
-                                           "checkpoint_progress", None)
+                now = time.monotonic()
+                ckpt_due = bool(
+                    self.journal_checkpoint_s
+                    and now - self._last_checkpoint
+                    >= self.journal_checkpoint_s)
+                # one loop thread steps every busy engine round-robin:
+                # two models serve concurrently from one process, each
+                # from its own slot pool. One engine's step() failure
+                # must NOT discard completions another engine already
+                # DRAINED this turn (draining popped them from the
+                # engine and sealed their journal entries — dropping
+                # `done` would strand their waiters unrecoverably) and
+                # must not STARVE the engines after it in iteration
+                # order: the remaining engines still step this turn, the
+                # drained set is delivered, and only then does the FIRST
+                # failure propagate to _recover (which resets exactly
+                # self._stepping, the engine whose step died; a second
+                # failing engine is caught on the next turn).
+                step_exc: Exception | None = None
+                failed_eng = None
+                for eng in self.engines.values():
+                    if eng.idle:
+                        continue
+                    busy = True
+                    attests = attests or (
+                        getattr(eng, "n_active", 1) > 0
+                        or getattr(eng, "pending", 1) > 0)
+                    self._stepping = eng
+                    try:
+                        eng.step()
+                        # only drain when something is (or is known to
+                        # be) finished: in predictive mode
+                        # drain_completed forces a device sync, which
+                        # called every tick would serialize compute
+                        # with the host round trip
+                        if eng.completions_ready:
+                            done.update(eng.drain_completed())
+                        elif ckpt_due:
+                            # durability checkpoint (bounded cadence):
+                            # keep the journal's emitted prefixes fresh
+                            # for replay/failover without draining the
+                            # dispatch runway (see
+                            # SlotServer.checkpoint_progress)
+                            ckpt = getattr(eng, "checkpoint_progress",
+                                           None)
                             if callable(ckpt):
                                 ckpt()
-                                done = self.server.drain_completed() \
-                                    if self.server.completions_ready \
-                                    else {}
-                            self._last_checkpoint = now
-                    self._observe_load()
-                if has_ctrs:
-                    attests = dispatch_ctrs() != pre
-                if busy and attests and self.status == "degraded":
-                    # a real device dispatch survived: recovery complete,
-                    # the failure streak, its backoff, and the sticky
-                    # error message re-arm
-                    self.status = "ok"
-                    self._restart_streak = 0
-                    self.error = None
+                                if eng.completions_ready:
+                                    done.update(eng.drain_completed())
+                    except Exception as e:
+                        if step_exc is None:
+                            step_exc, failed_eng = e, eng
+                if step_exc is None:
+                    self._stepping = None
+                    if busy and ckpt_due:
+                        self._last_checkpoint = now
+                    if busy:
+                        self._observe_load()
+                    if has_ctrs:
+                        attests = dispatch_ctrs() != pre
+                    if busy and attests and self.status == "degraded":
+                        # a real device dispatch survived: recovery
+                        # complete — the failure streak, its backoff,
+                        # and the sticky error message re-arm
+                        self.status = "ok"
+                        self._restart_streak = 0
+                        self.error = None
             if done:
                 self._deliver(done)
+            if step_exc is not None:
+                self._stepping = failed_eng     # _recover resets THIS one
+                raise step_exc
             if not busy:
                 # idle: the next busy turn must not record this gap as a
                 # giant scheduling turn in loop_turn_s
@@ -462,6 +588,7 @@ class ServeApp:
         with self.lock:
             for rid, comp in done.items():
                 ev = self._events.pop(rid, None)
+                self._rid_engine.pop(rid, None)
                 if ev is None:
                     # no waiter (timed out / cancelled / failed submit):
                     # drop the completion instead of growing _results
@@ -491,7 +618,10 @@ class ServeApp:
             self.loop_failures += 1
             self._restart_streak += 1
             self.error = f"{type(exc).__name__}: {exc}"
-            reset = getattr(self.server, "reset", None)
+            # reset the engine whose step died (the others' state is
+            # intact — resetting them would re-prefill for nothing)
+            failed_eng = self._stepping or self.server
+            reset = getattr(failed_eng, "reset", None)
             if not callable(reset):
                 self.status = "down"
                 self._fail_pending(exc)
@@ -516,6 +646,7 @@ class ServeApp:
             # ring; queued waiters ride through the restart untouched
             for rid in lost:
                 ev = self._events.pop(rid, None)
+                self._rid_engine.pop(rid, None)
                 if ev is not None:
                     self._results[rid] = ServingLoopError(
                         f"request {rid} lost to a serving-loop failure: "
@@ -531,13 +662,28 @@ class ServeApp:
 
     # ------------------------------------------------------------ requests
 
+    def _engine_for(self, model: str | None):
+        """Route a request's ``model=`` to its engine (None = the
+        default model). Unknown names are an UnknownModelError — the
+        HTTP layer's 400, never a silent fallback to the wrong
+        weights."""
+        if model is None:
+            return self.server
+        eng = self.engines.get(str(model))
+        if eng is None:
+            raise UnknownModelError(
+                f"unknown model {model!r}; this process serves "
+                f"{sorted(self.engines)}")
+        return eng
+
     def submit_async(self, prompt, max_new_tokens: int,
                      timeout: float = 600.0,
                      temperature: float | None = None,
                      top_k: int | None = None,
                      cache_prompt: bool | None = None,
                      resume_tokens: list | None = None,
-                     progress_key: str | None = None):
+                     progress_key: str | None = None,
+                     model: str | None = None):
         """Admission half of generate(): returns (request_id, event). The
         request carries ``timeout`` as its queue deadline — if it is
         still queued when the waiter would have given up, admission skips
@@ -545,14 +691,18 @@ class ServeApp:
         forces an already-emitted prefix (router failover resume — the
         completion's tokens include it); ``progress_key`` registers a
         caller-chosen key for GET /progress so a router can journal
-        this request's emitted prefix while it runs."""
+        this request's emitted prefix while it runs; ``model`` routes
+        to the named engine (multi-model serving)."""
         from ..models.serving import Request
 
+        engine = self._engine_for(model)
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k,
                       cache_prompt=cache_prompt,
                       resume_tokens=resume_tokens,
-                      deadline=time.monotonic() + timeout)
+                      deadline=time.monotonic() + timeout,
+                      model=getattr(engine, "model", None)
+                      if model is not None else None)
         ev = threading.Event()
         try:
             # health check + event registration + submit are ONE atomic
@@ -566,13 +716,15 @@ class ServeApp:
                     raise ServingLoopError(
                         "server is draining; not accepting requests")
                 self._events[req.id] = ev
-                self.server.submit(req)     # may shed: QueueFullError
+                engine.submit(req)          # may shed: QueueFullError
+                self._rid_engine[req.id] = engine
                 if progress_key:
                     self._progress_keys[str(progress_key)] = req.id
                     if len(self._progress_keys) > self._progress_keys_cap:
                         self._evict_progress_keys_locked()
         except Exception:
             self._events.pop(req.id, None)   # rejected: no waiter to leak
+            self._rid_engine.pop(req.id, None)
             raise
         self.wake.set()
         return req.id, ev
@@ -586,13 +738,14 @@ class ServeApp:
         bounded by slots+queue, far under the cap, so the blind
         oldest-first fallback only fires for engines without a
         journal."""
-        prog = getattr(self.server, "progress", None)
-        if callable(prog):
-            for key in list(self._progress_keys):
-                if len(self._progress_keys) <= self._progress_keys_cap:
-                    return
-                if prog(self._progress_keys[key]) is None:  # terminal
-                    del self._progress_keys[key]
+        for key in list(self._progress_keys):
+            if len(self._progress_keys) <= self._progress_keys_cap:
+                return
+            rid = self._progress_keys[key]
+            prog = getattr(self._rid_engine.get(rid, self.server),
+                           "progress", None)
+            if not callable(prog) or prog(rid) is None:     # terminal
+                del self._progress_keys[key]
         while len(self._progress_keys) > self._progress_keys_cap:
             self._progress_keys.popitem(last=False)
 
@@ -603,13 +756,14 @@ class ServeApp:
         already terminal are simply absent (the caller treats absence
         as 'no information', keeping whatever prefix it last saw)."""
         out = {}
-        prog = getattr(self.server, "progress", None)
-        if not callable(prog):
-            return out
         with self.lock:
             for key in keys:
                 rid = self._progress_keys.get(key)
                 if rid is None:
+                    continue
+                prog = getattr(self._rid_engine.get(rid, self.server),
+                               "progress", None)
+                if not callable(prog):
                     continue
                 p = prog(rid)
                 if p is not None:
@@ -629,16 +783,19 @@ class ServeApp:
         with self.lock:
             self._events.pop(request_id, None)
             self._results.pop(request_id, None)
-            srv_cancel = getattr(self.server, "cancel", None)
+            eng = self._rid_engine.pop(request_id, self.server)
+            srv_cancel = getattr(eng, "cancel", None)
             return bool(callable(srv_cancel) and srv_cancel(request_id))
 
     def generate(self, prompt, max_new_tokens: int, timeout: float = 600.0,
                  temperature: float | None = None,
                  top_k: int | None = None,
-                 cache_prompt: bool | None = None):
+                 cache_prompt: bool | None = None,
+                 model: str | None = None):
         rid, ev = self.submit_async(
             prompt, max_new_tokens, timeout=timeout,
-            temperature=temperature, top_k=top_k, cache_prompt=cache_prompt)
+            temperature=temperature, top_k=top_k, cache_prompt=cache_prompt,
+            model=model)
         if not ev.wait(timeout):
             self.cancel(rid)     # free the slot, don't decode for nobody
             raise TimeoutError(
@@ -655,28 +812,47 @@ class ServeApp:
         the portal/history layer sees TTFT next to the resource
         metrics without learning a new payload shape."""
         m = self.metrics
-        m.observe(_metrics.SERVING_ACTIVE_SLOTS,
-                  float(self.server.n_active))
-        m.observe(_metrics.SERVING_QUEUE_DEPTH, float(self.server.pending))
-        computed = getattr(self.server, "prefill_tokens_computed", 0)
-        reused = getattr(self.server, "prefill_tokens_reused", 0)
+        engines = list(self.engines.values())
+
+        def total(attr):
+            return float(sum(getattr(e, attr, 0) for e in engines))
+
+        m.observe(_metrics.SERVING_ACTIVE_SLOTS, total("n_active"))
+        m.observe(_metrics.SERVING_QUEUE_DEPTH, total("pending"))
+        computed = total("prefill_tokens_computed")
+        reused = total("prefill_tokens_reused")
         if computed + reused > 0:
             m.observe(_metrics.SERVING_PREFILL_REUSED_FRAC,
                       reused / (computed + reused))
-        m.observe(_metrics.SERVING_SHED_TOTAL,
-                  float(getattr(self.server, "shed_requests", 0)))
+        m.observe(_metrics.SERVING_SHED_TOTAL, total("shed_requests"))
         m.observe(_metrics.SERVING_CANCELLED_TOTAL,
-                  float(getattr(self.server, "cancelled_requests", 0)))
-        m.observe(_metrics.SERVING_EXPIRED_TOTAL,
-                  float(getattr(self.server, "expired_requests", 0)))
+                  total("cancelled_requests"))
+        m.observe(_metrics.SERVING_EXPIRED_TOTAL, total("expired_requests"))
         m.observe(_metrics.SERVING_LOOP_RESTARTS,
                   float(self.loop_restarts))
         tel = getattr(self.server, "telemetry", None)
         if tel is not None:
+            # the scheduling turn is app-level (one loop thread steps
+            # every engine); it ticks into the default engine's
+            # telemetry, whose loop_turn_s is therefore the process's
             dt = self._turn_timer.tick()
             if dt is not None:
                 tel.observe("loop_turn_s", dt)
-            ttft, tpot = tel.hist["ttft_s"], tel.hist["tpot_s"]
+
+            def merged(name):
+                hists = [t.hist[name] for t in
+                         (getattr(e, "telemetry", None)
+                          for e in engines) if t is not None]
+                if len(hists) == 1:
+                    return hists[0]
+                from ..observability import Histogram
+
+                out = Histogram()
+                for h in hists:
+                    out.merge(h)
+                return out
+
+            ttft, tpot = merged("ttft_s"), merged("tpot_s")
             if ttft.count:
                 m.observe(_metrics.SERVING_TTFT_P50_S, ttft.quantile(0.5))
                 m.observe(_metrics.SERVING_TTFT_P99_S, ttft.quantile(0.99))
@@ -770,11 +946,26 @@ class ServeApp:
         if tel is not None:
             # render under the serving lock: the loop thread mutates the
             # histograms under it, and a mid-observe scrape would emit
-            # buckets disagreeing with _count/_sum
+            # buckets disagreeing with _count/_sum. Multi-model: the
+            # unlabeled series is the PROCESS aggregate — engines'
+            # histograms share bounds, so they merge into a scratch
+            # copy (the {model=...} partition below carries each
+            # engine's own)
+            from ..observability import Histogram as _Hist
+
+            tels = [t for t in (getattr(e, "telemetry", None)
+                                for e in self.engines.values())
+                    if t is not None]
             with self.lock:
                 for name, help_text in TELEMETRY_HISTOGRAMS.items():
                     prom = "serving_" + name[:-2] + "_seconds"
-                    r.histogram(prom, tel.hist[name], help_text)
+                    if len(tels) > 1:
+                        merged = _Hist()
+                        for t in tels:
+                            merged.merge(t.hist[name])
+                        r.histogram(prom, merged, help_text)
+                    else:
+                        r.histogram(prom, tel.hist[name], help_text)
         # device-time attribution (observability.DispatchTracker): how
         # long the device actually spent behind each dispatched program,
         # per program kind, plus the measured in-flight pipeline depth —
@@ -820,6 +1011,81 @@ class ServeApp:
             r.gauge("serving_task_metric", entry["value"],
                     "MetricsAccumulator snapshot (max_/avg_ per gauge)",
                     labels={"name": entry["name"]})
+        # ---- per-model partition (multi-model serving) ----
+        # every registered model gets an info-gauge series, and the
+        # serving load/latency families repeat with a {model="..."}
+        # label partitioning the unlabeled process-level aggregates
+        # above — so two models behind one process are separable in any
+        # scraper, resolving the "one anonymous model" limitation
+        # (docs/observability.md "Per-model labels")
+        per_model = st.get("models", {})
+        for name, eng in self.engines.items():
+            lab = {"model": name}
+            r.gauge(_metrics.SERVING_MODELS, 1,
+                    "registered serving models (info gauge: one series "
+                    "per model, value 1)", labels=lab)
+            est = per_model.get(name) or {}
+            r.gauge(_metrics.SERVING_ACTIVE_SLOTS, est.get("active", 0),
+                    "slots holding an unfinished request",
+                    labels=lab)
+            r.gauge(_metrics.SERVING_QUEUE_DEPTH, est.get("queued", 0),
+                    "requests waiting for a slot", labels=lab)
+            for fam, key in (
+                    (_metrics.SERVING_SHED_TOTAL, "shed"),
+                    (_metrics.SERVING_CANCELLED_TOTAL, "cancelled"),
+                    (_metrics.SERVING_EXPIRED_TOTAL, "expired"),
+                    (_metrics.SERVING_REPLAYS_TOTAL, "replays"),
+                    (_metrics.SERVING_REPLAYED_TOKENS_TOTAL,
+                     "replayed_tokens"),
+                    ("serving_blocks_dispatched_total",
+                     "blocks_dispatched")):
+                if key in est:
+                    r.counter(fam, est[key], labels=lab)
+            etel = getattr(eng, "telemetry", None)
+            if etel is not None:
+                with self.lock:
+                    for hname in ("ttft_s", "tpot_s", "queue_wait_s",
+                                  "e2e_s"):
+                        r.histogram(
+                            "serving_" + hname[:-2] + "_seconds",
+                            etel.hist[hname], labels=lab)
+            # speculative decoding families (spec-enabled engines only):
+            # proposals vs acceptances, the live autotuned gamma, and
+            # the acceptance-rate / verify-round histograms
+            spec = est.get("speculative")
+            if spec:
+                r.counter(_metrics.SERVING_SPEC_ROUNDS_TOTAL,
+                          spec.get("rounds", 0),
+                          "speculative verify rounds dispatched",
+                          labels=lab)
+                r.counter(_metrics.SERVING_SPEC_PROPOSED_TOKENS_TOTAL,
+                          spec.get("proposed_tokens", 0),
+                          "draft tokens proposed for verification",
+                          labels=lab)
+                r.counter(_metrics.SERVING_SPEC_ACCEPTED_TOKENS_TOTAL,
+                          spec.get("accepted_tokens", 0),
+                          "draft tokens the target accepted", labels=lab)
+                r.gauge(_metrics.SERVING_SPEC_GAMMA,
+                        spec.get("gamma", 0),
+                        "the next verify round's draft window (autotuned "
+                        "from the acceptance EWMA, or pinned)",
+                        labels=lab)
+                # render under the serving lock: the loop thread
+                # mutates these histograms in _process, same contract
+                # as the telemetry histograms above
+                with self.lock:
+                    ah = getattr(eng, "spec_accept_hist", None)
+                    if ah is not None:
+                        r.histogram(
+                            _metrics.SERVING_SPEC_ACCEPTANCE_RATE, ah,
+                            "per-round draft acceptance rate "
+                            "(accepted/gamma, pre-clamp)", labels=lab)
+                    vh = getattr(eng, "spec_rounds_hist", None)
+                    if vh is not None:
+                        r.histogram(
+                            _metrics.SERVING_SPEC_VERIFY_ROUNDS, vh,
+                            "verify rounds per completed request",
+                            labels=lab)
         return r.render()
 
     def health(self) -> dict:
@@ -836,18 +1102,39 @@ class ServeApp:
                     "error": self.error,
                     "loop_restarts": self.loop_restarts}
 
+    # top-level /stats keys a multi-model process SUMS across engines so
+    # the unlabeled process view (and the /metrics counters rendered
+    # from it) stays a true aggregate, not the default engine's slice
+    _AGGREGATE_STAT_KEYS = (
+        "slots", "active", "queued", "shed", "cancelled", "expired",
+        "resets", "replays", "replayed_tokens", "blocks_dispatched",
+        "admission_dispatches", "prefill_tokens_computed",
+        "prefill_tokens_reused", "chaos_faults_injected")
+
     def stats(self) -> dict:
         with self.lock:
-            if hasattr(self.server, "stats"):   # SlotServer counters
-                out = self.server.stats()
-            else:
-                out = {
-                    "slots": self.server.slots,
-                    "active": self.server.n_active,
-                    "queued": self.server.pending,
-                    "max_len": self.server.max_len,
-                    "block_size": self.server.block_size,
-                }
+            # per-model partition: one stats payload per engine, keyed
+            # by registry name (the router's model-aware routing reads
+            # the KEYS as this replica's advertised model set). The
+            # top-level payload is the DEFAULT engine's (computed once
+            # — its dict doubles as the models entry), with the load/
+            # counter keys summed across engines so single-number
+            # consumers see the whole process.
+            per = {
+                name: (eng.stats() if hasattr(eng, "stats") else {
+                    "slots": getattr(eng, "slots", 0),
+                    "active": getattr(eng, "n_active", 0),
+                    "queued": getattr(eng, "pending", 0),
+                    "max_len": getattr(eng, "max_len", 0),
+                    "block_size": getattr(eng, "block_size", 0)})
+                for name, eng in self.engines.items()}
+            out = dict(per[self.default_model])
+            out["models"] = per
+            if len(self.engines) > 1:
+                for k in self._AGGREGATE_STAT_KEYS:
+                    if k in out:
+                        out[k] = sum(int(p.get(k, 0) or 0)
+                                     for p in per.values())
             out["loop"] = {
                 "status": self.status,
                 "restarts": self.loop_restarts,
@@ -1025,12 +1312,16 @@ def make_handler(app: ServeApp):
                 if progress_key is not None and not isinstance(
                         progress_key, str):
                     raise ValueError("progress_key must be a string")
+                model = payload.get("model")
+                if model is not None and not isinstance(model, str):
+                    raise ValueError("model must be a string")
                 rid, ev = app.submit_async(
                     prompt, max_new, timeout=timeout,
                     temperature=None if temp is None else float(temp),
                     top_k=None if top_k is None else int(top_k),
                     cache_prompt=cache_prompt,
-                    resume_tokens=resume, progress_key=progress_key)
+                    resume_tokens=resume, progress_key=progress_key,
+                    model=model)
             except QueueFullError as e:
                 # shed: the queue is full. 429 + Retry-After is the
                 # load-balancer contract — retry elsewhere/later instead
@@ -1081,22 +1372,85 @@ def make_handler(app: ServeApp):
 
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
-    params, cfg = load_model(args)
 
+    from ..models.registry import ModelRegistry
     from ..models.serving import SlotServer
 
+    # ---- model registry: every served model is a named entry ----
+    registry = ModelRegistry()
+    if args.model:
+        if args.hf_checkpoint or args.checkpoint_dir:
+            raise SystemExit(
+                "--model and the classic --hf-checkpoint/"
+                "--checkpoint-dir flags are exclusive: with --model, "
+                "the classic flags would be silently ignored — name "
+                "the checkpoint as a --model entry instead")
+        for item in args.model:
+            name, sep, spec = item.partition("=")
+            if not sep or not name:
+                raise SystemExit(
+                    f"--model expects NAME=SPEC, got {item!r}")
+            p_, c_ = load_named_model(spec, args)
+            registry.register(name, p_, c_, source=spec)
+    else:
+        params, cfg = load_model(args)
+        registry.register(
+            "default", params, cfg,
+            source=args.hf_checkpoint or args.checkpoint_dir or "random")
+    default_name = registry.default.name
+    draft_name = None
+    if args.draft_model:
+        if args.draft_model in registry:
+            draft_name = args.draft_model
+        else:
+            if "draft" in registry:
+                raise SystemExit(
+                    "--draft-model SPEC registers under the reserved "
+                    "name 'draft', which --model already claimed — "
+                    "either reference that entry by name "
+                    "(--draft-model draft) or rename it")
+            dp, dc = load_named_model(
+                args.draft_model, args,
+                dims=dict(d_model=args.draft_d_model,
+                          n_layers=args.draft_n_layers,
+                          n_heads=args.draft_n_heads,
+                          d_ff=args.draft_d_ff))
+            registry.register("draft", dp, dc, source=args.draft_model)
+            draft_name = "draft"
+        if draft_name == default_name:
+            raise SystemExit(
+                f"--draft-model {args.draft_model!r} names the default "
+                "serving model itself — a model cannot be its own "
+                "draft (register the draft as a separate --model entry "
+                "or give a SPEC)")
+        # the default model speculates with this draft; the SlotServer
+        # resolves the pairing straight off the registry entry
+        registry.get(default_name).draft = draft_name
+    serving_names = [n for n in registry.names() if n != draft_name]
+
     if args.mesh:
+        if len(serving_names) > 1 or draft_name:
+            raise SystemExit(
+                "--mesh serves a single model without a draft "
+                "(tensor-parallel speculative/multi-model serving is "
+                "not wired)")
         from ..models.generate import prepare_decode
 
         mesh = build_serving_mesh(args.mesh)
         # prepare ONCE onto the mesh and drop the unsharded masters: the
         # server then holds a single sharded copy of the model
-        params = prepare_decode(params, cfg, weight_dtype=args.weight_dtype,
-                                mesh=mesh)
+        entry = registry.get(default_name)
+        registry.register(
+            default_name,
+            prepare_decode(entry.weights, entry.cfg,
+                           weight_dtype=args.weight_dtype, mesh=mesh),
+            entry.cfg, source=entry.source)
     # request durability: file-backed journal under --trace-dir (a
     # SIGKILLed process's unfinished requests are recovered below and
     # FINISHED by this one); in-memory otherwise (loop-crash replay
     # only). --no-replay restores the fail-fast contract end to end.
+    # ONE journal serves every engine (ids are process-global); entries
+    # carry the model name so recovery resubmits to the right engine.
     journal = None
     recovered_entries = []
     if not args.no_replay and args.trace_dir:
@@ -1107,22 +1461,51 @@ def main(argv=None) -> int:
         journal, recovered_entries = RequestJournal.recover(
             _Path(args.trace_dir) / JOURNAL_FILE)
         print(f"request journal -> {journal.path}", flush=True)
-    slot_server = SlotServer(
-        params, cfg, slots=args.slots, max_len=args.max_len,
-        block_size=args.block_size, prefill_chunk=args.prefill_chunk,
-        kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
-        temperature=args.temperature, top_k=args.top_k,
-        stop_tokens=tuple(int(t) for t in args.stop_tokens.split()),
-        pad_id=args.pad_id, seed=args.seed,
-        batched_admission=not args.per_slot_admission,
-        prefix_cache_blocks=args.prefix_cache_blocks,
-        cache_prompts=not args.no_cache_prompts,
-        max_queue=args.max_queue,
-        journal=journal, replay=not args.no_replay)
+    engines = {}
+    for n in serving_names:
+        engines[n] = SlotServer(
+            registry=registry, model=n,
+            slots=args.slots, max_len=args.max_len,
+            block_size=args.block_size, prefill_chunk=args.prefill_chunk,
+            kv_dtype=args.kv_dtype, weight_dtype=args.weight_dtype,
+            temperature=args.temperature, top_k=args.top_k,
+            stop_tokens=tuple(int(t) for t in args.stop_tokens.split()),
+            pad_id=args.pad_id, seed=args.seed,
+            batched_admission=not args.per_slot_admission,
+            prefix_cache_blocks=args.prefix_cache_blocks,
+            cache_prompts=not args.no_cache_prompts,
+            max_queue=args.max_queue,
+            journal=journal, replay=not args.no_replay,
+            spec_gamma=args.spec_gamma,
+            spec_gamma_max=args.spec_gamma_max)
+    slot_server = engines[default_name]
     if recovered_entries:
-        n = slot_server.recover_journal(recovered_entries)
-        print(f"journal recovery: resumed {n} unfinished request(s) "
-              "from the previous process", flush=True)
+        # pre-multi-model records carry no model name and belong to the
+        # default engine; entries naming a model this relaunch no longer
+        # registers are dropped LOUDLY (no engine could serve them).
+        # compact=False: the engines share ONE journal file, and
+        # compacting after the first engine's resubmission would erase
+        # the only durable copy of the later engines' entries — a crash
+        # in that window would silently lose them. One compaction after
+        # EVERY engine has journaled its resubmissions keeps the
+        # double-replay-never-lose contract (it also finally drops the
+        # orphaned-model records, which no future launch could serve).
+        for n, eng in engines.items():
+            mine = [e for e in recovered_entries
+                    if (e.model or default_name) == n]
+            if mine:
+                cnt = eng.recover_journal(mine, compact=False)
+                print(f"journal recovery: resumed {cnt} unfinished "
+                      f"request(s) for model {n!r} from the previous "
+                      "process", flush=True)
+        orphans = [e for e in recovered_entries
+                   if (e.model or default_name) not in engines]
+        if orphans:
+            print(f"journal recovery: dropped {len(orphans)} entr(y/ies) "
+                  f"naming models this process no longer serves "
+                  f"({sorted({e.model for e in orphans})})", flush=True)
+        if journal is not None:
+            journal.compact()
     trace_writer = None
     telemetry_state_path = None
     if args.trace_dir:
@@ -1131,7 +1514,8 @@ def main(argv=None) -> int:
         from ..events.trace import TraceWriter
 
         trace_writer = TraceWriter(args.trace_dir)
-        slot_server.trace_sink = trace_writer.write
+        for eng in engines.values():
+            eng.trace_sink = trace_writer.write
         print(f"request traces -> {trace_writer.path}", flush=True)
         # histogram persistence across serve restarts: a re-armed server
         # resumes the cumulative /metrics buckets instead of zeroing
@@ -1150,7 +1534,7 @@ def main(argv=None) -> int:
                 # a stale/incompatible dump must not block startup —
                 # including valid JSON of the wrong shape
                 print(f"telemetry state not restored: {e}", flush=True)
-    app = ServeApp(slot_server, max_loop_restarts=args.loop_max_restarts,
+    app = ServeApp(engines, max_loop_restarts=args.loop_max_restarts,
                    loop_backoff_s=args.loop_backoff_s,
                    trace_dir=args.trace_dir,
                    journal_checkpoint_s=(0.0 if args.no_replay
@@ -1186,7 +1570,11 @@ def main(argv=None) -> int:
 
     _signal.signal(_signal.SIGTERM, _on_signal)
     _signal.signal(_signal.SIGINT, _on_signal)
-    print(f"serving {cfg.n_layers}L d{cfg.d_model} on "
+    model_descs = ", ".join(
+        f"{n}={registry.get(n).cfg.n_layers}L"
+        f"d{registry.get(n).cfg.d_model}" for n in serving_names)
+    spec_desc = (f" +draft {draft_name}" if draft_name else "")
+    print(f"serving {model_descs}{spec_desc} on "
           f"http://{args.host}:{httpd.server_address[1]} "
           f"({args.slots} slots x {args.max_len} tokens)", flush=True)
     try:

@@ -30,7 +30,7 @@ journal intact. Record shapes::
 
     {"op": "submit", "id": 3, "prompt": [...], "max_new_tokens": 64,
      "temperature": null, "top_k": null, "cache_prompt": null,
-     "seed": 0}
+     "seed": 0, "model": null}
     {"op": "emit", "id": 3, "tokens": [7, 9]}
     {"op": "end", "id": 3}
 
@@ -70,6 +70,11 @@ class JournalEntry:
     seed: int | None = None
     emitted: list[int] = field(default_factory=list)
     deadline: float | None = None
+    # multi-model serving: which registry entry served this request, so
+    # recovery resubmits it to the RIGHT engine (None = the process's
+    # default/only model — every pre-multi-model journal record reads
+    # back this way)
+    model: str | None = None
 
 
 class RequestJournal:
@@ -115,7 +120,8 @@ class RequestJournal:
     def submit(self, rid: int, prompt, max_new_tokens: int, *,
                temperature=None, top_k=None, cache_prompt=None,
                seed=None, deadline=None,
-               emitted: list[int] | None = None) -> None:
+               emitted: list[int] | None = None,
+               model: str | None = None) -> None:
         """Open an entry for a newly accepted request. ``emitted``
         pre-seeds the record for resumed requests (router failover /
         journal recovery) so a second failure replays from the full
@@ -125,13 +131,14 @@ class RequestJournal:
         entry = JournalEntry(
             id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             temperature=temperature, top_k=top_k, cache_prompt=cache_prompt,
-            seed=seed, emitted=emitted, deadline=deadline)
+            seed=seed, emitted=emitted, deadline=deadline, model=model)
         with self._lock:
             self._entries[rid] = entry
         self._append({"op": "submit", "id": rid, "prompt": prompt,
                       "max_new_tokens": int(max_new_tokens),
                       "temperature": temperature, "top_k": top_k,
-                      "cache_prompt": cache_prompt, "seed": seed})
+                      "cache_prompt": cache_prompt, "seed": seed,
+                      "model": model})
         if emitted:
             self._append({"op": "emit", "id": rid, "tokens": emitted})
 
@@ -181,7 +188,8 @@ class RequestJournal:
                              "temperature": e.temperature,
                              "top_k": e.top_k,
                              "cache_prompt": e.cache_prompt,
-                             "seed": e.seed}) + "\n")
+                             "seed": e.seed,
+                             "model": e.model}) + "\n")
                         if e.emitted:
                             f.write(json.dumps(
                                 {"op": "emit", "id": e.id,
@@ -270,7 +278,8 @@ def read_journal(path: str | Path) -> list[JournalEntry]:
                         temperature=rec.get("temperature"),
                         top_k=rec.get("top_k"),
                         cache_prompt=rec.get("cache_prompt"),
-                        seed=rec.get("seed"))
+                        seed=rec.get("seed"),
+                        model=rec.get("model"))
                 elif op == "emit":
                     entry = entries.get(rid)
                     if entry is not None:

@@ -60,6 +60,23 @@ SERVING_RETRY_AFTER_S = "serving_retry_after_s"
 # emitted tokens carried across the death boundary
 SERVING_REPLAYS_TOTAL = "serving_replays_total"
 SERVING_REPLAYED_TOKENS_TOTAL = "serving_replayed_tokens_total"
+# multi-model serving (models/registry.py): the info gauge (one series
+# per registered model, value 1) that makes the model inventory
+# scrapeable; the per-model partitions of the serving families carry a
+# {model="..."} label next to the process-level unlabeled aggregates
+# (docs/observability.md "Per-model labels")
+SERVING_MODELS = "serving_models"
+# speculative decoding in continuous batching (models/serving.py
+# _spec_block): verify rounds dispatched, draft proposals verified vs
+# accepted (host-observed, lag the device by the pipeline), the live
+# autotuned gamma, and the acceptance-rate / verify-rounds-per-request
+# histograms the autotuner and capacity planning read
+SERVING_SPEC_ROUNDS_TOTAL = "serving_spec_rounds_total"
+SERVING_SPEC_PROPOSED_TOKENS_TOTAL = "serving_spec_proposed_tokens_total"
+SERVING_SPEC_ACCEPTED_TOKENS_TOTAL = "serving_spec_accepted_tokens_total"
+SERVING_SPEC_GAMMA = "serving_spec_gamma"
+SERVING_SPEC_ACCEPTANCE_RATE = "serving_spec_acceptance_rate"
+SERVING_SPEC_VERIFY_ROUNDS = "serving_spec_verify_rounds"
 
 # driver-side cluster telemetry (rendered by Driver.render_metrics on the
 # driver's GET /metrics — docs/observability.md "Driver metrics"). Named

@@ -11,6 +11,7 @@ from .generate import (
     prepare_decode,
     sample_token,
 )
+from .registry import ModelEntry, ModelRegistry
 from .speculative import speculative_generate
 from .transformer import (
     TransformerConfig,
@@ -29,4 +30,5 @@ __all__ = [
     "KVCache", "init_cache", "generate", "sample_token",
     "prepare_decode", "DecodeWeights", "speculative_generate",
     "PrefixPool", "init_prefix_pool",
+    "ModelEntry", "ModelRegistry",
 ]

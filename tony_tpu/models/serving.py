@@ -132,6 +132,7 @@ import collections
 import functools
 import itertools
 import logging
+import math
 import os
 import random
 import signal
@@ -148,10 +149,12 @@ from .. import constants as c
 from ..events.journal import RequestJournal
 from ..observability import (
     DispatchTracker,
+    Histogram,
     RequestTrace,
     ServiceRateEstimator,
     ServingTelemetry,
 )
+from .registry import ModelEntry, ModelRegistry
 
 log = logging.getLogger(__name__)
 
@@ -231,6 +234,11 @@ class Request:
     cache_prompt: bool | None = None
     deadline: float | None = None
     resume_tokens: list | None = None
+    # multi-model serving: which registry entry should serve this
+    # request. The engine itself is single-model (the ServeApp routes
+    # by name to the right engine); the field rides the Request so the
+    # HTTP payload's model= survives into traces and the journal.
+    model: str | None = None
     id: int = field(default_factory=itertools.count().__next__)
 
 
@@ -835,6 +843,193 @@ def _cancel_slot(active, slot, *, shardings: DecodeShardings | None = None):
     return active
 
 
+def _spec_rows_forward(params, cfg, tokens, ck, cv, ks_buf, vs_buf,
+                       lens, offsets, active, cap):
+    """Forward L new tokens PER ROW (rows = slots) at per-row logical
+    positions ``lens[r]..lens[r]+L-1``, scattering each row's K/V into
+    its own ring — the building block of the speculative propose/verify
+    round. This is the multi-token per-row-position forward the shared-
+    cursor decode path deliberately avoids (per-row-offset writes lower
+    to scatters): speculation amortizes the scatter over up to gamma+1
+    tokens per dispatch, the same trade `_prefill_batch` already makes
+    per admission burst, and pays it back by streaming the target
+    weights once per ROUND instead of once per token.
+
+    Writes land only for ``active`` rows at positions ``< cap[r]`` —
+    everything else diverts out of bounds and drops. The cap matters for
+    ring safety: without the shared cursor, a row's ring holds logical
+    position p at index (offset+p) mod M, and a verify window overhanging
+    ``max_len`` would wrap onto the row's own earliest prompt KV. No
+    delivered emission ever needs KV at positions >= target (the row
+    freezes at target), so dropping those writes is exact, not lossy.
+
+    Returns (all-position logits [S, L, V] f32, ck, cv, k_scales,
+    v_scales). No fused/quantized weights — like the prefill programs,
+    exactness vs the plain decode path requires the raw-weight numerics
+    (the qkv/gate-up fusion is value-identical, but w8a16 is not, which
+    is why speculative serving rejects weight_dtype="int8")."""
+    dt = cfg.dtype
+    s, l = tokens.shape
+    m_cap = ck.shape[3]
+    positions = lens[:, None] + jnp.arange(l)[None, :]          # [S, L]
+    ok = active[:, None] & (positions < cap[:, None])
+    ring_idx = jnp.where(ok, (offsets[:, None] + positions) % m_cap,
+                         m_cap + jnp.arange(l)[None, :])
+    rows = jnp.arange(s)
+    int8_cache = ck.dtype == jnp.int8
+    swr = dict(unique_indices=True, mode="drop")
+    x = params["embed"].astype(dt)[tokens]
+    for i in range(cfg.n_layers):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = transformer._qkv(cfg, h, positions, lp)
+        k_hm = k.transpose(0, 2, 1, 3)                  # [S, kvH, L, D]
+        v_hm = v.transpose(0, 2, 1, 3)
+        if int8_cache:
+            k_w, ks = _quantize_kv(k_hm)
+            v_w, vs = _quantize_kv(v_hm)
+            ks_buf = ks_buf.at[i, rows[:, None], :, ring_idx].set(
+                ks.transpose(0, 2, 1), **swr)
+            vs_buf = vs_buf.at[i, rows[:, None], :, ring_idx].set(
+                vs.transpose(0, 2, 1), **swr)
+        else:
+            k_w, v_w = k_hm.astype(dt), v_hm.astype(dt)
+        ck = ck.at[i, rows[:, None], :, ring_idx, :].set(
+            k_w.transpose(0, 2, 1, 3), **swr)
+        cv = cv.at[i, rows[:, None], :, ring_idx, :].set(
+            v_w.transpose(0, 2, 1, 3), **swr)
+        attn = _cached_attention(
+            cfg, q, ck[i], cv[i], lens, l,
+            ks_buf[i] if int8_cache else None,
+            vs_buf[i] if int8_cache else None,
+            ring_offsets=offsets)
+        proj = jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(dt))
+        x = x + proj
+        hh = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        mlp_out, _ = transformer._mlp(cfg, hh, lp)
+        x = x + mlp_out
+    # every position's logits (the verify forward needs the target's
+    # prediction after each drafted token); L is the small draft window
+    x_out = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bld,dv->blv", x_out, params["unembed"].astype(dt)
+    ).astype(jnp.float32)
+    return logits, ck, cv, ks_buf, vs_buf
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "draft_cfg", "gamma", "stop_tokens", "pad_id"),
+    donate_argnames=("cache", "draft_cache", "d_tokens", "d_active"),
+)
+def _spec_block(params, draft_params, cache, draft_cache, d_tokens,
+                d_active, d_target, d_offsets,
+                *, cfg: TransformerConfig, draft_cfg: TransformerConfig,
+                gamma: int, stop_tokens: tuple, pad_id: int):
+    """One speculative round for ALL slots under one jit: the draft
+    autoregressively proposes ``gamma`` tokens per row (gamma+1 cheap
+    steps — the extra one ingests the last proposal so the all-accept
+    case's draft cache is one-ahead, exactly the solo discipline,
+    models/speculative.py), the target verifies every row's gamma+1
+    positions in ONE forward (the same weight stream as a single decode
+    step), and each row accepts its longest matching draft prefix plus
+    the target's own correction/bonus token.
+
+    **Exactness**: every emitted token is the target's greedy argmax
+    given its prefix, so each request's stream is byte-identical to the
+    plain `_decode_block` path (and to solo generate) for ANY draft —
+    a broken draft costs speed, never correctness. Budget and stop-token
+    clamps keep the identity at the boundaries: emissions are truncated
+    to the remaining budget and cut after the first stop token, which is
+    exactly where the plain path freezes the row.
+
+    Rollback is a length write: both caches' stale suffix entries beyond
+    the accepted prefix are overwritten by the next round's fed tokens
+    before any query can read them (rounds always re-feed from the new
+    length — the same argument the solo implementation rests on).
+
+    Returns (cache, draft_cache, next_tokens, still_active, packed)
+    where ``packed`` [S, gamma+4] int32 carries the emitted tokens
+    (pad-filled past each row's count), the raw per-row acceptance
+    count, and the final lengths/active mask — the host slices emissions
+    by length delta exactly as it does for plain decode blocks, so the
+    event-log replay (admissions, cancels, journal appends) is
+    unchanged: accepted tokens reach the journal as ordinary host-known
+    tokens and rejected drafts never exist host-side at all."""
+    params = _cast_decode_params(params, cfg)
+    draft_params = _cast_decode_params(draft_params, draft_cfg)
+    s = cache.k.shape[1]
+    len0 = cache.length                                  # [S]
+    active = d_active
+    tok = d_tokens
+    cap = d_target      # ring-wrap write guard; see _spec_rows_forward
+
+    def draft_step(carry, _):
+        t, dk, dv, dks, dvs, dlen = carry
+        lg, dk, dv, dks, dvs = _spec_rows_forward(
+            draft_params, draft_cfg, t[:, None], dk, dv, dks, dvs,
+            dlen, d_offsets, active, cap)
+        nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+        return (nxt, dk, dv, dks, dvs, dlen + 1), t
+
+    (_, dk, dv, dks, dvs, _), drafted_in = lax.scan(
+        draft_step,
+        (tok, draft_cache.k, draft_cache.v, draft_cache.k_scale,
+         draft_cache.v_scale, draft_cache.length),
+        None, length=gamma + 1)
+    # drafted_in[i] = the token INGESTED at step i = [tok, d_1..d_gamma]
+    d = jnp.moveaxis(drafted_in[1:], 0, 1)               # [S, gamma]
+
+    # --- target verifies all gamma+1 positions in ONE forward
+    verify_in = jnp.concatenate([tok[:, None], d], axis=1)
+    lg, ck, cv, cks, cvs = _spec_rows_forward(
+        params, cfg, verify_in, cache.k, cache.v, cache.k_scale,
+        cache.v_scale, len0, d_offsets, active, cap)
+    t_pred = jnp.argmax(lg, axis=-1).astype(jnp.int32)   # [S, gamma+1]
+
+    matches = (d == t_pred[:, :gamma]).astype(jnp.int32)
+    n_acc = jnp.cumprod(matches, axis=1).sum(axis=1)     # [S] in [0,gamma]
+    idx = jnp.arange(gamma + 1)[None, :]
+    correction = jnp.take_along_axis(t_pred, n_acc[:, None], axis=1)
+    d_ext = jnp.concatenate([d, jnp.zeros((s, 1), jnp.int32)], axis=1)
+    # cand[r] = the row's next n_acc+1 greedy tokens: accepted drafts,
+    # then the target's correction (mismatch) or bonus (all accepted)
+    cand = jnp.where(idx == n_acc[:, None], correction, d_ext)
+
+    # per-row emission count: acceptance, clamped by the remaining
+    # budget and cut after the first emitted stop token — the exact
+    # boundaries where the plain decode path freezes the row
+    room = jnp.maximum(d_target - len0, 0)
+    n_budget = jnp.minimum(n_acc + 1, room)
+    if stop_tokens:
+        stops = jnp.asarray(list(stop_tokens), jnp.int32)
+        hit = jnp.isin(cand, stops)
+        stop_idx = jnp.min(jnp.where(hit & (idx < n_budget[:, None]),
+                                     idx, gamma + 1), axis=1)
+        stop_hit = active & (stop_idx < n_budget)
+        n_emit = jnp.where(stop_hit, stop_idx + 1, n_budget)
+    else:
+        stop_hit = jnp.zeros((s,), bool)
+        n_emit = n_budget
+    n_emit = jnp.where(active, n_emit, 0)
+    new_len = len0 + n_emit
+    still = active & ~stop_hit & (new_len < d_target)
+    # next fed token: the last emitted token (only read while still
+    # active, in which case it is the unwritten correction/bonus)
+    nxt_tok = jnp.take_along_axis(
+        cand, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+    tok_out = jnp.where(still, nxt_tok, tok)
+    emitted = jnp.where(idx < n_emit[:, None], cand, jnp.int32(pad_id))
+    packed = jnp.concatenate(
+        [emitted, n_acc[:, None], new_len[:, None],
+         still.astype(jnp.int32)[:, None]], axis=1)
+    new_cache = KVCache(k=ck, v=cv, length=new_len,
+                        k_scale=cks, v_scale=cvs)
+    new_draft = KVCache(k=dk, v=dv, length=new_len,
+                        k_scale=dks, v_scale=dvs)
+    return new_cache, new_draft, tok_out, still, packed
+
+
 class SlotServer:
     """Continuous-batching server: S cache slots, requests admitted into
     freed slots while other slots keep decoding.
@@ -917,7 +1112,8 @@ class SlotServer:
       into production code paths, same contract as the driver's
       ``TEST_*`` knobs (constants.py)."""
 
-    def __init__(self, params, cfg: TransformerConfig, *, slots: int = 8,
+    def __init__(self, params=None, cfg: TransformerConfig | None = None,
+                 *, slots: int = 8,
                  max_len: int = 2048, block_size: int = 16,
                  prefill_chunk: int = 128, kv_dtype: str = "native",
                  weight_dtype: str = "native", temperature: float = 0.0,
@@ -927,7 +1123,42 @@ class SlotServer:
                  prefix_cache_blocks: int = 0, cache_prompts: bool = True,
                  max_queue: int = 0, trace_sink=None,
                  journal: RequestJournal | None = None,
-                 replay: bool = True):
+                 replay: bool = True,
+                 model: str = "default",
+                 registry: ModelRegistry | None = None,
+                 draft=None, draft_cfg: TransformerConfig | None = None,
+                 spec_gamma: int = 0, spec_gamma_max: int = 4):
+        # ---- model registry (models/registry.py) ----
+        # the weights singleton became a keyed registry: this server
+        # SERVES one named entry (its slot-pool cache shape is that
+        # entry's config), and the draft/target pair of speculative
+        # decoding is just two entries. Construct with registry=/model=
+        # to serve a pre-built registry entry, or the classic
+        # (params, cfg) pair — which is registered under ``model`` so
+        # every server exposes the same registry-backed surface.
+        if registry is not None:
+            self.registry = registry
+            # the unchanged ctor default "default" means "the registry's
+            # first entry"; any OTHER unregistered name is an error —
+            # silently serving different weights than the caller named
+            # is the one failure mode a registry exists to prevent
+            if model in registry:
+                entry = registry.get(model)
+            elif model == "default":
+                entry = registry.default
+            else:
+                entry = registry.get(model)     # raises with the names
+            self.model = entry.name
+            params, cfg = entry.weights, entry.cfg
+            if draft is None and entry.draft is not None:
+                draft = entry.draft
+        else:
+            if params is None or cfg is None:
+                raise ValueError(
+                    "SlotServer needs (params, cfg) or registry=/model=")
+            self.registry = ModelRegistry()
+            self.registry.register(str(model), params, cfg)
+            self.model = str(model)
         if not cfg.causal:
             raise ValueError("serving requires a causal model")
         if isinstance(params, DecodeWeights):
@@ -971,6 +1202,62 @@ class SlotServer:
                     f"by the 'batch' mesh axes (size {t_b}) — the slot pool "
                     "is the batch dimension of every decode block")
             self._shardings = _decode_shardings(mesh, rules)
+        # ---- speculative decoding (draft-model proposals) ----
+        # ``draft`` is a registry entry NAME or raw/prepared weights
+        # (with draft_cfg). Greedy-only, single-device, native target
+        # weights: the acceptance rule is the greedy-match rule (solo
+        # speculative.py scope), the per-row-position spec programs are
+        # not mesh-threaded, and the plain decode path's w8a16 numerics
+        # would break spec-on/spec-off byte-identity (the spec verify
+        # runs raw weights, like the prefill programs).
+        self._spec = False
+        self.draft_model: str | None = None
+        if draft is not None:
+            if isinstance(draft, str):
+                dentry = self.registry.get(draft)
+                draft_w, draft_cfg = dentry.weights, dentry.cfg
+                self.draft_model = dentry.name
+            else:
+                if draft_cfg is None:
+                    raise ValueError(
+                        "draft weights need draft_cfg (or pass a "
+                        "registry entry name)")
+                draft_w = draft
+                self.draft_model = "draft"
+                self.registry.register(self.draft_model, draft, draft_cfg,
+                                       source="inline")
+            self.registry.get(self.model).draft = self.draft_model
+            if isinstance(draft_w, DecodeWeights):
+                if draft_w.mesh is not None:
+                    raise ValueError(
+                        "speculative serving is single-device; prepare "
+                        "the draft without a mesh")
+                draft_w = draft_w.params
+            if mesh is not None:
+                raise ValueError(
+                    "speculative serving is single-device (the per-row-"
+                    "position propose/verify programs are not mesh-"
+                    "threaded); serve the draft pair without a mesh")
+            if weight_dtype != "native":
+                raise ValueError(
+                    "speculative serving requires weight_dtype='native': "
+                    "the verify forward runs raw weights (prefill "
+                    "numerics), which would not match a w8a16 decode path")
+            if temperature != 0.0:
+                raise ValueError(
+                    "speculative serving is greedy-only (temperature 0); "
+                    "the greedy-match acceptance rule has no sampled "
+                    "counterpart here (models/speculative.py scope)")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft and target must share a vocabulary "
+                    f"({draft_cfg.vocab_size} != {cfg.vocab_size})")
+            if not draft_cfg.causal:
+                raise ValueError("speculative decode requires a causal "
+                                 "draft")
+            self._draft_params = draft_w
+            self._draft_cfg = moe_dropfree(draft_cfg)
+            self._spec = True
         self.batched_admission = batched_admission
         self.admission_dispatches = 0   # prefill programs dispatched
         # prefix-cache dispatch + token counters (stats())
@@ -1064,7 +1351,32 @@ class SlotServer:
         # one packed transfer at the end — zero mid-run syncs. With stop
         # tokens the host must observe the device to see EOS, so blocks
         # sync (in bursts) behind a pipeline of in-flight blocks.
-        self._predictive = not self.stop_tokens
+        # Speculation also forces sync mode: a round advances each slot
+        # by a VARIABLE accepted count the host can only learn by
+        # observing the packed result — no exact open-loop model exists.
+        self._predictive = not self.stop_tokens and not self._spec
+        # ---- speculative-serving state (tentpole) ----
+        # gamma autotune: per-slot acceptance-rate EWMA over recent
+        # verify rounds steers the NEXT round's draft window — high
+        # agreement widens it (more tokens per target weight stream),
+        # low agreement shrinks it toward 1 (a failing draft costs one
+        # wasted step, never correctness). The dispatched gamma is the
+        # busy slots' mean EWMA mapped through the expected-run-length
+        # rule a/(1-a), snapped to a power of two so the compiled
+        # program set stays O(log gamma_max). spec_gamma pins it.
+        self._spec_gamma_pin = max(0, int(spec_gamma))
+        self.spec_gamma_max = max(1, int(spec_gamma_max))
+        if self._spec_gamma_pin:
+            self.spec_gamma_max = max(self.spec_gamma_max,
+                                      self._spec_gamma_pin)
+        self._spec_ewma_alpha = 0.2
+        self._accept_ewma = np.full((slots,), 0.6, np.float64)
+        self.spec_rounds = 0            # verify rounds dispatched
+        self.spec_proposed_tokens = 0   # draft proposals verified (host-
+        #                                 observed, lags by the pipeline)
+        self.spec_accepted_tokens = 0   # ... accepted by the target
+        self.spec_accept_hist = Histogram(lo=0.01, hi=1.0)
+        self.spec_rounds_hist = Histogram(lo=1.0, hi=512.0, per_decade=4)
         self._init_device_state()
         # ---- chunk-aligned prefix cache (module docstring) ----
         self.cache_prompts = cache_prompts
@@ -1120,6 +1432,15 @@ class SlotServer:
         self._d_offsets = jnp.zeros((slots,), jnp.int32)
         self._d_temps = jnp.zeros((slots,), jnp.float32)  # per-request
         self._d_topks = jnp.zeros((slots,), jnp.int32)    # per-request
+        if self._spec:
+            # the draft model mirrors the slot pool with its OWN cache
+            # (its config's shape), kept in per-row logical lockstep
+            # with the target: admission prefills both, every spec
+            # round advances/rolls both to the same lengths
+            dcache = init_cache(self._draft_cfg, slots, self.max_len,
+                                self.kv_dtype)
+            self._draft_cache = dcache._replace(
+                length=jnp.zeros((slots,), jnp.int32))
         if self._shardings is not None:
             # commit the pool's initial layout so the first dispatch (and
             # every donated successor) already sits where the programs'
@@ -1200,6 +1521,11 @@ class SlotServer:
         # (reset() fails exactly these)
         self._slot_of: dict[int, int] = {}
         self._inflight: set[int] = set()
+        # per-request speculative tallies (verify rounds + accepted
+        # tokens), reset at each admission, observed at the completion
+        # into spec_rounds_hist and the trace attrs
+        self._spec_round_counts = np.zeros((slots,), np.int64)
+        self._spec_accepted_counts = np.zeros((slots,), np.int64)
 
     # ------------------------------------------------------------- intake
 
@@ -1214,6 +1540,16 @@ class SlotServer:
                 f"request needs {prompt.size} prompt + "
                 f"{request.max_new_tokens} new tokens but slots hold "
                 f"max_len={self.max_len}")
+        if self._spec and request.temperature is not None \
+                and float(request.temperature) > 0:
+            raise ValueError(
+                "speculative serving is greedy-only: per-request "
+                "temperature overrides > 0 are rejected (the greedy-"
+                "match acceptance rule has no sampled counterpart)")
+        if request.model is not None and request.model != self.model:
+            raise ValueError(
+                f"request names model {request.model!r} but this engine "
+                f"serves {self.model!r} (the ServeApp routes by model)")
         resume = request.resume_tokens
         if resume is not None:
             resume = [int(t) for t in np.asarray(resume, np.int32)]
@@ -1274,7 +1610,8 @@ class SlotServer:
                 request.id, prompt.tolist(), request.max_new_tokens,
                 temperature=request.temperature, top_k=request.top_k,
                 cache_prompt=request.cache_prompt, seed=self._seed,
-                deadline=request.deadline, emitted=resume)
+                deadline=request.deadline, emitted=resume,
+                model=self.model)
         self._queue.append(request)
         return request.id
 
@@ -1430,7 +1767,7 @@ class SlotServer:
         self.resets += 1
         return failed
 
-    def recover_journal(self, entries) -> int:
+    def recover_journal(self, entries, compact: bool = True) -> int:
         """Resubmit another process's unfinished journal entries (see
         ``RequestJournal.recover``) as fresh requests resuming from
         their recorded prefixes — ``serve`` startup calls this so a
@@ -1487,9 +1824,14 @@ class SlotServer:
                 n += 1
         finally:
             self.max_queue = saved_max_queue
-        if self._journal is not None:
+        if compact and self._journal is not None:
             # the resubmitted live set is durable: drop the dead
-            # process's records now (see RequestJournal.compact)
+            # process's records now (see RequestJournal.compact).
+            # ``compact=False`` defers this for callers recovering ONE
+            # SHARED journal across several engines (multi-model serve):
+            # compacting after the first engine's resubmission would
+            # erase the only durable copy of the OTHER engines'
+            # still-unrecovered entries — they compact once, at the end.
             self._journal.compact()
         return n
 
@@ -1632,6 +1974,8 @@ class SlotServer:
         never touched the MXU — they were copied out of the shared pool —
         vs ``prefill_tokens_computed`` that ran the model."""
         out = {
+            "model": self.model,
+            "registry": self.registry.names(),
             "slots": self.slots,
             "active": self.n_active,
             "queued": self.pending,
@@ -1664,6 +2008,21 @@ class SlotServer:
             # depth, vs the host bookkeeping's documented bound)
             "device": self.dispatch_tracker.snapshot(),
         }
+        if self._spec:
+            out["speculative"] = {
+                "draft_model": self.draft_model,
+                "gamma": self._current_gamma(),
+                "gamma_pinned": bool(self._spec_gamma_pin),
+                "gamma_max": self.spec_gamma_max,
+                "rounds": self.spec_rounds,
+                "proposed_tokens": self.spec_proposed_tokens,
+                "accepted_tokens": self.spec_accepted_tokens,
+                "acceptance_ewma": round(
+                    float(self._accept_ewma.mean()), 4),
+                "acceptance": self.spec_accept_hist.snapshot(),
+                "verify_rounds_per_request":
+                    self.spec_rounds_hist.snapshot(),
+            }
         if self._journal is not None:
             out["journal"] = {
                 "entries": len(self._journal),
@@ -1758,8 +2117,14 @@ class SlotServer:
             body = full[:-1]
             # ring alignment: the slot's first decode write must land at
             # the cursor as of its first block, i.e. the current cursor
-            # (admission dispatches after every block dispatched so far)
-            offset = (self._cursor - body.size) % self.max_len
+            # (admission dispatches after every block dispatched so far).
+            # Speculative mode has no shared cursor (rounds advance each
+            # slot by its own accepted count; writes are per-row scatters
+            # with an explicit wrap guard), so the ring degenerates to
+            # offset 0 — logical position == buffer index, bounded by the
+            # submit-time prompt+budget <= max_len check.
+            offset = (0 if self._spec
+                      else (self._cursor - body.size) % self.max_len)
             # each active step advances length by 1 and emits 1 token, so
             # the remaining emissions end at body + remaining budget —
             # for a fresh request exactly body + max_new (the last
@@ -1797,6 +2162,8 @@ class SlotServer:
             for adm in admissions:
                 self._prefill_one(adm)
         self._dispatch_prefix_insert(admissions)
+        if self._spec:
+            self._prefill_draft(admissions)
         for adm in admissions:
             slot, req, body = adm.slot, adm.req, adm.body
             tr = self._traces.get(req.id)
@@ -1967,8 +2334,70 @@ class SlotServer:
             self.admission_dispatches += 1
             self.dispatch_tracker.track("prefill", fence)
 
+    def _prefill_draft(self, admissions) -> None:
+        """Speculative serving: the draft model needs the same context
+        in its OWN slot cache. The full body prefills every time — the
+        target-side prefix pool holds TARGET KV, the draft is small by
+        construction, and a draft-side pool would double the cache
+        machinery for a model whose whole point is being cheap. One
+        `_prefill_batch` dispatch per chunk round (the draft config
+        compiles its own variant); every commit row is diverted
+        (``fin`` all False), so the target's committed slot state rides
+        through the donation untouched while the DRAFT cache's lengths
+        land at each row's body size."""
+        C = self.prefill_chunk
+        n = len(admissions)
+        k_rows = 1 << (n - 1).bit_length() if n > 1 else 1
+        rounds = max(max(1, -(-a.body.size // C)) for a in admissions)
+        S = self.slots
+        for r in range(rounds):
+            tokens = np.zeros((k_rows, C), np.int32)
+            slots = S + np.arange(k_rows, dtype=np.int32)   # OOB default
+            starts = np.zeros(k_rows, np.int32)
+            offsets = np.zeros(k_rows, np.int32)
+            n_valids = np.zeros(k_rows, np.int32)
+            zi = np.zeros(k_rows, np.int32)
+            zf = np.zeros(k_rows, np.float32)
+            fin = np.zeros(k_rows, bool)
+            any_row = False
+            for row, adm in enumerate(admissions):
+                c0 = r * C
+                # every admission appears in round 0 even with an empty
+                # body (1-token prompt): the zero-valid row still RESETS
+                # the draft slot's stale length from its previous
+                # occupant, exactly as the target's degenerate finalize
+                # chunk does
+                if c0 >= adm.body.size and not (r == 0):
+                    continue
+                nv = max(0, min(C, adm.body.size - c0))
+                tokens[row, :nv] = adm.body[c0:c0 + nv]
+                slots[row] = adm.slot
+                starts[row] = c0
+                offsets[row] = adm.offset
+                n_valids[row] = nv
+                any_row = True
+            if not any_row:
+                continue
+            (self._draft_cache, self._d_tokens, self._d_active,
+             self._d_target, self._d_offsets,
+             self._d_temps, self._d_topks, fence) = _prefill_batch(
+                self._draft_params, self._draft_cache, self._d_tokens,
+                self._d_active, self._d_target, self._d_offsets,
+                self._d_temps, self._d_topks,
+                jnp.asarray(tokens), jnp.asarray(slots),
+                jnp.asarray(starts), jnp.asarray(offsets),
+                jnp.asarray(n_valids), jnp.asarray(zi),
+                jnp.asarray(zi), jnp.asarray(zf),
+                jnp.asarray(zi), jnp.asarray(fin),
+                cfg=self._draft_cfg, chunk=C, kv_dtype=self.kv_dtype,
+                shardings=None)
+            self.admission_dispatches += 1
+            self.dispatch_tracker.track("draft_prefill", fence)
+
     def _apply_admit(self, admit) -> None:
         slot, body_len, req = admit
+        self._spec_round_counts[slot] = 0
+        self._spec_accepted_counts[slot] = 0
         self._expect_len[slot] = body_len
         self._expect_active[slot] = True
         self._requests[slot] = req
@@ -2040,17 +2469,23 @@ class SlotServer:
         # block; _process subtracts that from its observation instant to
         # measure the pipeline lag this block's tokens were delivered at
         seq = self.dispatch_tracker.track("decode_block", packed)
-        self._pipeline.append({"packed": packed, "events": [], "seq": seq})
+        self._pipeline.append({"packed": packed, "events": [], "seq": seq,
+                               "w": self.block_size + 2,
+                               "spec_gamma": None})
         if self._predictive:            # exact: no EOS can surprise us
             adv = np.minimum(self.block_size,
                              self._model_target - self._model_len)
             self._model_len = self._model_len + np.where(
                 self._model_active, adv, 0).astype(np.int32)
             self._model_active &= self._model_len < self._model_target
-        # deterministic chaos (constants.py TEST_SERVING_*): crash the
-        # loop — or the whole process — at exact decode-block ordinals,
-        # i.e. mid-decode by construction. The block above was really
-        # dispatched: recovery has genuine in-flight work to replay.
+        self._post_dispatch_chaos()
+
+    def _post_dispatch_chaos(self) -> None:
+        """Deterministic chaos (constants.py TEST_SERVING_*): crash the
+        loop — or the whole process — at exact decode-block ordinals
+        (spec rounds count as blocks), i.e. mid-decode by construction.
+        The block was really dispatched: recovery has genuine in-flight
+        work to replay."""
         if (self._chaos_sigkill_block
                 and self.blocks_dispatched >= self._chaos_sigkill_block):
             log.error("chaos: SIGKILLing this process at decode block %d",
@@ -2062,6 +2497,53 @@ class SlotServer:
             raise RuntimeError(
                 "chaos: injected mid-decode loop crash at block "
                 f"{self.blocks_dispatched}")
+
+    def _current_gamma(self) -> int:
+        """The NEXT spec round's draft window. Pinned via spec_gamma, or
+        autotuned: the busy slots' mean acceptance EWMA mapped through
+        the expected-accepted-run-length rule a/(1-a) — the window a
+        geometric acceptance process actually fills — clamped to
+        [1, spec_gamma_max] and snapped to a power of two so the
+        compiled spec-program set stays O(log gamma_max)."""
+        if self._spec_gamma_pin:
+            return self._spec_gamma_pin
+        busy = self._host_busy
+        a = float(self._accept_ewma[busy].mean() if busy.any()
+                  else self._accept_ewma.mean())
+        a = min(max(a, 0.0), 0.99)
+        raw = max(1.0, min(a / max(1e-6, 1.0 - a),
+                           float(self.spec_gamma_max)))
+        g = 1 << int(round(math.log2(raw)))
+        # ceiling = the largest power of two <= spec_gamma_max: a plain
+        # min() against a non-power-of-two max would return the max
+        # itself and compile an off-ladder program variant
+        cap = 1 << (self.spec_gamma_max.bit_length() - 1)
+        return max(1, min(g, cap))
+
+    def _dispatch_spec_round(self) -> None:
+        """Speculative-mode decode dispatch: one propose/verify round
+        for all slots (`_spec_block`), logged in the SAME pipeline the
+        plain decode blocks use — admissions and cancels recorded
+        against it replay at exactly their dispatch positions, and the
+        packed result is sliced by length delta, so the whole event-log
+        discipline (journal appends included) is untouched by
+        speculation."""
+        t0 = time.monotonic()
+        gamma = self._current_gamma()
+        (self._cache, self._draft_cache, self._d_tokens, self._d_active,
+         packed) = _spec_block(
+            self._params, self._draft_params, self._cache,
+            self._draft_cache, self._d_tokens, self._d_active,
+            self._d_target, self._d_offsets,
+            cfg=self.cfg, draft_cfg=self._draft_cfg, gamma=gamma,
+            stop_tokens=self.stop_tokens, pad_id=self.pad_id)
+        self.blocks_dispatched += 1
+        self.spec_rounds += 1
+        self.telemetry.observe("decode_block_s", time.monotonic() - t0)
+        seq = self.dispatch_tracker.track("spec_round", packed)
+        self._pipeline.append({"packed": packed, "events": [], "seq": seq,
+                               "w": gamma + 4, "spec_gamma": gamma})
+        self._post_dispatch_chaos()
 
     def _process(self, count: int) -> None:
         """Sync + bookkeep the oldest ``count`` in-flight blocks with ONE
@@ -2093,13 +2575,41 @@ class SlotServer:
             lags.append(lag)
             if lag is not None:
                 self.telemetry.observe("device_lag_s", lag)
-        w = self.block_size + 2
+        col = 0
         for i, rec in enumerate(recs):
-            packed = flat[:, i * w:(i + 1) * w]
+            # records carry their own packed width: plain decode blocks
+            # are [S, block+2], spec rounds [S, gamma+4] (emissions,
+            # raw acceptance count, length, active) — and gammas vary
+            # across rounds when the autotuner moves
+            w = rec.get("w", self.block_size + 2)
+            packed = flat[:, col:col + w]
+            col += w
             lag = lags[i]
-            toks, lengths, active = (
-                packed[:, :-2], packed[:, -2], packed[:, -1].astype(bool))
+            gamma = rec.get("spec_gamma")
+            if gamma is not None:
+                toks, n_accs, lengths, active = (
+                    packed[:, :gamma + 1], packed[:, gamma + 1],
+                    packed[:, gamma + 2], packed[:, gamma + 3].astype(bool))
+            else:
+                toks, n_accs, lengths, active = (
+                    packed[:, :-2], None, packed[:, -2],
+                    packed[:, -1].astype(bool))
             for slot in np.nonzero(self._expect_active)[0]:
+                if n_accs is not None:
+                    # speculative bookkeeping: the RAW acceptance count
+                    # (true draft-target agreement, pre-clamp — the solo
+                    # stats convention) feeds the per-slot EWMA the
+                    # autotuner steers gamma from, the acceptance-rate
+                    # histogram, and the proposed/accepted counters
+                    acc = int(n_accs[slot])
+                    rate = acc / gamma if gamma else 0.0
+                    self.spec_proposed_tokens += gamma
+                    self.spec_accepted_tokens += acc
+                    self._accept_ewma[slot] += self._spec_ewma_alpha * (
+                        rate - self._accept_ewma[slot])
+                    self.spec_accept_hist.observe(rate)
+                    self._spec_round_counts[slot] += 1
+                    self._spec_accepted_counts[slot] += acc
                 n = int(lengths[slot] - self._expect_len[slot])
                 had_tokens = bool(self._emitted[slot])
                 self._emitted[slot].extend(int(t) for t in toks[slot, :n])
@@ -2131,6 +2641,18 @@ class SlotServer:
                         tr = self._traces.get(req.id)
                         if tr is not None:
                             tr.attrs["device_lag_s"] = round(lag, 6)
+                    if self._spec and req is not None:
+                        tr = self._traces.get(req.id)
+                        if tr is not None:
+                            tr.attrs["spec_rounds"] = int(
+                                self._spec_round_counts[slot])
+                            tr.attrs["spec_accepted_tokens"] = int(
+                                self._spec_accepted_counts[slot])
+                        if self._spec_round_counts[slot]:
+                            self.spec_rounds_hist.observe(
+                                float(self._spec_round_counts[slot]))
+                        self._spec_round_counts[slot] = 0
+                        self._spec_accepted_counts[slot] = 0
                     self._done[req.id] = Completion(
                         req.id, out, reason,
                         trace=self._finish_trace(
@@ -2196,7 +2718,10 @@ class SlotServer:
             self._admit()
         dispatched = False
         if self._device_may_be_active():
-            self._dispatch_block()
+            if self._spec:
+                self._dispatch_spec_round()
+            else:
+                self._dispatch_block()
             dispatched = True
         depth = self.pipeline_depth if dispatched else 0
         if len(self._pipeline) > depth:
@@ -2241,4 +2766,5 @@ class SlotServer:
 
 __all__ = ["Request", "Completion", "SlotServer", "PrefixCache",
            "QueueFullError", "RequestJournal",
+           "ModelEntry", "ModelRegistry",
            "COMPLETION_FINISH_REASONS", "FINISH_REASONS"]

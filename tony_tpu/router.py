@@ -88,7 +88,16 @@ class RouterError(RuntimeError):
 
 
 class NoReplicaError(RouterError):
-    """No live replica in the fleet (all ejected / none discovered)."""
+    """No live replica in the fleet (all ejected / none discovered) —
+    or, for a request naming a model, no live replica ADVERTISES that
+    model (model-aware routing: replicas publish their registry on
+    /stats)."""
+
+
+class RouterClientError(RouterError):
+    """The replica rejected the request as malformed (4xx other than
+    429) — the client's fault, not the replica's: no retry, no
+    ejection, surfaced as HTTP 400."""
 
 
 class FleetSaturatedError(RouterError):
@@ -127,6 +136,11 @@ class _ReplicaTimeout(Exception):
     must not eject a healthy replica from everyone's rotation."""
 
 
+class _ReplicaClientError(Exception):
+    """Internal: one replica answered 4xx (other than 429) — the
+    request itself is bad; retrying elsewhere would just repeat it."""
+
+
 class Replica:
     """Router-side state of one backend SlotServer."""
 
@@ -142,6 +156,11 @@ class Replica:
         self.active = 0
         self.slots = 0
         self.max_queue = 0
+        # the models this replica advertises on /stats ("models" keys).
+        # Empty = unknown/legacy replica: serves any model (requests
+        # naming one still route here rather than failing a fleet that
+        # predates multi-model /stats)
+        self.models: set[str] = set()
         # posts the ROUTER currently has outstanding against this
         # replica — exact and instantaneous, unlike the polled /stats
         # (which lag a health interval and double-count router traffic);
@@ -456,6 +475,11 @@ class FleetRouter:
             rep.slots = int(st.get("slots", 0) or 0)
             rep.max_queue = int(st.get("max_queue", 0) or 0)
             rep.retry_after_s = int(st.get("retry_after_s", 1) or 1)
+            models = st.get("models")
+            if isinstance(models, dict):
+                rep.models = {str(m) for m in models}
+            elif isinstance(models, (list, tuple)):
+                rep.models = {str(m) for m in models}
 
     def _eject_locked(self, rep: Replica, reason: str) -> None:
         if rep.up:
@@ -476,8 +500,21 @@ class FleetRouter:
         body = ",".join(str(int(t)) for t in prompt[:n]).encode()
         return hashlib.sha1(body).digest()
 
-    def _ranked_locked(self, key: bytes | None) -> list[Replica]:
+    def _ranked_locked(self, key: bytes | None,
+                       model: str | None = None,
+                       exclude: set | None = None) -> list[Replica]:
         live = [r for r in self.replicas.values() if r.up]
+        if model is not None:
+            # model-aware routing dimension: route/spill only among
+            # replicas advertising the request's model (empty set =
+            # legacy replica, serves any). Affinity and least-loaded
+            # both rank WITHIN the advertising subset, so spill never
+            # lands a model on weights that can't serve it. ``exclude``
+            # drops replicas that already answered 400 for this
+            # request's model (a not-yet-polled advertisement window).
+            live = [r for r in live
+                    if (not r.models or model in r.models)
+                    and (not exclude or r.name not in exclude)]
         if key is None:
             # least-loaded from the freshest /stats; name tie-break so
             # equal-load picks are deterministic
@@ -496,13 +533,14 @@ class FleetRouter:
                 and max(rep.queued, rep.inflight - max(0, rep.slots))
                 >= self.spill_queue_depth)
 
-    def _pick(self, key: bytes | None) -> Replica | None:
+    def _pick(self, key: bytes | None, model: str | None = None,
+              exclude: set | None = None) -> Replica | None:
         """Choose a replica: rendezvous-sticky (or least-loaded) with
         spill past saturated candidates; when everything is saturated,
         the first choice anyway — the caller handles its 429."""
         now = time.monotonic()
         with self._lock:
-            ranked = self._ranked_locked(key)
+            ranked = self._ranked_locked(key, model, exclude)
             if not ranked:
                 return None
             for rep in ranked:
@@ -514,11 +552,13 @@ class FleetRouter:
     def generate(self, prompt, max_new_tokens: int = 64,
                  timeout_s: float = 600.0, temperature: float | None = None,
                  top_k: int | None = None,
-                 cache_prompt: bool | None = None) -> dict:
+                 cache_prompt: bool | None = None,
+                 model: str | None = None) -> dict:
         """Route one generation request; returns the replica's response
-        dict (id/tokens/finish_reason) plus routing attrs. Raises
-        NoReplicaError / FleetSaturatedError / RouterError / TimeoutError
-        — never returns a half-answer."""
+        dict (id/tokens/finish_reason) plus routing attrs. ``model``
+        restricts routing to replicas advertising that model (their
+        /stats registry). Raises NoReplicaError / FleetSaturatedError /
+        RouterError / TimeoutError — never returns a half-answer."""
         rid = next(self._ids)
         tr = RequestTrace(rid)
         tr.mark("submitted")
@@ -541,9 +581,16 @@ class FleetRouter:
             payload["top_k"] = int(top_k)
         if cache_prompt is not None:
             payload["cache_prompt"] = bool(cache_prompt)
+        if model is not None:
+            payload["model"] = str(model)
+            tr.attrs["model"] = str(model)
         attempts = 0
         min_retry_after: int | None = None
         failover_pending = False    # a failover counts when it POSTS
+        # replicas that answered 400 for THIS request's model (their
+        # advertisement hadn't been polled yet): excluded from
+        # re-picks, never retried — but the request itself re-routes
+        wrong_model: set[str] = set()
         last_err = "no replica available"
         while True:
             remaining = deadline - time.monotonic()
@@ -553,17 +600,43 @@ class FleetRouter:
                     f"request {rid} exhausted its {timeout_s}s budget after "
                     f"{attempts} attempts (last: {last_err})")
             t0 = time.monotonic()
-            rep = self._pick(key)
+            rep = self._pick(key, model, wrong_model or None)
             dt = time.monotonic() - t0
             with self._lock:    # Histogram is not thread-safe
                 self.routing_hist.observe(dt)
             if rep is None:
+                if model is not None:
+                    # fail FAST when the fleet is live and fully
+                    # model-aware but nobody advertises the name: the
+                    # router already knows the answer, and spinning out
+                    # the client deadline in re-pick beats would pin a
+                    # handler thread per typo'd model. (Replicas whose
+                    # advertisement hasn't been polled yet have empty
+                    # sets and route as serve-anything, so a fresh
+                    # router never hits this branch spuriously.)
+                    with self._lock:
+                        live = [r for r in self.replicas.values()
+                                if r.up]
+                        fleet_knows = bool(live) and all(
+                            r.models for r in live)
+                    if fleet_knows:
+                        self._seal(tr, "failed", error="no_replica",
+                                   retries=attempts)
+                        raise NoReplicaError(
+                            f"no live replica advertises model "
+                            f"{model!r} (check the fleet's --model "
+                            "registrations)")
                 # nothing live: give health/discovery a beat to find one
-                last_err = "no live replica"
+                last_err = ("no live replica" if model is None else
+                            f"no live replica advertises model {model!r}")
                 if self._sleep(min(0.25, remaining), deadline):
                     continue        # still time: re-pick
                 self._seal(tr, "failed", error="no_replica",
                            retries=attempts)
+                if model is not None:
+                    raise NoReplicaError(
+                        f"no live replica advertises model {model!r} "
+                        "(check the fleet's --model registrations)")
                 raise NoReplicaError(
                     "no live replica in the fleet (all ejected or none "
                     "discovered)")
@@ -670,9 +743,27 @@ class FleetRouter:
                            * self._rng.uniform(0.5, 1.5))
                 self._sleep(min(backoff, max(0.0, deadline
                                              - time.monotonic())), deadline)
+            except _ReplicaClientError as e:
+                if model is not None and (
+                        not rep.models or model not in rep.models):
+                    # a MIS-ROUTE, not a bad request: the replica's
+                    # advertisement hadn't been polled yet (empty set
+                    # routes as serve-anything) and it doesn't serve
+                    # this model — exclude it for this request and
+                    # re-pick; a live advertiser elsewhere still gets
+                    # the request
+                    attempts += 1
+                    wrong_model.add(rep.name)
+                    last_err = f"{rep.name}: {e}"
+                    continue
+                # the replica says the REQUEST itself is malformed: no
+                # retry — another replica would say the same — and no
+                # ejection
+                self._seal(tr, "failed", error="client", retries=attempts)
+                raise RouterClientError(str(e)) from None
             else:
                 with self._lock:
-                    ranked = (self._ranked_locked(key)
+                    ranked = (self._ranked_locked(key, model)
                               if key is not None else [])
                     hit = bool(ranked and ranked[0] is rep)
                     if hit:
@@ -707,6 +798,16 @@ class FleetRouter:
                 except ValueError:
                     ra = 1
                 raise _ReplicaShed(ra) from None
+            if 400 <= e.code < 500:
+                # the request is malformed (unknown model, bad params):
+                # the replica is healthy and a retry would repeat it
+                try:
+                    detail = json.loads(e.read().decode()).get("error", "")
+                except Exception:
+                    detail = ""
+                raise _ReplicaClientError(
+                    f"HTTP {e.code} from {rep.name}"
+                    + (f": {detail}" if detail else "")) from None
             raise _ReplicaUnavailable(f"HTTP {e.code}") from None
         except Exception as e:      # URLError, socket timeout, reset, ...
             reason = getattr(e, "reason", None)
@@ -749,6 +850,9 @@ class FleetRouter:
                     "slots": r.slots, "requests": r.requests,
                     "retries": r.retries, "shed": r.shed,
                     "errors": r.errors, "ejections": r.ejections,
+                    # advertised model registry ([] = legacy replica:
+                    # serves any model it's asked for)
+                    "models": sorted(r.models),
                 } for r in self.replicas.values()}
             return {
                 "replicas": reps,
@@ -986,7 +1090,8 @@ def make_handler(router: FleetRouter):
                 if not 0 < kwargs["timeout_s"] < float("inf"):
                     raise ValueError(
                         "timeout_s must be a positive finite number")
-                for k, cast in (("temperature", float), ("top_k", int)):
+                for k, cast in (("temperature", float), ("top_k", int),
+                                ("model", str)):
                     if payload.get(k) is not None:
                         kwargs[k] = cast(payload[k])
                 if payload.get("cache_prompt") is not None:
@@ -1008,6 +1113,9 @@ def make_handler(router: FleetRouter):
                 return
             except TimeoutError as e:
                 self._send(504, {"error": str(e)})
+                return
+            except RouterClientError as e:
+                self._send(400, {"error": str(e)})
                 return
             except RouterError as e:
                 self._send(502, {"error": str(e)})
