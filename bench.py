@@ -75,6 +75,17 @@ vs random routing on the fleet-wide trie reuse fraction (asserted
 affinity > random) and merged p99 TTFT. Results land in PERF.json
 under `serving_fleet`.
 
+`python bench.py --serving --streaming` gates the streaming subsystem
+(docs/serving.md "Streaming & OpenAI compatibility"): an open-loop
+Poisson arrival process streamed per-token through the FleetRouter
+against 2 TINY serve processes with a mid-stream replica SIGKILL —
+ENFORCES zero failed requests and per-request byte-identity of the
+concatenated client-side stream vs non-streamed greedy (stream
+failovers included, resume prefix harvested from the stream), and
+reports client-observed inter-token-latency quantiles from per-token
+arrival timestamps. Results land in PERF.json under
+`streaming_serving`.
+
 `python bench.py --launch-path` measures the warm-executor-pool launch
 story (docs/performance.md "Launch path"): the same 1-worker mnist job
 submitted three ways in one run — cold (first-ever: cold XLA disk
@@ -1760,6 +1771,275 @@ def run_serving_replay_bench() -> int:
     return 0
 
 
+def run_serving_streaming_bench() -> int:
+    """Streaming-serving gate (one JSON line -> PERF.json
+    `streaming_serving`; docs/serving.md "Streaming & OpenAI
+    compatibility"). An open-loop POISSON arrival process at fleet
+    scale, every request streamed per-token through the router, with
+    one mid-stream replica SIGKILL. ENFORCED invariants:
+
+    - zero failed requests (the kill becomes latency via router
+      stream-failover, never an error);
+    - every request's CONCATENATED stream is byte-identical to the
+      non-streamed greedy completion (in-process SlotServer reference)
+      — including the requests whose stream moved replicas mid-flight;
+    - at least one stream failover actually fired (the kill landed on
+      live streams) with the resume prefix harvested from the stream;
+    - per-token inter-token-latency quantiles measured CLIENT-side
+      (per-token arrival timestamps; tokens of one SSE chunk share an
+      arrival instant, so intra-chunk gaps are genuine zeros).
+    """
+    import re as _re
+    import signal as _signal
+    import subprocess
+    import threading
+    import urllib.request
+
+    sys.path.insert(0, str(REPO))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tony_tpu.models import transformer
+    from tony_tpu.models.serving import Request, SlotServer
+    from tony_tpu.router import FleetRouter
+
+    tiny = dict(vocab=256, d_model=64, n_layers=2, n_heads=4, d_ff=128)
+    slots, max_len, chunk, block = 4, 128, 8, 4
+    n_requests = 24
+    budgets = [16, 48, 32, 64]
+    mean_interarrival_s = 0.08          # open-loop Poisson, seeded
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           # slow each scheduling turn so streams stay live long enough
+           # for a genuinely MID-stream kill on the TINY model
+           "TONY_TEST_SERVING_STEP_DELAY_MS": "20"}
+    env.pop("XLA_FLAGS", None)
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=tiny["vocab"], d_model=tiny["d_model"],
+        n_layers=tiny["n_layers"], n_heads=tiny["n_heads"],
+        n_kv_heads=tiny["n_heads"], d_ff=tiny["d_ff"], dtype=jnp.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(17)
+    template = rng.integers(0, tiny["vocab"], size=chunk, dtype=np.int32)
+    prompts = [np.concatenate(
+        [template, rng.integers(0, tiny["vocab"], size=2 + i % 5,
+                                dtype=np.int32)]).tolist()
+        for i in range(n_requests)]
+    arrivals = np.cumsum(rng.exponential(mean_interarrival_s,
+                                         size=n_requests))
+
+    # non-streamed greedy reference: the byte-identity target
+    ref_srv = SlotServer(params, cfg, slots=slots, max_len=max_len,
+                         block_size=block, prefill_chunk=chunk)
+    ref_reqs = [Request(prompt=p,
+                        max_new_tokens=budgets[i % len(budgets)])
+                for i, p in enumerate(prompts)]
+    for r in ref_reqs:
+        ref_srv.submit(r)
+    ref_done = ref_srv.run_until_drained()
+    refs = [ref_done[r.id].tokens for r in ref_reqs]
+
+    class Srv:
+        def __init__(self, name):
+            self.name = name
+            self.proc = self.port = None
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "tony_tpu.cli.main", "serve",
+                 "--port", "0", "--vocab", str(tiny["vocab"]),
+                 "--d-model", str(tiny["d_model"]),
+                 "--n-layers", str(tiny["n_layers"]),
+                 "--n-heads", str(tiny["n_heads"]),
+                 "--d-ff", str(tiny["d_ff"]), "--dtype", "float32",
+                 "--seed", "0", "--slots", str(slots),
+                 "--max-len", str(max_len), "--block-size", str(block),
+                 "--prefill-chunk", str(chunk)],
+                cwd=REPO, env=env, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+        def await_ready(self, timeout=240.0):
+            deadline = time.time() + timeout
+            while self.port is None and time.time() < deadline:
+                line = self.proc.stdout.readline()
+                m = _re.search(r"http://[\d.]+:(\d+)", line or "")
+                if m:
+                    self.port = int(m.group(1))
+            assert self.port, f"{self.name} never printed its port"
+            threading.Thread(target=self.proc.stdout.read,
+                             daemon=True).start()
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{self.port}/healthz",
+                            timeout=2) as r:
+                        if r.status == 200:
+                            return
+                except Exception:
+                    time.sleep(0.2)
+            raise AssertionError(f"{self.name} never became healthy")
+
+        def pid(self):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}/stats",
+                    timeout=10) as r:
+                return json.loads(r.read().decode())["pid"]
+
+        def stop(self):
+            if self.proc.poll() is None:
+                self.proc.kill()
+            self.proc.wait(timeout=15)
+
+    reps = [Srv("a"), Srv("b")]
+    router = None
+    try:
+        for rep in reps:
+            rep.await_ready()
+        router = FleetRouter(
+            [(rep.name, "127.0.0.1", rep.port) for rep in reps],
+            prefill_chunk=chunk, health_interval_s=0.15, stats_every=2,
+            seed=0)
+        router.start()
+
+        # warm both replicas' compiled programs off the clock
+        for rep_i in range(2):
+            router.generate(prompts[rep_i], max_new_tokens=4,
+                            timeout_s=300)
+
+        results: dict[int, object] = {}
+        stamps: dict[int, list[float]] = {}     # per-token arrival t
+
+        def call(i, delay):
+            time.sleep(delay)
+            ts = stamps[i] = []
+
+            def on_tokens(toks):
+                now = time.monotonic()
+                ts.extend([now] * len(toks))
+
+            try:
+                results[i] = router.generate(
+                    prompts[i],
+                    max_new_tokens=budgets[i % len(budgets)],
+                    timeout_s=600, on_tokens=on_tokens)
+            except Exception as e:
+                results[i] = e
+
+        t0 = time.time()
+        threads = [threading.Thread(target=call,
+                                    args=(i, float(arrivals[i])))
+                   for i in range(n_requests)]
+        for t in threads:
+            t.start()
+        # SIGKILL the replica the streams are sticky to, once tokens
+        # are demonstrably flowing through live relayed streams —
+        # ideally once at least one of the VICTIM's own streams has a
+        # harvested prefix, so the failover demonstrably carries
+        # tokens (bounded wait; live outstanding streams are the hard
+        # requirement, the prefix is opportunistic)
+        victim = None
+        deadline = time.time() + 120
+        prefix_deadline = time.time() + 20
+        while time.time() < deadline:
+            with router._lock:
+                names = set(router._outstanding.values())
+                flowing = router.streamed_tokens_total > 0
+            cand = next((rep for rep in reps if rep.name in names), None)
+            if cand is not None and flowing:
+                victim = cand
+                # does the VICTIM itself carry a harvestable prefix
+                # (its own outstanding streams, not just anyone's)?
+                with router._lock:
+                    victim_has_prefix = any(
+                        router._resume.get(rid)
+                        for rid, name in router._outstanding.items()
+                        if name == cand.name)
+                if victim_has_prefix or time.time() >= prefix_deadline:
+                    break
+            time.sleep(0.02)
+        assert victim is not None, "no live stream to kill under"
+        os.kill(victim.pid(), _signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=900)
+        wall = time.time() - t0
+        assert not any(t.is_alive() for t in threads), "hung streams"
+
+        failed = [i for i, r in results.items()
+                  if not isinstance(r, dict)]
+        assert not failed, (
+            f"streaming arm failed requests: "
+            f"{[(i, results[i]) for i in failed]}")
+        # byte-identity, TWICE over: the per-token stream the client
+        # assembled AND the final response both equal the non-streamed
+        # greedy reference
+        mismatched = [i for i in range(n_requests)
+                      if results[i]["tokens"] != refs[i]]
+        assert not mismatched, (
+            f"streamed output diverged from non-streamed greedy on: "
+            f"{mismatched}")
+        per_token_counts = [len(stamps[i]) for i in range(n_requests)]
+        assert per_token_counts == [len(r) for r in refs], (
+            "client-side token stream lengths diverged from refs")
+        rstats = router.stats()
+        assert rstats["failed"] == 0
+        assert rstats["stream_failovers"] >= 1, (
+            "the SIGKILL must land on live streams")
+        assert rstats["stream_disconnects"] == 0
+
+        # client-observed latency: TTFT (arrival->first token) is not
+        # derivable from stamps alone here, so report ITL only — the
+        # per-token gaps INCLUDING intra-chunk zeros (what a client
+        # sees), plus the nonzero chunk-gap view
+        gaps = []
+        for i in range(n_requests):
+            ts = stamps[i]
+            gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+        gaps.sort()
+
+        def q(p):
+            return gaps[min(len(gaps) - 1,
+                            int(p * (len(gaps) - 1)))] if gaps else 0.0
+
+        chunk_gaps = sorted(g for g in gaps if g > 0)
+
+        def cq(p):
+            return chunk_gaps[min(len(chunk_gaps) - 1,
+                                  int(p * (len(chunk_gaps) - 1)))] \
+                if chunk_gaps else 0.0
+
+        out = {
+            "metric": "streaming_serving_zero_failed_requests",
+            "value": 0,
+            "unit": "failed requests across an open-loop Poisson "
+                    "streamed burst with one mid-stream replica "
+                    "SIGKILL (byte-identity to non-streamed greedy "
+                    "enforced)",
+            "requests": n_requests,
+            "poisson_mean_interarrival_s": mean_interarrival_s,
+            "byte_identical": True,
+            "streamed_tokens": rstats["streamed_tokens"],
+            "stream_failovers": rstats["stream_failovers"],
+            "failovers": rstats["failovers"],
+            "resumed_tokens": rstats["resumed_tokens"],
+            "stream_disconnects": rstats["stream_disconnects"],
+            "itl_p50_s": round(q(0.50), 4),
+            "itl_p99_s": round(q(0.99), 4),
+            "chunk_gap_p50_s": round(cq(0.50), 4),
+            "chunk_gap_p99_s": round(cq(0.99), 4),
+            "wall_s": round(wall, 3),
+            "num_devices": jax.device_count(),
+        }
+        print(json.dumps(out))
+        return 0
+    finally:
+        if router is not None:
+            router.shutdown()
+        for rep in reps:
+            try:
+                rep.stop()
+            except Exception:
+                pass
+
+
 def run_elastic_bench() -> int:
     """Elastic-training robustness benchmark (docs/training-robustness.md),
     run TWICE — warm pool off, then on — so the recovery bound shows what
@@ -2504,6 +2784,8 @@ def main() -> int:
     if "--elastic" in sys.argv:
         return run_elastic_bench()
     if "--serving" in sys.argv:
+        if "--streaming" in sys.argv:
+            return run_serving_streaming_bench()
         if "--spec" in sys.argv:
             return run_serving_spec_bench()
         if "--replay" in sys.argv:

@@ -690,6 +690,22 @@ def test_metrics_names_rendered_and_documented():
                 _metrics.SERVING_SPEC_VERIFY_ROUNDS):
         assert fam in rendered, f"spec/model family unrendered: {fam}"
         assert fam in doc_names, f"spec/model family undocumented: {fam}"
+    # the streaming-delivery families are pinned EXPLICITLY the same
+    # way (ISSUE 14 lint discipline): each must be rendered by an
+    # endpoint (serve /metrics, router /metrics) and documented —
+    # renaming either side without the other fails here
+    for fam in (_metrics.SERVING_STREAMS_ACTIVE,
+                _metrics.SERVING_STREAMS_OPENED_TOTAL,
+                _metrics.SERVING_STREAM_STALLS_TOTAL,
+                _metrics.SERVING_STREAM_DISCONNECTS_TOTAL,
+                _metrics.ROUTER_STREAMS_ACTIVE,
+                _metrics.ROUTER_STREAMED_TOKENS_TOTAL,
+                _metrics.ROUTER_STREAM_FAILOVERS_TOTAL,
+                _metrics.ROUTER_STREAM_DISCONNECTS_TOTAL,
+                "serving_stream_itl_seconds"):
+        assert fam in rendered, f"streaming family unrendered: {fam}"
+        assert fam in doc_names, f"streaming family undocumented: {fam}"
+
     # the model-labeled partition is a rendered contract too: the serve
     # renderer must attach {model=...} labels somewhere (the per-model
     # block) and the doc must describe the label

@@ -996,6 +996,91 @@ def test_http_overload_sheds_429_with_retry_after(params):
         app.shutdown()
 
 
+def test_stream_client_disconnect_cancels_and_frees_slot(
+        params, monkeypatch):
+    """Mid-STREAM client disconnect (ISSUE 14 satellite): a client that
+    closes its socket while its SSE stream is live triggers cancel()
+    through the PR 3 path — the partial stream it read is an exact solo
+    prefix, the disconnect is counted, and the freed slot's next
+    occupant is byte-identical to a fresh server (cancellation is
+    scheduling, never numerics)."""
+    import json as _json
+    import socket as _socket
+    from http.server import ThreadingHTTPServer
+
+    from tony_tpu.cli.serve import make_handler
+
+    # slow each scheduling turn so the stream is reliably mid-decode
+    # when the client walks away (read at SlotServer construction),
+    # and serve in EOS mode (an unreachable stop token — vocab is 256)
+    # so blocks pace per turn instead of the predictive mode's
+    # open-loop run-ahead finishing the whole budget before the close
+    # is noticed
+    monkeypatch.setenv("TONY_TEST_SERVING_STEP_DELAY_MS", "40")
+    pa, pb = _prompts(2, key=311)
+    srv = _srv(params, stop_tokens=(300,))
+    app = ServeApp(srv)
+    app.start()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(app))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        body = _json.dumps({"prompt": [int(t) for t in pa],
+                            "max_new_tokens": 40,
+                            "stream": True}).encode()
+        raw = (f"POST /generate HTTP/1.1\r\nHost: x\r\n"
+               f"Content-Type: application/json\r\n"
+               f"Content-Length: {len(body)}\r\n"
+               f"Connection: close\r\n\r\n").encode() + body
+        sock = _socket.create_connection(("127.0.0.1", port), timeout=60)
+        sock.sendall(raw)
+        # read until at least one token frame arrived, then vanish
+        buf = b""
+        partial: list[int] = []
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not partial:
+            chunk = sock.recv(4096)
+            assert chunk, "server closed before any token frame"
+            buf += chunk
+            for line in buf.split(b"\n"):
+                line = line.strip()
+                if line.startswith(b"data: "):
+                    obj = _json.loads(line[len(b"data: "):])
+                    if "tokens" in obj and "finish_reason" not in obj:
+                        partial.extend(obj["tokens"])
+        assert partial, "never saw a token frame"
+        sock.close()                            # the client is gone
+        # the handler's next wait beat notices and cancels
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and \
+                srv.cancelled_requests < 1:
+            time.sleep(0.02)
+        assert srv.cancelled_requests == 1, (
+            "mid-stream disconnect must cancel the request")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and app.stream_disconnects < 1:
+            time.sleep(0.02)
+        assert app.stream_disconnects == 1
+        # the cancel is logged against the newest in-flight block; the
+        # stream is released when that block's processing replays it
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and srv.streams_active:
+            time.sleep(0.02)
+        assert srv.streams_active == 0, "stream must be released"
+        # the frames the client DID read are an exact solo prefix
+        assert 0 < len(partial) < 40
+        assert partial == _solo(params, pa, 40)[:len(partial)], (
+            "partial stream diverged from the solo greedy stream")
+        # the freed slot's next occupant: byte-identical to fresh
+        comp = app.generate(pb, 6, timeout=120)
+        assert comp.tokens == _solo(params, pb, 6), (
+            "request admitted after a disconnect-cancel diverged")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
 # --------------------------------------------------------------------------
 # seeded chaos: every request terminates, the server outlives the faults
 # --------------------------------------------------------------------------

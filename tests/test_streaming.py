@@ -1,0 +1,496 @@
+"""Streaming serving subsystem (tony_tpu/api/ + SlotServer token
+streams + the serve /v1 endpoints — docs/serving.md "Streaming &
+OpenAI compatibility").
+
+Contracts under test, bottom-up: the TokenStream channel (absolute-
+position dedupe, bounded-queue backpressure accounting, guaranteed
+terminal), the OpenAI payload mapping (params accepted, keys emitted,
+finish_reason mapping — PINNED against docs/serving.md by the
+api-contract lint so surface drift fails by name), SSE delivery over
+live HTTP byte-identical to the buffered path and to solo generate,
+multi-model /v1 routing, and streamed byte-identity ACROSS a mid-decode
+loop crash (the PR 11 replay riding underneath an open stream).
+"""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.api import openai as oai
+from tony_tpu.api.stream import SSE_DONE, TokenStream, sse_frame
+from tony_tpu.cli.serve import ServeApp, make_handler
+from tony_tpu.models import transformer
+from tony_tpu.models.generate import generate
+from tony_tpu.models.registry import ModelRegistry
+from tony_tpu.models.serving import Request, SlotServer
+
+TINY = transformer.TransformerConfig(
+    vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq_len=128, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init(jax.random.PRNGKey(0), TINY)
+
+
+def _prompt(n, seed=3):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, TINY.vocab_size), np.int32)
+
+
+def _solo(params, prompt, max_new):
+    out = generate(params, TINY, jnp.asarray(prompt)[None], max_new)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _srv(params, **kw):
+    """test_serving_robustness.py shapes — the tier-1 run reuses the
+    already-compiled programs."""
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prefill_chunk", 8)
+    return SlotServer(params, TINY, **kw)
+
+
+def _http_app(params, **kw):
+    srv = _srv(params, **kw)
+    app = ServeApp(srv)
+    app.start()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(app))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return srv, app, httpd, httpd.server_address[1]
+
+
+def _sse_post(port, path, payload, timeout=120):
+    """POST expecting an SSE response; returns the data-frame strings."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    frames = []
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        assert r.headers["Content-Type"] == "text/event-stream"
+        for raw in r:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                frames.append(line[len("data: "):])
+    return frames
+
+
+def _json_post(port, path, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+# --------------------------------------------------------------------------
+# TokenStream: the channel itself (no model, no HTTP)
+# --------------------------------------------------------------------------
+
+def test_token_stream_absolute_feed_dedupes():
+    """Feeds carry the ABSOLUTE emitted list; only the unseen suffix is
+    delivered — the property that makes replays and failover prefix
+    re-sends invisible to the consumer."""
+    ts = TokenStream()
+    assert ts.feed([1, 2, 3]) == (3, False)
+    assert ts.feed([1, 2, 3]) == (0, False)         # replay re-send
+    assert ts.feed([1, 2, 3, 4, 5]) == (2, False)   # only the suffix
+    ts.finish("length")
+    toks, reason, err = ts.drain_all(timeout=5)
+    assert toks == [1, 2, 3, 4, 5] and reason == "length" and err is None
+
+
+def test_token_stream_backpressure_coalesces_never_drops():
+    """A consumer that can't drain bounds the CHUNK count, not the
+    tokens: overflow coalesces into the newest chunk and is accounted
+    as a stall — byte-identity survives arbitrarily slow clients."""
+    ts = TokenStream(max_chunks=2)
+    emitted = []
+    stalls = 0
+    for i in range(10):
+        emitted.append(i)
+        _, stalled = ts.feed(emitted)
+        stalls += bool(stalled)
+    assert stalls == 10 - 2 == ts.stalls
+    assert len(ts._chunks) == 2
+    ts.finish("stop")
+    toks, reason, _ = ts.drain_all(timeout=5)
+    assert toks == list(range(10)) and reason == "stop"
+
+
+def test_token_stream_terminal_semantics():
+    """First terminal wins (a finish after a fail stays failed); the
+    iterator always ends with exactly one done/error event, after
+    every queued chunk."""
+    ts = TokenStream()
+    ts.feed([7])
+    ts.fail("boom")
+    ts.finish("length")                 # too late: failed stays failed
+    toks, reason, err = ts.drain_all(timeout=5)
+    assert toks == [7] and reason is None and err == "boom"
+    # wait beats surface while nothing is queued
+    ts2 = TokenStream()
+    assert ts2.take(timeout=0.01) == ("wait", None)
+    ts2.finish("stop")
+    assert ts2.take(timeout=0.01) == ("done", "stop")
+
+
+# --------------------------------------------------------------------------
+# OpenAI payload mapping units
+# --------------------------------------------------------------------------
+
+def test_codec_ids_roundtrip_and_bytes_mode():
+    ids = oai.TokenCodec("ids")
+    assert ids.encode("17 4 99") == [17, 4, 99]
+    assert ids.decode([17, 4, 99]) == "17 4 99"
+    with pytest.raises(ValueError, match="decimal token ids"):
+        ids.encode("hello world")
+    by = oai.TokenCodec("bytes", vocab_size=256)
+    assert by.encode("hi") == [104, 105]
+    assert by.decode([104, 105]) == "hi"
+    with pytest.raises(ValueError, match="vocab >= 256"):
+        oai.TokenCodec("bytes", vocab_size=128).encode("x")
+    with pytest.raises(ValueError, match="unknown text codec"):
+        oai.TokenCodec("words")
+
+
+def test_parse_completion_request():
+    codec = oai.TokenCodec("ids")
+    req = oai.parse_completion_request(
+        {"prompt": [1, 2, 3], "max_tokens": 9, "temperature": 0.5,
+         "top_k": 4, "stream": True, "model": "m"}, codec)
+    assert req["prompt_tokens"] == [1, 2, 3]
+    assert req["max_new_tokens"] == 9 and req["stream"] is True
+    assert req["temperature"] == 0.5 and req["top_k"] == 4
+    assert req["model"] == "m"
+    # defaults: OpenAI's max_tokens=16, no sampling overrides
+    req = oai.parse_completion_request({"prompt": "5 6"}, codec)
+    assert req["prompt_tokens"] == [5, 6]
+    assert req["max_new_tokens"] == 16 and req["stream"] is False
+    assert "temperature" not in req and "top_k" not in req
+    for bad in ({"prompt": []}, {"prompt": 7}, {"prompt": [True]},
+                {"prompt": [1], "n": 2},
+                {"prompt": [1], "stream": "yes"},
+                {"prompt": [1], "timeout_s": 0}):
+        with pytest.raises((ValueError, TypeError)):
+            oai.parse_completion_request(bad, codec)
+
+
+def test_parse_chat_request_concatenates_messages():
+    codec = oai.TokenCodec("ids")
+    req = oai.parse_chat_request(
+        {"messages": [{"role": "system", "content": "1 2"},
+                      {"role": "user", "content": "3"}]}, codec)
+    assert req["prompt_tokens"] == [1, 2, 3]
+    for bad in ({"messages": []}, {"messages": "hi"},
+                {"messages": [{"role": "user"}]},
+                {"messages": [{"content": ""}]}):
+        with pytest.raises(ValueError):
+            oai.parse_chat_request(bad, codec)
+
+
+def test_response_shapes_match_pinned_keys():
+    codec = oai.TokenCodec("ids")
+    comp = oai.completion_response(3, "m", [9, 8], "length", 5, codec)
+    assert set(comp) == set(oai.COMPLETION_RESPONSE_KEYS)
+    assert set(comp["choices"][0]) == set(oai.CHOICE_KEYS)
+    assert set(comp["usage"]) == set(oai.USAGE_KEYS)
+    assert comp["usage"] == {"prompt_tokens": 5, "completion_tokens": 2,
+                             "total_tokens": 7}
+    assert comp["id"].startswith("cmpl-") and comp["object"] == \
+        "text_completion"
+    chat = oai.chat_response(3, "m", [9, 8], "stop", 5, codec)
+    assert set(chat) == set(oai.CHAT_RESPONSE_KEYS)
+    assert set(chat["choices"][0]) == set(oai.CHAT_CHOICE_KEYS)
+    assert chat["choices"][0]["message"] == {"role": "assistant",
+                                             "content": "9 8"}
+    # finish_reason mapping is the pinned table, applied
+    for eng, wire in oai.FINISH_REASON_MAP.items():
+        got = oai.completion_response(0, "m", [], eng, 0, codec)
+        assert got["choices"][0]["finish_reason"] == wire
+    # streamed chunks: delta frames carry no finish_reason, the closer
+    # does; the first chat delta carries the assistant role
+    ch = oai.completion_chunk(1, "m", [4], codec)
+    assert ch["choices"][0]["finish_reason"] is None
+    closer = oai.chat_chunk(1, "m", [], codec, finish_reason="length",
+                            first=False)
+    assert closer["choices"][0]["finish_reason"] == "length"
+    first = oai.chat_chunk(1, "m", [4], codec, first=True)
+    assert first["choices"][0]["delta"]["role"] == "assistant"
+
+
+# --------------------------------------------------------------------------
+# api-contract lint: code <-> docs/serving.md, both directions
+# --------------------------------------------------------------------------
+
+def _doc_section(doc: str, marker: str) -> str:
+    m = re.search(rf"<!-- {marker}:start -->(.*?)<!-- {marker}:end -->",
+                  doc, re.S)
+    assert m, f"docs/serving.md lost its {marker} markers"
+    return m.group(1)
+
+
+def test_api_contract_pinned_against_docs():
+    """Surface-drift lint: the /v1 request params the server honors,
+    the response keys it emits, and the finish_reason mapping are
+    pinned between api/openai.py and docs/serving.md's marked tables —
+    adding/renaming on either side without the other fails BY NAME."""
+    doc = (Path(__file__).resolve().parent.parent
+           / "docs" / "serving.md").read_text()
+
+    def names(marker):
+        return set(re.findall(r"`([a-z_0-9]+)`",
+                              _doc_section(doc, marker)))
+
+    assert names("api-params-completions") == set(
+        oai.COMPLETION_REQUEST_PARAMS), "completions params drifted"
+    assert names("api-params-chat") == set(oai.CHAT_REQUEST_PARAMS), \
+        "chat params drifted"
+    assert names("api-response-keys") == (
+        set(oai.COMPLETION_RESPONSE_KEYS) | set(oai.CHOICE_KEYS)
+        | set(oai.CHAT_CHOICE_KEYS) | set(oai.USAGE_KEYS)), \
+        "response keys drifted"
+    # the finish_reason table maps engine -> wire, row for row
+    rows = re.findall(r"\|\s*`(\w+)`\s*\|\s*`(\w+)`\s*\|",
+                      _doc_section(doc, "api-finish-reasons"))
+    assert dict(rows) == dict(oai.FINISH_REASON_MAP), \
+        "finish_reason mapping drifted"
+    # the engine side of the mapping must cover the pinned completion
+    # vocabulary exactly (models/serving.py enum)
+    from tony_tpu.models.serving import COMPLETION_FINISH_REASONS
+
+    assert set(oai.FINISH_REASON_MAP) == set(COMPLETION_FINISH_REASONS)
+
+
+# --------------------------------------------------------------------------
+# live HTTP: SSE byte-identity, /v1 endpoints, multi-model, crash replay
+# --------------------------------------------------------------------------
+
+def test_generate_sse_byte_identical_to_buffered(params):
+    """THE streaming contract: /generate?stream=true delivers the same
+    tokens, in order, across >= 2 incremental SSE frames, as the
+    buffered POST and solo generate; the closing frame carries the
+    finish_reason and the stream accounting shows up in /stats and
+    /metrics."""
+    srv, app, httpd, port = _http_app(params)
+    try:
+        prompt = [int(t) for t in _prompt(6, seed=31)]
+        solo = _solo(params, np.asarray(prompt, np.int32), 12)
+        frames = [json.loads(f) for f in _sse_post(
+            port, "/generate?stream=true",
+            {"prompt": prompt, "max_new_tokens": 12})]
+        token_frames = [f for f in frames if "finish_reason" not in f]
+        final = frames[-1]
+        assert len(token_frames) >= 2, "delivery must be incremental"
+        toks = [t for f in token_frames for t in f["tokens"]]
+        assert toks == solo, "streamed tokens diverged from solo"
+        assert final["finish_reason"] == "length"
+        assert final["n_tokens"] == 12
+        # buffered path agrees
+        buf = _json_post(port, "/generate",
+                         {"prompt": prompt, "max_new_tokens": 12})
+        assert buf["tokens"] == solo
+        st = app.stats()
+        assert st["streams_opened"] == 1 and st["streams_active"] == 0
+        assert st["stream_disconnects"] == 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for fam in ("serving_streams_active",
+                    "serving_streams_opened_total",
+                    "serving_stream_backpressure_stalls_total",
+                    "serving_stream_disconnects_total",
+                    "serving_stream_itl_seconds"):
+            assert fam in text, f"{fam} missing from /metrics"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
+def test_openai_endpoints_stream_and_buffered(params):
+    """/v1/completions and /v1/chat/completions: the OpenAI wire shape
+    end to end — buffered responses carry the pinned keys and usage,
+    streams chunk the same tokens and end with [DONE], and the ids
+    codec round-trips text prompts."""
+    srv, app, httpd, port = _http_app(params)
+    try:
+        prompt = [int(t) for t in _prompt(6, seed=37)]
+        solo = _solo(params, np.asarray(prompt, np.int32), 10)
+        text = " ".join(str(t) for t in prompt)
+        # completions, buffered, token-array prompt
+        resp = _json_post(port, "/v1/completions",
+                          {"prompt": prompt, "max_tokens": 10})
+        assert resp["object"] == "text_completion"
+        assert resp["choices"][0]["tokens"] == solo
+        assert resp["choices"][0]["text"] == \
+            " ".join(str(t) for t in solo)
+        assert resp["usage"] == {"prompt_tokens": 6,
+                                 "completion_tokens": 10,
+                                 "total_tokens": 16}
+        # completions, streamed, TEXT prompt through the ids codec
+        frames = _sse_post(port, "/v1/completions",
+                           {"prompt": text, "max_tokens": 10,
+                            "stream": True})
+        assert frames[-1] == "[DONE]"
+        chunks = [json.loads(f)["choices"][0] for f in frames[:-1]]
+        toks = [t for c in chunks for t in c["tokens"]]
+        assert toks == solo
+        assert chunks[-1]["finish_reason"] == "length"
+        assert all(c["finish_reason"] is None for c in chunks[:-1])
+        # chat, streamed: first delta carries the role, contents concat
+        frames = _sse_post(port, "/v1/chat/completions",
+                           {"messages": [{"role": "user",
+                                          "content": text}],
+                            "max_tokens": 10, "stream": True})
+        assert frames[-1] == "[DONE]"
+        chunks = [json.loads(f)["choices"][0] for f in frames[:-1]]
+        assert chunks[0]["delta"].get("role") == "assistant"
+        assert [t for c in chunks for t in c["tokens"]] == solo
+        # chat, buffered
+        resp = _json_post(port, "/v1/chat/completions",
+                          {"messages": [{"role": "user",
+                                         "content": text}],
+                           "max_tokens": 10})
+        assert resp["object"] == "chat.completion"
+        assert resp["choices"][0]["message"]["content"] == \
+            " ".join(str(t) for t in solo)
+        # malformed: OpenAI error envelope, proper 400
+        try:
+            _json_post(port, "/v1/completions", {"prompt": []})
+            raise AssertionError("empty prompt must 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            err = json.loads(e.read().decode())["error"]
+            assert err["type"] == "invalid_request_error"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
+def test_openai_model_field_routes_through_registry(params):
+    """The /v1 ``model`` field routes through the ModelRegistry: two
+    engines in one process serve their own weights, the response
+    echoes the model, an unknown name is a 400 invalid_request_error
+    (never a silent fallback to the wrong weights)."""
+    reg = ModelRegistry()
+    reg.register("alpha", params, TINY, source="test")
+    # beta: different weights -> different completions prove routing
+    beta_params = transformer.init(jax.random.PRNGKey(9), TINY)
+    reg.register("beta", beta_params, TINY, source="test")
+    engines = {
+        name: SlotServer(registry=reg, model=name, slots=2, max_len=64,
+                         block_size=4, prefill_chunk=8)
+        for name in ("alpha", "beta")}
+    app = ServeApp(engines)
+    app.start()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(app))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        prompt = [int(t) for t in _prompt(5, seed=41)]
+        solo_a = _solo(params, np.asarray(prompt, np.int32), 8)
+        out_b = generate(beta_params, TINY,
+                         jnp.asarray(np.asarray(prompt, np.int32))[None],
+                         8)
+        solo_b = [int(t) for t in np.asarray(out_b)[0]]
+        assert solo_a != solo_b, "seeds must give distinct streams"
+        ra = _json_post(port, "/v1/completions",
+                        {"prompt": prompt, "max_tokens": 8,
+                         "model": "alpha"})
+        rb = _json_post(port, "/v1/completions",
+                        {"prompt": prompt, "max_tokens": 8,
+                         "model": "beta"})
+        assert ra["choices"][0]["tokens"] == solo_a
+        assert rb["choices"][0]["tokens"] == solo_b
+        assert ra["model"] == "alpha" and rb["model"] == "beta"
+        # streamed, model-routed
+        frames = _sse_post(port, "/v1/completions",
+                           {"prompt": prompt, "max_tokens": 8,
+                            "model": "beta", "stream": True})
+        toks = [t for f in frames[:-1]
+                for t in json.loads(f)["choices"][0]["tokens"]]
+        assert toks == solo_b
+        try:
+            _json_post(port, "/v1/completions",
+                       {"prompt": prompt, "max_tokens": 4,
+                        "model": "ghost"})
+            raise AssertionError("unknown model must 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert json.loads(e.read().decode())["error"]["type"] == \
+                "invalid_request_error"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
+def test_streamed_request_survives_loop_crash_byte_identical(
+        params, monkeypatch):
+    """Replay under an OPEN stream: a deterministic mid-decode loop
+    crash (PR 11 injection) replays the request with its journaled
+    prefix while the SSE consumer keeps reading — the delivered stream
+    has no duplicates, no gaps, and is byte-identical to solo. The
+    absolute-position feed is what makes the re-emitted prefix
+    invisible."""
+    monkeypatch.setenv("TONY_TEST_SERVING_CRASH_AT_BLOCKS", "2")
+    srv = _srv(params)
+    assert srv._chaos_crash_blocks == {2}
+    app = ServeApp(srv, max_loop_restarts=8, loop_backoff_s=0.02)
+    app.start()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(app))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        prompt = [int(t) for t in _prompt(6, seed=43)]
+        solo = _solo(params, np.asarray(prompt, np.int32), 16)
+        frames = [json.loads(f) for f in _sse_post(
+            port, "/generate?stream=true",
+            {"prompt": prompt, "max_new_tokens": 16})]
+        toks = [t for f in frames if "finish_reason" not in f
+                for t in f["tokens"]]
+        assert frames[-1]["finish_reason"] == "length"
+        assert toks == solo, (
+            "streamed tokens across a loop-crash replay diverged")
+        assert srv.chaos_faults_injected == 1 and srv.replays >= 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
+def test_stream_fails_loudly_when_replay_off(params, monkeypatch):
+    """Fail-fast preserved under streaming: with the journal off, a
+    loop crash ERRORS the open stream (one in-band error frame) instead
+    of hanging the consumer to its timeout."""
+    monkeypatch.setenv("TONY_TEST_SERVING_CRASH_AT_BLOCKS", "1")
+    srv = _srv(params, replay=False)
+    app = ServeApp(srv, max_loop_restarts=8, loop_backoff_s=0.02)
+    app.start()
+    try:
+        ts = TokenStream()
+        rid, ev = app.submit_async(_prompt(5, seed=47), 16, timeout=60,
+                                   stream=ts)
+        toks, reason, err = ts.drain_all(timeout=60)
+        assert reason is None and err is not None, (
+            "replay-off crash must error the stream")
+        app.discard_result(rid)
+    finally:
+        app.shutdown()

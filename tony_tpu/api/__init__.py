@@ -1,8 +1,17 @@
-"""Shared data model for the control plane.
+"""``tony_tpu.api`` — the externally visible surfaces.
 
-Mirrors the reference's rpc/TaskInfo + TaskStatus + models/ POJOs
-(tony-core/.../rpc/TaskInfo.java, TonySession.TonyTask, models/JobMetadata.java)
-as plain dataclasses serializable to JSON for the wire and the event log.
+This package holds two things:
+
+- the shared control-plane data model (this module): mirrors the
+  reference's rpc/TaskInfo + TaskStatus + models/ POJOs
+  (tony-core/.../rpc/TaskInfo.java, TonySession.TonyTask,
+  models/JobMetadata.java) as plain dataclasses serializable to JSON
+  for the wire and the event log;
+- the serving front-door surfaces: ``api.stream`` (the per-request
+  token emission channel + SSE framing behind ``/generate?stream=true``)
+  and ``api.openai`` (the OpenAI-compatible ``/v1/completions`` /
+  ``/v1/chat/completions`` payload mapping) — see docs/serving.md
+  "Streaming & OpenAI compatibility".
 """
 
 from __future__ import annotations

@@ -1,0 +1,316 @@
+"""OpenAI-compatible payload mapping for ``/v1/completions`` and
+``/v1/chat/completions``.
+
+The serving stack is token-native (prompts and completions are token-id
+sequences; no tokenizer ships with the repo), so the compatibility
+surface is defined around that:
+
+- ``/v1/completions`` accepts ``prompt`` as a token-id array (an
+  OpenAI-supported prompt form) or as TEXT run through the process's
+  ``TokenCodec`` (below);
+- responses carry the standard ``choices[0].text`` (codec-decoded)
+  PLUS a non-standard ``choices[0].tokens`` field with the raw ids —
+  the byte-identity contract (streamed vs non-streamed, failover vs
+  uninterrupted) is stated over tokens, and load generators that only
+  read ``text`` still work.
+
+``TokenCodec`` has two modes (serve/route ``--text-codec``):
+
+- ``ids`` (default): text is space-separated decimal token ids
+  ("17 4 99" <-> [17, 4, 99]) — exact round-trip, the mode every test
+  and bench uses;
+- ``bytes``: UTF-8 byte-level (needs vocab >= 256); ids >= 256 decode
+  as U+FFFD — lossy display, exact encode.
+
+The chat template is deliberately minimal: messages' contents are
+codec-encoded and concatenated in order (roles are not token-injected —
+there is no tokenizer to own special tokens). Documented in
+docs/serving.md; the api-contract lint (tests/test_streaming.py) pins
+the accepted request params, emitted response keys, and finish_reason
+mapping below against that doc, both directions.
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = [
+    "TokenCodec", "parse_completion_request", "parse_chat_request",
+    "completion_response", "chat_response", "completion_chunk",
+    "chat_chunk", "stream_frame_fns",
+    "COMPLETION_REQUEST_PARAMS", "CHAT_REQUEST_PARAMS",
+    "COMPLETION_RESPONSE_KEYS", "CHAT_RESPONSE_KEYS", "CHOICE_KEYS",
+    "CHAT_CHOICE_KEYS", "USAGE_KEYS", "FINISH_REASON_MAP",
+]
+
+
+# ---- the pinned surface (api-contract lint reads these) -------------------
+
+# request params the server HONORS (anything else in the payload is
+# ignored, except the validated-if-present ones noted in the doc)
+COMPLETION_REQUEST_PARAMS = frozenset((
+    "model", "prompt", "max_tokens", "temperature", "top_k", "stream",
+    "timeout_s",
+))
+CHAT_REQUEST_PARAMS = frozenset((
+    "model", "messages", "max_tokens", "temperature", "top_k", "stream",
+    "timeout_s",
+))
+
+COMPLETION_RESPONSE_KEYS = frozenset((
+    "id", "object", "created", "model", "choices", "usage",
+))
+CHAT_RESPONSE_KEYS = COMPLETION_RESPONSE_KEYS
+CHOICE_KEYS = frozenset(("index", "text", "tokens", "finish_reason"))
+CHAT_CHOICE_KEYS = frozenset(("index", "message", "tokens",
+                              "finish_reason"))
+USAGE_KEYS = frozenset(("prompt_tokens", "completion_tokens",
+                        "total_tokens"))
+
+# engine finish_reason (models/serving.py COMPLETION_FINISH_REASONS) ->
+# the /v1 wire value. "stop"/"length" are the OpenAI vocabulary;
+# "cancelled"/"expired" pass through VERBATIM (non-standard, documented)
+# — lying "stop" about a truncated stream would break any client that
+# trusts the enum to mean "the model chose to end here".
+FINISH_REASON_MAP = {
+    "stop": "stop",
+    "length": "length",
+    "cancelled": "cancelled",
+    "expired": "expired",
+}
+
+
+class TokenCodec:
+    """text <-> token-id mapping for the /v1 surface (module
+    docstring). ``mode`` is "ids" or "bytes"."""
+
+    def __init__(self, mode: str = "ids", vocab_size: int = 0):
+        if mode not in ("ids", "bytes"):
+            raise ValueError(f"unknown text codec {mode!r}")
+        self.mode = mode
+        self.vocab_size = int(vocab_size)
+
+    def encode(self, text: str) -> list[int]:
+        if self.mode == "ids":
+            try:
+                return [int(t) for t in text.split()]
+            except ValueError:
+                raise ValueError(
+                    "text-codec 'ids' expects space-separated decimal "
+                    "token ids (serve with --text-codec bytes for "
+                    "UTF-8 byte-level prompts)") from None
+        toks = list(text.encode("utf-8"))
+        if self.vocab_size and self.vocab_size < 256:
+            raise ValueError(
+                f"text-codec 'bytes' needs vocab >= 256, have "
+                f"{self.vocab_size}")
+        return toks
+
+    def decode(self, tokens) -> str:
+        if self.mode == "ids":
+            return " ".join(str(int(t)) for t in tokens)
+        # out-of-byte-range ids decode as U+FFFD: emit the full
+        # replacement-char UTF-8 sequence, never a bare lead byte that
+        # would swallow the NEXT valid tokens into one wrong character
+        out = bytearray()
+        for t in tokens:
+            t = int(t)
+            if 0 <= t < 256:
+                out.append(t)
+            else:
+                out += b"\xef\xbf\xbd"
+        return out.decode("utf-8", errors="replace")
+
+
+# ---- request parsing ------------------------------------------------------
+
+def _common_params(payload: dict) -> dict:
+    """The params shared by both /v1 endpoints, validated. Unknown
+    params are ignored (OpenAI tolerance), but a few poisoned ones are
+    rejected loudly rather than silently mis-served."""
+    if payload.get("n") not in (None, 1):
+        raise ValueError("n != 1 is not supported")
+    if payload.get("stream") is not None and not isinstance(
+            payload["stream"], bool):
+        raise ValueError("stream must be a JSON boolean")
+    out = {
+        "max_new_tokens": int(payload.get("max_tokens", 16)),
+        "stream": bool(payload.get("stream", False)),
+        "model": payload.get("model"),
+    }
+    if out["model"] is not None and not isinstance(out["model"], str):
+        raise ValueError("model must be a string")
+    if payload.get("temperature") is not None:
+        out["temperature"] = float(payload["temperature"])
+    if payload.get("top_k") is not None:
+        out["top_k"] = int(payload["top_k"])
+    timeout = float(payload.get("timeout_s", 600.0))
+    if not 0 < timeout < float("inf"):
+        raise ValueError("timeout_s must be a positive finite number")
+    out["timeout_s"] = timeout
+    return out
+
+
+def parse_completion_request(payload: dict, codec: TokenCodec) -> dict:
+    """``POST /v1/completions`` body -> engine kwargs:
+    {prompt_tokens, max_new_tokens, temperature?, top_k?, stream,
+    model, timeout_s}. ``prompt`` may be a string (codec-encoded) or a
+    token-id array."""
+    out = _common_params(payload)
+    prompt = payload.get("prompt")
+    if isinstance(prompt, str):
+        out["prompt_tokens"] = codec.encode(prompt)
+    elif isinstance(prompt, (list, tuple)) and prompt and all(
+            isinstance(t, (int, float)) and not isinstance(t, bool)
+            for t in prompt):
+        out["prompt_tokens"] = [int(t) for t in prompt]
+    else:
+        raise ValueError(
+            "prompt must be a non-empty token-id array or a string")
+    return out
+
+
+def parse_chat_request(payload: dict, codec: TokenCodec) -> dict:
+    """``POST /v1/chat/completions`` body -> engine kwargs (same shape
+    as ``parse_completion_request``). The chat template is the
+    identity concatenation of the messages' codec-encoded contents, in
+    order (module docstring)."""
+    out = _common_params(payload)
+    messages = payload.get("messages")
+    if not isinstance(messages, list) or not messages:
+        raise ValueError("messages must be a non-empty array")
+    toks: list[int] = []
+    for m in messages:
+        if not isinstance(m, dict) or not isinstance(m.get("content"),
+                                                     str):
+            raise ValueError(
+                "each message needs a string 'content' field")
+        toks.extend(codec.encode(m["content"]))
+    if not toks:
+        raise ValueError("messages encode to an empty prompt")
+    out["prompt_tokens"] = toks
+    return out
+
+
+# ---- response building ----------------------------------------------------
+
+def map_finish_reason(engine_reason: str) -> str:
+    return FINISH_REASON_MAP.get(engine_reason, engine_reason)
+
+
+def _usage(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {"prompt_tokens": int(prompt_tokens),
+            "completion_tokens": int(completion_tokens),
+            "total_tokens": int(prompt_tokens) + int(completion_tokens)}
+
+
+def completion_response(rid, model: str, tokens, finish_reason: str,
+                        prompt_tokens: int, codec: TokenCodec) -> dict:
+    return {
+        "id": f"cmpl-{rid}",
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "text": codec.decode(tokens),
+            "tokens": [int(t) for t in tokens],
+            "finish_reason": map_finish_reason(finish_reason),
+        }],
+        "usage": _usage(prompt_tokens, len(tokens)),
+    }
+
+
+def chat_response(rid, model: str, tokens, finish_reason: str,
+                  prompt_tokens: int, codec: TokenCodec) -> dict:
+    return {
+        "id": f"chatcmpl-{rid}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant",
+                        "content": codec.decode(tokens)},
+            "tokens": [int(t) for t in tokens],
+            "finish_reason": map_finish_reason(finish_reason),
+        }],
+        "usage": _usage(prompt_tokens, len(tokens)),
+    }
+
+
+def completion_chunk(rid, model: str, tokens, codec: TokenCodec,
+                     finish_reason: str | None = None) -> dict:
+    """One streamed /v1/completions SSE frame: a token-delta while
+    ``finish_reason`` is None, the closing frame otherwise (empty
+    delta, the mapped reason)."""
+    return {
+        "id": f"cmpl-{rid}",
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "text": codec.decode(tokens),
+            "tokens": [int(t) for t in tokens],
+            "finish_reason": (None if finish_reason is None
+                              else map_finish_reason(finish_reason)),
+        }],
+    }
+
+
+def stream_frame_fns(rid, model: str, codec: TokenCodec, chat: bool):
+    """The three byte-builders one /v1 SSE relay needs — shared by the
+    serve and router front doors so the framing can't drift between
+    them: ``frame(tokens)`` per delta (the first chat delta carries the
+    assistant role), ``final(reason)`` = closing chunk + ``[DONE]``,
+    ``err(message)`` = the in-band OpenAI error envelope."""
+    from .stream import SSE_DONE, sse_frame
+
+    first = {"v": True}
+
+    def frame(toks):
+        if chat:
+            obj = chat_chunk(rid, model, toks, codec, first=first["v"])
+            first["v"] = False
+        else:
+            obj = completion_chunk(rid, model, toks, codec)
+        return sse_frame(obj)
+
+    def final(reason):
+        obj = (chat_chunk(rid, model, [], codec, finish_reason=reason,
+                          first=first["v"]) if chat
+               else completion_chunk(rid, model, [], codec,
+                                     finish_reason=reason))
+        return sse_frame(obj) + SSE_DONE
+
+    def err(msg):
+        return sse_frame({"error": {"message": str(msg),
+                                    "type": "server_error"}})
+
+    return frame, final, err
+
+
+def chat_chunk(rid, model: str, tokens, codec: TokenCodec,
+               finish_reason: str | None = None, first: bool = False)\
+        -> dict:
+    """One streamed /v1/chat/completions SSE frame; the first delta
+    carries the assistant role (the OpenAI stream contract)."""
+    delta: dict = {}
+    if first:
+        delta["role"] = "assistant"
+    if tokens:
+        delta["content"] = codec.decode(tokens)
+    return {
+        "id": f"chatcmpl-{rid}",
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "delta": delta,
+            "tokens": [int(t) for t in tokens],
+            "finish_reason": (None if finish_reason is None
+                              else map_finish_reason(finish_reason)),
+        }],
+    }

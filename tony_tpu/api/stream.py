@@ -1,0 +1,200 @@
+"""Per-request token emission channel + SSE framing.
+
+``TokenStream`` is the delivery half of streaming serving
+(docs/serving.md "Streaming & OpenAI compatibility"): the engine side
+(``SlotServer``) feeds host-known tokens into it at every PROCESSED
+decode block — the same instant the request journal advances, so what a
+client has been streamed is exactly what a failover can resume from —
+and one HTTP handler thread drains it into SSE frames.
+
+Design constraints, in order:
+
+- **The serving loop never blocks on a slow client.** ``feed()`` is
+  called under the serving lock; it appends and returns. The queue is
+  bounded in CHUNK count, not tokens: when a consumer can't drain,
+  excess chunks COALESCE into the newest entry (no token is ever
+  dropped — byte-identity of the concatenated stream is a gate) and a
+  backpressure stall is accounted (``serving_stream_backpressure_stalls_
+  total``). Memory stays bounded by the request's ``max_new_tokens``
+  either way.
+- **Feeds are absolute, so replay dedupes itself.** The engine feeds
+  the request's FULL emitted tally (``_emitted[slot]``, resume prefix
+  included); the stream appends only ``emitted[n_fed:]``. A loop-crash
+  replay that re-emits the prefix, or a router failover stream that
+  re-sends it, delivers each token exactly once.
+- **Every stream terminates.** Each engine terminal (Completion
+  creation, reset loss, ServeApp failure path) finishes or fails the
+  stream, so a consumer iterating ``events()`` always sees a ``done``
+  or ``error`` frame — never a hang past its own deadline polling.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+
+__all__ = ["TokenStream", "sse_frame", "SSE_HEADERS", "SSE_DONE"]
+
+
+# the Content-Type + anti-buffering headers every streaming response
+# sends (serve and router front doors share them)
+SSE_HEADERS = (
+    ("Content-Type", "text/event-stream"),
+    ("Cache-Control", "no-cache"),
+    ("X-Accel-Buffering", "no"),
+)
+
+# the OpenAI stream terminator sentinel (literal, not JSON)
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+def sse_frame(obj) -> bytes:
+    """One ``data:`` SSE frame. ``obj`` is JSON-serialized unless it is
+    already a string (the ``[DONE]`` sentinel path)."""
+    data = obj if isinstance(obj, str) else json.dumps(obj)
+    return b"data: " + data.encode() + b"\n\n"
+
+
+def read_json_body(handler) -> dict:
+    """Read one HTTP request's JSON object body (serve and router
+    front doors share this; a non-object body is a ValueError the
+    caller maps to 400)."""
+    n = int(handler.headers.get("Content-Length", "0"))
+    payload = json.loads(handler.rfile.read(n) or b"{}")
+    if not isinstance(payload, dict):
+        raise ValueError("request body must be a JSON object")
+    return payload
+
+
+def begin_sse(handler) -> None:
+    """Send the SSE response head on a BaseHTTPRequestHandler."""
+    handler.send_response(200)
+    for k, v in SSE_HEADERS:
+        handler.send_header(k, v)
+    handler.send_header("Connection", "close")
+    handler.end_headers()
+
+
+def stream_requested(payload: dict, path: str) -> bool:
+    """The /generate stream opt-in, one rule for both front doors:
+    ``"stream": true`` in the payload (validated as a JSON boolean) or
+    ``?stream=true`` in the query string."""
+    from urllib.parse import parse_qs, urlparse
+
+    want = payload.get("stream")
+    if want is not None and not isinstance(want, bool):
+        raise ValueError("stream must be a JSON boolean")
+    return bool(want) or (
+        parse_qs(urlparse(path).query).get("stream", ["false"])[0]
+        .lower() in ("1", "true", "yes"))
+
+
+class TokenStream:
+    """Bounded per-request token channel between the serving loop and
+    one consumer thread. Producer side (``feed``/``finish``/``fail``)
+    is called under the serving lock; consumer side (``events``) holds
+    only the stream's own condition."""
+
+    def __init__(self, max_chunks: int = 64):
+        self._cond = threading.Condition()
+        self._chunks: collections.deque[list[int]] = collections.deque()
+        self.max_chunks = max(2, int(max_chunks))
+        self.n_fed = 0          # tokens accepted from the engine (absolute)
+        self.stalls = 0         # feeds that found the chunk queue full
+        self.finish_reason: str | None = None
+        self.error: str | None = None
+        # engine-side inter-feed instant (the inter-token-latency
+        # histogram's clock); owned by the engine, kept here so the
+        # stream object is the one piece of per-request streaming state
+        self.last_feed_t: float | None = None
+
+    # -------------------------------------------------------- producer side
+
+    def feed(self, emitted) -> tuple[int, bool]:
+        """Append the new suffix of ``emitted`` (the request's absolute
+        emitted-token list). Returns ``(n_new, stalled)`` — ``stalled``
+        is True when the consumer had fallen ``max_chunks`` behind and
+        the new tokens coalesced into the newest queued chunk instead
+        of a fresh one (accounting, never loss)."""
+        new = [int(t) for t in emitted[self.n_fed:]]
+        if not new:
+            return 0, False
+        with self._cond:
+            self.n_fed += len(new)
+            stalled = len(self._chunks) >= self.max_chunks
+            if stalled and self._chunks:
+                self.stalls += 1
+                self._chunks[-1].extend(new)
+            else:
+                self._chunks.append(new)
+            self._cond.notify_all()
+        return len(new), stalled
+
+    def finish(self, reason: str) -> None:
+        """Seal the stream at its terminal (idempotent; the first
+        terminal wins — a finish after a fail stays failed)."""
+        with self._cond:
+            if self.finish_reason is None:
+                self.finish_reason = str(reason)
+            self._cond.notify_all()
+
+    def fail(self, message: str) -> None:
+        """Terminal error: the request died without a Completion
+        (restart-budget exhaustion, drain timeout, replay-off reset
+        loss). The consumer's iterator yields one ``error`` event."""
+        with self._cond:
+            if self.finish_reason is None:
+                self.finish_reason = "failed"
+                self.error = str(message)
+            self._cond.notify_all()
+
+    @property
+    def done(self) -> bool:
+        with self._cond:
+            return self.finish_reason is not None and not self._chunks
+
+    # -------------------------------------------------------- consumer side
+
+    def take(self, timeout: float = 0.25):
+        """One consumer beat: ``("tokens", [ints])`` when a chunk is
+        ready, ``("done", finish_reason)`` / ``("error", message)`` at
+        the terminal (after every chunk is drained), ``("wait", None)``
+        when ``timeout`` elapsed with nothing new — the caller's chance
+        to notice its own deadline or a vanished client."""
+        with self._cond:
+            if not self._chunks and self.finish_reason is None:
+                self._cond.wait(timeout)
+            if self._chunks:
+                return "tokens", self._chunks.popleft()
+            if self.finish_reason is not None:
+                if self.error is not None:
+                    return "error", self.error
+                return "done", self.finish_reason
+            return "wait", None
+
+    def events(self, poll_s: float = 0.25):
+        """Iterate ``take()`` until the terminal event (which is
+        yielded, then iteration stops). ``wait`` beats are yielded
+        through so the caller can run its disconnect/deadline checks."""
+        while True:
+            kind, payload = self.take(timeout=poll_s)
+            yield kind, payload
+            if kind in ("done", "error"):
+                return
+
+    def drain_all(self, timeout: float = 60.0):
+        """Test/utility helper: block until the terminal, returning
+        ``(tokens, finish_reason_or_None, error_or_None)``."""
+        out: list[int] = []
+        deadline = time.monotonic() + timeout
+        for kind, payload in self.events(poll_s=0.05):
+            if kind == "tokens":
+                out.extend(payload)
+            elif kind == "done":
+                return out, payload, None
+            elif kind == "error":
+                return out, None, payload
+            elif time.monotonic() > deadline:
+                raise TimeoutError("stream never terminated")

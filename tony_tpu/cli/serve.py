@@ -13,7 +13,13 @@ One serving thread owns the device: it admits queued requests into freed
 KV-cache slots and runs compiled decode blocks; HTTP handler threads only
 enqueue and wait. POST /generate blocks until the request completes
 (simple and proxy-friendly — the reference fronts exactly this kind of
-long-lived service with its proxy, tony-proxy/.../ProxyServer.java:27-39);
+long-lived service with its proxy, tony-proxy/.../ProxyServer.java:27-39)
+— or STREAMS it: ``/generate?stream=true`` (or ``"stream": true``)
+delivers per-token SSE frames fed at every processed decode block, and
+``POST /v1/completions`` / ``/v1/chat/completions`` give the same engine
+an OpenAI-compatible front door (tony_tpu/api/, ``--text-codec``;
+docs/serving.md "Streaming & OpenAI compatibility"). A client that
+vanishes mid-stream is cancelled through the PR 3 path.
 GET /stats reports slot occupancy, queue depth, the prefix-cache counters
 (hits/misses/evictions, prefill tokens computed vs reused — see
 ``--prefix-cache-blocks`` and docs/serving.md), the latency-histogram
@@ -160,6 +166,13 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--draft-n-layers", type=int, default=2)
     p.add_argument("--draft-n-heads", type=int, default=4)
     p.add_argument("--draft-d-ff", type=int, default=256)
+    p.add_argument("--text-codec", default="ids", choices=("ids", "bytes"),
+                   help="text<->token mapping for the OpenAI-compatible "
+                        "/v1 endpoints (no tokenizer ships with the "
+                        "repo): 'ids' = text is space-separated decimal "
+                        "token ids (exact round-trip, the default); "
+                        "'bytes' = UTF-8 byte-level (needs vocab >= "
+                        "256; ids >= 256 decode as U+FFFD)")
     p.add_argument("--journal-checkpoint-s", type=float, default=1.0,
                    help="durability-checkpoint cadence: process the "
                         "open-loop pipeline down to pipeline_depth this "
@@ -362,6 +375,11 @@ class ServeApp:
         self._last_checkpoint = 0.0
         self.loop_failures = 0          # step exceptions, cumulative
         self.loop_restarts = 0          # successful reset+restart cycles
+        # streaming delivery: clients that vanished mid-SSE-stream (the
+        # handler maps the disconnect onto cancel(), so the slot goes
+        # back to live traffic; counted here because only the HTTP
+        # layer can see the socket die)
+        self.stream_disconnects = 0
         self._restart_streak = 0        # consecutive failures (the budget)
         self._events: dict[int, threading.Event] = {}
         self._results: dict[int, object] = {}
@@ -458,6 +476,11 @@ class ServeApp:
             seal = getattr(eng, "seal_journal", None)
             if callable(seal):
                 seal(rid)
+            # a streamed request's consumer must see the same terminal
+            # error its waiter got — never hang to its own deadline
+            fail_stream = getattr(eng, "fail_stream", None)
+            if callable(fail_stream):
+                fail_stream(rid, f"serving loop failed: {exc!r}")
             ev.set()
 
     def _loop(self):
@@ -683,7 +706,8 @@ class ServeApp:
                      cache_prompt: bool | None = None,
                      resume_tokens: list | None = None,
                      progress_key: str | None = None,
-                     model: str | None = None):
+                     model: str | None = None,
+                     stream=None):
         """Admission half of generate(): returns (request_id, event). The
         request carries ``timeout`` as its queue deadline — if it is
         still queued when the waiter would have given up, admission skips
@@ -692,7 +716,10 @@ class ServeApp:
         completion's tokens include it); ``progress_key`` registers a
         caller-chosen key for GET /progress so a router can journal
         this request's emitted prefix while it runs; ``model`` routes
-        to the named engine (multi-model serving)."""
+        to the named engine (multi-model serving); ``stream`` attaches
+        a caller-owned ``api.stream.TokenStream`` for per-token
+        delivery — attachment is atomic with the submit, so no emitted
+        token can slip between them."""
         from ..models.serving import Request
 
         engine = self._engine_for(model)
@@ -718,6 +745,12 @@ class ServeApp:
                 self._events[req.id] = ev
                 engine.submit(req)          # may shed: QueueFullError
                 self._rid_engine[req.id] = engine
+                if stream is not None:
+                    attach = getattr(engine, "attach_stream", None)
+                    if callable(attach):
+                        attach(req.id, stream)
+                    else:       # engine without streaming (test stubs)
+                        stream.fail("engine does not support streaming")
                 if progress_key:
                     self._progress_keys[str(progress_key)] = req.id
                     if len(self._progress_keys) > self._progress_keys_cap:
@@ -775,6 +808,21 @@ class ServeApp:
         if isinstance(res, Exception):   # the loop failed this request
             raise res
         return res
+
+    def discard_result(self, request_id: int) -> None:
+        """Streamed-request cleanup: the SSE handler delivered the
+        terminal through the TokenStream, so the waiter-side event and
+        any stored result are dropped unread (atomic vs ``_deliver``:
+        popping the event means a not-yet-delivered completion is
+        dropped instead of leaking into ``_results``)."""
+        with self.lock:
+            self._events.pop(request_id, None)
+            self._results.pop(request_id, None)
+            self._rid_engine.pop(request_id, None)
+
+    def note_stream_disconnect(self) -> None:
+        with self.lock:
+            self.stream_disconnects += 1
 
     def cancel(self, request_id: int) -> bool:
         """The abandonment path: drop the waiter and stop the request
@@ -932,6 +980,23 @@ class ServeApp:
         ):
             if key in st:
                 r.counter(name, st[key], help_text)
+        # streaming delivery families (docs/observability.md "Streaming
+        # metrics"): rendered unconditionally — a zero is a statement
+        r.gauge(_metrics.SERVING_STREAMS_ACTIVE,
+                st.get("streams_active", 0),
+                "live per-request SSE token streams")
+        r.counter(_metrics.SERVING_STREAMS_OPENED_TOTAL,
+                  st.get("streams_opened", 0),
+                  "token streams ever attached")
+        r.counter(_metrics.SERVING_STREAM_STALLS_TOTAL,
+                  st.get("stream_stalls", 0),
+                  "stream feeds that found the consumer's chunk queue "
+                  "full (backpressure: coalesced, accounted, never "
+                  "dropped)")
+        r.counter(_metrics.SERVING_STREAM_DISCONNECTS_TOTAL,
+                  st.get("stream_disconnects", 0),
+                  "clients that vanished mid-stream (mapped onto "
+                  "cancel(): the slot returns to live traffic)")
         loop = st.get("loop", {})
         r.counter(_metrics.SERVING_LOOP_RESTARTS,
                   loop.get("restarts", self.loop_restarts),
@@ -1109,7 +1174,8 @@ class ServeApp:
         "slots", "active", "queued", "shed", "cancelled", "expired",
         "resets", "replays", "replayed_tokens", "blocks_dispatched",
         "admission_dispatches", "prefill_tokens_computed",
-        "prefill_tokens_reused", "chaos_faults_injected")
+        "prefill_tokens_reused", "chaos_faults_injected",
+        "streams_active", "streams_opened", "stream_stalls")
 
     def stats(self) -> dict:
         with self.lock:
@@ -1141,6 +1207,10 @@ class ServeApp:
                 "failures": self.loop_failures,
                 "max_restarts": self.max_loop_restarts,
             }
+            # streaming: only the HTTP layer sees sockets die, so the
+            # disconnect counter lives here, next to the engines'
+            # streams_active/streams_opened/stream_stalls aggregates
+            out["stream_disconnects"] = self.stream_disconnects
             # which process answers here — fleet tooling (and the kill-a-
             # replica e2e) needs to map an endpoint back to its process
             import os as _os
@@ -1187,7 +1257,15 @@ class ServeApp:
             self._profile_lock.release()
 
 
-def make_handler(app: ServeApp):
+def make_handler(app: ServeApp, codec=None):
+    """The serve HTTP surface. ``codec`` is the ``api.openai.TokenCodec``
+    the /v1 endpoints use for text<->token mapping (default: "ids" —
+    text is space-separated decimal token ids; serve --text-codec)."""
+    from ..api.openai import TokenCodec
+
+    if codec is None:
+        codec = TokenCodec("ids")
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):      # quiet; the loop is the log story
             pass
@@ -1274,15 +1352,76 @@ def make_handler(app: ServeApp):
             else:
                 self._send(404, {"error": "unknown path"})
 
+        # ------------------------------------------------------- streaming
+
+        def _read_json(self) -> dict:
+            from ..api.stream import read_json_body
+
+            return read_json_body(self)
+
+        def _begin_sse(self) -> None:
+            from ..api.stream import begin_sse
+
+            begin_sse(self)
+
+        def _relay_sse(self, rid, stream, deadline, frame_fn, final_fn,
+                       error_fn) -> None:
+            """Drain one request's TokenStream into SSE frames (headers
+            already sent). ``frame_fn(tokens) -> bytes`` per delta,
+            ``final_fn(reason) -> bytes`` at the terminal,
+            ``error_fn(message) -> bytes`` for in-band errors. A write
+            failure or a peeked EOF = the client vanished: the request
+            is CANCELLED (PR 3 path — the freed slot's next occupant is
+            byte-identical to a fresh server) and the disconnect
+            counted."""
+            try:
+                for kind, payload in stream.events(poll_s=0.25):
+                    if kind == "tokens":
+                        self.wfile.write(frame_fn(payload))
+                        self.wfile.flush()
+                    elif kind == "done":
+                        self.wfile.write(final_fn(payload))
+                        self.wfile.flush()
+                        break
+                    elif kind == "error":
+                        self.wfile.write(error_fn(payload))
+                        self.wfile.flush()
+                        break
+                    else:                   # wait beat: our own checks
+                        if time.monotonic() >= deadline:
+                            app.cancel(rid)
+                            self.wfile.write(error_fn(
+                                f"request {rid} timed out; cancelled"))
+                            self.wfile.flush()
+                            break
+                        if self._client_gone():
+                            raise BrokenPipeError("client went away")
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                # mid-stream disconnect: stop decoding for nobody
+                app.cancel(rid)
+                app.note_stream_disconnect()
+            finally:
+                app.discard_result(rid)
+            self.close_connection = True
+
+        # -------------------------------------------------------- endpoints
+
         def do_POST(self):
-            if self.path != "/generate":
+            path = self.path.partition("?")[0]
+            if path == "/generate":
+                self._post_generate()
+            elif path == "/v1/completions":
+                self._post_openai(chat=False)
+            elif path == "/v1/chat/completions":
+                self._post_openai(chat=True)
+            else:
                 self._send(404, {"error": "unknown path"})
-                return
+
+        def _post_generate(self):
             from ..models.serving import QueueFullError
 
             try:
-                n = int(self.headers.get("Content-Length", "0"))
-                payload = json.loads(self.rfile.read(n) or b"{}")
+                payload = self._read_json()
                 prompt = payload["prompt"]
                 max_new = int(payload.get("max_new_tokens", 64))
                 temp = payload.get("temperature")
@@ -1315,13 +1454,22 @@ def make_handler(app: ServeApp):
                 model = payload.get("model")
                 if model is not None and not isinstance(model, str):
                     raise ValueError("model must be a string")
+                # per-token streaming: ?stream=true or "stream": true
+                from ..api.stream import stream_requested
+
+                stream_on = stream_requested(payload, self.path)
+                ts = None
+                if stream_on:
+                    from ..api.stream import TokenStream
+
+                    ts = TokenStream()
                 rid, ev = app.submit_async(
                     prompt, max_new, timeout=timeout,
                     temperature=None if temp is None else float(temp),
                     top_k=None if top_k is None else int(top_k),
                     cache_prompt=cache_prompt,
                     resume_tokens=resume, progress_key=progress_key,
-                    model=model)
+                    model=model, stream=ts)
             except QueueFullError as e:
                 # shed: the queue is full. 429 + Retry-After is the
                 # load-balancer contract — retry elsewhere/later instead
@@ -1341,6 +1489,30 @@ def make_handler(app: ServeApp):
                 return
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": str(e)})
+                return
+            if ts is not None:
+                # SSE per-token delivery. Native frame contract
+                # (docs/serving.md "Streaming & OpenAI compatibility"):
+                # {"tokens": [...]} deltas, then one closing
+                # {"id", "finish_reason", "n_tokens"} frame.
+                from ..api.stream import sse_frame
+
+                sent = {"n": 0}
+
+                def frame(toks):
+                    sent["n"] += len(toks)
+                    return sse_frame({"tokens": [int(t) for t in toks]})
+
+                def final(reason):
+                    return sse_frame({"id": rid, "finish_reason": reason,
+                                      "n_tokens": sent["n"]})
+
+                def err(msg):
+                    return sse_frame({"error": str(msg)})
+
+                self._begin_sse()
+                self._relay_sse(rid, ts, time.monotonic() + timeout,
+                                frame, final, err)
                 return
             # wait in short beats so a vanished client is noticed and its
             # request CANCELLED — the slot goes back to live traffic
@@ -1366,6 +1538,87 @@ def make_handler(app: ServeApp):
                 return
             self._send(200, {"id": comp.id, "tokens": comp.tokens,
                              "finish_reason": comp.finish_reason})
+
+        def _oai_error(self, code: int, message: str, etype: str) -> None:
+            self._send(code, {"error": {"message": message,
+                                        "type": etype}})
+
+        def _post_openai(self, chat: bool):
+            """OpenAI-compatible front door: ``/v1/completions`` and
+            ``/v1/chat/completions``, streaming and non-streaming. The
+            payload mapping (accepted params, response keys,
+            finish_reason mapping) is pinned in ``api.openai`` and
+            docs/serving.md, both directions, by the api-contract lint."""
+            from ..api import openai as oai
+            from ..models.serving import QueueFullError
+
+            try:
+                payload = self._read_json()
+                req = (oai.parse_chat_request(payload, codec) if chat
+                       else oai.parse_completion_request(payload, codec))
+            except (KeyError, ValueError, TypeError) as e:
+                self._oai_error(400, str(e), "invalid_request_error")
+                return
+            model_name = req["model"] or app.default_model
+            ts = None
+            if req["stream"]:
+                from ..api.stream import TokenStream
+
+                ts = TokenStream()
+            try:
+                rid, ev = app.submit_async(
+                    req["prompt_tokens"], req["max_new_tokens"],
+                    timeout=req["timeout_s"],
+                    temperature=req.get("temperature"),
+                    top_k=req.get("top_k"),
+                    model=req["model"], stream=ts)
+            except QueueFullError as e:
+                ra = getattr(e, "retry_after_s", 0)
+                self._send(429, {"error": {"message": str(e),
+                                           "type": "rate_limit_error"}},
+                           headers={"Retry-After": str(
+                               ra if ra else app.retry_after_s())})
+                return
+            except ServingLoopError as e:
+                self._oai_error(503, str(e), "service_unavailable")
+                return
+            except UnknownModelError as e:
+                self._oai_error(400, str(e), "invalid_request_error")
+                return
+            except (KeyError, ValueError, TypeError) as e:
+                self._oai_error(400, str(e), "invalid_request_error")
+                return
+            n_prompt = len(req["prompt_tokens"])
+            if ts is not None:
+                frame, final, err = oai.stream_frame_fns(
+                    rid, model_name, codec, chat)
+                self._begin_sse()
+                self._relay_sse(rid, ts, time.monotonic()
+                                + req["timeout_s"], frame, final, err)
+                return
+            deadline = time.monotonic() + req["timeout_s"]
+            while not ev.wait(0.25):
+                if time.monotonic() >= deadline:
+                    app.cancel(rid)
+                    self._oai_error(
+                        504, f"request {rid} timed out after "
+                             f"{req['timeout_s']}s; cancelled", "timeout")
+                    return
+                if self._client_gone():
+                    app.cancel(rid)
+                    self.close_connection = True
+                    return
+            try:
+                comp = app.take_result(rid)
+            except ServingLoopError as e:
+                self._oai_error(503, str(e), "service_unavailable")
+                return
+            except TimeoutError as e:
+                self._oai_error(504, str(e), "timeout")
+                return
+            build = oai.chat_response if chat else oai.completion_response
+            self._send(200, build(comp.id, model_name, comp.tokens,
+                                  comp.finish_reason, n_prompt, codec))
 
     return Handler
 
@@ -1540,7 +1793,11 @@ def main(argv=None) -> int:
                    journal_checkpoint_s=(0.0 if args.no_replay
                                          else args.journal_checkpoint_s))
     app.start()
-    httpd = ThreadingHTTPServer((args.host, args.port), make_handler(app))
+    from ..api.openai import TokenCodec
+
+    codec = TokenCodec(args.text_codec, vocab_size=args.vocab)
+    httpd = ThreadingHTTPServer((args.host, args.port),
+                                make_handler(app, codec))
 
     # graceful drain on SIGTERM/SIGINT: a supervisor's TERM must finish
     # in-flight requests instead of killing them mid-decode. A foreground

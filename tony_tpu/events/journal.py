@@ -143,7 +143,12 @@ class RequestJournal:
             self._append({"op": "emit", "id": rid, "tokens": emitted})
 
     def emit(self, rid: int, tokens) -> None:
-        """Append newly processed output tokens to a live entry."""
+        """Append newly processed output tokens to a live entry. This
+        is the per-request durability point — the streaming path
+        (``SlotServer._stream_feed``) advances each request's
+        ``TokenStream`` at the same processing instant, so what a
+        client has been streamed never runs ahead of what a replay or
+        router failover can resume from."""
         tokens = [int(t) for t in tokens]
         if not tokens:
             return

@@ -77,6 +77,16 @@ SERVING_SPEC_ACCEPTED_TOKENS_TOTAL = "serving_spec_accepted_tokens_total"
 SERVING_SPEC_GAMMA = "serving_spec_gamma"
 SERVING_SPEC_ACCEPTANCE_RATE = "serving_spec_acceptance_rate"
 SERVING_SPEC_VERIFY_ROUNDS = "serving_spec_verify_rounds"
+# streaming delivery (tony_tpu/api/stream.py + SlotServer token
+# streams — docs/serving.md "Streaming & OpenAI compatibility"): live
+# SSE streams, streams ever opened, feeds that found the per-request
+# chunk queue full (the consumer can't drain — coalesced, accounted,
+# never dropped), and clients that vanished mid-stream (mapped onto
+# cancel(); the freed slot's next occupant stays byte-identical)
+SERVING_STREAMS_ACTIVE = "serving_streams_active"
+SERVING_STREAMS_OPENED_TOTAL = "serving_streams_opened_total"
+SERVING_STREAM_STALLS_TOTAL = "serving_stream_backpressure_stalls_total"
+SERVING_STREAM_DISCONNECTS_TOTAL = "serving_stream_disconnects_total"
 
 # driver-side cluster telemetry (rendered by Driver.render_metrics on the
 # driver's GET /metrics — docs/observability.md "Driver metrics"). Named
@@ -135,6 +145,15 @@ ROUTER_AFFINITY_HIT_RATIO = "router_affinity_hit_ratio"
 # after a transport failure/ejection, carrying the emitted prefix the
 # router last learned from /progress (resume_tokens)
 ROUTER_FAILOVERS_TOTAL = "router_failovers_total"
+# streaming pass-through (docs/serving.md "Streaming & OpenAI
+# compatibility"): live relayed SSE streams, tokens forwarded through
+# them, mid-stream failovers where the resume prefix was HARVESTED
+# from the relayed stream itself (no /progress poll needed), and
+# front-door clients that vanished mid-relay
+ROUTER_STREAMS_ACTIVE = "router_streams_active"
+ROUTER_STREAMED_TOKENS_TOTAL = "router_streamed_tokens_total"
+ROUTER_STREAM_FAILOVERS_TOTAL = "router_stream_failovers_total"
+ROUTER_STREAM_DISCONNECTS_TOTAL = "router_stream_disconnects_total"
 # 1 while driver discovery is flying blind (driver.json missing/stale,
 # the RPC endpoint refusing, or an implausible empty fleet inside the
 # drop grace) and the router is serving its LAST-KNOWN fleet — the

@@ -1284,6 +1284,17 @@ class SlotServer:
                          else (RequestJournal() if self.replay else None))
         self.replays = 0                # admissions with a resume prefix
         self.replayed_tokens = 0        # teacher-forced resume tokens
+        # ---- streaming delivery (tony_tpu/api/stream.py) ----
+        # request id -> attached TokenStream: fed host-known tokens at
+        # every PROCESSED decode block (the journal's durability point,
+        # so a streamed prefix never runs ahead of what failover can
+        # resume), finished at the terminal, failed on reset loss.
+        # Streams survive reset() — a replayed request keeps its id and
+        # the absolute-position feed dedupes the re-emitted prefix.
+        self._streams: dict[int, object] = {}
+        self.streams_opened = 0         # streams ever attached
+        self.stream_stalls = 0          # feeds that found the chunk
+        #                                 queue full (consumer behind)
         # ---- request-level telemetry (observability.py) ----
         # every submitted request carries a RequestTrace from submit to
         # its terminal span; finished traces feed the latency histograms,
@@ -1638,6 +1649,7 @@ class SlotServer:
                     req.id, out, "expired",
                     trace=self._finish_trace(req.id, "expired",
                                              n_tokens=len(out)))
+                self._finish_stream(req.id)
                 if self._journal is not None:
                     self._journal.finish(req.id)
             else:
@@ -1671,6 +1683,7 @@ class SlotServer:
                     request_id, out, "cancelled",
                     trace=self._finish_trace(request_id, "cancelled",
                                              n_tokens=len(out)))
+                self._finish_stream(request_id)
                 if self._journal is not None:
                     self._journal.finish(request_id)
                 return True
@@ -1717,6 +1730,9 @@ class SlotServer:
             if entry is None:
                 failed.append(rid)  # traces end here, not in a leak
                 self._finish_trace(rid, "failed")
+                self.fail_stream(
+                    rid, f"request {rid} lost to a serving-loop failure "
+                         "(no journal entry to replay)")
                 if self._journal is not None:
                     self._journal.finish(rid)
                 continue
@@ -1735,6 +1751,7 @@ class SlotServer:
                     trace=self._finish_trace(
                         rid, "finished", n_tokens=len(toks),
                         reason="stop" if stop_end else "length"))
+                self._finish_stream(rid)
                 self._journal.finish(rid)
                 continue
             tr = self._traces.get(rid)
@@ -1844,6 +1861,80 @@ class SlotServer:
         if self._journal is not None:   # flush+close a file-backed journal
             self._journal.close()
 
+    # --------------------------------------------------------- streaming
+
+    def attach_stream(self, request_id: int, stream) -> None:
+        """Register a per-request token channel (``api.stream.
+        TokenStream``-shaped: ``feed(emitted)``, ``finish(reason)``,
+        ``fail(message)``). Call under the serving lock, immediately
+        after ``submit()`` (``ServeApp.submit_async`` does) — a request
+        that already completed at submit (a resume prefix satisfying
+        its budget) is delivered through the stream right here."""
+        self.streams_opened += 1
+        comp = self._done.get(request_id)
+        if comp is not None:
+            try:
+                stream.feed(comp.tokens)
+                stream.finish(comp.finish_reason)
+            except Exception:
+                log.exception("token stream attach-finish failed")
+            return
+        self._streams[request_id] = stream
+
+    def fail_stream(self, request_id: int, message: str) -> None:
+        """Terminal-error a request's stream WITHOUT a completion (the
+        caller delivered a hard failure upstream — restart-budget
+        exhaustion, drain timeout, replay-off reset loss). Idempotent;
+        unknown ids are a no-op."""
+        s = self._streams.pop(request_id, None)
+        if s is not None:
+            try:
+                s.fail(str(message))
+            except Exception:
+                log.exception("token stream fail() failed")
+
+    @property
+    def streams_active(self) -> int:
+        return len(self._streams)
+
+    def _stream_feed(self, rid, emitted) -> None:
+        """Push a request's absolute emitted-token list into its
+        attached stream (no-op without one). The stream appends only
+        the unseen suffix, so replays/resumes never double-deliver.
+        Called at processing time — the journal's durability point."""
+        s = self._streams.get(rid)
+        if s is None:
+            return
+        try:
+            n_new, stalled = s.feed(emitted)
+        except Exception:       # delivery must never kill the loop
+            log.exception("token stream feed failed")
+            return
+        if n_new:
+            now = time.monotonic()
+            if s.last_feed_t is not None:
+                self.telemetry.observe("stream_itl_s",
+                                       max(0.0, now - s.last_feed_t))
+            s.last_feed_t = now
+            if stalled:
+                self.stream_stalls += 1
+
+    def _finish_stream(self, rid: int) -> None:
+        """Seal a request's stream from its Completion (every terminal
+        that builds one calls this right after storing ``_done[rid]``)."""
+        s = self._streams.pop(rid, None)
+        if s is None:
+            return
+        comp = self._done.get(rid)
+        try:
+            if comp is not None:
+                s.feed(comp.tokens)
+                s.finish(comp.finish_reason)
+            else:               # defensive: no completion -> hard error
+                s.fail(f"request {rid} terminated without a completion")
+        except Exception:
+            log.exception("token stream finish failed")
+
     def seal_journal(self, request_id: int) -> None:
         """Seal a request's journal entry WITHOUT a completion: the
         caller delivered a terminal error upstream (restart-budget
@@ -1861,6 +1952,9 @@ class SlotServer:
         self._queue.clear()
         for req in out:
             self._finish_trace(req.id, "failed")
+            self.fail_stream(
+                req.id, f"request {req.id} failed: server shutting down "
+                        "before it was admitted")
             if self._journal is not None:
                 self._journal.finish(req.id)
         return out
@@ -1998,6 +2092,12 @@ class SlotServer:
             # carried across the boundary
             "replays": self.replays,
             "replayed_tokens": self.replayed_tokens,
+            # streaming delivery: live per-request token channels plus
+            # the backpressure accounting (stalls = feeds that found the
+            # consumer's chunk queue full; coalesced, never dropped)
+            "streams_active": self.streams_active,
+            "streams_opened": self.streams_opened,
+            "stream_stalls": self.stream_stalls,
             "chaos_faults_injected": self.chaos_faults_injected,
             # latency telemetry: per-histogram count + p50/p90/p99 (host-
             # monotonic; see docs/observability.md for the span schema)
@@ -2433,6 +2533,7 @@ class SlotServer:
         self._done[rid] = Completion(
             rid, out, "cancelled",
             trace=self._finish_trace(rid, "cancelled", n_tokens=len(out)))
+        self._finish_stream(rid)
         self._requests[slot] = None
         self._emitted[slot] = []
         self._host_busy[slot] = False
@@ -2620,6 +2721,12 @@ class SlotServer:
                     # from any true prefix is exact, the pipeline lag
                     # just re-decodes)
                     self._journal.emit(req.id, toks[slot, :n])
+                if n > 0 and req is not None:
+                    # streaming delivery at the SAME instant: the
+                    # absolute-position feed appends only the unseen
+                    # suffix (resume prefixes flow on the first
+                    # processed block, replays never double-deliver)
+                    self._stream_feed(req.id, self._emitted[slot])
                 if not had_tokens and n > 0 and req is not None:
                     # first emitted token OBSERVED by the host — the TTFT
                     # span (lags the device by the processing pipeline;
@@ -2658,6 +2765,7 @@ class SlotServer:
                         trace=self._finish_trace(
                             req.id, "finished", n_tokens=len(out),
                             reason=reason))
+                    self._finish_stream(req.id)
                     self._requests[slot] = None
                     self._emitted[slot] = []
                     self._host_busy[slot] = False

@@ -283,6 +283,12 @@ TELEMETRY_HISTOGRAMS = {
                         "'replayed' span) to the request's terminal — "
                         "what a loop crash actually cost the request in "
                         "latency instead of failing it",
+    "stream_itl_s": "inter-token latency OBSERVED AT THE EMISSION "
+                    "POINT: the gap between consecutive token-chunk "
+                    "feeds into a request's TokenStream (tokens inside "
+                    "one processed block arrive together, so this is "
+                    "the between-chunk gap a streaming client actually "
+                    "waits — the worst-case per-token spacing)",
 }
 
 

@@ -54,9 +54,24 @@ load balancer:
   families (docs/observability.md "Router metrics") through the shared
   PromRenderer.
 
+- **Streaming pass-through.** ``generate(on_tokens=...)`` relays a
+  replica's SSE stream delta-by-delta, HARVESTING the emitted prefix
+  from the stream itself as the failover resume state (fresher than
+  any /progress poll, which remains the fallback for non-streamed
+  requests). A replica dying mid-stream triggers the normal
+  eject+failover; the replacement re-streams from position 0 and the
+  absolute-position dedupe forwards each token exactly once
+  (``router_stream_failovers_total``). Affinity keys are per
+  ``(model, template)`` — two models sharing a prompt template hash to
+  different rendezvous buckets, since each engine owns its own prefix
+  pool.
+
 ``python -m tony_tpu.cli.main route`` serves the HTTP front door:
-POST /generate (the serve contract, proxied), GET /healthz, /stats,
-/metrics. See docs/serving.md "Fleet serving".
+POST /generate (the serve contract, proxied, ``stream=true``
+relayed), the OpenAI-compatible POST /v1/completions +
+/v1/chat/completions (one URL fronts the whole fleet), GET /healthz,
+/stats, /metrics. See docs/serving.md "Fleet serving" and "Streaming &
+OpenAI compatibility".
 """
 
 from __future__ import annotations
@@ -98,6 +113,14 @@ class RouterClientError(RouterError):
     """The replica rejected the request as malformed (4xx other than
     429) — the client's fault, not the replica's: no retry, no
     ejection, surfaced as HTTP 400."""
+
+
+class StreamConsumerError(RouterError):
+    """The STREAMING CLIENT vanished (or its callback failed) while the
+    router relayed a replica's stream. Not a replica fault: no retry,
+    no ejection — the downstream connection is closed (the replica's
+    own disconnect detection cancels the request) and the front door
+    counts a ``router_stream_disconnects_total``."""
 
 
 class FleetSaturatedError(RouterError):
@@ -267,6 +290,14 @@ class FleetRouter:
         self._nonce = f"{random.SystemRandom().getrandbits(48):012x}"
         self.failovers_total = 0      # mid-request resubmissions elsewhere
         self.resumed_tokens_total = 0  # prefix tokens carried by failovers
+        # streaming pass-through (docs/serving.md "Streaming & OpenAI
+        # compatibility"): live relayed streams, tokens forwarded,
+        # mid-stream failovers (resume prefix harvested from the relayed
+        # stream itself), and front-door clients that vanished mid-relay
+        self.streams_active = 0
+        self.streamed_tokens_total = 0
+        self.stream_failovers_total = 0
+        self.stream_disconnects_total = 0
         self._stop = threading.Event()
         self._health_started = False
         self._health_thread: threading.Thread | None = None
@@ -488,16 +519,24 @@ class FleetRouter:
             log.warning("router: ejecting %s (%s)", rep.name, reason)
 
     # ---------------------------------------------------------------- routing
-    def route_key(self, prompt) -> bytes | None:
-        """The affinity key: a digest of the prompt's leading
-        ``prefill_chunk``-aligned blocks — exactly the granularity the
-        prefix cache stores (PR 2), so requests that would share trie
-        blocks share a key. None when affinity is off or the prompt has
-        no full block (nothing cacheable to be sticky about)."""
+    def route_key(self, prompt, model: str | None = None) -> bytes | None:
+        """The affinity key: a digest of ``(model, template)`` — the
+        prompt's leading ``prefill_chunk``-aligned blocks, exactly the
+        granularity the prefix cache stores (PR 2), NAMESPACED by the
+        request's model. Two models sharing a prompt template must not
+        collide on one rendezvous bucket: each engine owns its own
+        prefix pool, so the cache working sets are disjoint and
+        co-locating them would double one replica's trie pressure while
+        its rendezvous peers idle. ``model=None`` (single-model fleets)
+        keeps the pure-template digest. None when affinity is off or
+        the prompt has no full block (nothing cacheable to be sticky
+        about)."""
         n = (len(prompt) // self.prefill_chunk) * self.prefill_chunk
         if not self.affinity or n <= 0:
             return None
         body = ",".join(str(int(t)) for t in prompt[:n]).encode()
+        if model is not None:
+            body = f"{model}|".encode() + body
         return hashlib.sha1(body).digest()
 
     def _ranked_locked(self, key: bytes | None,
@@ -548,21 +587,62 @@ class FleetRouter:
                     return rep
             return ranked[0]
 
+    def fleet_model_fallback(self) -> str:
+        """The /v1 ``model`` echo for requests that name none. The
+        serve front door echoes its first-registered model
+        (``app.default_model``); the router can't know registration
+        order, but a fleet whose replicas advertise exactly ONE model
+        name (the common single-model case) has an unambiguous answer.
+        Multi-model or not-yet-polled fleets echo "default"."""
+        with self._lock:
+            names: set[str] = set()
+            for rep in self.replicas.values():
+                names |= rep.models
+        return names.pop() if len(names) == 1 else "default"
+
     # ------------------------------------------------------------- the request
     def generate(self, prompt, max_new_tokens: int = 64,
                  timeout_s: float = 600.0, temperature: float | None = None,
                  top_k: int | None = None,
                  cache_prompt: bool | None = None,
-                 model: str | None = None) -> dict:
+                 model: str | None = None,
+                 on_tokens=None) -> dict:
         """Route one generation request; returns the replica's response
         dict (id/tokens/finish_reason) plus routing attrs. ``model``
         restricts routing to replicas advertising that model (their
         /stats registry). Raises NoReplicaError / FleetSaturatedError /
-        RouterError / TimeoutError — never returns a half-answer."""
+        RouterError / TimeoutError — never returns a half-answer.
+
+        ``on_tokens`` turns the request into a STREAMING pass-through:
+        the replica is asked with ``stream=true``, every relayed token
+        delta is handed to ``on_tokens(list_of_ints)`` exactly once
+        (failover re-sends of the resume prefix are deduped by absolute
+        position), and the emitted-so-far prefix is HARVESTED from the
+        stream itself as the failover resume state — fresher than any
+        /progress poll, which stays the fallback for non-streamed
+        requests. The returned dict still carries the FULL token list.
+        An ``on_tokens`` failure (the front-door client vanished)
+        raises StreamConsumerError: no retry, no ejection."""
+        if on_tokens is not None:
+            with self._lock:
+                self.streams_active += 1
+            try:
+                return self._generate(prompt, max_new_tokens, timeout_s,
+                                      temperature, top_k, cache_prompt,
+                                      model, on_tokens)
+            finally:
+                with self._lock:
+                    self.streams_active -= 1
+        return self._generate(prompt, max_new_tokens, timeout_s,
+                              temperature, top_k, cache_prompt, model,
+                              None)
+
+    def _generate(self, prompt, max_new_tokens, timeout_s, temperature,
+                  top_k, cache_prompt, model, on_tokens) -> dict:
         rid = next(self._ids)
         tr = RequestTrace(rid)
         tr.mark("submitted")
-        key = self.route_key(prompt)
+        key = self.route_key(prompt, model)
         with self._lock:
             self.requests_total += 1
             if key is not None:
@@ -575,6 +655,30 @@ class FleetRouter:
                    # mid-request death resumes elsewhere from the last
                    # journaled prefix instead of from scratch
                    "progress_key": self._pkey(rid)}
+        if on_tokens is not None:
+            payload["stream"] = True
+        # streaming relay state: `collected` is the CURRENT attempt's
+        # absolute stream (each attempt re-sends the resume prefix from
+        # position 0), `forwarded` the tokens already handed to the
+        # consumer across every attempt — the dedupe that makes a
+        # failover invisible to the client
+        collected: list[int] = []
+        forwarded = 0
+
+        def on_frame(delta):
+            nonlocal forwarded
+            collected.extend(int(t) for t in delta)
+            if len(collected) > forwarded:
+                new = collected[forwarded:]
+                forwarded = len(collected)
+                with self._lock:
+                    self.streamed_tokens_total += len(new)
+                try:
+                    on_tokens(new)
+                except Exception as e:
+                    raise StreamConsumerError(
+                        f"stream consumer failed: {type(e).__name__}: "
+                        f"{e}") from e
         if temperature is not None:
             payload["temperature"] = float(temperature)
         if top_k is not None:
@@ -655,14 +759,23 @@ class FleetRouter:
                     self.failovers_total += 1
                     self.resumed_tokens_total += len(
                         payload.get("resume_tokens", ()))
+                    if on_tokens is not None:
+                        # a STREAM resumed mid-relay: the client keeps
+                        # reading one uninterrupted stream while the
+                        # request moves replicas underneath it
+                        self.stream_failovers_total += 1
             tr.mark("routed")
             tr.attrs.update(replica=rep.name, attempt=attempts + 1)
             # the replica enforces the same deadline: a request the
             # router would abandon must not keep decoding downstream
             payload["timeout_s"] = max(0.05, remaining)
+            collected.clear()       # each attempt streams from position 0
             try:
                 try:
-                    resp = self._post_generate(rep, payload, remaining)
+                    resp = self._post_generate(
+                        rep, payload, remaining,
+                        on_frame=(on_frame if on_tokens is not None
+                                  else None))
                 finally:
                     with self._lock:
                         rep.inflight -= 1
@@ -721,6 +834,15 @@ class FleetRouter:
                 # the replica: plain re-route, nothing in flight there
                 # to ask about, and not a failover for the counter.
                 if not e.never_sent:
+                    # harvest the relayed STREAM's prefix first — it is
+                    # at least as fresh as any poll, and doing it here
+                    # (once, at failover) instead of per frame keeps
+                    # the hot relay path free of O(stream) list copies
+                    # under the router lock
+                    with self._lock:
+                        if len(collected) > len(
+                                self._resume.get(rid, ())):
+                            self._resume[rid] = list(collected)
                     pkey = self._pkey(rid)
                     fresh = (self._fetch_progress(
                         rep, [pkey],
@@ -743,6 +865,16 @@ class FleetRouter:
                            * self._rng.uniform(0.5, 1.5))
                 self._sleep(min(backoff, max(0.0, deadline
                                              - time.monotonic())), deadline)
+            except StreamConsumerError:
+                # the front-door CLIENT vanished mid-relay: not a
+                # replica fault — closing the downstream connection
+                # already triggered the replica's own disconnect
+                # cancel; no retry, no ejection
+                with self._lock:
+                    self.stream_disconnects_total += 1
+                self._seal(tr, "failed", error="client_gone",
+                           retries=attempts)
+                raise
             except _ReplicaClientError as e:
                 if model is not None and (
                         not rep.models or model not in rep.models):
@@ -768,6 +900,11 @@ class FleetRouter:
                     hit = bool(ranked and ranked[0] is rep)
                     if hit:
                         self.affinity_hits += 1
+                if on_tokens is not None:
+                    # the streaming final frame carries no token list;
+                    # the relayed stream IS the result — return it so
+                    # the caller's shape matches the buffered path
+                    resp.setdefault("tokens", list(collected))
                 self._seal(tr, "finished", retries=attempts,
                            affinity_hit=bool(hit),
                            n_tokens=len(resp.get("tokens", [])))
@@ -782,7 +919,13 @@ class FleetRouter:
         return time.monotonic() < deadline
 
     def _post_generate(self, rep: Replica, payload: dict,
-                       timeout: float) -> dict:
+                       timeout: float, on_frame=None) -> dict:
+        """POST /generate to one replica. ``on_frame`` switches to the
+        SSE relay: each token-delta frame is handed to it as it
+        arrives, and the replica's closing frame is returned in place
+        of the buffered response. A replica answering a stream request
+        with a buffered body (predates streaming) degrades gracefully:
+        its full token list is delivered as one frame."""
         body = json.dumps(payload).encode()
         req = urllib.request.Request(
             rep.base_url + "/generate", data=body,
@@ -790,7 +933,11 @@ class FleetRouter:
         try:
             with urllib.request.urlopen(req, timeout=max(0.05,
                                                          timeout)) as resp:
-                return json.loads(resp.read().decode())
+                if on_frame is None:
+                    return json.loads(resp.read().decode())
+                return self._read_stream(rep, resp, on_frame,
+                                         time.monotonic()
+                                         + max(0.05, timeout))
         except urllib.error.HTTPError as e:
             if e.code == 429:
                 try:
@@ -809,6 +956,9 @@ class FleetRouter:
                     f"HTTP {e.code} from {rep.name}"
                     + (f": {detail}" if detail else "")) from None
             raise _ReplicaUnavailable(f"HTTP {e.code}") from None
+        except (StreamConsumerError, _ReplicaUnavailable,
+                _ReplicaTimeout):
+            raise               # _read_stream already classified these
         except Exception as e:      # URLError, socket timeout, reset, ...
             reason = getattr(e, "reason", None)
             if isinstance(e, TimeoutError) or isinstance(reason,
@@ -819,6 +969,58 @@ class FleetRouter:
                 isinstance(reason, ConnectionRefusedError)
             raise _ReplicaUnavailable(
                 f"{type(e).__name__}: {e}", never_sent=refused) from None
+
+    def _read_stream(self, rep: Replica, resp, on_frame,
+                     deadline: float) -> dict:
+        """Relay one replica's SSE response: token-delta frames go to
+        ``on_frame`` as they arrive; returns the closing frame (the
+        one carrying ``finish_reason``). Raises _ReplicaUnavailable on
+        a severed/errored stream (the failover trigger — the harvested
+        prefix is already in ``_resume``), _ReplicaTimeout past the
+        caller's deadline, StreamConsumerError untouched."""
+        ctype = resp.headers.get("Content-Type", "")
+        if not ctype.startswith("text/event-stream"):
+            # pre-streaming replica: buffered body, delivered as one
+            # frame so the consumer contract holds
+            data = json.loads(resp.read().decode())
+            if data.get("tokens"):
+                on_frame(data["tokens"])
+            return data
+        final = None
+        try:
+            for raw in resp:
+                line = raw.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                if time.monotonic() >= deadline:
+                    raise _ReplicaTimeout("stream outlived the deadline")
+                text = line[6:].decode()
+                if text == "[DONE]":
+                    break
+                obj = json.loads(text)
+                if "error" in obj:
+                    # in-band failure (loop crash mid-stream): same
+                    # taxonomy as a 5xx — retry/failover elsewhere
+                    raise _ReplicaUnavailable(
+                        f"in-stream error: {obj['error']}")
+                if obj.get("finish_reason") is not None:
+                    final = obj
+                    break
+                toks = obj.get("tokens")
+                if toks:
+                    on_frame(toks)
+        except (StreamConsumerError, _ReplicaUnavailable,
+                _ReplicaTimeout):
+            raise
+        except Exception as e:
+            # severed mid-stream (SIGKILL, reset, short read): the
+            # mid-request failover trigger
+            raise _ReplicaUnavailable(
+                f"stream severed: {type(e).__name__}: {e}") from None
+        if final is None:
+            raise _ReplicaUnavailable(
+                "stream ended without a terminal frame")
+        return final
 
     def _seal(self, tr: RequestTrace, terminal: str, **attrs) -> None:
         tr.attrs.update(attrs)
@@ -868,6 +1070,13 @@ class FleetRouter:
                 # the emitted tokens they carried instead of re-decoding
                 "failovers": self.failovers_total,
                 "resumed_tokens": self.resumed_tokens_total,
+                # streaming pass-through: live relayed streams, tokens
+                # forwarded, mid-stream failovers (prefix harvested
+                # from the stream), clients gone mid-relay
+                "streams_active": self.streams_active,
+                "streamed_tokens": self.streamed_tokens_total,
+                "stream_failovers": self.stream_failovers_total,
+                "stream_disconnects": self.stream_disconnects_total,
                 "affinity": {
                     "enabled": self.affinity,
                     "requests": self.affinity_requests,
@@ -918,6 +1127,22 @@ class FleetRouter:
                       "mid-request resubmissions to another replica "
                       "after a transport failure/5xx, carrying the "
                       "journaled emitted prefix (resume_tokens)")
+            r.gauge(_metrics.ROUTER_STREAMS_ACTIVE, self.streams_active,
+                    "SSE streams currently relayed through this router")
+            r.counter(_metrics.ROUTER_STREAMED_TOKENS_TOTAL,
+                      self.streamed_tokens_total,
+                      "tokens forwarded through relayed streams "
+                      "(failover prefix re-sends deduped)")
+            r.counter(_metrics.ROUTER_STREAM_FAILOVERS_TOTAL,
+                      self.stream_failovers_total,
+                      "mid-STREAM failovers: the relay moved replicas "
+                      "with the resume prefix harvested from the "
+                      "stream itself, invisibly to the client")
+            r.counter(_metrics.ROUTER_STREAM_DISCONNECTS_TOTAL,
+                      self.stream_disconnects_total,
+                      "front-door clients that vanished mid-relay (the "
+                      "downstream request is cancelled, not failed "
+                      "over)")
             r.counter(_metrics.ROUTER_AFFINITY_HITS_TOTAL,
                       self.affinity_hits,
                       "keyed requests served by their sticky replica")
@@ -1033,8 +1258,18 @@ class DriverDiscovery:
 
 # ------------------------------------------------------------- HTTP front door
 
-def make_handler(router: FleetRouter):
+def make_handler(router: FleetRouter, codec=None):
     from http.server import BaseHTTPRequestHandler
+
+    from .api.openai import TokenCodec
+    from .api.stream import begin_sse, read_json_body, sse_frame
+
+    if codec is None:
+        codec = TokenCodec("ids")
+    # /v1 response ids: monotonic per router process (a handler
+    # instance is reused across keep-alive requests, so id(self)
+    # would hand two completions the same id)
+    oai_ids = itertools.count()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -1050,6 +1285,9 @@ def make_handler(router: FleetRouter):
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def _begin_sse(self) -> None:
+            begin_sse(self)
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -1072,12 +1310,89 @@ def make_handler(router: FleetRouter):
                 self._send(404, {"error": "unknown path"})
 
         def do_POST(self):
-            if self.path != "/generate":
+            path = self.path.partition("?")[0]
+            if path == "/generate":
+                self._post_generate()
+            elif path == "/v1/completions":
+                self._post_openai(chat=False)
+            elif path == "/v1/chat/completions":
+                self._post_openai(chat=True)
+            else:
                 self._send(404, {"error": "unknown path"})
-                return
+
+        def _read_json(self) -> dict:
+            return read_json_body(self)
+
+        def _route_stream(self, prompt, kwargs, frame_fn, final_fn,
+                          error_fn) -> None:
+            """The streaming relay glue both front-door surfaces share:
+            SSE headers are sent LAZILY at the first forwarded token,
+            so every pre-stream failure (429/503/504/400) still gets
+            its proper HTTP status; failures after first byte go
+            in-band. A vanished client surfaces as StreamConsumerError
+            from the router — counted there, connection dropped here."""
+            started = {"v": False}
+
+            def on_tokens(toks):
+                if not started["v"]:
+                    self._begin_sse()
+                    started["v"] = True
+                self.wfile.write(frame_fn(toks))
+                self.wfile.flush()
+
             try:
-                n = int(self.headers.get("Content-Length", "0"))
-                payload = json.loads(self.rfile.read(n) or b"{}")
+                resp = router.generate(prompt, on_tokens=on_tokens,
+                                       **kwargs)
+            except StreamConsumerError:
+                self.close_connection = True
+                return
+            except FleetSaturatedError as e:
+                if started["v"]:
+                    self._stream_tail(error_fn(str(e)))
+                else:
+                    self._send(429, {"error": str(e)}, headers={
+                        "Retry-After": str(e.retry_after_s)})
+                return
+            except NoReplicaError as e:
+                if started["v"]:
+                    self._stream_tail(error_fn(str(e)))
+                else:
+                    self._send(503, {"error": str(e)})
+                return
+            except TimeoutError as e:
+                if started["v"]:
+                    self._stream_tail(error_fn(str(e)))
+                else:
+                    self._send(504, {"error": str(e)})
+                return
+            except RouterClientError as e:
+                if started["v"]:
+                    self._stream_tail(error_fn(str(e)))
+                else:
+                    self._send(400, {"error": str(e)})
+                return
+            except RouterError as e:
+                if started["v"]:
+                    self._stream_tail(error_fn(str(e)))
+                else:
+                    self._send(502, {"error": str(e)})
+                return
+            if not started["v"]:    # zero-delta stream still terminates
+                self._begin_sse()
+                started["v"] = True
+            self._stream_tail(final_fn(resp))
+
+        def _stream_tail(self, data: bytes) -> None:
+            try:
+                self.wfile.write(data)
+                self.wfile.flush()
+            except OSError:
+                pass                # client left during the tail write
+            self.close_connection = True
+
+        def _post_generate(self):
+            try:
+                payload = self._read_json()
                 # coerce HERE so a malformed prompt ({"prompt": 123},
                 # strings, nested junk) is a 400, not an unhandled
                 # exception out of route_key on the handler thread
@@ -1099,8 +1414,31 @@ def make_handler(router: FleetRouter):
                         raise ValueError(
                             "cache_prompt must be a JSON boolean")
                     kwargs["cache_prompt"] = payload["cache_prompt"]
+                from .api.stream import stream_requested
+
+                stream_on = stream_requested(payload, self.path)
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": str(e)})
+                return
+            if stream_on:
+                sent = {"n": 0}
+
+                def frame(toks):
+                    sent["n"] += len(toks)
+                    return sse_frame({"tokens": [int(t) for t in toks]})
+
+                def final(resp):
+                    return sse_frame({
+                        "id": resp.get("id"),
+                        "finish_reason": resp.get("finish_reason"),
+                        "n_tokens": sent["n"],
+                        "replica": resp.get("replica"),
+                        "retries": resp.get("retries")})
+
+                def err(msg):
+                    return sse_frame({"error": str(msg)})
+
+                self._route_stream(prompt, kwargs, frame, final, err)
                 return
             try:
                 resp = router.generate(prompt, **kwargs)
@@ -1121,6 +1459,73 @@ def make_handler(router: FleetRouter):
                 self._send(502, {"error": str(e)})
                 return
             self._send(200, resp)
+
+        def _post_openai(self, chat: bool):
+            """The fleet-wide OpenAI-compatible surface: same payload
+            contract as the per-replica /v1 endpoints (api.openai, the
+            api-contract lint), routed/spilled/failed-over like every
+            other request — one URL fronts the whole fleet."""
+            from .api import openai as oai
+
+            try:
+                payload = self._read_json()
+                req = (oai.parse_chat_request(payload, codec) if chat
+                       else oai.parse_completion_request(payload, codec))
+            except (KeyError, ValueError, TypeError) as e:
+                self._send(400, {"error": {
+                    "message": str(e), "type": "invalid_request_error"}})
+                return
+            model_name = req["model"] or router.fleet_model_fallback()
+            kwargs = {"max_new_tokens": req["max_new_tokens"],
+                      "timeout_s": req["timeout_s"]}
+            if req.get("temperature") is not None:
+                kwargs["temperature"] = req["temperature"]
+            if req.get("top_k") is not None:
+                kwargs["top_k"] = req["top_k"]
+            if req["model"] is not None:
+                kwargs["model"] = req["model"]
+            prompt = req["prompt_tokens"]
+            rid = next(oai_ids)
+            if req["stream"]:
+                frame, close, err = oai.stream_frame_fns(
+                    rid, model_name, codec, chat)
+                self._route_stream(
+                    prompt, kwargs, frame,
+                    lambda resp: close(resp.get("finish_reason",
+                                                "stop")),
+                    err)
+                return
+            try:
+                resp = router.generate(prompt, **kwargs)
+            except FleetSaturatedError as e:
+                self._send(429, {"error": {
+                    "message": str(e), "type": "rate_limit_error"}},
+                    headers={"Retry-After": str(e.retry_after_s)})
+                return
+            except NoReplicaError as e:
+                self._send(503, {"error": {
+                    "message": str(e), "type": "service_unavailable"}})
+                return
+            except TimeoutError as e:
+                self._send(504, {"error": {
+                    "message": str(e), "type": "timeout"}})
+                return
+            except RouterClientError as e:
+                self._send(400, {"error": {
+                    "message": str(e), "type": "invalid_request_error"}})
+                return
+            except RouterError as e:
+                self._send(502, {"error": {
+                    "message": str(e), "type": "server_error"}})
+                return
+            build = (oai.chat_response if chat
+                     else oai.completion_response)
+            # the ROUTER-local rid, not the replica's engine id: two
+            # replicas' engines count independently (and restart from
+            # zero), so replica ids collide across the fleet
+            self._send(200, build(
+                rid, model_name, resp.get("tokens", []),
+                resp.get("finish_reason", "stop"), len(prompt), codec))
 
     return Handler
 
@@ -1173,6 +1578,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--trace-dir", default="",
                    help="dump router request traces as JSONL "
                         "(requests.trace.jsonl) into this directory")
+    p.add_argument("--text-codec", default="ids", choices=("ids", "bytes"),
+                   help="text<->token mapping for the OpenAI-compatible "
+                        "/v1 endpoints (must match the fleet's serve "
+                        "--text-codec)")
     return p
 
 
@@ -1213,8 +1622,11 @@ def main(argv=None) -> int:
         trace_sink=trace_sink,
         discovery_grace_s=args.discovery_grace_s)
     router.start()
+    from .api.openai import TokenCodec
+
     httpd = ThreadingHTTPServer((args.host, args.port),
-                                make_handler(router))
+                                make_handler(router,
+                                             TokenCodec(args.text_codec)))
     print(f"routing on http://{args.host}:{httpd.server_address[1]} "
           f"({len(router.replicas)} static replicas"
           + (", driver discovery on" if discover else "") + ")",
