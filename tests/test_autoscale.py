@@ -1,0 +1,695 @@
+"""Closed-loop autoscaler + multi-tenant resource arbiter
+(tony_tpu/autoscale.py + driver integration — docs/autoscaling.md).
+
+The contract under test, bottom-up: windowed-TTFT math (Prometheus
+bucket scraping, counter-reset clamping, quantile estimation), the
+control law's hysteresis (breach ticks, cooldown, clear-for-a-cooldown
+scale-down, the below-min floor rule), the arbiter's quota math and
+donor ordering (batch-only, chief-safe, floor-safe, busy-excluded),
+journal replay of the scale/park/donate ledgers (a recovered driver
+resumes mid-cooldown instead of flapping), and two scripted-provisioner
+e2es: scale-up/scale-down of a replica fleet against test-controlled
+/stats + /metrics endpoints, and the full donation cycle — interactive
+demand preempt-drains a batch trainer, the slot serves a replica, and
+the trainer reclaims it (with the checkpoint prestaged via
+TONY_PRESTAGE_CKPT) once serving scales back down. Stub executors speak
+the real framed-JSON RPC (the test_elastic pattern), TINY everything,
+well under the 45s per-test budget.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+import tony_tpu.constants as c
+from tony_tpu.autoscale import (
+    AutoscaleController,
+    FleetObservation,
+    FleetWatcher,
+    ResourceArbiter,
+    bucket_delta,
+    bucket_quantile,
+    scrape_ttft_buckets,
+)
+from tony_tpu.cluster.provisioner import ContainerHandle, Provisioner
+from tony_tpu.conf import TonyConf
+from tony_tpu.driver import Driver
+from tony_tpu.events.driver_journal import (
+    DriverJournal, load_state, rewrite_journal,
+)
+from tony_tpu.rpc import RpcClient
+from tony_tpu.session import Session
+
+# --------------------------------------------------------------------------
+# windowed-TTFT math: scrape -> delta -> quantile
+# --------------------------------------------------------------------------
+
+PROM = """\
+# HELP serving_ttft_seconds ttft
+# TYPE serving_ttft_seconds histogram
+serving_ttft_seconds_bucket{le="0.1"} 10
+serving_ttft_seconds_bucket{le="1.0"} 90
+serving_ttft_seconds_bucket{le="+Inf"} 100
+serving_ttft_seconds_bucket{model="m",le="0.1"} 5
+serving_ttft_seconds_sum 42.0
+serving_ttft_seconds_count 100
+other_seconds_bucket{le="0.1"} 7
+"""
+
+
+def test_scrape_ttft_buckets_skips_labeled_partitions():
+    got = scrape_ttft_buckets(PROM)
+    assert got == {"0.1": 10.0, "1.0": 90.0, "+Inf": 100.0}, got
+
+
+def test_bucket_quantile_and_delta():
+    cur = {"0.1": 10.0, "1.0": 90.0, "+Inf": 100.0}
+    # p50: rank 50 lands in (0.1, 1.0], 40/80 through the bucket
+    assert abs(bucket_quantile(cur, 0.5) - 0.55) < 1e-9
+    # overflow bucket answers its honest lower edge
+    assert bucket_quantile(cur, 0.999) == 1.0
+    assert bucket_quantile({}, 0.5) is None
+    assert bucket_quantile({"0.1": 0.0}, 0.5) is None
+    prev = {"0.1": 8.0, "1.0": 85.0, "+Inf": 90.0}
+    assert bucket_delta(prev, cur) == {"0.1": 2.0, "1.0": 5.0,
+                                       "+Inf": 10.0}
+    # a restarted replica's counters reset: negative deltas clamp to
+    # the CURRENT value (the fresh process's whole history)
+    assert bucket_delta(cur, prev) == prev
+
+
+# --------------------------------------------------------------------------
+# control law: hysteresis, cooldown, floor
+# --------------------------------------------------------------------------
+
+def _ctl(**kw):
+    kw.setdefault("ttft_slo_s", 1.0)
+    kw.setdefault("queue_slo", 4)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("breach_ticks", 2)
+    return AutoscaleController(**kw)
+
+
+def test_controller_breach_ticks_and_cooldown():
+    """One breaching window never scales; the second does; a repeat
+    inside the cooldown is suppressed even while still breaching."""
+    ctl = _ctl()
+    hot = FleetObservation(live=1, queued=10)
+    assert ctl.decide(hot, 1, now=0.0) is None          # streak 1
+    d = ctl.decide(hot, 1, now=1.0)
+    assert d is not None and d.direction == "up"
+    ctl.note_scaled("up", now=1.0)
+    assert ctl.decide(hot, 2, now=2.0) is None          # cooldown
+    assert ctl.decide(hot, 2, now=5.0) is None
+    # past the cooldown, a persisting breach scales again
+    assert ctl.decide(hot, 2, now=12.0) is None         # streak re-arms
+    d = ctl.decide(hot, 2, now=13.0)
+    assert d is not None and d.direction == "up"
+
+
+def test_controller_ttft_slo_and_max_bound():
+    ctl = _ctl(queue_slo=0)
+    slow = FleetObservation(live=2, queued=0, ttft_p99_s=2.5,
+                            window_samples=20)
+    assert ctl.decide(slow, 2, now=0.0) is None
+    d = ctl.decide(slow, 2, now=1.0)
+    assert d is not None and "ttft" in d.reason
+    # at max: breach or not, no decision
+    ctl2 = _ctl(queue_slo=0, max_replicas=2)
+    ctl2.decide(slow, 2, now=0.0)
+    assert ctl2.decide(slow, 2, now=1.0) is None
+
+
+def test_controller_scale_down_needs_clear_for_a_cooldown():
+    """Scale-down only after the signals sit below HALF the SLO for a
+    full cooldown — and a single breachy blip re-arms the clock."""
+    ctl = _ctl()
+    idle = FleetObservation(live=2, queued=0, ttft_p99_s=0.1,
+                            window_samples=5)
+    assert ctl.decide(idle, 2, now=0.0) is None
+    assert ctl.decide(idle, 2, now=5.0) is None         # clear 5s < 10s
+    blip = FleetObservation(live=2, queued=3)           # >half queue SLO
+    assert ctl.decide(blip, 2, now=6.0) is None         # re-arms clear
+    assert ctl.decide(idle, 2, now=7.0) is None
+    assert ctl.decide(idle, 2, now=16.0) is None        # clear 9s
+    d = ctl.decide(idle, 2, now=17.5)
+    assert d is not None and d.direction == "down"
+    ctl.note_scaled("down", now=17.5)
+    # never below min
+    assert ctl.decide(idle, 1, now=60.0) is None
+
+
+def test_controller_floor_rule_relaunches_below_min():
+    """A fleet below min (replica parked after budget exhaustion)
+    scales up WITHOUT waiting for an SLO breach or breach ticks."""
+    ctl = _ctl(min_replicas=2, max_replicas=3)
+    idle = FleetObservation(live=1, queued=0)
+    d = ctl.decide(idle, 1, now=0.0)
+    assert d is not None and d.direction == "up" and "min" in d.reason
+
+
+def test_controller_recovered_cooldown_suppresses_flap():
+    """A controller built with a journaled last_scale_t (driver
+    recovery) stays in cooldown — the no-flap contract."""
+    ctl = _ctl(last_scale_t=100.0)
+    hot = FleetObservation(live=1, queued=10)
+    ctl.decide(hot, 1, now=101.0)
+    assert ctl.decide(hot, 1, now=102.0) is None        # mid-cooldown
+    d = ctl.decide(hot, 1, now=111.0)
+    assert d is not None and d.direction == "up"
+
+
+# --------------------------------------------------------------------------
+# arbiter: quota math + donor ordering over a real Session
+# --------------------------------------------------------------------------
+
+def _session(**conf_extra):
+    conf = TonyConf({
+        "tony.replica.instances": 3,
+        "tony.replica.command": "stub",
+        "tony.trainer.instances": 3,
+        "tony.trainer.command": "stub",
+        "tony.trainer.priority-class": "batch",
+        **conf_extra,
+    })
+    return Session(conf)
+
+
+def _run(session, task_id):
+    session.register_task(task_id, "127.0.0.1", 1)
+
+
+def test_arbiter_quota_math():
+    s = _session(**{"tony.replica.quota": 2})
+    arb = ResourceArbiter(s, pool_slots=6)
+    assert arb.free() == 6 and arb.held("replica") == 0
+    _run(s, "replica:0")
+    _run(s, "trainer:0")
+    _run(s, "trainer:1")
+    assert arb.held("replica") == 1 and arb.held("trainer") == 2
+    assert arb.free() == 3
+    assert arb.quota("replica") == 2 and arb.quota("trainer") == 3
+    assert arb.can_grant("replica")
+    _run(s, "replica:1")
+    assert arb.over_quota("replica") and not arb.can_grant("replica")
+    # detached slots are free pool capacity
+    s.detach_task("trainer:1")
+    assert arb.held("trainer") == 1 and arb.free() == 3
+    snap = arb.snapshot()
+    assert snap["class"] == {"replica": "interactive",
+                             "trainer": "batch"}
+
+
+def test_arbiter_pool_exhaustion_blocks_grant():
+    s = _session()
+    arb = ResourceArbiter(s, pool_slots=2)
+    _run(s, "replica:0")
+    _run(s, "trainer:0")
+    assert arb.free() == 0 and not arb.can_grant("replica")
+
+
+def test_arbiter_donor_ordering_and_floors():
+    """Donors come only from the batch tier: highest-index RUNNING
+    non-chief of the MOST-held batch role, never below the elastic
+    floor, never a task already mid-drain (busy)."""
+    s = _session()
+    arb = ResourceArbiter(s, pool_slots=4)
+    # interactive-only fleet: nobody donates
+    _run(s, "replica:0")
+    assert arb.pick_donor("replica") is None
+    _run(s, "trainer:0")
+    _run(s, "trainer:1")
+    _run(s, "trainer:2")
+    assert arb.pick_donor("replica") == "trainer:2"
+    assert arb.pick_donor("replica", busy={"trainer:2"}) == "trainer:1"
+    # the elastic floor holds: 3 held, floor 3 -> no donor
+    assert arb.pick_donor("replica", elastic_min=3) is None
+    # trainer:0 is this gang's chief (no chief role configured):
+    # with only it running, nothing qualifies
+    assert arb.pick_donor("replica", busy={"trainer:1", "trainer:2"}) \
+        is None
+
+
+# --------------------------------------------------------------------------
+# journal: the scale/park/donate ledgers replay and survive compaction
+# --------------------------------------------------------------------------
+
+def test_journal_scale_ledgers_replay_and_compact(tmp_path):
+    path = tmp_path / "driver.journal.jsonl"
+    j = DriverJournal(path)
+    j.record("meta", app_id="a", token="t", session_id=0, rpc_port=1,
+             driver_generation=0)
+    j.record("detach", task="replica:1")
+    j.record("park", task="replica:1")
+    j.record("detach", task="replica:2")
+    j.record("park", task="replica:2")
+    j.record("scale", dir="up", task="replica:1", t=100.0, reason="q")
+    j.record("unpark", task="replica:1")
+    j.record("reattach", task="replica:1")
+    j.record("donate", task="trainer:1", **{"for": "replica"})
+    j.record("donated", task="trainer:1")
+    j.record("ledger", kind="scale_down", task="replica:0")
+    j.close()
+    state = load_state(path)
+    assert state.parked == {"replica:2"}
+    assert state.detached == {"replica:2"}
+    assert state.donations == {} and state.donated == {"trainer:1"}
+    assert state.scale_downs == {"replica:0"}
+    assert [op["dir"] for op in state.scale_ops] == ["up"]
+    assert state.scale_ops[0]["t"] == 100.0
+    # compaction round-trips every ledger
+    rewrite_journal(path, state)
+    again = load_state(path)
+    assert again.parked == state.parked
+    assert again.donated == state.donated
+    assert again.scale_downs == state.scale_downs
+    assert again.scale_ops[-1]["t"] == 100.0
+    # a reclaim clears the donated ledger; a launch clears scale_down;
+    # and PARKING clears scale_down too (parking IS the drain's
+    # discharge — a recovered driver must not see a parked slot as
+    # still mid-drain)
+    j2 = DriverJournal(path)
+    j2.record("reclaimed", task="trainer:1")
+    j2.record("launch", task="replica:0", attempt=2, container_id="x",
+              pid=0, host="h", t=1.0, log_path="")
+    j2.record("ledger", kind="scale_down", task="replica:3")
+    j2.record("park", task="replica:3")
+    j2.close()
+    final = load_state(path)
+    assert final.donated == set() and final.scale_downs == set()
+    assert "replica:3" in final.parked
+
+
+# --------------------------------------------------------------------------
+# scripted-provisioner e2e plumbing (the test_elastic pattern)
+# --------------------------------------------------------------------------
+
+class ScriptedProvisioner(Provisioner):
+    def __init__(self, script):
+        super().__init__()
+        self._script = script
+        self._attempts: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.launches: list[str] = []
+        self.launch_envs: dict[str, list[dict]] = {}
+        self.stops: list[str] = []
+
+    def launch(self, spec, index, env, log_dir):
+        task_id = f"{spec.name}:{index}"
+        with self._lock:
+            attempt = self._attempts.get(task_id, 0)
+            self._attempts[task_id] = attempt + 1
+            self.launches.append(task_id)
+            self.launch_envs.setdefault(task_id, []).append(dict(env))
+        handle = ContainerHandle(
+            container_id=f"stub_{task_id}_{attempt}",
+            host="127.0.0.1", role=spec.name, index=index)
+        handle.extra["stop"] = threading.Event()
+        threading.Thread(
+            target=self._run, args=(spec, index, env, handle, attempt),
+            daemon=True).start()
+        return handle
+
+    def _run(self, spec, index, env, handle, attempt):
+        try:
+            code = self._script(spec, index, env, handle, attempt)
+        except Exception as e:              # pragma: no cover - debug aid
+            print(f"stub executor failed: {type(e).__name__}: {e}",
+                  flush=True)
+            code = 1
+        if code is not None and self.on_completion:
+            self.on_completion(handle, code)
+
+    def stop_container(self, handle):
+        with self._lock:
+            self.stops.append(handle.container_id)
+        handle.extra["stop"].set()
+
+    def stop_all(self):
+        pass
+
+
+class _StatsServer:
+    """A test-controlled replica endpoint: /stats + /metrics with
+    mutable queue depth and TTFT bucket counts — the controller's
+    telemetry inputs without a model."""
+
+    def __init__(self):
+        self.queued = 0
+        self.slow = 0       # cumulative ttft observations in (1, +Inf]
+        self.fast = 0       # cumulative ttft observations <= 0.1
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/stats":
+                    body = json.dumps({
+                        "queued": outer.queued, "active": 0}).encode()
+                    ctype = "application/json"
+                elif self.path == "/metrics":
+                    f, s = outer.fast, outer.slow
+                    body = (
+                        f'serving_ttft_seconds_bucket{{le="0.1"}} {f}\n'
+                        f'serving_ttft_seconds_bucket{{le="1.0"}} {f}\n'
+                        f'serving_ttft_seconds_bucket{{le="+Inf"}} '
+                        f'{f + s}\n').encode()
+                    ctype = "text/plain"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.port = self.httpd.server_address[1]
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _conf(dirs, **extra):
+    return TonyConf({
+        "tony.staging.dir": dirs["staging"],
+        "tony.history.location": dirs["history"],
+        "tony.history.intermediate": dirs["history"] + "/intermediate",
+        "tony.history.finished": dirs["history"] + "/finished",
+        "tony.am.monitor-interval-ms": 50,
+        "tony.task.registration-poll-interval-ms": 50,
+        # a high interval parks the background runner; tests drive
+        # autoscale_tick by hand for determinism
+        "tony.autoscale.interval-s": 600,
+        **extra,
+    })
+
+
+def _driver(dirs, tmp_path, script, name, **conf_extra):
+    conf = _conf(dirs, **conf_extra)
+    job_dir = tmp_path / f"job_{name}"
+    job_dir.mkdir(exist_ok=True)
+    conf.write_final(job_dir)
+    driver = Driver(conf, app_id=name, job_dir=str(job_dir),
+                    token="autoscale-secret",
+                    provisioner=ScriptedProvisioner(script))
+    driver.client_signal.set()
+    return driver
+
+
+def _rpc_for(env):
+    return RpcClient(env[c.ENV_DRIVER_HOST], int(env[c.ENV_DRIVER_PORT]),
+                     token=env.get(c.ENV_TOKEN, ""), role="executor")
+
+
+def _wait(pred, timeout=20, every=0.05, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(every)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_autoscale_scale_up_then_down_e2e(tmp_job_dirs, tmp_path):
+    """The closed loop against scripted replicas and test-controlled
+    telemetry: a queue breach launches the parked replica:1 (journal
+    decision + unpark + trace mark 'scaled_up'); a sustained clear
+    drains the least-loaded replica back down (SIGTERM via the
+    provisioner, completion parks the slot, 'scaled_down' mark), with
+    the cooldown ledger journaled both times and zero restart budget
+    spent."""
+    stats = [_StatsServer() for _ in range(2)]
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        payload = rpc.call("register_worker", task_id=task_id,
+                           host="127.0.0.1", port=23300 + index,
+                           attempt=int(env.get(c.ENV_TASK_ATTEMPT, -1)))
+        while payload is None:
+            time.sleep(0.03)
+            payload = rpc.call("get_cluster_spec", task_id=task_id)
+        rpc.call("publish_ports", task_id=task_id,
+                 ports={"serve_port": stats[index].port})
+        # serve until drained (scale-down SIGTERM) or the test ends
+        handle.extra["stop"].wait(60)
+        rpc.call("register_execution_result", task_id=task_id,
+                 exit_code=137)
+        rpc.close()
+        return 137
+
+    driver = _driver(
+        tmp_job_dirs, tmp_path, script, name="updown",
+        **{"tony.replica.instances": 2,
+           "tony.replica.command": "stub",
+           "tony.replica.max-restarts": 1,
+           "tony.application.framework": "serving",
+           "tony.autoscale.enabled": True,
+           "tony.autoscale.role": "replica",
+           "tony.autoscale.min": 1,
+           "tony.autoscale.queue-depth-slo": 4,
+           "tony.quota.pool-slots": 2})
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    try:
+        # the parked slot never launched; only replica:0 runs
+        _wait(lambda: driver.serving_endpoints("replica"),
+              msg="replica:0 serving")
+        assert driver.provisioner.launches == ["replica:0"]
+        assert "replica:1" in driver._parked
+
+        clock = {"t": 1000.0}
+        ctl = AutoscaleController(
+            queue_slo=4, min_replicas=1, max_replicas=2,
+            cooldown_s=5.0, breach_ticks=2,
+            now_fn=lambda: clock["t"])
+        watcher = FleetWatcher()
+
+        stats[0].queued = 10                    # breach
+        assert driver.autoscale_tick(ctl, watcher) == "idle"  # streak 1
+        clock["t"] += 1
+        assert driver.autoscale_tick(ctl, watcher) == "scaled_up"
+        _wait(lambda: len(driver.serving_endpoints("replica")) == 2,
+              msg="replica:1 serving")
+        assert "replica:1" not in driver._parked
+        assert driver.arbiter.held("replica") == 2
+
+        # sustained clear -> scale down past the cooldown; replica:1 is
+        # least-loaded (its stats show queue 0 vs replica:0's 10...
+        # flip the load so the victim is deterministic)
+        stats[0].queued = 0
+        clock["t"] += 6
+        assert driver.autoscale_tick(ctl, watcher) == "idle"  # clear t0
+        clock["t"] += 6
+        assert driver.autoscale_tick(ctl, watcher) == "scaled_down"
+        _wait(lambda: "replica:1" in driver._parked,
+              msg="replica:1 parked")
+        assert len(driver.serving_endpoints("replica")) == 1
+        assert driver.arbiter.held("replica") == 1
+
+        text = driver.render_metrics()
+        assert "driver_autoscale_scale_ups_total 1" in text
+        assert "driver_autoscale_scale_downs_total 1" in text
+        assert "driver_task_restarts_total 0" in text
+        assert 'driver_quota_slots{role="replica",stat="held"} 1' in text
+        state = load_state(Path(driver.job_dir) / c.DRIVER_JOURNAL_FILE)
+        dirs = [op["dir"] for op in state.scale_ops]
+        assert dirs == ["up", "down"], dirs
+        assert state.parked == {"replica:1"}
+    finally:
+        driver._stop_requested.set()
+        for h in list(driver._handles.values()):
+            h.extra["stop"].set()
+        t.join(timeout=20)
+        for s in stats:
+            s.close()
+
+
+def test_donation_cycle_e2e(tmp_job_dirs, tmp_path):
+    """The arbiter's full batch<->interactive capacity cycle on one
+    exhausted pool: a serving breach finds no free slot, preempt-drains
+    trainer:1 (budget-free, 'donated' trace mark), launches replica:1
+    on the freed capacity; when traffic ebbs, the replica drains back
+    and the donated slot is RECLAIMED by the elastic rescale timer —
+    relaunched with TONY_PRESTAGE_CKPT stamped (checkpoint-aware
+    placement) and a 'reclaimed' trace mark."""
+    stats = [_StatsServer() for _ in range(2)]
+    trainer_events: dict = {"preempt": threading.Event()}
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        payload = rpc.call("register_worker", task_id=task_id,
+                           host="127.0.0.1", port=23400 + 10 * (
+                               spec.name == "trainer") + index,
+                           attempt=int(env.get(c.ENV_TASK_ATTEMPT, -1)))
+        while payload is None:
+            time.sleep(0.03)
+            payload = rpc.call("get_cluster_spec", task_id=task_id)
+        if spec.name == "replica":
+            rpc.call("publish_ports", task_id=task_id,
+                     ports={"serve_port": stats[index].port})
+            handle.extra["stop"].wait(60)
+            rpc.call("register_execution_result", task_id=task_id,
+                     exit_code=137)
+            rpc.close()
+            return 137
+        # trainer: heartbeat, drain on a preempt command or a resize
+        # SIGTERM (the stop event), exit EXIT_PREEMPTED either way
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            res = rpc.call("heartbeat", task_id=task_id)
+            if isinstance(res, dict) and res.get("preempt"):
+                trainer_events["preempt"].set()
+                break
+            if handle.extra["stop"].is_set():
+                break
+            time.sleep(0.05)
+        rpc.call("register_execution_result", task_id=task_id,
+                 exit_code=c.EXIT_PREEMPTED)
+        rpc.close()
+        return c.EXIT_PREEMPTED
+
+    driver = _driver(
+        tmp_job_dirs, tmp_path, script, name="donation",
+        **{"tony.replica.instances": 2,
+           "tony.replica.command": "stub",
+           "tony.replica.max-restarts": 1,
+           "tony.trainer.instances": 2,
+           "tony.trainer.command": "stub",
+           "tony.trainer.max-restarts": 1,
+           "tony.trainer.priority-class": "batch",
+           "tony.application.framework": "serving",
+           "tony.task.heartbeat-interval-ms": 100,
+           "tony.train.elastic-enabled": True,
+           "tony.train.elastic-min-instances": 1,
+           "tony.train.rescale-retry-ms": 200,
+           "tony.train.checkpoint-dir": "/ckpt/run_$TONY_TASK_INDEX",
+           "tony.autoscale.enabled": True,
+           "tony.autoscale.role": "replica",
+           "tony.autoscale.min": 1,
+           "tony.autoscale.queue-depth-slo": 4,
+           "tony.quota.pool-slots": 3})
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    try:
+        _wait(lambda: driver.serving_endpoints("replica")
+              and driver.arbiter.held("trainer") == 2,
+              msg="initial formation")
+        assert driver.arbiter.free() == 0
+
+        clock = {"t": 1000.0}
+        ctl = AutoscaleController(
+            queue_slo=4, min_replicas=1, max_replicas=2,
+            cooldown_s=5.0, breach_ticks=1,
+            now_fn=lambda: clock["t"])
+        watcher = FleetWatcher()
+        stats[0].queued = 10
+        # no free slot: the tick initiates a donation instead
+        assert driver.autoscale_tick(ctl, watcher) == "awaiting_donation"
+        assert trainer_events["preempt"].wait(20), "no preempt command"
+        _wait(lambda: "trainer:1" in driver._donated,
+              msg="donation discharge")
+        # the discharge hands the freed slot STRAIGHT to serving (a
+        # tick-paced claim would race the faster rescale-retry timer,
+        # which would reclaim the slot for batch — the donate->reclaim
+        # livelock); replica:1 launches without another tick, and a
+        # tick meanwhile reports the in-flight/at-max state, never a
+        # duplicate donation
+        _wait(lambda: len(driver.serving_endpoints("replica")) == 2,
+              msg="replica:1 serving")
+        clock["t"] += 1
+        assert driver.autoscale_tick(ctl, watcher) in ("idle",
+                                                       "at_max")
+        assert driver.arbiter.donations == 1
+        # donated slot must NOT be reclaimed while the pool is full
+        time.sleep(0.6)
+        assert "trainer:1" in driver._donated
+        assert driver.arbiter.held("trainer") == 1
+
+        # traffic ebbs: scale back down, then the rescale timer
+        # reclaims the donated slot with the checkpoint prestaged
+        stats[0].queued = 0
+        clock["t"] += 6
+        driver.autoscale_tick(ctl, watcher)             # clear t0
+        clock["t"] += 6
+        _wait(lambda: driver.autoscale_tick(ctl, watcher)
+              == "scaled_down", timeout=10, msg="scale-down")
+        _wait(lambda: "trainer:1" not in driver._donated
+              and driver.arbiter.held("trainer") == 2,
+              msg="reclaim")
+        assert driver.arbiter.reclaims == 1
+        envs = driver.provisioner.launch_envs["trainer:1"]
+        assert envs[-1].get(c.ENV_PRESTAGE_CKPT) == \
+            "/ckpt/run_$TONY_TASK_INDEX"
+        assert c.ENV_PRESTAGE_CKPT not in envs[0]
+        text = driver.render_metrics()
+        assert "driver_quota_donations_total 1" in text
+        assert "driver_quota_reclaims_total 1" in text
+        assert "driver_task_restarts_total 0" in text
+        # trace marks: donated + reclaimed on trainer:1
+        with driver._tt_lock:
+            tr = driver.task_traces.get("trainer:1")
+            names = [n for n, _ in tr.spans]
+        assert "donated" in names and "reclaimed" in names, names
+    finally:
+        driver._stop_requested.set()
+        for h in list(driver._handles.values()):
+            h.extra["stop"].set()
+        t.join(timeout=20)
+        for s in stats:
+            s.close()
+
+
+# --------------------------------------------------------------------------
+# checkpoint prestage helper (train/checkpoint.py)
+# --------------------------------------------------------------------------
+
+def test_prestage_checkpoint_reads_newest_complete_step(tmp_path):
+    from tony_tpu.train.checkpoint import prestage_checkpoint
+
+    root = tmp_path / "ckpt"
+    (root / "5").mkdir(parents=True)
+    (root / "5" / "a.bin").write_bytes(b"x" * 100)
+    (root / "10").mkdir()
+    (root / "10" / "b.bin").write_bytes(b"y" * 300)
+    (root / "10" / "sub").mkdir()
+    (root / "10" / "sub" / "c.bin").write_bytes(b"z" * 50)
+    # an in-progress orbax tmp dir must not be picked
+    (root / "12.orbax-checkpoint-tmp-123").mkdir()
+    got = prestage_checkpoint(str(root))
+    assert got == {"step": 10, "files": 2, "bytes": 350}
+    assert prestage_checkpoint(str(tmp_path / "missing")) is None
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert prestage_checkpoint(str(empty)) is None
+
+
+def test_controller_router_view_is_max_not_sum():
+    """The router's queue estimate OVERLAPS the replicas' own /stats
+    (a router-posted request admitted server-side appears in both):
+    the control law takes the max of the two views — summing would
+    breach (and starve scale-downs) at half the configured SLO."""
+    ctl = _ctl()                    # queue_slo 4
+    both = FleetObservation(live=1, queued=3, router_queued=3)
+    assert ctl.decide(both, 1, now=0.0) is None     # max 3 <= 4
+    assert ctl.decide(both, 1, now=1.0) is None     # never breaches
+    hot = FleetObservation(live=1, queued=0, router_queued=9)
+    ctl2 = _ctl()
+    ctl2.decide(hot, 1, now=0.0)
+    d = ctl2.decide(hot, 1, now=1.0)
+    assert d is not None and d.direction == "up"    # router-only breach

@@ -706,6 +706,24 @@ def test_metrics_names_rendered_and_documented():
         assert fam in rendered, f"streaming family unrendered: {fam}"
         assert fam in doc_names, f"streaming family undocumented: {fam}"
 
+    # the autoscaler + quota families are pinned EXPLICITLY the same
+    # way (ISSUE 15 lint discipline): each must be rendered by the
+    # driver /metrics endpoint and documented — renaming either side
+    # without the other fails here
+    for fam in (_metrics.DRIVER_AUTOSCALE_SCALE_UPS_TOTAL,
+                _metrics.DRIVER_AUTOSCALE_SCALE_DOWNS_TOTAL,
+                _metrics.DRIVER_AUTOSCALE_REPLICAS,
+                _metrics.DRIVER_AUTOSCALE_TTFT_P99_S,
+                _metrics.DRIVER_AUTOSCALE_QUEUE_DEPTH,
+                _metrics.DRIVER_QUOTA_POOL_SLOTS,
+                _metrics.DRIVER_QUOTA_POOL_FREE,
+                _metrics.DRIVER_QUOTA_SLOTS,
+                _metrics.DRIVER_QUOTA_DONATIONS_TOTAL,
+                _metrics.DRIVER_QUOTA_RECLAIMS_TOTAL):
+        assert fam in rendered, f"autoscale/quota family unrendered: {fam}"
+        assert fam in doc_names, (
+            f"autoscale/quota family undocumented: {fam}")
+
     # the model-labeled partition is a rendered contract too: the serve
     # renderer must attach {model=...} labels somewhere (the per-model
     # block) and the doc must describe the label

@@ -710,3 +710,48 @@ def test_spec_sigkill_recovery_subprocess(tmp_path):
     finally:
         proc2.kill()
         proc2.wait(timeout=30)
+
+
+def test_spec_per_request_stop_sequences(params, dparams):
+    """Per-request stop SEQUENCES under speculation (ISSUE 15
+    satellite): a multi-token stop match ends the request at the
+    earliest match end — identical to spec-off serving with the same
+    stop — even when the match completes mid-verify-round, and
+    stop-less neighbors in the same burst are untouched."""
+    prompts = _prompts(4, key=17)
+    budgets = [10] * 4
+    solo = [_solo(params, p, 10) for p in prompts]
+    seq = solo[0][3:5]                  # bigram from request 0's stream
+
+    def expect(toks):
+        for e in range(2, len(toks) + 1):
+            if toks[e - 2:e] == seq:
+                return toks[:e]
+        return toks
+
+    plain = _srv(params)
+    preqs = [Request(prompt=p, max_new_tokens=b,
+                     stop=[list(seq)] if i == 0 else None)
+             for i, (p, b) in enumerate(zip(prompts, budgets))]
+    for r in preqs:
+        plain.submit(r)
+    done_p = plain.run_until_drained()
+    spec = _srv(params, draft=dparams, draft_cfg=DRAFT, spec_gamma=2)
+    sreqs = [Request(prompt=p, max_new_tokens=b,
+                     stop=[list(seq)] if i == 0 else None)
+             for i, (p, b) in enumerate(zip(prompts, budgets))]
+    for r in sreqs:
+        spec.submit(r)
+    done_s = spec.run_until_drained()
+    for i in range(4):
+        want = expect(solo[i]) if i == 0 else solo[i]
+        assert done_p[preqs[i].id].tokens == want, f"plain {i}"
+        assert done_s[sreqs[i].id].tokens == want, f"spec {i}"
+    assert done_s[sreqs[0].id].finish_reason == "stop"
+    assert done_s[sreqs[1].id].finish_reason == "length"
+    # logprobs are out of scope under speculation, by contract
+    with pytest.raises(ValueError, match="logprobs"):
+        spec.submit(Request(prompt=prompts[0], max_new_tokens=4,
+                            logprobs=2))
+    plain.shutdown()
+    spec.shutdown()

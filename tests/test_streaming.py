@@ -494,3 +494,164 @@ def test_stream_fails_loudly_when_replay_off(params, monkeypatch):
         app.discard_result(rid)
     finally:
         app.shutdown()
+
+
+# --------------------------------------------------------------------------
+# per-request stop sequences + /v1 logprobs (ISSUE 15 satellites)
+# --------------------------------------------------------------------------
+
+def _earliest_stop_end(tokens, seq):
+    """Reference scanner for the engine's stop contract: the earliest
+    index (exclusive) where ``seq`` completes inside ``tokens``."""
+    n = len(seq)
+    for e in range(n, len(tokens) + 1):
+        if tokens[e - n:e] == list(seq):
+            return e
+    return None
+
+
+def test_per_request_stop_buffered_and_streamed(params):
+    """A per-request stop SEQUENCE truncates the greedy stream at the
+    earliest match end — same tokens on the buffered POST and across
+    SSE frames, finish_reason "stop", and the server-wide default is
+    untouched for a stop-less follow-up request."""
+    srv, app, httpd, port = _http_app(params)
+    try:
+        prompt = [int(t) for t in _prompt(6, seed=53)]
+        solo = _solo(params, np.asarray(prompt, np.int32), 16)
+        seq = solo[4:6]
+        end = _earliest_stop_end(solo, seq)
+        assert end is not None
+        expect = solo[:end]
+        body = _json_post(port, "/generate",
+                          {"prompt": prompt, "max_new_tokens": 16,
+                           "stop": seq})          # flat list = ONE seq
+        assert body["tokens"] == expect and \
+            body["finish_reason"] == "stop"
+        frames = [json.loads(f) for f in _sse_post(
+            port, "/generate?stream=true",
+            {"prompt": prompt, "max_new_tokens": 16,
+             "stop": [seq]})]                     # list-of-lists form
+        toks = [t for f in frames if "finish_reason" not in f
+                for t in f["tokens"]]
+        assert toks == expect
+        assert frames[-1]["finish_reason"] == "stop"
+        assert frames[-1]["n_tokens"] == len(expect)
+        # the freed slot's next stop-less occupant is unaffected
+        again = _json_post(port, "/generate",
+                           {"prompt": prompt, "max_new_tokens": 16})
+        assert again["tokens"] == solo and \
+            again["finish_reason"] == "length"
+        # malformed stop payloads are 400s, not engine faults
+        for bad in ("x", [], [[]], [["a"]]):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"prompt": prompt,
+                                 "stop": bad}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
+def test_per_request_stop_replay_safe_across_loop_crash(
+        params, monkeypatch):
+    """The journal carries the request's stop sequences: a mid-decode
+    loop crash replays the request WITH them, and the replayed result
+    is identical to an uncrashed server's (PR 11 discipline — the
+    truncated stream is the durable one)."""
+    prompt = [int(t) for t in _prompt(6, seed=59)]
+    solo = _solo(params, np.asarray(prompt, np.int32), 16)
+    seq = solo[5:7]
+    end = _earliest_stop_end(solo, seq)
+    expect = solo[:end]
+    monkeypatch.setenv("TONY_TEST_SERVING_CRASH_AT_BLOCKS", "1")
+    srv = _srv(params)
+    app = ServeApp(srv, max_loop_restarts=8, loop_backoff_s=0.02)
+    app.start()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(app))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        body = _json_post(port, "/generate",
+                          {"prompt": prompt, "max_new_tokens": 16,
+                           "stop": seq})
+        assert body["tokens"] == expect and \
+            body["finish_reason"] == "stop"
+        assert srv.chaos_faults_injected == 1 and srv.replays >= 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
+def test_v1_logprobs_choices_and_stop(params):
+    """/v1 logprobs from the already-computed logits row: completions
+    carry the classic tokens/token_logprobs/top_logprobs arrays, chat
+    carries the content list; greedy means the chosen token IS the top
+    alternative. ``stop`` rides the codec (text -> token ids) on both
+    endpoints; logprobs+stream is a 400 by contract."""
+    srv, app, httpd, port = _http_app(params)
+    try:
+        prompt = [int(t) for t in _prompt(6, seed=61)]
+        solo = _solo(params, np.asarray(prompt, np.int32), 8)
+        resp = _json_post(port, "/v1/completions",
+                          {"prompt": prompt, "max_tokens": 8,
+                           "logprobs": 3})
+        ch = resp["choices"][0]
+        assert ch["tokens"] == solo
+        lp = ch["logprobs"]
+        assert len(lp["tokens"]) == len(lp["token_logprobs"]) == \
+            len(lp["top_logprobs"]) == 8
+        for tok, tok_lp, top in zip(ch["tokens"], lp["token_logprobs"],
+                                    lp["top_logprobs"]):
+            assert tok_lp is not None and tok_lp <= 0.0
+            assert len(top) <= 3
+            # greedy: the emitted token is the argmax -> the best
+            # alternative, at its own logprob
+            assert top[str(tok)] == max(top.values())
+            assert abs(top[str(tok)] - tok_lp) < 1e-4
+        # logprobs-less requests carry an explicit null (pinned key)
+        plain = _json_post(port, "/v1/completions",
+                           {"prompt": prompt, "max_tokens": 4})
+        assert plain["choices"][0]["logprobs"] is None
+        # chat: boolean switch + top_logprobs count, content-list shape
+        text = " ".join(str(t) for t in prompt)
+        resp = _json_post(port, "/v1/chat/completions",
+                          {"messages": [{"role": "user",
+                                         "content": text}],
+                           "max_tokens": 6, "logprobs": True,
+                           "top_logprobs": 2})
+        content = resp["choices"][0]["logprobs"]["content"]
+        assert len(content) == 6
+        for entry in content:
+            assert set(entry) == {"token", "logprob", "top_logprobs"}
+            assert len(entry["top_logprobs"]) <= 2
+            assert entry["top_logprobs"][0]["token"] == entry["token"]
+        # stop through the codec: a one-token text stop truncates
+        stop_tok = solo[3]
+        resp = _json_post(port, "/v1/completions",
+                          {"prompt": prompt, "max_tokens": 8,
+                           "stop": str(stop_tok)})
+        e = _earliest_stop_end(solo, [stop_tok])
+        assert resp["choices"][0]["tokens"] == solo[:e]
+        assert resp["choices"][0]["finish_reason"] == "stop"
+        # streamed logprobs are rejected with the OpenAI envelope
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": prompt, "max_tokens": 4,
+                             "stream": True, "logprobs": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+        err = json.loads(ei.value.read().decode())["error"]
+        assert err["type"] == "invalid_request_error"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()

@@ -50,20 +50,21 @@ __all__ = [
 # ignored, except the validated-if-present ones noted in the doc)
 COMPLETION_REQUEST_PARAMS = frozenset((
     "model", "prompt", "max_tokens", "temperature", "top_k", "stream",
-    "timeout_s",
+    "timeout_s", "stop", "logprobs",
 ))
 CHAT_REQUEST_PARAMS = frozenset((
     "model", "messages", "max_tokens", "temperature", "top_k", "stream",
-    "timeout_s",
+    "timeout_s", "stop", "logprobs", "top_logprobs",
 ))
 
 COMPLETION_RESPONSE_KEYS = frozenset((
     "id", "object", "created", "model", "choices", "usage",
 ))
 CHAT_RESPONSE_KEYS = COMPLETION_RESPONSE_KEYS
-CHOICE_KEYS = frozenset(("index", "text", "tokens", "finish_reason"))
+CHOICE_KEYS = frozenset(("index", "text", "tokens", "finish_reason",
+                         "logprobs"))
 CHAT_CHOICE_KEYS = frozenset(("index", "message", "tokens",
-                              "finish_reason"))
+                              "finish_reason", "logprobs"))
 USAGE_KEYS = frozenset(("prompt_tokens", "completion_tokens",
                         "total_tokens"))
 
@@ -151,6 +152,35 @@ def _common_params(payload: dict) -> dict:
     return out
 
 
+def _parse_stop(payload: dict, codec: TokenCodec) -> list | None:
+    """``stop``: a string or a list of strings (the OpenAI shape),
+    codec-encoded into token-id sequences — or raw token-id lists for
+    token-native clients. None when absent."""
+    stop = payload.get("stop")
+    if stop is None:
+        return None
+    if isinstance(stop, str):
+        stop = [stop]
+    if not isinstance(stop, list) or not stop:
+        raise ValueError("stop must be a string or a non-empty list")
+    out = []
+    for item in stop:
+        if isinstance(item, str):
+            seq = codec.encode(item)
+        elif isinstance(item, (list, tuple)) and item and all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in item):
+            seq = [int(t) for t in item]
+        else:
+            raise ValueError(
+                "each stop entry must be a string or a non-empty "
+                "token-id list")
+        if not seq:
+            raise ValueError("a stop entry encoded to an empty sequence")
+        out.append(seq)
+    return out
+
+
 def parse_completion_request(payload: dict, codec: TokenCodec) -> dict:
     """``POST /v1/completions`` body -> engine kwargs:
     {prompt_tokens, max_new_tokens, temperature?, top_k?, stream,
@@ -167,6 +197,16 @@ def parse_completion_request(payload: dict, codec: TokenCodec) -> dict:
     else:
         raise ValueError(
             "prompt must be a non-empty token-id array or a string")
+    out["stop_sequences"] = _parse_stop(payload, codec)
+    lp = payload.get("logprobs", 0)
+    if lp is None:
+        lp = 0
+    if isinstance(lp, bool) or not isinstance(lp, int) or lp < 0:
+        raise ValueError("logprobs must be a non-negative integer")
+    out["logprobs"] = lp
+    if lp and out["stream"]:
+        raise ValueError("logprobs are unavailable on streamed "
+                         "requests (buffered responses only)")
     return out
 
 
@@ -189,6 +229,22 @@ def parse_chat_request(payload: dict, codec: TokenCodec) -> dict:
     if not toks:
         raise ValueError("messages encode to an empty prompt")
     out["prompt_tokens"] = toks
+    out["stop_sequences"] = _parse_stop(payload, codec)
+    # chat logprobs: the boolean switch + optional top_logprobs count
+    # (the OpenAI chat shape) collapse to one engine k
+    lp_on = payload.get("logprobs", False)
+    if lp_on is None:
+        lp_on = False
+    if not isinstance(lp_on, bool):
+        raise ValueError("logprobs must be a JSON boolean")
+    top_lp = payload.get("top_logprobs", 0) or 0
+    if isinstance(top_lp, bool) or not isinstance(top_lp, int) \
+            or top_lp < 0:
+        raise ValueError("top_logprobs must be a non-negative integer")
+    out["logprobs"] = (max(1, top_lp) if lp_on else 0)
+    if out["logprobs"] and out["stream"]:
+        raise ValueError("logprobs are unavailable on streamed "
+                         "requests (buffered responses only)")
     return out
 
 
@@ -204,8 +260,45 @@ def _usage(prompt_tokens: int, completion_tokens: int) -> dict:
             "total_tokens": int(prompt_tokens) + int(completion_tokens)}
 
 
+def _fmt_completion_logprobs(raw, codec: TokenCodec) -> dict | None:
+    """Engine logprob entries -> the /v1/completions ``logprobs``
+    object: per-token decoded text, the chosen token's logprob (null
+    for a replayed teacher-forced prefix), and the top alternatives as
+    {decoded: logprob} maps."""
+    if raw is None:
+        return None
+    tokens, token_lps, tops = [], [], []
+    for e in raw:
+        tokens.append(codec.decode([e["token"]]))
+        token_lps.append(e.get("logprob"))
+        top = e.get("top")
+        tops.append(
+            {codec.decode([t]): lp for t, lp in zip(top[0], top[1])}
+            if top else None)
+    return {"tokens": tokens, "token_logprobs": token_lps,
+            "top_logprobs": tops}
+
+
+def _fmt_chat_logprobs(raw, codec: TokenCodec) -> dict | None:
+    """Engine logprob entries -> the /v1/chat ``logprobs.content``
+    list (token/logprob/top_logprobs per emitted token)."""
+    if raw is None:
+        return None
+    content = []
+    for e in raw:
+        top = e.get("top")
+        content.append({
+            "token": codec.decode([e["token"]]),
+            "logprob": e.get("logprob"),
+            "top_logprobs": [
+                {"token": codec.decode([t]), "logprob": lp}
+                for t, lp in zip(top[0], top[1])] if top else []})
+    return {"content": content}
+
+
 def completion_response(rid, model: str, tokens, finish_reason: str,
-                        prompt_tokens: int, codec: TokenCodec) -> dict:
+                        prompt_tokens: int, codec: TokenCodec,
+                        logprobs=None) -> dict:
     return {
         "id": f"cmpl-{rid}",
         "object": "text_completion",
@@ -216,13 +309,15 @@ def completion_response(rid, model: str, tokens, finish_reason: str,
             "text": codec.decode(tokens),
             "tokens": [int(t) for t in tokens],
             "finish_reason": map_finish_reason(finish_reason),
+            "logprobs": _fmt_completion_logprobs(logprobs, codec),
         }],
         "usage": _usage(prompt_tokens, len(tokens)),
     }
 
 
 def chat_response(rid, model: str, tokens, finish_reason: str,
-                  prompt_tokens: int, codec: TokenCodec) -> dict:
+                  prompt_tokens: int, codec: TokenCodec,
+                  logprobs=None) -> dict:
     return {
         "id": f"chatcmpl-{rid}",
         "object": "chat.completion",
@@ -234,6 +329,7 @@ def chat_response(rid, model: str, tokens, finish_reason: str,
                         "content": codec.decode(tokens)},
             "tokens": [int(t) for t in tokens],
             "finish_reason": map_finish_reason(finish_reason),
+            "logprobs": _fmt_chat_logprobs(logprobs, codec),
         }],
         "usage": _usage(prompt_tokens, len(tokens)),
     }

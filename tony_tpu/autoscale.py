@@ -1,0 +1,505 @@
+"""Closed-loop serving autoscaler + multi-tenant resource arbiter.
+
+TonY's defining capability is YARN's resource-negotiation layer:
+heterogeneous jobs sharing one cluster under quotas, with the AM
+requesting and releasing containers as conditions change (PAPER.md
+L3-L4). Every input and actuator for that loop already exists in this
+repo — per-replica TTFT/queue telemetry (PRs 4, 7), budget-free
+roll/resize/preempt (PRs 7, 9), ~1s warm-pool adoption (PR 10), a
+journaled driver that survives its own death (PR 12) — and this module
+closes the loop (docs/autoscaling.md):
+
+- **AutoscaleController** — a driver-resident loop that watches the
+  serving fleet's merged telemetry (per-replica ``/metrics`` TTFT
+  histogram buckets, delta'd per tick into a WINDOWED fleet p99, plus
+  ``/stats`` queue depths and optionally a fleet-router ``/stats``) and
+  scales the serving role between ``tony.autoscale.min`` and ``max``:
+  scale-up relaunches a PARKED slot through the normal launch path
+  (serving replicas spawn cold by the PR 10 drain contract; the
+  warm-pool adoption fast path rides the loop's capacity-RETURN leg,
+  where a reclaimed training worker adopts a standby), scale-down
+  SIGTERM-drains the least-loaded replica (the serve child finishes
+  in-flight work; the router fails queued work over) and parks its
+  slot. Hysteresis is deliberate: ``breach-ticks`` consecutive breaching
+  windows before a scale-up, a full ``cooldown-s`` between decisions,
+  and scale-down additionally requires the signals CLEAR (below half
+  the SLO) for a whole cooldown. Every decision is journaled
+  (``{"op": "scale", ...}``) before it acts, so a recovered driver
+  resumes mid-cooldown with its ledger instead of flapping.
+
+- **ResourceArbiter** — all roles share one device/slot pool
+  (``tony.quota.pool-slots``; default = the sum of configured
+  instances) under per-role quotas and two priority classes.  When the
+  controller wants a replica and the pool is exhausted, the arbiter
+  picks a donor from the ``batch`` tier (the most-held batch role's
+  highest-index non-chief RUNNING worker, never below the elastic
+  floor) and the driver preempt-drains it — checkpoint at the step
+  boundary, budget-free, the PR 9 contract — then DETACHES the slot
+  instead of relaunching (trace mark ``donated``). When serving scales
+  back down, the freed capacity lets the existing elastic
+  rescale-retry loop re-attach the donated slot (trace mark
+  ``reclaimed``), with the checkpoint prestaged onto the returning
+  worker before it joins the gang barrier (checkpoint-aware rescale
+  placement, docs/autoscaling.md).
+
+The pieces are deliberately separable: ``scrape_ttft_buckets`` /
+``bucket_quantile`` are pure parsing, ``ResourceArbiter`` is pure
+accounting over the session table, and ``AutoscaleController.decide``
+is a pure function of (observation, clock) — each unit-testable
+without HTTP, a model, or a driver (tests/test_autoscale.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass
+
+from .api import TaskStatus
+from .conf import TonyConf, keys
+
+log = logging.getLogger(__name__)
+
+# the serve-side exposition family the controller windows its SLO over
+TTFT_FAMILY = "serving_ttft_seconds"
+
+_BUCKET_RE = re.compile(
+    r'^(?P<fam>[a-z0-9_]+)_bucket\{[^}]*le="(?P<le>[^"]+)"[^}]*\}\s+'
+    r'(?P<val>[0-9.eE+-]+)\s*$')
+
+
+def scrape_ttft_buckets(text: str, family: str = TTFT_FAMILY) -> dict:
+    """Parse one Prometheus exposition payload into the cumulative
+    bucket counts of ``family`` ({le-string: count}). Only the
+    UNLABELED family partition is read (per-model partitions carry a
+    ``model=`` label and would double-count)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        m = _BUCKET_RE.match(line.strip())
+        if m is None or m.group("fam") != family:
+            continue
+        if 'model="' in line:
+            continue
+        out[m.group("le")] = out.get(m.group("le"), 0.0) + float(
+            m.group("val"))
+    return out
+
+
+def bucket_delta(prev: dict, cur: dict) -> dict:
+    """Per-le delta of two cumulative bucket snapshots. A replica
+    restart resets its counters — a negative delta clamps to the
+    CURRENT value (the fresh process's whole history is the window)."""
+    out = {}
+    for le, v in cur.items():
+        d = v - prev.get(le, 0.0)
+        out[le] = v if d < 0 else d
+    return out
+
+
+def bucket_quantile(buckets: dict, q: float) -> float | None:
+    """q-th quantile from cumulative {le: count} buckets (linear within
+    the winning bucket, the PromQL convention); None on no samples."""
+    def le_key(le: str) -> float:
+        return float("inf") if le in ("+Inf", "inf") else float(le)
+
+    items = sorted(buckets.items(), key=lambda kv: le_key(kv[0]))
+    if not items:
+        return None
+    total = items[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    lo = 0.0
+    prev_count = 0.0
+    for le, count in items:
+        if count >= rank:
+            hi = le_key(le)
+            if hi == float("inf"):
+                return lo       # honest lower edge for an unbounded tail
+            width = count - prev_count
+            if width <= 0:
+                return hi
+            return lo + (hi - lo) * (rank - prev_count) / width
+        lo, prev_count = le_key(le), count
+    return le_key(items[-1][0])
+
+
+@dataclass
+class FleetObservation:
+    """One controller tick's merged view of the serving fleet."""
+    live: int = 0                   # replicas answering /stats
+    queued: int = 0                 # total queued across replicas
+    active: int = 0                 # busy slots across replicas
+    ttft_p99_s: float | None = None  # WINDOWED fleet p99 (None = no
+    #                                  completions this window)
+    window_samples: int = 0         # TTFT observations in the window
+    router_queued: int | None = None  # router-side QUEUE estimate
+    #                                   (outstanding posts minus active;
+    #                                   overlaps the replica view — the
+    #                                   control law takes the max)
+
+
+class FleetWatcher:
+    """Polls each replica's /stats (queue) + /metrics (TTFT buckets)
+    and windows the TTFT histogram by delta'ing the cumulative buckets
+    between ticks, merged across replicas — the fleet-wide p99 a
+    client actually experienced THIS window, not since boot."""
+
+    def __init__(self, timeout_s: float = 2.0):
+        self.timeout_s = timeout_s
+        self._prev: dict[str, dict] = {}    # replica name -> buckets
+        # per-replica instantaneous load (queued + active) from the
+        # newest observe() — the scale-down victim picker's input
+        self.last_loads: dict[str, int] = {}
+
+    def _get(self, url: str) -> str | None:
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+                return r.read().decode()
+        except Exception:
+            return None
+
+    def observe(self, endpoints, router_stats_url: str = "") \
+            -> FleetObservation:
+        """``endpoints``: [(name, host, port)] of the serving role's
+        RUNNING replicas (their published serve_port). Best-effort: a
+        replica that answers neither probe contributes nothing."""
+        obs = FleetObservation()
+        window: dict[str, float] = {}
+        loads: dict[str, int] = {}
+        for name, host, port in endpoints:
+            base = f"http://{host}:{port}"
+            st_raw = self._get(base + "/stats")
+            if st_raw is not None:
+                try:
+                    st = json.loads(st_raw)
+                    obs.live += 1
+                    queued = int(st.get("queued", 0) or 0)
+                    active = int(st.get("active", 0) or 0)
+                    obs.queued += queued
+                    obs.active += active
+                    loads[name] = queued + active
+                except ValueError:
+                    pass
+            met = self._get(base + "/metrics")
+            if met is None:
+                continue        # baseline RETAINED: the next successful
+                #                 scrape's delta covers the gap (a loaded
+                #                 replica timing out one poll mid-breach
+                #                 must not blind the TTFT window)
+            cur = scrape_ttft_buckets(met)
+            if not cur:
+                continue
+            prev = self._prev.get(name)
+            self._prev[name] = cur
+            delta = bucket_delta(prev, cur) if prev is not None else {}
+            for le, v in delta.items():
+                window[le] = window.get(le, 0.0) + v
+        # drop baselines of replicas that LEFT THE FLEET — membership,
+        # not scrape success (a reused name at a new port still deltas
+        # correctly: counters restart, clamp wins)
+        for name in set(self._prev) - {n for n, _, _ in endpoints}:
+            self._prev.pop(name, None)
+        self.last_loads = loads
+        if window:
+            items = sorted(window.values())
+            obs.window_samples = int(max(items)) if items else 0
+            obs.ttft_p99_s = bucket_quantile(window, 0.99)
+            if obs.window_samples <= 0:
+                obs.ttft_p99_s = None
+        if router_stats_url:
+            raw = self._get(router_stats_url)
+            if raw is not None:
+                try:
+                    st = json.loads(raw)
+                    # the router's QUEUE estimate is outstanding posts
+                    # minus actively-decoding ones: inflight alone
+                    # counts admitted work twice over the replicas' own
+                    # stats, and adding the router's polled `queued`
+                    # copy would double-count again
+                    fleet = st.get("fleet")
+                    if isinstance(fleet, dict):
+                        obs.router_queued = max(
+                            0, int(fleet.get("inflight", 0) or 0)
+                            - int(fleet.get("active", 0) or 0))
+                    else:       # pre-"fleet" routers: per-replica view
+                        reps = st.get("replicas") or {}
+                        obs.router_queued = sum(
+                            max(0, int(r.get("inflight", 0) or 0)
+                                - int(r.get("active", 0) or 0))
+                            for r in reps.values() if isinstance(r, dict))
+                except (ValueError, AttributeError, TypeError):
+                    pass
+        return obs
+
+
+@dataclass
+class ScaleDecision:
+    direction: str              # "up" | "down"
+    reason: str
+
+
+class AutoscaleController:
+    """The control law, separated from its actuators. ``decide()`` is a
+    pure function of (observation, now) over the controller's hysteresis
+    state; the driver-resident ``tick()`` wires it to real telemetry and
+    the driver's scale_up/scale_down actuators; ``start()`` runs ticks
+    on a daemon thread at ``interval-s``."""
+
+    def __init__(self, *, ttft_slo_s: float = 0.0, queue_slo: int = 0,
+                 min_replicas: int = 1, max_replicas: int = 1,
+                 cooldown_s: float = 30.0, breach_ticks: int = 2,
+                 interval_s: float = 2.0, last_scale_t: float | None = None,
+                 now_fn=time.time):
+        self.ttft_slo_s = float(ttft_slo_s)
+        self.queue_slo = int(queue_slo)
+        self.min_replicas = max(0, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self.breach_ticks = max(1, int(breach_ticks))
+        self.interval_s = max(0.1, float(interval_s))
+        self._now = now_fn
+        # hysteresis state. last_scale_t is WALL clock (it must survive
+        # a driver recovery via the journal's scale ledger); clear_since
+        # is in the same clock for symmetry.
+        self.last_scale_t = last_scale_t
+        self._breach_streak = 0
+        self._clear_since: float | None = None
+        # breach windows observed inside a cooldown WE armed are
+        # discounted — they still reflect the pre-actuation fleet (the
+        # new replica hadn't absorbed load when those requests ran).  A
+        # RECOVERED cooldown (last_scale_t via ctor) only suppresses
+        # actuation: post-recovery windows are fresh evidence and may
+        # pre-arm the streak.
+        self._discard_until = 0.0
+        # the newest observation, for /metrics
+        self.last_obs = FleetObservation()
+        self.decisions_up = 0
+        self.decisions_down = 0
+
+    @classmethod
+    def from_conf(cls, conf: TonyConf,
+                  last_scale_t: float | None = None) -> "AutoscaleController":
+        return cls(
+            ttft_slo_s=float(conf.get(keys.AUTOSCALE_TTFT_P99_SLO_S, 0)
+                             or 0),
+            queue_slo=conf.get_int(keys.AUTOSCALE_QUEUE_DEPTH_SLO, 0),
+            min_replicas=conf.get_int(keys.AUTOSCALE_MIN, 1),
+            max_replicas=conf.get_int(keys.AUTOSCALE_MAX, 0),
+            cooldown_s=float(conf.get(keys.AUTOSCALE_COOLDOWN_S, 30) or 0),
+            breach_ticks=conf.get_int(keys.AUTOSCALE_BREACH_TICKS, 2),
+            interval_s=float(conf.get(keys.AUTOSCALE_INTERVAL_S, 2) or 2),
+            last_scale_t=last_scale_t)
+
+    # ------------------------------------------------------------ control law
+    def _breaching(self, obs: FleetObservation) -> str | None:
+        """Which SLO (if any) this observation breaches. The router's
+        inflight/queued view OVERLAPS the replicas' own /stats (a
+        router-posted request admitted server-side appears in both), so
+        the queue signal is the MAX of the two views, never the sum —
+        summing would breach at half the configured SLO."""
+        queued = max(obs.queued, obs.router_queued or 0)
+        if self.queue_slo > 0 and queued > self.queue_slo:
+            return f"queue depth {queued} > SLO {self.queue_slo}"
+        if (self.ttft_slo_s > 0 and obs.ttft_p99_s is not None
+                and obs.ttft_p99_s > self.ttft_slo_s):
+            return (f"windowed ttft p99 {obs.ttft_p99_s:.3f}s > SLO "
+                    f"{self.ttft_slo_s}s")
+        return None
+
+    def _clear(self, obs: FleetObservation) -> bool:
+        """Both signals comfortably under HALF their SLO (a no-traffic
+        window — no completions, empty queue — counts as clear)."""
+        queued = max(obs.queued, obs.router_queued or 0)
+        if self.queue_slo > 0 and queued > self.queue_slo / 2:
+            return False
+        if (self.ttft_slo_s > 0 and obs.ttft_p99_s is not None
+                and obs.ttft_p99_s > self.ttft_slo_s / 2):
+            return False
+        return True
+
+    def decide(self, obs: FleetObservation, n_running: int,
+               now: float | None = None) -> ScaleDecision | None:
+        """One control-law evaluation. ``n_running`` is the serving
+        role's current non-parked replica count (launched or launching).
+        Returns a decision or None; the CALLER journals + actuates, and
+        reports success back via ``note_scaled`` (an actuation that
+        could not proceed — e.g. awaiting a donation drain — must not
+        start the cooldown, or the pending scale-up would starve)."""
+        now = self._now() if now is None else now
+        self.last_obs = obs
+        breach = self._breaching(obs)
+        in_cooldown = (self.last_scale_t is not None
+                       and now - self.last_scale_t < self.cooldown_s)
+        if n_running < self.min_replicas and not in_cooldown:
+            # floor enforcement: a replica parked by budget exhaustion
+            # (or a recovered formation below min) relaunches without
+            # waiting for an SLO breach
+            return ScaleDecision(
+                "up", f"{n_running} running < min {self.min_replicas}")
+        if breach is not None:
+            self._clear_since = None
+            if now < self._discard_until:
+                return None
+            self._breach_streak += 1
+            if (self._breach_streak >= self.breach_ticks
+                    and not in_cooldown and n_running < self.max_replicas):
+                return ScaleDecision("up", breach)
+            return None
+        self._breach_streak = 0
+        if not self._clear(obs):
+            self._clear_since = None
+            return None
+        if self._clear_since is None:
+            self._clear_since = now
+        if (not in_cooldown and n_running > self.min_replicas
+                and now - self._clear_since >= self.cooldown_s):
+            return ScaleDecision(
+                "down", f"signals clear for {now - self._clear_since:.0f}s")
+        return None
+
+    def note_scaled(self, direction: str, now: float | None = None) -> None:
+        """The actuation actually happened: arm the cooldown."""
+        now = self._now() if now is None else now
+        self.last_scale_t = now
+        self._breach_streak = 0
+        self._clear_since = None
+        self._discard_until = now + self.cooldown_s
+        if direction == "up":
+            self.decisions_up += 1
+        else:
+            self.decisions_down += 1
+
+
+class ResourceArbiter:
+    """Quota + priority accounting over one shared slot pool. Pure
+    bookkeeping over the session's task table — the driver actuates
+    (preempt-drain, detach, relaunch); the arbiter only answers
+    ``free()`` / ``can_grant()`` / ``pick_donor()``."""
+
+    def __init__(self, session, specs=None, pool_slots: int = 0):
+        self.session = session
+        specs = list(specs if specs is not None
+                     else session.role_specs.values())
+        self.specs = {s.name: s for s in specs}
+        self.pool_slots = (int(pool_slots) if pool_slots
+                           else sum(s.instances for s in specs))
+        self.donations = 0          # batch slots preempt-drained for
+        #                             interactive demand
+        self.reclaims = 0           # donated slots returned to batch
+
+    def held(self, role: str) -> int:
+        """Slots a role currently occupies: launched (or launching),
+        non-terminal, non-detached tasks. Parked/donated slots are
+        detached, so they count as free pool capacity."""
+        n = 0
+        for t in self.session.tasks.get(role, []):
+            if t.task_id in self.session.detached:
+                continue
+            if t.status in (TaskStatus.NEW,) or t.status.is_terminal():
+                continue
+            n += 1
+        return n
+
+    def held_total(self) -> int:
+        return sum(self.held(r) for r in self.specs)
+
+    def free(self) -> int:
+        return self.pool_slots - self.held_total()
+
+    def quota(self, role: str) -> int:
+        spec = self.specs.get(role)
+        if spec is None:
+            return 0
+        return spec.instances if spec.quota < 0 else spec.quota
+
+    def can_grant(self, role: str) -> bool:
+        """May ``role`` take one more slot right now (quota + free
+        pool)?"""
+        return (self.held(role) < self.quota(role)) and self.free() >= 1
+
+    def over_quota(self, role: str) -> bool:
+        return self.held(role) >= self.quota(role)
+
+    def batch_floor(self, role: str, elastic_min: int = 1) -> int:
+        """How low donation may drain a batch role: the elastic floor
+        (survivors must still form a gang)."""
+        return max(1, int(elastic_min))
+
+    def pick_donor(self, for_role: str, elastic_min: int = 1,
+                   busy: set | None = None) -> str | None:
+        """The batch task that yields its slot to ``for_role``: from the
+        MOST-held batch role (most capacity to spare), its highest-index
+        RUNNING non-chief task — deterministic, chief-safe, floor-safe.
+        ``busy`` excludes tasks already mid-drain for another ledger."""
+        busy = busy or set()
+        candidates = []
+        for name, spec in self.specs.items():
+            if name == for_role or spec.priority_class != "batch":
+                continue
+            running = [
+                t for t in self.session.tasks.get(name, [])
+                if t.task_id not in self.session.detached
+                and t.status == TaskStatus.RUNNING
+                and t.task_id not in busy
+                and not self.session.is_chief(t.name, t.index)
+                # index 0 is the role's gang anchor (completion policy,
+                # rank-0 rendezvous) even when no explicit chief role
+                # exists — never donated
+                and t.index != 0]
+            if self.held(name) - 1 < self.batch_floor(name, elastic_min):
+                continue
+            if running:
+                candidates.append((self.held(name), name, running))
+        if not candidates:
+            return None
+        _, _, running = max(candidates, key=lambda c: (c[0], c[1]))
+        return max(running, key=lambda t: t.index).task_id
+
+    def snapshot(self) -> dict:
+        """The /metrics + journal-debug view."""
+        return {
+            "pool_slots": self.pool_slots,
+            "free": self.free(),
+            "held": {r: self.held(r) for r in sorted(self.specs)},
+            "quota": {r: self.quota(r) for r in sorted(self.specs)},
+            "class": {r: self.specs[r].priority_class
+                      for r in sorted(self.specs)},
+            "donations": self.donations,
+            "reclaims": self.reclaims,
+        }
+
+
+class AutoscaleRunner(threading.Thread):
+    """The driver-resident loop: every ``interval-s``, observe the
+    fleet, evaluate the control law, and actuate through the driver.
+    All actuation goes through ``driver.autoscale_tick()`` so the
+    scale/donate/park ledger discipline lives next to the other ledgers
+    in driver.py."""
+
+    def __init__(self, driver, controller: AutoscaleController,
+                 watcher: FleetWatcher | None = None,
+                 router_stats_url: str = ""):
+        super().__init__(name="autoscaler", daemon=True)
+        self.driver = driver
+        self.controller = controller
+        self.watcher = watcher or FleetWatcher()
+        self.router_stats_url = router_stats_url
+        self.stop_event = threading.Event()
+
+    def run(self) -> None:
+        while not self.stop_event.wait(self.controller.interval_s):
+            try:
+                self.driver.autoscale_tick(self.controller, self.watcher,
+                                           self.router_stats_url)
+            except Exception:
+                # one bad tick (replica mid-restart, transient HTTP)
+                # must not end the loop for the life of the job
+                log.exception("autoscale tick failed")
+
+    def shutdown(self) -> None:
+        self.stop_event.set()

@@ -707,7 +707,9 @@ class ServeApp:
                      resume_tokens: list | None = None,
                      progress_key: str | None = None,
                      model: str | None = None,
-                     stream=None):
+                     stream=None,
+                     stop: list | None = None,
+                     logprobs: int = 0):
         """Admission half of generate(): returns (request_id, event). The
         request carries ``timeout`` as its queue deadline — if it is
         still queued when the waiter would have given up, admission skips
@@ -728,6 +730,7 @@ class ServeApp:
                       cache_prompt=cache_prompt,
                       resume_tokens=resume_tokens,
                       deadline=time.monotonic() + timeout,
+                      stop=stop, logprobs=int(logprobs or 0),
                       model=getattr(engine, "model", None)
                       if model is not None else None)
         ev = threading.Event()
@@ -1454,10 +1457,29 @@ def make_handler(app: ServeApp, codec=None):
                 model = payload.get("model")
                 if model is not None and not isinstance(model, str):
                     raise ValueError("model must be a string")
+                # per-request stop sequences (docs/serving.md "Stop
+                # sequences & logprobs"): a flat int list is ONE
+                # sequence, a list of lists several; deep validation
+                # (non-empty, ints) is the engine's _normalize_stop
+                stop = payload.get("stop")
+                if stop is not None and not isinstance(stop, list):
+                    raise ValueError(
+                        "stop must be a list of token ids or a list "
+                        "of token-id lists")
+                logprobs = payload.get("logprobs", 0)
+                if logprobs is None:
+                    logprobs = 0
+                if isinstance(logprobs, bool) or not isinstance(
+                        logprobs, int):
+                    raise ValueError("logprobs must be an integer")
                 # per-token streaming: ?stream=true or "stream": true
                 from ..api.stream import stream_requested
 
                 stream_on = stream_requested(payload, self.path)
+                if stream_on and logprobs:
+                    raise ValueError(
+                        "logprobs are unavailable on streamed "
+                        "requests (buffered responses only)")
                 ts = None
                 if stream_on:
                     from ..api.stream import TokenStream
@@ -1469,7 +1491,8 @@ def make_handler(app: ServeApp, codec=None):
                     top_k=None if top_k is None else int(top_k),
                     cache_prompt=cache_prompt,
                     resume_tokens=resume, progress_key=progress_key,
-                    model=model, stream=ts)
+                    model=model, stream=ts, stop=stop,
+                    logprobs=logprobs)
             except QueueFullError as e:
                 # shed: the queue is full. 429 + Retry-After is the
                 # load-balancer contract — retry elsewhere/later instead
@@ -1536,8 +1559,11 @@ def make_handler(app: ServeApp, codec=None):
             except TimeoutError as e:
                 self._send(504, {"error": str(e)})
                 return
-            self._send(200, {"id": comp.id, "tokens": comp.tokens,
-                             "finish_reason": comp.finish_reason})
+            body = {"id": comp.id, "tokens": comp.tokens,
+                    "finish_reason": comp.finish_reason}
+            if comp.logprobs is not None:
+                body["logprobs"] = comp.logprobs
+            self._send(200, body)
 
         def _oai_error(self, code: int, message: str, etype: str) -> None:
             self._send(code, {"error": {"message": message,
@@ -1571,7 +1597,9 @@ def make_handler(app: ServeApp, codec=None):
                     timeout=req["timeout_s"],
                     temperature=req.get("temperature"),
                     top_k=req.get("top_k"),
-                    model=req["model"], stream=ts)
+                    model=req["model"], stream=ts,
+                    stop=req.get("stop_sequences"),
+                    logprobs=req.get("logprobs", 0))
             except QueueFullError as e:
                 ra = getattr(e, "retry_after_s", 0)
                 self._send(429, {"error": {"message": str(e),
@@ -1618,7 +1646,8 @@ def make_handler(app: ServeApp, codec=None):
                 return
             build = oai.chat_response if chat else oai.completion_response
             self._send(200, build(comp.id, model_name, comp.tokens,
-                                  comp.finish_reason, n_prompt, codec))
+                                  comp.finish_reason, n_prompt, codec,
+                                  logprobs=comp.logprobs))
 
     return Handler
 

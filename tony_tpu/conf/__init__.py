@@ -61,6 +61,15 @@ class RoleSpec:
     max_restarts: int = 0
     env: dict[str, str] = field(default_factory=dict)
     priority: int = 0  # unique per role, like reference YARN priorities
+    # per-role runtime override ("" = the app-level framework): a
+    # multi-tenant job mixes serving replicas with training workers in
+    # one session (docs/autoscaling.md)
+    framework: str = ""
+    # arbiter tier: interactive roles preempt batch roles' capacity
+    # under pool pressure (tony_tpu/autoscale.py ResourceArbiter)
+    priority_class: str = "interactive"
+    # max pool slots this role may hold concurrently (-1 = instances)
+    quota: int = -1
 
 
 class TonyConf:
@@ -173,6 +182,10 @@ class TonyConf:
                     max_restarts=int(get("max-restarts", 0)),
                     env=env,
                     priority=prio,
+                    framework=str(get("framework", "") or ""),
+                    priority_class=str(
+                        get("priority-class", "") or "interactive").lower(),
+                    quota=int(get("quota", -1)),
                 )
             )
         return specs
@@ -211,6 +224,21 @@ class TonyConf:
                 raise ValueError(
                     f"role {s.name}: instances {s.instances} exceeds max-instances {s.max_instances}"
                 )
+        for s in specs:
+            if s.priority_class not in ("interactive", "batch"):
+                raise ValueError(
+                    f"role {s.name}: priority-class must be 'interactive' "
+                    f"or 'batch', got {s.priority_class!r}")
+        if self.get_bool(keys.AUTOSCALE_ENABLED, False):
+            role = str(self.get(keys.AUTOSCALE_ROLE, "") or "")
+            if not role and len(specs) != 1:
+                raise ValueError(
+                    f"{keys.AUTOSCALE_ROLE} is required when the job has "
+                    f"more than one role")
+            if role and role not in {s.name for s in specs}:
+                raise ValueError(
+                    f"{keys.AUTOSCALE_ROLE}={role!r} names no configured "
+                    "role")
         mode = str(self.get(keys.APPLICATION_DISTRIBUTED_MODE, "GANG")).upper()
         if mode not in ("GANG", "FCFS"):
             raise ValueError(f"distributed-mode must be GANG or FCFS, got {mode}")

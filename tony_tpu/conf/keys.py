@@ -183,6 +183,62 @@ TRAIN_STRAGGLER_RESTART_FACTOR = "tony.train.straggler-restart-factor"
 # fires (one noisy push must not cost a budget unit)
 TRAIN_STRAGGLER_GRACE_CHECKS = "tony.train.straggler-grace-checks"
 
+# ---------------------------------------------------------------- autoscaling
+# closed-loop serving autoscaler (tony_tpu/autoscale.py, docs/
+# autoscaling.md): a driver-resident controller watches the serving
+# fleet's merged telemetry (per-replica /metrics TTFT buckets + /stats
+# queue depths, optionally a router /stats) and scales the serving role
+# between min and max replicas — scale-up launches a parked slot via the
+# normal (warm-pool-adopting) launch path, scale-down SIGTERM-drains the
+# least-loaded replica and parks its slot. Decisions are journaled so a
+# recovered driver resumes mid-cooldown instead of flapping.
+AUTOSCALE_ENABLED = "tony.autoscale.enabled"
+# the serving role the controller scales ("" = the job's single role;
+# multi-role jobs must name it)
+AUTOSCALE_ROLE = "tony.autoscale.role"
+# scale-up SLOs: windowed fleet TTFT p99 (seconds; 0 = ignore) and total
+# queued requests across replicas (0 = ignore). Breaching EITHER for
+# breach-ticks consecutive controller ticks triggers a scale-up.
+AUTOSCALE_TTFT_P99_SLO_S = "tony.autoscale.ttft-p99-slo-s"
+AUTOSCALE_QUEUE_DEPTH_SLO = "tony.autoscale.queue-depth-slo"
+# replica-count bounds: min is the steady-state floor (the slots above
+# it start PARKED — detached, unlaunched); max 0 = the role's instances
+AUTOSCALE_MIN = "tony.autoscale.min"
+AUTOSCALE_MAX = "tony.autoscale.max"
+# hysteresis: no two scale decisions inside the cooldown, and scale-down
+# additionally needs the signals CLEAR (below half the SLO) for a full
+# cooldown — flapping costs drains, so the loop is deliberately sticky
+AUTOSCALE_COOLDOWN_S = "tony.autoscale.cooldown-s"
+# controller tick cadence (telemetry poll + decision)
+AUTOSCALE_INTERVAL_S = "tony.autoscale.interval-s"
+# consecutive breaching ticks before a scale-up fires (one noisy window
+# must not launch capacity)
+AUTOSCALE_BREACH_TICKS = "tony.autoscale.breach-ticks"
+# optional fleet-router /stats URL merged into the controller's view
+# (the router sees posted-but-unadmitted traffic the replicas' own
+# stats lag on; the two views OVERLAP, so the control law takes their
+# max, never the sum)
+AUTOSCALE_ROUTER_STATS_URL = "tony.autoscale.router-stats-url"
+
+# ------------------------------------------------------------------- quota
+# multi-tenant arbitration (tony_tpu/autoscale.py ResourceArbiter): all
+# roles share one device/slot pool; per-role quotas cap what each may
+# hold, and priority classes decide who yields when the pool is
+# exhausted — `interactive` (serving) capacity demands preempt `batch`
+# (training) workers via the budget-free preempt drain, and batch
+# reclaims the slots when the interactive tier scales back down.
+# 0 = the sum of configured role instances (no oversubscription).
+QUOTA_POOL_SLOTS = "tony.quota.pool-slots"
+
+# ------------------------------------------------------------------ training
+# checkpoint directory of the (elastic) training role, used by the
+# checkpoint-aware rescale placement: a worker relaunched on the
+# capacity-return path gets TONY_PRESTAGE_CKPT so its executor restores
+# (pre-reads) the newest checkpoint BEFORE registering — the gang
+# barrier opens onto a worker whose checkpoint bytes are already local.
+# May reference task env vars (e.g. .../ckpt_$TONY_TASK_INDEX).
+TRAIN_CKPT_DIR = "tony.train.checkpoint-dir"
+
 # ----------------------------------------------------------------- warm pool
 # warm executor pool (tony_tpu/warmpool.py, docs/performance.md "Launch
 # path"): N standby python children per host that have already imported
@@ -221,13 +277,20 @@ ROLE_KEY_TEMPLATES = (
     "env",
     "max-restarts",  # per-task restart budget — exceeds the reference, which
                      # only supports whole-job AM retry (SURVEY.md §5)
+    "framework",     # per-role runtime override (multi-tenant jobs mix
+                     # serving replicas with training workers; "" = the
+                     # app-level tony.application.framework)
+    "priority-class",  # arbiter tier: "interactive" (default) or "batch"
+                       # — batch roles donate capacity to interactive
+                       # ones under pool pressure (docs/autoscaling.md)
+    "quota",         # max pool slots this role may hold (-1 = instances)
 )
 
 _ROLE_KEY_RE = re.compile(r"^tony\.([A-Za-z][A-Za-z0-9_\-]*)\.instances$")
 _RESERVED_NON_ROLES = frozenset(
     {"application", "am", "task", "staging", "history", "cluster", "tpu",
      "security", "execution", "horovod", "version", "serving", "router",
-     "train", "warmpool"}
+     "train", "warmpool", "autoscale", "quota"}
 )
 
 

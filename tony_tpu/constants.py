@@ -42,6 +42,16 @@ ENV_SERVE_PORT = "TONY_SERVE_PORT"  # serving job type (runtimes/serving.py):
                                   # the adapter advertises it as serve_port/
                                   # metrics_port via the publish_ports RPC
 
+ENV_PRESTAGE_CKPT = "TONY_PRESTAGE_CKPT"  # checkpoint-aware rescale
+                                  # placement (docs/autoscaling.md): set on
+                                  # a capacity-return relaunch; the executor
+                                  # restores (pre-reads) the newest
+                                  # checkpoint under this dir BEFORE
+                                  # registering, so the gang barrier opens
+                                  # onto a worker whose checkpoint bytes
+                                  # are already local ($VARs expanded
+                                  # against the task env)
+
 ENV_GANG_GENERATION = "TONY_GANG_GENERATION"  # which gang formation this
                                   # attempt belongs to: bumped by every
                                   # elastic resize (worker lost past its

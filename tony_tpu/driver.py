@@ -39,12 +39,22 @@ from .events.types import (
 )
 from .events.driver_journal import DriverJournal, DriverState, load_state
 from .metrics import (
+    DRIVER_AUTOSCALE_QUEUE_DEPTH,
+    DRIVER_AUTOSCALE_REPLICAS,
+    DRIVER_AUTOSCALE_SCALE_DOWNS_TOTAL,
+    DRIVER_AUTOSCALE_SCALE_UPS_TOTAL,
+    DRIVER_AUTOSCALE_TTFT_P99_S,
     DRIVER_CHECKPOINT_AGE_S,
     DRIVER_GANG_LAUNCH_SECONDS,
     DRIVER_GANG_RESIZES_TOTAL,
     DRIVER_HEARTBEAT_EXPIRED_TOTAL,
     DRIVER_HEARTBEAT_INTERVAL_SECONDS,
     DRIVER_PREEMPTIONS_TOTAL,
+    DRIVER_QUOTA_DONATIONS_TOTAL,
+    DRIVER_QUOTA_POOL_FREE,
+    DRIVER_QUOTA_POOL_SLOTS,
+    DRIVER_QUOTA_RECLAIMS_TOTAL,
+    DRIVER_QUOTA_SLOTS,
     DRIVER_RECOVERIES_TOTAL,
     DRIVER_STRAGGLER_HEARTBEAT_S,
     DRIVER_STRAGGLER_REGISTRATION_S,
@@ -463,6 +473,56 @@ class Driver:
             1, conf.get_int(keys.TRAIN_STRAGGLER_GRACE_CHECKS, 3))
         self._straggler_strikes: dict[str, int] = {}
         self._straggler_check_t = 0.0
+        # ---- closed-loop autoscaler + multi-tenant arbiter ----
+        # (tony_tpu/autoscale.py, docs/autoscaling.md). Ledger
+        # discipline mirrors rolls/preempts/resizes: _parked = slots
+        # the autoscaler holds detached (only a scale-up relaunches
+        # them — the elastic rescale timer must skip them);
+        # _scale_downs = replicas mid-scale-down drain (their
+        # completion PARKS the slot instead of relaunching);
+        # _donations = batch workers mid-donation drain (their
+        # completion detaches the slot, freeing pool capacity for the
+        # interactive tier); _donated = donated slots awaiting reclaim
+        # (the rescale timer re-attaches them only once the arbiter
+        # has free capacity again).
+        from .autoscale import ResourceArbiter
+
+        self._autoscale_enabled = conf.get_bool(keys.AUTOSCALE_ENABLED,
+                                                False)
+        roles_sorted = sorted(self.session.role_specs)
+        self._autoscale_role = str(
+            conf.get(keys.AUTOSCALE_ROLE, "") or "") or (
+            roles_sorted[0] if len(roles_sorted) == 1 else "")
+        self.arbiter = ResourceArbiter(
+            self.session,
+            pool_slots=conf.get_int(keys.QUOTA_POOL_SLOTS, 0))
+        self._parked: set[str] = set()
+        self._scale_downs: set[str] = set()
+        self._donations: dict[str, str] = {}
+        # donor -> the SLO breach that motivated the donation (transient
+        # display state; a recovered driver falls back to a synthesized
+        # reason when the discharge lands post-recovery)
+        self._donation_reasons: dict[str, str] = {}
+        self._donated: set[str] = set()
+        self._scale_up_count = 0
+        self._scale_down_count = 0
+        self._autoscale_runner = None
+        self._controller = None
+        self._recovered_scale_t: float | None = None
+        if self._autoscale_enabled and self._autoscale_role:
+            spec = self.session.role_specs.get(self._autoscale_role)
+            n_min = max(0, conf.get_int(keys.AUTOSCALE_MIN, 1))
+            if spec is not None and n_min < spec.instances:
+                # slots above the floor start PARKED: detached (never
+                # launched, invisible to barrier/completion policy)
+                # until a scale-up decision claims one. Recovery
+                # overwrites this from the journal (restore_formation
+                # replaces the detached set wholesale).
+                for task in self.session.tasks.get(self._autoscale_role,
+                                                   []):
+                    if task.index >= n_min:
+                        self.session.detach_task(task.task_id)
+                        self._parked.add(task.task_id)
         # seeded driver chaos (TONY_TEST_DRIVER_*, constants.py) — the
         # cluster-side mirror of the serving chaos knobs; read once so a
         # run's fault sequence is reproducible from the seed
@@ -578,6 +638,14 @@ class Driver:
             self._jrec("recovered",
                        driver_generation=self.driver_generation,
                        t=time.time())
+        elif self._parked:
+            # fresh job: the pre-parked autoscale slots must be
+            # recoverable facts, not re-derived config (a recovered
+            # driver replays detached+parked wholesale)
+            for task_id in sorted(self._parked):
+                self._jrec("detach", task=task_id)
+                self._jrec("park", task=task_id)
+        self._start_autoscaler()
         # seed the warm pool on THIS host for local capacity: standbys
         # prepay the jax/backend bill while the first gang launches, so
         # even the first relaunch adopts. Remote hosts seed their own
@@ -685,6 +753,10 @@ class Driver:
         for index in range(spec.instances):
             task = self.session.get_task(spec.name, index)
             if task is None or task.status.is_terminal():
+                continue
+            if task.task_id in self.session.detached:
+                # a PARKED autoscale slot (or a journaled detach): only
+                # a scale-up decision / capacity return launches it
                 continue
             task.status = TaskStatus.REQUESTED
             self._trace_mark(task.task_id, "requested", role=spec.name)
@@ -1114,6 +1186,14 @@ class Driver:
             r.counter(DRIVER_TASKS_READOPTED_TOTAL, self._readopted,
                       "live tasks a recovered driver re-adopted "
                       "(heartbeats re-attached) instead of relaunching")
+            r.counter(DRIVER_AUTOSCALE_SCALE_UPS_TOTAL,
+                      self._scale_up_count,
+                      "autoscaler scale-up decisions actuated (parked "
+                      "replica slots relaunched)")
+            r.counter(DRIVER_AUTOSCALE_SCALE_DOWNS_TOTAL,
+                      self._scale_down_count,
+                      "autoscaler scale-down decisions actuated "
+                      "(replicas SIGTERM-drained, slots parked)")
             reg = dict(self._reg_t)
         from .warmpool import count_ready
 
@@ -1137,6 +1217,46 @@ class Driver:
                     "XLA backend compile duration in the driver process")
         r.counter("driver_xla_compiles_total", comp["compiles"],
                   "XLA backend compilations in the driver process")
+        # autoscaler view + shared-pool quota accounting (docs/
+        # autoscaling.md): rendered whenever the arbiter exists (always)
+        # so the pool is scrapeable even before the first decision
+        snap = self.arbiter.snapshot()
+        r.gauge(DRIVER_QUOTA_POOL_SLOTS, snap["pool_slots"],
+                "the shared device/slot pool every role draws from")
+        r.gauge(DRIVER_QUOTA_POOL_FREE, snap["free"],
+                "pool slots no role currently holds")
+        for role_name in snap["held"]:
+            for stat, val in (("held", snap["held"][role_name]),
+                              ("quota", snap["quota"][role_name])):
+                r.gauge(DRIVER_QUOTA_SLOTS, val,
+                        "per-role pool occupancy vs quota",
+                        labels={"role": role_name, "stat": stat})
+        r.counter(DRIVER_QUOTA_DONATIONS_TOTAL, self.arbiter.donations,
+                  "batch workers preempt-drained to free pool slots "
+                  "for the interactive tier")
+        r.counter(DRIVER_QUOTA_RECLAIMS_TOTAL, self.arbiter.reclaims,
+                  "donated slots returned to the batch tier after the "
+                  "interactive tier scaled back down")
+        ctl = self._controller
+        if ctl is not None:
+            role = self._autoscale_role
+            for stat, val in (("current", self.arbiter.held(role)),
+                              ("min", ctl.min_replicas),
+                              ("max", ctl.max_replicas)):
+                r.gauge(DRIVER_AUTOSCALE_REPLICAS, val,
+                        "the autoscaled serving role's replica count "
+                        "and bounds",
+                        labels={"role": role, "stat": stat})
+            obs = ctl.last_obs
+            r.gauge(DRIVER_AUTOSCALE_TTFT_P99_S,
+                    round(obs.ttft_p99_s or 0.0, 6),
+                    "newest WINDOWED fleet TTFT p99 the controller "
+                    "observed (0 = no completions in the window)")
+            r.gauge(DRIVER_AUTOSCALE_QUEUE_DEPTH,
+                    max(obs.queued, obs.router_queued or 0),
+                    "newest queued-request signal the controller "
+                    "observed (max of the replica /stats view and the "
+                    "router view — they overlap, never summed)")
         counts: dict[str, int] = {}
         for t in self.session.all_tasks():
             counts[t.status.value] = counts.get(t.status.value, 0) + 1
@@ -1268,6 +1388,10 @@ class Driver:
             # Failures then fall through to the budgeted path, and a
             # budget-exhausted loss tries the elastic resize before the
             # completion policy gets to fail the job.
+            if self._discharge_scale_down(task_id):
+                return
+            if self._discharge_donation(task_id):
+                return
             if self._discharge_roll(task_id):
                 return
             if self._discharge_resize(task_id):
@@ -1275,6 +1399,10 @@ class Driver:
             if self._discharge_preempt(task_id, exit_code):
                 return
             if exit_code != 0 and self._try_restart_task(task_id, exit_code):
+                return
+            if (exit_code != 0
+                    and self._park_failed_replica(
+                        task_id, cause=f"exited {exit_code}")):
                 return
             if (exit_code != 0 and self._elastic_candidate(task_id)
                     and self._resize_down(task_id,
@@ -1355,9 +1483,12 @@ class Driver:
                    t=time.time(),
                    log_path=str(handle.extra.get("log_path", "")))
 
-    def _relaunch_task(self, task_id: str, spec: RoleSpec, idx: int) -> None:
+    def _relaunch_task(self, task_id: str, spec: RoleSpec, idx: int,
+                       extra_env: dict[str, str] | None = None) -> None:
         """Launch a fresh attempt of an existing task (restart or roll):
-        new container, fresh liveness, stale published ports dropped."""
+        new container, fresh liveness, stale published ports dropped.
+        ``extra_env`` rides this attempt only (e.g. the rescale path's
+        TONY_PRESTAGE_CKPT)."""
         task = self.session.get_task_by_id(task_id)
         task.status = TaskStatus.REQUESTED
         task.exit_code = None  # re-arm heartbeat liveness for the new attempt
@@ -1375,6 +1506,8 @@ class Driver:
         task.launch_path = ""   # the NEW attempt reports its own path
         self._trace_mark(task_id, "requested")
         env = self._task_env(spec, idx)
+        if extra_env:
+            env.update(extra_env)
         env[c.ENV_TASK_ATTEMPT] = str(self._bump_attempt(task_id))
         # same launch/handle atomicity as _request_role (reentrant: the
         # discharge paths already hold the lock)
@@ -1454,6 +1587,363 @@ class Driver:
         self._clear_attempt_state(task_id)
         self._trace_mark(task_id, "rolled")
         self._relaunch_task(task_id, spec, int(idx))
+        return True
+
+    # ---------------------------------------- autoscaler + resource arbiter
+    def _role_class(self, role: str) -> str:
+        spec = self.session.role_specs.get(role)
+        return getattr(spec, "priority_class", "interactive") \
+            if spec is not None else "interactive"
+
+    def _start_autoscaler(self) -> None:
+        """Start the driver-resident autoscale loop (prepare(); no-op
+        when disabled). The controller's cooldown clock resumes from
+        the journal's newest scale decision, so a recovered driver
+        continues mid-cooldown instead of flapping."""
+        if not self._autoscale_enabled or not self._autoscale_role:
+            return
+        if self._autoscale_runner is not None:
+            return
+        from .autoscale import AutoscaleController, AutoscaleRunner
+
+        controller = AutoscaleController.from_conf(
+            self.conf, last_scale_t=self._recovered_scale_t)
+        if self.conf.get_int(keys.AUTOSCALE_MAX, 0) <= 0:
+            spec = self.session.role_specs.get(self._autoscale_role)
+            controller.max_replicas = max(
+                controller.min_replicas,
+                spec.instances if spec is not None else 1)
+        self._controller = controller
+        self._autoscale_runner = AutoscaleRunner(
+            self, controller,
+            router_stats_url=str(
+                self.conf.get(keys.AUTOSCALE_ROUTER_STATS_URL, "") or ""))
+        self._autoscale_runner.start()
+        log.info(
+            "autoscaler on for role %s: min=%d max=%d ttft_slo=%ss "
+            "queue_slo=%s cooldown=%ss pool=%d slots",
+            self._autoscale_role, controller.min_replicas,
+            controller.max_replicas, controller.ttft_slo_s,
+            controller.queue_slo, controller.cooldown_s,
+            self.arbiter.pool_slots)
+
+    def serving_endpoints(self, role: str) -> list[tuple[str, str, int]]:
+        """The role's live serving endpoints: RUNNING, non-detached
+        tasks that published a ``serve_port`` — the controller's
+        telemetry targets (same filter as the router's discovery)."""
+        out = []
+        for task in self.session.tasks.get(role, []):
+            if task.task_id in self.session.detached:
+                continue
+            if task.status != TaskStatus.RUNNING:
+                continue
+            port = task.ports.get("serve_port")
+            if not port:
+                continue
+            out.append((task.task_id, task.host or "127.0.0.1", int(port)))
+        return out
+
+    def autoscale_tick(self, controller, watcher,
+                       router_stats_url: str = "") -> str:
+        """One controller tick: observe the fleet, evaluate the control
+        law, actuate. Returns a status string (telemetry/testing):
+        "idle" (no decision), "scaled_up"/"scaled_down" (actuated),
+        "awaiting_donation" (capacity requested from the batch tier,
+        drain in flight), "no_capacity"/"quota"/"at_max" (denied)."""
+        role = self._autoscale_role
+        if not role or self._stop_requested.is_set():
+            return "idle"
+        obs = watcher.observe(self.serving_endpoints(role),
+                              router_stats_url)
+        with self._restart_lock:
+            draining = sum(1 for t in self._scale_downs
+                           if t.partition(":")[0] == role)
+        # the control law sees the POST-drain fleet size: a replica
+        # mid-scale-down-drain still counts as RUNNING in the session
+        # table, and counting it would let a second scale-down fire
+        # past the cooldown while the first drain is in flight —
+        # draining the whole fleet
+        decision = controller.decide(obs,
+                                     self.arbiter.held(role) - draining)
+        if decision is None:
+            return "idle"
+        if decision.direction == "up":
+            status = self._autoscale_scale_up(decision.reason)
+            if status == "scaled":
+                controller.note_scaled("up")
+                return "scaled_up"
+            if status == "launch_failed":
+                # arm the cooldown anyway: a persistent provisioner
+                # failure must not journal a fresh "up" op every tick
+                controller.note_scaled("up")
+            return status
+        victim = self._pick_scale_down_victim(role, watcher.last_loads)
+        if victim is not None and self._autoscale_scale_down(
+                victim, decision.reason):
+            controller.note_scaled("down")
+            return "scaled_down"
+        return "idle"
+
+    def _pick_scale_down_victim(self, role: str,
+                                loads: dict) -> str | None:
+        """The least-loaded RUNNING replica (instantaneous queued +
+        active from the watcher's newest poll; unknown load sorts
+        first — an unpolled replica is at worst idle), highest index on
+        ties so the fleet shrinks from the top like it grew."""
+        candidates = [
+            t for t in self.session.tasks.get(role, [])
+            if t.task_id not in self.session.detached
+            and t.status == TaskStatus.RUNNING
+            and t.task_id not in self._scale_downs
+            and t.task_id not in self._rolls]
+        if not candidates:
+            return None
+        return min(candidates,
+                   key=lambda t: (loads.get(t.task_id, 0), -t.index)).task_id
+
+    def _autoscale_scale_up(self, reason: str) -> str:
+        """Claim a parked slot for the serving role. When the pool is
+        exhausted, ask the arbiter for a batch donor and preempt-drain
+        it (budget-free, checkpoint at the step boundary); the actual
+        launch happens on a later tick, once the donation's completion
+        has freed the slot — the controller keeps its cooldown unarmed
+        until then."""
+        role = self._autoscale_role
+        spec = self.session.role_specs.get(role)
+        if spec is None:
+            return "no_role"
+        with self._restart_lock:
+            if self._stop_requested.is_set():
+                return "stopped"
+            parked = sorted(
+                (t for t in self.session.tasks.get(role, [])
+                 if t.task_id in self._parked
+                 and t.task_id in self.session.detached),
+                key=lambda t: t.index)
+            if not parked:
+                return "at_max"
+            if not self.arbiter.can_grant(role):
+                if self.arbiter.over_quota(role):
+                    return "quota"
+                if role in self._donations.values():
+                    # a donation drain is already in flight for this
+                    # role; its discharge hands the slot over directly
+                    return "awaiting_donation"
+                busy = (set(self._donations) | self._resizes
+                        | self._rolls | self._preempts
+                        | self._scale_downs)
+                donor = self.arbiter.pick_donor(
+                    role, elastic_min=self._elastic_min, busy=busy)
+                if donor is None:
+                    log.warning(
+                        "autoscale: %s wants capacity (%s) but the pool "
+                        "is exhausted and no batch donor qualifies",
+                        role, reason)
+                    return "no_capacity"
+                if self._initiate_donation(donor, role, reason):
+                    return "awaiting_donation"
+                return "no_capacity"
+            task = parked[0]
+            task_id = task.task_id
+            self.session.reattach_task(task_id)
+            self._parked.discard(task_id)
+            self._detach_t.pop(task_id, None)
+            self._jrec("reattach", task=task_id)
+            self._jrec("unpark", task=task_id)
+            # the decision ledger: journaled BEFORE the launch so a
+            # driver killed mid-actuation recovers the cooldown clock
+            self._jrec("scale", dir="up", task=task_id, t=time.time(),
+                       reason=reason)
+            with self._tt_lock:
+                self._scale_up_count += 1
+            self._clear_attempt_state(task_id)
+            self._trace_mark(task_id, "scaled_up", scale_reason=reason)
+            log.warning("autoscale: scaling %s UP via %s (%s)", role,
+                        task_id, reason)
+            try:
+                self._relaunch_task(task_id, spec, task.index)
+            except Exception:
+                # capacity vanished between grant and launch (the
+                # _try_rescale_up contract): RE-PARK the slot so the
+                # arbiter doesn't count a handle-less task as a live
+                # replica forever; the journaled decision keeps the
+                # cooldown armed, and the floor rule / next breach
+                # retries after it
+                log.exception("autoscale: launch of %s failed; "
+                              "re-parking the slot", task_id)
+                self.session.detach_task(task_id)
+                self._parked.add(task_id)
+                self._jrec("detach", task=task_id)
+                self._jrec("park", task=task_id)
+                return "launch_failed"
+        return "scaled"
+
+    def _autoscale_scale_down(self, task_id: str, reason: str) -> bool:
+        """SIGTERM-drain one replica (the serve child finishes its
+        in-flight requests on the group signal — the roll path's drain
+        contract); its completion PARKS the slot instead of
+        relaunching. Zero dropped requests by construction: in-flight
+        work drains, queued work fails over through the router's
+        journal/progress machinery."""
+        task = self.session.get_task_by_id(task_id)
+        if task is None or task.status != TaskStatus.RUNNING:
+            return False
+        with self._restart_lock:
+            if (task_id in self._scale_downs or task_id in self._rolls
+                    or task_id in self._resizes):
+                return False
+            handle = self._handles.get(task_id)
+            if handle is None:
+                return False
+            self._scale_downs.add(task_id)
+        self._jrec("ledger", kind="scale_down", task=task_id)
+        self._jrec("scale", dir="down", task=task_id, t=time.time(),
+                   reason=reason)
+        with self._tt_lock:
+            self._scale_down_count += 1
+        log.warning("autoscale: scaling DOWN — draining %s (%s)",
+                    task_id, reason)
+        threading.Thread(target=self.provisioner.stop_container,
+                         args=(handle,), name=f"scale-down-{task_id}",
+                         daemon=True).start()
+        return True
+
+    def _discharge_scale_down(self, task_id: str) -> bool:
+        """Container completion of a replica mid-scale-down drain: park
+        the slot (detached, ports cleared so discovery drops the dead
+        endpoint) instead of relaunching. Caller holds the restart
+        lock."""
+        if task_id not in self._scale_downs:
+            return False
+        self._scale_downs.discard(task_id)
+        task = self.session.get_task_by_id(task_id)
+        self.session.detach_task(task_id)
+        self._parked.add(task_id)
+        self._handles.pop(task_id, None)
+        self.heartbeats.pop(task_id, None)
+        if task is not None:
+            task.ports.clear()
+        self._jrec("detach", task=task_id)
+        self._jrec("park", task=task_id)
+        self._trace_mark(task_id, "scaled_down")
+        log.info("autoscale: %s drained; slot parked", task_id)
+        return True
+
+    def _park_failed_replica(self, task_id: str, cause: str) -> bool:
+        """A budget-exhausted autoscaled replica parks (the controller
+        relaunches it on its floor rule / next breach) instead of
+        failing the whole multi-tenant job. Caller holds the restart
+        lock (or no thread races: expiry path)."""
+        if (not self._autoscale_enabled
+                or task_id.partition(":")[0] != self._autoscale_role
+                or self._stop_requested.is_set()):
+            return False
+        with self._restart_lock:
+            task = self.session.get_task_by_id(task_id)
+            if task is None or task.task_id in self.session.detached:
+                return False
+            self.session.detach_task(task_id)
+            self._parked.add(task_id)
+            self._handles.pop(task_id, None)
+            self.heartbeats.pop(task_id, None)
+            task.ports.clear()
+        self._jrec("detach", task=task_id)
+        self._jrec("park", task=task_id)
+        self._trace_mark(task_id, "scaled_down", cause=cause)
+        log.warning("autoscale: %s lost past its budget (%s); slot "
+                    "parked for the controller", task_id, cause)
+        return True
+
+    def _initiate_donation(self, donor: str, for_role: str,
+                           reason: str) -> bool:
+        """Preempt-drain a batch worker so its slot can serve the
+        interactive tier: the PR 9 drain contract (checkpoint at the
+        step boundary, budget-free), but the completion DETACHES the
+        slot (``_discharge_donation``) instead of relaunching. Caller
+        holds the restart lock (reentrant)."""
+        if donor in self._donations:
+            return True
+        if not self.preempt_task(donor):
+            return False
+        self._donations[donor] = for_role
+        self._donation_reasons[donor] = reason
+        self._jrec("donate", task=donor, **{"for": for_role})
+        log.warning(
+            "arbiter: preempt-draining batch worker %s to donate its "
+            "slot to %s (%s)", donor, for_role, reason)
+        return True
+
+    def _discharge_donation(self, task_id: str) -> bool:
+        """Container completion of a donating batch worker: detach the
+        slot (freeing pool capacity for the interactive tier), re-form
+        the donor's gang at the smaller world size (same-class
+        survivors drain budget-free, exactly like a resize), and arm
+        the reclaim timer — gated on arbiter free capacity, so the
+        slot returns only when serving scales back down. Caller holds
+        the restart lock."""
+        if task_id not in self._donations:
+            return False
+        for_role = self._donations.pop(task_id)
+        self._preempts.discard(task_id)
+        self._preempt_cmds.discard(task_id)
+        if not self.session.detach_task(task_id):
+            return False
+        self._donated.add(task_id)
+        self._handles.pop(task_id, None)
+        self.heartbeats.pop(task_id, None)
+        self._detach_t[task_id] = time.monotonic()
+        gen = self.session.begin_generation()
+        with self._tt_lock:
+            self._resize_count += 1
+        self.arbiter.donations += 1
+        cls = self._role_class(task_id.partition(":")[0])
+        survivors = [
+            t.task_id for t in self.session.active_tasks()
+            if t.status == TaskStatus.RUNNING and t.task_id != task_id
+            and self._role_class(t.name) == cls]
+        handles = []
+        for tid in survivors:
+            self._resizes.add(tid)
+            self.heartbeats.pop(tid, None)
+            h = self._handles.get(tid)
+            if h is not None:
+                handles.append(h)
+        self._straggler_strikes.clear()
+        self._jrec("detach", task=task_id)
+        self._jrec("donated", task=task_id)
+        self._jrec("generation", gen=gen)
+        for tid in survivors:
+            self._jrec("ledger", kind="resize", task=tid)
+        self._trace_mark(task_id, "donated", gang_generation=gen,
+                         donated_to=for_role)
+        for tid in survivors:
+            self._trace_mark(tid, "resized", gang_generation=gen,
+                             donated=task_id)
+            self.metrics.pop(tid, None)
+        log.warning(
+            "arbiter: %s donated its slot to %s (gang generation %d; "
+            "%d survivors re-forming)", task_id, for_role, gen,
+            len(survivors))
+        for h in handles:
+            threading.Thread(target=self.provisioner.stop_container,
+                             args=(h,), name=f"donate-drain-{h.role}",
+                             daemon=True).start()
+        # hand the freed slot STRAIGHT to the role the donation was for:
+        # waiting for the next controller tick opens a race where the
+        # (faster) elastic rescale-retry timer sees free capacity and
+        # snatches the slot back for the batch tier — the observed
+        # donate->reclaim->donate livelock. The restart lock is
+        # reentrant; _autoscale_scale_up finds free() >= 1 and claims a
+        # parked slot, and the controller's cooldown arms at the REAL
+        # actuation instant.
+        reason = self._donation_reasons.pop(
+            task_id, f"slot donated by {task_id}")
+        status = self._autoscale_scale_up(reason)
+        if status in ("scaled", "launch_failed") \
+                and self._controller is not None:
+            # launch_failed arms the cooldown too (the slot re-parked;
+            # retry rides the floor rule / next breach, not a tight loop)
+            self._controller.note_scaled("up")
         return True
 
     # -------------------------------------------------- preemption drain
@@ -1598,9 +2088,15 @@ class Driver:
             gen = self.session.begin_generation()
             with self._tt_lock:
                 self._resize_count += 1
+            # the gang that re-forms is the lost task's TIER: in a
+            # multi-tenant job (batch trainers + interactive serving
+            # replicas sharing the pool, docs/autoscaling.md), a
+            # trainer's resize must not drain the serving fleet
+            cls = self._role_class(task_id.partition(":")[0])
             survivors = [
                 t.task_id for t in self.session.active_tasks()
                 if t.status == TaskStatus.RUNNING and t.task_id != task_id
+                and self._role_class(t.name) == cls
             ]
             handles = []
             for tid in survivors:
@@ -1672,9 +2168,18 @@ class Driver:
         now = time.monotonic()
         candidate = None
         for task_id, t0 in self._detach_t.items():
-            if now - t0 >= self._rescale_retry_s:
-                candidate = task_id
-                break
+            if now - t0 < self._rescale_retry_s:
+                continue
+            if task_id in self._parked:
+                # an autoscaler-parked slot is the CONTROLLER's to
+                # relaunch, never the rescale timer's
+                continue
+            if task_id in self._donated and self.arbiter.free() < 1:
+                # a donated slot returns only once the interactive
+                # tier has scaled back down and freed pool capacity
+                continue
+            candidate = task_id
+            break
         if candidate is None:
             return
         task_id = candidate
@@ -1695,9 +2200,16 @@ class Driver:
             # the returned slot is fresh capacity: its crash-loop budget
             # starts over (the spent budget belonged to the lost host)
             self._restarts.pop(task_id, None)
+            reclaimed = task_id in self._donated
+            if reclaimed:
+                self._donated.discard(task_id)
+                self.arbiter.reclaims += 1
+                self._jrec("reclaimed", task=task_id)
+            cls = self._role_class(task_id.partition(":")[0])
             survivors = [
                 t.task_id for t in self.session.active_tasks()
                 if t.status == TaskStatus.RUNNING and t.task_id != task_id
+                and self._role_class(t.name) == cls
             ]
             handles = []
             for tid in survivors:
@@ -1717,13 +2229,28 @@ class Driver:
             gen, task_id, len(survivors))
         self._trace_mark(task_id, "resized", gang_generation=gen,
                          resize="rejoined")
+        if reclaimed:
+            # the arbiter's capacity-return path: the batch tier gets
+            # its donated slot back now that serving has scaled down
+            self._trace_mark(task_id, "reclaimed", gang_generation=gen)
+            log.warning("arbiter: reclaiming donated slot %s for the "
+                        "batch tier", task_id)
         for tid in survivors:
             self._trace_mark(tid, "resized", gang_generation=gen,
                              resize="up", rejoined=task_id)
             self.metrics.pop(tid, None)
+        # checkpoint-aware rescale placement (docs/autoscaling.md): the
+        # returning worker restores (pre-reads) the newest checkpoint
+        # BEFORE registering, so the re-formed gang's barrier opens
+        # onto a worker whose checkpoint bytes are already local
+        extra_env = {}
+        ckpt_dir = str(self.conf.get(keys.TRAIN_CKPT_DIR, "") or "")
+        if ckpt_dir:
+            extra_env[c.ENV_PRESTAGE_CKPT] = ckpt_dir
         try:
             with self._restart_lock:
-                self._relaunch_task(task_id, spec, int(idx))
+                self._relaunch_task(task_id, spec, int(idx),
+                                    extra_env=extra_env)
         except Exception as e:
             # capacity still gone: fall back to the smaller formation —
             # survivors are already draining and will re-register into
@@ -1733,6 +2260,12 @@ class Driver:
             with self._restart_lock:
                 self.session.detach_task(task_id)
                 self._detach_t[task_id] = time.monotonic()
+                if reclaimed:
+                    # the slot is still donated capacity: future
+                    # retries stay gated on arbiter free slots
+                    self._donated.add(task_id)
+                    self.arbiter.reclaims -= 1
+                    self._jrec("donated", task=task_id)
             self._jrec("detach", task=task_id)
         for h in handles:
             threading.Thread(target=self.provisioner.stop_container,
@@ -1811,6 +2344,20 @@ class Driver:
                     # budget on the collision
                     if old is not None:
                         self.provisioner.stop_container(old)
+                    with self._restart_lock:
+                        # the expiry IS the drain completing: an ADOPTED
+                        # task's executor exits on the group SIGTERM
+                        # without a watcher or a result RPC, so expiry is
+                        # the only signal the driver gets. A scale-down
+                        # victim parks and a donation's slot frees,
+                        # budget-free, instead of burning a restart unit
+                        # relaunching what was just drained (a stale
+                        # donation ledger would also wedge every future
+                        # scale-up at "awaiting_donation").
+                        if self._discharge_scale_down(task_id):
+                            continue
+                        if self._discharge_donation(task_id):
+                            continue
                     restarted = (
                         not self._stop_requested.is_set()
                         and self._try_restart_task(
@@ -1818,6 +2365,11 @@ class Driver:
                             cause=f"missed {max_missed} heartbeats")
                     )
                     if restarted:
+                        continue
+                    # an autoscaled replica lost past its budget parks
+                    # (the controller's floor rule relaunches it) —
+                    # one bad replica must not fail the tenant pool
+                    if self._park_failed_replica(task_id, cause=msg):
                         continue
                     # budget spent (or none configured): an elastic job
                     # re-forms the gang from the survivors instead of
@@ -2067,7 +2619,8 @@ class Driver:
     # ------------------------------------------------- control-plane recovery
     @classmethod
     def recover(cls, job_dir: str, provisioner: Provisioner | None = None,
-                app_id: str = "") -> "Driver":
+                app_id: str = "",
+                conf_overrides: dict | None = None) -> "Driver":
         """Build a replacement driver from a dead one's journal — the
         reproduction of YARN AM restart with
         ``keep-containers-across-application-attempts``: replay
@@ -2093,6 +2646,8 @@ class Driver:
             raise RuntimeError(
                 f"journal belongs to {state.app_id}, not {app_id}")
         conf = TonyConf.from_final(str(job_dir))
+        for k, v in (conf_overrides or {}).items():
+            conf.set(k, v)
         driver = cls(conf, app_id=state.app_id, job_dir=str(job_dir),
                      token=state.token, provisioner=provisioner,
                      rpc_port=state.rpc_port)
@@ -2127,6 +2682,19 @@ class Driver:
         self._preempt_cmds = set(state.preempt_cmds)
         self._rolls = set(state.rolls)
         self._resizes = set(state.resizes)
+        # autoscaler/arbiter ledgers: parked slots stay the controller's,
+        # mid-drain scale-downs/donations discharge on their completions,
+        # donated slots stay gated on arbiter free capacity, and the
+        # decision ledger's newest timestamp resumes the cooldown (a
+        # recovered driver must not flap a decision its predecessor
+        # just made)
+        self._parked = set(state.parked)
+        self._scale_downs = set(state.scale_downs)
+        self._donations = dict(state.donations)
+        self._donated = set(state.donated)
+        if state.scale_ops:
+            self._recovered_scale_t = max(
+                float(op.get("t", 0.0) or 0.0) for op in state.scale_ops)
         now = time.time()
         hb_expiry_s = (self.conf.get_int(keys.TASK_HEARTBEAT_INTERVAL_MS,
                                          1000)
@@ -2154,8 +2722,11 @@ class Driver:
                 task.url = rec.log_path
             if task_id in state.detached:
                 # a detached slot stays detached; the rescale timer
-                # re-arms so capacity retries resume on schedule
-                self._detach_t[task_id] = time.monotonic()
+                # re-arms so capacity retries resume on schedule —
+                # except autoscaler-PARKED slots, which only a scale-up
+                # decision relaunches
+                if task_id not in state.parked:
+                    self._detach_t[task_id] = time.monotonic()
                 continue
             if rec.registered:
                 self.session.register_task(task_id, rec.reg_host,
@@ -2216,6 +2787,22 @@ class Driver:
                 log.warning("journaled pid %d of %s is dead; routing "
                             "through the expiry/restart path", rec.pid,
                             task_id)
+        # a scale-down journaled but not yet drained when the old driver
+        # died must be RE-ACTUATED: the re-adopted replica keeps serving
+        # and heartbeating, so neither completion nor expiry would ever
+        # discharge the ledger — the journaled "down" decision would
+        # silently never take effect (and `draining` would offset the
+        # control law's n_running for the rest of the job)
+        for task_id in sorted(self._scale_downs):
+            handle = self._handles.get(task_id)
+            if handle is None:
+                continue
+            log.warning("resuming interrupted scale-down drain of %s",
+                        task_id)
+            threading.Thread(target=self.provisioner.stop_container,
+                             args=(handle,),
+                             name=f"scale-down-resume-{task_id}",
+                             daemon=True).start()
         log.warning("recovered control plane of %s as driver generation "
                     "%d: %d task(s) re-adopted, %d restart unit(s) "
                     "already spent", self.app_id, self.driver_generation,
@@ -2270,12 +2857,31 @@ class Driver:
         self._driver_stops.clear()
         self._straggler_strikes.clear()
         self.metrics.clear()
+        # autoscaler/arbiter state follows the session: re-point the
+        # arbiter at the fresh task table and re-park the slots above
+        # the autoscale floor (journaled like a fresh prepare)
+        self._scale_downs.clear()
+        self._donations.clear()
+        self._donation_reasons.clear()
+        self._donated.clear()
+        self._parked.clear()
+        self.arbiter.session = self.session
+        if self._autoscale_enabled and self._autoscale_role:
+            n_min = max(0, self.conf.get_int(keys.AUTOSCALE_MIN, 1))
+            for task in self.session.tasks.get(self._autoscale_role, []):
+                if task.index >= n_min:
+                    self.session.detach_task(task.task_id)
+                    self._parked.add(task.task_id)
+                    self._jrec("detach", task=task.task_id)
+                    self._jrec("park", task=task.task_id)
 
     # ------------------------------------------------------------------ stop
     def stop(self) -> None:
         """Reference stop:739-781: stop containers, wait briefly for the
         client's finish signal so it can read terminal state, then tear down."""
         status = self.session.status
+        if self._autoscale_runner is not None:
+            self._autoscale_runner.shutdown()
         self.provisioner.stop_all()
         # reap the warm pool AFTER the containers: an adopted child dies
         # with its executor (control-pipe EOF), and idle standbys must
@@ -2336,6 +2942,16 @@ def main(argv: list[str] | None = None) -> int:
              "dead driver's live tasks instead of starting a fresh job "
              "(docs/training-robustness.md 'Control-plane recovery'); "
              "--app-id is then optional and only cross-checked")
+    parser.add_argument(
+        "--no-autoscale", action="store_true",
+        help="run with the closed-loop autoscaler disabled even when "
+             "tony.autoscale.enabled is set (operator override for "
+             "incident debugging; docs/autoscaling.md)")
+    parser.add_argument(
+        "--autoscale-router-url", default="",
+        help="fleet-router /stats URL merged into the autoscale "
+             "controller's telemetry view (overrides "
+             "tony.autoscale.router-stats-url)")
     args = parser.parse_args(argv)
     if not args.recover and not args.app_id:
         parser.error("--app-id is required (unless --recover)")
@@ -2390,12 +3006,21 @@ def main(argv: list[str] | None = None) -> int:
         conf, on_constructing=lambda p: holder.__setitem__("provisioner", p)
     )
     holder["provisioner"] = prov  # non-lifecycle kinds never call back
+    overrides: dict = {}
+    if args.no_autoscale:
+        overrides[keys.AUTOSCALE_ENABLED] = False
+    if args.autoscale_router_url:
+        overrides[keys.AUTOSCALE_ROUTER_STATS_URL] = \
+            args.autoscale_router_url
     if args.recover:
         # auth root + endpoint come from the journal, not the env — the
         # supervisor relaunching a dead driver may not hold the secret
         driver = Driver.recover(args.job_dir, provisioner=prov,
-                                app_id=args.app_id)
+                                app_id=args.app_id,
+                                conf_overrides=overrides)
     else:
+        for k, v in overrides.items():
+            conf.set(k, v)
         driver = Driver(conf, app_id=args.app_id, job_dir=args.job_dir,
                         token=token, provisioner=prov)
     holder["driver"] = driver

@@ -45,6 +45,13 @@ Record vocabulary (one JSON object per line)::
     {"op": "terminal", "task": "worker:0", "status": "SUCCEEDED",
      "exit_code": 0}
     {"op": "recovered", "driver_generation": 1, "t": wall}
+    {"op": "scale", "dir": "up"|"down", "task": "replica:1", "t": wall,
+     "reason": ...}                      # autoscaler decision ledger
+    {"op": "park", "task": "replica:2"} / {"op": "unpark", ...}
+    {"op": "donate", "task": "trainer:1", "for": "replica"}  # pending
+    {"op": "donated", "task": "trainer:1"}   # drain done, slot freed
+    {"op": "reclaimed", "task": "trainer:1"} # capacity returned
+    {"op": "ledger", "kind": "scale_down", "task": "replica:1"}
 
 Replay semantics worth pinning: a ``launch`` op starts a fresh attempt
 — it clears the task's registration, published ports, terminal state,
@@ -112,6 +119,20 @@ class DriverState:
     preempt_cmds: set = field(default_factory=set)
     rolls: set = field(default_factory=set)
     resizes: set = field(default_factory=set)
+    # ---- autoscaler / arbiter state (tony_tpu/autoscale.py) ----
+    # slots the autoscaler PARKED (detached deliberately, relaunched
+    # only by a scale-up decision, never by the elastic rescale timer)
+    parked: set = field(default_factory=set)
+    # replicas mid-scale-down drain (their completion parks the slot)
+    scale_downs: set = field(default_factory=set)
+    # batch tasks mid-donation drain (task -> beneficiary role) and
+    # slots whose donation completed (awaiting reclaim)
+    donations: dict = field(default_factory=dict)
+    donated: set = field(default_factory=set)
+    # the controller's decision ledger (newest last; rewrite keeps the
+    # tail): a recovered driver resumes mid-cooldown from the newest
+    # decision instead of flapping
+    scale_ops: list = field(default_factory=list)
 
     def task(self, task_id: str) -> TaskRecord:
         rec = self.tasks.get(task_id)
@@ -192,8 +213,9 @@ def _apply(state: DriverState, rec: dict) -> None:
         t.ports = {}
         t.status, t.exit_code = "", None
         for ledger in (state.preempts, state.preempt_cmds, state.rolls,
-                       state.resizes):
+                       state.resizes, state.scale_downs):
             ledger.discard(t.task_id)
+        state.donations.pop(t.task_id, None)
     elif op == "register":
         t = state.task(str(rec["task"]))
         t.registered = True
@@ -223,6 +245,8 @@ def _apply(state: DriverState, rec: dict) -> None:
             state.rolls.add(task_id)
         elif kind == "resize":
             state.resizes.add(task_id)
+        elif kind == "scale_down":
+            state.scale_downs.add(task_id)
     elif op == "terminal":
         t = state.task(str(rec["task"]))
         t.status = str(rec.get("status", ""))
@@ -232,6 +256,29 @@ def _apply(state: DriverState, rec: dict) -> None:
         state.recoveries += 1
         state.driver_generation = int(
             rec.get("driver_generation", state.driver_generation))
+    elif op == "scale":
+        state.scale_ops.append(
+            {"dir": str(rec.get("dir", "")), "task": str(rec.get("task", "")),
+             "t": float(rec.get("t", 0.0) or 0.0),
+             "reason": str(rec.get("reason", ""))})
+    elif op == "park":
+        task_id = str(rec["task"])
+        state.parked.add(task_id)
+        # parking IS the scale-down drain's discharge: a parked slot is
+        # definitionally not mid-drain (a stale entry would make a
+        # recovered controller under-count n_running forever and park
+        # the slot budget-free on its next unrelated nonzero exit)
+        state.scale_downs.discard(task_id)
+    elif op == "unpark":
+        state.parked.discard(str(rec["task"]))
+    elif op == "donate":
+        state.donations[str(rec["task"])] = str(rec.get("for", ""))
+    elif op == "donated":
+        task_id = str(rec["task"])
+        state.donations.pop(task_id, None)
+        state.donated.add(task_id)
+    elif op == "reclaimed":
+        state.donated.discard(str(rec["task"]))
     # unknown ops are skipped silently: an older driver reading a newer
     # journal must degrade, not crash
 
@@ -309,6 +356,18 @@ def rewrite_journal(path: str | Path, state: DriverState) -> None:
             w("ledger", kind="roll", task=task_id)
         for task_id in sorted(state.resizes):
             w("ledger", kind="resize", task=task_id)
+        for task_id in sorted(state.scale_downs):
+            w("ledger", kind="scale_down", task=task_id)
+        for task_id in sorted(state.parked):
+            w("park", task=task_id)
+        for task_id in sorted(state.donations):
+            w("donate", task=task_id, **{"for": state.donations[task_id]})
+        for task_id in sorted(state.donated):
+            w("donated", task=task_id)
+        # the decision ledger's tail is enough for cooldown continuity;
+        # an unbounded history would re-accrete across recoveries
+        for op in state.scale_ops[-64:]:
+            w("scale", **op)
         for _ in range(state.recoveries):
             w("recovered", driver_generation=state.driver_generation,
               t=time.time())

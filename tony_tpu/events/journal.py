@@ -30,7 +30,7 @@ journal intact. Record shapes::
 
     {"op": "submit", "id": 3, "prompt": [...], "max_new_tokens": 64,
      "temperature": null, "top_k": null, "cache_prompt": null,
-     "seed": 0, "model": null}
+     "seed": 0, "model": null, "stop": null}
     {"op": "emit", "id": 3, "tokens": [7, 9]}
     {"op": "end", "id": 3}
 
@@ -75,6 +75,14 @@ class JournalEntry:
     # default/only model — every pre-multi-model journal record reads
     # back this way)
     model: str | None = None
+    # per-request stop sequences (list of token-id lists; None = only
+    # the server-wide stop_tokens apply) — replayed so a resumed
+    # continuation honors the same early-stop contract
+    stop: list | None = None
+    # requested top-k logprobs (0 = off): replayed so the continuation
+    # still carries per-token logprobs (the teacher-forced prefix gets
+    # None placeholders — those rows died with the old process)
+    logprobs: int = 0
 
 
 class RequestJournal:
@@ -121,24 +129,30 @@ class RequestJournal:
                temperature=None, top_k=None, cache_prompt=None,
                seed=None, deadline=None,
                emitted: list[int] | None = None,
-               model: str | None = None) -> None:
+               model: str | None = None,
+               stop: list | None = None,
+               logprobs: int = 0) -> None:
         """Open an entry for a newly accepted request. ``emitted``
         pre-seeds the record for resumed requests (router failover /
         journal recovery) so a second failure replays from the full
         known prefix, not just the tokens THIS process produced."""
         prompt = [int(t) for t in prompt]
         emitted = [int(t) for t in (emitted or [])]
+        stop = ([[int(t) for t in seq] for seq in stop]
+                if stop else None)
         entry = JournalEntry(
             id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             temperature=temperature, top_k=top_k, cache_prompt=cache_prompt,
-            seed=seed, emitted=emitted, deadline=deadline, model=model)
+            seed=seed, emitted=emitted, deadline=deadline, model=model,
+            stop=stop, logprobs=int(logprobs or 0))
         with self._lock:
             self._entries[rid] = entry
         self._append({"op": "submit", "id": rid, "prompt": prompt,
                       "max_new_tokens": int(max_new_tokens),
                       "temperature": temperature, "top_k": top_k,
                       "cache_prompt": cache_prompt, "seed": seed,
-                      "model": model})
+                      "model": model, "stop": stop,
+                      "logprobs": int(logprobs or 0)})
         if emitted:
             self._append({"op": "emit", "id": rid, "tokens": emitted})
 
@@ -194,7 +208,9 @@ class RequestJournal:
                              "top_k": e.top_k,
                              "cache_prompt": e.cache_prompt,
                              "seed": e.seed,
-                             "model": e.model}) + "\n")
+                             "model": e.model,
+                             "stop": e.stop,
+                             "logprobs": e.logprobs}) + "\n")
                         if e.emitted:
                             f.write(json.dumps(
                                 {"op": "emit", "id": e.id,
@@ -284,7 +300,9 @@ def read_journal(path: str | Path) -> list[JournalEntry]:
                         top_k=rec.get("top_k"),
                         cache_prompt=rec.get("cache_prompt"),
                         seed=rec.get("seed"),
-                        model=rec.get("model"))
+                        model=rec.get("model"),
+                        stop=rec.get("stop"),
+                        logprobs=int(rec.get("logprobs", 0) or 0))
                 elif op == "emit":
                     entry = entries.get(rid)
                     if entry is not None:

@@ -320,7 +320,12 @@ class Executor:
 
         from .runtimes import get_runtime
 
-        framework = str(self.conf.get(keys.APPLICATION_FRAMEWORK, "jax"))
+        # per-role runtime override (multi-tenant jobs mix serving
+        # replicas with training workers in one session — docs/
+        # autoscaling.md); "" = the app-level framework
+        framework = str(
+            self.conf.get(keys.role_key(self.job_name, "framework"), "")
+            or self.conf.get(keys.APPLICATION_FRAMEWORK, "jax"))
         self.framework = framework
         self.adapter = get_runtime(framework).task_adapter()
         # preemption drain state: the watchdog that enforces the grace
@@ -618,6 +623,33 @@ class Executor:
                 # heartbeat command path
                 log.warning("cannot install SIGTERM drain handler off "
                             "the main thread")
+
+        # checkpoint-aware rescale placement (docs/autoscaling.md): a
+        # capacity-return relaunch carries TONY_PRESTAGE_CKPT — restore
+        # (pre-read) the newest checkpoint BEFORE registering, so the
+        # gang barrier opens onto a worker whose checkpoint bytes are
+        # already local instead of serializing the fetch behind it
+        prestage_dir = os.environ.get(c.ENV_PRESTAGE_CKPT, "")
+        if prestage_dir:
+            try:
+                # NOT tony_tpu.train: its package __init__ imports jax,
+                # which this python -S executor deliberately lacks — a
+                # prestage failure must degrade to a cold restore, never
+                # crash the capacity-return relaunch
+                from .utils.prestage import prestage_checkpoint
+
+                staged = prestage_checkpoint(
+                    os.path.expandvars(prestage_dir))
+            except Exception:
+                log.exception("checkpoint prestage failed; the child "
+                              "restores cold")
+                staged = None
+            if staged is not None:
+                monitor.add_span("ckpt_prestaged")
+                log.info(
+                    "prestaged checkpoint step %s (%d files, %.1f MB) "
+                    "before registration", staged["step"],
+                    staged["files"], staged["bytes"] / 1e6)
 
         payload = self.register_and_get_cluster_spec()
         monitor.start()

@@ -125,6 +125,25 @@ DRIVER_WARM_POOL_MISSES_TOTAL = "driver_warm_pool_misses_total"
 # instead of relaunching — the AM-restart "worker restarts = 0" bound
 DRIVER_RECOVERIES_TOTAL = "driver_recoveries_total"
 DRIVER_TASKS_READOPTED_TOTAL = "driver_tasks_readopted_total"
+# closed-loop autoscaler + multi-tenant arbiter (tony_tpu/autoscale.py,
+# docs/autoscaling.md): controller decisions (scale-ups launch a parked
+# replica slot via warm-pool adoption, scale-downs SIGTERM-drain the
+# least-loaded replica), the replica-count view {stat=current|min|
+# max}, the newest observed control signals, and the shared-pool
+# quota accounting — slots held per role {role,stat=held|quota}, pool
+# free capacity, and the batch->interactive capacity flow (donations =
+# batch workers preempt-drained to free slots for serving, reclaims =
+# donated slots returned when traffic ebbed)
+DRIVER_AUTOSCALE_SCALE_UPS_TOTAL = "driver_autoscale_scale_ups_total"
+DRIVER_AUTOSCALE_SCALE_DOWNS_TOTAL = "driver_autoscale_scale_downs_total"
+DRIVER_AUTOSCALE_REPLICAS = "driver_autoscale_replicas"
+DRIVER_AUTOSCALE_TTFT_P99_S = "driver_autoscale_ttft_p99_s"
+DRIVER_AUTOSCALE_QUEUE_DEPTH = "driver_autoscale_queue_depth"
+DRIVER_QUOTA_POOL_SLOTS = "driver_quota_pool_slots"
+DRIVER_QUOTA_POOL_FREE = "driver_quota_pool_free"
+DRIVER_QUOTA_SLOTS = "driver_quota_slots"
+DRIVER_QUOTA_DONATIONS_TOTAL = "driver_quota_donations_total"
+DRIVER_QUOTA_RECLAIMS_TOTAL = "driver_quota_reclaims_total"
 
 # fleet-router exposition families (rendered by tony_tpu/router.py's GET
 # /metrics; same one-contract rule — the metrics-name lint pins these to

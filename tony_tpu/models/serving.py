@@ -172,6 +172,54 @@ COMPLETION_FINISH_REASONS = ("stop", "length", "cancelled", "expired")
 # lint.
 FINISH_REASONS = COMPLETION_FINISH_REASONS + ("shed", "failed")
 
+# per-request logprobs cap: one compiled decode-block variant carries
+# this many top entries whenever ANY busy slot asked for logprobs (a
+# per-request k would compile a program per distinct k; requests just
+# slice down to what they asked for)
+LOGPROBS_MAX = 8
+
+
+def _normalize_stop(stop) -> list[tuple[int, ...]]:
+    """Validate/normalize Request.stop: a list of token-id sequences
+    (a flat int list reads as ONE sequence). Raises ValueError on
+    empty sequences or non-ints."""
+    if not isinstance(stop, (list, tuple)) or not stop:
+        raise ValueError("stop must be a non-empty list")
+    if all(isinstance(t, (int, np.integer)) for t in stop):
+        stop = [stop]
+    out = []
+    for seq in stop:
+        if not isinstance(seq, (list, tuple)) or not seq:
+            raise ValueError("each stop sequence must be a non-empty "
+                             "list of token ids")
+        out.append(tuple(int(t) for t in seq))
+    if len(out) > 16:
+        raise ValueError("at most 16 stop sequences per request")
+    return out
+
+
+def _stop_match_end(tokens, stop_seqs, start: int = 0) -> int | None:
+    """Earliest end index (exclusive) of a stop-sequence match that
+    ENDS after ``start`` — tokens before ``start`` were already
+    delivered/journaled and are never retracted, but a match may BEGIN
+    inside them (sequences span block boundaries). None = no match."""
+    best = None
+    n = len(tokens)
+    for seq in stop_seqs or ():
+        m = len(seq)
+        if m == 0 or n < m:
+            continue
+        lo = max(0, start - m + 1)
+        for i in range(lo, n - m + 1):
+            end = i + m
+            if end <= start:
+                continue
+            if tuple(int(t) for t in tokens[i:end]) == tuple(seq):
+                if best is None or end < best:
+                    best = end
+                break       # earliest match of THIS sequence found
+    return best
+
 from .generate import (
     DecodeShardings,
     DecodeWeights,
@@ -226,7 +274,26 @@ class Request:
     recovery, and the router's mid-request failover (docs/serving.md
     "Request durability & replay"). A prefix that already satisfies the
     request (budget reached, or it ends in a stop token) completes
-    immediately without taking a slot."""
+    immediately without taking a slot.
+
+    ``stop`` is a per-request list of stop SEQUENCES (token-id lists; a
+    flat int list reads as one sequence): the emission ends at the
+    first completed match, checked host-side at the processing instant
+    — the matched sequence itself is included in the output (the
+    engine's stop-token convention) and the device slot is freed like
+    a cancel. Matches may span block boundaries and work in every mode
+    (predictive, EOS, speculative); the journal is truncated at the
+    match, so replay/failover/streaming never deliver past it. The
+    server-wide ``stop_tokens`` stays the default and both apply
+    independently.
+
+    ``logprobs`` (0 = off, <= LOGPROBS_MAX) asks for the top-k
+    log-probabilities of every emitted token, read off the SAME logits
+    row the token was sampled from (no second forward). Rejected under
+    speculative serving (rejected drafts never existed host-side, so
+    per-token logits rows don't either). A replayed request's
+    teacher-forced prefix carries ``None`` placeholders — those
+    positions were prefilled, not decoded, by this process."""
     prompt: Any
     max_new_tokens: int
     temperature: float | None = None
@@ -234,6 +301,8 @@ class Request:
     cache_prompt: bool | None = None
     deadline: float | None = None
     resume_tokens: list | None = None
+    stop: list | None = None
+    logprobs: int = 0
     # multi-model serving: which registry entry should serve this
     # request. The engine itself is single-model (the ServeApp routes
     # by name to the right engine); the field rides the Request so the
@@ -254,6 +323,10 @@ class Completion:
     # host-monotonic span events + attrs) — None only for engines that
     # don't record traces (test stubs)
     trace: dict | None = None
+    # per-emitted-token log-probabilities (Request.logprobs > 0): one
+    # {"token", "logprob", "top": [[ids], [logprobs]]} per token, in
+    # stream order; teacher-forced resume positions carry logprob=None
+    logprobs: list | None = None
 
 
 class QueueFullError(RuntimeError):
@@ -757,7 +830,7 @@ def _prefill_batch(params, cache, d_tokens, d_active, d_target, d_offsets,
     jax.jit,
     static_argnames=("cfg", "block", "stop_tokens", "pad_id",
                      "top_k", "per_row_topk", "weight_dtype", "build_fused",
-                     "all_greedy", "shardings"),
+                     "all_greedy", "lp_k", "shardings"),
     donate_argnames=("cache",),
 )
 def _decode_block(params, fused, cache, tokens, active, target_len,
@@ -765,6 +838,7 @@ def _decode_block(params, fused, cache, tokens, active, target_len,
                   *, cfg: TransformerConfig, block: int, stop_tokens: tuple,
                   pad_id: int, top_k: int, per_row_topk: bool,
                   weight_dtype: str, build_fused: bool, all_greedy: bool,
+                  lp_k: int = 0,
                   shardings: DecodeShardings | None = None):
     """``block`` single-token decode steps for ALL slots under one scan.
     Per-row masks freeze finished slots: their length stops advancing (the
@@ -777,7 +851,14 @@ def _decode_block(params, fused, cache, tokens, active, target_len,
     block (measured ~0.2s per transfer on a tunneled chip regardless of
     size; three separate fetches tripled the serving loop's wall time).
     Emitted rows are pad past a slot's stop; the host slices by length
-    delta instead of trusting pad."""
+    delta instead of trusting pad.
+
+    ``lp_k`` (static; nonzero iff some busy slot asked for logprobs)
+    widens ``packed`` to [S, block+2+block*(2*lp_k+1)]: after the
+    length/active columns come each step's CHOSEN-token logprob (f32
+    bitcast to int32), the top-``lp_k`` token ids, and their logprobs
+    (bitcast) — read off the SAME log-softmax row the token was sampled
+    from, still one transfer."""
     params = _cast_decode_params(params, cfg)
     if build_fused:
         fused = _fuse_decode_weights(params, cfg, weight_dtype)
@@ -801,6 +882,15 @@ def _decode_block(params, fused, cache, tokens, active, target_len,
                            0.0 if all_greedy else temps,
                            topks if per_row_topk else top_k)
         emitted = jnp.where(active, nxt, pad_id).astype(jnp.int32)
+        if lp_k:
+            # the raw-distribution logprobs of the row the sample came
+            # from (pre temperature/top-k filtering — the model's own
+            # distribution, the OpenAI convention)
+            lp_full = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                         axis=-1)
+            top_vals, top_ids = lax.top_k(lp_full, lp_k)
+            chosen = jnp.take_along_axis(
+                lp_full, nxt[:, None].astype(jnp.int32), axis=-1)[:, 0]
         # only rows active this step advance (staying ring-aligned with
         # the cursor); a frozen row keeps taking the shared-cursor garbage
         # write, but its data is dead — completions are extracted from the
@@ -811,13 +901,28 @@ def _decode_block(params, fused, cache, tokens, active, target_len,
                     else jnp.zeros_like(active))
         still = active & ~hit_stop & (new_len < target_len)
         tokens = jnp.where(still, nxt, tokens)
-        return (new_cache, tokens, still, (cursor + 1) % m_cap, key), emitted
+        ys = ((emitted, chosen, top_ids.astype(jnp.int32), top_vals)
+              if lp_k else emitted)
+        return (new_cache, tokens, still, (cursor + 1) % m_cap, key), ys
 
-    (cache, tokens, active, cursor, key), toks = lax.scan(
+    (cache, tokens, active, cursor, key), ys = lax.scan(
         step, (cache, tokens, active, cursor, key), None, length=block)
+    if lp_k:
+        toks, chosen, ids, vals = ys
+        s = toks.shape[1]
+        extra = [
+            lax.bitcast_convert_type(
+                chosen.T.astype(jnp.float32), jnp.int32),
+            jnp.transpose(ids, (1, 0, 2)).reshape(s, block * lp_k),
+            lax.bitcast_convert_type(
+                jnp.transpose(vals, (1, 0, 2)).astype(jnp.float32),
+                jnp.int32).reshape(s, block * lp_k),
+        ]
+    else:
+        toks, extra = ys, []
     packed = jnp.concatenate(
-        [toks.T, cache.length[:, None], active.astype(jnp.int32)[:, None]],
-        axis=1)
+        [toks.T, cache.length[:, None], active.astype(jnp.int32)[:, None]]
+        + extra, axis=1)
     cache, tokens, active, packed = _constrain_pool(
         shardings, cache, tokens, active, packed)
     return cache, tokens, active, packed
@@ -1505,6 +1610,9 @@ class SlotServer:
         # argmax-only / static-threshold program variants
         self._np_temps = np.zeros((slots,), np.float32)
         self._np_topks = np.full((slots,), self.top_k, np.int32)
+        # per-slot requested logprobs k (0 = off): any nonzero busy slot
+        # flips the block dispatch onto the lp-carrying program variant
+        self._np_lp = np.zeros((slots,), np.int32)
         self._cursor = 0        # host-tracked, advances block per dispatch
         # exact host model of the device slot state as of the NEWEST
         # dispatched block — usable for scheduling only in predictive mode
@@ -1526,6 +1634,15 @@ class SlotServer:
         # unprocessed never mixes the two streams)
         self._requests: list[Request | None] = [None] * slots
         self._emitted: list[list[int]] = [[] for _ in range(slots)]
+        # per-slot accumulated logprob entries, in lockstep with
+        # _emitted (only populated while the slot's request asked)
+        self._lp_acc: list[list] = [[] for _ in range(slots)]
+        # slots completed by a per-request STOP match whose device-side
+        # deactivation hasn't been observed yet: blocks dispatched
+        # before the cancel program still show the row active, and the
+        # bookkeeping must keep skipping it until a block shows it
+        # inactive — or an admit event re-occupies it for a new request
+        self._stop_cancelled: set[int] = set()
         # dispatch-side views: which slot is CURRENTLY serving a request
         # id (cancel targeting — _requests lags by the pipeline depth),
         # and every admitted id whose completion hasn't been delivered
@@ -1561,6 +1678,16 @@ class SlotServer:
             raise ValueError(
                 f"request names model {request.model!r} but this engine "
                 f"serves {self.model!r} (the ServeApp routes by model)")
+        if request.stop is not None:
+            request.stop = _normalize_stop(request.stop)
+        request.logprobs = int(request.logprobs or 0)
+        if not 0 <= request.logprobs <= LOGPROBS_MAX:
+            raise ValueError(
+                f"logprobs must be in [0, {LOGPROBS_MAX}]")
+        if request.logprobs and self._spec:
+            raise ValueError(
+                "logprobs are unavailable under speculative serving "
+                "(rejected drafts have no per-token logits rows)")
         resume = request.resume_tokens
         if resume is not None:
             resume = [int(t) for t in np.asarray(resume, np.int32)]
@@ -1570,15 +1697,24 @@ class SlotServer:
         if resume:
             tr.attrs["resume_tokens"] = len(resume)
             # a prefix that already satisfies the request (budget
-            # reached, or it ends in a stop token) is a finished
-            # completion someone failed to deliver — deliver it now,
-            # without a slot, a prefill, or a decode step
+            # reached, it ends in a stop token, or it completes a
+            # per-request stop sequence) is a finished completion
+            # someone failed to deliver — deliver it now, without a
+            # slot, a prefill, or a decode step
             stop_end = bool(self.stop_tokens) and resume[-1] in \
                 self.stop_tokens
-            if len(resume) >= request.max_new_tokens or stop_end:
+            seq_end = _stop_match_end(resume, request.stop) \
+                if request.stop else None
+            if (len(resume) >= request.max_new_tokens or stop_end
+                    or seq_end is not None):
+                if seq_end is not None and \
+                        seq_end <= request.max_new_tokens:
+                    resume = resume[:seq_end]
+                    stop_end = True
                 toks = resume[:request.max_new_tokens]
-                reason = "stop" if stop_end and toks and \
-                    toks[-1] in self.stop_tokens else "length"
+                reason = "stop" if stop_end and toks and (
+                    seq_end is not None
+                    or toks[-1] in self.stop_tokens) else "length"
                 self.replays += 1
                 self.replayed_tokens += len(toks)
                 self._traces[request.id] = tr
@@ -1622,7 +1758,10 @@ class SlotServer:
                 temperature=request.temperature, top_k=request.top_k,
                 cache_prompt=request.cache_prompt, seed=self._seed,
                 deadline=request.deadline, emitted=resume,
-                model=self.model)
+                model=self.model,
+                stop=[list(s) for s in request.stop]
+                if request.stop else None,
+                logprobs=request.logprobs)
         self._queue.append(request)
         return request.id
 
@@ -1739,10 +1878,19 @@ class SlotServer:
             emitted = list(entry.emitted)
             stop_end = bool(self.stop_tokens) and bool(emitted) and \
                 emitted[-1] in self.stop_tokens
-            if len(emitted) >= entry.max_new_tokens or stop_end:
+            seq_end = _stop_match_end(emitted, entry.stop) \
+                if entry.stop else None
+            if (len(emitted) >= entry.max_new_tokens or stop_end
+                    or seq_end is not None):
                 # fully emitted but undelivered (the crash landed between
                 # the finishing block's processing and delivery): deliver
-                # the journaled stream, don't re-decode past the budget
+                # the journaled stream, don't re-decode past the budget.
+                # A journaled per-request stop match counts as fully
+                # emitted the same way (the journal is truncated at the
+                # match, so this only fires for pre-seeded prefixes).
+                if seq_end is not None and seq_end <= entry.max_new_tokens:
+                    emitted = emitted[:seq_end]
+                    stop_end = True
                 toks = emitted[:entry.max_new_tokens]
                 self.replays += 1
                 self.replayed_tokens += len(toks)
@@ -1764,7 +1912,11 @@ class SlotServer:
                 max_new_tokens=entry.max_new_tokens,
                 temperature=entry.temperature, top_k=entry.top_k,
                 cache_prompt=entry.cache_prompt, deadline=entry.deadline,
-                resume_tokens=list(entry.emitted), id=rid))
+                resume_tokens=list(entry.emitted),
+                stop=[list(s) for s in entry.stop]
+                if entry.stop else None,
+                logprobs=int(getattr(entry, "logprobs", 0) or 0),
+                id=rid))
         self._prefix_refs.clear()
         # drop pending dispatch-tracker entries WITHOUT blocking on them
         # (their buffers may have died with the failed dispatch) and
@@ -1824,7 +1976,10 @@ class SlotServer:
                     max_new_tokens=entry.max_new_tokens,
                     temperature=entry.temperature, top_k=entry.top_k,
                     cache_prompt=entry.cache_prompt,
-                    resume_tokens=list(entry.emitted))
+                    resume_tokens=list(entry.emitted),
+                    stop=[list(s) for s in entry.stop]
+                    if entry.stop else None,
+                    logprobs=int(getattr(entry, "logprobs", 0) or 0))
                 try:
                     rid = self.submit(req)
                 except ValueError as e:
@@ -2275,6 +2430,7 @@ class SlotServer:
             self._host_busy[slot] = True
             self._np_temps[slot] = adm.temp
             self._np_topks[slot] = adm.topk
+            self._np_lp[slot] = adm.req.logprobs
             self._model_len[slot] = body.size
             self._model_active[slot] = True
             self._model_target[slot] = adm.target
@@ -2496,6 +2652,10 @@ class SlotServer:
 
     def _apply_admit(self, admit) -> None:
         slot, body_len, req = admit
+        # the slot belongs to a NEW request from this event on: any
+        # pending stop-cancel skip for the predecessor ends here (the
+        # admission program was dispatched after the cancel program)
+        self._stop_cancelled.discard(int(slot))
         self._spec_round_counts[slot] = 0
         self._spec_accepted_counts[slot] = 0
         self._expect_len[slot] = body_len
@@ -2505,6 +2665,12 @@ class SlotServer:
         # seed the tally with the teacher-forced prefix (those positions
         # were prefilled, not decoded — only the continuation appends)
         self._emitted[slot] = [int(t) for t in (req.resume_tokens or ())]
+        # logprob placeholders for the teacher-forced prefix keep the
+        # per-token alignment (those rows were prefilled, not decoded)
+        self._lp_acc[slot] = ([{"token": int(t), "logprob": None,
+                                "top": None}
+                               for t in (req.resume_tokens or ())]
+                              if req.logprobs else [])
         # re-arm busy at the replay position: when this slot was
         # re-admitted before its PREDECESSOR's completion was processed,
         # that processing (replayed just before this admit) cleared
@@ -2532,10 +2698,12 @@ class SlotServer:
         out = self._emitted[slot]
         self._done[rid] = Completion(
             rid, out, "cancelled",
-            trace=self._finish_trace(rid, "cancelled", n_tokens=len(out)))
+            trace=self._finish_trace(rid, "cancelled", n_tokens=len(out)),
+            logprobs=(self._lp_acc[slot] if req.logprobs else None))
         self._finish_stream(rid)
         self._requests[slot] = None
         self._emitted[slot] = []
+        self._lp_acc[slot] = []
         self._host_busy[slot] = False
         self._expect_active[slot] = False
         self._release_request(rid)
@@ -2543,6 +2711,11 @@ class SlotServer:
     def _dispatch_block(self) -> None:
         t0 = time.monotonic()
         self._key, sub = jax.random.split(self._key)
+        # logprobs: one packed-width variant whenever ANY busy slot
+        # asked (static — two compiled programs total); requests slice
+        # down to their own k at processing time
+        lp_k = (LOGPROBS_MAX
+                if bool((self._np_lp[self._host_busy] > 0).any()) else 0)
         (self._cache, self._d_tokens, self._d_active, packed) = _decode_block(
             self._params, self._fused, self._cache,
             self._d_tokens, self._d_active, self._d_target,
@@ -2559,6 +2732,7 @@ class SlotServer:
             weight_dtype=self.weight_dtype, build_fused=self._build_fused,
             all_greedy=not bool(
                 (self._np_temps[self._host_busy] > 0).any()),
+            lp_k=lp_k,
             shardings=self._shardings)
         self._cursor = (self._cursor + self.block_size) % self.max_len
         self.blocks_dispatched += 1
@@ -2571,7 +2745,10 @@ class SlotServer:
         # measure the pipeline lag this block's tokens were delivered at
         seq = self.dispatch_tracker.track("decode_block", packed)
         self._pipeline.append({"packed": packed, "events": [], "seq": seq,
-                               "w": self.block_size + 2,
+                               "w": self.block_size + 2
+                               + (self.block_size * (2 * lp_k + 1)
+                                  if lp_k else 0),
+                               "lp_k": lp_k,
                                "spec_gamma": None})
         if self._predictive:            # exact: no EOS can surprise us
             adv = np.minimum(self.block_size,
@@ -2679,23 +2856,45 @@ class SlotServer:
         col = 0
         for i, rec in enumerate(recs):
             # records carry their own packed width: plain decode blocks
-            # are [S, block+2], spec rounds [S, gamma+4] (emissions,
-            # raw acceptance count, length, active) — and gammas vary
-            # across rounds when the autotuner moves
+            # are [S, block+2] (+ the logprob columns when lp_k was on),
+            # spec rounds [S, gamma+4] (emissions, raw acceptance count,
+            # length, active) — and gammas vary across rounds when the
+            # autotuner moves
             w = rec.get("w", self.block_size + 2)
             packed = flat[:, col:col + w]
             col += w
             lag = lags[i]
             gamma = rec.get("spec_gamma")
+            lp_k = rec.get("lp_k", 0) or 0
+            lp_chosen = lp_ids = lp_vals = None
             if gamma is not None:
                 toks, n_accs, lengths, active = (
                     packed[:, :gamma + 1], packed[:, gamma + 1],
                     packed[:, gamma + 2], packed[:, gamma + 3].astype(bool))
+            elif lp_k:
+                B = self.block_size
+                toks = packed[:, :B]
+                n_accs = None
+                lengths, active = packed[:, B], packed[:, B + 1].astype(bool)
+                # the logprob columns ride the same int32 transfer:
+                # f32 values bitcast at pack time, viewed back here
+                base = B + 2
+                lp_chosen = np.ascontiguousarray(
+                    packed[:, base:base + B]).view(np.float32)
+                lp_ids = np.ascontiguousarray(
+                    packed[:, base + B:base + B + B * lp_k]
+                ).reshape(-1, B, lp_k)
+                lp_vals = np.ascontiguousarray(
+                    packed[:, base + B + B * lp_k:
+                           base + B + 2 * B * lp_k]
+                ).view(np.float32).reshape(-1, B, lp_k)
             else:
                 toks, n_accs, lengths, active = (
                     packed[:, :-2], None, packed[:, -2],
                     packed[:, -1].astype(bool))
             for slot in np.nonzero(self._expect_active)[0]:
+                if slot in self._stop_cancelled:
+                    continue
                 if n_accs is not None:
                     # speculative bookkeeping: the RAW acceptance count
                     # (true draft-target agreement, pre-clamp — the solo
@@ -2713,21 +2912,49 @@ class SlotServer:
                     self._spec_accepted_counts[slot] += acc
                 n = int(lengths[slot] - self._expect_len[slot])
                 had_tokens = bool(self._emitted[slot])
-                self._emitted[slot].extend(int(t) for t in toks[slot, :n])
                 req = self._requests[slot]
-                if n > 0 and req is not None and self._journal is not None:
+                new = [int(t) for t in toks[slot, :n]]
+                stop_hit = False
+                if new and req is not None and req.stop:
+                    # per-request stop sequences, checked at the
+                    # durability point so journal/stream/replay all see
+                    # the truncated stream; a match may START inside
+                    # already-delivered tokens but must END in this
+                    # batch (delivered tokens are never retracted)
+                    prev_len = len(self._emitted[slot])
+                    cand = self._emitted[slot] + new
+                    end = _stop_match_end(cand, req.stop, start=prev_len)
+                    if end is not None:
+                        new = cand[prev_len:end]
+                        stop_hit = True
+                n_new = len(new)
+                self._emitted[slot].extend(new)
+                if (n_new and lp_chosen is not None and req is not None
+                        and req.logprobs):
+                    k = req.logprobs
+                    for j in range(n_new):
+                        self._lp_acc[slot].append({
+                            "token": new[j],
+                            "logprob": round(
+                                float(lp_chosen[slot, j]), 6),
+                            "top": [
+                                [int(t) for t in lp_ids[slot, j, :k]],
+                                [round(float(v), 6)
+                                 for v in lp_vals[slot, j, :k]]]})
+                if n_new > 0 and req is not None and \
+                        self._journal is not None:
                     # durability point: the journaled prefix advances at
                     # processing time (host-known tokens only — replay
                     # from any true prefix is exact, the pipeline lag
                     # just re-decodes)
-                    self._journal.emit(req.id, toks[slot, :n])
-                if n > 0 and req is not None:
+                    self._journal.emit(req.id, new)
+                if n_new > 0 and req is not None:
                     # streaming delivery at the SAME instant: the
                     # absolute-position feed appends only the unseen
                     # suffix (resume prefixes flow on the first
                     # processed block, replays never double-deliver)
                     self._stream_feed(req.id, self._emitted[slot])
-                if not had_tokens and n > 0 and req is not None:
+                if not had_tokens and n_new > 0 and req is not None:
                     # first emitted token OBSERVED by the host — the TTFT
                     # span (lags the device by the processing pipeline;
                     # trace timestamps are host-monotonic by contract).
@@ -2740,43 +2967,79 @@ class SlotServer:
                         if lag is not None:
                             tr.attrs["device_lag_first_token_s"] = round(
                                 lag, 6)
+                if stop_hit:
+                    # complete NOW with reason "stop" and free the
+                    # device slot like a cancel (dispatch order is
+                    # device order: blocks already dispatched decode
+                    # dead tokens the bookkeeping skips; later blocks
+                    # see an idle row). _stop_cancelled keeps the slot
+                    # skipped until the deactivation is OBSERVED in a
+                    # later block's packed state (or an admit event
+                    # re-occupies the slot for a new request).
+                    self._complete_slot(slot, req, "stop", lag)
+                    if active[slot]:
+                        self._d_active = _cancel_slot(
+                            self._d_active, jnp.int32(slot),
+                            shardings=self._shardings)
+                        self._stop_cancelled.add(int(slot))
+                    self._model_active[slot] = False
+                    continue
                 if not active[slot]:
                     out = self._emitted[slot]
                     reason = ("stop" if out and out[-1] in self.stop_tokens
                               else "length")
-                    if lag is not None:
-                        tr = self._traces.get(req.id)
-                        if tr is not None:
-                            tr.attrs["device_lag_s"] = round(lag, 6)
-                    if self._spec and req is not None:
-                        tr = self._traces.get(req.id)
-                        if tr is not None:
-                            tr.attrs["spec_rounds"] = int(
-                                self._spec_round_counts[slot])
-                            tr.attrs["spec_accepted_tokens"] = int(
-                                self._spec_accepted_counts[slot])
-                        if self._spec_round_counts[slot]:
-                            self.spec_rounds_hist.observe(
-                                float(self._spec_round_counts[slot]))
-                        self._spec_round_counts[slot] = 0
-                        self._spec_accepted_counts[slot] = 0
-                    self._done[req.id] = Completion(
-                        req.id, out, reason,
-                        trace=self._finish_trace(
-                            req.id, "finished", n_tokens=len(out),
-                            reason=reason))
-                    self._finish_stream(req.id)
-                    self._requests[slot] = None
-                    self._emitted[slot] = []
-                    self._host_busy[slot] = False
-                    self._release_request(req.id)
+                    self._complete_slot(slot, req, reason, lag)
             self._expect_len = np.array(lengths)
             self._expect_active = np.array(active)
+            for slot in list(self._stop_cancelled):
+                if not active[slot]:
+                    # the cancel program's effect reached this block:
+                    # the ledger entry has done its job
+                    self._stop_cancelled.discard(slot)
+                else:
+                    self._expect_active[slot] = False
             for kind, payload in rec["events"]:
                 if kind == "admit":
                     self._apply_admit(payload)
                 else:
                     self._apply_cancel(payload)
+
+    def _complete_slot(self, slot: int, req: Request, reason: str,
+                       lag: float | None) -> None:
+        """Deliver one slot's finished request (natural end or a
+        per-request stop match) and free the host-side slot state —
+        the single completion point both paths in ``_process`` share."""
+        out = self._emitted[slot]
+        if lag is not None:
+            tr = self._traces.get(req.id)
+            if tr is not None:
+                tr.attrs["device_lag_s"] = round(lag, 6)
+        if self._spec:
+            tr = self._traces.get(req.id)
+            if tr is not None:
+                tr.attrs["spec_rounds"] = int(
+                    self._spec_round_counts[slot])
+                tr.attrs["spec_accepted_tokens"] = int(
+                    self._spec_accepted_counts[slot])
+            if self._spec_round_counts[slot]:
+                self.spec_rounds_hist.observe(
+                    float(self._spec_round_counts[slot]))
+            self._spec_round_counts[slot] = 0
+            self._spec_accepted_counts[slot] = 0
+        lps = self._lp_acc[slot] if req.logprobs else None
+        if lps is not None and len(lps) > len(out):
+            lps = lps[:len(out)]
+        self._done[req.id] = Completion(
+            req.id, out, reason,
+            trace=self._finish_trace(
+                req.id, "finished", n_tokens=len(out), reason=reason),
+            logprobs=lps)
+        self._finish_stream(req.id)
+        self._requests[slot] = None
+        self._emitted[slot] = []
+        self._lp_acc[slot] = []
+        self._host_busy[slot] = False
+        self._release_request(req.id)
 
     def _device_may_be_active(self) -> bool:
         if self._predictive:

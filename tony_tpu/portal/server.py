@@ -462,6 +462,14 @@ _TASK_SEG_COLORS = {
     #                                (control-plane recovery — the task
     #                                never stopped; attrs carry the new
     #                                driver_generation)
+    "scaled_up": "#6fd0a0",        # autoscaler claimed this parked slot
+    "scaled_down": "#5f9ea0",      # autoscaler drained + parked it
+    "donated": "#d98fc4",          # batch worker's slot donated to the
+    #                                interactive tier (arbiter preempt
+    #                                drain; docs/autoscaling.md)
+    "reclaimed": "#b4d98f",        # donated slot returned to batch
+    "ckpt_prestaged": "#cfd98f",   # checkpoint pre-read before the
+    #                                barrier (rescale placement)
     "failed": "#d98080", "killed": "#d98080",
     "heartbeat_expired": "#d98080",
 }
@@ -538,6 +546,8 @@ def _task_timeline_html(app_id: str, traces: list[dict]) -> str:
                      ("done", "#79b77a"), ("restart", "#e0876c"),
                      ("roll", "#8fd0c9"), ("preempt", "#d6b35c"),
                      ("resize", "#9a7fd0"), ("readopted", "#67c5a8"),
+                     ("scale up", "#6fd0a0"), ("scale down", "#5f9ea0"),
+                     ("donated", "#d98fc4"), ("reclaimed", "#b4d98f"),
                      ("dead", "#d98080")))
     body = (
         f"<h3>{html.escape(app_id)} — gang-launch waterfall</h3>"

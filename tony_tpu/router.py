@@ -184,6 +184,10 @@ class Replica:
         # naming one still route here rather than failing a fleet that
         # predates multi-model /stats)
         self.models: set[str] = set()
+        # the replica's own cumulative TTFT p99 from its newest /stats
+        # poll (latency.ttft_s.p99_s) — rolled into stats()["fleet"],
+        # the autoscale controller's router-side signal
+        self.ttft_p99_s = 0.0
         # posts the ROUTER currently has outstanding against this
         # replica — exact and instantaneous, unlike the polled /stats
         # (which lag a health interval and double-count router traffic);
@@ -511,6 +515,12 @@ class FleetRouter:
                 rep.models = {str(m) for m in models}
             elif isinstance(models, (list, tuple)):
                 rep.models = {str(m) for m in models}
+            try:
+                rep.ttft_p99_s = float(
+                    (st.get("latency") or {}).get("ttft_s", {})
+                    .get("p99_s", 0.0) or 0.0)
+            except (TypeError, ValueError, AttributeError):
+                pass
 
     def _eject_locked(self, rep: Replica, reason: str) -> None:
         if rep.up:
@@ -606,7 +616,8 @@ class FleetRouter:
                  top_k: int | None = None,
                  cache_prompt: bool | None = None,
                  model: str | None = None,
-                 on_tokens=None) -> dict:
+                 on_tokens=None, stop: list | None = None,
+                 logprobs: int = 0) -> dict:
         """Route one generation request; returns the replica's response
         dict (id/tokens/finish_reason) plus routing attrs. ``model``
         restricts routing to replicas advertising that model (their
@@ -629,16 +640,17 @@ class FleetRouter:
             try:
                 return self._generate(prompt, max_new_tokens, timeout_s,
                                       temperature, top_k, cache_prompt,
-                                      model, on_tokens)
+                                      model, on_tokens, stop, logprobs)
             finally:
                 with self._lock:
                     self.streams_active -= 1
         return self._generate(prompt, max_new_tokens, timeout_s,
                               temperature, top_k, cache_prompt, model,
-                              None)
+                              None, stop, logprobs)
 
     def _generate(self, prompt, max_new_tokens, timeout_s, temperature,
-                  top_k, cache_prompt, model, on_tokens) -> dict:
+                  top_k, cache_prompt, model, on_tokens,
+                  stop=None, logprobs=0) -> dict:
         rid = next(self._ids)
         tr = RequestTrace(rid)
         tr.mark("submitted")
@@ -685,6 +697,11 @@ class FleetRouter:
             payload["top_k"] = int(top_k)
         if cache_prompt is not None:
             payload["cache_prompt"] = bool(cache_prompt)
+        if stop is not None:
+            # pass-through: the replica engine validates/normalizes
+            payload["stop"] = stop
+        if logprobs:
+            payload["logprobs"] = int(logprobs)
         if model is not None:
             payload["model"] = str(model)
             tr.attrs["model"] = str(model)
@@ -1055,10 +1072,30 @@ class FleetRouter:
                     # advertised model registry ([] = legacy replica:
                     # serves any model it's asked for)
                     "models": sorted(r.models),
+                    "ttft_p99_s": round(r.ttft_p99_s, 6),
                 } for r in self.replicas.values()}
             return {
                 "replicas": reps,
                 "live": sum(r.up for r in self.replicas.values()),
+                # controller-readable fleet aggregate (tony_tpu/
+                # autoscale.py): the merged load signals a scaling loop
+                # needs in one place — router-outstanding posts are
+                # fresher than any replica poll, queued/active lag one
+                # stats refresh, ttft_p99_s is the WORST replica's own
+                # cumulative p99 (the controller's windowed signal
+                # comes from /metrics bucket deltas; this is the
+                # coarse at-a-glance mirror)
+                "fleet": {
+                    "inflight": sum(r.inflight
+                                    for r in self.replicas.values()),
+                    "queued": sum(max(0, r.queued)
+                                  for r in self.replicas.values()),
+                    "active": sum(max(0, r.active)
+                                  for r in self.replicas.values()),
+                    "ttft_p99_s": round(max(
+                        (r.ttft_p99_s for r in self.replicas.values()),
+                        default=0.0), 6),
+                },
                 # True while driver discovery is failing/distrusted and
                 # the router serves its last-known fleet (control-plane
                 # outage; docs/training-robustness.md)
@@ -1414,6 +1451,17 @@ def make_handler(router: FleetRouter, codec=None):
                         raise ValueError(
                             "cache_prompt must be a JSON boolean")
                     kwargs["cache_prompt"] = payload["cache_prompt"]
+                if payload.get("stop") is not None:
+                    if not isinstance(payload["stop"], list):
+                        raise ValueError(
+                            "stop must be a list of token ids or a "
+                            "list of token-id lists")
+                    kwargs["stop"] = payload["stop"]
+                lp = payload.get("logprobs", 0) or 0
+                if isinstance(lp, bool) or not isinstance(lp, int):
+                    raise ValueError("logprobs must be an integer")
+                if lp:
+                    kwargs["logprobs"] = lp
                 from .api.stream import stream_requested
 
                 stream_on = stream_requested(payload, self.path)
@@ -1484,6 +1532,10 @@ def make_handler(router: FleetRouter, codec=None):
                 kwargs["top_k"] = req["top_k"]
             if req["model"] is not None:
                 kwargs["model"] = req["model"]
+            if req.get("stop_sequences"):
+                kwargs["stop"] = req["stop_sequences"]
+            if req.get("logprobs"):
+                kwargs["logprobs"] = req["logprobs"]
             prompt = req["prompt_tokens"]
             rid = next(oai_ids)
             if req["stream"]:
@@ -1525,7 +1577,8 @@ def make_handler(router: FleetRouter, codec=None):
             # zero), so replica ids collide across the fleet
             self._send(200, build(
                 rid, model_name, resp.get("tokens", []),
-                resp.get("finish_reason", "stop"), len(prompt), codec))
+                resp.get("finish_reason", "stop"), len(prompt), codec,
+                logprobs=resp.get("logprobs")))
 
     return Handler
 

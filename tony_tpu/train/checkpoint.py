@@ -18,6 +18,11 @@ import threading
 from pathlib import Path
 from typing import Any
 
+# re-exported for training-side callers; the implementation lives in a
+# jax-free module because the EXECUTOR (python -S, no training stack)
+# runs it before the child exists (utils/prestage.py)
+from ..utils.prestage import prestage_checkpoint  # noqa: F401
+
 log = logging.getLogger(__name__)
 
 

@@ -1,0 +1,63 @@
+"""Checkpoint prestage — a pure-filesystem helper the EXECUTOR runs.
+
+Lives under utils (not tony_tpu/train/) on purpose: executors run
+``python -S`` without the training stack, and ``tony_tpu.train``'s
+package __init__ imports jax at module level — importing the helper
+from there crashed the capacity-return relaunch before it could
+register (found by ``bench.py --autoscale``). ``train.checkpoint``
+re-exports the name for training-side callers.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+
+def prestage_checkpoint(directory: str) -> dict | None:
+    """Checkpoint-aware rescale placement (docs/autoscaling.md): read
+    every file of the NEWEST complete checkpoint under ``directory``
+    so the bytes are local (page cache on a local FS; the actual fetch
+    on a remote mount) BEFORE the worker joins the gang barrier — the
+    restore the training child runs after the barrier then hits warm
+    data instead of serializing cold I/O behind the whole gang.
+
+    Pure filesystem walk (no orbax import — the executor calls this
+    before the child exists): step directories are the orbax layout's
+    integer-named children; in-progress/tmp saves are skipped. Returns
+    ``{"step", "files", "bytes"}`` or None when there is nothing
+    staged yet (first launch) — never raises (a prestage failure must
+    degrade to the old cold-restore behavior, not fail the relaunch)."""
+    try:
+        root = Path(directory)
+        if not root.is_dir():
+            return None
+        steps = []
+        for child in root.iterdir():
+            # isdigit alone is the whole guard: orbax finalizes via
+            # tmp+rename and its in-progress dirs are suffixed
+            # ("<step>.orbax-checkpoint-tmp-<n>"), never bare integers
+            if child.is_dir() and child.name.isdigit():
+                steps.append(int(child.name))
+        if not steps:
+            return None
+        step = max(steps)
+        n_files = 0
+        n_bytes = 0
+        for p in sorted((root / str(step)).rglob("*")):
+            if not p.is_file():
+                continue
+            n_files += 1
+            with open(p, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    n_bytes += len(chunk)
+        return {"step": step, "files": n_files, "bytes": n_bytes}
+    except OSError:
+        log.exception("checkpoint prestage of %s failed; the child "
+                      "restores cold", directory)
+        return None
