@@ -54,7 +54,11 @@ histograms, docs/observability.md) under `serving_latency` — the
 latency baseline future perf PRs regress against — plus a `device_time`
 section (dispatch→ready quantiles per program kind from the
 DispatchTracker, measured device lag behind host observation, and the
-XLA compile count/time for the whole bench process).
+XLA compile count/time for the whole bench process). An `open_loop`
+arm rides along: the same workload offered as seeded Poisson arrivals
+at the measured burst capacity (byte-identity vs the burst asserted;
+the latency block there is the open-loop shape, not the burst's
+deep-backlog artifact).
 
 `python bench.py --serving --shared-prefix` benchmarks the chunk-aligned
 prefix KV cache on the workload it exists for: N requests sharing one
@@ -69,11 +73,28 @@ under `prefix_cache`.
 fleet serving (docs/serving.md "Fleet serving"): 2-3 real serve
 processes (one pinned per core, prefix caches on) behind the
 prefix-aware FleetRouter — fleet-vs-single CAPACITY (closed-loop,
-concurrency-matched, best-of-trials; asserted > 1.5x), Poisson
-open-loop passes at 1.2x measured fleet capacity, and prefix-affinity
+concurrency-matched, best-of-trials; asserted > 1.5x), open-loop
+CAPACITY arms (Poisson arrivals at each arm's own measured capacity,
+best-of-trials; asserted > 1.3x), Poisson open-loop collapse passes
+at 1.2x measured fleet capacity, and prefix-affinity
 vs random routing on the fleet-wide trie reuse fraction (asserted
 affinity > random) and merged p99 TTFT. Results land in PERF.json
 under `serving_fleet`.
+
+`python bench.py --serving --paged-kv` gates the paged KV allocator
+(docs/serving.md "Paged KV & admission tiers") on TINY shapes: (1)
+byte-identical greedy completions vs the ring engine with peak
+concurrency strictly above the ring's `slots` bound at EQUAL device
+memory (same pool bytes, more slots, admission gated on free blocks);
+(2) an admission storm of long prompts against in-flight decodes,
+chaos-paced (20ms/turn) so the comparison is deterministic — TPOT p99
+with chunked-prefill interleaving ON must stay ≤ 1.2x the quiescent
+baseline while the interleave-OFF arm's single-turn stall is reported
+(and must exceed the interleaved arm's); (3) admission tiers under
+queue pressure — queued batch requests shed (finish_reason "shed")
+before any interactive arrival is refused, zero failed requests, and
+the 429s carry engine-derived Retry-After. Results land in PERF.json
+under `paged_kv`.
 
 `python bench.py --serving --streaming` gates the streaming subsystem
 (docs/serving.md "Streaming & OpenAI compatibility"): an open-loop
@@ -383,6 +404,64 @@ def run_serving_bench() -> int:
     perslot, toks_p = serve(params, batched=False)
     assert toks_b == toks_p, "admission policy changed completions"
 
+    # open-loop Poisson arrivals (ROADMAP leftover, ISSUE 16): the same
+    # workload offered the way real traffic arrives — seeded
+    # interarrivals at the measured burst capacity — instead of all up
+    # front. Capacity is whatever the engine sustains under that
+    # arrival process; byte-identity is asserted (arrival timing is
+    # scheduling, never numerics), and the latency shape is the
+    # open-loop one rather than the burst's deep-backlog artifact.
+    def serve_open_loop(offered_tok_s):
+        srv = SlotServer(params, cfg, slots=slots, max_len=max_len,
+                         block_size=16, prefill_chunk=64,
+                         batched_admission=True)
+        mean_new = sum(budgets) / len(budgets)
+        interarrival = mean_new / offered_tok_s
+        sched = np.cumsum(np.random.default_rng(16).exponential(
+            scale=interarrival, size=n_requests))
+        reqs = [Request(prompt=p, max_new_tokens=budgets[i % len(budgets)])
+                for i, p in enumerate(prompts)]
+        done: dict = {}
+        nxt = 0
+        t0 = _time.time()
+        while nxt < len(reqs) or not srv.idle:
+            now = _time.time() - t0
+            while nxt < len(reqs) and sched[nxt] <= now:
+                srv.submit(reqs[nxt])
+                nxt += 1
+            if srv.idle and nxt < len(reqs):
+                _time.sleep(min(0.002, max(0.0, sched[nxt] - now)))
+                continue
+            srv.step()
+            # host-observe per turn (the ServeApp journal cadence):
+            # predictive processing is lazy, and without this the
+            # first_token/finished marks collapse into end-of-run
+            # bursts and the latency block below is fiction
+            srv.checkpoint_progress()
+            if srv._done:
+                done.update(srv.drain_completed())
+        done.update(srv.drain_completed())
+        wall = _time.time() - t0
+        toks = {i: done[r.id].tokens for i, r in enumerate(reqs)}
+        n_tokens = sum(len(t) for t in toks.values())
+        lat = srv.telemetry.snapshot()
+        srv.dispatch_tracker.drain(timeout=10.0)
+        out = {
+            "offered_tokens_per_sec": round(offered_tok_s, 1),
+            "poisson_interarrival_s": round(interarrival, 4),
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(n_tokens / wall, 1),
+            "useful_tokens": n_tokens,
+            "latency": {k: v for k, v in lat.items()
+                        if k in ("ttft_s", "tpot_s", "queue_wait_s",
+                                 "e2e_s")},
+        }
+        srv.shutdown()
+        return out, toks
+    serve_open_loop(batched["tokens_per_sec"])        # warm the pacer
+    open_loop, toks_ol = serve_open_loop(batched["tokens_per_sec"])
+    assert toks_ol == toks_b, "arrival process changed completions"
+
     # latency baseline (ISSUE 4): p50/p90/p99 TTFT / TPOT / queue wait /
     # e2e of the timed batched pass, from the observability histograms —
     # the PERF.json `serving_latency` section future perf PRs regress
@@ -425,6 +504,8 @@ def run_serving_bench() -> int:
         "device_time": device_time,
         "batched_admission": batched,
         "per_slot_admission": perslot,
+        "open_loop": {**open_loop,
+                      "byte_identical_vs_burst": toks_ol == toks_b},
         "admission_dispatch_ratio": round(
             perslot["admission_dispatches"]
             / max(1, batched["admission_dispatches"]), 2),
@@ -445,6 +526,260 @@ def run_serving_bench() -> int:
         tp.pop("device", None)
         out["tp"] = {**tp, "mesh": dict(mesh.shape),
                      "parity_vs_single_device": toks_tp == toks_b}
+    print(json.dumps(out))
+    return 0
+
+
+def run_paged_kv_bench() -> int:
+    """Paged-KV allocator benchmark (one JSON line -> PERF.json
+    `paged_kv`; see the module docstring). TINY shapes throughout —
+    every gate here is an INVARIANT (byte-identity, concurrency bound,
+    shed order, bounded TPOT ratio), not a host-speed number, and the
+    storm arm is chaos-paced so the per-turn sleep dominates compute
+    and the ratio is deterministic on any host."""
+    import time as _time
+
+    sys.path.insert(0, str(REPO))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tony_tpu.models import transformer
+    from tony_tpu.models.serving import QueueFullError, Request, SlotServer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    B, max_len, chunk = 8, 64, 8
+    ring_slots = 4
+    pool_blocks = ring_slots * max_len // B     # EQUAL device memory
+    rng = np.random.default_rng(16)
+
+    # ---- arm 1: byte-identity + concurrency above the ring bound ----
+    # Requests sized so the pool holds ~10 concurrent block tables
+    # (mean ~3 blocks each) where the ring engine pins concurrency at
+    # ring_slots=4 regardless of actual KV bytes.
+    plens, budgets_c = [6, 10, 14, 18], [6, 12, 8, 10]
+    n_requests = 16
+    prompts = [rng.integers(0, cfg.vocab_size, size=plens[i % 4],
+                            dtype=np.int32) for i in range(n_requests)]
+
+    def drive(srv):
+        """run_until_drained, sampling peak concurrent active slots."""
+        reqs = [Request(prompt=p, max_new_tokens=budgets_c[i % 4])
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            srv.submit(r)
+        done: dict = {}
+        peak = 0
+        t0 = _time.time()
+        while not srv.idle:
+            srv.step()
+            peak = max(peak, srv.n_active)
+            if srv._done:
+                done.update(srv.drain_completed())
+        done.update(srv.drain_completed())
+        wall = _time.time() - t0
+        toks = {i: done[r.id].tokens for i, r in enumerate(reqs)}
+        reasons = [done[r.id].finish_reason for r in reqs]
+        return toks, peak, wall, reasons
+
+    def mk_ring():
+        return SlotServer(params, cfg, slots=ring_slots, max_len=max_len,
+                          block_size=4, prefill_chunk=chunk)
+
+    def mk_paged(**kw):
+        kw.setdefault("slots", 12)
+        kw.setdefault("kv_pool_blocks", pool_blocks)
+        return SlotServer(params, cfg, max_len=max_len, block_size=4,
+                          prefill_chunk=chunk, paged=True, kv_block=B,
+                          **kw)
+
+    drive(mk_ring())                            # compile warm-up
+    toks_ring, peak_ring, wall_ring, reasons_r = drive(mk_ring())
+    drive(mk_paged())
+    paged_srv = mk_paged()
+    toks_paged, peak_paged, wall_paged, reasons_p = drive(paged_srv)
+    pkv = paged_srv.stats()["paged_kv"]
+    assert toks_paged == toks_ring, (
+        "paged engine diverged from the ring engine on greedy outputs")
+    assert all(r in ("stop", "length") for r in reasons_r + reasons_p), (
+        f"failed/early requests: {reasons_r} {reasons_p}")
+    assert peak_paged > ring_slots, (
+        f"paged peak concurrency {peak_paged} did not exceed the ring "
+        f"slots x max_len bound ({ring_slots}) at equal device memory")
+    assert pkv["pool_blocks_peak"] <= pool_blocks
+    paged_srv._allocator.check()
+
+    # ---- arm 2: admission-storm TPOT, interleave on vs off ----------
+    # 20ms per scheduling turn dwarfs TINY compute, so TPOT measures
+    # TURN CADENCE: interleaved prefill rides the decode turn (cadence
+    # unchanged, ratio ~1.0x) while the uncapped pump drains the whole
+    # storm's chunks inside ONE turn (a concentrated stall every
+    # in-flight stream feels).
+    os.environ["TONY_TEST_SERVING_STEP_DELAY_MS"] = "20"
+    try:
+        def run_storm(interleave, storm=True):
+            srv = SlotServer(
+                params, cfg, slots=16, max_len=max_len, block_size=4,
+                prefill_chunk=chunk, paged=True, kv_block=B,
+                kv_pool_blocks=128, prefill_interleave=interleave)
+            r2 = np.random.default_rng(17)
+            cohort = [Request(prompt=r2.integers(0, cfg.vocab_size,
+                                                 size=8, dtype=np.int32),
+                              max_new_tokens=32) for _ in range(4)]
+            for r in cohort:
+                srv.submit(r)
+            for _ in range(6):          # cohort admitted + mid-decode
+                srv.step()
+                srv.checkpoint_progress()
+            if storm:
+                for _ in range(12):     # 6 prefill chunks each
+                    srv.submit(Request(
+                        prompt=r2.integers(0, cfg.vocab_size, size=48,
+                                           dtype=np.int32),
+                        max_new_tokens=1))
+            done: dict = {}
+            turn_walls = []
+            while not srv.idle:
+                t1 = _time.time()
+                srv.step()
+                # predictive processing is lazy; pace it per turn the
+                # way ServeApp's journal checkpoint does, so the host
+                # first_token/finished marks (the TPOT spans) track
+                # turn cadence instead of collapsing into one
+                # end-of-run processing burst
+                srv.checkpoint_progress()
+                turn_walls.append(_time.time() - t1)
+                if srv._done:
+                    done.update(srv.drain_completed())
+            done.update(srv.drain_completed())
+            assert all(c.finish_reason in ("stop", "length")
+                       for c in done.values())
+            # cohort-only TPOT, exact from the request traces (the
+            # stats histogram is bucket-resolution; at 4 samples the
+            # quantization would dominate the gated ratio) — the
+            # storm's max_new=1 requests emit no TPOT samples
+            tpots = []
+            for c in done.values():
+                spans = dict(c.trace["spans"])
+                n = len(c.tokens)
+                if "first_token" in spans and "finished" in spans \
+                        and n >= 2:
+                    tpots.append(
+                        (spans["finished"] - spans["first_token"])
+                        / (n - 1))
+            assert len(tpots) == 4, f"cohort TPOT samples: {len(tpots)}"
+            return {
+                "tpot_p99_s": max(tpots),
+                "max_turn_s": round(max(turn_walls), 4),
+                "chunks_interleaved":
+                    srv.stats()["paged_kv"]["prefill_chunks_interleaved"],
+            }
+
+        run_storm(chunk, storm=True)    # compile warm-up: every program
+        run_storm(0, storm=True)        # shape both timed arms will hit
+        quiescent = run_storm(chunk, storm=False)
+        storm_on = run_storm(chunk, storm=True)
+        storm_off = run_storm(0, storm=True)
+    finally:
+        del os.environ["TONY_TEST_SERVING_STEP_DELAY_MS"]
+    tpot_ratio_on = storm_on["tpot_p99_s"] / quiescent["tpot_p99_s"]
+    assert tpot_ratio_on <= 1.2, (
+        f"storm TPOT p99 with interleaving is {tpot_ratio_on:.2f}x "
+        "quiescent (gate: <= 1.2x)")
+    assert storm_on["chunks_interleaved"] > 0, (
+        "the storm never exercised the interleave cap")
+    assert storm_off["max_turn_s"] > 1.5 * storm_on["max_turn_s"], (
+        "uncapped admission should stall one turn for the whole "
+        f"storm's prefill: off {storm_off['max_turn_s']}s vs "
+        f"on {storm_on['max_turn_s']}s")
+
+    # ---- arm 3: admission tiers — batch sheds before interactive ----
+    srv = SlotServer(params, cfg, slots=2, max_len=max_len, block_size=4,
+                     prefill_chunk=chunk, paged=True, kv_block=B,
+                     max_queue=4, batch_queue_frac=0.5)
+    r3 = np.random.default_rng(18)
+
+    def _req(priority):
+        return Request(prompt=r3.integers(0, cfg.vocab_size, size=6,
+                                          dtype=np.int32),
+                       max_new_tokens=12, priority=priority)
+
+    occupants = [_req("interactive") for _ in range(2)]
+    for r in occupants:
+        srv.submit(r)
+    for _ in range(4):                  # both slots occupied, mid-decode
+        srv.step()
+    refused = {"batch": 0, "interactive": 0}
+    retry_afters = []
+    submitted = []
+    # batch fills its (frac-limited) share of the queue, then 429s
+    for _ in range(3):
+        try:
+            submitted.append(srv.submit(_req("batch")))
+        except QueueFullError as e:
+            refused[e.priority] += 1
+            retry_afters.append(e.retry_after_s)
+    # interactive fills the rest, then displaces the queued batch work
+    for _ in range(5):
+        try:
+            submitted.append(srv.submit(_req("interactive")))
+        except QueueFullError as e:
+            refused[e.priority] += 1
+            retry_afters.append(e.retry_after_s)
+    done = srv.run_until_drained()
+    shed = srv.stats()["shed_by_class"]
+    shed_completions = [c for c in done.values()
+                        if c.finish_reason == "shed"]
+    assert refused["batch"] >= 1, "batch tier never hit its 429 line"
+    assert shed["batch"] >= len(shed_completions) >= 2, (
+        f"queued batch work was not displaced: {shed}")
+    assert all(1 <= ra <= 60 for ra in retry_afters), retry_afters
+    # every interactive request either finished or was refused AT THE
+    # DOOR with Retry-After — none failed, none displaced mid-queue
+    n_interactive_ok = sum(
+        1 for c in done.values() if c.finish_reason in ("stop", "length"))
+    assert n_interactive_ok == 2 + 5 - refused["interactive"] + \
+        3 - refused["batch"] - len(shed_completions), done
+    srv._allocator.check()
+
+    out = {
+        "metric": "paged_kv_storm_tpot_p99_ratio_vs_quiescent",
+        "value": round(tpot_ratio_on, 3),
+        "unit": "x (chunked-prefill interleaving ON; gate <= 1.2x)",
+        "kv_block": B,
+        "pool_blocks": pool_blocks,
+        "equal_device_memory_kv_rows": pool_blocks * B,
+        "ring_concurrency_bound": ring_slots,
+        "peak_concurrent_paged": peak_paged,
+        "byte_identical_vs_ring": True,
+        "zero_failed_requests": True,
+        "ring_wall_s": round(wall_ring, 3),
+        "paged_wall_s": round(wall_paged, 3),
+        "admission_defers": pkv["admission_defers"],
+        "storm": {
+            "chaos_step_delay_ms": 20,
+            "quiescent_tpot_p99_s": round(quiescent["tpot_p99_s"], 4),
+            "interleave_on_tpot_p99_s":
+                round(storm_on["tpot_p99_s"], 4),
+            "interleave_off_tpot_p99_s":
+                round(storm_off["tpot_p99_s"], 4),
+            "interleave_on_max_turn_s": storm_on["max_turn_s"],
+            "interleave_off_max_turn_s": storm_off["max_turn_s"],
+            "chunks_interleaved": storm_on["chunks_interleaved"],
+        },
+        "tiers": {
+            "shed_by_class": shed,
+            "queued_batch_displaced": len(shed_completions),
+            "refused_429": refused,
+            "retry_after_s_range": [min(retry_afters),
+                                    max(retry_afters)],
+            "batch_shed_before_interactive":
+                shed["interactive"] <= refused["interactive"],
+        },
+    }
     print(json.dumps(out))
     return 0
 
@@ -601,10 +936,13 @@ def run_serving_fleet_bench() -> int:
       headroom is compute AND cache capacity: the per-replica trie
       budget holds 2/3 of the template working set, so the
       affinity-routed fleet holds it collectively while the single
-      replica churns it through LRU eviction. Poisson OPEN-LOOP passes
-      at 1.2x the measured fleet capacity are reported alongside (the
-      lone replica collapses into deep queueing at fleet-rate
-      traffic).
+      replica churns it through LRU eviction. Open-loop CAPACITY arms
+      ride along: Poisson arrivals offered at each arm's own measured
+      capacity, best-of-`trials`, enforcing a softer 1.3x fleet
+      advantage (per-pass open-loop walls swing with placement).
+      Poisson OPEN-LOOP passes at 1.2x the measured fleet capacity are
+      reported alongside (the lone replica collapses into deep
+      queueing at fleet-rate traffic).
     - **prefix-affinity vs random routing**: the same open-loop
       schedule routed sticky vs least-loaded, after an untimed
       steady-state prepass per policy. Affinity must beat random on
@@ -906,6 +1244,26 @@ def run_serving_fleet_bench() -> int:
                 fleet, concurrency=2 * slots * n_fleet))
         cap_single = max(single_runs)
         cap_fleet = max(fleet_runs)
+        # open-loop CAPACITY arms (ISSUE 16): the same capacity
+        # question asked the way traffic actually arrives — seeded
+        # Poisson arrivals offered at each arm's OWN measured
+        # closed-loop capacity, best-of-`trials`. Per-pass open-loop
+        # walls on this host class swing with scheduler placement (the
+        # ~3x above), so the enforced ratio here is softer (1.3x) than
+        # the closed-loop 1.5x; the closed-loop number stays the
+        # headline capacity.
+        def open_loop_capacity(reps, cap):
+            sched = np.cumsum(rng.exponential(
+                scale=max_new / cap, size=n_requests)).tolist()
+            return run_pass(reps, affinity=True,
+                            schedule=sched)["tokens_per_sec"]
+        ol_single_runs, ol_fleet_runs = [], []
+        for _ in range(trials):
+            ol_single_runs.append(
+                open_loop_capacity(single_arm, cap_single))
+            ol_fleet_runs.append(open_loop_capacity(fleet, cap_fleet))
+        ol_single = max(ol_single_runs)
+        ol_fleet = max(ol_fleet_runs)
         # the open-loop (Poisson) passes run at 1.2x the measured FLEET
         # capacity: the single arm is then deeply saturated (the
         # open-loop collapse a lone replica suffers at fleet-rate
@@ -931,12 +1289,18 @@ def run_serving_fleet_bench() -> int:
             r.stop()
 
     print(f"# capacity single {cap_single:.0f} {single_runs} | fleet "
-          f"{cap_fleet:.0f} {fleet_runs} | open-loop single {single} | "
+          f"{cap_fleet:.0f} {fleet_runs} | open-loop capacity single "
+          f"{ol_single_runs} fleet {ol_fleet_runs} | "
+          f"open-loop single {single} | "
           f"fleet {fleet_pass} | affinity {affinity_pass} | "
           f"random {random_pass}", file=sys.stderr)
     speedup = round(cap_fleet / cap_single, 3)
     assert speedup > 1.5, (
         f"fleet speedup {speedup} <= 1.5x single replica")
+    speedup_open_loop = round(ol_fleet / ol_single, 3)
+    assert speedup_open_loop > 1.3, (
+        f"open-loop fleet speedup {speedup_open_loop} <= 1.3x single "
+        f"replica (single {ol_single_runs}, fleet {ol_fleet_runs})")
     assert (affinity_pass["prefill_reused_frac"]
             > random_pass["prefill_reused_frac"]), (
         "prefix-affinity routing must beat random routing on trie reuse")
@@ -958,6 +1322,13 @@ def run_serving_fleet_bench() -> int:
         "capacity_fleet_tokens_per_sec": round(cap_fleet, 1),
         "capacity_single_all_trials": [round(v, 1) for v in single_runs],
         "capacity_fleet_all_trials": [round(v, 1) for v in fleet_runs],
+        "speedup_open_loop": speedup_open_loop,
+        "capacity_single_open_loop_tokens_per_sec": round(ol_single, 1),
+        "capacity_fleet_open_loop_tokens_per_sec": round(ol_fleet, 1),
+        "open_loop_capacity_all_trials": {
+            "single": [round(v, 1) for v in ol_single_runs],
+            "fleet": [round(v, 1) for v in ol_fleet_runs],
+        },
         "open_loop_single_replica": single,
         "open_loop_fleet": fleet_pass,
         "prefix_cache_blocks_per_replica": cache_blocks,
@@ -3158,6 +3529,8 @@ def main() -> int:
     if "--elastic" in sys.argv:
         return run_elastic_bench()
     if "--serving" in sys.argv:
+        if "--paged-kv" in sys.argv:
+            return run_paged_kv_bench()
         if "--streaming" in sys.argv:
             return run_serving_streaming_bench()
         if "--spec" in sys.argv:

@@ -755,8 +755,8 @@ def test_finish_reason_vocabulary_pinned():
     # the pinned sets themselves (a rename/removal is a doc+router
     # migration, not a drive-by)
     assert COMPLETION_FINISH_REASONS == ("stop", "length", "cancelled",
-                                         "expired")
-    assert FINISH_REASONS == COMPLETION_FINISH_REASONS + ("shed", "failed")
+                                         "expired", "shed")
+    assert FINISH_REASONS == COMPLETION_FINISH_REASONS + ("failed",)
     # trace terminals <-> finish reasons: "finished" carries the
     # stop/length reason in attrs; every other terminal IS its reason
     from tony_tpu.observability import TERMINAL_SPANS

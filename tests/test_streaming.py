@@ -15,6 +15,7 @@ loop crash (the PR 11 replay riding underneath an open stream).
 import json
 import re
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import ThreadingHTTPServer
@@ -275,6 +276,24 @@ def test_api_contract_pinned_against_docs():
     from tony_tpu.models.serving import COMPLETION_FINISH_REASONS
 
     assert set(oai.FINISH_REASON_MAP) == set(COMPLETION_FINISH_REASONS)
+    # admission-tier surface: both /v1 param sets honor `priority`
+    # (engine classes, docs "Paged KV & admission tiers"), a shed
+    # completion maps onto the wire, and every 429 producer advertises
+    # Retry-After — serve derives it (engine estimate folded with the
+    # autoscaler cooldown hint), the router PROPAGATES the replica
+    # value instead of synthesizing its own
+    import inspect
+
+    import tony_tpu.cli.serve as serve_mod
+    import tony_tpu.router as router_mod
+
+    assert "priority" in oai.COMPLETION_REQUEST_PARAMS
+    assert "priority" in oai.CHAT_REQUEST_PARAMS
+    assert oai.FINISH_REASON_MAP.get("shed") == "shed"
+    serve_src = inspect.getsource(serve_mod)
+    router_src = inspect.getsource(router_mod)
+    assert "Retry-After" in serve_src and "retry_after_s" in serve_src
+    assert "Retry-After" in router_src and "min_retry_after" in router_src
 
 
 # --------------------------------------------------------------------------
@@ -651,6 +670,126 @@ def test_v1_logprobs_choices_and_stop(params):
         assert ei.value.code == 400
         err = json.loads(ei.value.read().decode())["error"]
         assert err["type"] == "invalid_request_error"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
+# --------------------------------------------------------------------------
+# SSE reconnect (Last-Event-ID) + engine-derived Retry-After
+# --------------------------------------------------------------------------
+
+def _sse_post_with_ids(port, path, payload, headers=None, timeout=120):
+    """POST expecting SSE; returns (data_frames, id_lines)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    frames, ids = [], []
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        assert r.headers["Content-Type"] == "text/event-stream"
+        for raw in r:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                frames.append(json.loads(line[len("data: "):]))
+            elif line.startswith("id: "):
+                ids.append(line[len("id: "):])
+    return frames, ids
+
+
+def test_sse_last_event_id_reconnect_resumes(params):
+    """docs/serving.md "SSE reconnect": a client that lost its stream
+    re-POSTs with the last frame's ``id: <rid>:<n>`` — the server pops
+    the parked prefix (what on_disconnect saved), teacher-forces it,
+    withholds the first n already-acked tokens, and the concatenated
+    re-delivery is byte-identical to the unbroken stream past the ack
+    point. A malformed header is ignored (fresh run)."""
+    srv, app, httpd, port = _http_app(params)
+    try:
+        prompt = _prompt(6, seed=33).tolist()
+        ref = _json_post(port, "/generate",
+                         {"prompt": prompt, "max_new_tokens": 8})
+        full, rid = ref["tokens"], ref["id"]
+        assert len(full) == 8
+        # the disconnect path: the handler parked the delivered prefix
+        app.save_resume_prefix(rid, full[:5])
+        # client acked 3 of those 5 before the link died
+        frames, ids = _sse_post_with_ids(
+            port, "/generate?stream=true",
+            {"prompt": prompt, "max_new_tokens": 8},
+            headers={"Last-Event-ID": f"{rid}:3"})
+        got = [t for f in frames if "tokens" in f for t in f["tokens"]]
+        assert got == full[3:], "resumed delivery diverged from stream"
+        closing = frames[-1]
+        assert closing["finish_reason"] == "length"
+        assert closing["n_tokens"] == len(full) - 3
+        # every frame carries the reconnect cursor; the final id acks
+        # the full absolute position (teacher-forced prefix included)
+        assert ids and all(":" in i for i in ids)
+        assert ids[-1].split(":")[1] == str(len(full))
+        # the parked prefix is single-use: it was popped
+        assert app.resume_prefix(rid) is None
+        # malformed header -> fresh full run, not an error
+        frames, _ = _sse_post_with_ids(
+            port, "/generate?stream=true",
+            {"prompt": prompt, "max_new_tokens": 8},
+            headers={"Last-Event-ID": "not-a-cursor"})
+        got = [t for f in frames if "tokens" in f for t in f["tokens"]]
+        assert got == full
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
+def test_retry_after_folds_engine_estimate_and_autoscale_hint(params):
+    """The 429 Retry-After contract (docs/serving.md "Paged KV &
+    admission tiers"): the advertised value is the MAX of the engine's
+    service-rate estimate and the autoscaler's pushed cooldown hint
+    (POST /autoscale/hint), clamped to [1, 60] — and the hint decays
+    on its own so a dead driver cannot pin it forever."""
+    srv, app, httpd, port = _http_app(params, max_queue=1)
+    try:
+        assert app.retry_after_s(engine_estimate=7.4) == 8
+        assert app.retry_after_s(engine_estimate=10_000) == 60
+        app.set_autoscale_hint(23.0)
+        assert app.retry_after_s(engine_estimate=2.0) == 23
+        app.set_autoscale_hint(0.0)     # decay-to-zero shape
+        assert app.retry_after_s(engine_estimate=2.0) == 2
+        # over HTTP: push a hint, then saturate the 1-deep queue and
+        # read the folded header off a real 429
+        _json_post(port, "/autoscale/hint", {"cooldown_s": 17.0})
+        hits: list[int] = []
+
+        def occupy(s):
+            try:
+                _json_post(port, "/generate",
+                           {"prompt": _prompt(6, seed=s).tolist(),
+                            "max_new_tokens": 10})
+            except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    hits.append(int(e.headers["Retry-After"]))
+                e.read()
+        occupied = [threading.Thread(target=occupy, args=(50 + i,))
+                    for i in range(6)]
+        for t in occupied:
+            t.start()
+        for t in occupied:
+            t.join(timeout=60)
+        assert hits, "6 concurrent posts never saturated the 1-deep queue"
+        # the pushed 17s hint dominates the TINY engine's 1-2s estimate
+        # but decays in real time between the push and each 429 — allow
+        # for a few seconds of warm-up/prefill before the sheds landed
+        assert all(10 <= ra <= 60 for ra in hits), hits
+        # a bad hint is a 400, never a silent reset
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/autoscale/hint",
+            data=json.dumps({"cooldown_s": -3}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
     finally:
         httpd.shutdown()
         httpd.server_close()

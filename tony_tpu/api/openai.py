@@ -50,11 +50,11 @@ __all__ = [
 # ignored, except the validated-if-present ones noted in the doc)
 COMPLETION_REQUEST_PARAMS = frozenset((
     "model", "prompt", "max_tokens", "temperature", "top_k", "stream",
-    "timeout_s", "stop", "logprobs",
+    "timeout_s", "stop", "logprobs", "priority",
 ))
 CHAT_REQUEST_PARAMS = frozenset((
     "model", "messages", "max_tokens", "temperature", "top_k", "stream",
-    "timeout_s", "stop", "logprobs", "top_logprobs",
+    "timeout_s", "stop", "logprobs", "top_logprobs", "priority",
 ))
 
 COMPLETION_RESPONSE_KEYS = frozenset((
@@ -70,14 +70,17 @@ USAGE_KEYS = frozenset(("prompt_tokens", "completion_tokens",
 
 # engine finish_reason (models/serving.py COMPLETION_FINISH_REASONS) ->
 # the /v1 wire value. "stop"/"length" are the OpenAI vocabulary;
-# "cancelled"/"expired" pass through VERBATIM (non-standard, documented)
-# — lying "stop" about a truncated stream would break any client that
-# trusts the enum to mean "the model chose to end here".
+# "cancelled"/"expired"/"shed" pass through VERBATIM (non-standard,
+# documented) — lying "stop" about a truncated stream would break any
+# client that trusts the enum to mean "the model chose to end here".
+# "shed" is the per-class admission-tier displacement terminal: a
+# buffered waiter gets HTTP 429 + Retry-After instead of a body.
 FINISH_REASON_MAP = {
     "stop": "stop",
     "length": "length",
     "cancelled": "cancelled",
     "expired": "expired",
+    "shed": "shed",
 }
 
 
@@ -149,6 +152,15 @@ def _common_params(payload: dict) -> dict:
     if not 0 < timeout < float("inf"):
         raise ValueError("timeout_s must be a positive finite number")
     out["timeout_s"] = timeout
+    # admission tier (engine PRIORITY_CLASSES): "interactive" (default)
+    # is shed last, "batch" first — validated here so a typo'd tier is
+    # a 400, not a silently-interactive request
+    pri = payload.get("priority")
+    if pri is not None:
+        if pri not in ("interactive", "batch"):
+            raise ValueError(
+                "priority must be 'interactive' or 'batch'")
+        out["priority"] = pri
     return out
 
 
@@ -355,30 +367,51 @@ def completion_chunk(rid, model: str, tokens, codec: TokenCodec,
     }
 
 
-def stream_frame_fns(rid, model: str, codec: TokenCodec, chat: bool):
+def stream_frame_fns(rid, model: str, codec: TokenCodec, chat: bool,
+                     skip: int = 0, collect: list | None = None):
     """The three byte-builders one /v1 SSE relay needs — shared by the
     serve and router front doors so the framing can't drift between
     them: ``frame(tokens)`` per delta (the first chat delta carries the
     assistant role), ``final(reason)`` = closing chunk + ``[DONE]``,
-    ``err(message)`` = the in-band OpenAI error envelope."""
+    ``err(message)`` = the in-band OpenAI error envelope.
+
+    SSE reconnect support (docs/serving.md "SSE reconnect"): every
+    delta/closing frame carries an ``id: <rid>:<abs>`` line — the
+    absolute emitted-token cursor a client echoes back as
+    ``Last-Event-ID``. On a resumed stream ``skip`` already-acked
+    tokens are withheld (the engine re-emits the teacher-forced resume
+    prefix; the client saw it). ``collect`` (when given) accumulates
+    every token the stream carried — resume prefix included — so the
+    caller can park it for the NEXT reconnect at disconnect."""
     from .stream import SSE_DONE, sse_frame
 
     first = {"v": True}
+    seen = {"n": 0}
 
     def frame(toks):
+        toks = [int(t) for t in toks]
+        if collect is not None:
+            collect.extend(toks)
+        start = max(0, skip - seen["n"])
+        seen["n"] += len(toks)
+        toks = toks[start:]
+        if not toks:
+            # fully acked (resume replay): nothing to re-deliver; the
+            # role delta (chat) rides the first frame with NEW tokens
+            return b""
         if chat:
             obj = chat_chunk(rid, model, toks, codec, first=first["v"])
             first["v"] = False
         else:
             obj = completion_chunk(rid, model, toks, codec)
-        return sse_frame(obj)
+        return sse_frame(obj, event_id=f"{rid}:{seen['n']}")
 
     def final(reason):
         obj = (chat_chunk(rid, model, [], codec, finish_reason=reason,
                           first=first["v"]) if chat
                else completion_chunk(rid, model, [], codec,
                                      finish_reason=reason))
-        return sse_frame(obj) + SSE_DONE
+        return sse_frame(obj, event_id=f"{rid}:{seen['n']}") + SSE_DONE
 
     def err(msg):
         return sse_frame({"error": {"message": str(msg),

@@ -35,7 +35,8 @@ import json
 import threading
 import time
 
-__all__ = ["TokenStream", "sse_frame", "SSE_HEADERS", "SSE_DONE"]
+__all__ = ["TokenStream", "sse_frame", "parse_last_event_id",
+           "SSE_HEADERS", "SSE_DONE"]
 
 
 # the Content-Type + anti-buffering headers every streaming response
@@ -50,11 +51,32 @@ SSE_HEADERS = (
 SSE_DONE = b"data: [DONE]\n\n"
 
 
-def sse_frame(obj) -> bytes:
+def sse_frame(obj, event_id: str | None = None) -> bytes:
     """One ``data:`` SSE frame. ``obj`` is JSON-serialized unless it is
-    already a string (the ``[DONE]`` sentinel path)."""
+    already a string (the ``[DONE]`` sentinel path). ``event_id``
+    prepends an ``id:`` line — the browser EventSource reconnect
+    contract: the client echoes the last seen id back as a
+    ``Last-Event-ID`` header, and the server resumes the stream from
+    that absolute token position (docs/serving.md "SSE reconnect")."""
     data = obj if isinstance(obj, str) else json.dumps(obj)
-    return b"data: " + data.encode() + b"\n\n"
+    head = (b"id: " + str(event_id).encode() + b"\n"
+            if event_id is not None else b"")
+    return head + b"data: " + data.encode() + b"\n\n"
+
+
+def parse_last_event_id(value) -> tuple[int, int] | None:
+    """Parse a client's ``Last-Event-ID`` header — ``"<rid>:<n>"``, the
+    shape every streaming frame's ``id:`` line carries (request id +
+    absolute delivered-token count). Returns ``(rid, n)``, or None on
+    absent/malformed input: a bad header degrades to a fresh request,
+    never a 4xx/500."""
+    if not value:
+        return None
+    try:
+        rid, n = str(value).split(":", 1)
+        return int(rid), max(0, int(n))
+    except ValueError:
+        return None
 
 
 def read_json_body(handler) -> dict:

@@ -362,6 +362,17 @@ class AutoscaleController:
                 "down", f"signals clear for {now - self._clear_since:.0f}s")
         return None
 
+    def cooldown_remaining(self, now: float | None = None) -> float:
+        """Seconds left in the armed cooldown (0.0 when none is armed).
+        The serving layer folds this into 429 ``Retry-After`` hints: a
+        client told to come back AFTER the cooldown lands when capacity
+        can actually have changed, instead of re-slamming a fleet that
+        is contractually frozen."""
+        if self.last_scale_t is None:
+            return 0.0
+        now = self._now() if now is None else now
+        return max(0.0, self.cooldown_s - (now - self.last_scale_t))
+
     def note_scaled(self, direction: str, now: float | None = None) -> None:
         """The actuation actually happened: arm the cooldown."""
         now = self._now() if now is None else now

@@ -112,6 +112,39 @@ def build_argparser() -> argparse.ArgumentParser:
                         "waiting are shed with HTTP 429 + Retry-After "
                         "instead of queueing past their deadlines "
                         "(0 = unbounded)")
+    p.add_argument("--paged-kv", action="store_true",
+                   help="swap the slots x max-len ring KV cache for one "
+                        "paged block pool with per-slot block tables "
+                        "(docs/serving.md 'Paged KV & admission tiers'): "
+                        "admission is gated on free pool blocks, so "
+                        "concurrency is bounded by actual KV demand "
+                        "instead of the worst-case slot reservation")
+    p.add_argument("--kv-block", type=int, default=0,
+                   help="with --paged-kv: tokens per KV block (must "
+                        "divide --max-len and --prefill-chunk; default: "
+                        "--block-size)")
+    p.add_argument("--kv-pool-blocks", type=int, default=0,
+                   help="with --paged-kv: allocatable blocks in the "
+                        "shared pool — the real KV memory budget "
+                        "(default: slots * max-len / kv-block, the ring "
+                        "equivalent; set it LOWER to oversubscribe)")
+    p.add_argument("--prefill-interleave", type=int, default=0,
+                   help="with --paged-kv: pump at most this many pending "
+                        "prefill TOKENS per decode block so a long "
+                        "admission storm cannot stall running decodes "
+                        "(0 = prefills run to completion at admission)")
+    p.add_argument("--class-budget-interactive", type=int, default=0,
+                   help="with --paged-kv: cap the KV blocks the "
+                        "'interactive' tier may hold exclusively "
+                        "(0 = uncapped)")
+    p.add_argument("--class-budget-batch", type=int, default=0,
+                   help="with --paged-kv: cap the KV blocks the 'batch' "
+                        "tier may hold exclusively (0 = uncapped)")
+    p.add_argument("--batch-queue-frac", type=float, default=0.5,
+                   help="with --max-queue: batch-priority requests are "
+                        "shed once the queue is this fraction full "
+                        "(interactive requests use the full queue and "
+                        "displace queued batch work under pressure)")
     p.add_argument("--loop-max-restarts", type=int, default=3,
                    help="serving-loop recovery budget: consecutive step "
                         "failures tolerated (each one resets the slot "
@@ -392,6 +425,24 @@ class ServeApp:
         self._progress_keys: "_collections.OrderedDict[str, int]" = \
             _collections.OrderedDict()
         self._progress_keys_cap = 4096
+        # SSE reconnect state (docs/serving.md "SSE reconnect"): when a
+        # streaming client vanishes mid-stream, the handler parks the
+        # request's full emitted prefix here under its request id —
+        # exactly the journaled prefix, since stream feeds advance in
+        # lockstep with the journal. A reconnect presenting
+        # ``Last-Event-ID: <rid>:<n>`` pops it, teacher-forces the
+        # prefix into a fresh request, and re-delivers only the tokens
+        # past the client's acked position. Single-use, bounded FIFO.
+        self._resume_cache: "_collections.OrderedDict[int, list[int]]" = \
+            _collections.OrderedDict()
+        self._resume_cache_cap = 256
+        # fleet-autoscaler backpressure hint: (remaining scale-up
+        # cooldown seconds, the monotonic instant it was set). Folded
+        # into 429 Retry-After so a shed client is told to come back
+        # when new capacity can actually exist — not merely when one
+        # queue seat frees. Pushed by the driver's autoscale tick
+        # (POST /autoscale/hint) or set in-process; decays on its own.
+        self._autoscale_hint: tuple[float, float] = (0.0, 0.0)
         # serving-load gauges (active slots, queue depth, reused-token
         # fraction, shed/cancelled/expired/restart counters) accumulated
         # the same way TaskMonitor accumulates executor metrics —
@@ -709,7 +760,8 @@ class ServeApp:
                      model: str | None = None,
                      stream=None,
                      stop: list | None = None,
-                     logprobs: int = 0):
+                     logprobs: int = 0,
+                     priority: str = "interactive"):
         """Admission half of generate(): returns (request_id, event). The
         request carries ``timeout`` as its queue deadline — if it is
         still queued when the waiter would have given up, admission skips
@@ -721,7 +773,9 @@ class ServeApp:
         to the named engine (multi-model serving); ``stream`` attaches
         a caller-owned ``api.stream.TokenStream`` for per-token
         delivery — attachment is atomic with the submit, so no emitted
-        token can slip between them."""
+        token can slip between them; ``priority`` is the admission
+        tier ("interactive" | "batch" — docs/serving.md "Paged KV &
+        admission tiers")."""
         from ..models.serving import Request
 
         engine = self._engine_for(model)
@@ -731,6 +785,7 @@ class ServeApp:
                       resume_tokens=resume_tokens,
                       deadline=time.monotonic() + timeout,
                       stop=stop, logprobs=int(logprobs or 0),
+                      priority=str(priority or "interactive"),
                       model=getattr(engine, "model", None)
                       if model is not None else None)
         ev = threading.Event()
@@ -914,18 +969,88 @@ class ServeApp:
         if callable(est):
             m.observe(_metrics.SERVING_RETRY_AFTER_S, float(est()))
 
-    def retry_after_s(self) -> int:
-        """The 429 Retry-After value: the engine's service-rate estimate
-        (seconds until a queue seat frees, [1, 60]); 1 when the engine
-        has no estimator (test stubs) or the estimate fails."""
-        est = getattr(self.server, "estimate_retry_after", None)
-        if not callable(est):
-            return 1
-        try:
-            with self.lock:
-                return max(1, min(60, int(est())))
-        except Exception:
-            return 1
+    def set_autoscale_hint(self, cooldown_s: float) -> None:
+        """Record the fleet autoscaler's remaining scale-up cooldown
+        (seconds). Every 429 Retry-After from now on advertises at
+        least this window (decaying as wall time passes): a shed client
+        told to retry in 2s against a fleet that cannot add a replica
+        for 20s just gets shed again 10 times. The driver's autoscale
+        tick pushes it over POST /autoscale/hint after each scale
+        decision; 0 clears it."""
+        with self.lock:
+            self._autoscale_hint = (max(0.0, float(cooldown_s)),
+                                    time.monotonic())
+
+    def _autoscale_hint_remaining_locked(self) -> float:
+        hint, t0 = self._autoscale_hint
+        if hint <= 0.0:
+            return 0.0
+        return max(0.0, hint - (time.monotonic() - t0))
+
+    def retry_after_s(self, engine_estimate: float | None = None) -> int:
+        """The 429 Retry-After value: the LARGER of the engine's
+        service-rate estimate (seconds until a queue seat frees —
+        passed in when the shed already carried one, re-asked
+        otherwise) and the autoscaler's remaining scale-up cooldown
+        (``set_autoscale_hint``), clamped to [1, 60]; 1 when the
+        engine has no estimator (test stubs) or the estimate fails."""
+        import math
+
+        est = 0.0
+        if engine_estimate is not None:
+            try:
+                est = float(engine_estimate)
+            except (TypeError, ValueError):
+                est = 0.0
+        else:
+            fn = getattr(self.server, "estimate_retry_after", None)
+            if callable(fn):
+                try:
+                    with self.lock:
+                        est = float(fn())
+                except Exception:
+                    est = 0.0
+        with self.lock:
+            cooldown = self._autoscale_hint_remaining_locked()
+        return max(1, min(60, int(math.ceil(max(est, cooldown, 1.0)))))
+
+    # ----------------------------------------------------- SSE reconnect
+
+    def save_resume_prefix(self, request_id: int, tokens) -> None:
+        """Park a vanished streaming client's full emitted prefix so a
+        ``Last-Event-ID`` reconnect can resume it (docs/serving.md "SSE
+        reconnect"). The handler accumulates exactly what the stream
+        fed it — the journaled prefix — and saves it at disconnect."""
+        toks = [int(t) for t in tokens]
+        if not toks:
+            return
+        with self.lock:
+            self._resume_cache[int(request_id)] = toks
+            self._resume_cache.move_to_end(int(request_id))
+            while len(self._resume_cache) > self._resume_cache_cap:
+                self._resume_cache.popitem(last=False)
+
+    def resume_prefix(self, request_id: int) -> list | None:
+        """The emitted prefix a ``Last-Event-ID: <rid>:<n>`` reconnect
+        resumes from, or None when ``rid`` is unknown (the reconnect
+        degrades to a fresh request). Checks the disconnect cache
+        first (single use — popped); a rid still LIVE means the client
+        reconnected before the server noticed the old connection die:
+        the zombie request is cancelled (its slot returns to live
+        traffic) and its journaled prefix resumed."""
+        rid = int(request_id)
+        with self.lock:
+            toks = self._resume_cache.pop(rid, None)
+            if toks is not None:
+                return toks
+            eng = self._rid_engine.get(rid)
+            prog = (getattr(eng, "progress", None)
+                    if eng is not None else None)
+            p = prog(rid) if callable(prog) else None
+        if p is None:
+            return None
+        self.cancel(rid)
+        return [int(t) for t in p.get("tokens", [])] or None
 
     def prometheus_metrics(self) -> str:
         """The GET /metrics payload: every /stats number in Prometheus
@@ -1000,6 +1125,43 @@ class ServeApp:
                   st.get("stream_disconnects", 0),
                   "clients that vanished mid-stream (mapped onto "
                   "cancel(): the slot returns to live traffic)")
+        # paged-KV allocator families (docs/serving.md "Paged KV &
+        # admission tiers"): pool occupancy, per-class block usage,
+        # admission deferrals and interleaved prefill chunks
+        pk = st.get("paged_kv")
+        if pk:
+            r.gauge("serving_kv_pool_blocks_total",
+                    pk.get("pool_blocks_total", 0),
+                    "allocatable KV blocks in the paged pool")
+            r.gauge("serving_kv_pool_blocks_free",
+                    pk.get("pool_blocks_free", 0),
+                    "KV blocks on the free list")
+            r.gauge("serving_kv_pool_blocks_used",
+                    pk.get("pool_blocks_used", 0),
+                    "KV blocks held by slots, the prefix trie, or the "
+                    "draft mirror (refcounted)")
+            r.gauge("serving_kv_pool_blocks_peak",
+                    pk.get("pool_blocks_peak", 0),
+                    "high-water mark of used KV blocks")
+            r.counter("serving_kv_admission_defers_total",
+                      pk.get("admission_defers", 0),
+                      "admissions deferred for pool blocks or a class "
+                      "budget (the request stays queued, never fails)")
+            r.counter("serving_prefill_chunks_interleaved_total",
+                      pk.get("prefill_chunks_interleaved", 0),
+                      "prefill chunks dispatched between decode blocks "
+                      "(chunked-prefill interleaving)")
+            for cls, used in sorted(
+                    (pk.get("class_used") or {}).items()):
+                r.gauge("serving_kv_class_blocks_used", used,
+                        "KV blocks exclusively held per admission tier "
+                        "(COW/shared blocks are unattributed)",
+                        labels={"class": cls})
+        for cls, n in sorted((st.get("shed_by_class") or {}).items()):
+            r.counter("serving_shed_by_class_total", n,
+                      "requests shed per admission tier (queue-full "
+                      "429s plus batch displacements by interactive "
+                      "arrivals)", labels={"class": cls})
         loop = st.get("loop", {})
         r.counter(_metrics.SERVING_LOOP_RESTARTS,
                   loop.get("restarts", self.loop_restarts),
@@ -1368,15 +1530,17 @@ def make_handler(app: ServeApp, codec=None):
             begin_sse(self)
 
         def _relay_sse(self, rid, stream, deadline, frame_fn, final_fn,
-                       error_fn) -> None:
+                       error_fn, on_disconnect=None) -> None:
             """Drain one request's TokenStream into SSE frames (headers
             already sent). ``frame_fn(tokens) -> bytes`` per delta,
             ``final_fn(reason) -> bytes`` at the terminal,
             ``error_fn(message) -> bytes`` for in-band errors. A write
             failure or a peeked EOF = the client vanished: the request
             is CANCELLED (PR 3 path — the freed slot's next occupant is
-            byte-identical to a fresh server) and the disconnect
-            counted."""
+            byte-identical to a fresh server), the disconnect counted,
+            and ``on_disconnect`` (if given) runs — the SSE-reconnect
+            path parks the emitted prefix there for a later
+            ``Last-Event-ID`` resume."""
             try:
                 for kind, payload in stream.events(poll_s=0.25):
                     if kind == "tokens":
@@ -1403,6 +1567,8 @@ def make_handler(app: ServeApp, codec=None):
                 # mid-stream disconnect: stop decoding for nobody
                 app.cancel(rid)
                 app.note_stream_disconnect()
+                if on_disconnect is not None:
+                    on_disconnect()
             finally:
                 app.discard_result(rid)
             self.close_connection = True
@@ -1417,8 +1583,28 @@ def make_handler(app: ServeApp, codec=None):
                 self._post_openai(chat=False)
             elif path == "/v1/chat/completions":
                 self._post_openai(chat=True)
+            elif path == "/autoscale/hint":
+                self._post_autoscale_hint()
             else:
                 self._send(404, {"error": "unknown path"})
+
+        def _post_autoscale_hint(self):
+            """Driver-pushed backpressure: the fleet autoscaler's
+            remaining scale-up cooldown, folded into every 429's
+            Retry-After from here on (ServeApp.set_autoscale_hint).
+            The hint decays on its own — a driver that dies after one
+            push cannot pin the advertised retry window forever."""
+            try:
+                payload = self._read_json()
+                cd = float(payload.get("cooldown_s", 0.0))
+                if not 0 <= cd < float("inf"):
+                    raise ValueError(
+                        "cooldown_s must be a finite number >= 0")
+            except (KeyError, ValueError, TypeError) as e:
+                self._send(400, {"error": str(e)})
+                return
+            app.set_autoscale_hint(cd)
+            self._send(200, {"ok": True, "cooldown_s": cd})
 
         def _post_generate(self):
             from ..models.serving import QueueFullError
@@ -1472,6 +1658,13 @@ def make_handler(app: ServeApp, codec=None):
                 if isinstance(logprobs, bool) or not isinstance(
                         logprobs, int):
                     raise ValueError("logprobs must be an integer")
+                # admission tier (docs/serving.md "Paged KV & admission
+                # tiers"): batch requests queue under a lower threshold
+                # and are displaced first under pressure
+                priority = payload.get("priority") or "interactive"
+                if priority not in ("interactive", "batch"):
+                    raise ValueError(
+                        "priority must be 'interactive' or 'batch'")
                 # per-token streaming: ?stream=true or "stream": true
                 from ..api.stream import stream_requested
 
@@ -1481,9 +1674,23 @@ def make_handler(app: ServeApp, codec=None):
                         "logprobs are unavailable on streamed "
                         "requests (buffered responses only)")
                 ts = None
+                skip = 0
                 if stream_on:
-                    from ..api.stream import TokenStream
+                    from ..api.stream import (TokenStream,
+                                              parse_last_event_id)
 
+                    # SSE reconnect (docs/serving.md "SSE reconnect"):
+                    # a client re-POSTing with the last frame's id
+                    # resumes from the parked prefix — the emitted
+                    # tokens are teacher-forced, and only those past
+                    # the acked position are re-delivered
+                    lei = parse_last_event_id(
+                        self.headers.get("Last-Event-ID"))
+                    if lei is not None:
+                        prev = app.resume_prefix(lei[0])
+                        if prev is not None:
+                            resume = prev
+                            skip = min(lei[1], len(prev))
                     ts = TokenStream()
                 rid, ev = app.submit_async(
                     prompt, max_new, timeout=timeout,
@@ -1492,7 +1699,7 @@ def make_handler(app: ServeApp, codec=None):
                     cache_prompt=cache_prompt,
                     resume_tokens=resume, progress_key=progress_key,
                     model=model, stream=ts, stop=stop,
-                    logprobs=logprobs)
+                    logprobs=logprobs, priority=priority)
             except QueueFullError as e:
                 # shed: the queue is full. 429 + Retry-After is the
                 # load-balancer contract — retry elsewhere/later instead
@@ -1502,10 +1709,12 @@ def make_handler(app: ServeApp, codec=None):
                 # not a constant — a saturated queue advertises a longer
                 # retry than a momentarily full one. The engine attaches
                 # the estimate to the error (computed under the lock the
-                # submit already held); the fallback re-asks the app.
+                # submit already held); the app folds the autoscaler's
+                # cooldown hint in either way.
                 ra = getattr(e, "retry_after_s", 0)
                 self._send(429, {"error": str(e)}, headers={
-                    "Retry-After": str(ra if ra else app.retry_after_s())})
+                    "Retry-After": str(app.retry_after_s(
+                        engine_estimate=ra or None))})
                 return
             except ServingLoopError as e:
                 self._send(503, {"error": str(e)})
@@ -1517,25 +1726,41 @@ def make_handler(app: ServeApp, codec=None):
                 # SSE per-token delivery. Native frame contract
                 # (docs/serving.md "Streaming & OpenAI compatibility"):
                 # {"tokens": [...]} deltas, then one closing
-                # {"id", "finish_reason", "n_tokens"} frame.
+                # {"id", "finish_reason", "n_tokens"} frame. Every
+                # frame carries an ``id: <rid>:<abs>`` line — the
+                # reconnect cursor — and on a resumed stream the first
+                # ``skip`` already-acked tokens are withheld.
                 from ..api.stream import sse_frame
 
-                sent = {"n": 0}
+                seen = {"n": 0}
+                got: list = []
 
                 def frame(toks):
-                    sent["n"] += len(toks)
-                    return sse_frame({"tokens": [int(t) for t in toks]})
+                    toks = [int(t) for t in toks]
+                    got.extend(toks)
+                    start = max(0, skip - seen["n"])
+                    seen["n"] += len(toks)
+                    new = toks[start:]
+                    if not new:
+                        return b""
+                    return sse_frame({"tokens": new},
+                                     event_id=f"{rid}:{seen['n']}")
 
                 def final(reason):
-                    return sse_frame({"id": rid, "finish_reason": reason,
-                                      "n_tokens": sent["n"]})
+                    return sse_frame(
+                        {"id": rid, "finish_reason": reason,
+                         "n_tokens": max(0, seen["n"] - skip)},
+                        event_id=f"{rid}:{seen['n']}")
 
                 def err(msg):
                     return sse_frame({"error": str(msg)})
 
                 self._begin_sse()
-                self._relay_sse(rid, ts, time.monotonic() + timeout,
-                                frame, final, err)
+                self._relay_sse(
+                    rid, ts, time.monotonic() + timeout, frame, final,
+                    err,
+                    on_disconnect=lambda: app.save_resume_prefix(
+                        rid, got))
                 return
             # wait in short beats so a vanished client is noticed and its
             # request CANCELLED — the slot goes back to live traffic
@@ -1558,6 +1783,15 @@ def make_handler(app: ServeApp, codec=None):
                 return
             except TimeoutError as e:
                 self._send(504, {"error": str(e)})
+                return
+            if comp.finish_reason == "shed":
+                # displaced from the batch queue by an interactive
+                # arrival (admission tiers): same contract as an
+                # admission-time shed — 429 + honest Retry-After
+                self._send(429, {"error": f"request {comp.id} shed by "
+                                 "admission tiers; retry later"},
+                           headers={"Retry-After":
+                                    str(app.retry_after_s())})
                 return
             body = {"id": comp.id, "tokens": comp.tokens,
                     "finish_reason": comp.finish_reason}
@@ -1587,9 +1821,21 @@ def make_handler(app: ServeApp, codec=None):
                 return
             model_name = req["model"] or app.default_model
             ts = None
+            skip = 0
+            resume = None
             if req["stream"]:
-                from ..api.stream import TokenStream
+                from ..api.stream import TokenStream, parse_last_event_id
 
+                # SSE reconnect: same contract as /generate — the /v1
+                # frames' ``id:`` lines carry the engine rid + absolute
+                # delivered-token cursor the client echoes back here
+                lei = parse_last_event_id(
+                    self.headers.get("Last-Event-ID"))
+                if lei is not None:
+                    prev = app.resume_prefix(lei[0])
+                    if prev is not None:
+                        resume = prev
+                        skip = min(lei[1], len(prev))
                 ts = TokenStream()
             try:
                 rid, ev = app.submit_async(
@@ -1597,15 +1843,18 @@ def make_handler(app: ServeApp, codec=None):
                     timeout=req["timeout_s"],
                     temperature=req.get("temperature"),
                     top_k=req.get("top_k"),
+                    resume_tokens=resume,
                     model=req["model"], stream=ts,
                     stop=req.get("stop_sequences"),
-                    logprobs=req.get("logprobs", 0))
+                    logprobs=req.get("logprobs", 0),
+                    priority=req.get("priority") or "interactive")
             except QueueFullError as e:
                 ra = getattr(e, "retry_after_s", 0)
                 self._send(429, {"error": {"message": str(e),
                                            "type": "rate_limit_error"}},
                            headers={"Retry-After": str(
-                               ra if ra else app.retry_after_s())})
+                               app.retry_after_s(
+                                   engine_estimate=ra or None))})
                 return
             except ServingLoopError as e:
                 self._oai_error(503, str(e), "service_unavailable")
@@ -1618,11 +1867,16 @@ def make_handler(app: ServeApp, codec=None):
                 return
             n_prompt = len(req["prompt_tokens"])
             if ts is not None:
+                got: list = []
                 frame, final, err = oai.stream_frame_fns(
-                    rid, model_name, codec, chat)
+                    rid, model_name, codec, chat, skip=skip,
+                    collect=got)
                 self._begin_sse()
-                self._relay_sse(rid, ts, time.monotonic()
-                                + req["timeout_s"], frame, final, err)
+                self._relay_sse(
+                    rid, ts, time.monotonic() + req["timeout_s"],
+                    frame, final, err,
+                    on_disconnect=lambda: app.save_resume_prefix(
+                        rid, got))
                 return
             deadline = time.monotonic() + req["timeout_s"]
             while not ev.wait(0.25):
@@ -1644,6 +1898,13 @@ def make_handler(app: ServeApp, codec=None):
             except TimeoutError as e:
                 self._oai_error(504, str(e), "timeout")
                 return
+            if comp.finish_reason == "shed":
+                self._send(429, {"error": {
+                    "message": f"request {comp.id} shed by admission "
+                               "tiers; retry later",
+                    "type": "rate_limit_error"}},
+                    headers={"Retry-After": str(app.retry_after_s())})
+                return
             build = oai.chat_response if chat else oai.completion_response
             self._send(200, build(comp.id, model_name, comp.tokens,
                                   comp.finish_reason, n_prompt, codec,
@@ -1653,7 +1914,17 @@ def make_handler(app: ServeApp, codec=None):
 
 
 def main(argv=None) -> int:
-    args = build_argparser().parse_args(argv)
+    # conf-templated flags (runtimes/serving.py exports them from the
+    # tony.serving.* keys): PREPENDED so explicit flags override them
+    import os as _os
+    import sys as _sys
+
+    from .. import constants as _c
+
+    extra = _os.environ.get(_c.ENV_SERVE_EXTRA_FLAGS, "").split()
+    if argv is None:
+        argv = _sys.argv[1:]
+    args = build_argparser().parse_args(extra + list(argv))
 
     from ..models.registry import ModelRegistry
     from ..models.serving import SlotServer
@@ -1743,6 +2014,11 @@ def main(argv=None) -> int:
         journal, recovered_entries = RequestJournal.recover(
             _Path(args.trace_dir) / JOURNAL_FILE)
         print(f"request journal -> {journal.path}", flush=True)
+    class_budgets = {}
+    if args.class_budget_interactive:
+        class_budgets["interactive"] = args.class_budget_interactive
+    if args.class_budget_batch:
+        class_budgets["batch"] = args.class_budget_batch
     engines = {}
     for n in serving_names:
         engines[n] = SlotServer(
@@ -1759,7 +2035,12 @@ def main(argv=None) -> int:
             max_queue=args.max_queue,
             journal=journal, replay=not args.no_replay,
             spec_gamma=args.spec_gamma,
-            spec_gamma_max=args.spec_gamma_max)
+            spec_gamma_max=args.spec_gamma_max,
+            paged=args.paged_kv, kv_block=args.kv_block,
+            kv_pool_blocks=args.kv_pool_blocks,
+            prefill_interleave=args.prefill_interleave,
+            class_budgets=class_budgets or None,
+            batch_queue_frac=args.batch_queue_frac)
     slot_server = engines[default_name]
     if recovered_entries:
         # pre-multi-model records carry no model name and belong to the

@@ -161,6 +161,17 @@ SERVING_HEALTHZ_DOWN_POLLS = "tony.serving.healthz-down-polls"
 # how long a replica gets from spawn to its first healthy /healthz before
 # the adapter gives up (model load + first compile can dominate)
 SERVING_READY_TIMEOUT_MS = "tony.serving.ready-timeout-ms"
+# paged-KV serving (serve --paged-kv family; docs/serving.md "Paged KV &
+# admission tiers"): replica launch commands templated from conf pick
+# these up instead of hard-coding flags per job file
+SERVING_PAGED_KV = "tony.serving.paged-kv"
+SERVING_KV_BLOCK = "tony.serving.kv-block"
+SERVING_KV_POOL_BLOCKS = "tony.serving.kv-pool-blocks"
+SERVING_PREFILL_INTERLEAVE = "tony.serving.prefill-interleave"
+SERVING_CLASS_BUDGET_INTERACTIVE = \
+    "tony.serving.class-budget-interactive"
+SERVING_CLASS_BUDGET_BATCH = "tony.serving.class-budget-batch"
+SERVING_BATCH_QUEUE_FRAC = "tony.serving.batch-queue-frac"
 
 # ------------------------------------------------------------------ training
 # elastic, preemption-tolerant training (docs/training-robustness.md):

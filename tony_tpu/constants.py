@@ -41,6 +41,11 @@ ENV_SERVE_PORT = "TONY_SERVE_PORT"  # serving job type (runtimes/serving.py):
                                   # must bind (= the task's registered port);
                                   # the adapter advertises it as serve_port/
                                   # metrics_port via the publish_ports RPC
+ENV_SERVE_EXTRA_FLAGS = "TONY_SERVE_EXTRA_FLAGS"  # conf-templated serve
+                                  # flags (tony.serving.* keys: paged KV,
+                                  # class budgets, ...): the adapter exports
+                                  # them, cli/serve.py prepends them to its
+                                  # argv — explicit command-line flags win
 
 ENV_PRESTAGE_CKPT = "TONY_PRESTAGE_CKPT"  # checkpoint-aware rescale
                                   # placement (docs/autoscaling.md): set on

@@ -1671,18 +1671,45 @@ class Driver:
             status = self._autoscale_scale_up(decision.reason)
             if status == "scaled":
                 controller.note_scaled("up")
+                self._push_autoscale_hint(controller)
                 return "scaled_up"
             if status == "launch_failed":
                 # arm the cooldown anyway: a persistent provisioner
                 # failure must not journal a fresh "up" op every tick
                 controller.note_scaled("up")
+                self._push_autoscale_hint(controller)
             return status
         victim = self._pick_scale_down_victim(role, watcher.last_loads)
         if victim is not None and self._autoscale_scale_down(
                 victim, decision.reason):
             controller.note_scaled("down")
+            self._push_autoscale_hint(controller)
             return "scaled_down"
         return "idle"
+
+    def _push_autoscale_hint(self, controller) -> None:
+        """Broadcast the freshly armed cooldown to every serving
+        replica (POST /autoscale/hint, best effort): their 429
+        ``Retry-After`` headers then advertise AT LEAST the window in
+        which the fleet cannot add capacity, so shed clients stop
+        hammering a fleet that is already scaling. The hint decays
+        replica-side, so a missed broadcast only costs accuracy."""
+        import json as _json
+        import urllib.request as _urlreq
+
+        cooldown = controller.cooldown_remaining()
+        body = _json.dumps({"cooldown_s": cooldown}).encode()
+        for task_id, host, port in self.serving_endpoints(
+                self._autoscale_role):
+            try:
+                req = _urlreq.Request(
+                    f"http://{host}:{port}/autoscale/hint", data=body,
+                    headers={"Content-Type": "application/json"})
+                with _urlreq.urlopen(req, timeout=1.0):
+                    pass
+            except Exception:
+                log.debug("autoscale hint push to %s failed", task_id,
+                          exc_info=True)
 
     def _pick_scale_down_victim(self, role: str,
                                 loads: dict) -> str | None:

@@ -83,6 +83,10 @@ class JournalEntry:
     # still carries per-token logprobs (the teacher-forced prefix gets
     # None placeholders — those rows died with the old process)
     logprobs: int = 0
+    # admission tier ("interactive" | "batch"): replayed so a resumed
+    # request keeps its class budget/shedding behavior — every
+    # pre-priority journal record reads back as interactive
+    priority: str = "interactive"
 
 
 class RequestJournal:
@@ -131,7 +135,8 @@ class RequestJournal:
                emitted: list[int] | None = None,
                model: str | None = None,
                stop: list | None = None,
-               logprobs: int = 0) -> None:
+               logprobs: int = 0,
+               priority: str = "interactive") -> None:
         """Open an entry for a newly accepted request. ``emitted``
         pre-seeds the record for resumed requests (router failover /
         journal recovery) so a second failure replays from the full
@@ -144,7 +149,8 @@ class RequestJournal:
             id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             temperature=temperature, top_k=top_k, cache_prompt=cache_prompt,
             seed=seed, emitted=emitted, deadline=deadline, model=model,
-            stop=stop, logprobs=int(logprobs or 0))
+            stop=stop, logprobs=int(logprobs or 0),
+            priority=str(priority or "interactive"))
         with self._lock:
             self._entries[rid] = entry
         self._append({"op": "submit", "id": rid, "prompt": prompt,
@@ -152,7 +158,8 @@ class RequestJournal:
                       "temperature": temperature, "top_k": top_k,
                       "cache_prompt": cache_prompt, "seed": seed,
                       "model": model, "stop": stop,
-                      "logprobs": int(logprobs or 0)})
+                      "logprobs": int(logprobs or 0),
+                      "priority": str(priority or "interactive")})
         if emitted:
             self._append({"op": "emit", "id": rid, "tokens": emitted})
 
@@ -302,7 +309,9 @@ def read_journal(path: str | Path) -> list[JournalEntry]:
                         seed=rec.get("seed"),
                         model=rec.get("model"),
                         stop=rec.get("stop"),
-                        logprobs=int(rec.get("logprobs", 0) or 0))
+                        logprobs=int(rec.get("logprobs", 0) or 0),
+                        priority=str(rec.get("priority")
+                                     or "interactive"))
                 elif op == "emit":
                     entry = entries.get(rid)
                     if entry is not None:

@@ -161,16 +161,27 @@ log = logging.getLogger(__name__)
 # What a DELIVERED Completion.finish_reason can say. "stop"/"length" are
 # the natural endings (trace terminal "finished"); "cancelled"/"expired"
 # are early exits that still build a Completion (empty or partial
-# tokens).
-COMPLETION_FINISH_REASONS = ("stop", "length", "cancelled", "expired")
-# The full trace-level finish_reason vocabulary: "shed" (refused at the
-# door — surfaces as QueueFullError / HTTP 429, never a Completion) and
-# "failed" (in-flight state lost with no replay — ServingLoopError /
-# HTTP 503) terminate a request's TRACE without ever building a
-# Completion. Pinned against code, docstrings, docs/serving.md, and the
-# router's HTTP mapping by tests/test_observability.py's finish-reason
-# lint.
-FINISH_REASONS = COMPLETION_FINISH_REASONS + ("shed", "failed")
+# tokens); "shed" is a QUEUED batch-tier request displaced by an
+# interactive arrival under queue pressure (empty Completion — the
+# request never reached a slot; a shed at submit() still raises
+# QueueFullError with no Completion).
+COMPLETION_FINISH_REASONS = ("stop", "length", "cancelled", "expired",
+                             "shed")
+# The full trace-level finish_reason vocabulary adds "failed" (in-flight
+# state lost with no replay — ServingLoopError / HTTP 503), which
+# terminates a request's TRACE without ever building a Completion.
+# Pinned against code, docstrings, docs/serving.md, and the router's
+# HTTP mapping by tests/test_observability.py's finish-reason lint.
+FINISH_REASONS = COMPLETION_FINISH_REASONS + ("failed",)
+
+# Engine-level admission tiers, best first. "interactive" is the
+# latency-sensitive default; "batch" is sheddable throughput work that
+# 429s at a LOWER queue threshold (``batch_queue_frac``) and, under a
+# full queue, is displaced by interactive arrivals (finish_reason
+# "shed"). In paged-KV mode each class can also carry a block budget
+# (``class_budgets``) so batch prefills cannot starve interactive
+# admissions of pool blocks.
+PRIORITY_CLASSES = ("interactive", "batch")
 
 # per-request logprobs cap: one compiled decode-block variant carries
 # this many top entries whenever ANY busy slot asked for logprobs (a
@@ -308,6 +319,13 @@ class Request:
     # by name to the right engine); the field rides the Request so the
     # HTTP payload's model= survives into traces and the journal.
     model: str | None = None
+    # admission tier ("interactive" | "batch"). The batch tier is the
+    # engine's load-shed buffer: it sheds at a LOWER queue threshold,
+    # a full queue displaces its youngest queued batch request to seat
+    # an interactive one, and (paged mode) its concurrent KV blocks
+    # are capped by its class budget — the engine-side counterpart of
+    # the driver's ResourceArbiter tiers (autoscale.py).
+    priority: str = "interactive"
     id: int = field(default_factory=itertools.count().__next__)
 
 
@@ -316,9 +334,11 @@ class Completion:
     id: int
     tokens: list[int]
     finish_reason: str    # one of COMPLETION_FINISH_REASONS:
-    #                       "stop" | "length" | "cancelled" | "expired"
-    #                       (shed/failed requests never build a
-    #                       Completion — see FINISH_REASONS)
+    #                       "stop" | "length" | "cancelled" | "expired" |
+    #                       "shed" (a queued batch-tier request displaced
+    #                       by an interactive arrival; empty tokens).
+    #                       Failed requests never build a Completion —
+    #                       see FINISH_REASONS.
     # the request's lifecycle trace (observability.RequestTrace.to_dict():
     # host-monotonic span events + attrs) — None only for engines that
     # don't record traces (test stubs)
@@ -408,9 +428,21 @@ class PrefixCache:
       aliased by an admitted slot's pending copy). ``alloc`` returns None
       when the budget is exhausted and nothing is evictable — callers
       skip insertion rather than fail.
+
+    With ``allocator=`` (paged-KV mode) the trie stops owning a private
+    free list: blocks come from the shared ``BlockAllocator`` and every
+    trie node holds one allocator ref on its block. Sharing is
+    copy-on-write with no writer — a block adopted into the trie is a
+    fully-written prefill chunk that neither the donating slot nor any
+    hit slot ever writes again — so "sharing" is just refcounts: the
+    block frees when the LAST holder (trie node or slot table) unrefs.
+    Eviction then only takes leaves whose block the trie SOLELY owns
+    (allocator refcount 1): a block still in some slot's table must not
+    be handed to a new writer mid-read. ``n_blocks`` stays as a soft cap
+    on trie size so cached prefixes can't squat the whole pool.
     """
 
-    def __init__(self, n_blocks: int, chunk: int):
+    def __init__(self, n_blocks: int, chunk: int, allocator=None):
         if n_blocks < 1:
             raise ValueError(f"prefix cache needs >= 1 block, got {n_blocks}")
         if chunk < 1:
@@ -418,7 +450,9 @@ class PrefixCache:
         self.n_blocks = n_blocks
         self.chunk = chunk
         self.root = _PrefixNode(None, b"", -1)
-        self._free = list(range(n_blocks - 1, -1, -1))
+        self._allocator = allocator
+        self._free = ([] if allocator is not None
+                      else list(range(n_blocks - 1, -1, -1)))
         self._owned: set[_PrefixNode] = set()
         self._tick = 0
         self.hits = 0           # admissions matching >= 1 chunk
@@ -428,7 +462,7 @@ class PrefixCache:
 
     @property
     def blocks_used(self) -> int:
-        return self.n_blocks - len(self._free)
+        return len(self._owned)
 
     def _touch(self, node: _PrefixNode) -> None:
         self._tick += 1
@@ -464,10 +498,17 @@ class PrefixCache:
             assert n.refs >= 0, "prefix-cache ref underflow"
 
     def _evict_one(self) -> int | None:
-        """Reclaim the least-recently-used unreferenced leaf's block."""
+        """Reclaim the least-recently-used unreferenced leaf's block.
+        The trie's ref on the block transfers to the caller (reuse or
+        ``reclaim``); blocks still shared with a live slot table
+        (allocator refcount > 1) are skipped — handing one to a new
+        writer would corrupt the reader's KV."""
         victim = None
         for node in self._owned:
             if node.children or node.refs > 0:
+                continue
+            if (self._allocator is not None
+                    and self._allocator.refs[node.block] > 1):
                 continue
             if victim is None or node.tick < victim.tick:
                 victim = node
@@ -479,9 +520,58 @@ class PrefixCache:
         return victim.block
 
     def alloc(self) -> int | None:
+        if self._allocator is not None:
+            block = self._allocator.take()
+            if block is not None:
+                return block
+            return self._evict_one()
         if self._free:
             return self._free.pop()
         return self._evict_one()
+
+    def reclaim(self, n: int) -> int:
+        """Paged mode: hand up to ``n`` blocks back to the shared
+        allocator by evicting unreferenced sole-owner leaves. Called
+        when a slot admission comes up short of pool blocks — cached
+        prefixes are the reclaimable tier, in-flight tables are not."""
+        assert self._allocator is not None, "reclaim needs an allocator"
+        got = 0
+        while got < n:
+            block = self._evict_one()
+            if block is None:
+                break
+            self._allocator.unref(block)
+            got += 1
+        return got
+
+    def adopt(self, body: np.ndarray, blocks: dict) -> int:
+        """Paged mode insert: record a slot's own freshly-prefilled
+        blocks in the trie with ZERO device copies. ``blocks`` maps
+        chunk index -> pool block id for the full chunk-aligned span the
+        slot prefilled itself; each newly-created node takes an
+        allocator ref, so the block is now shared between the slot's
+        table and the trie and frees only when both let go. Existing
+        nodes win (a burst-mate adopted the same chunk first); the walk
+        stops at the soft cap or a gap. Returns the node count added."""
+        assert self._allocator is not None, "adopt needs an allocator"
+        node, adopted = self.root, 0
+        c = self.chunk
+        for c0 in range(0, len(body) - c + 1, c):
+            key = body[c0:c0 + c].tobytes()
+            child = node.children.get(key)
+            if child is None:
+                block = blocks.get(c0 // c)
+                if block is None or len(self._owned) >= self.n_blocks:
+                    break
+                child = _PrefixNode(node, key, block)
+                node.children[key] = child
+                self._owned.add(child)
+                self._allocator.ref(block)
+                self.inserted_blocks += 1
+                adopted += 1
+            self._touch(child)
+            node = child
+        return adopted
 
     def insert(self, body: np.ndarray) -> list[tuple[int, "_PrefixNode"]]:
         """Add ``body``'s full chunks to the trie, reusing existing nodes
@@ -1135,6 +1225,193 @@ def _spec_block(params, draft_params, cache, draft_cache, d_tokens,
     return new_cache, new_draft, tok_out, still, packed
 
 
+class BlockAllocator:
+    """Host-side authority over the shared paged-KV pool: a free list +
+    per-block refcounts + per-class accounting. Pure host bookkeeping
+    (device programs only ever see block-id TABLES), so the lifecycle
+    invariants are unit-testable without a model.
+
+    A block's refcount counts its HOLDERS: each slot table entry that
+    points at it and each trie node that owns it. Blocks free when the
+    last holder lets go — that is the whole copy-on-write story, because
+    shared blocks are never written again (prefill chunks are immutable
+    once complete; decode writes only land in a slot's exclusively-owned
+    tail blocks).
+
+    ``class_budgets`` caps how many blocks each admission tier may hold
+    EXCLUSIVELY at once (``alloc_for`` debits, ``credit`` at release);
+    trie-shared blocks ride free — a cached prefix benefits every class.
+    A class over budget defers at admission instead of starving the
+    other tier of pool blocks."""
+
+    def __init__(self, n_blocks: int, class_budgets: dict | None = None):
+        if n_blocks < 1:
+            raise ValueError(f"paged KV pool needs >= 1 block, "
+                             f"got {n_blocks}")
+        self.n_blocks = n_blocks
+        self._free = list(range(n_blocks - 1, -1, -1))
+        self.refs = np.zeros(n_blocks, np.int32)
+        self.class_budgets: dict[str, int] = {}
+        for cls, cap in (class_budgets or {}).items():
+            if cls not in PRIORITY_CLASSES:
+                raise ValueError(
+                    f"unknown priority class {cls!r} in class_budgets "
+                    f"(valid: {PRIORITY_CLASSES})")
+            self.class_budgets[cls] = int(cap)
+        self.class_used = {cls: 0 for cls in PRIORITY_CLASSES}
+        self.peak_used = 0
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    def take(self) -> int | None:
+        """One class-unaccounted block (trie growth), refcount 1."""
+        if not self._free:
+            return None
+        block = self._free.pop()
+        self.refs[block] = 1
+        self.peak_used = max(self.peak_used, self.used_blocks)
+        return block
+
+    def alloc_for(self, cls: str, n: int) -> list | None:
+        """``n`` fresh blocks (refcount 1 each) debited to class ``cls``,
+        all-or-nothing: None when the free list or the class budget
+        comes up short (callers defer the admission, never partially
+        admit)."""
+        budget = self.class_budgets.get(cls)
+        if budget is not None and self.class_used.get(cls, 0) + n > budget:
+            return None
+        if len(self._free) < n:
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        for block in blocks:
+            self.refs[block] = 1
+        if cls in self.class_used:
+            self.class_used[cls] += n
+        self.peak_used = max(self.peak_used, self.used_blocks)
+        return blocks
+
+    def ref(self, block: int) -> None:
+        assert self.refs[block] >= 1, "ref on a free block"
+        self.refs[block] += 1
+
+    def unref(self, block: int) -> None:
+        self.refs[block] -= 1
+        assert self.refs[block] >= 0, "paged-KV block refcount underflow"
+        if self.refs[block] == 0:
+            self._free.append(block)
+
+    def credit(self, cls: str, n: int) -> None:
+        """Return ``n`` exclusively-held blocks to ``cls``'s budget (the
+        refcounts are separate — a block credited back may live on,
+        shared with the trie)."""
+        if cls in self.class_used:
+            self.class_used[cls] = max(0, self.class_used[cls] - n)
+
+    def check(self) -> None:
+        """Assert the refcount invariant (tests): every block is either
+        on the free list with refcount 0 or off it with refcount >= 1 —
+        no orphans, no double-frees, no referenced free blocks."""
+        free = set(self._free)
+        assert len(free) == len(self._free), "duplicate blocks on free list"
+        for block in range(self.n_blocks):
+            if block in free:
+                assert self.refs[block] == 0, \
+                    f"free block {block} still referenced"
+            else:
+                assert self.refs[block] >= 1, \
+                    f"allocated block {block} unreferenced (orphan)"
+
+
+@jax.jit
+def _gather_paged_view(pool, tables, lens, offsets):
+    """Materialize the paged pool into a RING-ORDERED slot-pool view —
+    view index (s, i) holds slot s's logical position (i - offsets[s])
+    mod M, exactly where the ring engine would store it — so the
+    existing prefill/decode programs run on the view UNCHANGED and the
+    paged engine's outputs are byte-identical to the ring engine's by
+    construction: same programs, same index arithmetic, same reduction
+    orders. Table entries pointing at the pad block (the pool's last
+    block, always zero) read zeros where the ring holds stale garbage —
+    positions the attention mask weighs to exactly 0 either way.
+
+    The view is TRANSIENT (alive gather -> program -> scatter, then
+    donated away); persistent device memory is the pool, which is what
+    lets concurrency exceed the slots x max_len ring bound."""
+    n_pool = pool.k.shape[1]
+    block = pool.k.shape[3]
+    n_tbl = tables.shape[1]
+    m_cap = n_tbl * block
+    # ring index i holds logical position (i - offset) mod M
+    p = (jnp.arange(m_cap)[None, :] - offsets[:, None]) % m_cap   # [S, M]
+    blk = jnp.take_along_axis(tables, p // block, axis=1)         # [S, M]
+    row = p % block
+    # advanced indices separated by a slice -> result axes lead:
+    # pool.k[L, N, kvH, B, D][:, blk, :, row] -> [S, M, L, kvH, D]
+    k = pool.k[:, blk, :, row].transpose(2, 0, 3, 1, 4)
+    v = pool.v[:, blk, :, row].transpose(2, 0, 3, 1, 4)
+    ks = vs = None
+    if pool.k_scale is not None:
+        ks = pool.k_scale[:, blk, :, row].transpose(2, 0, 3, 1)
+        vs = pool.v_scale[:, blk, :, row].transpose(2, 0, 3, 1)
+    return KVCache(k=k, v=v, length=lens, k_scale=ks, v_scale=vs)
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def _scatter_paged_rows(pool, view, tables, offsets, ring_ids, n_valids,
+                        floors):
+    """Commit a program's freshly-written view rows back into the pool:
+    ``ring_ids`` [S, W] names the ring indices each slot's program wrote
+    this dispatch (decode: the shared cursor window for every row;
+    prefill: one slot's chunk span, other rows masked via ``n_valids``).
+    Three guards divert a write to a dropped out-of-bounds id instead of
+    committing it: column >= ``n_valids[s]`` (masked row / chunk pad
+    tail), logical position < ``floors[s]`` (a pending/idle slot the
+    decode program still writes garbage rows for — the ring engine
+    buries those in the slot's private ring; here they must never reach
+    a pool block another holder might share), and a pad-block target (an
+    unmapped table entry). Diverted ids are DISTINCT per (slot, column)
+    so ``unique_indices=True`` stays honest; real targets are unique
+    because decode only ever writes a slot's exclusively-owned tail
+    blocks (shared prefix blocks sit strictly below every write
+    position). Returns the pool plus a dispatch-tracker fence scalar."""
+    n_pool = pool.k.shape[1]
+    block = pool.k.shape[3]
+    n_tbl = tables.shape[1]
+    m_cap = n_tbl * block
+    n_slots, w = ring_ids.shape
+    n_pad = n_pool - 1                      # pad block id
+    p = (ring_ids - offsets[:, None]) % m_cap                     # [S, W]
+    blk = jnp.take_along_axis(tables, p // block, axis=1)
+    row = p % block
+    j = jnp.arange(w)[None, :]
+    bad = ((j >= n_valids[:, None]) | (p < floors[:, None])
+           | (blk >= n_pad))
+    divert = n_pool + jnp.arange(n_slots)[:, None] * w + j
+    blk = jnp.where(bad, divert, blk)
+    swr = dict(unique_indices=True, mode="drop")
+    rows = jnp.arange(n_slots)[:, None]
+    # view.k[L, S, kvH, M, D][:, rows, :, ring_ids] -> [S, W, L, kvH, D],
+    # exactly the gather shape of pool.k[:, blk, :, row]
+    pk = pool.k.at[:, blk, :, row].set(
+        view.k[:, rows, :, ring_ids], **swr)
+    pv = pool.v.at[:, blk, :, row].set(
+        view.v[:, rows, :, ring_ids], **swr)
+    pks, pvs = pool.k_scale, pool.v_scale
+    if pks is not None:
+        pks = pks.at[:, blk, :, row].set(
+            view.k_scale[:, rows, :, ring_ids], **swr)
+        pvs = pvs.at[:, blk, :, row].set(
+            view.v_scale[:, rows, :, ring_ids], **swr)
+    fence = jnp.sum(blk).astype(jnp.int32)
+    return PrefixPool(k=pk, v=pv, k_scale=pks, v_scale=pvs), fence
+
+
 class SlotServer:
     """Continuous-batching server: S cache slots, requests admitted into
     freed slots while other slots keep decoding.
@@ -1232,7 +1509,12 @@ class SlotServer:
                  model: str = "default",
                  registry: ModelRegistry | None = None,
                  draft=None, draft_cfg: TransformerConfig | None = None,
-                 spec_gamma: int = 0, spec_gamma_max: int = 4):
+                 spec_gamma: int = 0, spec_gamma_max: int = 4,
+                 paged: bool = False, kv_block: int = 0,
+                 kv_pool_blocks: int = 0,
+                 class_budgets: dict | None = None,
+                 prefill_interleave: int = 0,
+                 batch_queue_frac: float = 0.5):
         # ---- model registry (models/registry.py) ----
         # the weights singleton became a keyed registry: this server
         # SERVES one named entry (its slot-pool cache shape is that
@@ -1363,6 +1645,55 @@ class SlotServer:
             self._draft_params = draft_w
             self._draft_cfg = moe_dropfree(draft_cfg)
             self._spec = True
+        # ---- paged KV allocator (tentpole) ----
+        # paged=True swaps the slots x max_len ring cache for a shared
+        # pool of kv_block-sized blocks: each slot carries a block TABLE
+        # instead of a private ring, dispatches run gather -> (unchanged
+        # ring program) -> scatter on a ring-ordered transient view, and
+        # admission is gated on free POOL blocks, so concurrency is
+        # bounded by actual KV bytes rather than worst-case length.
+        self._paged = bool(paged)
+        self.kv_block = int(kv_block) if kv_block else 0
+        self.kv_pool_blocks = int(kv_pool_blocks) if kv_pool_blocks else 0
+        self.prefill_interleave = max(0, int(prefill_interleave))
+        self.batch_queue_frac = float(batch_queue_frac)
+        self._class_budgets = dict(class_budgets or {})
+        if self._paged:
+            if self._spec:
+                raise ValueError(
+                    "paged KV does not support speculative serving yet "
+                    "(the spec programs carry their own draft cache; see "
+                    "docs/serving.md)")
+            if mesh is not None:
+                raise ValueError(
+                    "paged KV is single-device (the gather/scatter "
+                    "programs are not mesh-threaded); serve without a "
+                    "mesh")
+            if not self.kv_block:
+                self.kv_block = int(block_size)
+            if max_len % self.kv_block:
+                raise ValueError(
+                    f"max_len={max_len} must be a multiple of "
+                    f"kv_block={self.kv_block} (a slot's table has "
+                    f"max_len/kv_block entries)")
+            if prefill_chunk % self.kv_block:
+                raise ValueError(
+                    f"prefill_chunk={prefill_chunk} must be a multiple "
+                    f"of kv_block={self.kv_block} (chunk boundaries must "
+                    f"land on block boundaries for zero-copy trie "
+                    f"adoption)")
+            if not self.kv_pool_blocks:
+                # same device bytes as the ring it replaces
+                self.kv_pool_blocks = slots * (max_len // self.kv_block)
+        else:
+            if self.prefill_interleave:
+                raise ValueError(
+                    "prefill_interleave requires paged=True (the ring "
+                    "engine prefills whole admissions up front)")
+            if self._class_budgets:
+                raise ValueError(
+                    "class_budgets requires paged=True (budgets are "
+                    "pool-block budgets)")
         self.batched_admission = batched_admission
         self.admission_dispatches = 0   # prefill programs dispatched
         # prefix-cache dispatch + token counters (stats())
@@ -1372,6 +1703,15 @@ class SlotServer:
         self.prefill_tokens_reused = 0      # served from the prefix pool
         # failure-model counters (stats()) — cumulative across reset()
         self.shed_requests = 0          # refused at submit (queue full)
+        #                                 or displaced from the queue
+        self.shed_by_class = {cls: 0 for cls in PRIORITY_CLASSES}
+        # paged-KV counters (stats())
+        self.admission_defers = 0       # admissions deferred on pool
+        #                                 blocks / class budget
+        self.paged_gather_dispatches = 0
+        self.paged_scatter_dispatches = 0
+        self.prefill_chunks_interleaved = 0  # chunks deferred by the
+        #                                      per-decode interleave cap
         self.cancelled_requests = 0     # cancel() reached the request
         self.expired_requests = 0       # deadline passed while queued
         self.resets = 0                 # reset() calls (loop recoveries)
@@ -1491,6 +1831,8 @@ class SlotServer:
         self.spec_proposed_tokens = 0   # draft proposals verified (host-
         #                                 observed, lags by the pipeline)
         self.spec_accepted_tokens = 0   # ... accepted by the target
+        self.draft_prefill_tokens_reused = 0  # draft prefill skipped by
+        #                                 prefix hits (COW draft pool)
         self.spec_accept_hist = Histogram(lo=0.01, hi=1.0)
         self.spec_rounds_hist = Histogram(lo=1.0, hi=512.0, per_decade=4)
         self._init_device_state()
@@ -1498,6 +1840,7 @@ class SlotServer:
         self.cache_prompts = cache_prompts
         self._prefix_cache: PrefixCache | None = None
         self._pool: PrefixPool | None = None
+        self._draft_pool: PrefixPool | None = None
         # request id -> matched trie path, ref-held until the completion
         # is processed
         self._prefix_refs: dict[int, list] = {}
@@ -1510,7 +1853,10 @@ class SlotServer:
                 t_b = _rule_size(mesh, rules, "batch")
                 n_blocks = -(-n_blocks // t_b) * t_b
             self._prefix_blocks = n_blocks
-            self._init_prefix_pool()
+            if not self._paged:
+                self._init_prefix_pool()
+        if self._paged:
+            self._init_paged_state()
         self._init_host_state()
         self._queue: collections.deque[Request] = collections.deque()
         self._done: dict[int, Completion] = {}
@@ -1535,10 +1881,19 @@ class SlotServer:
         failed dispatch the old donated buffers may be dead, so recovery
         must never reuse them."""
         slots = self.slots
-        cache = init_cache(self.cfg, slots, self.max_len, self.kv_dtype)
-        # device-carried slot state: blocks consume the previous block's
-        # outputs directly, never waiting on a host round trip
-        self._cache = cache._replace(length=jnp.zeros((slots,), jnp.int32))
+        if self._paged:
+            # paged mode: no monolithic ring cache — per-slot KV lives in
+            # the block pool (_init_paged_state); _d_lens is the
+            # device-carried per-slot length vector the ring cache's
+            # .length field would otherwise hold
+            self._cache = None
+            self._d_lens = jnp.zeros((slots,), jnp.int32)
+        else:
+            cache = init_cache(self.cfg, slots, self.max_len, self.kv_dtype)
+            # device-carried slot state: blocks consume the previous
+            # block's outputs directly, never waiting on a host round trip
+            self._cache = cache._replace(
+                length=jnp.zeros((slots,), jnp.int32))
         self._d_tokens = jnp.zeros((slots,), jnp.int32)   # next fed token
         self._d_active = jnp.zeros((slots,), bool)
         self._d_target = jnp.zeros((slots,), jnp.int32)   # stop length
@@ -1588,6 +1943,55 @@ class SlotServer:
             self.cfg, self._prefix_blocks, self.prefill_chunk, self.kv_dtype)
         self._prefix_cache = PrefixCache(self._prefix_blocks,
                                          self.prefill_chunk)
+        # speculative serving: the draft model's cache blocks ride the
+        # SAME trie — each node dual-indexes a target-pool block and a
+        # draft-pool block (same block id, two pools), so a prefix hit
+        # seeds both caches and the draft prefills only the suffix too
+        self._draft_pool = (
+            init_prefix_pool(self._draft_cfg, self._prefix_blocks,
+                             self.prefill_chunk, self.kv_dtype)
+            if self._spec else None)
+
+    def _init_paged_state(self) -> None:
+        """(Re)create the paged-KV pool, allocator, and per-slot block
+        tables. The pool carries ``kv_pool_blocks`` allocatable
+        kv_block-sized blocks plus ONE pad block (the last index):
+        unmapped table entries point at it, gathers read its zeros
+        (positions the attention mask never weighs), and the scatter
+        diverts any write aimed at it. The prefix trie, when enabled,
+        shares the same allocator — cached prefixes and slot tables hold
+        refs on the same physical blocks (COW without a writer)."""
+        n = self.kv_pool_blocks
+        self._kv_pool = init_prefix_pool(
+            self.cfg, n + 1, self.kv_block, self.kv_dtype)
+        self._allocator = BlockAllocator(n, self._class_budgets)
+        entries = self.max_len // self.kv_block
+        self._np_tables = np.full((self.slots, entries), n, np.int32)
+        self._d_tables = jnp.asarray(self._np_tables)
+        self._tables_dirty = False
+        # per-slot ring offsets + write floors (host mirrors; the device
+        # offsets vector is _d_offsets as in ring mode). floor = the
+        # lowest logical position the scatter may commit for the slot:
+        # max_len (= never) while the slot is idle or mid-prefill,
+        # body.size once activated — the decode program writes garbage
+        # rows for inactive slots, and those must never land in a block
+        # the trie might share.
+        self._np_offs = np.zeros((self.slots,), np.int32)
+        self._np_floor = np.full((self.slots,), self.max_len, np.int32)
+        # slot -> exclusively-owned block ids (decode tail + cold-filled
+        # prefix chunks; refcount-1 holders unless adopted by the trie)
+        # and trie-shared block ids (prefix hits; we hold one ref each)
+        self._slot_blocks: list[list] = [[] for _ in range(self.slots)]
+        self._slot_shared: list[list] = [[] for _ in range(self.slots)]
+        self._slot_class = ["interactive"] * self.slots
+        # admissions whose blocks are allocated but whose prefill is not
+        # finished: [admission, next_chunk_start] pairs, drained by
+        # _pump_prefill under the interleave budget
+        self._pending_prefill: collections.deque = collections.deque()
+        if self._prefix_blocks > 0:
+            self._prefix_cache = PrefixCache(
+                self._prefix_blocks, self.kv_block,
+                allocator=self._allocator)
         if self._shardings is not None:
             sh = self._shardings
             self._pool = PrefixPool(
@@ -1726,27 +2130,49 @@ class SlotServer:
                 if self._journal is not None:
                     self._journal.finish(request.id)
                 return request.id
-        if self.max_queue and len(self._queue) >= self.max_queue:
+        cls = str(request.priority or "interactive")
+        if cls not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority {request.priority!r} "
+                f"(valid: {PRIORITY_CLASSES})")
+        request.priority = cls
+        if self.max_queue:
             # shed at the door: an unbounded queue converts overload into
             # unbounded latency for EVERY admitted request; a bounded one
             # keeps admitted-request latency flat and tells the excess to
-            # retry (HTTP 429 upstream). Sweep expired corpses first — a
-            # queue full of requests whose deadlines already passed is
-            # capacity the next _admit would reclaim anyway, not load
-            self._sweep_expired()
-            if len(self._queue) >= self.max_queue:
-                self.shed_requests += 1
-                # a shed request still leaves a (two-span) trace: shedding
-                # must be as visible per-request as it is in the counters
-                self._seal_trace(tr, "shed")
-                err = QueueFullError(
-                    f"queue full ({self.max_queue} waiting); request shed")
-                # ride the estimate on the error: the 429 handler already
-                # holds whatever lock guards this server — making it call
-                # back for the header would buy a second lock wait on the
-                # shed fast path, at peak load
-                err.retry_after_s = self.estimate_retry_after()
-                raise err
+            # retry (HTTP 429 upstream). The batch tier backs off at a
+            # LOWER threshold (batch_queue_frac of max_queue) so overload
+            # sheds throughput work first and keeps queue headroom for
+            # interactive arrivals.
+            limit = self.max_queue
+            if cls != "interactive":
+                limit = max(1, int(self.max_queue * self.batch_queue_frac))
+            if len(self._queue) >= limit:
+                # Sweep expired corpses first — a queue full of requests
+                # whose deadlines already passed is capacity the next
+                # _admit would reclaim anyway, not load
+                self._sweep_expired()
+                if len(self._queue) >= limit and cls == "interactive":
+                    # full queue, best tier: displace the youngest queued
+                    # batch request instead of shedding the arrival
+                    self._shed_queued_batch()
+                if len(self._queue) >= limit:
+                    self.shed_requests += 1
+                    self.shed_by_class[cls] += 1
+                    # a shed request still leaves a (two-span) trace:
+                    # shedding must be as visible per-request as it is in
+                    # the counters
+                    self._seal_trace(tr, "shed")
+                    err = QueueFullError(
+                        f"queue full ({limit} {cls} waiting); "
+                        f"request shed")
+                    # ride the estimate on the error: the 429 handler
+                    # already holds whatever lock guards this server —
+                    # making it call back for the header would buy a
+                    # second lock wait on the shed fast path, at peak load
+                    err.retry_after_s = self.estimate_retry_after()
+                    err.priority = cls
+                    raise err
         request.prompt = prompt
         self._traces[request.id] = tr
         if self._journal is not None:
@@ -1761,9 +2187,35 @@ class SlotServer:
                 model=self.model,
                 stop=[list(s) for s in request.stop]
                 if request.stop else None,
-                logprobs=request.logprobs)
+                logprobs=request.logprobs,
+                priority=request.priority)
         self._queue.append(request)
         return request.id
+
+    def _shed_queued_batch(self) -> bool:
+        """Displace the YOUNGEST queued batch-tier request to make room
+        for an interactive arrival: it gets an empty
+        Completion("shed") — it never reached a slot, so there is no
+        partial work to deliver — and its waiter/stream unblocks with
+        the same backpressure signal a submit-time shed raises (the
+        ServeApp maps the reason to HTTP 429 + Retry-After). Youngest
+        first: the most recently queued request has waited least, so
+        displacing it wastes the least invested queue time."""
+        for i in range(len(self._queue) - 1, -1, -1):
+            req = self._queue[i]
+            if req.priority == "interactive":
+                continue
+            del self._queue[i]
+            self.shed_requests += 1
+            self.shed_by_class[req.priority] += 1
+            self._done[req.id] = Completion(
+                req.id, [], "shed",
+                trace=self._finish_trace(req.id, "shed"))
+            self._finish_stream(req.id)
+            if self._journal is not None:
+                self._journal.finish(req.id)
+            return True
+        return False
 
     def _sweep_expired(self) -> None:
         """Deadline sweep: a request whose client already gave up must
@@ -1825,6 +2277,26 @@ class SlotServer:
                 self._finish_stream(request_id)
                 if self._journal is not None:
                     self._journal.finish(request_id)
+                return True
+        if self._paged:
+            # mid-prefill under chunked interleaving: the request holds
+            # blocks and a slot but no decode has started — drop the
+            # pending chunks and free the blocks promptly (the next
+            # admission sweep can reuse them immediately)
+            for i, pend in enumerate(self._pending_prefill):
+                adm = pend[0]
+                if adm.req.id != request_id:
+                    continue
+                del self._pending_prefill[i]
+                self.cancelled_requests += 1
+                self._host_busy[adm.slot] = False
+                out = [int(t) for t in (adm.req.resume_tokens or [])]
+                self._done[request_id] = Completion(
+                    request_id, out, "cancelled",
+                    trace=self._finish_trace(request_id, "cancelled",
+                                             n_tokens=len(out)))
+                self._finish_stream(request_id)
+                self._release_request(request_id)
                 return True
         slot = self._slot_of.get(request_id)
         if slot is None:
@@ -1916,6 +2388,8 @@ class SlotServer:
                 stop=[list(s) for s in entry.stop]
                 if entry.stop else None,
                 logprobs=int(getattr(entry, "logprobs", 0) or 0),
+                priority=str(getattr(entry, "priority", None)
+                             or "interactive"),
                 id=rid))
         self._prefix_refs.clear()
         # drop pending dispatch-tracker entries WITHOUT blocking on them
@@ -1926,8 +2400,13 @@ class SlotServer:
         # same as the latency telemetry.
         self.dispatch_tracker.reset()
         self._init_device_state()
-        if self._prefix_blocks:
+        if self._prefix_blocks and not self._paged:
             self._init_prefix_pool()
+        if self._paged:
+            # fresh pool + allocator + tables (the old donated pool may
+            # be dead); pending prefills' requests are in _inflight, so
+            # they replay with everything else
+            self._init_paged_state()
         self._init_host_state()
         # replays go AHEAD of the never-started queue: they were
         # admitted first, and their waiters have been waiting longest
@@ -1979,7 +2458,9 @@ class SlotServer:
                     resume_tokens=list(entry.emitted),
                     stop=[list(s) for s in entry.stop]
                     if entry.stop else None,
-                    logprobs=int(getattr(entry, "logprobs", 0) or 0))
+                    logprobs=int(getattr(entry, "logprobs", 0) or 0),
+                    priority=str(getattr(entry, "priority", None)
+                                 or "interactive"))
                 try:
                     rid = self.submit(req)
                 except ValueError as e:
@@ -2116,10 +2597,16 @@ class SlotServer:
 
     def _release_request(self, request_id: int) -> None:
         """Drop the dispatch-side tracking of a finished/cancelled
-        request, unpin its matched prefix-cache path, and seal its
-        journal entry (no replay after a delivered terminal)."""
-        self._slot_of.pop(request_id, None)
+        request, unpin its matched prefix-cache path, free its paged-KV
+        blocks, and seal its journal entry (no replay after a delivered
+        terminal)."""
+        slot = self._slot_of.pop(request_id, None)
         self._inflight.discard(request_id)
+        if self._paged and slot is not None:
+            # the id still OWNED the slot: a predictive re-admission
+            # would have superseded the _slot_of mapping (and freed the
+            # blocks) already, so this never double-frees
+            self._free_slot_blocks(slot)
         path = self._prefix_refs.pop(request_id, None)
         if path is not None:
             self._prefix_cache.release(path)
@@ -2239,6 +2726,7 @@ class SlotServer:
             # (a server that silently sheds reads as a server that lost
             # requests)
             "shed": self.shed_requests,
+            "shed_by_class": dict(self.shed_by_class),
             "cancelled": self.cancelled_requests,
             "expired": self.expired_requests,
             "resets": self.resets,
@@ -2272,6 +2760,8 @@ class SlotServer:
                 "rounds": self.spec_rounds,
                 "proposed_tokens": self.spec_proposed_tokens,
                 "accepted_tokens": self.spec_accepted_tokens,
+                "draft_prefill_tokens_reused":
+                    self.draft_prefill_tokens_reused,
                 "acceptance_ewma": round(
                     float(self._accept_ewma.mean()), 4),
                 "acceptance": self.spec_accept_hist.snapshot(),
@@ -2297,6 +2787,24 @@ class SlotServer:
                 "copy_dispatches": self.prefix_copy_dispatches,
                 "insert_dispatches": self.prefix_insert_dispatches,
             }
+        if self._paged:
+            alloc = self._allocator
+            out["paged_kv"] = {
+                "kv_block": self.kv_block,
+                "pool_blocks_total": alloc.n_blocks,
+                "pool_blocks_free": alloc.free_blocks,
+                "pool_blocks_used": alloc.used_blocks,
+                "pool_blocks_peak": alloc.peak_used,
+                "class_used": dict(alloc.class_used),
+                "class_budgets": dict(self._class_budgets or {}),
+                "admission_defers": self.admission_defers,
+                "gather_dispatches": self.paged_gather_dispatches,
+                "scatter_dispatches": self.paged_scatter_dispatches,
+                "prefill_chunks_interleaved":
+                    self.prefill_chunks_interleaved,
+                "prefill_interleave": self.prefill_interleave,
+                "pending_prefill": len(self._pending_prefill),
+            }
         return out
 
     # ----------------------------------------------------------- the loop
@@ -2306,6 +2814,9 @@ class SlotServer:
         # its blocks haven't been processed; re-admitting is safe because
         # the processing replay keeps successive requests' streams
         # separate. EOS mode: only a PROCESSED completion frees the slot.
+        if self._paged and any(p[0].slot == slot
+                               for p in self._pending_prefill):
+            return False        # mid-prefill: not even model-active yet
         if self._predictive:
             return not self._model_active[slot]
         return not self._host_busy[slot]
@@ -2330,6 +2841,9 @@ class SlotServer:
         would otherwise be dispatched before the twin's insert) — so
         sharing begins one burst after a template first appears."""
         if self.pause_admission:
+            return
+        if self._paged:
+            self._admit_paged()
             return
         self._sweep_expired()
         C = self.prefill_chunk
@@ -2416,9 +2930,12 @@ class SlotServer:
         else:
             for adm in admissions:
                 self._prefill_one(adm)
-        self._dispatch_prefix_insert(admissions)
+        # draft prefill BEFORE the trie insert: the insert now mirrors
+        # each new chunk into the draft pool too, reading the draft
+        # cache the suffix prefill just wrote
         if self._spec:
             self._prefill_draft(admissions)
+        self._dispatch_prefix_insert(admissions)
         for adm in admissions:
             slot, req, body = adm.slot, adm.req, adm.body
             tr = self._traces.get(req.id)
@@ -2456,6 +2973,15 @@ class SlotServer:
             shardings=self._shardings)
         self.prefix_copy_dispatches += 1
         self.dispatch_tracker.track("prefix_copy", fence)
+        if self._draft_pool is not None:
+            # COW sharing with the draft cache: the same trie path is
+            # valid in the draft-shaped pool (inserts mirror every block
+            # id into both pools), so a hit seeds the draft slot cache
+            # too and the draft re-prefills only the suffix
+            self._draft_cache, dfence = _copy_prefix_blocks(
+                self._draft_pool, self._draft_cache,
+                *self._prefix_rows(rows, oob="slot"), shardings=None)
+            self.dispatch_tracker.track("draft_prefix_copy", dfence)
 
     def _dispatch_prefix_insert(self, admissions) -> None:
         """Phase 3 of admission: insert the burst's new full-body chunks
@@ -2482,6 +3008,15 @@ class SlotServer:
                 shardings=self._shardings)
             self.prefix_insert_dispatches += 1
             self.dispatch_tracker.track("prefix_insert", fence)
+            if self._draft_pool is not None:
+                # mirror the same rows into the draft pool (the draft
+                # suffix prefill dispatched just before this, so the
+                # draft cache holds the data) — one trie node, two
+                # pools, one refcount
+                self._draft_pool, dfence = _insert_prefix_blocks(
+                    self._draft_pool, self._draft_cache,
+                    *self._prefix_rows(rows, oob="block"), shardings=None)
+                self.dispatch_tracker.track("draft_prefix_insert", dfence)
         if created:     # insert-refs protected the blocks until dispatch
             self._prefix_cache.release(created)
 
@@ -2592,20 +3127,27 @@ class SlotServer:
 
     def _prefill_draft(self, admissions) -> None:
         """Speculative serving: the draft model needs the same context
-        in its OWN slot cache. The full body prefills every time — the
-        target-side prefix pool holds TARGET KV, the draft is small by
-        construction, and a draft-side pool would double the cache
-        machinery for a model whose whole point is being cheap. One
+        in its OWN slot cache. A prefix-cache hit covers the draft too
+        — the trie's blocks are mirrored into a draft-shaped pool by
+        the same insert rows (``_dispatch_prefix_copy`` seeded the
+        draft slot cache before this ran) — so only the uncached
+        suffix prefills, same ``chunk_starts`` as the target. One
         `_prefill_batch` dispatch per chunk round (the draft config
         compiles its own variant); every commit row is diverted
         (``fin`` all False), so the target's committed slot state rides
         through the donation untouched while the DRAFT cache's lengths
-        land at each row's body size."""
+        land at each row's body size. Every admission appears in round
+        0 even with an empty suffix (fully-cached or 1-token prompt):
+        the zero-valid row still RESETS the draft slot's stale length
+        from its previous occupant, exactly as the target's degenerate
+        finalize chunk does."""
         C = self.prefill_chunk
         n = len(admissions)
         k_rows = 1 << (n - 1).bit_length() if n > 1 else 1
-        rounds = max(max(1, -(-a.body.size // C)) for a in admissions)
+        rounds = max(len(a.chunk_starts) for a in admissions)
         S = self.slots
+        for adm in admissions:
+            self.draft_prefill_tokens_reused += adm.prefix_len
         for r in range(rounds):
             tokens = np.zeros((k_rows, C), np.int32)
             slots = S + np.arange(k_rows, dtype=np.int32)   # OOB default
@@ -2617,14 +3159,9 @@ class SlotServer:
             fin = np.zeros(k_rows, bool)
             any_row = False
             for row, adm in enumerate(admissions):
-                c0 = r * C
-                # every admission appears in round 0 even with an empty
-                # body (1-token prompt): the zero-valid row still RESETS
-                # the draft slot's stale length from its previous
-                # occupant, exactly as the target's degenerate finalize
-                # chunk does
-                if c0 >= adm.body.size and not (r == 0):
-                    continue
+                if r >= len(adm.chunk_starts):
+                    continue            # this prompt has no chunk round r
+                c0 = adm.chunk_starts[r]
                 nv = max(0, min(C, adm.body.size - c0))
                 tokens[row, :nv] = adm.body[c0:c0 + nv]
                 slots[row] = adm.slot
@@ -2649,6 +3186,346 @@ class SlotServer:
                 shardings=None)
             self.admission_dispatches += 1
             self.dispatch_tracker.track("draft_prefill", fence)
+
+    # ------------------------------------------------- paged-KV engine
+    # Every dispatch is gather -> (unchanged ring program) -> scatter:
+    # the gather materializes a transient RING-ORDERED view of the
+    # busy slots' blocks (same indices, same masked-garbage semantics as
+    # the ring cache, so greedy outputs are byte-identical by
+    # construction), the program runs exactly as in ring mode, and the
+    # scatter commits only the rows the program wrote back into the
+    # pool. Blocks are allocated UP FRONT at admission (ceil(target /
+    # kv_block) per request), so an admitted request can never run out
+    # of KV mid-decode — "zero failed requests" is structural, and
+    # overload surfaces as admission deferral instead of preemption.
+
+    def _free_slot_blocks(self, slot: int) -> None:
+        """Return a slot's table to the all-pad state: unref every held
+        block (exclusively-owned ones free unless the trie adopted them;
+        trie-shared ones just drop this slot's ref), credit the class
+        budget for the exclusive holdings, and floor the slot so no
+        in-flight decode garbage row can land in a freed block."""
+        own, shared = self._slot_blocks[slot], self._slot_shared[slot]
+        if own or shared:
+            self._allocator.credit(self._slot_class[slot], len(own))
+            for block in own:
+                self._allocator.unref(block)
+            for block in shared:
+                self._allocator.unref(block)
+            self._slot_blocks[slot] = []
+            self._slot_shared[slot] = []
+            self._np_tables[slot, :] = self._allocator.n_blocks   # pad
+            self._tables_dirty = True
+        self._np_floor[slot] = self.max_len
+
+    def _gather_view(self):
+        """Dispatch the pool -> ring-view gather for the next program.
+        Host tables/offsets are the authority (the device copies lag by
+        design: _d_offsets commits at each finalize, fine for programs,
+        stale for layout)."""
+        if self._tables_dirty:
+            self._d_tables = jnp.asarray(self._np_tables)
+            self._tables_dirty = False
+        self.paged_gather_dispatches += 1
+        return _gather_paged_view(self._kv_pool, self._d_tables,
+                                  self._d_lens, jnp.asarray(self._np_offs))
+
+    def _scatter_view(self, view, ring_ids, n_valids, floors) -> None:
+        """Commit the program's written rows back into the pool (the
+        gather/program/scatter triple always shares one table+offset
+        snapshot — nothing mutates them in between)."""
+        self._kv_pool, fence = _scatter_paged_rows(
+            self._kv_pool, view, self._d_tables,
+            jnp.asarray(self._np_offs), jnp.asarray(ring_ids),
+            jnp.asarray(n_valids), jnp.asarray(floors))
+        self.paged_scatter_dispatches += 1
+        self.dispatch_tracker.track("paged_scatter", fence)
+
+    def _admit_paged(self) -> None:
+        """Paged admission: gate on free POOL blocks (and the class
+        budget), not just free slots. Allocation is all-or-nothing per
+        request and FIFO by default; the one reordering allowed is
+        skipping past a head-of-line request whose CLASS is over budget
+        to the first request of the other tier — per-class budgets would
+        otherwise head-of-line-block the tier they exist to protect.
+        Admitted requests join _pending_prefill; _pump_prefill drains
+        their chunks (fully here when interleaving is off, or capped
+        per decode block when on)."""
+        self._sweep_expired()
+        for slot in range(self.slots):
+            if not self._queue:
+                break
+            if not self._free_for_admission(slot):
+                continue
+            status = self._try_admit_paged(slot, 0)
+            if status == "ok":
+                continue
+            self.admission_defers += 1
+            if status == "budget":
+                head_cls = self._queue[0].priority
+                alt = next(
+                    (i for i in range(1, len(self._queue))
+                     if self._queue[i].priority != head_cls), None)
+                if alt is not None and \
+                        self._try_admit_paged(slot, alt) == "ok":
+                    continue
+            break       # pool exhausted: FIFO holds, retry next tick
+        self._pump_prefill(self.prefill_interleave or None)
+
+    def _try_admit_paged(self, slot: int, qidx: int) -> str:
+        """Attempt one (slot, queued-request) admission. Returns "ok"
+        (queue entry consumed, admission pending), "budget" (the
+        request's class is over its block budget), or "pool" (free
+        blocks short even after reclaiming trie leaves)."""
+        B = self.kv_block
+        req = self._queue[qidx]
+        prompt = req.prompt
+        resume = req.resume_tokens
+        full = (np.concatenate([prompt, np.asarray(resume, np.int32)])
+                if resume else prompt)
+        body = full[:-1]
+        target = body.size + req.max_new_tokens - len(resume or ())
+        # every logical position the request can ever write, allocated
+        # up front: no admitted request ever stalls or fails on KV
+        cap_blocks = max(1, -(-target // B))
+        prefix_len, path = 0, []
+        if self._prefix_cache is not None:
+            path = self._prefix_cache.lookup(body)
+            prefix_len = len(path) * B
+        n_new = cap_blocks - len(path)
+        cls = req.priority
+        blocks = self._allocator.alloc_for(cls, n_new)
+        if blocks is None:
+            budget = self._allocator.class_budgets.get(cls)
+            if budget is not None and \
+                    self._allocator.class_used.get(cls, 0) + n_new > budget:
+                return "budget"
+            short = n_new - self._allocator.free_blocks
+            if self._prefix_cache is not None and short > 0:
+                # cached prefixes yield to live admissions; reclaiming
+                # may evict nodes on the matched path, so re-resolve it
+                self._prefix_cache.reclaim(short)
+                path = self._prefix_cache.lookup(body) if path else []
+                prefix_len = len(path) * B
+                n_new = cap_blocks - len(path)
+                blocks = self._allocator.alloc_for(cls, n_new)
+            if blocks is None:
+                return "pool"
+        del self._queue[qidx]
+        if resume is not None:
+            self.replays += 1
+            self.replayed_tokens += len(resume)
+        for stale in [r for r, s in self._slot_of.items() if s == slot]:
+            del self._slot_of[stale]
+        # predictive re-admission: the predecessor's completion is
+        # unprocessed but its decode is device-done — free its blocks
+        # now (its _slot_of mapping is gone, so _release_request cannot
+        # double-free)
+        self._free_slot_blocks(slot)
+        self._slot_of[req.id] = slot
+        self._inflight.add(req.id)
+        offset = (self._cursor - body.size) % self.max_len
+        temp = (self.temperature if req.temperature is None
+                else float(req.temperature))
+        topk = (self.top_k if req.top_k is None else int(req.top_k))
+        if path:
+            self._prefix_cache.acquire(path)
+            self.prefill_tokens_reused += prefix_len
+            self._prefix_refs[req.id] = path
+        chunk_starts = (list(range(prefix_len, body.size,
+                                   self.prefill_chunk)) or [prefix_len])
+        tr = self._traces.get(req.id)
+        if tr is not None:
+            tr.attrs["prompt_tokens"] = int(prompt.size)
+            tr.attrs["prefix_hit_blocks"] = len(path)
+            tr.mark("admitted")
+        # table row: trie-hit blocks first (shared — one allocator ref
+        # each, zero copies: the hit IS the block), then the fresh
+        # exclusively-owned blocks the prefill/decode will fill
+        row = self._np_tables[slot]
+        row[:] = self._allocator.n_blocks                   # pad
+        shared = []
+        for i, node in enumerate(path):
+            row[i] = node.block
+            self._allocator.ref(node.block)
+            shared.append(node.block)
+        for j, block in enumerate(blocks):
+            row[len(path) + j] = block
+        self._tables_dirty = True
+        self._slot_blocks[slot] = list(blocks)
+        self._slot_shared[slot] = shared
+        self._slot_class[slot] = cls
+        self._np_offs[slot] = offset
+        self._np_floor[slot] = self.max_len     # no decode writes until
+        #                                         the finalize activates
+        self._host_busy[slot] = True
+        self._np_temps[slot] = temp
+        self._np_topks[slot] = topk
+        self._np_lp[slot] = req.logprobs
+        self._pending_prefill.append(
+            [_Admission(slot=slot, req=req, body=body, offset=offset,
+                        target=target, temp=temp, topk=topk,
+                        chunk_starts=chunk_starts, last=int(full[-1]),
+                        prefix_len=prefix_len, hit_path=path), 0])
+        return "ok"
+
+    def _pump_prefill(self, budget: int | None) -> None:
+        """Dispatch pending admissions' prefill chunks, oldest first, up
+        to ``budget`` prompt tokens (None = drain everything now, the
+        uncapped ring-engine behavior). The cap is the chunked-prefill
+        interleave: a decode block dispatches between pumps, so an
+        admission burst stretches across decode blocks instead of
+        stalling every in-flight stream for the whole burst's prefill."""
+        C = self.prefill_chunk
+        spent = 0
+        while self._pending_prefill:
+            if budget is not None and spent >= budget:
+                self.prefill_chunks_interleaved += 1
+                break
+            pend = self._pending_prefill[0]
+            adm, idx = pend
+            c0 = adm.chunk_starts[idx]
+            final = idx == len(adm.chunk_starts) - 1
+            n_valid = max(0, min(C, adm.body.size - c0))
+            if final:
+                # the admission-time offset aligned the slot's first
+                # decode write with the cursor AS OF ADMISSION; decode
+                # blocks interleaved since then moved the cursor. The
+                # pool is logical (tables map positions to blocks), so
+                # the offset is free to change between dispatches —
+                # re-derive it so the finalize commits an offset whose
+                # first decode write lands at the CURRENT cursor. A
+                # no-op when nothing interleaved.
+                adm.offset = (self._cursor - adm.body.size) % self.max_len
+                self._np_offs[adm.slot] = adm.offset
+            self._dispatch_paged_prefill(adm, c0, n_valid, final)
+            spent += max(1, n_valid)
+            if final:
+                self._pending_prefill.popleft()
+                self._finalize_admit_paged(adm)
+            else:
+                pend[1] = idx + 1
+
+    def _dispatch_paged_prefill(self, adm: _Admission, c0: int,
+                                n_valid: int, final: bool) -> None:
+        """One `_prefill_chunk` dispatch on the gathered view, then
+        scatter the chunk's span back into the slot's blocks."""
+        C = self.prefill_chunk
+        slot = adm.slot
+        chunk = np.zeros((1, C), np.int32)
+        chunk[0, :n_valid] = adm.body[c0:c0 + n_valid]
+        view = self._gather_view()
+        (view, self._d_tokens, self._d_active,
+         self._d_target, self._d_offsets,
+         self._d_temps, self._d_topks, fence) = _prefill_chunk(
+            self._params, view, self._d_tokens,
+            self._d_active, self._d_target, self._d_offsets,
+            self._d_temps, self._d_topks,
+            jnp.asarray(chunk), jnp.int32(slot), jnp.int32(c0),
+            jnp.int32(adm.offset), jnp.int32(n_valid),
+            jnp.int32(adm.last), jnp.int32(adm.target),
+            jnp.float32(adm.temp), jnp.int32(adm.topk),
+            cfg=self.cfg, chunk=C, kv_dtype=self.kv_dtype,
+            finalize=final, shardings=None)
+        self._d_lens = view.length
+        ring_ids = np.zeros((self.slots, C), np.int32)
+        ring_ids[slot] = (adm.offset + c0
+                          + np.arange(C, dtype=np.int32)) % self.max_len
+        n_valids = np.zeros((self.slots,), np.int32)
+        n_valids[slot] = n_valid
+        # floors stay zero here: this IS the prefill writing the span
+        # the floor will later protect
+        self._scatter_view(view, ring_ids, n_valids,
+                           np.zeros((self.slots,), np.int32))
+        self.admission_dispatches += 1
+        self.dispatch_tracker.track("prefill", fence)
+        self.prefill_tokens_computed += n_valid
+
+    def _finalize_admit_paged(self, adm: _Admission) -> None:
+        """The finalize chunk is dispatched: activate the slot for
+        decode (floor + exact host model), adopt its freshly-filled full
+        chunks into the trie (zero-copy — the trie just refs the
+        blocks), and log the admit event at this position in the
+        dispatch order."""
+        slot, req, body = adm.slot, adm.req, adm.body
+        self._np_floor[slot] = body.size
+        tr = self._traces.get(req.id)
+        if tr is not None:
+            tr.mark("prefill_done")
+        self._model_len[slot] = body.size
+        self._model_active[slot] = True
+        self._model_target[slot] = adm.target
+        want = (self.cache_prompts if req.cache_prompt is None
+                else req.cache_prompt)
+        if self._prefix_cache is not None and want:
+            B = self.kv_block
+            row = self._np_tables[slot]
+            offer = {i: int(row[i])
+                     for i in range(adm.prefix_len // B, body.size // B)}
+            if offer:
+                self._prefix_cache.adopt(body, offer)
+        admit = (slot, body.size, req)
+        if self._pipeline:
+            self._pipeline[-1]["events"].append(("admit", admit))
+        else:                           # nothing in flight: applies now
+            self._apply_admit(admit)
+
+    def _dispatch_block_paged(self) -> None:
+        """Paged decode block: pump at most ``prefill_interleave``
+        pending prefill tokens, then gather -> `_decode_block` (the
+        unchanged ring program) -> scatter the cursor window. Pipeline
+        record, counters, predictive model advance, and chaos hooks are
+        exactly the ring path's — processing cannot tell the engines
+        apart."""
+        if self._pending_prefill and self.prefill_interleave:
+            self._pump_prefill(self.prefill_interleave)
+        t0 = time.monotonic()
+        self._key, sub = jax.random.split(self._key)
+        lp_k = (LOGPROBS_MAX
+                if bool((self._np_lp[self._host_busy] > 0).any()) else 0)
+        view = self._gather_view()
+        (view, self._d_tokens, self._d_active, packed) = _decode_block(
+            self._params, self._fused, view,
+            self._d_tokens, self._d_active, self._d_target,
+            self._d_offsets, jnp.int32(self._cursor), self._d_temps,
+            self._d_topks, sub,
+            cfg=self.cfg, block=self.block_size,
+            stop_tokens=self.stop_tokens, pad_id=self.pad_id,
+            top_k=self.top_k,
+            per_row_topk=bool(
+                (self._np_topks[self._host_busy] != self.top_k).any()),
+            weight_dtype=self.weight_dtype, build_fused=self._build_fused,
+            all_greedy=not bool(
+                (self._np_temps[self._host_busy] > 0).any()),
+            lp_k=lp_k,
+            shardings=None)
+        self._d_lens = view.length
+        # every row writes the shared cursor window; floors divert the
+        # rows that must not commit (pending/idle/finished-and-lapped)
+        ring_ids = np.tile(
+            (self._cursor + np.arange(self.block_size, dtype=np.int32))
+            % self.max_len, (self.slots, 1))
+        self._scatter_view(
+            view, ring_ids,
+            np.full((self.slots,), self.block_size, np.int32),
+            self._np_floor.copy())
+        self._cursor = (self._cursor + self.block_size) % self.max_len
+        self.blocks_dispatched += 1
+        self.telemetry.observe("decode_block_s", time.monotonic() - t0)
+        seq = self.dispatch_tracker.track("decode_block", packed)
+        self._pipeline.append({"packed": packed, "events": [], "seq": seq,
+                               "w": self.block_size + 2
+                               + (self.block_size * (2 * lp_k + 1)
+                                  if lp_k else 0),
+                               "lp_k": lp_k,
+                               "spec_gamma": None})
+        if self._predictive:            # exact: no EOS can surprise us
+            adv = np.minimum(self.block_size,
+                             self._model_target - self._model_len)
+            self._model_len = self._model_len + np.where(
+                self._model_active, adv, 0).astype(np.int32)
+            self._model_active &= self._model_len < self._model_target
+        self._post_dispatch_chaos()
 
     def _apply_admit(self, admit) -> None:
         slot, body_len, req = admit
@@ -2709,6 +3586,9 @@ class SlotServer:
         self._release_request(rid)
 
     def _dispatch_block(self) -> None:
+        if self._paged:
+            self._dispatch_block_paged()
+            return
         t0 = time.monotonic()
         self._key, sub = jax.random.split(self._key)
         # logprobs: one packed-width variant whenever ANY busy slot
@@ -3136,6 +4016,7 @@ class SlotServer:
 
 
 __all__ = ["Request", "Completion", "SlotServer", "PrefixCache",
-           "QueueFullError", "RequestJournal",
+           "BlockAllocator", "QueueFullError", "RequestJournal",
            "ModelEntry", "ModelRegistry",
-           "COMPLETION_FINISH_REASONS", "FINISH_REASONS"]
+           "COMPLETION_FINISH_REASONS", "FINISH_REASONS",
+           "PRIORITY_CLASSES"]

@@ -617,7 +617,9 @@ class FleetRouter:
                  cache_prompt: bool | None = None,
                  model: str | None = None,
                  on_tokens=None, stop: list | None = None,
-                 logprobs: int = 0) -> dict:
+                 logprobs: int = 0,
+                 priority: str | None = None,
+                 last_event_id: str | None = None) -> dict:
         """Route one generation request; returns the replica's response
         dict (id/tokens/finish_reason) plus routing attrs. ``model``
         restricts routing to replicas advertising that model (their
@@ -633,24 +635,34 @@ class FleetRouter:
         /progress poll, which stays the fallback for non-streamed
         requests. The returned dict still carries the FULL token list.
         An ``on_tokens`` failure (the front-door client vanished)
-        raises StreamConsumerError: no retry, no ejection."""
+        raises StreamConsumerError: no retry, no ejection.
+
+        ``priority`` ("interactive" | "batch") passes through to the
+        replica's admission tiers; ``last_event_id`` forwards a
+        reconnecting client's ``Last-Event-ID`` header to the FIRST
+        replica attempt (best effort — the replica that parked the
+        prefix resumes it, any other starts fresh; retries fall back
+        to the router's own /progress-harvested resume)."""
         if on_tokens is not None:
             with self._lock:
                 self.streams_active += 1
             try:
                 return self._generate(prompt, max_new_tokens, timeout_s,
                                       temperature, top_k, cache_prompt,
-                                      model, on_tokens, stop, logprobs)
+                                      model, on_tokens, stop, logprobs,
+                                      priority, last_event_id)
             finally:
                 with self._lock:
                     self.streams_active -= 1
         return self._generate(prompt, max_new_tokens, timeout_s,
                               temperature, top_k, cache_prompt, model,
-                              None, stop, logprobs)
+                              None, stop, logprobs, priority,
+                              last_event_id)
 
     def _generate(self, prompt, max_new_tokens, timeout_s, temperature,
                   top_k, cache_prompt, model, on_tokens,
-                  stop=None, logprobs=0) -> dict:
+                  stop=None, logprobs=0, priority=None,
+                  last_event_id=None) -> dict:
         rid = next(self._ids)
         tr = RequestTrace(rid)
         tr.mark("submitted")
@@ -705,6 +717,10 @@ class FleetRouter:
         if model is not None:
             payload["model"] = str(model)
             tr.attrs["model"] = str(model)
+        if priority is not None:
+            # pass-through: the replica validates the tier name
+            payload["priority"] = str(priority)
+            tr.attrs["priority"] = str(priority)
         attempts = 0
         min_retry_after: int | None = None
         failover_pending = False    # a failover counts when it POSTS
@@ -792,7 +808,16 @@ class FleetRouter:
                     resp = self._post_generate(
                         rep, payload, remaining,
                         on_frame=(on_frame if on_tokens is not None
-                                  else None))
+                                  else None),
+                        # SSE reconnect pass-through: only the FIRST
+                        # attempt forwards the client's header — a
+                        # failover retry resumes via the router's own
+                        # harvested resume_tokens instead, and sending
+                        # both would double-resume
+                        extra_headers=(
+                            {"Last-Event-ID": last_event_id}
+                            if last_event_id and attempts == 0
+                            else None))
                 finally:
                     with self._lock:
                         rep.inflight -= 1
@@ -936,17 +961,22 @@ class FleetRouter:
         return time.monotonic() < deadline
 
     def _post_generate(self, rep: Replica, payload: dict,
-                       timeout: float, on_frame=None) -> dict:
+                       timeout: float, on_frame=None,
+                       extra_headers: dict | None = None) -> dict:
         """POST /generate to one replica. ``on_frame`` switches to the
         SSE relay: each token-delta frame is handed to it as it
         arrives, and the replica's closing frame is returned in place
         of the buffered response. A replica answering a stream request
         with a buffered body (predates streaming) degrades gracefully:
-        its full token list is delivered as one frame."""
+        its full token list is delivered as one frame.
+        ``extra_headers`` ride the POST verbatim (the Last-Event-ID
+        reconnect pass-through)."""
         body = json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json"}
+        if extra_headers:
+            headers.update(extra_headers)
         req = urllib.request.Request(
-            rep.base_url + "/generate", data=body,
-            headers={"Content-Type": "application/json"})
+            rep.base_url + "/generate", data=body, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=max(0.05,
                                                          timeout)) as resp:
@@ -1462,9 +1492,22 @@ def make_handler(router: FleetRouter, codec=None):
                     raise ValueError("logprobs must be an integer")
                 if lp:
                     kwargs["logprobs"] = lp
+                pri = payload.get("priority")
+                if pri is not None:
+                    if pri not in ("interactive", "batch"):
+                        raise ValueError(
+                            "priority must be 'interactive' or 'batch'")
+                    kwargs["priority"] = pri
                 from .api.stream import stream_requested
 
                 stream_on = stream_requested(payload, self.path)
+                if stream_on and self.headers.get("Last-Event-ID"):
+                    # SSE reconnect pass-through (docs/serving.md "SSE
+                    # reconnect"): forwarded to the first replica
+                    # attempt; the replica that parked the prefix
+                    # resumes it, any other starts fresh
+                    kwargs["last_event_id"] = \
+                        self.headers.get("Last-Event-ID")
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": str(e)})
                 return
@@ -1536,8 +1579,14 @@ def make_handler(router: FleetRouter, codec=None):
                 kwargs["stop"] = req["stop_sequences"]
             if req.get("logprobs"):
                 kwargs["logprobs"] = req["logprobs"]
+            if req.get("priority"):
+                kwargs["priority"] = req["priority"]
             prompt = req["prompt_tokens"]
             rid = next(oai_ids)
+            if req["stream"] and self.headers.get("Last-Event-ID"):
+                # SSE reconnect pass-through, same as /generate
+                kwargs["last_event_id"] = \
+                    self.headers.get("Last-Event-ID")
             if req["stream"]:
                 frame, close, err = oai.stream_frame_fns(
                     rid, model_name, codec, chat)

@@ -106,7 +106,40 @@ class ServingTaskAdapter(TaskAdapter):
             c.ENV_CLUSTER_SPEC: json.dumps(ctx.cluster_spec),
             c.ENV_SERVE_PORT: ctx.base_child_env.get(c.ENV_TASK_PORT, ""),
         }
+        flags = self._conf_serve_flags(ctx.conf)
+        if flags:
+            env[c.ENV_SERVE_EXTRA_FLAGS] = flags
         return env
+
+    @staticmethod
+    def _conf_serve_flags(conf) -> str:
+        """Template the paged-KV serve flags from ``tony.serving.*``
+        conf keys (docs/serving.md "Paged KV & admission tiers") into
+        one space-separated string the child exports as
+        TONY_SERVE_EXTRA_FLAGS — cli/serve.py prepends it to argv, so
+        a job file flips the whole fleet to paged admission without
+        editing every replica command (explicit flags still win)."""
+        if conf is None:
+            return ""
+        flags: list[str] = []
+        if conf.get_bool(keys.SERVING_PAGED_KV, False):
+            flags.append("--paged-kv")
+        for key, flag in (
+                (keys.SERVING_KV_BLOCK, "--kv-block"),
+                (keys.SERVING_KV_POOL_BLOCKS, "--kv-pool-blocks"),
+                (keys.SERVING_PREFILL_INTERLEAVE,
+                 "--prefill-interleave"),
+                (keys.SERVING_CLASS_BUDGET_INTERACTIVE,
+                 "--class-budget-interactive"),
+                (keys.SERVING_CLASS_BUDGET_BATCH,
+                 "--class-budget-batch")):
+            val = conf.get_int(key, 0)
+            if val:
+                flags.extend([flag, str(val)])
+        frac = conf.get(keys.SERVING_BATCH_QUEUE_FRAC, "")
+        if frac:
+            flags.extend(["--batch-queue-frac", str(frac)])
+        return " ".join(flags)
 
     # ------------------------------------------------------------ health
     def _poll_healthz(self, port: int, timeout: float = 2.0) -> str:
