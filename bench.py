@@ -96,6 +96,19 @@ before any interactive arrival is refused, zero failed requests, and
 the 429s carry engine-derived Retry-After. Results land in PERF.json
 under `paged_kv`.
 
+`python bench.py --serving --disagg` gates disaggregated prefill/
+decode serving (docs/serving.md "Disaggregated serving"): (1) a mixed
+workload (long-prompt prefill storm dropped on in-flight interactive
+decodes) on 1 prefill specialist + 1 decode replica vs 2 role="both"
+replicas at EQUAL hardware — the decode tier's TPOT p99 must be ≥
+1.2x better because prefill chunks never ride its scheduling turns —
+with byte-identity vs solo greedy and zero failed requests enforced;
+(2) a fleet leg with a mid-transfer SIGKILL of the prefill specialist:
+completed handoffs before the kill, journal-replay fallback after it
+(the router re-prefills from the prompt on the decode replica), zero
+failed requests, byte-identical. Results land in PERF.json under
+`disaggregated_serving`.
+
 `python bench.py --serving --streaming` gates the streaming subsystem
 (docs/serving.md "Streaming & OpenAI compatibility"): an open-loop
 Poisson arrival process streamed per-token through the FleetRouter
@@ -779,6 +792,403 @@ def run_paged_kv_bench() -> int:
             "batch_shed_before_interactive":
                 shed["interactive"] <= refused["interactive"],
         },
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def run_disagg_bench() -> int:
+    """Disaggregated prefill/decode serving gate (one JSON line ->
+    PERF.json `disaggregated_serving`; see the module docstring).
+    TINY shapes; the TPOT comparison is real-compute (NOT chaos-paced:
+    the win IS the compute a decode turn no longer carries) and every
+    correctness property — byte-identity, zero failed requests, the
+    SIGKILL replay fallback — is an enforced invariant."""
+    import re as _re
+    import signal as _signal
+    import subprocess
+    import threading
+    import time as _time
+    import urllib.request
+
+    sys.path.insert(0, str(REPO))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tony_tpu.models import transformer
+    from tony_tpu.models.serving import (
+        QueueFullError, Request, SlotServer,
+    )
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32)
+    params = transformer.init(jax.random.PRNGKey(0), cfg)
+    B, max_len, chunk, slots, pool = 8, 64, 8, 8, 96
+    rng = np.random.default_rng(17)
+
+    # mixed workload: an interactive decode cohort already in flight
+    # when a long-prompt prefill storm arrives. Cohort TPOT is what the
+    # decode tier's SLO protects; the storm is pure prefill pressure.
+    n_cohort, cohort_new = 6, 48
+    n_storm, storm_new = 16, 2
+    cohort_p = [rng.integers(0, cfg.vocab_size, size=8, dtype=np.int32)
+                for _ in range(n_cohort)]
+    storm_p = [rng.integers(0, cfg.vocab_size, size=48, dtype=np.int32)
+               for _ in range(n_storm)]
+
+    def mk(role="both"):
+        return SlotServer(params, cfg, slots=slots, max_len=max_len,
+                          block_size=4, prefill_chunk=chunk, paged=True,
+                          kv_block=B, kv_pool_blocks=pool, role=role)
+
+    def creq(i):
+        return Request(prompt=cohort_p[i], max_new_tokens=cohort_new)
+
+    def sreq(i):
+        return Request(prompt=storm_p[i], max_new_tokens=storm_new)
+
+    def _p99(walls):
+        assert len(walls) >= 10, f"too few turn samples: {len(walls)}"
+        s = sorted(walls)
+        return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+    # ---- byte reference: every request solo on ONE paged engine ----
+    solo = mk()
+    solo_reqs = ([creq(i) for i in range(n_cohort)]
+                 + [sreq(i) for i in range(n_storm)])
+    for r in solo_reqs:
+        solo.submit(r)
+    solo_done = solo.run_until_drained()
+    refs = [solo_done[r.id].tokens for r in solo_reqs]
+
+    # Both legs drive every engine serially in ONE process, so a
+    # stream's trace spans would absorb the OTHER replica's compute —
+    # the opposite of the separate-hardware reality. The faithful
+    # per-replica TPOT is the engine's OWN per-turn step wall while
+    # cohort work is in flight: an in-flight stream emits one token
+    # per scheduling turn, so its TPOT is exactly its replica's turn
+    # time, and whatever rides that turn (storm prefill chunks on a
+    # role=both replica; nothing on a decode specialist) is what the
+    # measurement must charge.
+
+    def run_both_leg():
+        """2 x role='both' at equal hardware: each replica carries half
+        the cohort AND half the storm — storm prefill chunks ride the
+        cohort's decode turns (bounded by the interleave cap, but
+        riding them all the same)."""
+        engines = [mk(), mk()]
+        reqs = [creq(i) for i in range(n_cohort)]
+        cohort_ids: list = [set(), set()]
+        for i, r in enumerate(reqs):
+            engines[i % 2].submit(r)
+            cohort_ids[i % 2].add(r.id)
+        for _ in range(3):              # cohort admitted, mid-decode
+            for e in engines:
+                e.step()
+                e.checkpoint_progress()
+        for i in range(n_storm):
+            engines[i % 2].submit(sreq(i))
+        done: list[dict] = [{}, {}]
+        walls: list = []
+        while not all(e.idle for e in engines):
+            for ei, e in enumerate(engines):
+                if not e.idle:
+                    t1 = _time.time()
+                    e.step()
+                    w = _time.time() - t1
+                    e.checkpoint_progress()
+                    if cohort_ids[ei] - set(done[ei]):
+                        walls.append(w)
+                if e._done:
+                    done[ei].update(e.drain_completed())
+        for ei, e in enumerate(engines):
+            done[ei].update(e.drain_completed())
+            e._allocator.check()
+        reasons = [c.finish_reason for d in done for c in d.values()]
+        assert all(r in ("stop", "length") for r in reasons), reasons
+        return _p99(walls)
+
+    def run_disagg_leg():
+        """1 prefill specialist + 1 decode replica (equal hardware):
+        every request prefills on the specialist and decodes — via the
+        exported-block handoff — on the decode replica, whose turns
+        carry ONLY decode work."""
+        pre, dec = mk("prefill"), mk("decode")
+        done_pre: dict = {}
+        done_dec: dict = {}
+        handoffs: list = []             # payloads awaiting a dec slot
+        rid_map: dict = {}              # original id -> dec-side id
+        kv_imports = 0
+
+        def pump_pre():
+            nonlocal kv_imports
+            if not pre.idle:
+                pre.step()
+                pre.checkpoint_progress()
+            if pre._done:
+                done_pre.update(pre.drain_completed())
+            for rid in list(done_pre):
+                comp = done_pre.pop(rid)
+                assert comp.finish_reason == "prefilled", comp
+                handoffs.append(pre.export_blocks(rid))
+            while handoffs:
+                try:
+                    new_rid = dec.import_blocks(handoffs[0])
+                except QueueFullError:
+                    break               # dec full; retry next turn
+                # the decode replica assigns its own request id; the
+                # entry carries the original for the caller's join
+                rid_map[handoffs[0]["entry"]["id"]] = new_rid
+                handoffs.pop(0)
+                kv_imports += 1
+
+        # leg ordering mirrors the both leg: cohort first, mid-decode,
+        # then the storm drops
+        cohort = [creq(i) for i in range(n_cohort)]
+        for r in cohort:
+            pre.submit(r)
+        while kv_imports < n_cohort:    # cohort handed off to dec
+            pump_pre()
+        for _ in range(3):              # cohort admitted, mid-decode
+            dec.step()
+            dec.checkpoint_progress()
+        storm = [sreq(i) for i in range(n_storm)]
+        for r in storm:
+            pre.submit(r)
+        all_reqs = cohort + storm
+        walls: list = []
+        cohort_orig = {r.id for r in cohort}
+        while len(done_dec) < len(all_reqs):
+            pump_pre()
+            if not dec.idle:
+                t1 = _time.time()
+                dec.step()
+                w = _time.time() - t1
+                dec.checkpoint_progress()
+                if {rid_map[i] for i in cohort_orig
+                        if i in rid_map} - set(done_dec):
+                    walls.append(w)
+            if dec._done:
+                done_dec.update(dec.drain_completed())
+        pre._allocator.check()
+        dec._allocator.check()
+        assert dec.stats()["paged_kv"]["kv_imports"] == len(all_reqs)
+        assert pre.stats()["paged_kv"]["kv_exports"] == len(all_reqs)
+        reasons = [c.finish_reason for c in done_dec.values()]
+        assert all(r in ("stop", "length") for r in reasons), reasons
+        toks = [done_dec[rid_map[r.id]].tokens for r in all_reqs]
+        return _p99(walls), toks
+
+    run_both_leg()                      # compile warm-up, both shapes
+    run_disagg_leg()
+    tpot_both = run_both_leg()
+    tpot_disagg, disagg_toks = run_disagg_leg()
+    speedup = tpot_both / tpot_disagg
+    assert disagg_toks == refs, (
+        "disaggregated completions diverged from solo greedy")
+    assert speedup >= 1.2, (
+        f"decode TPOT p99: 2x both {tpot_both:.4f}s vs disagg "
+        f"{tpot_disagg:.4f}s = {speedup:.2f}x (gate: >= 1.2x)")
+
+    # ---- fleet leg: mid-transfer SIGKILL -> journal-replay fallback --
+    import tempfile as _tempfile
+
+    from tony_tpu.router import FleetRouter
+
+    f_requests = 10
+    f_budgets = [8, 12, 16]
+    f_prompts = [rng.integers(0, cfg.vocab_size, size=24,
+                              dtype=np.int32).tolist()
+                 for _ in range(f_requests)]
+    # the serve CLI always sets n_kv_heads=n_heads (and the default
+    # max_seq_len), so the fleet byte-reference uses the CLI's shape —
+    # NOT the in-process cfg above
+    f_cfg = transformer.TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=128, dtype=jnp.float32)
+    f_params = transformer.init(jax.random.PRNGKey(0), f_cfg)
+    f_solo = SlotServer(f_params, f_cfg, slots=slots, max_len=max_len,
+                        block_size=4, prefill_chunk=chunk, paged=True,
+                        kv_block=B, kv_pool_blocks=pool)
+    f_reqs = [Request(prompt=p,
+                      max_new_tokens=f_budgets[i % len(f_budgets)])
+              for i, p in enumerate(f_prompts)]
+    for r in f_reqs:
+        f_solo.submit(r)
+    f_done = f_solo.run_until_drained()
+    f_refs = [f_done[r.id].tokens for r in f_reqs]
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           # slow each turn so the prefill leg stays in flight long
+           # enough for a genuinely MID-transfer kill
+           "TONY_TEST_SERVING_STEP_DELAY_MS": "25"}
+    env.pop("XLA_FLAGS", None)
+
+    class Srv:
+        def __init__(self, name, role, trace_dir):
+            self.name, self.role, self.trace_dir = name, role, trace_dir
+            self.proc = self.port = None
+            self.spawn()
+
+        def spawn(self):
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "tony_tpu.cli.main", "serve",
+                 "--port", "0", "--vocab", "256", "--d-model", "64",
+                 "--n-layers", "2", "--n-heads", "4",
+                 "--d-ff", "128", "--dtype", "float32",
+                 "--seed", "0", "--slots", str(slots),
+                 "--max-len", str(max_len), "--block-size", "4",
+                 "--prefill-chunk", str(chunk), "--paged-kv",
+                 "--kv-block", str(B), "--kv-pool-blocks", str(pool),
+                 "--role", self.role, "--trace-dir", self.trace_dir],
+                cwd=REPO, env=env, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            self.port = None
+
+        def await_ready(self, timeout=240.0):
+            deadline = _time.time() + timeout
+            while self.port is None and _time.time() < deadline:
+                line = self.proc.stdout.readline()
+                m = _re.search(r"http://[\d.]+:(\d+)", line or "")
+                if m:
+                    self.port = int(m.group(1))
+            assert self.port, f"{self.name} never printed its port"
+            threading.Thread(target=self.proc.stdout.read,
+                             daemon=True).start()
+            while _time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{self.port}/healthz",
+                            timeout=2) as r:
+                        if r.status == 200:
+                            return
+                except Exception:
+                    _time.sleep(0.2)
+            raise AssertionError(f"{self.name} never became healthy")
+
+        def stats(self):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}/stats",
+                    timeout=10) as r:
+                return json.loads(r.read().decode())
+
+        def stop(self):
+            if self.proc.poll() is None:
+                self.proc.kill()
+            self.proc.wait(timeout=15)
+
+    td = _tempfile.mkdtemp(prefix="tony-disagg-bench-")
+    pre_s = Srv("pre", "prefill", os.path.join(td, "pre"))
+    dec_s = Srv("dec", "decode", os.path.join(td, "dec"))
+    router = None
+    try:
+        pre_s.await_ready()
+        dec_s.await_ready()
+        router = FleetRouter(
+            [("pre", "127.0.0.1", pre_s.port),
+             ("dec", "127.0.0.1", dec_s.port)],
+            prefill_chunk=chunk, health_interval_s=0.15,
+            stats_every=1, seed=0)
+        router.start()
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            st = router.stats()["replicas"]
+            if st.get("pre", {}).get("role") == "prefill" \
+                    and st.get("dec", {}).get("role") == "decode":
+                break
+            _time.sleep(0.1)
+
+        fleet_results: dict[int, object] = {}
+
+        def call(i):
+            try:
+                fleet_results[i] = router.generate(
+                    f_prompts[i],
+                    max_new_tokens=f_budgets[i % len(f_budgets)],
+                    timeout_s=300)
+            except Exception as e:
+                fleet_results[i] = e
+
+        t0 = _time.time()
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(f_requests)]
+        for t in threads:
+            t.start()
+            _time.sleep(0.05)
+        # kill the prefill specialist once the transfer path has
+        # genuinely moved blocks (>=1 completed handoff) AND a prefill
+        # leg is in flight — a mid-transfer death, not a cold one
+        deadline = _time.time() + 120
+        killed = False
+        while _time.time() < deadline:
+            rs = router.stats()
+            if rs["disagg_handoffs"] >= 1 and rs["disagg_requests"] \
+                    > rs["disagg_handoffs"] + rs["disagg_fallbacks"]:
+                os.kill(pre_s.stats()["pid"], _signal.SIGKILL)
+                killed = True
+                break
+            _time.sleep(0.02)
+        assert killed, "the transfer path never reached a kill window"
+        for t in threads:
+            t.join(timeout=600)
+        fleet_wall = _time.time() - t0
+        assert not any(t.is_alive() for t in threads), "hung callers"
+        failed = [i for i, r in fleet_results.items()
+                  if not isinstance(r, dict)]
+        assert not failed, (
+            f"disagg SIGKILL leg failed requests: "
+            f"{[(i, fleet_results[i]) for i in failed]}")
+        mismatch = [i for i in range(f_requests)
+                    if fleet_results[i]["tokens"] != f_refs[i]]
+        assert not mismatch, (
+            f"disagg fleet diverged from solo greedy on: {mismatch}")
+        rstats = router.stats()
+        assert rstats["failed"] == 0
+        assert rstats["disagg_handoffs"] >= 1, (
+            "no handoff completed before the kill")
+        assert rstats["disagg_fallbacks"] >= 1, (
+            "the mid-transfer kill must exercise the replay fallback")
+        dec_stats = dec_s.stats()
+        kv_imported = dec_stats["paged_kv"]["kv_imports"]
+    finally:
+        if router is not None:
+            router.shutdown()
+        for s in (pre_s, dec_s):
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+    out = {
+        "metric": "disagg_decode_tpot_p99_speedup_vs_both",
+        "value": round(speedup, 3),
+        "unit": "x (1 prefill + 1 decode vs 2x role=both at equal "
+                "hardware; gate >= 1.2x)",
+        "kv_block": B,
+        "pool_blocks_per_replica": pool,
+        "mixed_workload": {
+            "cohort": {"n": n_cohort, "prompt_len": 8,
+                       "max_new": cohort_new},
+            "storm": {"n": n_storm, "prompt_len": 48,
+                      "max_new": storm_new},
+        },
+        "both_tpot_p99_s": round(tpot_both, 4),
+        "disagg_tpot_p99_s": round(tpot_disagg, 4),
+        "byte_identical_vs_solo": True,
+        "zero_failed_requests": True,
+        "sigkill_leg": {
+            "requests": f_requests,
+            "failed": 0,
+            "byte_identical": True,
+            "handoffs_before_kill": rstats["disagg_handoffs"],
+            "replay_fallbacks": rstats["disagg_fallbacks"],
+            "decode_kv_imports": kv_imported,
+            "wall_s": round(fleet_wall, 3),
+            "chaos_step_delay_ms": 25,
+        },
+        "num_devices": jax.device_count(),
     }
     print(json.dumps(out))
     return 0
@@ -3531,6 +3941,8 @@ def main() -> int:
     if "--serving" in sys.argv:
         if "--paged-kv" in sys.argv:
             return run_paged_kv_bench()
+        if "--disagg" in sys.argv:
+            return run_disagg_bench()
         if "--streaming" in sys.argv:
             return run_serving_streaming_bench()
         if "--spec" in sys.argv:

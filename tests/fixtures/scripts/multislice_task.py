@@ -33,6 +33,18 @@ if session == 0:
              "slice.json").unlink(missing_ok=True)
         print("preempted: slice 1 destroyed", file=sys.stderr)
     else:
+        # the peer's collective breaks BECAUSE the slice vanished, so
+        # it must observe the destruction before dying: the chief's
+        # exit short-circuits the attempt, and a chief that races
+        # ahead lets the driver's reset() kill this gang (and re-
+        # discover slice 1) before the stub cloud state reflects the
+        # preemption — the retry would then skip the re-create
+        import time
+
+        gone = Path(os.environ["STUB_PREEMPT_DIR"], "slice.json")
+        deadline = time.time() + 10
+        while gone.exists() and time.time() < deadline:
+            time.sleep(0.01)
         print("gang peer lost (slice 1 preempted)", file=sys.stderr)
     sys.exit(1)
 print(f"attempt {session} slice {sid} ok")

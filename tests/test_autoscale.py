@@ -693,3 +693,104 @@ def test_controller_router_view_is_max_not_sum():
     ctl2.decide(hot, 1, now=0.0)
     d = ctl2.decide(hot, 1, now=1.0)
     assert d is not None and d.direction == "up"    # router-only breach
+
+
+# --------------------------------------------------------------------------
+# two-tier scaling (disaggregated fleets, PR 17)
+# --------------------------------------------------------------------------
+
+
+def test_controller_tiered_breach_attribution():
+    """On a tiered fleet the breach SIGNAL names the tier: queue depth
+    scales the prefill tier, TTFT/TPOT p99 the decode tier; the same
+    signals on an untiered fleet leave tier empty (today's behavior)."""
+    ctl = _ctl()
+    hot_q = FleetObservation(live=2, queued=10, tiered=True)
+    ctl.decide(hot_q, 2, now=0.0)
+    d = ctl.decide(hot_q, 2, now=1.0)
+    assert d is not None and d.direction == "up" and d.tier == "prefill"
+
+    ctl = _ctl(queue_slo=0)
+    slow = FleetObservation(live=2, ttft_p99_s=2.5, window_samples=20,
+                            tiered=True)
+    ctl.decide(slow, 2, now=0.0)
+    d = ctl.decide(slow, 2, now=1.0)
+    assert d is not None and d.tier == "decode" and "ttft" in d.reason
+    # untiered: same breach, no tier
+    ctl = _ctl()
+    flat = FleetObservation(live=2, queued=10)
+    ctl.decide(flat, 2, now=0.0)
+    d = ctl.decide(flat, 2, now=1.0)
+    assert d is not None and d.tier == ""
+
+
+def test_controller_tpot_slo_breach_scales_decode():
+    """TPOT p99 is the decode tier's own latency signal: a controller
+    with tpot_slo_s set breaches on it (tier 'decode' when tiered) and
+    a sub-half-SLO TPOT counts toward the scale-down clear window."""
+    ctl = _ctl(queue_slo=0, ttft_slo_s=0.0, tpot_slo_s=0.05)
+    slow = FleetObservation(live=2, tpot_p99_s=0.2, window_samples=20,
+                            tiered=True)
+    assert ctl.decide(slow, 2, now=0.0) is None
+    d = ctl.decide(slow, 2, now=1.0)
+    assert d is not None and d.direction == "up"
+    assert d.tier == "decode" and "tpot" in d.reason
+    # a TPOT still above half-SLO blocks the clear window
+    ctl2 = _ctl(queue_slo=0, ttft_slo_s=0.0, tpot_slo_s=0.05,
+                cooldown_s=5.0)
+    warm = FleetObservation(live=2, tpot_p99_s=0.04, window_samples=5)
+    for t in (0.0, 3.0, 6.0, 9.0):
+        assert ctl2.decide(warm, 2, now=t) is None
+    cool = FleetObservation(live=2, tpot_p99_s=0.01, window_samples=5)
+    assert ctl2.decide(cool, 2, now=10.0) is None       # clear re-armed
+    d = ctl2.decide(cool, 2, now=16.0)
+    assert d is not None and d.direction == "down"
+
+
+def test_watcher_parses_roles_and_tpot(monkeypatch):
+    """FleetWatcher marks the observation tiered when any replica
+    advertises a specialist role, splits prefill queue depth out, and
+    windows TPOT buckets by delta exactly like TTFT."""
+    import json as _json
+
+    from tony_tpu.autoscale import FleetWatcher
+
+    stats = {
+        "p": {"role": "prefill", "queued": 6, "active": 0, "slots": 2},
+        "d": {"role": "decode", "queued": 1, "active": 2, "slots": 2},
+    }
+    tpot = {"0.025": 0, "0.1": 40, "+Inf": 40}
+
+    def metrics_text():
+        return "\n".join(
+            f'serving_tpot_seconds_bucket{{le="{le}"}} {v}'
+            for le, v in tpot.items())
+
+    watcher = FleetWatcher()
+
+    def fake_get(url):
+        for name in stats:
+            if f"//{name}:" in url.replace("http://", "//h-"):
+                pass
+        if url.endswith("/stats"):
+            name = url.split("//")[1].split(":")[0].split("-")[1]
+            return _json.dumps(stats[name])
+        return metrics_text()
+
+    monkeypatch.setattr(watcher, "_get", fake_get)
+    eps = [("p", "h-p", 1), ("d", "h-d", 2)]
+    obs = watcher.observe(eps)
+    assert obs.tiered
+    assert obs.queued_prefill == 6
+    assert obs.queued == 7 and obs.live == 2
+    assert watcher.last_roles == {"p": "prefill", "d": "decode"}
+    assert obs.tpot_p99_s is None, "first poll is the baseline"
+    # a delta-only second poll windows TPOT: 10 new samples under 0.1s
+    tpot = {"0.025": 0, "0.1": 50, "+Inf": 50}
+    obs2 = watcher.observe(eps)
+    assert obs2.tpot_p99_s is not None
+    assert 0.025 < obs2.tpot_p99_s <= 0.1
+    # an untiered fleet never sets the flag
+    stats["p"]["role"] = "both"
+    del stats["d"]["role"]
+    assert not watcher.observe(eps).tiered

@@ -412,10 +412,13 @@ def test_metrics_endpoint_matches_stats(params):
         # every SERVING_* series named in metrics.py is present — except
         # the speculative families, which render only for spec-enabled
         # engines (this server has no draft; their live rendering is
-        # asserted in tests/test_spec_serving.py's metrics-labels test)
+        # asserted in tests/test_spec_serving.py's metrics-labels test),
+        # and the paged-pool/KV-transfer families, which render only
+        # for paged engines (live rendering asserted in
+        # tests/test_streaming.py's disaggregated two-leg e2e)
         for attr in dir(_metrics):
             if attr.startswith("SERVING_") and \
-                    not attr.startswith("SERVING_SPEC_"):
+                    not attr.startswith(("SERVING_SPEC_", "SERVING_KV_")):
                 assert getattr(_metrics, attr) in text, (
                     f"{attr} series missing from /metrics")
         for fam in ("serving_ttft_seconds", "serving_tpot_seconds",
@@ -724,6 +727,21 @@ def test_metrics_names_rendered_and_documented():
         assert fam in doc_names, (
             f"autoscale/quota family undocumented: {fam}")
 
+    # the disaggregated-serving families are pinned EXPLICITLY the same
+    # way (ISSUE 17 lint discipline): pool occupancy by owner plus the
+    # KV-transfer counters on serve /metrics, and the split-request
+    # accounting on router /metrics — each must be rendered and
+    # documented; renaming either side without the other fails here
+    for fam in (_metrics.SERVING_KV_POOL_BLOCKS,
+                _metrics.SERVING_KV_EXPORTS_TOTAL,
+                _metrics.SERVING_KV_IMPORTS_TOTAL,
+                _metrics.SERVING_KV_IMPORT_REJECTS_TOTAL,
+                _metrics.ROUTER_DISAGG_REQUESTS_TOTAL,
+                _metrics.ROUTER_DISAGG_HANDOFFS_TOTAL,
+                _metrics.ROUTER_DISAGG_FALLBACKS_TOTAL):
+        assert fam in rendered, f"disagg family unrendered: {fam}"
+        assert fam in doc_names, f"disagg family undocumented: {fam}"
+
     # the model-labeled partition is a rendered contract too: the serve
     # renderer must attach {model=...} labels somewhere (the per-model
     # block) and the doc must describe the label
@@ -755,14 +773,15 @@ def test_finish_reason_vocabulary_pinned():
     # the pinned sets themselves (a rename/removal is a doc+router
     # migration, not a drive-by)
     assert COMPLETION_FINISH_REASONS == ("stop", "length", "cancelled",
-                                         "expired", "shed")
+                                         "expired", "shed", "prefilled")
     assert FINISH_REASONS == COMPLETION_FINISH_REASONS + ("failed",)
     # trace terminals <-> finish reasons: "finished" carries the
-    # stop/length reason in attrs; every other terminal IS its reason
+    # stop/length/prefilled reason in attrs; every other terminal IS
+    # its reason
     from tony_tpu.observability import TERMINAL_SPANS
 
     assert set(TERMINAL_SPANS) - {"finished"} == \
-        set(FINISH_REASONS) - set(("stop", "length"))
+        set(FINISH_REASONS) - set(("stop", "length", "prefilled"))
     assert "replayed" not in TERMINAL_SPANS, (
         "replay is a mid-life mark, never a terminal")
 

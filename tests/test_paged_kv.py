@@ -314,7 +314,10 @@ def test_class_budgets_shed_order_and_retry_after(params):
 
 
 def test_paged_mode_constructor_carveouts(params):
-    """The documented incompatibilities fail loudly at construction."""
+    """The documented incompatibilities fail loudly at construction.
+    (The PR 16 spec/mesh carve-outs are gone — paged now composes with
+    both; see the byte-identity tests — so the remaining refusals are
+    the structural ones plus the disaggregation role rules.)"""
     with pytest.raises(ValueError, match="multiple of"):
         _mk(params, paged=True, max_len=60, kv_block=8)
     with pytest.raises(ValueError, match="prefill_chunk"):
@@ -323,9 +326,212 @@ def test_paged_mode_constructor_carveouts(params):
         _mk(params, prefill_interleave=2)
     with pytest.raises(ValueError, match="requires paged"):
         _mk(params, class_budgets={"batch": 4})
+    with pytest.raises(ValueError, match="role"):
+        _mk(params, paged=True, role="verifier")
+    with pytest.raises(ValueError, match="paged"):
+        _mk(params, role="prefill")
     draft_cfg = transformer.TransformerConfig(
         vocab_size=256, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
         d_ff=64, max_seq_len=128, dtype=jnp.float32)
     draft = transformer.init(jax.random.PRNGKey(1), draft_cfg)
-    with pytest.raises(ValueError, match="speculative"):
-        _mk(params, paged=True, draft=draft, draft_cfg=draft_cfg)
+    with pytest.raises(ValueError, match="prefill"):
+        _mk(params, paged=True, role="prefill",
+            draft=draft, draft_cfg=draft_cfg)
+
+
+# ------------------------------------- disaggregated serving (PR 17)
+# KV block transfer: a prefill-role replica exports finished block
+# tables; a decode replica imports them and resumes byte-identically.
+# The serde pair is pure host code, so damage modes are unit-tested
+# without HTTP; the refcount invariants ride the same engines.
+
+
+def _prefill_decode_pair(params, **kw):
+    pre = _mk(params, paged=True, role="prefill", **kw)
+    dec = _mk(params, paged=True, role="decode", **kw)
+    return pre, dec
+
+
+def _export_one(pre, prompt, max_new=8):
+    """Prefill one request on a prefill-role server; return its payload."""
+    r = Request(prompt=prompt, max_new_tokens=max_new)
+    pre.submit(r)
+    done = pre.run_until_drained()
+    comp = done[r.id]
+    assert comp.finish_reason == "prefilled" and comp.tokens == []
+    return pre.export_blocks(r.id)
+
+
+def test_kv_block_serialize_roundtrip_f32_and_int8(params):
+    """serialize_kv_blocks <-> deserialize_kv_blocks is exact for both
+    the f32 pool and the int8 pool (payload AND scales), and the wire
+    payload carries exactly the pinned key set."""
+    from tony_tpu.models.serving import (
+        KV_IMPORT_KEYS, deserialize_kv_blocks,
+    )
+
+    for kv_dtype in (None, "int8"):
+        kw = {"kv_dtype": kv_dtype} if kv_dtype else {}
+        pre = _mk(params, paged=True, role="prefill", **kw)
+        payload = _export_one(pre, _prompt(11, seed=41))
+        assert set(payload) == set(KV_IMPORT_KEYS)
+        k, v, ks, vs = deserialize_kv_blocks(payload)
+        assert k.shape == v.shape and k.shape[1] == payload["n_blocks"]
+        if kv_dtype == "int8":
+            assert k.dtype == np.int8 and ks is not None and vs is not None
+            assert ks.shape == k.shape[:4]
+        else:
+            assert ks is None and vs is None
+        # a JSON round trip (the wire format) changes nothing
+        import json as _json
+
+        k2, v2, ks2, vs2 = deserialize_kv_blocks(
+            _json.loads(_json.dumps(payload)))
+        np.testing.assert_array_equal(k, k2)
+        np.testing.assert_array_equal(v, v2)
+        if ks is not None:
+            np.testing.assert_array_equal(ks, ks2)
+            np.testing.assert_array_equal(vs, vs2)
+
+
+def test_export_import_byte_identity_and_refcounts(params):
+    """THE transfer contract: prefill on one engine, decode on another,
+    tokens byte-identical to a solo paged engine. Refcount invariants:
+    the exporter's pool drains back to fully free (the snapshot is host
+    bytes — export never leaks a block), and both allocators pass
+    check() after the handoff."""
+    prompts = [_prompt(9, seed=11), _prompt(13, seed=12)]
+    solo = _run(_mk(params, paged=True),
+                [Request(prompt=p, max_new_tokens=8) for p in prompts])
+    solo_toks = [solo[key].tokens for key in sorted(solo)]
+
+    pre, dec = _prefill_decode_pair(params)
+    total = pre.stats()["paged_kv"]["pool_blocks_total"]
+    payloads = [_export_one(pre, p) for p in prompts]
+    st = pre.stats()["paged_kv"]
+    assert st["kv_exports"] == 2
+    assert st["pool_blocks_free"] == total, (
+        "export must free the prefill replica's blocks")
+    pre._allocator.check()
+
+    rids = [dec.import_blocks(pl) for pl in payloads]
+    done = dec.run_until_drained()
+    assert [done[r].tokens for r in rids] == solo_toks
+    assert dec.stats()["paged_kv"]["kv_imports"] == 2
+    dec._allocator.check()
+    # pool_state partitions the pool: the four owner states sum to total
+    ps = dec.stats()["paged_kv"]["pool_state"]
+    assert set(ps) == {"free", "slot", "trie", "shared"}
+    assert sum(ps.values()) == dec.stats()["paged_kv"]["pool_blocks_total"]
+
+
+def test_import_rejects_damage_loudly_then_replays(params):
+    """The torn-transfer contract: every damage mode raises ValueError
+    (counted in kv_import_rejects), the importer's pool is untouched,
+    and the fallback—re-prefilling from the entry's prompt, the journal
+    replay story—still completes byte-identically."""
+    prompt = _prompt(10, seed=51)
+    solo = _run(_mk(params, paged=True),
+                [Request(prompt=prompt, max_new_tokens=8)])
+    solo_toks = [solo[key].tokens for key in sorted(solo)]
+
+    pre, dec = _prefill_decode_pair(params)
+    payload = _export_one(pre, prompt)
+    free0 = dec.stats()["paged_kv"]["pool_blocks_free"]
+
+    damaged = []
+    p = dict(payload); p["version"] = 99
+    damaged.append(("version", p))
+    p = dict(payload); p["model"] = "other-model"
+    damaged.append(("model", p))
+    p = dict(payload); p["kv_block"] = 16
+    damaged.append(("kv_block", p))
+    p = dict(payload); p["blocks_k"] = p["blocks_k"][:-24]  # truncated
+    damaged.append(("truncated", p))
+    raw = bytearray(__import__("base64").b64decode(payload["blocks_v"]))
+    raw[0] ^= 0xFF                                          # bit flip
+    p = dict(payload)
+    p["blocks_v"] = __import__("base64").b64encode(bytes(raw)).decode()
+    damaged.append(("checksum", p))
+    p = dict(payload); p["entry"] = None
+    damaged.append(("entry", p))
+    for name, bad in damaged:
+        with pytest.raises(ValueError):
+            dec.import_blocks(bad)
+    st = dec.stats()["paged_kv"]
+    assert st["kv_import_rejects"] == len(damaged)
+    assert st["kv_imports"] == 0
+    assert st["pool_blocks_free"] == free0, (
+        "a rejected import must not leak pool blocks")
+    dec._allocator.check()
+    # the fallback leg: re-prefill from the entry's replay state
+    entry = payload["entry"]
+    fb = Request(prompt=np.asarray(entry["prompt"], np.int32),
+                 max_new_tokens=entry["max_new_tokens"])
+    dec.submit(fb)
+    done = dec.run_until_drained()
+    assert [done[fb.id].tokens] == solo_toks
+    dec._allocator.check()
+
+
+def test_import_backpressure_is_queue_full(params):
+    """A handoff needs a seat NOW: with every slot busy, import_blocks
+    raises QueueFullError (with a Retry-After estimate) instead of
+    queueing — queueing would hide the decode tier's backpressure from
+    the router."""
+    pre, dec = _prefill_decode_pair(params)
+    payloads = [_export_one(pre, _prompt(9 + i, seed=60 + i),
+                            max_new=24) for i in range(3)]
+    dec.import_blocks(payloads[0])
+    dec.import_blocks(payloads[1])       # both slots now busy
+    with pytest.raises(QueueFullError) as ei:
+        dec.import_blocks(payloads[2])
+    assert getattr(ei.value, "retry_after_s", 0) > 0
+    assert dec.stats()["paged_kv"]["kv_import_rejects"] == 0, (
+        "backpressure is not damage")
+    dec.run_until_drained()
+    dec._allocator.check()
+
+
+def test_spec_paged_byte_identity(params):
+    """PR 16 carve-out closed: speculative decoding on the paged pool
+    (target + draft pools under one allocator, forced-sync rounds) is
+    byte-identical to speculative decoding on the ring engine."""
+    draft_cfg = transformer.TransformerConfig(
+        vocab_size=256, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq_len=128, dtype=jnp.float32)
+    draft = transformer.init(jax.random.PRNGKey(1), draft_cfg)
+
+    def sreqs():
+        return [Request(prompt=_prompt(7 + i, seed=20 + i),
+                        max_new_tokens=8) for i in range(3)]
+
+    ring = _run(_mk(params, draft=draft, draft_cfg=draft_cfg,
+                    stop_tokens=(5,)), sreqs())
+    srv = _mk(params, draft=draft, draft_cfg=draft_cfg,
+              stop_tokens=(5,), paged=True)
+    paged = _run(srv, sreqs())
+    _same(ring, paged)
+    assert srv.stats()["speculative"]["rounds"] > 0
+    srv._allocator.check()
+
+
+def test_paged_mesh_byte_identity(params):
+    """PR 16 carve-out closed: the paged pool under a (data=2, tensor=2)
+    mesh — pool sharded over its block axis like the ring cache's batch
+    axis — is byte-identical to the single-device paged engine."""
+    from tony_tpu.parallel import MeshSpec, build_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 forced host devices")
+    prompts = [_prompt(9, seed=11), _prompt(13, seed=12)]
+    solo = _run(_mk(params, paged=True),
+                [Request(prompt=p, max_new_tokens=8) for p in prompts])
+    solo_toks = [solo[key].tokens for key in sorted(solo)]
+    mesh = build_mesh(MeshSpec(data=2, fsdp=1, tensor=2),
+                      devices=jax.devices()[:4])
+    msrv = SlotServer(params, TINY, slots=4, max_len=64, block_size=4,
+                      prefill_chunk=8, paged=True, mesh=mesh)
+    m = _run(msrv, [Request(prompt=p, max_new_tokens=8) for p in prompts])
+    assert [m[key].tokens for key in sorted(m)] == solo_toks
+    msrv._allocator.check()

@@ -61,6 +61,14 @@ class StubReplica:
         # advertised model registry (the serve /stats "models" keys);
         # None = legacy replica without the field
         self.models: list | None = None
+        # disaggregated serving: role advertised on /stats (None =
+        # legacy roleless replica); a prefill-role stub answers
+        # /generate with finish_reason="prefilled" + this handoff
+        # payload (None = export stash aged out); /kv/import POSTs
+        # land in import_payloads and answer like a decode completion
+        self.role: str | None = None
+        self.handoff: dict | None = None
+        self.import_payloads: list[dict] = []
         self.delay_s = 0.0
         # mid-request death: sleep, then sever the connection with no
         # response (what a SIGKILL looks like to the router's POST)
@@ -108,6 +116,8 @@ class StubReplica:
                         "retry_after_s": stub.retry_after}
                     if stub.models is not None:
                         payload["models"] = {m: {} for m in stub.models}
+                    if stub.role is not None:
+                        payload["role"] = stub.role
                     self._send(200, payload)
                 elif self.path.partition("?")[0] == "/progress":
                     # serve-contract shape: {key: {tokens, prompt_tokens}}
@@ -127,6 +137,7 @@ class StubReplica:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", "0"))
                 payload = json.loads(self.rfile.read(n) or b"{}")
+                path = self.path.partition("?")[0]
                 with stub._lock:
                     if stub.shed_next > 0:
                         stub.shed_next -= 1
@@ -141,14 +152,35 @@ class StubReplica:
                         stub.client_error_next -= 1
                         self._send(400, {"error": "unknown model"})
                         return
-                    stub.received.append(list(payload["prompt"]))
-                    stub.payloads.append(dict(payload))
+                    if path == "/kv/import":
+                        stub.import_payloads.append(dict(payload))
+                    else:
+                        stub.received.append(list(payload["prompt"]))
+                        stub.payloads.append(dict(payload))
                 if stub.abort_after_s:
                     time.sleep(stub.abort_after_s)
                     self.connection.close()     # died mid-request
                     return
                 if stub.delay_s:
                     time.sleep(stub.delay_s)
+                if path == "/kv/import":
+                    # decode leg: resume from the imported blocks — a
+                    # deterministic function of the entry's prompt
+                    base = sum(payload.get("entry", {})
+                               .get("prompt", [0])) % 100
+                    self._send(200, {
+                        "id": len(stub.import_payloads),
+                        "tokens": [base + 1, base + 2],
+                        "finish_reason": "length"})
+                    return
+                if stub.role == "prefill":
+                    # prefill specialist: zero tokens + handoff payload
+                    resp = {"id": len(stub.received), "tokens": [],
+                            "finish_reason": "prefilled"}
+                    if stub.handoff is not None:
+                        resp["handoff"] = stub.handoff
+                    self._send(200, resp)
+                    return
                 if payload.get("stream") and stub.stream_total:
                     # SSE contract: the full logical stream from
                     # position 0 (resume prefix is a true prefix of it
@@ -1141,3 +1173,129 @@ def test_fleet_e2e_kill_midburst_zero_failures(tmp_job_dirs, tmp_path):
         driver.session.kill_all("test complete")
         driver_thread.join(timeout=60)
     assert not driver_thread.is_alive(), "driver did not stop"
+
+
+# --------------------------------------------------------------------------
+# disaggregated serving: phase-aware routing (PR 17)
+# --------------------------------------------------------------------------
+
+
+def test_disagg_two_leg_handoff(stubs):
+    """The disaggregated happy path: a roled fleet routes the request
+    through TWO legs — prefill on the specialist, then the handoff
+    payload POSTed VERBATIM to the decode replica's /kv/import — and
+    the caller sees one completion, served by the decode leg."""
+    pre, dec = stubs("pre", "dec")
+    pre.role, dec.role = "prefill", "decode"
+    pre.handoff = {"version": 1, "entry": {"prompt": [1, 2, 3]}}
+    router = _router([pre, dec], prefill_chunk=8)
+    router.health_tick()
+    assert router.replicas["pre"].role == "prefill"
+    assert router.replicas["dec"].role == "decode"
+
+    resp = router.generate([1, 2, 3], max_new_tokens=4, timeout_s=5)
+    base = sum([1, 2, 3]) % 100
+    assert resp["tokens"] == [base + 1, base + 2]
+    assert resp["replica"] == "dec"
+    assert resp["prefill_replica"] == "pre"
+    assert pre.received == [[1, 2, 3]], "leg 1 must hit the specialist"
+    assert dec.import_payloads == [pre.handoff], \
+        "leg 2 must carry the handoff payload verbatim"
+    assert not dec.received, "decode leg rides /kv/import, not /generate"
+    st = router.stats()
+    assert (st["disagg_requests"], st["disagg_handoffs"],
+            st["disagg_fallbacks"]) == (1, 1, 0)
+    assert st["failed"] == 0
+    # per-role aggregates feed the two-tier autoscaler
+    assert st["fleet"]["roles"]["prefill"]["live"] == 1
+    assert st["fleet"]["roles"]["decode"]["live"] == 1
+    # ... and the three counters render on /metrics
+    text = router.prometheus_metrics()
+    for fam in ("router_disagg_requests_total",
+                "router_disagg_handoffs_total",
+                "router_disagg_fallbacks_total"):
+        assert f"{fam} " in text, fam
+    for line in text.strip().splitlines():
+        assert _PROM_LINE.match(line), f"malformed line: {line!r}"
+
+
+def test_disagg_prefill_replicas_never_serve_classic(stubs):
+    """A prefill specialist is reachable ONLY through the two-leg path:
+    when it sheds its leg, the fallback re-prefills on the decode-
+    capable replica — the specialist never appears in the classic
+    rotation, and no request fails."""
+    pre, both = stubs("pre", "both")
+    pre.role = "prefill"                     # 'both' stays roleless
+    pre.shed_next = 10                       # every prefill leg sheds
+    router = _router([pre, both], prefill_chunk=8)
+    router.health_tick()
+    for i in range(3):
+        resp = router.generate([7, i], max_new_tokens=2, timeout_s=5)
+        assert resp["replica"] == "both"
+    st = router.stats()
+    assert (st["disagg_requests"], st["disagg_handoffs"],
+            st["disagg_fallbacks"]) == (3, 0, 3)
+    assert st["failed"] == 0
+    assert len(both.received) == 3, \
+        "fallback = classic single-leg re-prefill from the prompt"
+    assert not pre.import_payloads and len(pre.received) == 0
+
+
+def test_disagg_fallback_on_torn_import(stubs):
+    """A damaged payload is rejected LOUDLY by the decode replica (400
+    from import_blocks) and the router replays: re-prefill from the
+    prompt on the classic path. Recompute, never a lost request."""
+    pre, dec = stubs("pre", "dec")
+    pre.role, dec.role = "prefill", "decode"
+    pre.handoff = {"version": 1, "entry": {"prompt": [4, 4]}}
+    dec.client_error_next = 1                # 400s the /kv/import POST
+    router = _router([pre, dec], prefill_chunk=8)
+    router.health_tick()
+    resp = router.generate([4, 4], max_new_tokens=2, timeout_s=5)
+    assert resp["replica"] == "dec"
+    assert resp["finish_reason"] == "length"
+    st = router.stats()
+    assert (st["disagg_handoffs"], st["disagg_fallbacks"]) == (0, 1)
+    assert st["failed"] == 0
+    assert router.replicas["dec"].up, "a torn payload must not eject"
+    assert len(dec.received) == 1, "fallback re-prefilled on dec"
+
+
+def test_disagg_stale_export_and_stale_role(stubs):
+    """Two advertisement-skew shapes: (a) the specialist prefilled but
+    its export stash aged out (no handoff in the response) — fall back;
+    (b) a replica advertised prefill but served the WHOLE request
+    (role changed between polls) — deliver what we already paid for."""
+    pre, dec = stubs("pre", "dec")
+    pre.role, dec.role = "prefill", "decode"
+    pre.handoff = None                       # (a) stash aged out
+    router = _router([pre, dec], prefill_chunk=8)
+    router.health_tick()
+    resp = router.generate([5, 6], max_new_tokens=2, timeout_s=5)
+    assert resp["finish_reason"] == "length"
+    st = router.stats()
+    assert (st["disagg_handoffs"], st["disagg_fallbacks"]) == (0, 1)
+    assert st["failed"] == 0
+    # (b): the "specialist" stops advertising prefilled terminals —
+    # emulate by clearing the role on the stub side only (the router
+    # still believes it's a specialist until the next poll)
+    pre.role = None                          # serves a full completion
+    resp = router.generate([6, 7], max_new_tokens=2, timeout_s=5)
+    assert resp["replica"] == "pre"
+    assert resp["tokens"] == [2], "the full completion is delivered"
+    assert router.stats()["failed"] == 0
+
+
+def test_disagg_mixed_fleet_degrades_to_classic(stubs):
+    """A fleet with NO live prefill specialist (roleless or role=both)
+    never attempts the two-leg path — today's behavior, untouched."""
+    a, b = stubs("a", "b")
+    b.role = "both"
+    router = _router([a, b], prefill_chunk=8)
+    router.health_tick()
+    for i in range(4):
+        router.generate([9, i], max_new_tokens=1, timeout_s=5)
+    st = router.stats()
+    assert st["disagg_requests"] == 0
+    assert st["failed"] == 0
+    assert len(a.received) + len(b.received) == 4

@@ -294,6 +294,19 @@ def test_api_contract_pinned_against_docs():
     router_src = inspect.getsource(router_mod)
     assert "Retry-After" in serve_src and "retry_after_s" in serve_src
     assert "Retry-After" in router_src and "min_retry_after" in router_src
+    # disaggregated-serving surface (ISSUE 17): the /kv/import transfer
+    # payload keys and the embedded journal-entry keys are pinned
+    # against docs/serving.md's marked tables, and the replica role is
+    # advertised where the router reads it — /stats on both layers
+    from tony_tpu.models.serving import KV_ENTRY_KEYS, KV_IMPORT_KEYS
+
+    assert names("kv-import-keys") == set(KV_IMPORT_KEYS), \
+        "/kv/import payload keys drifted"
+    assert names("kv-entry-keys") == set(KV_ENTRY_KEYS), \
+        "KV transfer entry keys drifted"
+    assert "/kv/import" in serve_src and "/kv/import" in router_src
+    assert '"role"' in serve_src and '"role"' in router_src
+    assert '"handoff"' in serve_src and '"handoff"' in router_src
 
 
 # --------------------------------------------------------------------------
@@ -794,3 +807,74 @@ def test_retry_after_folds_engine_estimate_and_autoscale_hint(params):
         httpd.shutdown()
         httpd.server_close()
         app.shutdown()
+
+
+# --------------------------------------------------------------------------
+# disaggregated serving over HTTP (PR 17)
+# --------------------------------------------------------------------------
+
+
+def test_kv_import_http_two_legs_byte_identical(params):
+    """The full HTTP transfer contract: POST /generate on a prefill-
+    role replica answers finish_reason="prefilled" with the handoff
+    payload riding the SAME response; POSTing that payload VERBATIM to
+    a decode replica's /kv/import resumes the decode byte-identically
+    to a solo paged engine — buffered AND ?stream=true — and a damaged
+    payload is a LOUD 400, backpressure the usual 429 + Retry-After."""
+    from tony_tpu.models.serving import KV_IMPORT_KEYS
+
+    prompt = [int(t) for t in _prompt(7, seed=91)]
+    solo = _solo(params, np.asarray(prompt, np.int32), 10)
+
+    pre_srv, pre_app, pre_httpd, pre_port = _http_app(
+        params, paged=True, role="prefill")
+    dec_srv, dec_app, dec_httpd, dec_port = _http_app(
+        params, paged=True, role="decode")
+    try:
+        # roles ride /stats — the router's discovery surface
+        for port, role in ((pre_port, "prefill"), (dec_port, "decode")):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/stats", timeout=10) as r:
+                assert json.loads(r.read().decode())["role"] == role
+
+        def leg1():
+            body = _json_post(pre_port, "/generate",
+                              {"prompt": prompt, "max_new_tokens": 10})
+            assert body["finish_reason"] == "prefilled"
+            assert body["tokens"] == []
+            assert set(body["handoff"]) == set(KV_IMPORT_KEYS)
+            return body["handoff"]
+
+        # buffered decode leg
+        buf = _json_post(dec_port, "/kv/import", leg1())
+        assert buf["tokens"] == solo
+        assert buf["finish_reason"] == "length"
+        # streamed decode leg: same tokens, incremental frames
+        frames = [json.loads(f) for f in _sse_post(
+            dec_port, "/kv/import?stream=true", leg1())]
+        toks = [t for f in frames if "finish_reason" not in f
+                for t in f["tokens"]]
+        assert toks == solo
+        assert frames[-1]["finish_reason"] == "length"
+        # torn payload: loud 400, counted, never queued
+        torn = leg1()
+        torn["blocks_k"] = torn["blocks_k"][:-24]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _json_post(dec_port, "/kv/import", torn)
+        assert ei.value.code == 400
+        # pool-occupancy gauges + transfer counters on both /metrics
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{dec_port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for state in ("free", "slot", "trie", "shared"):
+            assert f'serving_kv_pool_blocks{{state="{state}"}}' in text
+        assert "serving_kv_imports_total 2" in text
+        assert "serving_kv_import_rejects_total 1" in text
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{pre_port}/metrics", timeout=10) as r:
+            assert "serving_kv_exports_total 3" in r.read().decode()
+    finally:
+        for httpd, app in ((pre_httpd, pre_app), (dec_httpd, dec_app)):
+            httpd.shutdown()
+            httpd.server_close()
+            app.shutdown()

@@ -81,6 +81,11 @@ FINISH_REASON_MAP = {
     "cancelled": "cancelled",
     "expired": "expired",
     "shed": "shed",
+    # a prefill-role replica's terminal: prefill finished, zero tokens
+    # emitted — the KV handoff payload (not this response) carries the
+    # request onward to a decode replica (docs/serving.md
+    # "Disaggregated serving")
+    "prefilled": "prefilled",
 }
 
 

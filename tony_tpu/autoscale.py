@@ -64,8 +64,12 @@ from .conf import TonyConf, keys
 
 log = logging.getLogger(__name__)
 
-# the serve-side exposition family the controller windows its SLO over
+# the serve-side exposition families the controller windows its SLOs
+# over: TTFT for admission latency, TPOT for decode inter-token latency
+# (the disaggregated decode tier's own signal — docs/autoscaling.md
+# "Two-tier scaling")
 TTFT_FAMILY = "serving_ttft_seconds"
+TPOT_FAMILY = "serving_tpot_seconds"
 
 _BUCKET_RE = re.compile(
     r'^(?P<fam>[a-z0-9_]+)_bucket\{[^}]*le="(?P<le>[^"]+)"[^}]*\}\s+'
@@ -141,6 +145,13 @@ class FleetObservation:
     #                                   (outstanding posts minus active;
     #                                   overlaps the replica view — the
     #                                   control law takes the max)
+    # disaggregated fleets (docs/serving.md "Disaggregated serving"):
+    # True when any replica advertises role prefill/decode — breach
+    # attribution then names the tier to scale (queue -> prefill,
+    # TTFT/TPOT -> decode)
+    tiered: bool = False
+    queued_prefill: int = 0         # queued on prefill-role replicas
+    tpot_p99_s: float | None = None  # WINDOWED fleet decode p99/token
 
 
 class FleetWatcher:
@@ -152,9 +163,13 @@ class FleetWatcher:
     def __init__(self, timeout_s: float = 2.0):
         self.timeout_s = timeout_s
         self._prev: dict[str, dict] = {}    # replica name -> buckets
+        self._prev_tpot: dict[str, dict] = {}
         # per-replica instantaneous load (queued + active) from the
         # newest observe() — the scale-down victim picker's input
         self.last_loads: dict[str, int] = {}
+        # per-replica advertised serving role from the newest /stats —
+        # the tier-targeted victim picker's input
+        self.last_roles: dict[str, str] = {}
 
     def _get(self, url: str) -> str | None:
         try:
@@ -170,7 +185,9 @@ class FleetWatcher:
         replica that answers neither probe contributes nothing."""
         obs = FleetObservation()
         window: dict[str, float] = {}
+        tpot_window: dict[str, float] = {}
         loads: dict[str, int] = {}
+        roles: dict[str, str] = {}
         for name, host, port in endpoints:
             base = f"http://{host}:{port}"
             st_raw = self._get(base + "/stats")
@@ -183,6 +200,12 @@ class FleetWatcher:
                     obs.queued += queued
                     obs.active += active
                     loads[name] = queued + active
+                    role = str(st.get("role") or "both")
+                    roles[name] = role
+                    if role in ("prefill", "decode"):
+                        obs.tiered = True
+                    if role == "prefill":
+                        obs.queued_prefill += queued
                 except ValueError:
                     pass
             met = self._get(base + "/metrics")
@@ -192,25 +215,36 @@ class FleetWatcher:
                 #                 replica timing out one poll mid-breach
                 #                 must not blind the TTFT window)
             cur = scrape_ttft_buckets(met)
-            if not cur:
-                continue
-            prev = self._prev.get(name)
-            self._prev[name] = cur
-            delta = bucket_delta(prev, cur) if prev is not None else {}
-            for le, v in delta.items():
-                window[le] = window.get(le, 0.0) + v
+            if cur:
+                prev = self._prev.get(name)
+                self._prev[name] = cur
+                delta = bucket_delta(prev, cur) if prev is not None else {}
+                for le, v in delta.items():
+                    window[le] = window.get(le, 0.0) + v
+            cur_tpot = scrape_ttft_buckets(met, family=TPOT_FAMILY)
+            if cur_tpot:
+                prev = self._prev_tpot.get(name)
+                self._prev_tpot[name] = cur_tpot
+                delta = (bucket_delta(prev, cur_tpot)
+                         if prev is not None else {})
+                for le, v in delta.items():
+                    tpot_window[le] = tpot_window.get(le, 0.0) + v
         # drop baselines of replicas that LEFT THE FLEET — membership,
         # not scrape success (a reused name at a new port still deltas
         # correctly: counters restart, clamp wins)
         for name in set(self._prev) - {n for n, _, _ in endpoints}:
             self._prev.pop(name, None)
+            self._prev_tpot.pop(name, None)
         self.last_loads = loads
+        self.last_roles = roles
         if window:
             items = sorted(window.values())
             obs.window_samples = int(max(items)) if items else 0
             obs.ttft_p99_s = bucket_quantile(window, 0.99)
             if obs.window_samples <= 0:
                 obs.ttft_p99_s = None
+        if tpot_window and max(tpot_window.values()) > 0:
+            obs.tpot_p99_s = bucket_quantile(tpot_window, 0.99)
         if router_stats_url:
             raw = self._get(router_stats_url)
             if raw is not None:
@@ -241,6 +275,11 @@ class FleetWatcher:
 class ScaleDecision:
     direction: str              # "up" | "down"
     reason: str
+    # which phase tier the decision targets on a DISAGGREGATED fleet
+    # ("prefill" | "decode"; "" = untiered / whole fleet): breach
+    # attribution is signal-shaped — queue depth names the admission
+    # bottleneck (prefill tier), TTFT/TPOT p99 names decode
+    tier: str = ""
 
 
 class AutoscaleController:
@@ -254,8 +293,9 @@ class AutoscaleController:
                  min_replicas: int = 1, max_replicas: int = 1,
                  cooldown_s: float = 30.0, breach_ticks: int = 2,
                  interval_s: float = 2.0, last_scale_t: float | None = None,
-                 now_fn=time.time):
+                 tpot_slo_s: float = 0.0, now_fn=time.time):
         self.ttft_slo_s = float(ttft_slo_s)
+        self.tpot_slo_s = float(tpot_slo_s)
         self.queue_slo = int(queue_slo)
         self.min_replicas = max(0, int(min_replicas))
         self.max_replicas = max(self.min_replicas, int(max_replicas))
@@ -293,32 +333,48 @@ class AutoscaleController:
             cooldown_s=float(conf.get(keys.AUTOSCALE_COOLDOWN_S, 30) or 0),
             breach_ticks=conf.get_int(keys.AUTOSCALE_BREACH_TICKS, 2),
             interval_s=float(conf.get(keys.AUTOSCALE_INTERVAL_S, 2) or 2),
+            tpot_slo_s=float(conf.get(keys.AUTOSCALE_TPOT_P99_SLO_S, 0)
+                             or 0),
             last_scale_t=last_scale_t)
 
     # ------------------------------------------------------------ control law
-    def _breaching(self, obs: FleetObservation) -> str | None:
-        """Which SLO (if any) this observation breaches. The router's
-        inflight/queued view OVERLAPS the replicas' own /stats (a
-        router-posted request admitted server-side appears in both), so
-        the queue signal is the MAX of the two views, never the sum —
-        summing would breach at half the configured SLO."""
+    def _breaching(self, obs: FleetObservation) -> tuple[str, str] | None:
+        """Which SLO (if any) this observation breaches, as (reason,
+        tier). The router's inflight/queued view OVERLAPS the replicas'
+        own /stats (a router-posted request admitted server-side
+        appears in both), so the queue signal is the MAX of the two
+        views, never the sum — summing would breach at half the
+        configured SLO. On a TIERED (disaggregated) fleet the breach
+        names the tier whose phase the signal measures: queue depth is
+        admission pressure (prefill), TTFT/TPOT p99 is decode latency
+        (decode). Untiered fleets get tier "" — today's behavior."""
         queued = max(obs.queued, obs.router_queued or 0)
         if self.queue_slo > 0 and queued > self.queue_slo:
-            return f"queue depth {queued} > SLO {self.queue_slo}"
+            return (f"queue depth {queued} > SLO {self.queue_slo}",
+                    "prefill" if obs.tiered else "")
         if (self.ttft_slo_s > 0 and obs.ttft_p99_s is not None
                 and obs.ttft_p99_s > self.ttft_slo_s):
             return (f"windowed ttft p99 {obs.ttft_p99_s:.3f}s > SLO "
-                    f"{self.ttft_slo_s}s")
+                    f"{self.ttft_slo_s}s",
+                    "decode" if obs.tiered else "")
+        if (self.tpot_slo_s > 0 and obs.tpot_p99_s is not None
+                and obs.tpot_p99_s > self.tpot_slo_s):
+            return (f"windowed tpot p99 {obs.tpot_p99_s:.4f}s > SLO "
+                    f"{self.tpot_slo_s}s",
+                    "decode" if obs.tiered else "")
         return None
 
     def _clear(self, obs: FleetObservation) -> bool:
-        """Both signals comfortably under HALF their SLO (a no-traffic
+        """All signals comfortably under HALF their SLO (a no-traffic
         window — no completions, empty queue — counts as clear)."""
         queued = max(obs.queued, obs.router_queued or 0)
         if self.queue_slo > 0 and queued > self.queue_slo / 2:
             return False
         if (self.ttft_slo_s > 0 and obs.ttft_p99_s is not None
                 and obs.ttft_p99_s > self.ttft_slo_s / 2):
+            return False
+        if (self.tpot_slo_s > 0 and obs.tpot_p99_s is not None
+                and obs.tpot_p99_s > self.tpot_slo_s / 2):
             return False
         return True
 
@@ -342,13 +398,14 @@ class AutoscaleController:
             return ScaleDecision(
                 "up", f"{n_running} running < min {self.min_replicas}")
         if breach is not None:
+            reason, tier = breach
             self._clear_since = None
             if now < self._discard_until:
                 return None
             self._breach_streak += 1
             if (self._breach_streak >= self.breach_ticks
                     and not in_cooldown and n_running < self.max_replicas):
-                return ScaleDecision("up", breach)
+                return ScaleDecision("up", reason, tier=tier)
             return None
         self._breach_streak = 0
         if not self._clear(obs):

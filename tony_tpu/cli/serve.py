@@ -140,6 +140,15 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--class-budget-batch", type=int, default=0,
                    help="with --paged-kv: cap the KV blocks the 'batch' "
                         "tier may hold exclusively (0 = uncapped)")
+    p.add_argument("--role", default="both",
+                   choices=("prefill", "decode", "both"),
+                   help="disaggregated serving role (docs/serving.md "
+                        "'Disaggregated serving'): 'prefill' runs "
+                        "admission + chunked prefill only and answers "
+                        "/generate with finish_reason='prefilled' plus "
+                        "a KV handoff payload (requires --paged-kv); "
+                        "'decode' additionally accepts POST /kv/import; "
+                        "'both' (default) is today's behavior")
     p.add_argument("--batch-queue-frac", type=float, default=0.5,
                    help="with --max-queue: batch-priority requests are "
                         "shed once the queue is this fraction full "
@@ -893,6 +902,59 @@ class ServeApp:
             srv_cancel = getattr(eng, "cancel", None)
             return bool(callable(srv_cancel) and srv_cancel(request_id))
 
+    def import_async(self, payload: dict, timeout: float = 600.0,
+                     stream=None):
+        """Admission half of the KV-transfer decode leg (POST
+        /kv/import): install a prefill replica's exported blocks into
+        the matching engine and register a waiter exactly like
+        ``submit_async`` — returns (request_id, event). The engine
+        raises ValueError on payload damage (the torn-transfer
+        contract: the caller falls back to journal replay, i.e.
+        re-prefilling from the prompt on a replica that decodes) and
+        QueueFullError when no slot/pool blocks are free."""
+        with self.lock:
+            if self.status == "down":
+                raise ServingLoopError(
+                    f"serving loop is down: {self.error}")
+            if self.draining:
+                raise ServingLoopError(
+                    "server is draining; not accepting requests")
+            engine = self._engine_for(
+                payload.get("model") if isinstance(payload, dict)
+                else None)
+            imp = getattr(engine, "import_blocks", None)
+            if not callable(imp):
+                raise ValueError(
+                    "this engine does not support KV import")
+            rid = imp(payload)      # ValueError/QueueFullError propagate
+            ev = threading.Event()
+            self._events[rid] = ev
+            self._rid_engine[rid] = engine
+            if stream is not None:
+                attach = getattr(engine, "attach_stream", None)
+                if callable(attach):
+                    attach(rid, stream)
+                else:
+                    stream.fail("engine does not support streaming")
+        self.wake.set()
+        return rid, ev
+
+    def export_payload(self, request_id: int) -> dict:
+        """Pop a prefilled request's KV handoff payload (rides the
+        /generate response on a prefill-role replica). KeyError when no
+        engine holds one — the stash is bounded, so an aged-out export
+        simply sends the router down the replay fallback."""
+        with self.lock:
+            for eng in self.engines.values():
+                exp = getattr(eng, "export_blocks", None)
+                if not callable(exp):
+                    continue
+                try:
+                    return exp(request_id)
+                except KeyError:
+                    continue
+        raise KeyError(f"no KV export payload for request {request_id}")
+
     def generate(self, prompt, max_new_tokens: int, timeout: float = 600.0,
                  temperature: float | None = None,
                  top_k: int | None = None,
@@ -1151,6 +1213,31 @@ class ServeApp:
                       pk.get("prefill_chunks_interleaved", 0),
                       "prefill chunks dispatched between decode blocks "
                       "(chunked-prefill interleaving)")
+            # pool occupancy by OWNER (disaggregated serving lands and
+            # leaves blocks through both slots and the trie — one gauge
+            # family makes pressure readable): slot+trie+shared+free ==
+            # total
+            for state, n in sorted(
+                    (pk.get("pool_state") or {}).items()):
+                r.gauge("serving_kv_pool_blocks", n,
+                        "KV pool blocks by owner: free list, slot "
+                        "tables only, prefix trie only, or shared "
+                        "(slot+trie at once)", labels={"state": state})
+            # KV block transfer (docs/serving.md "Disaggregated
+            # serving"): prefill-side exports, decode-side imports, and
+            # payloads rejected as damaged (torn transfer -> journal
+            # replay fallback)
+            r.counter("serving_kv_exports_total",
+                      pk.get("kv_exports", 0),
+                      "finished prefills serialized for handoff")
+            r.counter("serving_kv_imports_total",
+                      pk.get("kv_imports", 0),
+                      "transfer payloads installed into the local pool")
+            r.counter("serving_kv_import_rejects_total",
+                      pk.get("kv_import_rejects", 0),
+                      "transfer payloads rejected (version/geometry/"
+                      "checksum damage; the router re-prefills via "
+                      "journal replay)")
             for cls, used in sorted(
                     (pk.get("class_used") or {}).items()):
                 r.gauge("serving_kv_class_blocks_used", used,
@@ -1381,6 +1468,12 @@ class ServeApp:
             import os as _os
 
             out["pid"] = _os.getpid()
+            # disaggregated-serving role advertisement (docs/serving.md
+            # "Disaggregated serving"): the fleet router reads this to
+            # split prefill traffic from decode traffic; engines without
+            # a role (test stubs) advertise the default "both"
+            out["role"] = out.get("role") or getattr(
+                self.server, "role", "both")
             out["metrics"] = self.metrics.snapshot()
             # XLA compile telemetry: compiles/compile_time_s/
             # recompiles_post_warm — /stats mirror of the
@@ -1585,6 +1678,8 @@ def make_handler(app: ServeApp, codec=None):
                 self._post_openai(chat=True)
             elif path == "/autoscale/hint":
                 self._post_autoscale_hint()
+            elif path == "/kv/import":
+                self._post_kv_import()
             else:
                 self._send(404, {"error": "unknown path"})
 
@@ -1605,6 +1700,100 @@ def make_handler(app: ServeApp, codec=None):
                 return
             app.set_autoscale_hint(cd)
             self._send(200, {"ok": True, "cooldown_s": cd})
+
+        def _post_kv_import(self):
+            """The KV-transfer decode leg (docs/serving.md
+            'Disaggregated serving'): the body is a prefill replica's
+            exported handoff payload VERBATIM — its keys are the pinned
+            transfer contract (models/serving.py KV_IMPORT_KEYS), so
+            stream/timeout ride the QUERY string, never the body. The
+            request then behaves exactly like /generate: buffered waits
+            for the completion, ``?stream=true`` delivers per-token SSE
+            frames from the resumed decode. A damaged payload is a LOUD
+            400 (the router falls back to journal replay: re-prefill
+            from the prompt); pool/slot pressure is the usual 429 +
+            Retry-After."""
+            from urllib.parse import parse_qs, urlparse
+
+            from ..models.serving import QueueFullError
+
+            qs = parse_qs(urlparse(self.path).query)
+            try:
+                timeout = float((qs.get("timeout_s") or ["600"])[0])
+                if not 0 < timeout < float("inf"):
+                    raise ValueError(
+                        "timeout_s must be a positive finite number")
+                stream_on = (qs.get("stream") or ["false"])[0].lower() \
+                    in ("1", "true", "yes")
+                payload = self._read_json()
+                ts = None
+                if stream_on:
+                    from ..api.stream import TokenStream
+
+                    ts = TokenStream()
+                rid, ev = app.import_async(payload, timeout=timeout,
+                                           stream=ts)
+            except QueueFullError as e:
+                ra = getattr(e, "retry_after_s", 0)
+                self._send(429, {"error": str(e)}, headers={
+                    "Retry-After": str(app.retry_after_s(
+                        engine_estimate=ra or None))})
+                return
+            except ServingLoopError as e:
+                self._send(503, {"error": str(e)})
+                return
+            except UnknownModelError as e:
+                self._send(400, {"error": str(e)})
+                return
+            except (KeyError, ValueError, TypeError) as e:
+                self._send(400, {"error": str(e)})
+                return
+            if ts is not None:
+                from ..api.stream import sse_frame
+
+                seen = {"n": 0}
+
+                def frame(toks):
+                    toks = [int(t) for t in toks]
+                    seen["n"] += len(toks)
+                    return sse_frame({"tokens": toks},
+                                     event_id=f"{rid}:{seen['n']}")
+
+                def final(reason):
+                    return sse_frame(
+                        {"id": rid, "finish_reason": reason,
+                         "n_tokens": seen["n"]},
+                        event_id=f"{rid}:{seen['n']}")
+
+                def err(msg):
+                    return sse_frame({"error": str(msg)})
+
+                self._begin_sse()
+                self._relay_sse(rid, ts, time.monotonic() + timeout,
+                                frame, final, err)
+                return
+            deadline = time.monotonic() + timeout
+            while not ev.wait(0.25):
+                if time.monotonic() >= deadline:
+                    app.cancel(rid)
+                    self._send(504, {"error": f"request {rid} timed "
+                                     f"out after {timeout}s; cancelled"})
+                    return
+                if self._client_gone():
+                    app.cancel(rid)
+                    self.close_connection = True
+                    return
+            try:
+                comp = app.take_result(rid)
+            except ServingLoopError as e:
+                self._send(503, {"error": str(e)})
+                return
+            except TimeoutError as e:
+                self._send(504, {"error": str(e)})
+                return
+            body = {"id": comp.id, "tokens": comp.tokens,
+                    "finish_reason": comp.finish_reason}
+            self._send(200, body)
 
         def _post_generate(self):
             from ..models.serving import QueueFullError
@@ -1797,6 +1986,15 @@ def make_handler(app: ServeApp, codec=None):
                     "finish_reason": comp.finish_reason}
             if comp.logprobs is not None:
                 body["logprobs"] = comp.logprobs
+            if comp.finish_reason == "prefilled":
+                # prefill-role handoff: the KV transfer payload rides
+                # the SAME response the router already waits on — no
+                # extra round trip. An aged-out stash just omits it;
+                # the router re-prefills via the replay fallback.
+                try:
+                    body["handoff"] = app.export_payload(comp.id)
+                except KeyError:
+                    pass
             self._send(200, body)
 
         def _oai_error(self, code: int, message: str, etype: str) -> None:
@@ -2040,7 +2238,8 @@ def main(argv=None) -> int:
             kv_pool_blocks=args.kv_pool_blocks,
             prefill_interleave=args.prefill_interleave,
             class_budgets=class_budgets or None,
-            batch_queue_frac=args.batch_queue_frac)
+            batch_queue_frac=args.batch_queue_frac,
+            role=args.role)
     slot_server = engines[default_name]
     if recovered_entries:
         # pre-multi-model records carry no model name and belong to the

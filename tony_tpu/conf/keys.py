@@ -172,6 +172,13 @@ SERVING_CLASS_BUDGET_INTERACTIVE = \
     "tony.serving.class-budget-interactive"
 SERVING_CLASS_BUDGET_BATCH = "tony.serving.class-budget-batch"
 SERVING_BATCH_QUEUE_FRAC = "tony.serving.batch-queue-frac"
+# disaggregated prefill/decode serving (docs/serving.md "Disaggregated
+# serving"): carve the replica gang into phase tiers by task index —
+# the first P replicas launch with --role prefill (forcing --paged-kv:
+# the KV block is the transfer unit), the next D with --role decode,
+# the remainder --role both. 0/0 (default) = a uniform "both" fleet.
+SERVING_PREFILL_INSTANCES = "tony.serving.prefill-instances"
+SERVING_DECODE_INSTANCES = "tony.serving.decode-instances"
 
 # ------------------------------------------------------------------ training
 # elastic, preemption-tolerant training (docs/training-robustness.md):
@@ -212,6 +219,11 @@ AUTOSCALE_ROLE = "tony.autoscale.role"
 # breach-ticks consecutive controller ticks triggers a scale-up.
 AUTOSCALE_TTFT_P99_SLO_S = "tony.autoscale.ttft-p99-slo-s"
 AUTOSCALE_QUEUE_DEPTH_SLO = "tony.autoscale.queue-depth-slo"
+# decode-tier SLO for disaggregated fleets (docs/autoscaling.md
+# "Two-tier scaling"): windowed fleet TPOT p99 in seconds/token (0 =
+# ignore). On a fleet with role specialists, a queue breach scales the
+# PREFILL tier while a TTFT/TPOT breach scales the DECODE tier.
+AUTOSCALE_TPOT_P99_SLO_S = "tony.autoscale.tpot-p99-slo-s"
 # replica-count bounds: min is the steady-state floor (the slots above
 # it start PARKED — detached, unlaunched); max 0 = the role's instances
 AUTOSCALE_MIN = "tony.autoscale.min"

@@ -1668,7 +1668,8 @@ class Driver:
         if decision is None:
             return "idle"
         if decision.direction == "up":
-            status = self._autoscale_scale_up(decision.reason)
+            status = self._autoscale_scale_up(decision.reason,
+                                              tier=decision.tier)
             if status == "scaled":
                 controller.note_scaled("up")
                 self._push_autoscale_hint(controller)
@@ -1728,13 +1729,35 @@ class Driver:
         return min(candidates,
                    key=lambda t: (loads.get(t.task_id, 0), -t.index)).task_id
 
-    def _autoscale_scale_up(self, reason: str) -> str:
+    def _tier_match(self, index: int, tier: str) -> bool:
+        """Does a replica slot's task index fall in ``tier``'s range?
+        Tiers are carved by index (runtimes/serving.py _role_flags):
+        the first ``tony.serving.prefill-instances`` slots launch
+        ``--role prefill``, the next ``decode-instances`` launch
+        ``--role decode``. Empty tier matches everything."""
+        if not tier:
+            return True
+        n_prefill = max(0, self.conf.get_int(
+            keys.SERVING_PREFILL_INSTANCES, 0))
+        n_decode = max(0, self.conf.get_int(
+            keys.SERVING_DECODE_INSTANCES, 0))
+        if tier == "prefill":
+            return index < n_prefill
+        if tier == "decode":
+            return n_prefill <= index < n_prefill + n_decode
+        return True
+
+    def _autoscale_scale_up(self, reason: str, tier: str = "") -> str:
         """Claim a parked slot for the serving role. When the pool is
         exhausted, ask the arbiter for a batch donor and preempt-drain
         it (budget-free, checkpoint at the step boundary); the actual
         launch happens on a later tick, once the donation's completion
         has freed the slot — the controller keeps its cooldown unarmed
-        until then."""
+        until then. ``tier`` targets a phase tier of a disaggregated
+        fleet (queue breach -> prefill slots, latency breach -> decode
+        slots); a tier with no parked slot falls back to any parked
+        slot — capacity in the wrong phase still beats a breach (the
+        extra replica serves role "both" and absorbs either phase)."""
         role = self._autoscale_role
         spec = self.session.role_specs.get(role)
         if spec is None:
@@ -1747,6 +1770,16 @@ class Driver:
                  if t.task_id in self._parked
                  and t.task_id in self.session.detached),
                 key=lambda t: t.index)
+            if tier:
+                in_tier = [t for t in parked
+                           if self._tier_match(t.index, tier)]
+                if in_tier:
+                    parked = in_tier
+                elif parked:
+                    log.warning(
+                        "autoscale: no parked %s-tier slot; claiming "
+                        "%s outside the tier instead", tier,
+                        parked[0].task_id)
             if not parked:
                 return "at_max"
             if not self.arbiter.can_grant(role):
@@ -1780,7 +1813,7 @@ class Driver:
             # the decision ledger: journaled BEFORE the launch so a
             # driver killed mid-actuation recovers the cooldown clock
             self._jrec("scale", dir="up", task=task_id, t=time.time(),
-                       reason=reason)
+                       reason=reason, tier=tier)
             with self._tt_lock:
                 self._scale_up_count += 1
             self._clear_attempt_state(task_id)

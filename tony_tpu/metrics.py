@@ -87,6 +87,17 @@ SERVING_STREAMS_ACTIVE = "serving_streams_active"
 SERVING_STREAMS_OPENED_TOTAL = "serving_streams_opened_total"
 SERVING_STREAM_STALLS_TOTAL = "serving_stream_backpressure_stalls_total"
 SERVING_STREAM_DISCONNECTS_TOTAL = "serving_stream_disconnects_total"
+# paged-pool occupancy + KV block transfer (models/serving.py paged
+# allocator and the disaggregated prefill/decode handoff — docs/
+# serving.md "Disaggregated serving"): pool blocks by OWNER
+# {state=free|slot|trie|shared}, finished prefills serialized for
+# handoff, transfer payloads installed into the local pool, and
+# payloads rejected as damaged (version/geometry/checksum — the router
+# falls back to journal replay, i.e. re-prefill from the prompt)
+SERVING_KV_POOL_BLOCKS = "serving_kv_pool_blocks"
+SERVING_KV_EXPORTS_TOTAL = "serving_kv_exports_total"
+SERVING_KV_IMPORTS_TOTAL = "serving_kv_imports_total"
+SERVING_KV_IMPORT_REJECTS_TOTAL = "serving_kv_import_rejects_total"
 
 # driver-side cluster telemetry (rendered by Driver.render_metrics on the
 # driver's GET /metrics — docs/observability.md "Driver metrics"). Named
@@ -178,6 +189,16 @@ ROUTER_STREAM_DISCONNECTS_TOTAL = "router_stream_disconnects_total"
 # drop grace) and the router is serving its LAST-KNOWN fleet — the
 # control-plane-outage visibility gauge (0 with a live driver view)
 ROUTER_DISCOVERY_STALE = "router_discovery_stale"
+# disaggregated prefill/decode serving (docs/serving.md "Disaggregated
+# serving"): requests the router attempted to split across a prefill
+# specialist and a decode replica, handoffs that completed (prefill leg
+# -> /kv/import on the decode leg), and attempts that fell back to the
+# classic single-replica path (no specialist live, prefill leg failed,
+# handoff aged out, or the decode import was refused — fallback
+# re-prefills from the prompt, so correctness only costs recompute)
+ROUTER_DISAGG_REQUESTS_TOTAL = "router_disagg_requests_total"
+ROUTER_DISAGG_HANDOFFS_TOTAL = "router_disagg_handoffs_total"
+ROUTER_DISAGG_FALLBACKS_TOTAL = "router_disagg_fallbacks_total"
 
 # executor-accumulator metric names (ride update_metrics pushes the same
 # way memory_rss_mb does; surface on the driver /metrics as

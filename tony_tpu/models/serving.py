@@ -128,8 +128,10 @@ with all scheduling included.
 
 from __future__ import annotations
 
+import base64
 import collections
 import functools
+import hashlib
 import itertools
 import logging
 import math
@@ -164,9 +166,11 @@ log = logging.getLogger(__name__)
 # tokens); "shed" is a QUEUED batch-tier request displaced by an
 # interactive arrival under queue pressure (empty Completion — the
 # request never reached a slot; a shed at submit() still raises
-# QueueFullError with no Completion).
+# QueueFullError with no Completion); "prefilled" is a prefill-role
+# replica's terminal (disaggregated serving): the KV is computed and
+# exported, decode happens on another replica after ``import_blocks``.
 COMPLETION_FINISH_REASONS = ("stop", "length", "cancelled", "expired",
-                             "shed")
+                             "shed", "prefilled")
 # The full trace-level finish_reason vocabulary adds "failed" (in-flight
 # state lost with no replay — ServingLoopError / HTTP 503), which
 # terminates a request's TRACE without ever building a Completion.
@@ -1328,8 +1332,8 @@ class BlockAllocator:
                     f"allocated block {block} unreferenced (orphan)"
 
 
-@jax.jit
-def _gather_paged_view(pool, tables, lens, offsets):
+@functools.partial(jax.jit, static_argnames=("shardings",))
+def _gather_paged_view(pool, tables, lens, offsets, shardings=None):
     """Materialize the paged pool into a RING-ORDERED slot-pool view —
     view index (s, i) holds slot s's logical position (i - offsets[s])
     mod M, exactly where the ring engine would store it — so the
@@ -1359,12 +1363,17 @@ def _gather_paged_view(pool, tables, lens, offsets):
     if pool.k_scale is not None:
         ks = pool.k_scale[:, blk, :, row].transpose(2, 0, 3, 1)
         vs = pool.v_scale[:, blk, :, row].transpose(2, 0, 3, 1)
-    return KVCache(k=k, v=v, length=lens, k_scale=ks, v_scale=vs)
+    view = KVCache(k=k, v=v, length=lens, k_scale=ks, v_scale=vs)
+    # mesh serving: the transient view carries the ring cache's layout,
+    # so it takes the ring cache's shardings (pool stays sharded over
+    # its block axis; GSPMD plans the block->slot redistribution)
+    return _constrain_pool(shardings, view)[0]
 
 
-@functools.partial(jax.jit, donate_argnames=("pool",))
+@functools.partial(jax.jit, donate_argnames=("pool",),
+                   static_argnames=("shardings",))
 def _scatter_paged_rows(pool, view, tables, offsets, ring_ids, n_valids,
-                        floors):
+                        floors, shardings=None):
     """Commit a program's freshly-written view rows back into the pool:
     ``ring_ids`` [S, W] names the ring indices each slot's program wrote
     this dispatch (decode: the shared cursor window for every row;
@@ -1408,8 +1417,177 @@ def _scatter_paged_rows(pool, view, tables, offsets, ring_ids, n_valids,
             view.k_scale[:, rows, :, ring_ids], **swr)
         pvs = pvs.at[:, blk, :, row].set(
             view.v_scale[:, rows, :, ring_ids], **swr)
+    if shardings is not None:
+        # pool [L, N, kvH, B, D] shards its block axis like the ring
+        # cache's batch axis — same spec as _insert_prefix_blocks uses
+        pk = jax.lax.with_sharding_constraint(pk, shardings.cache)
+        pv = jax.lax.with_sharding_constraint(pv, shardings.cache)
+        if pks is not None:
+            pks = jax.lax.with_sharding_constraint(pks, shardings.scale)
+            pvs = jax.lax.with_sharding_constraint(pvs, shardings.scale)
     fence = jnp.sum(blk).astype(jnp.int32)
     return PrefixPool(k=pk, v=pv, k_scale=pks, v_scale=pvs), fence
+
+
+# ---------------------------------------------------------------------------
+# KV block transfer protocol (disaggregated serving)
+#
+# Pool blocks store KV rows in LOGICAL order — position p lives at table
+# entry p // B, row p % B, independent of the exporting slot's ring
+# offset — so a block's bytes are portable between replicas whose
+# cursors/offsets never agreed on anything. A prefill-role replica
+# serializes the blocks covering [0, body_len) together with the
+# request's journal entry (the PR 11 replay record: if the transfer
+# dies, the prompt + emitted prefix re-prefills anywhere); a decode
+# replica allocates blocks from its OWN pool, writes the payload in,
+# installs the table row, and decodes byte-identically — the gather view
+# makes imported blocks indistinguishable from locally-prefilled ones.
+#
+# Payload keys below are pinned by the api-contract lint
+# (tests/test_streaming.py) against docs/serving.md "Disaggregated
+# serving"; the sha256 checksum makes a torn/truncated transfer a loud
+# ValueError at import, never a silently-wrong cache.
+# ---------------------------------------------------------------------------
+
+KV_TRANSFER_VERSION = 1
+
+# every key a /kv/import payload carries (the api-contract lint pins
+# this tuple against docs/serving.md both directions)
+KV_IMPORT_KEYS = (
+    "version", "model", "kv_block", "kv_dtype", "body_len", "n_blocks",
+    "block_shape", "dtype", "scale_dtype", "blocks_k", "blocks_v",
+    "scales_k", "scales_v", "checksum", "entry",
+)
+
+# the journal-entry fields that ride inside payload["entry"] — exactly
+# the JournalEntry replay state minus the process-local deadline
+KV_ENTRY_KEYS = (
+    "id", "prompt", "max_new_tokens", "temperature", "top_k",
+    "cache_prompt", "seed", "emitted", "model", "stop", "logprobs",
+    "priority",
+)
+
+
+def _b64(arr: np.ndarray) -> str:
+    return base64.b64encode(
+        np.ascontiguousarray(arr).tobytes()).decode("ascii")
+
+
+def _transfer_checksum(*bufs: bytes) -> str:
+    h = hashlib.sha256()
+    for b in bufs:
+        h.update(b)
+    return h.hexdigest()
+
+
+def serialize_kv_blocks(pool, ids, *, model, kv_block, kv_dtype,
+                        body_len, entry) -> dict:
+    """Snapshot the pool blocks ``ids`` (in table order) into a
+    JSON-able transfer payload. Copies device->host, so the payload
+    survives the exporter freeing/reusing the blocks immediately
+    after. ``entry`` is the request's journal replay state (dict) —
+    the receiver resubmits from it if the KV payload is unusable."""
+    ids = np.asarray(ids, np.int32)
+    k = np.asarray(pool.k[:, ids])          # [L, n, kvH, B, D]
+    v = np.asarray(pool.v[:, ids])
+    bufs = [np.ascontiguousarray(k).tobytes(),
+            np.ascontiguousarray(v).tobytes()]
+    scales_k = scales_v = None
+    scale_dtype = None
+    if pool.k_scale is not None:
+        ks = np.asarray(pool.k_scale[:, ids])   # [L, n, kvH, B]
+        vs = np.asarray(pool.v_scale[:, ids])
+        bufs += [np.ascontiguousarray(ks).tobytes(),
+                 np.ascontiguousarray(vs).tobytes()]
+        scales_k, scales_v = _b64(ks), _b64(vs)
+        scale_dtype = str(ks.dtype)
+    return {
+        "version": KV_TRANSFER_VERSION,
+        "model": model,
+        "kv_block": int(kv_block),
+        "kv_dtype": str(kv_dtype),
+        "body_len": int(body_len),
+        "n_blocks": int(ids.size),
+        "block_shape": [int(d) for d in k.shape],
+        "dtype": str(k.dtype),
+        "scale_dtype": scale_dtype,
+        "blocks_k": base64.b64encode(bufs[0]).decode("ascii"),
+        "blocks_v": base64.b64encode(bufs[1]).decode("ascii"),
+        "scales_k": scales_k,
+        "scales_v": scales_v,
+        "checksum": _transfer_checksum(*bufs),
+        "entry": dict(entry),
+    }
+
+
+def deserialize_kv_blocks(payload: dict) -> tuple[np.ndarray, np.ndarray,
+                                                  np.ndarray | None,
+                                                  np.ndarray | None]:
+    """Decode + verify a transfer payload's KV buffers. Raises
+    ValueError on any structural damage — wrong version, missing keys,
+    truncated buffers, checksum mismatch — so a torn transfer is
+    rejected loudly and the caller falls back to journal replay."""
+    try:
+        version = int(payload["version"])
+        shape = tuple(int(d) for d in payload["block_shape"])
+        dtype = np.dtype(payload["dtype"])
+        raw_k = base64.b64decode(payload["blocks_k"], validate=True)
+        raw_v = base64.b64decode(payload["blocks_v"], validate=True)
+        checksum = payload["checksum"]
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed KV transfer payload: {e}") from None
+    if version != KV_TRANSFER_VERSION:
+        raise ValueError(
+            f"KV transfer version {version} != {KV_TRANSFER_VERSION}")
+    if len(shape) != 5 or shape[1] != int(payload.get("n_blocks", -1)):
+        raise ValueError("KV transfer block_shape/n_blocks mismatch")
+    expect = int(np.prod(shape)) * dtype.itemsize
+    if len(raw_k) != expect or len(raw_v) != expect:
+        raise ValueError(
+            f"truncated KV transfer payload: expected {expect} bytes "
+            f"per buffer, got k={len(raw_k)} v={len(raw_v)}")
+    bufs = [raw_k, raw_v]
+    ks = vs = None
+    if payload.get("scales_k") is not None:
+        try:
+            sdtype = np.dtype(payload["scale_dtype"])
+            raw_ks = base64.b64decode(payload["scales_k"], validate=True)
+            raw_vs = base64.b64decode(payload["scales_v"], validate=True)
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(
+                f"malformed KV transfer scales: {e}") from None
+        s_expect = int(np.prod(shape[:4])) * sdtype.itemsize
+        if len(raw_ks) != s_expect or len(raw_vs) != s_expect:
+            raise ValueError("truncated KV transfer scale payload")
+        bufs += [raw_ks, raw_vs]
+        ks = np.frombuffer(raw_ks, sdtype).reshape(shape[:4])
+        vs = np.frombuffer(raw_vs, sdtype).reshape(shape[:4])
+    if _transfer_checksum(*bufs) != checksum:
+        raise ValueError("KV transfer payload checksum mismatch")
+    k = np.frombuffer(raw_k, dtype).reshape(shape)
+    v = np.frombuffer(raw_v, dtype).reshape(shape)
+    return k, v, ks, vs
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",),
+                   static_argnames=("shardings",))
+def _write_pool_blocks(pool, ids, k, v, ks, vs, shardings=None):
+    """Install imported block payloads at the receiver's block ids
+    (one dispatch, pool donated — the import path's only device
+    write)."""
+    pk = pool.k.at[:, ids].set(k)
+    pv = pool.v.at[:, ids].set(v)
+    pks, pvs = pool.k_scale, pool.v_scale
+    if pks is not None:
+        pks = pks.at[:, ids].set(ks)
+        pvs = pvs.at[:, ids].set(vs)
+    if shardings is not None:
+        pk = jax.lax.with_sharding_constraint(pk, shardings.cache)
+        pv = jax.lax.with_sharding_constraint(pv, shardings.cache)
+        if pks is not None:
+            pks = jax.lax.with_sharding_constraint(pks, shardings.scale)
+            pvs = jax.lax.with_sharding_constraint(pvs, shardings.scale)
+    return PrefixPool(k=pk, v=pv, k_scale=pks, v_scale=pvs)
 
 
 class SlotServer:
@@ -1514,7 +1692,8 @@ class SlotServer:
                  kv_pool_blocks: int = 0,
                  class_budgets: dict | None = None,
                  prefill_interleave: int = 0,
-                 batch_queue_frac: float = 0.5):
+                 batch_queue_frac: float = 0.5,
+                 role: str = "both"):
         # ---- model registry (models/registry.py) ----
         # the weights singleton became a keyed registry: this server
         # SERVES one named entry (its slot-pool cache shape is that
@@ -1659,16 +1838,6 @@ class SlotServer:
         self.batch_queue_frac = float(batch_queue_frac)
         self._class_budgets = dict(class_budgets or {})
         if self._paged:
-            if self._spec:
-                raise ValueError(
-                    "paged KV does not support speculative serving yet "
-                    "(the spec programs carry their own draft cache; see "
-                    "docs/serving.md)")
-            if mesh is not None:
-                raise ValueError(
-                    "paged KV is single-device (the gather/scatter "
-                    "programs are not mesh-threaded); serve without a "
-                    "mesh")
             if not self.kv_block:
                 self.kv_block = int(block_size)
             if max_len % self.kv_block:
@@ -1685,6 +1854,13 @@ class SlotServer:
             if not self.kv_pool_blocks:
                 # same device bytes as the ring it replaces
                 self.kv_pool_blocks = slots * (max_len // self.kv_block)
+            if mesh is not None:
+                # the pool's block axis shards over the 'batch' mesh
+                # axes like the ring cache's slot axis; round the pool
+                # up so (blocks + pad) divides evenly
+                t_b = _rule_size(mesh, rules, "batch")
+                n1 = self.kv_pool_blocks + 1        # + the pad block
+                self.kv_pool_blocks = -(-n1 // t_b) * t_b - 1
         else:
             if self.prefill_interleave:
                 raise ValueError(
@@ -1694,6 +1870,37 @@ class SlotServer:
                 raise ValueError(
                     "class_budgets requires paged=True (budgets are "
                     "pool-block budgets)")
+        # ---- disaggregated serving role (docs/serving.md) ----
+        # "prefill" runs admission + chunked prefill only, then exports
+        # the finished block table (export_blocks) and completes the
+        # request with finish_reason="prefilled"; "decode"/"both" serve
+        # normally ("decode" is advisory — the router's phase-aware
+        # dispatch prefers it for import legs, but it can still serve a
+        # full /generate as the replay fallback).
+        self.role = str(role or "both")
+        if self.role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"unknown serving role {role!r} (expected 'prefill', "
+                "'decode', or 'both')")
+        if self.role == "prefill" and not self._paged:
+            raise ValueError(
+                "role='prefill' requires paged=True (the transfer unit "
+                "is the paged KV block; see docs/serving.md "
+                "'Disaggregated serving')")
+        if self.role == "prefill" and self._spec:
+            raise ValueError(
+                "role='prefill' is incompatible with speculative "
+                "serving (a prefill specialist never decodes, so a "
+                "draft has nothing to propose against)")
+        # finished prefill payloads awaiting router pickup (bounded
+        # FIFO: an unclaimed handoff ages out and costs the decode side
+        # a journal-replay re-prefill, never a lost request)
+        self._exports: collections.OrderedDict[int, dict] = \
+            collections.OrderedDict()
+        self._exports_cap = 64
+        self.kv_exports = 0             # payloads serialized (stats())
+        self.kv_imports = 0             # payloads installed (stats())
+        self.kv_import_rejects = 0      # torn/invalid payloads refused
         self.batched_admission = batched_admission
         self.admission_dispatches = 0   # prefill programs dispatched
         # prefix-cache dispatch + token counters (stats())
@@ -1907,25 +2114,36 @@ class SlotServer:
             # the draft model mirrors the slot pool with its OWN cache
             # (its config's shape), kept in per-row logical lockstep
             # with the target: admission prefills both, every spec
-            # round advances/rolls both to the same lengths
-            dcache = init_cache(self._draft_cfg, slots, self.max_len,
-                                self.kv_dtype)
-            self._draft_cache = dcache._replace(
-                length=jnp.zeros((slots,), jnp.int32))
+            # round advances/rolls both to the same lengths. Paged mode
+            # keeps the draft KV in a mirrored block pool instead
+            # (_init_paged_state) — only the length vector lives here.
+            if self._paged:
+                self._draft_cache = None
+                self._d_draft_lens = jnp.zeros((slots,), jnp.int32)
+            else:
+                dcache = init_cache(self._draft_cfg, slots, self.max_len,
+                                    self.kv_dtype)
+                self._draft_cache = dcache._replace(
+                    length=jnp.zeros((slots,), jnp.int32))
         if self._shardings is not None:
             # commit the pool's initial layout so the first dispatch (and
             # every donated successor) already sits where the programs'
             # output constraints keep it
             sh = self._shardings
-            self._cache = KVCache(
-                k=jax.device_put(self._cache.k, sh.cache),
-                v=jax.device_put(self._cache.v, sh.cache),
-                length=jax.device_put(self._cache.length, sh.act),
-                k_scale=(None if self._cache.k_scale is None
-                         else jax.device_put(self._cache.k_scale, sh.scale)),
-                v_scale=(None if self._cache.v_scale is None
-                         else jax.device_put(self._cache.v_scale, sh.scale)),
-            )
+            if self._paged:
+                self._d_lens = jax.device_put(self._d_lens, sh.act)
+            else:
+                self._cache = KVCache(
+                    k=jax.device_put(self._cache.k, sh.cache),
+                    v=jax.device_put(self._cache.v, sh.cache),
+                    length=jax.device_put(self._cache.length, sh.act),
+                    k_scale=(None if self._cache.k_scale is None
+                             else jax.device_put(self._cache.k_scale,
+                                                 sh.scale)),
+                    v_scale=(None if self._cache.v_scale is None
+                             else jax.device_put(self._cache.v_scale,
+                                                 sh.scale)),
+                )
             self._d_tokens = jax.device_put(self._d_tokens, sh.act)
             self._d_active = jax.device_put(self._d_active, sh.act)
             self._d_target = jax.device_put(self._d_target, sh.act)
@@ -1964,6 +2182,15 @@ class SlotServer:
         n = self.kv_pool_blocks
         self._kv_pool = init_prefix_pool(
             self.cfg, n + 1, self.kv_block, self.kv_dtype)
+        # speculative serving: the draft model's KV rides a MIRROR pool
+        # with the same block geometry — one allocator owns both, a slot
+        # table indexes both, and a trie node's block id is valid in
+        # both (the draft bytes for a token prefix are as
+        # prefix-deterministic as the target's)
+        self._draft_kv_pool = (
+            init_prefix_pool(self._draft_cfg, n + 1, self.kv_block,
+                             self.kv_dtype)
+            if self._spec else None)
         self._allocator = BlockAllocator(n, self._class_budgets)
         entries = self.max_len // self.kv_block
         self._np_tables = np.full((self.slots, entries), n, np.int32)
@@ -1994,13 +2221,13 @@ class SlotServer:
                 allocator=self._allocator)
         if self._shardings is not None:
             sh = self._shardings
-            self._pool = PrefixPool(
-                k=jax.device_put(self._pool.k, sh.cache),
-                v=jax.device_put(self._pool.v, sh.cache),
-                k_scale=(None if self._pool.k_scale is None else
-                         jax.device_put(self._pool.k_scale, sh.scale)),
-                v_scale=(None if self._pool.v_scale is None else
-                         jax.device_put(self._pool.v_scale, sh.scale)),
+            self._kv_pool = PrefixPool(
+                k=jax.device_put(self._kv_pool.k, sh.cache),
+                v=jax.device_put(self._kv_pool.v, sh.cache),
+                k_scale=(None if self._kv_pool.k_scale is None else
+                         jax.device_put(self._kv_pool.k_scale, sh.scale)),
+                v_scale=(None if self._kv_pool.v_scale is None else
+                         jax.device_put(self._kv_pool.v_scale, sh.scale)),
             )
 
     def _init_host_state(self) -> None:
@@ -2711,6 +2938,7 @@ class SlotServer:
         vs ``prefill_tokens_computed`` that ran the model."""
         out = {
             "model": self.model,
+            "role": self.role,
             "registry": self.registry.names(),
             "slots": self.slots,
             "active": self.n_active,
@@ -2795,6 +3023,14 @@ class SlotServer:
                 "pool_blocks_free": alloc.free_blocks,
                 "pool_blocks_used": alloc.used_blocks,
                 "pool_blocks_peak": alloc.peak_used,
+                # occupancy by OWNER, not just used/free: "shared" blocks
+                # are referenced by a slot table AND the trie at once (the
+                # zero-copy prefix-hit path), so slot+trie+shared+free ==
+                # total and pressure reads off one gauge family
+                "pool_state": self._pool_state_counts(),
+                "kv_exports": self.kv_exports,
+                "kv_imports": self.kv_imports,
+                "kv_import_rejects": self.kv_import_rejects,
                 "class_used": dict(alloc.class_used),
                 "class_budgets": dict(self._class_budgets or {}),
                 "admission_defers": self.admission_defers,
@@ -2806,6 +3042,26 @@ class SlotServer:
                 "pending_prefill": len(self._pending_prefill),
             }
         return out
+
+    def _pool_state_counts(self) -> dict:
+        """Block-pool occupancy by owner: ``slot`` (referenced only by a
+        slot table), ``trie`` (only by the prefix trie), ``shared``
+        (both — the zero-copy prefix-hit blocks), ``free`` (allocator
+        free list). The four buckets partition the pool."""
+        slot_set: set[int] = set()
+        for s in range(self.slots):
+            slot_set.update(int(b) for b in self._slot_blocks[s])
+            slot_set.update(int(b) for b in self._slot_shared[s])
+        pc = self._prefix_cache
+        trie_set = ({int(node.block) for node in pc._owned}
+                    if pc is not None else set())
+        shared = slot_set & trie_set
+        return {
+            "free": self._allocator.free_blocks,
+            "slot": len(slot_set - trie_set),
+            "trie": len(trie_set - slot_set),
+            "shared": len(shared),
+        }
 
     # ----------------------------------------------------------- the loop
 
@@ -3218,26 +3474,37 @@ class SlotServer:
             self._tables_dirty = True
         self._np_floor[slot] = self.max_len
 
-    def _gather_view(self):
+    def _gather_view(self, pool=None, lens=None):
         """Dispatch the pool -> ring-view gather for the next program.
         Host tables/offsets are the authority (the device copies lag by
         design: _d_offsets commits at each finalize, fine for programs,
-        stale for layout)."""
+        stale for layout). ``pool``/``lens`` select the draft mirror
+        pool in speculative mode (same tables, same offsets)."""
         if self._tables_dirty:
             self._d_tables = jnp.asarray(self._np_tables)
             self._tables_dirty = False
         self.paged_gather_dispatches += 1
-        return _gather_paged_view(self._kv_pool, self._d_tables,
-                                  self._d_lens, jnp.asarray(self._np_offs))
+        return _gather_paged_view(
+            self._kv_pool if pool is None else pool, self._d_tables,
+            self._d_lens if lens is None else lens,
+            jnp.asarray(self._np_offs), shardings=self._shardings)
 
-    def _scatter_view(self, view, ring_ids, n_valids, floors) -> None:
+    def _scatter_view(self, view, ring_ids, n_valids, floors,
+                      draft: bool = False) -> None:
         """Commit the program's written rows back into the pool (the
         gather/program/scatter triple always shares one table+offset
-        snapshot — nothing mutates them in between)."""
-        self._kv_pool, fence = _scatter_paged_rows(
-            self._kv_pool, view, self._d_tables,
+        snapshot — nothing mutates them in between). ``draft=True``
+        commits into the draft mirror pool instead (same tables)."""
+        pool = self._draft_kv_pool if draft else self._kv_pool
+        pool, fence = _scatter_paged_rows(
+            pool, view, self._d_tables,
             jnp.asarray(self._np_offs), jnp.asarray(ring_ids),
-            jnp.asarray(n_valids), jnp.asarray(floors))
+            jnp.asarray(n_valids), jnp.asarray(floors),
+            shardings=self._shardings)
+        if draft:
+            self._draft_kv_pool = pool
+        else:
+            self._kv_pool = pool
         self.paged_scatter_dispatches += 1
         self.dispatch_tracker.track("paged_scatter", fence)
 
@@ -3324,7 +3591,11 @@ class SlotServer:
         self._free_slot_blocks(slot)
         self._slot_of[req.id] = slot
         self._inflight.add(req.id)
-        offset = (self._cursor - body.size) % self.max_len
+        # speculative mode has no shared cursor (per-slot lengths
+        # advance by variable accepted counts); the ring degenerates to
+        # offset 0, as in the ring engine's spec admission
+        offset = (0 if self._spec
+                  else (self._cursor - body.size) % self.max_len)
         temp = (self.temperature if req.temperature is None
                 else float(req.temperature))
         topk = (self.top_k if req.top_k is None else int(req.top_k))
@@ -3387,7 +3658,7 @@ class SlotServer:
             c0 = adm.chunk_starts[idx]
             final = idx == len(adm.chunk_starts) - 1
             n_valid = max(0, min(C, adm.body.size - c0))
-            if final:
+            if final and not self._spec and self.role != "prefill":
                 # the admission-time offset aligned the slot's first
                 # decode write with the cursor AS OF ADMISSION; decode
                 # blocks interleaved since then moved the cursor. The
@@ -3395,7 +3666,9 @@ class SlotServer:
                 # the offset is free to change between dispatches —
                 # re-derive it so the finalize commits an offset whose
                 # first decode write lands at the CURRENT cursor. A
-                # no-op when nothing interleaved.
+                # no-op when nothing interleaved. (Spec mode pins
+                # offset 0 — no shared cursor; a prefill-role slot
+                # never decodes, so its offset is moot.)
                 adm.offset = (self._cursor - adm.body.size) % self.max_len
                 self._np_offs[adm.slot] = adm.offset
             self._dispatch_paged_prefill(adm, c0, n_valid, final)
@@ -3409,7 +3682,14 @@ class SlotServer:
     def _dispatch_paged_prefill(self, adm: _Admission, c0: int,
                                 n_valid: int, final: bool) -> None:
         """One `_prefill_chunk` dispatch on the gathered view, then
-        scatter the chunk's span back into the slot's blocks."""
+        scatter the chunk's span back into the slot's blocks. A
+        prefill-role replica dispatches even the final chunk with
+        ``finalize=False``: the KV write is unconditional, only the
+        device-side slot ACTIVATION is finalize-gated — so the blocks
+        finish fully written while the slot never decodes (the export
+        snapshot is taken at `_finalize_admit_paged`). In speculative
+        mode the draft mirror pool prefills the same span right after
+        (same tables, same ring ids, its own length vector)."""
         C = self.prefill_chunk
         slot = adm.slot
         chunk = np.zeros((1, C), np.int32)
@@ -3426,7 +3706,8 @@ class SlotServer:
             jnp.int32(adm.last), jnp.int32(adm.target),
             jnp.float32(adm.temp), jnp.int32(adm.topk),
             cfg=self.cfg, chunk=C, kv_dtype=self.kv_dtype,
-            finalize=final, shardings=None)
+            finalize=final and self.role != "prefill",
+            shardings=self._shardings)
         self._d_lens = view.length
         ring_ids = np.zeros((self.slots, C), np.int32)
         ring_ids[slot] = (adm.offset + c0
@@ -3435,26 +3716,50 @@ class SlotServer:
         n_valids[slot] = n_valid
         # floors stay zero here: this IS the prefill writing the span
         # the floor will later protect
-        self._scatter_view(view, ring_ids, n_valids,
-                           np.zeros((self.slots,), np.int32))
+        floors = np.zeros((self.slots,), np.int32)
+        self._scatter_view(view, ring_ids, n_valids, floors)
         self.admission_dispatches += 1
         self.dispatch_tracker.track("prefill", fence)
         self.prefill_tokens_computed += n_valid
+        if self._spec:
+            # draft mirror: never finalizes (the target's commit owns
+            # the slot state; fin-False passes the state vecs through
+            # the donation untouched, like ring-mode _prefill_draft)
+            dview = self._gather_view(pool=self._draft_kv_pool,
+                                      lens=self._d_draft_lens)
+            (dview, self._d_tokens, self._d_active,
+             self._d_target, self._d_offsets,
+             self._d_temps, self._d_topks, dfence) = _prefill_chunk(
+                self._draft_params, dview, self._d_tokens,
+                self._d_active, self._d_target, self._d_offsets,
+                self._d_temps, self._d_topks,
+                jnp.asarray(chunk), jnp.int32(slot), jnp.int32(c0),
+                jnp.int32(adm.offset), jnp.int32(n_valid),
+                jnp.int32(adm.last), jnp.int32(adm.target),
+                jnp.float32(adm.temp), jnp.int32(adm.topk),
+                cfg=self._draft_cfg, chunk=C, kv_dtype=self.kv_dtype,
+                finalize=False, shardings=None)
+            self._d_draft_lens = dview.length
+            self._scatter_view(dview, ring_ids, n_valids, floors,
+                               draft=True)
+            self.admission_dispatches += 1
+            self.dispatch_tracker.track("draft_prefill", dfence)
 
     def _finalize_admit_paged(self, adm: _Admission) -> None:
         """The finalize chunk is dispatched: activate the slot for
         decode (floor + exact host model), adopt its freshly-filled full
         chunks into the trie (zero-copy — the trie just refs the
         blocks), and log the admit event at this position in the
-        dispatch order."""
+        dispatch order. A prefill-role replica terminates here instead:
+        snapshot the finished blocks into an export payload, complete
+        the request with finish_reason="prefilled", and free the slot —
+        the request decodes on whichever replica imports the payload."""
         slot, req, body = adm.slot, adm.req, adm.body
-        self._np_floor[slot] = body.size
         tr = self._traces.get(req.id)
         if tr is not None:
             tr.mark("prefill_done")
-        self._model_len[slot] = body.size
-        self._model_active[slot] = True
-        self._model_target[slot] = adm.target
+        if self._spec:
+            self.draft_prefill_tokens_reused += adm.prefix_len
         want = (self.cache_prompts if req.cache_prompt is None
                 else req.cache_prompt)
         if self._prefix_cache is not None and want:
@@ -3464,11 +3769,278 @@ class SlotServer:
                      for i in range(adm.prefix_len // B, body.size // B)}
             if offer:
                 self._prefix_cache.adopt(body, offer)
+        if self.role == "prefill":
+            self._stash_export(adm)
+            self._done[req.id] = Completion(
+                req.id, [], "prefilled",
+                trace=self._finish_trace(
+                    req.id, "finished", n_tokens=0, reason="prefilled"))
+            self._finish_stream(req.id)
+            self._host_busy[slot] = False
+            self._release_request(req.id)   # frees blocks (snapshot is
+            return                          # host bytes), seals journal
+        self._np_floor[slot] = body.size
+        self._model_len[slot] = body.size
+        self._model_active[slot] = True
+        self._model_target[slot] = adm.target
         admit = (slot, body.size, req)
         if self._pipeline:
             self._pipeline[-1]["events"].append(("admit", admit))
         else:                           # nothing in flight: applies now
             self._apply_admit(admit)
+
+    # ---------------------------- KV block transfer (disaggregation)
+
+    def _stash_export(self, adm: _Admission) -> None:
+        """Serialize a finished prefill's blocks + replay state into
+        the bounded export stash. The snapshot is host bytes (the
+        device sync happens here), so the slot and its blocks recycle
+        immediately after."""
+        req, slot, body = adm.req, adm.slot, adm.body
+        B = self.kv_block
+        n_blocks = max(1, -(-int(body.size) // B))
+        ids = [int(b) for b in self._np_tables[slot][:n_blocks]]
+        entry = {
+            "id": int(req.id),
+            "prompt": [int(t) for t in req.prompt],
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": req.temperature,
+            "top_k": req.top_k,
+            "cache_prompt": req.cache_prompt,
+            "seed": self._seed,
+            "emitted": [int(t) for t in (req.resume_tokens or ())],
+            "model": req.model,
+            "stop": ([list(map(int, s)) for s in req.stop]
+                     if req.stop else None),
+            "logprobs": int(req.logprobs or 0),
+            "priority": req.priority,
+        }
+        self._exports[int(req.id)] = serialize_kv_blocks(
+            self._kv_pool, ids, model=self.model, kv_block=B,
+            kv_dtype=self.kv_dtype, body_len=int(body.size),
+            entry=entry)
+        self.kv_exports += 1
+        tr = self._traces.get(req.id)
+        if tr is not None:
+            tr.attrs["exported_blocks"] = n_blocks
+        while len(self._exports) > self._exports_cap:
+            self._exports.popitem(last=False)
+
+    def export_blocks(self, request_id: int) -> dict:
+        """Pop a prefilled request's transfer payload. KeyError when
+        the request never finished prefilling here (or the bounded
+        stash aged it out) — the caller falls back to journal replay
+        on a decode replica, which re-prefills from the prompt."""
+        payload = self._exports.pop(int(request_id), None)
+        if payload is None:
+            raise KeyError(
+                f"no KV export payload for request {int(request_id)}")
+        return payload
+
+    def import_blocks(self, payload: dict) -> int:
+        """Install a prefill replica's exported blocks and resume the
+        request HERE, decode-only: allocate fresh blocks from our own
+        pool, write the payload in (one donated dispatch), install the
+        table row at our cursor's offset, and activate the slot exactly
+        as a local finalize would — the gather view cannot tell an
+        imported block from a locally-prefilled one, so decode is
+        byte-identical. Raises ValueError on any payload damage
+        (version/model/geometry/checksum — the torn-transfer contract:
+        loud rejection, the caller re-prefills via journal replay) and
+        QueueFullError when no slot or pool blocks are free right now.
+        Returns the new engine-local request id."""
+        try:
+            return self._import_blocks(payload)
+        except ValueError:
+            self.kv_import_rejects += 1
+            raise
+
+    def _import_blocks(self, payload: dict) -> int:
+        if not self._paged:
+            raise ValueError(
+                "import_blocks requires paged=True (the transfer unit "
+                "is the paged KV block)")
+        if self.role == "prefill":
+            raise ValueError(
+                "a prefill-role replica cannot import KV blocks "
+                "(nothing here decodes them)")
+        if self._spec:
+            raise ValueError(
+                "KV import into a speculative server is unsupported "
+                "(the transfer carries no draft-pool payload)")
+        B = self.kv_block
+        if not isinstance(payload, dict):
+            raise ValueError("KV transfer payload must be an object")
+        if payload.get("model") != self.model:
+            raise ValueError(
+                f"KV transfer is for model {payload.get('model')!r} "
+                f"but this engine serves {self.model!r}")
+        if int(payload.get("kv_block", 0)) != B:
+            raise ValueError(
+                f"KV transfer kv_block={payload.get('kv_block')} != "
+                f"this engine's {B}")
+        if str(payload.get("kv_dtype")) != str(self.kv_dtype):
+            raise ValueError(
+                f"KV transfer kv_dtype={payload.get('kv_dtype')!r} != "
+                f"this engine's {self.kv_dtype!r}")
+        k, v, ks, vs = deserialize_kv_blocks(payload)   # checksum etc.
+        pk = self._kv_pool.k
+        if k.shape[0] != pk.shape[0] or k.shape[2:] != pk.shape[2:] \
+                or str(k.dtype) != str(pk.dtype):
+            raise ValueError(
+                f"KV transfer block shape {k.shape[0:1] + k.shape[2:]}"
+                f"/{k.dtype} does not match this pool's "
+                f"{pk.shape[0:1] + pk.shape[2:]}/{pk.dtype}")
+        entry = payload.get("entry")
+        if not isinstance(entry, dict):
+            raise ValueError("KV transfer payload has no journal entry")
+        try:
+            prompt = [int(t) for t in entry["prompt"]]
+            max_new = int(entry["max_new_tokens"])
+            emitted = [int(t) for t in (entry.get("emitted") or ())]
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(
+                f"malformed KV transfer entry: {e}") from None
+        body_len = int(payload["body_len"])
+        if body_len != len(prompt) + len(emitted) - 1:
+            raise ValueError(
+                f"KV transfer body_len={body_len} does not match the "
+                f"entry's {len(prompt)} prompt + {len(emitted)} emitted "
+                "tokens")
+        n_payload = int(payload["n_blocks"])
+        if n_payload != max(1, -(-body_len // B)):
+            raise ValueError("KV transfer n_blocks/body_len mismatch")
+        if len(prompt) < 1 or max_new < 1:
+            raise ValueError("KV transfer entry has an empty request")
+        if len(emitted) >= max_new:
+            raise ValueError(
+                "KV transfer entry is already satisfied (nothing left "
+                "to decode); deliver it from the journal instead")
+        if len(prompt) + max_new > self.max_len:
+            raise ValueError(
+                f"KV transfer request needs {len(prompt)} prompt + "
+                f"{max_new} new tokens but slots hold "
+                f"max_len={self.max_len}")
+        stop = entry.get("stop")
+        req = Request(
+            prompt=np.asarray(prompt, np.int32),
+            max_new_tokens=max_new,
+            temperature=entry.get("temperature"),
+            top_k=entry.get("top_k"),
+            cache_prompt=entry.get("cache_prompt"),
+            resume_tokens=emitted or None,
+            stop=_normalize_stop(stop) if stop else None,
+            logprobs=int(entry.get("logprobs") or 0),
+            priority=(entry.get("priority")
+                      if entry.get("priority") in PRIORITY_CLASSES
+                      else "interactive"))
+        if req.logprobs and not 0 <= req.logprobs <= LOGPROBS_MAX:
+            raise ValueError(f"logprobs must be in [0, {LOGPROBS_MAX}]")
+        # -- strict admission: a handoff needs a seat NOW or the router
+        #    falls back; queueing it would hide the backpressure
+        slot = next((s for s in range(self.slots)
+                     if self._free_for_admission(s)), None)
+        if slot is None:
+            err = QueueFullError("no free slot for KV import")
+            err.retry_after_s = self.estimate_retry_after()
+            err.priority = req.priority
+            raise err
+        full = np.concatenate(
+            [req.prompt, np.asarray(emitted, np.int32)]
+        ) if emitted else req.prompt
+        body = full[:-1]
+        target = body.size + max_new - len(emitted)
+        cap_blocks = max(1, -(-target // B))
+        cls = req.priority
+        blocks = self._allocator.alloc_for(cls, cap_blocks)
+        if blocks is None:
+            short = cap_blocks - self._allocator.free_blocks
+            if self._prefix_cache is not None and short > 0:
+                self._prefix_cache.reclaim(short)
+                blocks = self._allocator.alloc_for(cls, cap_blocks)
+            if blocks is None:
+                self.admission_defers += 1
+                err = QueueFullError(
+                    f"pool blocks short for KV import ({cap_blocks} "
+                    "needed)")
+                err.retry_after_s = self.estimate_retry_after()
+                err.priority = cls
+                raise err
+        # -- validated and funded: install
+        tr = RequestTrace(req.id)
+        tr.mark("submitted")
+        tr.attrs["imported_blocks"] = n_payload
+        if emitted:
+            tr.attrs["resume_tokens"] = len(emitted)
+        self._traces[req.id] = tr
+        ids = np.asarray(blocks[:n_payload], np.int32)
+        self._kv_pool = _write_pool_blocks(
+            self._kv_pool, jnp.asarray(ids), jnp.asarray(k),
+            jnp.asarray(v),
+            None if ks is None else jnp.asarray(ks),
+            None if vs is None else jnp.asarray(vs),
+            shardings=self._shardings)
+        for stale in [r for r, s in self._slot_of.items() if s == slot]:
+            del self._slot_of[stale]
+        self._free_slot_blocks(slot)
+        self._slot_of[req.id] = slot
+        self._inflight.add(req.id)
+        offset = (self._cursor - body.size) % self.max_len
+        temp = (self.temperature if req.temperature is None
+                else float(req.temperature))
+        topk = (self.top_k if req.top_k is None else int(req.top_k))
+        row = self._np_tables[slot]
+        row[:] = self._allocator.n_blocks                   # pad
+        for j, block in enumerate(blocks):
+            row[j] = block
+        self._tables_dirty = True
+        self._slot_blocks[slot] = list(blocks)
+        self._slot_shared[slot] = []
+        self._slot_class[slot] = cls
+        self._np_offs[slot] = offset
+        self._np_floor[slot] = body.size
+        self._host_busy[slot] = True
+        self._np_temps[slot] = temp
+        self._np_topks[slot] = topk
+        self._np_lp[slot] = req.logprobs
+        # device-side activation: exactly what the finalize chunk's
+        # commit lane would have written
+        self._d_tokens = self._d_tokens.at[slot].set(int(full[-1]))
+        self._d_active = self._d_active.at[slot].set(True)
+        self._d_target = self._d_target.at[slot].set(int(target))
+        self._d_offsets = self._d_offsets.at[slot].set(int(offset))
+        self._d_temps = self._d_temps.at[slot].set(float(temp))
+        self._d_topks = self._d_topks.at[slot].set(int(topk))
+        self._d_lens = self._d_lens.at[slot].set(int(body.size))
+        self._model_len[slot] = body.size
+        self._model_active[slot] = True
+        self._model_target[slot] = target
+        tr.mark("admitted")
+        tr.mark("prefill_done")
+        # the imported prefix seeds the trie zero-copy, same as a local
+        # finalize: repeated prompts to this decode replica skip the
+        # transfer entirely next time
+        want = (self.cache_prompts if req.cache_prompt is None
+                else req.cache_prompt)
+        if self._prefix_cache is not None and want:
+            offer = {i: int(row[i]) for i in range(body.size // B)}
+            if offer:
+                self._prefix_cache.adopt(body, offer)
+        if self._journal is not None:
+            self._journal.submit(
+                req.id, prompt, max_new,
+                temperature=req.temperature, top_k=req.top_k,
+                cache_prompt=req.cache_prompt, seed=self._seed,
+                emitted=emitted, model=self.model,
+                stop=[list(s) for s in req.stop] if req.stop else None,
+                logprobs=req.logprobs, priority=req.priority)
+        admit = (slot, int(body.size), req)
+        if self._pipeline:
+            self._pipeline[-1]["events"].append(("admit", admit))
+        else:
+            self._apply_admit(admit)
+        self.kv_imports += 1
+        return req.id
 
     def _dispatch_block_paged(self) -> None:
         """Paged decode block: pump at most ``prefill_interleave``
@@ -3498,7 +4070,7 @@ class SlotServer:
             all_greedy=not bool(
                 (self._np_temps[self._host_busy] > 0).any()),
             lp_k=lp_k,
-            shardings=None)
+            shardings=self._shardings)
         self._d_lens = view.length
         # every row writes the shared cursor window; floors divert the
         # rows that must not commit (pending/idle/finished-and-lapped)
@@ -3686,6 +4258,9 @@ class SlotServer:
         packed result is sliced by length delta, so the whole event-log
         discipline (journal appends included) is untouched by
         speculation."""
+        if self._paged:
+            self._dispatch_spec_round_paged()
+            return
         t0 = time.monotonic()
         gamma = self._current_gamma()
         (self._cache, self._draft_cache, self._d_tokens, self._d_active,
@@ -3702,6 +4277,56 @@ class SlotServer:
         self._pipeline.append({"packed": packed, "events": [], "seq": seq,
                                "w": gamma + 4, "spec_gamma": gamma})
         self._post_dispatch_chaos()
+
+    def _dispatch_spec_round_paged(self) -> None:
+        """Paged speculative round: gather BOTH pools into ring views
+        (same tables, per-pool length vectors), run the unchanged
+        `_spec_block`, scatter each slot's round window — the gamma+1
+        positions starting at its pre-round length — back into both
+        pools, and process the round IMMEDIATELY (forced sync, like
+        spec's sync mode generally: the scatter window is computed from
+        host lengths, which only stay exact with an empty pipeline).
+        Committing all gamma+1 rows is safe even when the verify
+        rolled tokens back: rolled-back rows sit ABOVE the slot's new
+        length in exclusively-owned tail blocks — the mask never reads
+        past length, and the next round overwrites them. View rows the
+        program didn't write round-trip their gathered bytes
+        unchanged."""
+        t0 = time.monotonic()
+        gamma = self._current_gamma()
+        # pre-round lengths: exact under forced sync (pipeline empty,
+        # every admit/import/process already applied)
+        lens_before = self._expect_len.copy()
+        view = self._gather_view()
+        dview = self._gather_view(pool=self._draft_kv_pool,
+                                  lens=self._d_draft_lens)
+        (view, dview, self._d_tokens, self._d_active,
+         packed) = _spec_block(
+            self._params, self._draft_params, view, dview,
+            self._d_tokens, self._d_active,
+            self._d_target, self._d_offsets,
+            cfg=self.cfg, draft_cfg=self._draft_cfg, gamma=gamma,
+            stop_tokens=self.stop_tokens, pad_id=self.pad_id)
+        self._d_lens = view.length
+        self._d_draft_lens = dview.length
+        w = gamma + 1
+        ring_ids = (self._np_offs[:, None] + lens_before[:, None]
+                    + np.arange(w, dtype=np.int32)[None, :]) \
+            % self.max_len
+        n_valids = np.full((self.slots,), w, np.int32)
+        floors = self._np_floor.copy()
+        self._scatter_view(view, ring_ids, n_valids, floors)
+        self._scatter_view(dview, ring_ids, n_valids, floors,
+                           draft=True)
+        self.blocks_dispatched += 1
+        self.spec_rounds += 1
+        self.telemetry.observe("decode_block_s", time.monotonic() - t0)
+        seq = self.dispatch_tracker.track("spec_round", packed)
+        self._pipeline.append({"packed": packed, "events": [], "seq": seq,
+                               "w": gamma + 4, "spec_gamma": gamma})
+        self._post_dispatch_chaos()
+        if self._pipeline:          # forced sync (see docstring); the
+            self._process(1)        # chaos hook may have emptied it
 
     def _process(self, count: int) -> None:
         """Sync + bookkeep the oldest ``count`` in-flight blocks with ONE
@@ -4019,4 +4644,6 @@ __all__ = ["Request", "Completion", "SlotServer", "PrefixCache",
            "BlockAllocator", "QueueFullError", "RequestJournal",
            "ModelEntry", "ModelRegistry",
            "COMPLETION_FINISH_REASONS", "FINISH_REASONS",
-           "PRIORITY_CLASSES"]
+           "PRIORITY_CLASSES",
+           "KV_TRANSFER_VERSION", "KV_IMPORT_KEYS", "KV_ENTRY_KEYS",
+           "serialize_kv_blocks", "deserialize_kv_blocks"]

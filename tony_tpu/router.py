@@ -184,6 +184,11 @@ class Replica:
         # naming one still route here rather than failing a fleet that
         # predates multi-model /stats)
         self.models: set[str] = set()
+        # disaggregated-serving role from /stats ("prefill" | "decode" |
+        # "both"; docs/serving.md "Disaggregated serving"). Legacy
+        # replicas that advertise none default to "both" — a mixed or
+        # roleless fleet routes exactly as before
+        self.role = "both"
         # the replica's own cumulative TTFT p99 from its newest /stats
         # poll (latency.ttft_s.p99_s) — rolled into stats()["fleet"],
         # the autoscale controller's router-side signal
@@ -294,6 +299,15 @@ class FleetRouter:
         self._nonce = f"{random.SystemRandom().getrandbits(48):012x}"
         self.failovers_total = 0      # mid-request resubmissions elsewhere
         self.resumed_tokens_total = 0  # prefix tokens carried by failovers
+        # disaggregated serving (docs/serving.md "Disaggregated
+        # serving"): requests that attempted the two-leg prefill->decode
+        # path, handoffs that completed through a KV import, and
+        # attempts that fell back to the classic single-leg path
+        # (either leg failed/torn — the fallback re-prefills from the
+        # prompt, so disaggregation costs recompute, never a request)
+        self.disagg_requests = 0
+        self.disagg_handoffs = 0
+        self.disagg_fallbacks = 0
         # streaming pass-through (docs/serving.md "Streaming & OpenAI
         # compatibility"): live relayed streams, tokens forwarded,
         # mid-stream failovers (resume prefix harvested from the relayed
@@ -510,6 +524,9 @@ class FleetRouter:
             rep.slots = int(st.get("slots", 0) or 0)
             rep.max_queue = int(st.get("max_queue", 0) or 0)
             rep.retry_after_s = int(st.get("retry_after_s", 1) or 1)
+            role = st.get("role")
+            if role in ("prefill", "decode", "both"):
+                rep.role = role
             models = st.get("models")
             if isinstance(models, dict):
                 rep.models = {str(m) for m in models}
@@ -552,7 +569,13 @@ class FleetRouter:
     def _ranked_locked(self, key: bytes | None,
                        model: str | None = None,
                        exclude: set | None = None) -> list[Replica]:
-        live = [r for r in self.replicas.values() if r.up]
+        # prefill-role replicas never serve a complete request (their
+        # /generate terminal is "prefilled" + a handoff payload, zero
+        # tokens) — the classic single-leg path must not land on one.
+        # They are reachable ONLY through the disaggregated two-leg
+        # path (_try_disagg), which picks them explicitly.
+        live = [r for r in self.replicas.values()
+                if r.up and r.role != "prefill"]
         if model is not None:
             # model-aware routing dimension: route/spill only among
             # replicas advertising the request's model (empty set =
@@ -596,6 +619,207 @@ class FleetRouter:
                 if not self._saturated_locked(rep, now):
                     return rep
             return ranked[0]
+
+    def _pick_prefill(self, model: str | None = None) -> Replica | None:
+        """Least-loaded live prefill-specialist replica (they are
+        compute-bound and phase-uniform, so load beats rendezvous
+        stickiness here — the DECODE leg keeps the template's trie
+        affinity). Saturated specialists are skipped while any other is
+        available; None when the fleet has no live prefill replica (the
+        caller uses the classic single-leg path)."""
+        now = time.monotonic()
+        with self._lock:
+            live = [r for r in self.replicas.values()
+                    if r.up and r.role == "prefill"
+                    and (model is None or not r.models
+                         or model in r.models)]
+            if not live:
+                return None
+            avail = [r for r in live
+                     if not self._saturated_locked(r, now)]
+            return min(avail or live, key=lambda r: (r.load, r.name))
+
+    def _post_import(self, rep: Replica, handoff: dict, timeout: float,
+                     on_frame=None) -> dict:
+        """POST /kv/import to one decode-capable replica: the body is
+        the prefill leg's handoff payload VERBATIM (the pinned transfer
+        contract); stream selection rides the query string. Same error
+        taxonomy as _post_generate — a 400 here means the payload was
+        damaged in flight (torn transfer), which the caller maps onto
+        the replay fallback."""
+        url = rep.base_url + "/kv/import"
+        if on_frame is not None:
+            url += "?stream=true"
+        body = json.dumps(handoff).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=max(0.05, timeout)) as resp:
+                if on_frame is None:
+                    return json.loads(resp.read().decode())
+                return self._read_stream(rep, resp, on_frame,
+                                         time.monotonic()
+                                         + max(0.05, timeout))
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                try:
+                    ra = int(e.headers.get("Retry-After", "1") or "1")
+                except ValueError:
+                    ra = 1
+                raise _ReplicaShed(ra) from None
+            if 400 <= e.code < 500:
+                try:
+                    detail = json.loads(e.read().decode()).get("error", "")
+                except Exception:
+                    detail = ""
+                raise _ReplicaClientError(
+                    f"HTTP {e.code} from {rep.name}"
+                    + (f": {detail}" if detail else "")) from None
+            raise _ReplicaUnavailable(f"HTTP {e.code}") from None
+        except (StreamConsumerError, _ReplicaUnavailable,
+                _ReplicaTimeout):
+            raise
+        except Exception as e:
+            reason = getattr(e, "reason", None)
+            if isinstance(e, TimeoutError) or isinstance(reason,
+                                                         TimeoutError):
+                raise _ReplicaTimeout(f"{type(e).__name__}: {e}") \
+                    from None
+            refused = isinstance(e, ConnectionRefusedError) or \
+                isinstance(reason, ConnectionRefusedError)
+            raise _ReplicaUnavailable(
+                f"{type(e).__name__}: {e}", never_sent=refused) from None
+
+    def _try_disagg(self, rid: int, tr, key, payload: dict,
+                    deadline: float, model, on_frame,
+                    collected: list) -> dict | None:
+        """The disaggregated two-leg path (docs/serving.md
+        'Disaggregated serving'): prefill on a least-loaded prefill
+        specialist, then hand the exported KV blocks to the rendezvous
+        decode replica via POST /kv/import and return (or relay) ITS
+        completion. Returns None on any leg failure — the caller falls
+        back to the classic single-leg path, which re-prefills from the
+        prompt on a decode-capable replica (the journal-replay recovery
+        shape: a dead prefill replica, a torn payload, or a full decode
+        pool each cost recompute, never the request)."""
+        pre = self._pick_prefill(model)
+        if pre is None:
+            return None
+        with self._lock:
+            self.disagg_requests += 1
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None
+
+        def _fallback(msg: str) -> None:
+            with self._lock:
+                self.disagg_fallbacks += 1
+            log.debug("router: disagg fallback for request %d: %s",
+                      rid, msg)
+
+        # ---- leg 1: prefill (buffered — the handoff payload rides the
+        # /generate response; streaming starts on the decode leg)
+        leg1 = dict(payload)
+        leg1.pop("stream", None)
+        leg1["timeout_s"] = max(0.05, remaining)
+        tr.attrs["prefill_replica"] = pre.name
+        with self._lock:
+            pre.requests += 1
+            pre.inflight += 1
+        try:
+            resp1 = self._post_generate(pre, leg1, remaining)
+        except _ReplicaShed as e:
+            with self._lock:
+                pre.shed += 1
+                pre.retry_after_s = e.retry_after_s
+                pre.saturated_until = (time.monotonic()
+                                       + min(e.retry_after_s, 30))
+            _fallback(f"{pre.name} shed the prefill leg")
+            return None
+        except _ReplicaUnavailable as e:
+            with self._lock:
+                pre.errors += 1
+                self._eject_locked(pre, f"disagg prefill leg: {e}")
+            _fallback(f"{pre.name} unavailable: {e}")
+            return None
+        except (_ReplicaTimeout, _ReplicaClientError) as e:
+            # timeout: the outer loop's deadline check decides; client
+            # error: the classic path will surface the same 400 — the
+            # fallback keeps ONE error-reporting surface
+            _fallback(f"{pre.name}: {e}")
+            return None
+        finally:
+            with self._lock:
+                pre.inflight -= 1
+        if resp1.get("finish_reason") != "prefilled":
+            # stale role advertisement: the replica served the whole
+            # request — deliver what we already paid for
+            if on_frame is not None and resp1.get("tokens"):
+                on_frame(resp1["tokens"])
+            resp1["replica"] = pre.name
+            resp1.setdefault("retries", 0)
+            return resp1
+        handoff = resp1.get("handoff")
+        if not handoff:
+            _fallback(f"{pre.name} prefilled but the export stash "
+                      "aged out")
+            return None
+
+        # ---- leg 2: import + decode on the rendezvous replica (the
+        # decode-side trie adopts the imported prefix blocks, so
+        # template affinity keeps paying on the decode tier)
+        dec = self._pick(key, model)
+        if dec is None:
+            _fallback("no live decode-capable replica")
+            return None
+        with self._lock:
+            dec.requests += 1
+            dec.inflight += 1
+        try:
+            resp2 = self._post_import(
+                dec, handoff, deadline - time.monotonic(),
+                on_frame=on_frame)
+        except _ReplicaShed as e:
+            with self._lock:
+                dec.shed += 1
+                dec.retry_after_s = e.retry_after_s
+                dec.saturated_until = (time.monotonic()
+                                       + min(e.retry_after_s, 30))
+            _fallback(f"{dec.name} shed the import leg")
+            return None
+        except _ReplicaUnavailable as e:
+            with self._lock:
+                dec.errors += 1
+                self._eject_locked(dec, f"disagg import leg: {e}")
+            # a partially-relayed decode stream is a true prefix: carry
+            # it so the fallback resumes instead of re-decoding
+            if collected:
+                payload["resume_tokens"] = list(collected)
+            _fallback(f"{dec.name} unavailable: {e}")
+            return None
+        except (_ReplicaTimeout, _ReplicaClientError) as e:
+            # client error = damaged/torn payload rejected LOUDLY by
+            # import_blocks: exactly the case the replay fallback is
+            # for (re-prefill from the prompt)
+            _fallback(f"{dec.name}: {e}")
+            return None
+        finally:
+            with self._lock:
+                dec.inflight -= 1
+        with self._lock:
+            self.disagg_handoffs += 1
+            if key is not None:
+                ranked = self._ranked_locked(key, model)
+                if ranked and ranked[0] is dec:
+                    self.affinity_hits += 1
+        if on_frame is not None:
+            resp2.setdefault("tokens", list(collected))
+        resp2["replica"] = dec.name
+        resp2["prefill_replica"] = pre.name
+        resp2.setdefault("retries", 0)
+        tr.attrs.update(disagg=True, replica=dec.name)
+        return resp2
 
     def fleet_model_fallback(self) -> str:
         """The /v1 ``model`` echo for requests that name none. The
@@ -721,6 +945,18 @@ class FleetRouter:
             # pass-through: the replica validates the tier name
             payload["priority"] = str(priority)
             tr.attrs["priority"] = str(priority)
+        # disaggregated two-leg attempt first (only when the fleet has
+        # live prefill specialists; a roleless/mixed fleet skips this
+        # entirely). SSE reconnects stay on the classic path — the
+        # parked prefix lives on one specific replica.
+        if last_event_id is None:
+            resp = self._try_disagg(
+                rid, tr, key, payload, deadline, model,
+                on_frame if on_tokens is not None else None, collected)
+            if resp is not None:
+                self._seal(tr, "finished", retries=0,
+                           n_tokens=len(resp.get("tokens", [])))
+                return resp
         attempts = 0
         min_retry_after: int | None = None
         failover_pending = False    # a failover counts when it POSTS
@@ -1102,8 +1338,21 @@ class FleetRouter:
                     # advertised model registry ([] = legacy replica:
                     # serves any model it's asked for)
                     "models": sorted(r.models),
+                    # disaggregated-serving role advertisement
+                    "role": r.role,
                     "ttft_p99_s": round(r.ttft_p99_s, 6),
                 } for r in self.replicas.values()}
+            # per-role load aggregates: the two-tier autoscaler's
+            # router-side signals (queue depth scales the prefill tier,
+            # latency scales the decode tier — docs/autoscaling.md)
+            roles: dict = {}
+            for r in self.replicas.values():
+                agg = roles.setdefault(r.role, {
+                    "live": 0, "inflight": 0, "queued": 0, "active": 0})
+                agg["live"] += 1 if r.up else 0
+                agg["inflight"] += r.inflight
+                agg["queued"] += max(0, r.queued)
+                agg["active"] += max(0, r.active)
             return {
                 "replicas": reps,
                 "live": sum(r.up for r in self.replicas.values()),
@@ -1125,7 +1374,14 @@ class FleetRouter:
                     "ttft_p99_s": round(max(
                         (r.ttft_p99_s for r in self.replicas.values()),
                         default=0.0), 6),
+                    "roles": roles,
                 },
+                # disaggregated serving: two-leg attempts, completed
+                # handoffs, and single-leg fallbacks (either leg died/
+                # tore — recompute, never a lost request)
+                "disagg_requests": self.disagg_requests,
+                "disagg_handoffs": self.disagg_handoffs,
+                "disagg_fallbacks": self.disagg_fallbacks,
                 # True while driver discovery is failing/distrusted and
                 # the router serves its last-known fleet (control-plane
                 # outage; docs/training-robustness.md)
@@ -1222,6 +1478,20 @@ class FleetRouter:
                         "affinity_hits / affinity_requests — how often "
                         "the sticky replica actually served (spills and "
                         "ejections lower it)")
+            r.counter(_metrics.ROUTER_DISAGG_REQUESTS_TOTAL,
+                      self.disagg_requests,
+                      "requests the router attempted to split across a "
+                      "prefill specialist and a decode replica")
+            r.counter(_metrics.ROUTER_DISAGG_HANDOFFS_TOTAL,
+                      self.disagg_handoffs,
+                      "completed prefill->decode handoffs (the prefill "
+                      "leg's KV blocks imported via /kv/import and "
+                      "decode resumed on them)")
+            r.counter(_metrics.ROUTER_DISAGG_FALLBACKS_TOTAL,
+                      self.disagg_fallbacks,
+                      "disaggregated attempts that fell back to the "
+                      "classic single-replica path (re-prefill from "
+                      "the prompt — correctness kept, recompute paid)")
             r.histogram(_metrics.ROUTER_ROUTING_SECONDS, self.routing_hist,
                         "routing-decision latency (pick only, no I/O)")
             r.histogram(_metrics.ROUTER_E2E_SECONDS, self.e2e_hist,

@@ -106,10 +106,36 @@ class ServingTaskAdapter(TaskAdapter):
             c.ENV_CLUSTER_SPEC: json.dumps(ctx.cluster_spec),
             c.ENV_SERVE_PORT: ctx.base_child_env.get(c.ENV_TASK_PORT, ""),
         }
-        flags = self._conf_serve_flags(ctx.conf)
+        flags = " ".join(part for part in (
+            self._conf_serve_flags(ctx.conf),
+            self._role_flags(ctx.conf, ctx.task_index)) if part)
         if flags:
             env[c.ENV_SERVE_EXTRA_FLAGS] = flags
         return env
+
+    @staticmethod
+    def _role_flags(conf, task_index) -> str:
+        """Phase-tier assignment for disaggregated serving (docs/
+        serving.md "Disaggregated serving"): with ``tony.serving.
+        prefill-instances`` = P and ``decode-instances`` = D, the
+        first P task indices launch as prefill specialists and the
+        next D as decode replicas — both tiers force ``--paged-kv``
+        (the KV block is the transfer unit on either side of
+        /kv/import) — and the remainder stay classic ``both``
+        engines. P = D = 0 (default) templates nothing: a uniform
+        fleet, today's behavior."""
+        if conf is None or task_index is None:
+            return ""
+        n_prefill = max(0, conf.get_int(keys.SERVING_PREFILL_INSTANCES, 0))
+        n_decode = max(0, conf.get_int(keys.SERVING_DECODE_INSTANCES, 0))
+        if not n_prefill and not n_decode:
+            return ""
+        idx = int(task_index)
+        if idx < n_prefill:
+            return "--role prefill --paged-kv"
+        if idx < n_prefill + n_decode:
+            return "--role decode --paged-kv"
+        return "--role both"
 
     @staticmethod
     def _conf_serve_flags(conf) -> str:
