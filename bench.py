@@ -165,6 +165,17 @@ behavior), and the SIGKILLed replica restarted against the same
 --trace-dir must recover its file journal and finish the orphaned
 requests. Results land in PERF.json under `serving_replay`.
 
+`python bench.py --serving --router-ha` gates the shared-nothing router
+tier (docs/serving.md "Router tier HA"): a real driver launches 2 serve
+replicas behind 2 `router`-framework front doors, SIGKILLs door 0 on
+its Nth request mid-burst, and ENFORCES zero failed requests (clients
+re-POST the same request_id on the survivor), byte-identical buffered
+AND streamed responses for every rerouted request, live cross-door
+affinity agreement after the driver relaunches the dead door (restart
+budget: router:0 restarts == 1, no collateral), reporting the p50
+latency cost of losing a front door. Results land in PERF.json under
+`router_ha`.
+
 `python bench.py --serving --spec` gates speculative decoding inside
 continuous batching (docs/serving.md "Speculative decoding &
 multi-model serving"): a target and a 12x-smaller draft trained on the
@@ -1751,6 +1762,349 @@ def run_serving_fleet_bench() -> int:
                            random_pass["ttft_p99_s"]],
             "affinity_hit_ratio": affinity_pass["affinity_hit_ratio"],
         },
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def run_router_ha_bench() -> int:
+    """Router-tier HA gate (one JSON line -> PERF.json `router_ha`;
+    docs/serving.md "Router tier HA"): a REAL driver gang-launches 2
+    serving replicas AND 2 shared-nothing front doors — the `router`
+    framework, each executor supervising a real `tony-tpu route` child
+    on the task's published port — then
+    TONY_TEST_ROUTER_SIGKILL_AT_REQUEST deterministically SIGKILLs
+    door 0 on receipt of its Nth front-door POST, mid-burst. Enforced
+    rather than reported:
+
+    - **zero failed requests**: every client whose door died re-POSTs
+      the same ``request_id`` on the surviving door and completes (the
+      replica-journaled ``req:<id>`` progress key makes resume
+      portable across doors);
+    - **byte-identical responses**: every rerouted request's tokens
+      equal a fresh undisturbed run of the same prompt — buffered AND
+      streamed (the SSE relay of the same prompt yields the same
+      token sequence);
+    - **affinity preserved**: both doors, probed live, route the same
+      keyed prompt to the same replica (shared-nothing rendezvous
+      agreement, after one door was relaunched);
+    - **the driver relaunches the dead door** on its restart budget
+      (journal: router:0 restarts == 1, replicas untouched) and the
+      relaunched door serves.
+
+    Router death is a latency cost: the reported value is the p50
+    latency of the requests that lost their front door over the p50 of
+    the undisturbed ones."""
+    import signal as _signal
+    import statistics as _stats
+    import tempfile as _tempfile
+    import threading
+    import urllib.request
+
+    sys.path.insert(0, str(REPO))
+    import numpy as np
+
+    from tony_tpu import constants as c
+    from tony_tpu.client import TonyClient
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.events.driver_journal import load_state
+    from tony_tpu.router import DriverDiscovery
+
+    e = dict(vocab=64, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+             slots=4, max_len=96, block_size=4, prefill_chunk=8)
+    MAX_NEW = 8
+    STEP_DELAY_MS = 30      # ~0.25s of decode per request: the SIGKILL
+    #                         catches real relays in flight
+    N_REQUESTS = 48
+    KILL_AT = 10            # door 0 dies on its 10th front-door POST
+
+    td = _tempfile.mkdtemp(prefix="tony-router-ha-bench-")
+    root = Path(td)
+    serve_cmd = (
+        f"{sys.executable} -m tony_tpu.cli.main serve "
+        "--port $TONY_SERVE_PORT --host 127.0.0.1 "
+        f"--vocab {e['vocab']} --d-model {e['d_model']} "
+        f"--n-layers {e['n_layers']} --n-heads {e['n_heads']} "
+        f"--d-ff {e['d_ff']} --dtype float32 --seed 0 "
+        f"--slots {e['slots']} --max-len {e['max_len']} "
+        f"--block-size {e['block_size']} "
+        f"--prefill-chunk {e['prefill_chunk']} "
+        "--max-queue 64 --drain-timeout-s 5")
+    route_cmd = (
+        f"{sys.executable} -m tony_tpu.cli.main route "
+        "--port $TONY_SERVE_PORT --host 127.0.0.1 "
+        "--job-dir $TONY_JOB_DIR --role replica "
+        f"--prefill-chunk {e['prefill_chunk']} "
+        "--health-interval-s 0.3 --probe-timeout-s 5.0 "
+        "--discovery-min-interval-s 0.5 --stats-every 2 "
+        "--drain-timeout-s 10")
+    conf = TonyConf({
+        "tony.staging.dir": str(root / "staging"),
+        "tony.history.location": str(root / "history"),
+        "tony.history.intermediate": str(root / "history/intermediate"),
+        "tony.history.finished": str(root / "history/finished"),
+        "tony.am.monitor-interval-ms": 100,
+        "tony.application.framework": "serving",
+        "tony.task.registration-poll-interval-ms": 100,
+        "tony.task.heartbeat-interval-ms": 250,
+        "tony.serving.healthz-interval-ms": 200,
+        "tony.replica.instances": 2,
+        "tony.replica.command": serve_cmd,
+        "tony.replica.max-restarts": 1,
+        "tony.router.instances": 2,
+        "tony.router.command": route_cmd,
+        "tony.router.framework": "router",
+        "tony.router.max-restarts": 2,
+        # the injection env reaches every child; only route processes
+        # read it, and only the one whose TONY_TASK_INDEX matches dies.
+        # NOTE: the RELAUNCHED door 0 carries the same spec — the
+        # post-burst probes below stay well under KILL_AT posts.
+        "tony.execution.env": " ".join([
+            f"PYTHONPATH={REPO}", "JAX_PLATFORMS=cpu",
+            f"{c.TEST_SERVING_STEP_DELAY_MS}={STEP_DELAY_MS}",
+            f"{c.TEST_ROUTER_SIGKILL_AT_REQUEST}=0#{KILL_AT}"]),
+    })
+    t_bench = time.time()
+    client = TonyClient(conf, poll_interval_s=0.2)
+    client.submit()
+    job_dir = Path(client.job_dir)
+    disco_router = DriverDiscovery(str(job_dir), role="router",
+                                   token=client.token)
+    disco_replica = DriverDiscovery(str(job_dir), role="replica",
+                                    token=client.token)
+
+    def endpoints(disco):
+        try:
+            return {tid: (host, port) for tid, host, port in disco()}
+        except Exception:
+            return {}
+
+    def post(port, payload, timeout=120):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+
+    def sse_tokens(port, payload, timeout=120):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate?stream=true",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        toks, final = [], None
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            for raw in r:
+                line = raw.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                frame = json.loads(line[len("data: "):])
+                if "finish_reason" in frame:
+                    final = frame
+                else:
+                    toks.extend(frame.get("tokens", []))
+        return toks, final
+
+    rng = np.random.default_rng(17)
+    chunk = e["prefill_chunk"]
+    template = rng.integers(0, e["vocab"], size=2 * chunk,
+                            dtype=np.int32)
+    prompts = [np.concatenate(
+        [template, rng.integers(0, e["vocab"], size=1 + i % 5,
+                                dtype=np.int32)]).tolist()
+        for i in range(N_REQUESTS)]
+
+    results: dict[int, object] = {}
+    latencies: dict[int, float] = {}
+    retried: set[int] = set()
+    marks: dict[str, float] = {}
+    try:
+        deadline = time.time() + 240
+        doors = reps = {}
+        while time.time() < deadline:
+            doors = endpoints(disco_router)
+            reps = endpoints(disco_replica)
+            if len(doors) == 2 and len(reps) == 2:
+                break
+            time.sleep(0.3)
+        assert len(doors) == 2, f"router tier never fully up: {doors}"
+        assert len(reps) == 2, f"replica fleet never fully up: {reps}"
+        door_ports = [doors["router:0"][1], doors["router:1"][1]]
+        dead_port = door_ports[0]
+
+        # ---- the burst: round-robined across both doors; door 0
+        # SIGKILLs itself on its KILL_AT-th POST. A client whose door
+        # died (mid-flight or refused) re-POSTs the SAME request_id on
+        # the other door; alternation also covers the relaunch window.
+        def call(i):
+            payload = {"prompt": prompts[i], "max_new_tokens": MAX_NEW,
+                       "request_id": f"burst-{i}"}
+            t0 = time.time()
+            attempt, last = 0, None
+            while time.time() - t0 < 180:
+                port = door_ports[(i + attempt) % 2]
+                try:
+                    results[i] = post(port, payload)
+                    latencies[i] = time.time() - t0
+                    return
+                except Exception as exc:
+                    last = exc
+                    retried.add(i)
+                    if "died" not in marks:
+                        marks["died"] = time.time()
+                    attempt += 1
+                    time.sleep(0.05)
+            results[i] = last
+
+        threads = []
+        t_burst = time.time()
+        for i in range(N_REQUESTS):
+            th = threading.Thread(target=call, args=(i,))
+            th.start()
+            threads.append(th)
+            time.sleep(0.03)
+        for th in threads:
+            th.join(timeout=300)
+        marks["burst_done"] = time.time()
+
+        # ---- gate 1: zero failed requests
+        failed = {i: r for i, r in results.items()
+                  if not isinstance(r, dict)}
+        assert not failed, (
+            f"{len(failed)} requests failed across the door kill: "
+            f"{dict(list(failed.items())[:3])}")
+        assert len(results) == N_REQUESTS
+        assert retried, (
+            "the SIGKILL never disrupted a request — the burst "
+            "finished before door 0's kill threshold?")
+        assert "died" in marks
+
+        # ---- gate 2: the driver relaunches the dead door, and it
+        # serves (the route child exited on SIGKILL; the adapter's
+        # nonzero exit spent one unit of router:0's restart budget)
+        relaunched_port = None
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            doors = endpoints(disco_router)
+            if "router:0" in doors and doors["router:0"][1]:
+                try:
+                    r0 = post(doors["router:0"][1],
+                              {"prompt": prompts[0],
+                               "max_new_tokens": MAX_NEW}, timeout=30)
+                    if isinstance(r0, dict) and r0.get("tokens"):
+                        relaunched_port = doors["router:0"][1]
+                        marks["relaunched"] = time.time()
+                        break
+                except Exception:
+                    pass
+            time.sleep(0.5)
+        assert relaunched_port is not None, (
+            "driver never relaunched the SIGKILLed door")
+        survivor = door_ports[1]
+
+        # ---- gate 3: byte-identical responses for every rerouted
+        # request — buffered re-runs on the survivor, plus the SSE
+        # relay of the same prompt on BOTH doors (streams included)
+        checked = sorted(retried)[:12]
+        for i in checked:
+            ref = post(survivor, {"prompt": prompts[i],
+                                  "max_new_tokens": MAX_NEW,
+                                  "request_id": f"ref-{i}"})
+            assert ref["tokens"] == results[i]["tokens"], (
+                f"request {i} rerouted mid-kill diverged: "
+                f"{results[i]['tokens']} vs fresh {ref['tokens']}")
+            assert ref["finish_reason"] == results[i]["finish_reason"]
+        s_toks, s_final = sse_tokens(
+            survivor, {"prompt": prompts[checked[0]],
+                       "max_new_tokens": MAX_NEW})
+        r_toks, r_final = sse_tokens(
+            relaunched_port, {"prompt": prompts[checked[0]],
+                              "max_new_tokens": MAX_NEW})
+        assert s_toks == r_toks == results[checked[0]]["tokens"], (
+            f"streamed relays diverged: {s_toks} vs {r_toks} vs "
+            f"buffered {results[checked[0]]['tokens']}")
+        assert s_final and s_final["finish_reason"] == "length"
+        assert r_final and r_final["finish_reason"] == "length"
+
+        # ---- gate 4: live affinity agreement — both doors (one of
+        # them freshly relaunched with a cold replica view) route the
+        # same keyed prompt to the same replica, with zero coordination
+        probes = [np.concatenate(
+            [rng.integers(0, e["vocab"], size=2 * chunk,
+                          dtype=np.int32),
+             rng.integers(0, e["vocab"], size=2, dtype=np.int32)]
+            ).tolist() for _ in range(3)]
+        disagreements = []
+        for k, probe in enumerate(probes):
+            a = post(survivor, {"prompt": probe,
+                                "max_new_tokens": 1})
+            b = post(relaunched_port, {"prompt": probe,
+                                       "max_new_tokens": 1})
+            if a.get("replica") != b.get("replica"):
+                disagreements.append((k, a.get("replica"),
+                                      b.get("replica")))
+        assert not disagreements, (
+            f"shared-nothing doors disagreed on affinity owners: "
+            f"{disagreements}")
+
+        # ---- forensics: the kill spent router:0's budget, nothing
+        # else moved; the survivor harvested journaled progress
+        state = load_state(job_dir / c.DRIVER_JOURNAL_FILE)
+        r0_restarts = state.tasks["router:0"].restarts
+        assert r0_restarts == 1, (
+            f"router:0 restarts {r0_restarts} != 1")
+        other = {tid: t.restarts for tid, t in state.tasks.items()
+                 if tid != "router:0" and t.restarts}
+        assert not other, f"collateral restarts: {other}"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{survivor}/stats", timeout=10) as r:
+            surv_stats = json.loads(r.read().decode())
+        assert surv_stats["failed"] == 0, surv_stats
+    finally:
+        proc = client._driver_proc
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, _signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                proc.wait(timeout=30)
+            except Exception:
+                try:
+                    os.killpg(proc.pid, _signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+    smooth = [latencies[i] for i in latencies if i not in retried]
+    disrupted = [latencies[i] for i in retried if i in latencies]
+    p50_smooth = _stats.median(smooth)
+    p50_disrupted = _stats.median(disrupted)
+    out = {
+        "metric": "router_ha_latency_cost",
+        "value": round(p50_disrupted / p50_smooth, 2),
+        "unit": "x p50 latency for requests that lost their front door "
+                "(vs undisturbed; zero failed)",
+        "doors": 2,
+        "replicas": 2,
+        "requests": N_REQUESTS,
+        "failed_requests": 0,
+        "rerouted_requests": len(retried),
+        "byte_identical_reroutes_checked": len(checked),
+        "streams_byte_identical": True,
+        "affinity_agreement_probes": len(probes),
+        "kill_at_request": KILL_AT,
+        "router0_restarts": 1,
+        "collateral_restarts": 0,
+        "survivor_resumed_tokens": surv_stats.get("resumed_tokens", 0),
+        "survivor_failed": 0,
+        "p50_latency_s_undisturbed": round(p50_smooth, 3),
+        "p50_latency_s_rerouted": round(p50_disrupted, 3),
+        "p99_latency_s_rerouted": round(
+            sorted(disrupted)[int(0.99 * (len(disrupted) - 1))], 3),
+        "door_relaunch_s": round(
+            marks["relaunched"] - marks["died"], 1),
+        "burst_wall_s": round(marks["burst_done"] - t_burst, 1),
+        "wall_s": round(time.time() - t_bench, 1),
     }
     print(json.dumps(out))
     return 0
@@ -3939,6 +4293,8 @@ def main() -> int:
     if "--elastic" in sys.argv:
         return run_elastic_bench()
     if "--serving" in sys.argv:
+        if "--router-ha" in sys.argv:
+            return run_router_ha_bench()
         if "--paged-kv" in sys.argv:
             return run_paged_kv_bench()
         if "--disagg" in sys.argv:

@@ -794,3 +794,339 @@ def test_watcher_parses_roles_and_tpot(monkeypatch):
     stats["p"]["role"] = "both"
     del stats["d"]["role"]
     assert not watcher.observe(eps).tiered
+
+
+# --------------------------------------------------------------------------
+# router-tier scaling (docs/serving.md "Router tier HA")
+# --------------------------------------------------------------------------
+
+
+def test_controller_router_tier_law():
+    """The router tier scales on ITS OWN saturation signal — mean
+    in-flight relays per live front door — with the serving hysteresis
+    shape (breach ticks up, clear-for-a-cooldown down, floor rule) and
+    a SHARED cooldown; n_routers=None or router_slo=0 leaves the law
+    inert (byte-identical to the two-tier controller)."""
+    def rctl(**kw):
+        kw.setdefault("queue_slo", 0)
+        kw.setdefault("ttft_slo_s", 0.0)
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 1)
+        kw.setdefault("router_slo", 2.0)
+        kw.setdefault("router_min", 1)
+        kw.setdefault("router_max", 3)
+        kw.setdefault("cooldown_s", 10.0)
+        kw.setdefault("breach_ticks", 2)
+        return AutoscaleController(**kw)
+
+    hot = FleetObservation(live=1, routers_live=2,
+                           router_relay_inflight=10)
+    # inert without a router fleet size (or with the SLO unset)
+    ctl = rctl()
+    assert ctl.decide(hot, 1, now=0.0) is None
+    assert ctl.decide(hot, 1, now=1.0, n_routers=None) is None
+    off = rctl(router_slo=0.0)
+    assert off.decide(hot, 1, now=0.0, n_routers=2) is None
+    assert off.decide(hot, 1, now=1.0, n_routers=2) is None
+
+    # breach ticks, then up with tier="router"; cooldown suppresses
+    ctl = rctl()
+    assert ctl.decide(hot, 1, now=0.0, n_routers=2) is None  # streak 1
+    d = ctl.decide(hot, 1, now=1.0, n_routers=2)
+    assert d is not None and d.direction == "up" and d.tier == "router"
+    assert "router relay inflight" in d.reason
+    ctl.note_scaled("up", now=1.0)
+    assert ctl.decide(hot, 1, now=2.0, n_routers=3) is None  # cooldown
+    # at router-max: no decision even past cooldown + streak
+    assert ctl.decide(hot, 1, now=12.0, n_routers=3) is None
+    assert ctl.decide(hot, 1, now=13.0, n_routers=3) is None
+
+    # clear below half the SLO for a full cooldown -> down; a blip
+    # above half re-arms the clock
+    ctl = rctl(cooldown_s=5.0)
+    idle = FleetObservation(live=1, routers_live=2,
+                            router_relay_inflight=0)
+    warm = FleetObservation(live=1, routers_live=2,
+                            router_relay_inflight=3)  # mean 1.5 > 1
+    assert ctl.decide(idle, 1, now=0.0, n_routers=2) is None  # clear t0
+    assert ctl.decide(warm, 1, now=2.0, n_routers=2) is None  # re-arm
+    assert ctl.decide(idle, 1, now=3.0, n_routers=2) is None  # clear t0
+    assert ctl.decide(idle, 1, now=6.0, n_routers=2) is None  # 3s < 5s
+    d = ctl.decide(idle, 1, now=8.5, n_routers=2)
+    assert d is not None and d.direction == "down"
+    assert d.tier == "router"
+    ctl.note_scaled("down", now=8.5)
+    # never below router-min
+    assert ctl.decide(idle, 1, now=60.0, n_routers=1) is None
+
+    # floor rule: a router fleet below min relaunches without a breach
+    ctl = rctl(router_min=2)
+    d = ctl.decide(idle, 1, now=0.0, n_routers=1)
+    assert d is not None and d.direction == "up" and d.tier == "router"
+    assert "min" in d.reason
+
+    # the SERVING law wins when both tiers breach: capacity goes where
+    # the tokens are made
+    ctl = rctl(queue_slo=4, max_replicas=3, breach_ticks=1)
+    both = FleetObservation(live=1, queued=10, routers_live=1,
+                            router_relay_inflight=10)
+    d = ctl.decide(both, 1, now=0.0, n_routers=1)
+    assert d is not None and d.direction == "up" and d.tier == ""
+
+    # no router answered /stats: the law never actuates blind (but the
+    # floor rule above still fires off the driver's own count)
+    ctl = rctl(breach_ticks=1)
+    blind = FleetObservation(live=1, routers_live=0,
+                             router_relay_inflight=0)
+    assert ctl.decide(blind, 1, now=0.0, n_routers=2) is None
+
+
+def test_watcher_scrapes_router_endpoints(monkeypatch):
+    """FleetWatcher scrapes each front door's /stats for
+    relay_inflight (summed into the observation, kept per-door for
+    victim picking) and, absent an explicit router_stats_url, derives
+    the router-side queue estimate from their fleet views: per-door
+    inflight SUMS (shared-nothing — each door counts only its own
+    relays), the polled active view takes the MAX (every door polls
+    the same replicas)."""
+    import json as _json
+
+    door_stats = {
+        "router:0": {"relay_inflight": 3,
+                     "fleet": {"inflight": 3, "active": 2}},
+        "router:1": {"relay_inflight": 1,
+                     "fleet": {"inflight": 1, "active": 2}},
+    }
+    watcher = FleetWatcher()
+
+    def fake_get(url):
+        if url == "http://agg:9/stats":
+            return _json.dumps({"fleet": {"inflight": 9, "active": 2}})
+        for name, port in (("router:0", 1), ("router:1", 2)):
+            if url == f"http://d{port}:{port}/stats":
+                return _json.dumps(door_stats[name])
+        return None
+
+    monkeypatch.setattr(watcher, "_get", fake_get)
+    doors = [("router:0", "d1", 1), ("router:1", "d2", 2)]
+    obs = watcher.observe([], router_endpoints=doors)
+    assert obs.routers_live == 2
+    assert obs.router_relay_inflight == 4
+    assert watcher.last_router_loads == {"router:0": 3, "router:1": 1}
+    # queue estimate: sum(inflight) - max(active) = 4 - 2
+    assert obs.router_queued == 2
+    # an explicit router_stats_url wins over the derived view
+    obs = watcher.observe([], router_stats_url="http://agg:9/stats",
+                          router_endpoints=doors)
+    assert obs.router_queued == 7
+    # a dead door contributes nothing and drops from the load map
+    doors.append(("router:2", "dead", 3))
+    obs = watcher.observe([], router_endpoints=doors)
+    assert obs.routers_live == 2
+    assert "router:2" not in watcher.last_router_loads
+
+
+def test_router_tier_autoscale_e2e(tmp_job_dirs, tmp_path):
+    """The tentpole's closed loop, end to end with REAL ``tony-tpu
+    route`` front doors under a scripted provisioner: the role named
+    ``router`` (framework "router" — auto-detected, no explicit
+    tony.autoscale.router-role) starts with door 1 PARKED; saturating
+    door 0 with live relays breaches the router law and unparks door 1
+    (a second real route process, serving requests); a sustained clear
+    scales the tier back down with an in-flight relay on the victim —
+    which completes through the SIGTERM drain (exit 0, zero dropped),
+    the slot parks, and the {tier="router"} metric series count both
+    actuations."""
+    import re as _re
+    import signal as _signal
+    import subprocess
+    import sys
+    import urllib.request
+
+    from tests.test_router import StubReplica
+
+    rep = StubReplica("backend")
+    rep.delay_s = 1.2       # keeps relays in flight across a tick
+
+    def script(spec, index, env, handle, attempt):
+        rpc = _rpc_for(env)
+        task_id = f"{spec.name}:{index}"
+        if spec.name == "replica":
+            payload = rpc.call("register_worker", task_id=task_id,
+                               host="127.0.0.1", port=23500 + index,
+                               attempt=int(env.get(c.ENV_TASK_ATTEMPT,
+                                                   -1)))
+            while payload is None:
+                time.sleep(0.03)
+                payload = rpc.call("get_cluster_spec", task_id=task_id)
+            rpc.call("publish_ports", task_id=task_id,
+                     ports={"serve_port": rep.port})
+            handle.extra["stop"].wait(120)
+            rpc.call("register_execution_result", task_id=task_id,
+                     exit_code=0)
+            rpc.close()
+            return 0
+        # router door: a REAL route process on an ephemeral port
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tony_tpu.cli.main", "route",
+             "--port", "0", "--replica", f"127.0.0.1:{rep.port}",
+             "--prefill-chunk", "4", "--health-interval-s", "0.2",
+             "--drain-timeout-s", "20"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env={"PATH": env.get("PATH", "/usr/bin:/bin"),
+                            "JAX_PLATFORMS": "cpu",
+                            "PYTHONPATH": ":".join(sys.path)})
+        port = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            m = _re.search(r"routing on http://[^:]+:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        if port is None:
+            proc.kill()
+            return 1
+        payload = rpc.call("register_worker", task_id=task_id,
+                           host="127.0.0.1", port=23600 + index,
+                           attempt=int(env.get(c.ENV_TASK_ATTEMPT, -1)))
+        while payload is None:
+            time.sleep(0.03)
+            payload = rpc.call("get_cluster_spec", task_id=task_id)
+        rpc.call("publish_ports", task_id=task_id,
+                 ports={"serve_port": port, "metrics_port": port})
+        handle.extra["stop"].wait(120)
+        # the drain contract: SIGTERM, in-flight relays finish, exit 0
+        proc.send_signal(_signal.SIGTERM)
+        try:
+            code = proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            code = proc.wait(timeout=10)
+        rpc.call("register_execution_result", task_id=task_id,
+                 exit_code=code)
+        rpc.close()
+        return code
+
+    driver = _driver(
+        tmp_job_dirs, tmp_path, script, name="routertier",
+        **{"tony.replica.instances": 1,
+           "tony.replica.command": "stub",
+           "tony.replica.max-restarts": 1,
+           "tony.router.instances": 2,
+           "tony.router.command": "stub",
+           "tony.router.framework": "router",
+           "tony.router.max-restarts": 1,
+           "tony.application.framework": "serving",
+           "tony.autoscale.enabled": True,
+           "tony.autoscale.role": "replica",
+           "tony.autoscale.min": 1,
+           "tony.autoscale.router-relay-slo": 2,
+           "tony.autoscale.router-min": 1,
+           "tony.quota.pool-slots": 3})
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    posts: list[threading.Thread] = []
+    try:
+        # the router role was auto-detected from its framework, and
+        # door 1 started parked under the router floor
+        assert driver._router_role == "router"
+        _wait(lambda: driver.serving_endpoints("router")
+              and driver.serving_endpoints("replica"),
+              timeout=40, msg="door 0 + replica up")
+        assert "router:1" in driver._parked
+        door0 = driver.serving_endpoints("router")[0]
+
+        def relay(port, out):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"prompt": [1, 2, 3, 4],
+                                 "max_new_tokens": 1}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out.append(json.loads(r.read().decode()))
+
+        def inflight(port):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/stats", timeout=5) as r:
+                return json.loads(r.read().decode())["relay_inflight"]
+
+        clock = {"t": 1000.0}
+        ctl = AutoscaleController(
+            min_replicas=1, max_replicas=1, router_slo=2.0,
+            router_min=1, router_max=2, cooldown_s=5.0,
+            breach_ticks=2, now_fn=lambda: clock["t"])
+        watcher = FleetWatcher()
+
+        # saturate door 0: three live relays > SLO 2 per door
+        got_up: list = []
+        for _ in range(3):
+            th = threading.Thread(target=relay, args=(door0[2], got_up))
+            th.start()
+            posts.append(th)
+        _wait(lambda: inflight(door0[2]) == 3, timeout=10,
+              msg="relays in flight")
+        assert driver.autoscale_tick(ctl, watcher) == "idle"  # streak 1
+        clock["t"] += 1
+        assert driver.autoscale_tick(ctl, watcher) == "scaled_up"
+        _wait(lambda: len(driver.serving_endpoints("router")) == 2,
+              timeout=40, msg="door 1 serving")
+        assert "router:1" not in driver._parked
+        for th in posts:
+            th.join(timeout=30)
+        assert len(got_up) == 3
+        assert all(r["finish_reason"] == "length" for r in got_up)
+        # the fresh door really serves
+        door1 = [e for e in driver.serving_endpoints("router")
+                 if e[0] == "router:1"][0]
+        got_d1: list = []
+        relay(door1[2], got_d1)
+        assert got_d1[0]["finish_reason"] == "length"
+
+        # traffic ebbs to one relay per door (mean == half the SLO ->
+        # clear): past the cooldown the tier scales DOWN, picking the
+        # highest-index door on the load tie; its in-flight relay
+        # finishes through the SIGTERM drain — zero dropped
+        clock["t"] += 6
+        got_down: list = []
+        for _, _, port in driver.serving_endpoints("router"):
+            th = threading.Thread(target=relay, args=(port, got_down))
+            th.start()
+            posts.append(th)
+        _wait(lambda: inflight(door0[2]) == 1
+              and inflight(door1[2]) == 1,
+              timeout=10, msg="one relay per door")
+        assert driver.autoscale_tick(ctl, watcher) == "idle"  # clear t0
+        clock["t"] += 6
+        assert driver.autoscale_tick(ctl, watcher) == "scaled_down"
+        _wait(lambda: "router:1" in driver._parked, timeout=40,
+              msg="door 1 drained + parked")
+        for th in posts:
+            th.join(timeout=30)
+        assert len(got_down) == 2, "a relay was dropped on scale-down"
+        assert all(r["finish_reason"] == "length" for r in got_down)
+        assert len(driver.serving_endpoints("router")) == 1
+        assert driver.arbiter.held("router") == 1
+
+        text = driver.render_metrics()
+        assert ('driver_autoscale_scale_ups_total{tier="router"} 1'
+                in text)
+        assert ('driver_autoscale_scale_downs_total{tier="router"} 1'
+                in text)
+        assert ('driver_autoscale_replicas{role="router",'
+                'stat="current",tier="router"} 1' in text)
+        assert "driver_task_restarts_total 0" in text
+        state = load_state(Path(driver.job_dir) / c.DRIVER_JOURNAL_FILE)
+        router_ops = [(op["dir"], op.get("tier"))
+                      for op in state.scale_ops
+                      if op["task"].startswith("router:")]
+        assert router_ops == [("up", "router"), ("down", "router")]
+        assert state.parked == {"router:1"}
+    finally:
+        driver._stop_requested.set()
+        for h in list(driver._handles.values()):
+            h.extra["stop"].set()
+        t.join(timeout=30)
+        rep.close()

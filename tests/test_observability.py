@@ -742,6 +742,25 @@ def test_metrics_names_rendered_and_documented():
         assert fam in rendered, f"disagg family unrendered: {fam}"
         assert fam in doc_names, f"disagg family undocumented: {fam}"
 
+    # the router-tier HA families are pinned EXPLICITLY the same way
+    # (ISSUE 18 lint discipline): each front door's self-telemetry on
+    # router /metrics, and the driver's {tier="router"} partition of
+    # the autoscale families — each must be rendered and documented;
+    # renaming either side without the other fails here
+    for fam in (_metrics.ROUTER_FLEET_SIZE,
+                _metrics.ROUTER_REPLICAS,
+                _metrics.ROUTER_RELAY_INFLIGHT):
+        assert fam in rendered, f"router-tier family unrendered: {fam}"
+        assert fam in doc_names, f"router-tier family undocumented: {fam}"
+    # the tier="router" label partition of the autoscale counters and
+    # gauges is a rendered contract too, both directions: the driver
+    # renderer must attach it and the doc must describe it
+    driver_src = inspect.getsource(driver_mod)
+    assert '{"tier": "router"}' in driver_src, (
+        "driver /metrics lost its tier=router autoscale partition")
+    assert 'tier="router"' in doc, (
+        "docs/observability.md lost the tier=router label description")
+
     # the model-labeled partition is a rendered contract too: the serve
     # renderer must attach {model=...} labels somewhere (the per-model
     # block) and the doc must describe the label

@@ -1,0 +1,390 @@
+"""Router-tier HA (docs/serving.md "Router tier HA").
+
+The shared-nothing contract under test, bottom-up: K independently
+constructed FleetRouters — distinct instance nonces, shuffled discovery
+orderings, divergent load views — must agree on every keyed pick AND
+the full spill order, because the rendezvous ranking is a pure function
+of (affinity key, replica NAME) and nothing else. Then the
+request-survival machinery a router death leans on: a surviving router
+harvests a dead peer's journaled progress from the owning replica via
+the portable ``req:<request_id>`` key and teacher-forces the exact
+prefix once; the drain contract (SIGTERM mirror of serve's) refuses
+new front-door work while in-flight relays finish; and the ``tony-tpu
+route`` process honors the deterministic SIGKILL injection knob the
+router-HA bench drives.
+"""
+
+import json
+import os
+import random
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+import tony_tpu.constants as c
+from tony_tpu.router import FleetRouter, make_handler
+
+from tests.test_router import StubReplica, _router, stubs  # noqa: F401
+
+# --------------------------------------------------------------------------
+# shared-nothing agreement (pure unit — no HTTP)
+# --------------------------------------------------------------------------
+
+NAMES = [f"replica:{i}" for i in range(6)]
+ENDPOINTS = [(n, "127.0.0.1", 9000 + i) for i, n in enumerate(NAMES)]
+
+
+def _fleet(k: int, rng: random.Random) -> list[FleetRouter]:
+    """K shared-nothing routers over the same replica NAMES: shuffled
+    endpoint orderings (discovery hands lists in arbitrary order),
+    distinct seeds, and divergent load views (each router's inflight
+    counts only its own relays)."""
+    routers = []
+    for _ in range(k):
+        eps = list(ENDPOINTS)
+        rng.shuffle(eps)
+        r = FleetRouter(eps, prefill_chunk=4,
+                        seed=rng.randrange(1 << 30))
+        for rep in r.replicas.values():
+            rep.queued = rng.randrange(10)
+            rep.inflight = rng.randrange(10)
+        routers.append(r)
+    return routers
+
+
+def test_k_router_affinity_agreement_property():
+    """The tentpole's correctness core: N routers with zero shared
+    state independently rank every keyed request identically — same
+    owner, same runner-up spill order — across shuffled orderings,
+    distinct nonces, and divergent load views. After an ejection, all
+    routers agree again, and ONLY the ejected replica's keys move
+    (each to its previous runner-up): rendezvous stability, the reason
+    a router death never reshuffles the fleet's prefix caches."""
+    rng = random.Random(18)
+    routers = _fleet(5, rng)
+    # the progress-key nonces really are per-instance (anti-splicing)
+    assert len({r._nonce for r in routers}) == len(routers)
+
+    prompts = [[rng.randrange(64) for _ in range(rng.randrange(4, 24))]
+               for _ in range(40)]
+    models = [None, "alpha", "beta"]
+    cases = [(p, models[i % len(models)]) for i, p in enumerate(prompts)]
+
+    before: dict[int, list[str]] = {}
+    for i, (prompt, model) in enumerate(cases):
+        key = routers[0].route_key(prompt, model)
+        assert key is not None      # every case has a full block
+        # model namespacing is part of the digest: same template,
+        # different model -> (almost surely) different rendezvous bucket
+        rankings = [[rep.name for rep in r._ranked_locked(key, model)]
+                    for r in routers]
+        assert all(rk == rankings[0] for rk in rankings), (
+            f"case {i}: shared-nothing routers disagree: {rankings}")
+        assert sorted(rankings[0]) == sorted(NAMES)
+        before[i] = rankings[0]
+
+    # eject one replica everywhere (each router notices independently)
+    victim = before[0][0]
+    for r in routers:
+        r.replicas[victim].up = False
+    for i, (prompt, model) in enumerate(cases):
+        key = routers[0].route_key(prompt, model)
+        rankings = [[rep.name for rep in r._ranked_locked(key, model)]
+                    for r in routers]
+        assert all(rk == rankings[0] for rk in rankings)
+        # rendezvous stability: the ranking is the old one minus the
+        # victim — non-victim keys keep their owner, the victim's keys
+        # land exactly on their previous runner-up
+        assert rankings[0] == [n for n in before[i] if n != victim]
+        if before[i][0] == victim:
+            assert rankings[0][0] == before[i][1]
+        else:
+            assert rankings[0][0] == before[i][0]
+
+
+def test_route_key_is_model_namespaced_and_chunk_aligned():
+    """Two models sharing a template must not collide on one bucket;
+    prompts differing only past the last full block share a key."""
+    r = FleetRouter(ENDPOINTS, prefill_chunk=4)
+    base = [1, 2, 3, 4]
+    assert r.route_key(base, "alpha") != r.route_key(base, "beta")
+    assert r.route_key(base + [9]) == r.route_key(base + [7])
+    assert r.route_key([1, 2, 3]) is None       # no full block
+
+
+# --------------------------------------------------------------------------
+# cross-router resume (stubs)
+# --------------------------------------------------------------------------
+
+def test_cross_router_resume_carries_journaled_prefix_once(stubs):  # noqa: F811
+    """A front-door retry through a SURVIVING router (same client
+    request_id) pre-polls the rendezvous owner's /progress under the
+    portable ``req:<id>`` key and teacher-forces the dead router's
+    journaled prefix EXACTLY once: the replica payload carries it as
+    ``resume_tokens``, the response tokens start with it (serve-contract
+    resume semantics: tokens include the prefix from position 0) and
+    never repeat it."""
+    a, b = stubs("a", "b")
+    survivor = _router([a, b], prefill_chunk=4)
+    survivor.health_tick()
+    prompt = [1, 2, 3, 4, 5]
+    owner = survivor._pick(survivor.route_key(prompt))
+    owner_stub = a if owner.name == "a" else b
+    # what the DEAD router's attempt journaled on the owning replica
+    owner_stub.progress_tokens = [7, 8, 9]
+
+    resp = survivor.generate(prompt, max_new_tokens=4, timeout_s=5,
+                             request_id="req-abc.1")
+    assert resp["replica"] == owner.name        # same rendezvous pick
+    assert owner_stub.payloads[-1]["resume_tokens"] == [7, 8, 9]
+    assert owner_stub.payloads[-1]["progress_key"] == "req:req-abc.1"
+    # the prefix appears once, at the head — never duplicated
+    assert resp["tokens"] == [7, 8, 9, len(prompt)]
+    assert survivor.stats()["resumed_tokens"] == 3
+
+    # no request_id -> private nonce key, no cross-router harvest
+    resp = survivor.generate(prompt, max_new_tokens=4, timeout_s=5)
+    assert "resume_tokens" not in owner_stub.payloads[-1]
+    assert owner_stub.payloads[-1]["progress_key"].startswith(
+        survivor._nonce + ":")
+    assert resp["tokens"] == [len(prompt)]
+
+    # journal already sealed (replica answers {}): fresh request, no
+    # resume — the poll costs nothing else
+    owner_stub.progress_tokens = None
+    resp = survivor.generate(prompt, max_new_tokens=4, timeout_s=5,
+                             request_id="req-abc.1")
+    assert "resume_tokens" not in owner_stub.payloads[-1]
+    assert resp["tokens"] == [len(prompt)]
+    assert survivor.stats()["resumed_tokens"] == 3  # unchanged
+
+
+def test_front_door_request_id_validation(stubs):  # noqa: F811
+    """The HTTP front door accepts a sane request_id (it becomes a
+    progress key fragment on replicas) and 400s hostile ones instead
+    of letting them poison the journal namespace."""
+    a = stubs("a")
+    router = _router([a], prefill_chunk=4)
+    router.health_tick()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(router))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        def post(payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read().decode())
+
+        status, _ = post({"prompt": [1, 2, 3, 4], "max_new_tokens": 1,
+                          "request_id": "Retry-7.of_9"})
+        assert status == 200
+        assert a.payloads[-1]["progress_key"] == "req:Retry-7.of_9"
+        for bad in ("", "a b", "x" * 65, "né", "a,b"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post({"prompt": [1, 2, 3, 4], "max_new_tokens": 1,
+                      "request_id": bad})
+            assert ei.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# --------------------------------------------------------------------------
+# drain contract
+# --------------------------------------------------------------------------
+
+def test_drain_refuses_new_work_and_finishes_inflight(stubs):  # noqa: F811
+    """begin_drain/drain (what the route CLI's SIGTERM handler runs):
+    new front-door posts 503 with a retry-another-door hint, /healthz
+    flips unhealthy (the LB eject signal), and drain() returns True
+    only after every in-flight relay finished — zero-dropped scale-down
+    by construction."""
+    a = stubs("a")
+    a.delay_s = 1.0
+    router = _router([a], prefill_chunk=4)
+    router.health_tick()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(router))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{port}/generate"
+    body = json.dumps({"prompt": [1, 2, 3, 4],
+                       "max_new_tokens": 1}).encode()
+    try:
+        results: dict = {}
+
+        def go():
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=15) as r:
+                results["resp"] = json.loads(r.read().decode())
+
+        t = threading.Thread(target=go)
+        t.start()
+        deadline = time.monotonic() + 5
+        while (router.stats()["relay_inflight"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert router.stats()["relay_inflight"] == 1
+
+        drained: dict = {}
+        dt = threading.Thread(
+            target=lambda: drained.setdefault("ok", router.drain(15)))
+        dt.start()
+        deadline = time.monotonic() + 5
+        while not router.draining and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # draining: new posts are refused toward the other doors...
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        # ... and /healthz ejects this router from the LB rotation
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read().decode())["draining"] is True
+
+        dt.join(timeout=15)
+        t.join(timeout=15)
+        assert drained["ok"] is True
+        assert results["resp"]["finish_reason"] == "length"
+        st = router.stats()
+        assert st["relay_inflight"] == 0 and st["draining"] is True
+        assert st["failed"] == 0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# --------------------------------------------------------------------------
+# the route PROCESS: SIGKILL injection + SIGTERM drain (subprocess)
+# --------------------------------------------------------------------------
+
+def _spawn_route(stub, extra_env=None, extra_args=()):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("TONY_TEST_")}
+    env.update({"JAX_PLATFORMS": "cpu", **(extra_env or {})})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tony_tpu.cli.main", "route",
+         "--port", "0", "--replica", f"127.0.0.1:{stub.port}",
+         "--prefill-chunk", "4", "--health-interval-s", "0.2",
+         *extra_args],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+    deadline = time.monotonic() + 30
+    port = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        m = re.search(r"routing on http://[^:]+:(\d+)", line)
+        if m:
+            port = int(m.group(1))
+            break
+    assert port, "route process never printed its readiness line"
+    return proc, port
+
+
+def _post(port, payload, timeout=15):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def test_route_sigkill_injection_kills_on_nth_request(stubs):  # noqa: F811
+    """TONY_TEST_ROUTER_SIGKILL_AT_REQUEST=N: the route process
+    SIGKILLs itself on RECEIPT of its Nth front-door generate request —
+    before routing, so the client sees a severed connection, exactly
+    the failure the router-HA bench's front-door retry must absorb."""
+    a = stubs("a")
+    proc, port = _spawn_route(
+        a, extra_env={c.TEST_ROUTER_SIGKILL_AT_REQUEST: "2"})
+    try:
+        resp = _post(port, {"prompt": [1, 2, 3, 4], "max_new_tokens": 1})
+        assert resp["finish_reason"] == "length"
+        with pytest.raises((urllib.error.URLError, ConnectionError,
+                            OSError)):
+            _post(port, {"prompt": [1, 2, 3, 4], "max_new_tokens": 1})
+        assert proc.wait(timeout=10) == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def test_route_sigkill_injection_targets_task_index(stubs):  # noqa: F811
+    """The "IDX#N" spelling arms the knob only on the router task whose
+    TONY_TASK_INDEX matches — how the bench kills door 0 of a fleet
+    that shares one tony.execution.env."""
+    a = stubs("a")
+    proc, port = _spawn_route(
+        a, extra_env={c.TEST_ROUTER_SIGKILL_AT_REQUEST: "0#1",
+                      c.ENV_TASK_INDEX: "1"})
+    try:
+        # index 1 ignores door 0's kill spec entirely
+        for _ in range(3):
+            resp = _post(port, {"prompt": [1, 2, 3, 4],
+                                "max_new_tokens": 1})
+            assert resp["finish_reason"] == "length"
+        assert proc.poll() is None
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+
+
+def test_route_sigterm_drains_inflight_then_exits_zero(stubs):  # noqa: F811
+    """The satellite drain contract end-to-end: SIGTERM mid-relay ->
+    the in-flight request still completes, new work is refused, and
+    the process exits 0 (a scale-down, not a failure, against the
+    driver's restart budget)."""
+    a = stubs("a")
+    a.delay_s = 1.5
+    proc, port = _spawn_route(a, extra_args=("--drain-timeout-s", "20"))
+    results: dict = {}
+
+    def go():
+        try:
+            results["resp"] = _post(
+                port, {"prompt": [1, 2, 3, 4], "max_new_tokens": 1},
+                timeout=25)
+        except Exception as e:      # pragma: no cover - failure detail
+            results["err"] = e
+
+    t = threading.Thread(target=go)
+    try:
+        t.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/stats", timeout=5) as r:
+                if json.loads(r.read().decode())["relay_inflight"] > 0:
+                    break
+            time.sleep(0.02)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+        t.join(timeout=30)
+        assert results.get("err") is None, results["err"]
+        assert results["resp"]["finish_reason"] == "length"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        t.join(timeout=5)

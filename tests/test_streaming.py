@@ -307,6 +307,30 @@ def test_api_contract_pinned_against_docs():
     assert "/kv/import" in serve_src and "/kv/import" in router_src
     assert '"role"' in serve_src and '"role"' in router_src
     assert '"handoff"' in serve_src and '"handoff"' in router_src
+    # router-tier HA surface (ISSUE 18): the "router" framework string
+    # is pinned in every layer that speaks it — the runtime registry
+    # maps it to a task adapter, the driver auto-detects the role by
+    # it, and keys.py stopped reserving it as a role name; the route
+    # CLI's SIGTERM drain flag and the portable cross-router progress
+    # key (client request_id -> ``req:<id>``) are contract, not detail
+    import tony_tpu.conf.keys as keys_mod
+    import tony_tpu.driver as driver_mod
+    import tony_tpu.runtimes as runtimes_mod
+
+    registry_src = inspect.getsource(runtimes_mod)
+    assert '("router",' in registry_src, (
+        "runtimes registry lost the router framework")
+    driver_src = inspect.getsource(driver_mod)
+    assert 'fw == "router"' in driver_src, (
+        "driver lost router-role framework auto-detection")
+    assert "router" not in keys_mod._RESERVED_NON_ROLES, (
+        "keys.py re-reserved 'router' — router roles can't be declared")
+    assert "--drain-timeout-s" in router_src, (
+        "route CLI lost its SIGTERM drain flag")
+    assert 'f"req:{request_id}"' in router_src, (
+        "router lost the portable cross-router progress key")
+    assert '"request_id"' in router_src, (
+        "router /generate lost the request_id body param")
 
 
 # --------------------------------------------------------------------------

@@ -152,6 +152,12 @@ class FleetObservation:
     tiered: bool = False
     queued_prefill: int = 0         # queued on prefill-role replicas
     tpot_p99_s: float | None = None  # WINDOWED fleet decode p99/token
+    # router-TIER telemetry (docs/serving.md "Router tier HA"): the
+    # front-door fleet's own saturation signal — relays in flight is
+    # work each router is actively proxying, so the mean per live
+    # router is per-front-door load regardless of how many doors exist
+    routers_live: int = 0           # routers answering /stats
+    router_relay_inflight: int = 0  # in-flight relays summed across them
 
 
 class FleetWatcher:
@@ -170,6 +176,9 @@ class FleetWatcher:
         # per-replica advertised serving role from the newest /stats —
         # the tier-targeted victim picker's input
         self.last_roles: dict[str, str] = {}
+        # per-ROUTER in-flight relay count from the newest observe() —
+        # the router-tier scale-down victim picker's input
+        self.last_router_loads: dict[str, int] = {}
 
     def _get(self, url: str) -> str | None:
         try:
@@ -178,11 +187,16 @@ class FleetWatcher:
         except Exception:
             return None
 
-    def observe(self, endpoints, router_stats_url: str = "") \
-            -> FleetObservation:
+    def observe(self, endpoints, router_stats_url: str = "",
+                router_endpoints=()) -> FleetObservation:
         """``endpoints``: [(name, host, port)] of the serving role's
         RUNNING replicas (their published serve_port). Best-effort: a
-        replica that answers neither probe contributes nothing."""
+        replica that answers neither probe contributes nothing.
+        ``router_endpoints``: same shape for the router ROLE's front
+        doors — each is scraped for its /stats ``relay_inflight`` (the
+        router-tier saturation signal) and, absent an explicit
+        ``router_stats_url``, their fleet views stand in for the
+        router-side queue estimate."""
         obs = FleetObservation()
         window: dict[str, float] = {}
         tpot_window: dict[str, float] = {}
@@ -245,6 +259,35 @@ class FleetWatcher:
                 obs.ttft_p99_s = None
         if tpot_window and max(tpot_window.values()) > 0:
             obs.tpot_p99_s = bucket_quantile(tpot_window, 0.99)
+        router_loads: dict[str, int] = {}
+        inflight_total = 0
+        active_view = 0
+        saw_fleet = False
+        for name, host, port in router_endpoints:
+            raw = self._get(f"http://{host}:{port}/stats")
+            if raw is None:
+                continue
+            try:
+                st = json.loads(raw)
+                relay = int(st.get("relay_inflight", 0) or 0)
+                obs.routers_live += 1
+                obs.router_relay_inflight += relay
+                router_loads[name] = relay
+                fleet = st.get("fleet")
+                if isinstance(fleet, dict):
+                    saw_fleet = True
+                    # inflight is per-router (each door counts only its
+                    # own relays — shared-nothing), so it SUMS; active
+                    # is every door's poll of the same replica /stats,
+                    # so the MAX view stands for the fleet
+                    inflight_total += int(fleet.get("inflight", 0) or 0)
+                    active_view = max(
+                        active_view, int(fleet.get("active", 0) or 0))
+            except (ValueError, AttributeError, TypeError):
+                pass
+        self.last_router_loads = router_loads
+        if saw_fleet and not router_stats_url:
+            obs.router_queued = max(0, inflight_total - active_view)
         if router_stats_url:
             raw = self._get(router_stats_url)
             if raw is not None:
@@ -293,10 +336,19 @@ class AutoscaleController:
                  min_replicas: int = 1, max_replicas: int = 1,
                  cooldown_s: float = 30.0, breach_ticks: int = 2,
                  interval_s: float = 2.0, last_scale_t: float | None = None,
-                 tpot_slo_s: float = 0.0, now_fn=time.time):
+                 tpot_slo_s: float = 0.0, router_slo: float = 0.0,
+                 router_min: int = 1, router_max: int = 0,
+                 now_fn=time.time):
         self.ttft_slo_s = float(ttft_slo_s)
         self.tpot_slo_s = float(tpot_slo_s)
         self.queue_slo = int(queue_slo)
+        # router-TIER law (docs/autoscaling.md "Three-tier signals"):
+        # router_slo is the mean in-flight relays per live front door
+        # above which the router tier itself is the bottleneck. 0 =
+        # the router tier is not autoscaled (today's behavior).
+        self.router_slo = float(router_slo)
+        self.router_min = max(0, int(router_min))
+        self.router_max = max(self.router_min, int(router_max))
         self.min_replicas = max(0, int(min_replicas))
         self.max_replicas = max(self.min_replicas, int(max_replicas))
         self.cooldown_s = max(0.0, float(cooldown_s))
@@ -309,6 +361,12 @@ class AutoscaleController:
         self.last_scale_t = last_scale_t
         self._breach_streak = 0
         self._clear_since: float | None = None
+        # router-tier hysteresis is SEPARATE (a router breach must not
+        # arm a serving scale-up and vice versa) but the cooldown is
+        # SHARED — one slot pool, and two tiers actuating in the same
+        # window would race each other for it
+        self._router_breach_streak = 0
+        self._router_clear_since: float | None = None
         # breach windows observed inside a cooldown WE armed are
         # discounted — they still reflect the pre-actuation fleet (the
         # new replica hadn't absorbed load when those requests ran).  A
@@ -335,6 +393,9 @@ class AutoscaleController:
             interval_s=float(conf.get(keys.AUTOSCALE_INTERVAL_S, 2) or 2),
             tpot_slo_s=float(conf.get(keys.AUTOSCALE_TPOT_P99_SLO_S, 0)
                              or 0),
+            router_slo=float(conf.get(keys.AUTOSCALE_ROUTER_RELAY_SLO, 0)
+                             or 0),
+            router_min=conf.get_int(keys.AUTOSCALE_ROUTER_MIN, 1),
             last_scale_t=last_scale_t)
 
     # ------------------------------------------------------------ control law
@@ -379,15 +440,27 @@ class AutoscaleController:
         return True
 
     def decide(self, obs: FleetObservation, n_running: int,
-               now: float | None = None) -> ScaleDecision | None:
+               now: float | None = None,
+               n_routers: int | None = None) -> ScaleDecision | None:
         """One control-law evaluation. ``n_running`` is the serving
-        role's current non-parked replica count (launched or launching).
-        Returns a decision or None; the CALLER journals + actuates, and
-        reports success back via ``note_scaled`` (an actuation that
-        could not proceed — e.g. awaiting a donation drain — must not
-        start the cooldown, or the pending scale-up would starve)."""
+        role's current non-parked replica count (launched or launching);
+        ``n_routers`` the router role's (None = no router tier — the
+        router law never evaluates, byte-identical to the two-tier
+        controller). Returns a decision or None; the CALLER journals +
+        actuates, and reports success back via ``note_scaled`` (an
+        actuation that could not proceed — e.g. awaiting a donation
+        drain — must not start the cooldown, or the pending scale-up
+        would starve). The serving law is evaluated FIRST: when both
+        tiers breach, capacity goes where the tokens are made."""
         now = self._now() if now is None else now
         self.last_obs = obs
+        decision = self._decide_serving(obs, n_running, now)
+        if decision is None:
+            decision = self._decide_router(obs, n_routers, now)
+        return decision
+
+    def _decide_serving(self, obs: FleetObservation, n_running: int,
+                        now: float) -> ScaleDecision | None:
         breach = self._breaching(obs)
         in_cooldown = (self.last_scale_t is not None
                        and now - self.last_scale_t < self.cooldown_s)
@@ -419,6 +492,56 @@ class AutoscaleController:
                 "down", f"signals clear for {now - self._clear_since:.0f}s")
         return None
 
+    def _decide_router(self, obs: FleetObservation,
+                       n_routers: int | None,
+                       now: float) -> ScaleDecision | None:
+        """The router-TIER law (docs/autoscaling.md "Three-tier
+        signals"): front doors scale on their OWN saturation signal —
+        mean in-flight relays per live router — never on the serving
+        tier's latency SLOs (a slow model must add replicas, not
+        routers). Same hysteresis shape as serving: breach-ticks
+        streak up, clear-below-half-SLO-for-a-full-cooldown down,
+        floor rule for a fleet below min, shared cooldown."""
+        if self.router_slo <= 0 or n_routers is None:
+            return None
+        in_cooldown = (self.last_scale_t is not None
+                       and now - self.last_scale_t < self.cooldown_s)
+        if n_routers < self.router_min and not in_cooldown:
+            return ScaleDecision(
+                "up", f"{n_routers} routers < min {self.router_min}",
+                tier="router")
+        if not obs.routers_live:
+            return None     # no router answered /stats: never actuate
+            #                 the tier blind (the floor rule above still
+            #                 relaunches a fleet the DRIVER knows is
+            #                 short)
+        mean = obs.router_relay_inflight / obs.routers_live
+        if mean > self.router_slo:
+            self._router_clear_since = None
+            if now < self._discard_until:
+                return None
+            self._router_breach_streak += 1
+            if (self._router_breach_streak >= self.breach_ticks
+                    and not in_cooldown and n_routers < self.router_max):
+                return ScaleDecision(
+                    "up",
+                    f"router relay inflight {mean:.1f}/door > SLO "
+                    f"{self.router_slo:g}", tier="router")
+            return None
+        self._router_breach_streak = 0
+        if mean > self.router_slo / 2:
+            self._router_clear_since = None
+            return None
+        if self._router_clear_since is None:
+            self._router_clear_since = now
+        if (not in_cooldown and n_routers > self.router_min
+                and now - self._router_clear_since >= self.cooldown_s):
+            return ScaleDecision(
+                "down",
+                f"router signal clear for "
+                f"{now - self._router_clear_since:.0f}s", tier="router")
+        return None
+
     def cooldown_remaining(self, now: float | None = None) -> float:
         """Seconds left in the armed cooldown (0.0 when none is armed).
         The serving layer folds this into 429 ``Retry-After`` hints: a
@@ -436,6 +559,8 @@ class AutoscaleController:
         self.last_scale_t = now
         self._breach_streak = 0
         self._clear_since = None
+        self._router_breach_streak = 0
+        self._router_clear_since = None
         self._discard_until = now + self.cooldown_s
         if direction == "up":
             self.decisions_up += 1

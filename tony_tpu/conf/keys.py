@@ -242,6 +242,16 @@ AUTOSCALE_BREACH_TICKS = "tony.autoscale.breach-ticks"
 # stats lag on; the two views OVERLAP, so the control law takes their
 # max, never the sum)
 AUTOSCALE_ROUTER_STATS_URL = "tony.autoscale.router-stats-url"
+# router-TIER scaling (docs/autoscaling.md "Three-tier scaling"): the
+# role whose tasks are fleet routers ("" = auto-detect the role whose
+# framework is "router"; the tier is scaled only when such a role
+# exists), the per-router relay-inflight SLO that breaches it (mean of
+# router_relay_inflight across live front doors; 0 = never scale the
+# tier), and its steady-state floor (slots above the floor start
+# parked, exactly like the serving role's)
+AUTOSCALE_ROUTER_ROLE = "tony.autoscale.router-role"
+AUTOSCALE_ROUTER_RELAY_SLO = "tony.autoscale.router-relay-slo"
+AUTOSCALE_ROUTER_MIN = "tony.autoscale.router-min"
 
 # ------------------------------------------------------------------- quota
 # multi-tenant arbitration (tony_tpu/autoscale.py ResourceArbiter): all
@@ -311,8 +321,12 @@ ROLE_KEY_TEMPLATES = (
 
 _ROLE_KEY_RE = re.compile(r"^tony\.([A-Za-z][A-Za-z0-9_\-]*)\.instances$")
 _RESERVED_NON_ROLES = frozenset(
+    # "router" is deliberately NOT reserved: the router tier is an
+    # ordinary role (tony.router.instances, framework "router" —
+    # docs/serving.md "Router tier HA"), and no global tony.router.*
+    # keys exist to collide with it
     {"application", "am", "task", "staging", "history", "cluster", "tpu",
-     "security", "execution", "horovod", "version", "serving", "router",
+     "security", "execution", "horovod", "version", "serving",
      "train", "warmpool", "autoscale", "quota"}
 )
 

@@ -155,6 +155,13 @@ TEST_SERVING_SIGKILL_AT_BLOCK = "TONY_TEST_SERVING_SIGKILL_AT_BLOCK"
 #   the serving PROCESS SIGKILLs itself at that decode block — the
 #   replica-death injection point for router-failover and journal-
 #   recovery e2e tests (0/unset = off)
+TEST_ROUTER_SIGKILL_AT_REQUEST = "TONY_TEST_ROUTER_SIGKILL_AT_REQUEST"
+#   the ROUTER process SIGKILLs itself upon receiving its Nth
+#   front-door generate request ("N", or "IDX#N" to target only the
+#   router task whose TONY_TASK_INDEX is IDX) — the router-death
+#   injection behind the router-HA gate (bench.py --serving
+#   --router-ha): the front-door retry must land on a surviving
+#   router and the request must still complete (0/unset = off)
 
 # driver-side chaos hooks (driver.py monitor loop; read once at
 # construction, seeded so a chaos run's fault sequence is reproducible —

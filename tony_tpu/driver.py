@@ -493,6 +493,20 @@ class Driver:
         self._autoscale_role = str(
             conf.get(keys.AUTOSCALE_ROLE, "") or "") or (
             roles_sorted[0] if len(roles_sorted) == 1 else "")
+        # the router TIER's role (docs/serving.md "Router tier HA"):
+        # explicit conf, else the first role whose framework resolves
+        # to "router" — the same per-role-override-then-app-level
+        # resolution the executor applies
+        self._router_role = str(
+            conf.get(keys.AUTOSCALE_ROUTER_ROLE, "") or "")
+        if not self._router_role:
+            for rname in roles_sorted:
+                fw = str(
+                    conf.get(keys.role_key(rname, "framework"), "")
+                    or conf.get(keys.APPLICATION_FRAMEWORK, "jax"))
+                if fw == "router":
+                    self._router_role = rname
+                    break
         self.arbiter = ResourceArbiter(
             self.session,
             pool_slots=conf.get_int(keys.QUOTA_POOL_SLOTS, 0))
@@ -506,6 +520,10 @@ class Driver:
         self._donated: set[str] = set()
         self._scale_up_count = 0
         self._scale_down_count = 0
+        # the router-TIER slices of the two counters above, rendered as
+        # the {tier="router"} series next to the unlabeled totals
+        self._router_scale_up_count = 0
+        self._router_scale_down_count = 0
         self._autoscale_runner = None
         self._controller = None
         self._recovered_scale_t: float | None = None
@@ -523,6 +541,17 @@ class Driver:
                     if task.index >= n_min:
                         self.session.detach_task(task.task_id)
                         self._parked.add(task.task_id)
+        if (self._autoscale_enabled and self._router_role
+                and float(conf.get(keys.AUTOSCALE_ROUTER_RELAY_SLO, 0)
+                          or 0) > 0):
+            # router-tier headroom parks the same way: front doors
+            # above the router floor start detached until the router
+            # law claims one
+            r_min = max(0, conf.get_int(keys.AUTOSCALE_ROUTER_MIN, 1))
+            for task in self.session.tasks.get(self._router_role, []):
+                if task.index >= r_min:
+                    self.session.detach_task(task.task_id)
+                    self._parked.add(task.task_id)
         # seeded driver chaos (TONY_TEST_DRIVER_*, constants.py) — the
         # cluster-side mirror of the serving chaos knobs; read once so a
         # run's fault sequence is reproducible from the seed
@@ -1194,6 +1223,19 @@ class Driver:
                       self._scale_down_count,
                       "autoscaler scale-down decisions actuated "
                       "(replicas SIGTERM-drained, slots parked)")
+            if self._router_tier_active():
+                # the router-TIER slices of the same families: the
+                # unlabeled totals above keep counting EVERY tier (the
+                # pre-router contract), the {tier="router"} series
+                # break out the front-door fleet's share
+                r.counter(DRIVER_AUTOSCALE_SCALE_UPS_TOTAL,
+                          self._router_scale_up_count,
+                          "autoscaler scale-up decisions actuated",
+                          labels={"tier": "router"})
+                r.counter(DRIVER_AUTOSCALE_SCALE_DOWNS_TOTAL,
+                          self._router_scale_down_count,
+                          "autoscaler scale-down decisions actuated",
+                          labels={"tier": "router"})
             reg = dict(self._reg_t)
         from .warmpool import count_ready
 
@@ -1257,6 +1299,21 @@ class Driver:
                     "newest queued-request signal the controller "
                     "observed (max of the replica /stats view and the "
                     "router view — they overlap, never summed)")
+            if self._router_tier_active():
+                rrole = self._router_role
+                for stat, val in (("current", self.arbiter.held(rrole)),
+                                  ("min", ctl.router_min),
+                                  ("max", ctl.router_max)):
+                    r.gauge(DRIVER_AUTOSCALE_REPLICAS, val,
+                            "the autoscaled serving role's replica "
+                            "count and bounds",
+                            labels={"role": rrole, "stat": stat,
+                                    "tier": "router"})
+                r.gauge(DRIVER_AUTOSCALE_QUEUE_DEPTH,
+                        obs.router_relay_inflight,
+                        "newest queued-request signal the controller "
+                        "observed",
+                        labels={"tier": "router"})
         counts: dict[str, int] = {}
         for t in self.session.all_tasks():
             counts[t.status.value] = counts.get(t.status.value, 0) + 1
@@ -1595,6 +1652,16 @@ class Driver:
         return getattr(spec, "priority_class", "interactive") \
             if spec is not None else "interactive"
 
+    def _router_tier_active(self) -> bool:
+        """Is the router TIER under the controller's law (a router role
+        exists and ``tony.autoscale.router-relay-slo`` armed it)? Gates
+        the park-don't-fail treatment of budget-exhausted routers: a
+        parked front door with no law to un-park it would be a silent
+        capacity leak."""
+        ctl = self._controller
+        return bool(self._router_role and ctl is not None
+                    and ctl.router_slo > 0)
+
     def _start_autoscaler(self) -> None:
         """Start the driver-resident autoscale loop (prepare(); no-op
         when disabled). The controller's cooldown clock resumes from
@@ -1613,6 +1680,15 @@ class Driver:
             controller.max_replicas = max(
                 controller.min_replicas,
                 spec.instances if spec is not None else 1)
+        if controller.router_slo > 0 and self._router_role:
+            # the router ceiling is the role's configured instance
+            # count — there is no tony.autoscale.router-max key; the
+            # job file's `tony.<role>.instances` IS the front-door
+            # budget, and slots above router-min start parked
+            rspec = self.session.role_specs.get(self._router_role)
+            controller.router_max = max(
+                controller.router_min,
+                rspec.instances if rspec is not None else 1)
         self._controller = controller
         self._autoscale_runner = AutoscaleRunner(
             self, controller,
@@ -1653,18 +1729,26 @@ class Driver:
         role = self._autoscale_role
         if not role or self._stop_requested.is_set():
             return "idle"
-        obs = watcher.observe(self.serving_endpoints(role),
-                              router_stats_url)
+        router_role = (self._router_role
+                       if controller.router_slo > 0 else "")
+        obs = watcher.observe(
+            self.serving_endpoints(role), router_stats_url,
+            router_endpoints=(self.serving_endpoints(router_role)
+                              if router_role else ()))
         with self._restart_lock:
             draining = sum(1 for t in self._scale_downs
                            if t.partition(":")[0] == role)
+            r_draining = sum(1 for t in self._scale_downs
+                             if t.partition(":")[0] == router_role)
         # the control law sees the POST-drain fleet size: a replica
         # mid-scale-down-drain still counts as RUNNING in the session
         # table, and counting it would let a second scale-down fire
         # past the cooldown while the first drain is in flight —
-        # draining the whole fleet
-        decision = controller.decide(obs,
-                                     self.arbiter.held(role) - draining)
+        # draining the whole fleet. Same arithmetic for front doors.
+        decision = controller.decide(
+            obs, self.arbiter.held(role) - draining,
+            n_routers=(self.arbiter.held(router_role) - r_draining
+                       if router_role else None))
         if decision is None:
             return "idle"
         if decision.direction == "up":
@@ -1680,9 +1764,14 @@ class Driver:
                 controller.note_scaled("up")
                 self._push_autoscale_hint(controller)
             return status
-        victim = self._pick_scale_down_victim(role, watcher.last_loads)
+        if decision.tier == "router":
+            victim = self._pick_scale_down_victim(
+                router_role, watcher.last_router_loads)
+        else:
+            victim = self._pick_scale_down_victim(role,
+                                                  watcher.last_loads)
         if victim is not None and self._autoscale_scale_down(
-                victim, decision.reason):
+                victim, decision.reason, tier=decision.tier):
             controller.note_scaled("down")
             self._push_autoscale_hint(controller)
             return "scaled_down"
@@ -1757,8 +1846,15 @@ class Driver:
         fleet (queue breach -> prefill slots, latency breach -> decode
         slots); a tier with no parked slot falls back to any parked
         slot — capacity in the wrong phase still beats a breach (the
-        extra replica serves role "both" and absorbs either phase)."""
-        role = self._autoscale_role
+        extra replica serves role "both" and absorbs either phase).
+        ``tier="router"`` targets the router ROLE instead of the
+        serving role (docs/serving.md "Router tier HA"): same parked-
+        slot claim, same ledger, different role — front doors have no
+        phase sub-tiers, so the index carve does not apply."""
+        if tier == "router":
+            role, slot_tier = self._router_role, ""
+        else:
+            role, slot_tier = self._autoscale_role, tier
         spec = self.session.role_specs.get(role)
         if spec is None:
             return "no_role"
@@ -1770,15 +1866,15 @@ class Driver:
                  if t.task_id in self._parked
                  and t.task_id in self.session.detached),
                 key=lambda t: t.index)
-            if tier:
+            if slot_tier:
                 in_tier = [t for t in parked
-                           if self._tier_match(t.index, tier)]
+                           if self._tier_match(t.index, slot_tier)]
                 if in_tier:
                     parked = in_tier
                 elif parked:
                     log.warning(
                         "autoscale: no parked %s-tier slot; claiming "
-                        "%s outside the tier instead", tier,
+                        "%s outside the tier instead", slot_tier,
                         parked[0].task_id)
             if not parked:
                 return "at_max"
@@ -1816,6 +1912,8 @@ class Driver:
                        reason=reason, tier=tier)
             with self._tt_lock:
                 self._scale_up_count += 1
+                if tier == "router":
+                    self._router_scale_up_count += 1
             self._clear_attempt_state(task_id)
             self._trace_mark(task_id, "scaled_up", scale_reason=reason)
             log.warning("autoscale: scaling %s UP via %s (%s)", role,
@@ -1838,13 +1936,17 @@ class Driver:
                 return "launch_failed"
         return "scaled"
 
-    def _autoscale_scale_down(self, task_id: str, reason: str) -> bool:
+    def _autoscale_scale_down(self, task_id: str, reason: str,
+                              tier: str = "") -> bool:
         """SIGTERM-drain one replica (the serve child finishes its
         in-flight requests on the group signal — the roll path's drain
         contract); its completion PARKS the slot instead of
         relaunching. Zero dropped requests by construction: in-flight
         work drains, queued work fails over through the router's
-        journal/progress machinery."""
+        journal/progress machinery. ``tier="router"`` drains a front
+        door the same way — ``tony-tpu route``'s SIGTERM handler stops
+        accepting (healthz flips unhealthy, new posts 503 to the other
+        doors) and finishes its in-flight relays before exiting 0."""
         task = self.session.get_task_by_id(task_id)
         if task is None or task.status != TaskStatus.RUNNING:
             return False
@@ -1858,9 +1960,11 @@ class Driver:
             self._scale_downs.add(task_id)
         self._jrec("ledger", kind="scale_down", task=task_id)
         self._jrec("scale", dir="down", task=task_id, t=time.time(),
-                   reason=reason)
+                   reason=reason, tier=tier)
         with self._tt_lock:
             self._scale_down_count += 1
+            if tier == "router":
+                self._router_scale_down_count += 1
         log.warning("autoscale: scaling DOWN — draining %s (%s)",
                     task_id, reason)
         threading.Thread(target=self.provisioner.stop_container,
@@ -1892,10 +1996,16 @@ class Driver:
     def _park_failed_replica(self, task_id: str, cause: str) -> bool:
         """A budget-exhausted autoscaled replica parks (the controller
         relaunches it on its floor rule / next breach) instead of
-        failing the whole multi-tenant job. Caller holds the restart
-        lock (or no thread races: expiry path)."""
+        failing the whole multi-tenant job. Routers qualify too when
+        their tier is autoscaled: the router floor rule un-parks a
+        front door the same way the serving floor does a replica.
+        Caller holds the restart lock (or no thread races: expiry
+        path)."""
+        parkable = {self._autoscale_role}
+        if self._router_tier_active():
+            parkable.add(self._router_role)
         if (not self._autoscale_enabled
-                or task_id.partition(":")[0] != self._autoscale_role
+                or task_id.partition(":")[0] not in parkable
                 or self._stop_requested.is_set()):
             return False
         with self._restart_lock:
@@ -2930,6 +3040,15 @@ class Driver:
             n_min = max(0, self.conf.get_int(keys.AUTOSCALE_MIN, 1))
             for task in self.session.tasks.get(self._autoscale_role, []):
                 if task.index >= n_min:
+                    self.session.detach_task(task.task_id)
+                    self._parked.add(task.task_id)
+        if (self._autoscale_enabled and self._router_role
+                and float(self.conf.get(keys.AUTOSCALE_ROUTER_RELAY_SLO,
+                                        0) or 0) > 0):
+            r_min = max(0, self.conf.get_int(keys.AUTOSCALE_ROUTER_MIN,
+                                             1))
+            for task in self.session.tasks.get(self._router_role, []):
+                if task.index >= r_min:
                     self.session.detach_task(task.task_id)
                     self._parked.add(task.task_id)
                     self._jrec("detach", task=task.task_id)

@@ -260,7 +260,8 @@ def _apply(state: DriverState, rec: dict) -> None:
         state.scale_ops.append(
             {"dir": str(rec.get("dir", "")), "task": str(rec.get("task", "")),
              "t": float(rec.get("t", 0.0) or 0.0),
-             "reason": str(rec.get("reason", ""))})
+             "reason": str(rec.get("reason", "")),
+             "tier": str(rec.get("tier", "") or "")})
     elif op == "park":
         task_id = str(rec["task"])
         state.parked.add(task_id)

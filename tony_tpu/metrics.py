@@ -161,6 +161,13 @@ DRIVER_QUOTA_RECLAIMS_TOTAL = "driver_quota_reclaims_total"
 # the router renderer and docs/observability.md, both directions)
 ROUTER_REPLICA_UP = "router_replica_up"
 ROUTER_REPLICAS_LIVE = "router_replicas_live"
+# fleet-level ejection/readmission visibility (ISSUE 18): total known
+# replicas, the live/ejected split as a labeled family, and the
+# requests this router currently relays — the router-TIER saturation
+# signal the autoscaler scrapes per front door
+ROUTER_FLEET_SIZE = "router_fleet_size"
+ROUTER_REPLICAS = "router_replicas"
+ROUTER_RELAY_INFLIGHT = "router_relay_inflight"
 ROUTER_REQUESTS_TOTAL = "router_requests_total"
 ROUTER_RETRIES_TOTAL = "router_retries_total"
 ROUTER_SHED_TOTAL = "router_shed_total"

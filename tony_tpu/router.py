@@ -227,7 +227,8 @@ class FleetRouter:
                  eject_after: int = 2, spill_queue_depth: int | None = None,
                  probe_timeout_s: float = 2.0, stats_every: int = 4,
                  discover=None, trace_sink=None, seed: int | None = None,
-                 discovery_grace_s: float = 10.0):
+                 discovery_grace_s: float = 10.0,
+                 stats_phase: int | None = None):
         """``replicas``: static endpoints ("host:port" strings or
         (name, host, port) triples). ``discover``: zero-arg callable
         returning the current [(name, host, port)] — the driver-backed
@@ -248,7 +249,11 @@ class FleetRouter:
         replica's serving lock and computes histogram quantiles, and
         polling it at liveness cadence measurably steals saturated
         replicas' cycles (the router's own in-flight counts carry the
-        fast load signal between refreshes)."""
+        fast load signal between refreshes). ``stats_phase``: which
+        tick (mod ``stats_every``) pulls /stats — None derives a
+        per-INSTANCE phase from the router nonce, so N shared-nothing
+        routers spread their /stats renders across the cycle instead
+        of phase-locking N serving-lock grabs onto the same beat."""
         self.prefill_chunk = max(1, int(prefill_chunk))
         self.affinity = affinity
         self.health_interval_s = health_interval_s
@@ -297,6 +302,12 @@ class FleetRouter:
         self._outstanding: dict[int, str] = {}      # rid -> replica name
         self._resume: dict[int, list[int]] = {}     # rid -> emitted prefix
         self._nonce = f"{random.SystemRandom().getrandbits(48):012x}"
+        # client-supplied request ids make the progress key PORTABLE
+        # across routers (``req:<id>``): a front-door retry through a
+        # surviving router can harvest the prefix the dead router's
+        # request journaled on the owning replica. rid -> portable key;
+        # absent = the nonce-namespaced private key.
+        self._pkeys: dict[int, str] = {}
         self.failovers_total = 0      # mid-request resubmissions elsewhere
         self.resumed_tokens_total = 0  # prefix tokens carried by failovers
         # disaggregated serving (docs/serving.md "Disaggregated
@@ -316,6 +327,22 @@ class FleetRouter:
         self.streamed_tokens_total = 0
         self.stream_failovers_total = 0
         self.stream_disconnects_total = 0
+        # requests currently being relayed through THIS router
+        # (buffered and streamed alike) — the router-tier saturation
+        # signal the autoscaler scrapes (``router_relay_inflight``),
+        # and the drain gate a SIGTERM waits on
+        self._relay_inflight = 0
+        # True once a drain began: new front-door requests are refused
+        # (503, so an upstream LB moves on) while in-flight relays
+        # finish — router scale-down is zero-dropped by construction
+        self.draining = False
+        # per-INSTANCE phase jitter (Heartbeater precedent,
+        # executor.py): OS-entropy seeded, deliberately NOT ``seed`` —
+        # N routers built alike must still desynchronize their health
+        # polls, discovery reads, and /stats scrapes
+        self._phase_rng = random.Random()
+        self._stats_phase = (stats_phase if stats_phase is not None
+                             else int(self._nonce, 16)) % self.stats_every
         self._stop = threading.Event()
         self._health_started = False
         self._health_thread: threading.Thread | None = None
@@ -367,8 +394,42 @@ class FleetRouter:
         if self._health_thread is not None:
             self._health_thread.join(timeout=5)
 
+    def begin_drain(self) -> None:
+        """Stop accepting NEW front-door requests (the HTTP handler
+        503s them and ``/healthz`` goes unhealthy so an upstream LB
+        ejects this router) while in-flight relays keep running."""
+        with self._lock:
+            if not self.draining:
+                log.info("router: draining (%d relay(s) in flight)",
+                         self._relay_inflight)
+            self.draining = True
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Drain for scale-down/roll (mirrors serve's SIGTERM
+        contract): refuse new requests, wait up to ``timeout_s`` for
+        every in-flight relay — buffered and streamed — to finish.
+        True when the router emptied; False when the timeout cut the
+        wait short (the stragglers are abandoned with the process)."""
+        self.begin_drain()
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while True:
+            with self._lock:
+                if self._relay_inflight == 0:
+                    return True
+            if time.monotonic() >= deadline:
+                with self._lock:
+                    log.warning(
+                        "router: drain timed out with %d relay(s) "
+                        "still in flight", self._relay_inflight)
+                    return self._relay_inflight == 0
+            time.sleep(0.05)
+
     def _health_loop(self) -> None:
-        while not self._stop.wait(self.health_interval_s):
+        # ±10% phase jitter per wait (Heartbeater precedent): N
+        # shared-nothing routers started together must not probe every
+        # replica's /healthz — or hit discovery — in lockstep waves
+        while not self._stop.wait(self.health_interval_s
+                                  * self._phase_rng.uniform(0.9, 1.1)):
             try:
                 self.health_tick()
             except Exception:       # the loop must outlive a bad tick
@@ -381,9 +442,12 @@ class FleetRouter:
         ``stats_every``-th tick (see __init__)."""
         self._tick += 1
         # the FIRST tick always refreshes (fresh routers need a baseline
-        # before any traffic), then every stats_every-th
-        refresh_stats = (self._tick % self.stats_every) == 1 \
-            or self.stats_every == 1
+        # before any traffic), then every stats_every-th at this
+        # router's own phase offset (see __init__: staggered so N
+        # routers don't grab every replica's serving lock on one beat)
+        refresh_stats = (self.stats_every == 1 or self._tick == 1
+                         or (self._tick % self.stats_every)
+                         == self._stats_phase)
         if self.discover is not None:
             self._discovery_tick()
         with self._lock:
@@ -452,7 +516,11 @@ class FleetRouter:
         self.discovery_stale = False
 
     def _pkey(self, rid: int) -> str:
-        return f"{self._nonce}:{rid}"
+        # a client-supplied request_id makes the key portable across
+        # routers (req:<id>); otherwise the nonce namespaces it to this
+        # instance so shared-nothing peers can't splice each other's
+        # tokens into a resume
+        return self._pkeys.get(rid) or f"{self._nonce}:{rid}"
 
     def _refresh_progress(self, reps) -> None:
         """Journal the emitted-so-far prefix of every request this
@@ -843,7 +911,8 @@ class FleetRouter:
                  on_tokens=None, stop: list | None = None,
                  logprobs: int = 0,
                  priority: str | None = None,
-                 last_event_id: str | None = None) -> dict:
+                 last_event_id: str | None = None,
+                 request_id: str | None = None) -> dict:
         """Route one generation request; returns the replica's response
         dict (id/tokens/finish_reason) plus routing attrs. ``model``
         restricts routing to replicas advertising that model (their
@@ -866,27 +935,35 @@ class FleetRouter:
         reconnecting client's ``Last-Event-ID`` header to the FIRST
         replica attempt (best effort — the replica that parked the
         prefix resumes it, any other starts fresh; retries fall back
-        to the router's own /progress-harvested resume)."""
-        if on_tokens is not None:
-            with self._lock:
+        to the router's own /progress-harvested resume).
+
+        ``request_id`` (client-supplied, optional) makes the request's
+        progress key PORTABLE across shared-nothing routers
+        (``req:<id>``): if a router dies mid-request, the front-door
+        retry through ANY surviving router — same id — harvests the
+        prefix the dead router's attempt journaled on the owning
+        replica and carries it as ``resume_tokens``, so a router death
+        costs recompute of the gap, never the request (docs/serving.md
+        "Router tier HA")."""
+        with self._lock:
+            self._relay_inflight += 1
+            if on_tokens is not None:
                 self.streams_active += 1
-            try:
-                return self._generate(prompt, max_new_tokens, timeout_s,
-                                      temperature, top_k, cache_prompt,
-                                      model, on_tokens, stop, logprobs,
-                                      priority, last_event_id)
-            finally:
-                with self._lock:
+        try:
+            return self._generate(prompt, max_new_tokens, timeout_s,
+                                  temperature, top_k, cache_prompt,
+                                  model, on_tokens, stop, logprobs,
+                                  priority, last_event_id, request_id)
+        finally:
+            with self._lock:
+                self._relay_inflight -= 1
+                if on_tokens is not None:
                     self.streams_active -= 1
-        return self._generate(prompt, max_new_tokens, timeout_s,
-                              temperature, top_k, cache_prompt, model,
-                              None, stop, logprobs, priority,
-                              last_event_id)
 
     def _generate(self, prompt, max_new_tokens, timeout_s, temperature,
                   top_k, cache_prompt, model, on_tokens,
                   stop=None, logprobs=0, priority=None,
-                  last_event_id=None) -> dict:
+                  last_event_id=None, request_id=None) -> dict:
         rid = next(self._ids)
         tr = RequestTrace(rid)
         tr.mark("submitted")
@@ -895,6 +972,12 @@ class FleetRouter:
             self.requests_total += 1
             if key is not None:
                 self.affinity_requests += 1
+            if request_id is not None:
+                # portable progress key: every router derives the SAME
+                # key from the client's id, so the journaled prefix is
+                # readable across the shared-nothing tier
+                self._pkeys[rid] = f"req:{request_id}"
+                tr.attrs["request_id"] = str(request_id)
         deadline = time.monotonic() + timeout_s
         payload = {"prompt": [int(t) for t in prompt],
                    "max_new_tokens": int(max_new_tokens),
@@ -903,6 +986,28 @@ class FleetRouter:
                    # mid-request death resumes elsewhere from the last
                    # journaled prefix instead of from scratch
                    "progress_key": self._pkey(rid)}
+        if request_id is not None and last_event_id is None:
+            # cross-router resume: a retry of a request a DEAD router
+            # had in flight. Shared-nothing agreement makes the owning
+            # replica discoverable without coordination — this router's
+            # rendezvous pick over the same replica NAMES is the same
+            # replica the dead router posted to — so ask its /progress
+            # for the portable key once, before routing. An empty
+            # answer (fresh request, or the journal already sealed)
+            # costs one sub-probe-timeout poll and nothing else.
+            owner = self._pick(key, model)
+            if owner is not None:
+                prior = (self._fetch_progress(
+                    owner, [self._pkey(rid)],
+                    timeout=min(0.5, self.probe_timeout_s))
+                    .get(self._pkey(rid)) or {}).get("tokens")
+                if prior:
+                    payload["resume_tokens"] = [int(t) for t in prior]
+                    with self._lock:
+                        self.resumed_tokens_total += len(prior)
+                        self._resume[rid] = [int(t) for t in prior]
+                    tr.attrs["resumed_tokens"] = len(prior)
+                    tr.attrs["cross_router_resume"] = True
         if on_tokens is not None:
             payload["stream"] = True
         # streaming relay state: `collected` is the CURRENT attempt's
@@ -948,8 +1053,10 @@ class FleetRouter:
         # disaggregated two-leg attempt first (only when the fleet has
         # live prefill specialists; a roleless/mixed fleet skips this
         # entirely). SSE reconnects stay on the classic path — the
-        # parked prefix lives on one specific replica.
-        if last_event_id is None:
+        # parked prefix lives on one specific replica — and so do
+        # cross-router resumes: the harvested prefix replays through
+        # the classic teacher-forcing path, not a prefill handoff.
+        if last_event_id is None and "resume_tokens" not in payload:
             resp = self._try_disagg(
                 rid, tr, key, payload, deadline, model,
                 on_frame if on_tokens is not None else None, collected)
@@ -1317,6 +1424,7 @@ class FleetRouter:
             # journaled prefix
             self._outstanding.pop(tr.id, None)
             self._resume.pop(tr.id, None)
+            self._pkeys.pop(tr.id, None)
         sink = self.trace_sink
         if sink is not None:
             try:
@@ -1356,6 +1464,16 @@ class FleetRouter:
             return {
                 "replicas": reps,
                 "live": sum(r.up for r in self.replicas.values()),
+                # known replicas, live AND ejected — with `live`, the
+                # fleet-level view of ejection/readmission churn
+                "fleet_size": len(self.replicas),
+                # requests currently relayed through THIS router
+                # (buffered + streamed): the router-tier saturation
+                # signal the autoscaler scrapes, and the drain gate
+                "relay_inflight": self._relay_inflight,
+                # True once a SIGTERM/scale-down drain began: new
+                # requests are refused while in-flight relays finish
+                "draining": self.draining,
                 # controller-readable fleet aggregate (tony_tpu/
                 # autoscale.py): the merged load signals a scaling loop
                 # needs in one place — router-outstanding posts are
@@ -1437,6 +1555,21 @@ class FleetRouter:
                           labels=lab)
             r.gauge(_metrics.ROUTER_REPLICAS_LIVE, live,
                     "replicas currently in rotation")
+            r.gauge(_metrics.ROUTER_FLEET_SIZE, len(reps),
+                    "replicas this router knows about, live and "
+                    "ejected alike (discovery's newest view)")
+            r.gauge(_metrics.ROUTER_REPLICAS, live,
+                    "replica count by rotation state: ejection/"
+                    "readmission churn at the fleet level",
+                    labels={"state": "live"})
+            r.gauge(_metrics.ROUTER_REPLICAS, len(reps) - live,
+                    "replica count by rotation state: ejection/"
+                    "readmission churn at the fleet level",
+                    labels={"state": "ejected"})
+            r.gauge(_metrics.ROUTER_RELAY_INFLIGHT, self._relay_inflight,
+                    "requests currently relayed through this router "
+                    "(buffered + streamed) — the router-tier "
+                    "saturation signal the autoscaler scrapes")
             r.gauge(_metrics.ROUTER_DISCOVERY_STALE,
                     1 if self.discovery_stale else 0,
                     "1 while driver discovery is failing/distrusted and "
@@ -1518,14 +1651,19 @@ class FleetRouter:
         with self._lock:
             live = sum(r.up for r in self.replicas.values())
             total = len(self.replicas)
+            draining = self.draining
         loop_alive = None
         if self._health_started:
             loop_alive = (self._health_thread is not None
                           and self._health_thread.is_alive()
                           and not self._stop.is_set())
-        return {"healthy": bool(live) and loop_alive is not False,
+        return {"healthy": (bool(live) and loop_alive is not False
+                            and not draining),
                 "live": live, "replicas": total,
-                "health_loop_alive": loop_alive}
+                "health_loop_alive": loop_alive,
+                # a draining router must leave the LB rotation NOW —
+                # it refuses new requests while in-flight relays finish
+                "draining": draining}
 
 
 class DriverDiscovery:
@@ -1541,16 +1679,44 @@ class DriverDiscovery:
     recovery) rewrites it with a fresh endpoint and restores the
     journaled ports, so discovery heals without a replica bounce; the
     router's ``_discovery_tick`` keeps the last-known fleet serving in
-    the meantime (``router_discovery_stale``)."""
+    the meantime (``router_discovery_stale``).
+
+    ``min_interval_s`` caches a successful result that long (jittered
+    ±10% from OS entropy, so N shared-nothing routers spread their
+    ``get_task_infos`` reads instead of hammering the driver in
+    lockstep waves at health-poll cadence), and a FAILED call backs
+    off exponentially (0.5s doubling to 10s, same jitter) — during a
+    control-plane outage N routers re-probing the dead endpoint every
+    tick would synchronize into a recovery stampede the instant the
+    driver returns. Within the backoff window the cached failure
+    re-raises, so the router's ``_discovery_tick`` keeps reporting
+    stale instead of mistaking the cache for a live view.
+
+    ``token_role`` names what ``token`` IS. "client" (the default): the
+    ROOT job secret, from which the client-role key is derived here. A
+    router launched AS A TASK (the ``router`` framework) never sees the
+    root secret — its env carries the driver's already-derived
+    executor-role key — so the route CLI passes
+    ``token_role="executor"`` and the token is used verbatim
+    (``get_task_infos`` is not ACL-restricted; an executor key reads
+    the fleet view but still cannot sign client-privileged calls)."""
 
     def __init__(self, job_dir: str, role: str | None = None,
-                 token: str = ""):
+                 token: str = "", min_interval_s: float = 0.0,
+                 token_role: str = "client"):
         from pathlib import Path
 
+        self.token_role = token_role
         self.job_dir = Path(job_dir)
         self.role = role
+        self.min_interval_s = float(min_interval_s)
         self._token = token
         self._rpc = None
+        self._jitter = random.Random()      # per-process phase
+        self._cached: list | None = None
+        self._cached_err: Exception | None = None
+        self._next_t = 0.0
+        self._backoff = 0.0
 
     def _client(self):
         if self._rpc is None:
@@ -1560,18 +1726,39 @@ class DriverDiscovery:
 
             info = json.loads(
                 (self.job_dir / c.DRIVER_INFO_FILE).read_text())
+            # an executor-role token arrives pre-derived; only the root
+            # secret needs the client-key derivation
+            key = (derive_role_key(self._token, "client")
+                   if self.token_role == "client" else self._token)
             self._rpc = RpcClient(
                 info["host"], info["port"],
-                token=derive_role_key(self._token, "client")
-                if self._token else "",
-                role="client" if self._token else "", max_retries=2)
+                token=key if self._token else "",
+                role=self.token_role if self._token else "",
+                max_retries=2)
         return self._rpc
 
     def __call__(self) -> list[tuple[str, str, int]]:
+        now = time.monotonic()
+        if now < self._next_t:
+            # inside the cache/backoff window: replay the last outcome
+            # without touching the driver
+            if self._cached_err is not None:
+                raise RuntimeError(
+                    f"discovery backing off after: {self._cached_err}")
+            if self._cached is not None:
+                return list(self._cached)
         try:
             infos = self._client().call("get_task_infos")
-        except Exception:
+        except Exception as e:
             self.close()            # re-resolve driver.json next tick
+            self._cached_err = e
+            # capped below the router's own discovery grace: a
+            # recovered driver must be re-noticed before an empty/stale
+            # view would be honored
+            self._backoff = min(
+                max(self._backoff * 2, 0.5), 10.0)
+            self._next_t = now + (self._backoff
+                                  * self._jitter.uniform(0.9, 1.1))
             raise
         out = []
         for info in infos:
@@ -1585,6 +1772,9 @@ class DriverDiscovery:
             task_id = f"{info['name']}:{info['index']}"
             out.append((task_id, info.get("host") or "127.0.0.1",
                         int(serve)))
+        self._cached, self._cached_err, self._backoff = out, None, 0.0
+        self._next_t = now + (self.min_interval_s
+                              * self._jitter.uniform(0.9, 1.1))
         return out
 
     def close(self) -> None:
@@ -1596,8 +1786,12 @@ class DriverDiscovery:
 # ------------------------------------------------------------- HTTP front door
 
 def make_handler(router: FleetRouter, codec=None):
+    import os
+    import re
+    import signal
     from http.server import BaseHTTPRequestHandler
 
+    from . import constants as c
     from .api.openai import TokenCodec
     from .api.stream import begin_sse, read_json_body, sse_frame
 
@@ -1607,6 +1801,19 @@ def make_handler(router: FleetRouter, codec=None):
     # instance is reused across keep-alive requests, so id(self)
     # would hand two completions the same id)
     oai_ids = itertools.count()
+    # deterministic fault injection for the router-HA gate: SIGKILL
+    # this router upon RECEIVING its Nth front-door generate request —
+    # mid-POST from the client's view, so the front-door retry path is
+    # what survives it. "N" fires on any router; "IDX#N" only on the
+    # task whose TONY_TASK_INDEX is IDX (targets one member of a fleet
+    # that shares its env).
+    kill_at = 0
+    spec = os.environ.get(c.TEST_ROUTER_SIGKILL_AT_REQUEST, "")
+    if spec:
+        idx, sep, n = spec.rpartition("#")
+        if not sep or idx == os.environ.get(c.ENV_TASK_INDEX, ""):
+            kill_at = int(n)
+    req_seq = itertools.count(1)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -1648,6 +1855,17 @@ def make_handler(router: FleetRouter, codec=None):
 
         def do_POST(self):
             path = self.path.partition("?")[0]
+            if path in ("/generate", "/v1/completions",
+                        "/v1/chat/completions"):
+                if kill_at and next(req_seq) == kill_at:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                if router.draining:
+                    # drain contract (scale-down/roll): NEW requests
+                    # are refused loudly so the front door retries a
+                    # surviving router; in-flight relays keep running
+                    self._send(503, {"error": "router draining: retry "
+                                              "another front door"})
+                    return
             if path == "/generate":
                 self._post_generate()
             elif path == "/v1/completions":
@@ -1768,6 +1986,16 @@ def make_handler(router: FleetRouter, codec=None):
                         raise ValueError(
                             "priority must be 'interactive' or 'batch'")
                     kwargs["priority"] = pri
+                reqid = payload.get("request_id")
+                if reqid is not None:
+                    # the id becomes a /progress URL key: constrain it
+                    # to URL-safe chars and a sane length
+                    if (not isinstance(reqid, str) or not re.fullmatch(
+                            r"[A-Za-z0-9_.\-]{1,64}", reqid)):
+                        raise ValueError(
+                            "request_id must be 1-64 characters of "
+                            "[A-Za-z0-9_.-]")
+                    kwargs["request_id"] = reqid
                 from .api.stream import stream_requested
 
                 stream_on = stream_requested(payload, self.path)
@@ -1941,6 +2169,22 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="refresh each replica's /stats only every Nth "
                         "health tick (a /stats render takes the "
                         "replica's serving lock)")
+    p.add_argument("--stats-offset", type=int, default=-1,
+                   help="which tick (mod --stats-every) pulls /stats; "
+                        "-1 derives a per-instance phase from the "
+                        "router nonce so N routers stagger their "
+                        "scrapes instead of phase-locking them")
+    p.add_argument("--discovery-min-interval-s", type=float, default=2.0,
+                   help="cache a successful driver-discovery read this "
+                        "long (jittered): N routers must not hammer "
+                        "get_task_infos at health-poll cadence; failed "
+                        "reads back off exponentially on their own")
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="on SIGTERM/SIGINT, stop accepting new "
+                        "front-door requests and wait this long for "
+                        "in-flight relays (streams included) to finish "
+                        "before exiting 0 — the scale-down/roll drain "
+                        "contract, mirroring serve")
     p.add_argument("--discovery-grace-s", type=float, default=10.0,
                    help="distrust an EMPTY discovery result this long "
                         "while live replicas still answer their own "
@@ -1972,9 +2216,14 @@ def main(argv=None) -> int:
     if args.job_dir:
         from . import constants as c
 
+        # under an executor (the `router` framework) ENV_TOKEN is the
+        # driver's pre-derived executor-role key, not the root secret
+        as_task = os.environ.get(c.ENV_TASK_INDEX) is not None
         discover = DriverDiscovery(
             args.job_dir, role=args.role or None,
-            token=os.environ.get(c.ENV_TOKEN, ""))
+            token=os.environ.get(c.ENV_TOKEN, ""),
+            min_interval_s=args.discovery_min_interval_s,
+            token_role="executor" if as_task else "client")
     trace_writer = None
     trace_sink = None
     if args.trace_dir:
@@ -1992,13 +2241,45 @@ def main(argv=None) -> int:
         spill_queue_depth=args.spill_queue_depth or None,
         stats_every=args.stats_every, discover=discover,
         trace_sink=trace_sink,
-        discovery_grace_s=args.discovery_grace_s)
+        discovery_grace_s=args.discovery_grace_s,
+        stats_phase=(None if args.stats_offset < 0
+                     else args.stats_offset))
     router.start()
     from .api.openai import TokenCodec
 
     httpd = ThreadingHTTPServer((args.host, args.port),
                                 make_handler(router,
                                              TokenCodec(args.text_codec)))
+
+    # graceful drain on SIGTERM/SIGINT, mirroring serve's contract: a
+    # driver-initiated roll/scale-down must stop accepting new
+    # front-door requests, finish relaying in-flight streams (bounded
+    # by --drain-timeout-s), then exit 0 — so a router scale-down is
+    # zero-dropped by construction. A SECOND signal force-exits; the
+    # drain runs on a helper thread (httpd.shutdown() deadlocks from
+    # the serve_forever thread, and handlers must return fast).
+    # Handlers install BEFORE the readiness print: a supervisor that
+    # TERMs the instant it sees the routing line must hit the drain
+    # path, not the default-action kill.
+    import signal as _signal
+
+    draining = threading.Event()
+
+    def _drain_and_stop():
+        router.drain(args.drain_timeout_s)
+        httpd.shutdown()
+
+    def _on_signal(signum, frame):
+        if draining.is_set():
+            print("second signal: exiting immediately", flush=True)
+            os._exit(128 + signum)
+        draining.set()
+        print(f"signal {signum}: draining (finishing in-flight "
+              f"relays, up to {args.drain_timeout_s}s)", flush=True)
+        threading.Thread(target=_drain_and_stop, daemon=True).start()
+
+    _signal.signal(_signal.SIGTERM, _on_signal)
+    _signal.signal(_signal.SIGINT, _on_signal)
     print(f"routing on http://{args.host}:{httpd.server_address[1]} "
           f"({len(router.replicas)} static replicas"
           + (", driver discovery on" if discover else "") + ")",

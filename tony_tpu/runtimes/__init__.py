@@ -21,7 +21,11 @@ from .jax_runtime import JaxDriverAdapter, JaxTaskAdapter
 from .mxnet import MXNetDriverAdapter, MXNetTaskAdapter
 from .pytorch import PyTorchDriverAdapter, PyTorchTaskAdapter
 from .ray import RayDriverAdapter, RayTaskAdapter
-from .serving import ServingDriverAdapter, ServingTaskAdapter
+from .serving import (
+    RouterTaskAdapter,
+    ServingDriverAdapter,
+    ServingTaskAdapter,
+)
 from .tensorflow import TFDriverAdapter, TFTaskAdapter
 
 
@@ -53,6 +57,10 @@ for _name, _d, _t in (
     ("horovod", HorovodDriverAdapter, HorovodTaskAdapter),
     ("ray", RayDriverAdapter, RayTaskAdapter),
     ("serving", ServingDriverAdapter, ServingTaskAdapter),
+    # the router tier is supervised exactly like serving replicas —
+    # same driver adapter (no gang barrier), a task adapter that skips
+    # the serve-flag templating (docs/serving.md "Router tier HA")
+    ("router", ServingDriverAdapter, RouterTaskAdapter),
     ("standalone", StandaloneDriverAdapter, StandaloneTaskAdapter),
     ("generic", GenericDriverAdapter, GenericTaskAdapter),
 ):

@@ -277,3 +277,26 @@ class ServingTaskAdapter(TaskAdapter):
                 proc.wait(timeout=10)
                 return 1
 
+
+class RouterTaskAdapter(ServingTaskAdapter):
+    """Executor-side supervisor of one fleet-ROUTER child (``tony-tpu
+    route``) — the ``router`` framework (docs/serving.md "Router tier
+    HA"). The router tier rides the exact serving supervision shape:
+    the child binds the task's published port (``TONY_SERVE_PORT``),
+    the adapter watches its ``/healthz`` (FleetRouter.health: 503 on
+    an empty fleet or a dead maintenance loop), the first healthy poll
+    publishes ``serve_port``/``metrics_port`` (so the autoscaler's
+    FleetWatcher — and an upstream LB reading get_task_infos — can
+    find every front door), and a terminally-down router is killed
+    into the per-task restart budget exactly like a replica. The only
+    difference is the child env: routers take their flags from the
+    role command itself, so none of the ``tony.serving.*`` serve-flag
+    templating applies."""
+
+    def build_env(self, ctx: TaskContext) -> dict[str, str]:
+        import json
+
+        return {
+            c.ENV_CLUSTER_SPEC: json.dumps(ctx.cluster_spec),
+            c.ENV_SERVE_PORT: ctx.base_child_env.get(c.ENV_TASK_PORT, ""),
+        }
