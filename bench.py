@@ -176,6 +176,22 @@ budget: router:0 restarts == 1, no collateral), reporting the p50
 latency cost of losing a front door. Results land in PERF.json under
 `router_ha`.
 
+`python bench.py --serving --tracing` gates END-TO-END DISTRIBUTED
+TRACING (docs/observability.md "Distributed tracing"): a disaggregated
+fleet (1 prefill + 1 decode replica, --paged-kv) behind 2 router front
+doors, every tier writing --trace-dir JSONL; door 0 is SIGKILLed upon
+receiving its Nth front-door request mid-burst and the clients re-POST
+the same request_id at door 1. The bench merges every tier's trace
+file with TraceCollector and ENFORCES: every completed request yields
+exactly ONE merged trace (the deterministic for_request_id trace_id
+each response header echoed), ZERO orphan spans, >= 1 failover trace
+carrying spans from BOTH router nonces under one trace_id (the dead
+door contributes its unsealed write-ahead record), >= 1 trace whose
+serve spans come from both the prefill and the decode replica (the
+disagg handoff is one trace), and the span-union coverage accounts for
+each client-observed e2e within a bounded gap. Results land in
+PERF.json under `distributed_tracing`.
+
 `python bench.py --serving --spec` gates speculative decoding inside
 continuous batching (docs/serving.md "Speculative decoding &
 multi-model serving"): a target and a 12x-smaller draft trained on the
@@ -2923,6 +2939,339 @@ def run_serving_replay_bench() -> int:
     return 0
 
 
+def run_distributed_tracing_bench() -> int:
+    """Distributed-tracing gate (one JSON line -> PERF.json
+    `distributed_tracing`; docs/observability.md "Distributed
+    tracing"). Runs the disagg + router-SIGKILL story end to end: a
+    prefill + a decode replica (--paged-kv) behind two router front
+    doors, all four processes dumping --trace-dir JSONL; door 0 is
+    SIGKILLed upon receiving its Nth /generate mid-burst, clients
+    re-POST the same request_id at door 1, and the bench merges every
+    tier's trace file with TraceCollector and enforces the four gates
+    documented in docs/observability.md "Distributed tracing"."""
+    import re as _re
+    import tempfile as _tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from tony_tpu import constants as c
+    from tony_tpu.events.trace import (
+        TRACE_FILE,
+        TraceCollector,
+        coverage_s,
+    )
+    from tony_tpu.observability import (
+        TRACE_ID_RESPONSE_HEADER,
+        TraceContext,
+    )
+
+    e = dict(vocab=64, d_model=16, n_layers=1, n_heads=2, d_ff=32)
+    SLOTS, MAX_LEN, CHUNK, BLOCK = 4, 96, 8, 4
+    N_REQUESTS, MAX_NEW, KILL_AT = 24, 8, 8
+    STEP_DELAY_MS = 40      # slow decode so the kill hits in-flight work
+    STAGGER_S = 0.02        # burst spacing: #KILL_AT arrives ~0.15s in
+    DEADLINE_S = 240.0
+    # the documented bound on e2e time the merged span tree may leave
+    # unaccounted: client->door network, the dead door's pre-relay
+    # work, and the failover client's detect+re-POST beat
+    GAP_BOUND_S = 2.0
+
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, e["vocab"], size=10 + i % 6,
+                            dtype=np.int32).tolist()
+               for i in range(N_REQUESTS)]
+
+    base_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    base_env.pop("XLA_FLAGS", None)
+    base_env.pop(c.TEST_ROUTER_SIGKILL_AT_REQUEST, None)
+    serve_env = {**base_env,
+                 c.TEST_SERVING_STEP_DELAY_MS: str(STEP_DELAY_MS)}
+
+    td = _tempfile.mkdtemp(prefix="tony-tracing-bench-")
+
+    class Proc:
+        """One tier process (serve replica or route front door); both
+        print their endpoint as '... on http://host:port ...'."""
+
+        def __init__(self, name, argv, env):
+            self.name = name
+            self.trace_dir = os.path.join(td, name)
+            self.proc = subprocess.Popen(
+                argv + ["--trace-dir", self.trace_dir],
+                cwd=REPO, env=env, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            self.port = None
+
+        def await_ready(self, timeout=240.0):
+            deadline = time.time() + timeout
+            while self.port is None and time.time() < deadline:
+                line = self.proc.stdout.readline()
+                if line == "" and self.proc.poll() is not None:
+                    break
+                m = _re.search(r" on http://[\d.]+:(\d+)", line or "")
+                if m:
+                    self.port = int(m.group(1))
+            assert self.port, f"{self.name} never printed its endpoint"
+            threading.Thread(target=self.proc.stdout.read,
+                             daemon=True).start()
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{self.port}/healthz",
+                            timeout=2) as r:
+                        if r.status == 200:
+                            return
+                except Exception:
+                    pass        # 503 until the fleet is in rotation
+                time.sleep(0.2)
+            raise AssertionError(f"{self.name} never became healthy")
+
+        def get_json(self, path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}{path}",
+                    timeout=10) as r:
+                return json.loads(r.read().decode())
+
+        def stop(self):
+            if self.proc.poll() is None:
+                self.proc.kill()
+            self.proc.wait(timeout=15)
+
+    def serve_argv(role):
+        return [sys.executable, "-m", "tony_tpu.cli.main", "serve",
+                "--port", "0", "--vocab", str(e["vocab"]),
+                "--d-model", str(e["d_model"]),
+                "--n-layers", str(e["n_layers"]),
+                "--n-heads", str(e["n_heads"]),
+                "--d-ff", str(e["d_ff"]), "--dtype", "float32",
+                "--seed", "0", "--slots", str(SLOTS),
+                "--max-len", str(MAX_LEN), "--block-size", str(BLOCK),
+                "--prefill-chunk", str(CHUNK),
+                "--paged-kv", "--role", role]
+
+    def route_argv(replicas):
+        argv = [sys.executable, "-m", "tony_tpu.cli.main", "route",
+                "--port", "0", "--prefill-chunk", str(CHUNK),
+                "--health-interval-s", "0.15", "--stats-every", "1"]
+        for rep in replicas:
+            argv += ["--replica", f"127.0.0.1:{rep.port}"]
+        return argv
+
+    reps = doors = []
+    results: dict[int, object] = {}
+    try:
+        reps = [Proc("prefill", serve_argv("prefill"), serve_env),
+                Proc("decode", serve_argv("decode"), serve_env)]
+        for rep in reps:
+            rep.await_ready()
+        doors = [
+            Proc("door0", route_argv(reps),
+                 {**base_env,
+                  c.TEST_ROUTER_SIGKILL_AT_REQUEST: str(KILL_AT)}),
+            Proc("door1", route_argv(reps), base_env)]
+        for door in doors:
+            door.await_ready()
+        # both doors must have POLLED the replicas' role advertisements
+        # before the burst, or the early requests route classically and
+        # the disagg story never runs
+        for door in doors:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                st = door.get_json("/stats")
+                roles = {r.get("role")
+                         for r in st["replicas"].values()
+                         if r.get("up")}
+                if {"prefill", "decode"} <= roles:
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError(
+                    f"{door.name} never discovered both roles")
+
+        def post(door, body, timeout):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{door.port}/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return (json.loads(r.read().decode()),
+                        r.headers.get(TRACE_ID_RESPONSE_HEADER))
+
+        # warm both legs' compiles through door 1 so the timed burst
+        # (and its kill window) isn't dominated by first-call tracing;
+        # warmup trace_ids are distinct so the gates ignore them
+        post(doors[1], {"prompt": prompts[0], "max_new_tokens": MAX_NEW,
+                        "timeout_s": DEADLINE_S,
+                        "request_id": "warmup-0"}, DEADLINE_S)
+
+        def call(i):
+            body = {"prompt": prompts[i], "max_new_tokens": MAX_NEW,
+                    "timeout_s": DEADLINE_S,
+                    "request_id": f"burst-{i}"}
+            t0 = time.time()
+            attempt = 0
+            while True:
+                door = doors[attempt % 2]   # door 0 first, then flip
+                try:
+                    resp, tid = post(door, body,
+                                     max(1.0, t0 + DEADLINE_S
+                                         - time.time()))
+                    results[i] = {"resp": resp, "trace_id": tid,
+                                  "e2e_s": time.time() - t0}
+                    return
+                except Exception as err:
+                    attempt += 1
+                    if time.time() - t0 > DEADLINE_S:
+                        results[i] = err
+                        return
+                    time.sleep(0.25)
+
+        t0 = time.time()
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(N_REQUESTS)]
+        for t in threads:
+            t.start()
+            time.sleep(STAGGER_S)
+        for t in threads:
+            t.join(timeout=600)
+        burst_wall = time.time() - t0
+        assert not any(t.is_alive() for t in threads), "hung callers"
+        assert doors[0].proc.poll() is not None, (
+            "door 0 survived its SIGKILL injection")
+        failed = [i for i, r in results.items()
+                  if not isinstance(r, dict)]
+        assert not failed, (
+            f"failed requests: {[(i, results[i]) for i in failed]}")
+
+        # drain the orphans: the dead door's relays keep decoding on
+        # the replicas and must SEAL their spans before the sweep
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if all(rep.get_json("/stats").get("active", 1) == 0
+                   for rep in reps):
+                break
+            time.sleep(0.25)
+
+        leg_counts = {m.group(1): int(m.group(2)) for m in _re.finditer(
+            r'router_leg_seconds_count\{leg="(\w+)"\} (\d+)',
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{doors[1].port}/metrics",
+                timeout=10).read().decode())}
+    finally:
+        for p in list(doors) + list(reps):
+            try:
+                p.stop()
+            except Exception:
+                pass
+
+    # ---- the merge + the four gates ----
+    collector = TraceCollector()
+    for name in ("prefill", "decode", "door0", "door1"):
+        path = os.path.join(td, name, TRACE_FILE)
+        if os.path.exists(path):
+            collector.add_file(path)
+    assert collector.files_read == 4, (
+        f"expected 4 tier trace files, read {collector.files_read}")
+    merged = collector.merged()
+
+    # gate 1: every completed request -> exactly ONE merged trace,
+    # keyed by the deterministic request_id-derived trace_id that the
+    # front door's response header echoed back
+    expected = {i: TraceContext.for_request_id(f"burst-{i}").trace_id
+                for i in range(N_REQUESTS)}
+    bad_echo = [i for i in range(N_REQUESTS)
+                if results[i]["trace_id"] != expected[i]]
+    assert not bad_echo, (
+        f"response header trace_id mismatch on requests: {bad_echo}")
+    missing = [i for i in range(N_REQUESTS)
+               if expected[i] not in merged]
+    assert not missing, f"no merged trace for requests: {missing}"
+    burst = {i: merged[expected[i]] for i in range(N_REQUESTS)}
+
+    # gate 2: zero orphan spans — every span's parent produced a
+    # record, INCLUDING children of the SIGKILLed door (its write-ahead
+    # open records are the parents)
+    orphans = sum(len(t["orphans"]) for t in burst.values())
+    assert orphans == 0, (
+        f"{orphans} orphan spans: "
+        f"{[(i, t['orphans']) for i, t in burst.items() if t['orphans']]}")
+
+    # gate 3: the failover story is VISIBLE — >= 1 trace carries router
+    # spans from two distinct door nonces (door 0's unsealed open
+    # record + door 1's sealed relay), and the dead door left >= 1
+    # unsealed span for the merge to surface
+    def routers_of(trace):
+        return {s["attrs"].get("router") for s in trace["spans"]
+                if s["attrs"].get("service") == "router"} - {None}
+
+    two_door = [i for i, t in burst.items() if len(routers_of(t)) >= 2]
+    assert two_door, ("no trace shows both doors: the kill either hit "
+                      "an idle door or the open records were lost")
+    unsealed = sum(
+        1 for t in burst.values() for s in t["spans"]
+        if s["attrs"].get("service") == "router"
+        and s["terminal"] is None)
+    assert unsealed >= 1, "the SIGKILLed door left no unsealed span"
+    assert collector.superseded >= 1, (
+        "no open record was superseded by its sealed twin; the "
+        "write-ahead path is not exercising the merge fence")
+
+    # the disagg handoff is ONE trace: the prefill leg (a serve span
+    # finishing "prefilled") and the decode import leg (a serve span
+    # with imported_blocks) both sit under a single trace_id
+    def disagg_legs(trace):
+        serves = [s["attrs"] for s in trace["spans"]
+                  if s["attrs"].get("service") == "serve"]
+        return (any(a.get("finish_reason") == "prefilled"
+                    for a in serves)
+                and any(a.get("imported_blocks") for a in serves))
+
+    disagg_traces = [i for i, t in burst.items() if disagg_legs(t)]
+    assert disagg_traces, "no trace spans both disagg replicas"
+    assert leg_counts.get("prefill", 0) >= 1, leg_counts
+    assert leg_counts.get("decode", 0) >= 1, leg_counts
+
+    # gate 4: the span-union coverage accounts for the client-observed
+    # e2e within the documented bound (failover detect+re-POST and
+    # client->door network are the only permitted dark time)
+    gaps = {i: results[i]["e2e_s"] - coverage_s(burst[i])
+            for i in range(N_REQUESTS)}
+    max_gap = max(gaps.values())
+    assert max_gap <= GAP_BOUND_S, (
+        f"unaccounted e2e gap {max_gap:.3f}s exceeds the "
+        f"{GAP_BOUND_S}s bound: {sorted(gaps.items(), key=lambda kv: -kv[1])[:4]}")
+
+    out = {
+        "metric": "distributed_tracing_one_trace_per_request",
+        "value": len(burst),
+        "unit": "merged cross-tier traces for a 24-request disagg "
+                "burst surviving a router SIGKILL (exactly one per "
+                "completed request)",
+        "requests": N_REQUESTS,
+        "failed": 0,
+        "trace_files_merged": collector.files_read,
+        "spans_total": sum(len(t["spans"]) for t in burst.values()),
+        "orphan_spans": 0,
+        "header_echo_verified": True,
+        "failover_two_door_traces": len(two_door),
+        "unsealed_router_spans": unsealed,
+        "superseded_open_records": collector.superseded,
+        "torn_or_identityless_skipped": collector.skipped,
+        "disagg_two_replica_traces": len(disagg_traces),
+        "router_leg_counts": leg_counts,
+        "max_unaccounted_gap_s": round(max_gap, 3),
+        "gap_bound_s": GAP_BOUND_S,
+        "burst_wall_s": round(burst_wall, 3),
+        "num_devices": jax.device_count(),
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def run_serving_streaming_bench() -> int:
     """Streaming-serving gate (one JSON line -> PERF.json
     `streaming_serving`; docs/serving.md "Streaming & OpenAI
@@ -4295,6 +4644,8 @@ def main() -> int:
     if "--serving" in sys.argv:
         if "--router-ha" in sys.argv:
             return run_router_ha_bench()
+        if "--tracing" in sys.argv:
+            return run_distributed_tracing_bench()
         if "--paged-kv" in sys.argv:
             return run_paged_kv_bench()
         if "--disagg" in sys.argv:

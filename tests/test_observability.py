@@ -761,6 +761,22 @@ def test_metrics_names_rendered_and_documented():
     assert 'tier="router"' in doc, (
         "docs/observability.md lost the tier=router label description")
 
+    # the distributed-tracing families are pinned EXPLICITLY the same
+    # way (ISSUE 19 lint discipline): the per-leg router histograms on
+    # router /metrics — each must be rendered and documented; renaming
+    # either side without the other fails here. The leg label
+    # vocabulary is contract too, both directions: the router must
+    # build a histogram per leg and the doc must name every leg.
+    for fam in (_metrics.ROUTER_LEG_SECONDS,):
+        assert fam in rendered, f"tracing family unrendered: {fam}"
+        assert fam in doc_names, f"tracing family undocumented: {fam}"
+    router_src = inspect.getsource(router_mod)
+    for leg in ("prefill", "transfer", "decode", "relay"):
+        assert f'"{leg}"' in router_src, (
+            f"router lost the {leg} leg histogram")
+        assert f"`{leg}`" in doc, (
+            f"docs/observability.md lost the {leg} leg description")
+
     # the model-labeled partition is a rendered contract too: the serve
     # renderer must attach {model=...} labels somewhere (the per-model
     # block) and the doc must describe the label

@@ -331,6 +331,26 @@ def test_api_contract_pinned_against_docs():
         "router lost the portable cross-router progress key")
     assert '"request_id"' in router_src, (
         "router /generate lost the request_id body param")
+    # distributed-tracing surface (ISSUE 19): the trace header names
+    # are constants in observability.py, pinned against the doc's
+    # marked table AND against both front-door sources, both
+    # directions — renaming any side without the others fails here
+    from tony_tpu.observability import (TRACE_HEADER,
+                                        TRACE_ID_RESPONSE_HEADER)
+
+    doc_headers = set(re.findall(r"`(X-Tony-[A-Za-z-]+)`",
+                                 _doc_section(doc, "trace-headers")))
+    assert doc_headers == {TRACE_HEADER, TRACE_ID_RESPONSE_HEADER}, \
+        "trace header table drifted from observability.py constants"
+    for src, who in ((serve_src, "serve"), (router_src, "router")):
+        assert "TRACE_HEADER" in src, f"{who} lost X-Tony-Trace parsing"
+        assert "TRACE_ID_RESPONSE_HEADER" in src, (
+            f"{who} lost the X-Tony-Trace-Id response echo")
+        assert '"trace_id"' in src, (
+            f"{who} lost the SSE closing-frame trace_id field")
+    # the transfer entry carries the trace context (header-less
+    # imports must still land in the originating trace)
+    assert "trace" in KV_ENTRY_KEYS
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,542 @@
+"""Distributed request tracing (docs/observability.md "Distributed
+tracing").
+
+Contracts under test, bottom-up: the TraceContext identity rules
+(random roots, DETERMINISTIC request_id-derived trace ids, header
+adopt/malformed-reject, same-identity journal rebinding), the merge
+layer (TraceCollector: cross-host wall re-anchoring, topological skew
+repair, torn-line tolerance, the duplicate-span wall-clock fence,
+orphan surfacing, interval-union coverage), the ``tony-tpu trace``
+CLI over real files, the serve front door (header parse, journal
+persistence + recovery lineage, response-header / SSE closing-frame
+echo), and the router (header stamping on relays and both disagg
+legs, deterministic cross-door trace join, the open write-ahead
+record, per-leg histograms).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.cli.main import main as cli_main
+from tony_tpu.events.trace import (
+    TraceCollector,
+    coverage_s,
+    render_waterfall,
+)
+from tony_tpu.observability import (
+    TRACE_HEADER,
+    TRACE_ID_RESPONSE_HEADER,
+    RequestTrace,
+    TraceContext,
+)
+
+TINY_KW = dict(slots=2, max_len=64, block_size=4, prefill_chunk=8)
+
+
+# --------------------------------------------------------------------------
+# TraceContext: the identity rules (no model, no HTTP)
+# --------------------------------------------------------------------------
+
+def test_context_mint_and_header_roundtrip():
+    """A minted root has no parent; the header hop adopts the trace,
+    records the SENDER's span as parent, and mints a fresh span — the
+    one rule that makes every merged tree connect."""
+    root = TraceContext.mint()
+    assert root.parent_span_id is None
+    assert root.trace_id != root.span_id
+    hop = TraceContext.from_header(root.to_header())
+    assert hop.trace_id == root.trace_id
+    assert hop.parent_span_id == root.span_id
+    assert hop.span_id not in (root.span_id, root.trace_id)
+    # distinct mints never collide on either id
+    other = TraceContext.mint()
+    assert other.trace_id != root.trace_id
+
+
+def test_context_for_request_id_is_deterministic():
+    """The cross-door join: every shared-nothing door derives the SAME
+    trace_id from the same client request_id (and different ids give
+    different traces) — zero coordination, like the req:<id> progress
+    key."""
+    a1 = TraceContext.for_request_id("burst-7")
+    a2 = TraceContext.for_request_id("burst-7")
+    b = TraceContext.for_request_id("burst-8")
+    assert a1.trace_id == a2.trace_id != b.trace_id
+    # the trace id is stable across processes, so pin it
+    assert len(a1.trace_id) == 16
+    # spans stay fresh per door: same trace, different hop identity
+    assert a1.span_id != a2.span_id
+
+
+def test_context_from_header_rejects_malformed():
+    """Tracing must never 400 a request: any malformed header value
+    parses to None and the receiver mints a fresh root instead."""
+    for bad in (None, "", "nocolon", "UPPER123:abcdef12", "ab:cdef",
+                "abcdef12", "abcdef12:", ":abcdef12",
+                "abcdef12:ghijklmn", "a" * 33 + ":" + "b" * 16,
+                "abcdef12:abcd_f12"):
+        assert TraceContext.from_header(bad) is None, bad
+
+
+def test_context_from_dict_reuses_identity_child_is_fresh():
+    """from_dict returns the SAME span identity (journal recovery must
+    re-seal the dead attempt's span, not orphan a child under a parent
+    that never wrote); child() is the explicit new-hop path."""
+    ctx = TraceContext.from_header(TraceContext.mint().to_header())
+    back = TraceContext.from_dict(ctx.as_dict())
+    assert (back.trace_id, back.span_id, back.parent_span_id) == (
+        ctx.trace_id, ctx.span_id, ctx.parent_span_id)
+    kid = ctx.child()
+    assert kid.trace_id == ctx.trace_id
+    assert kid.parent_span_id == ctx.span_id
+    assert kid.span_id != ctx.span_id
+    assert TraceContext.from_dict(None) is None
+    assert TraceContext.from_dict({"trace_id": "ab"}) is None
+
+
+def test_request_trace_bind_rides_attrs():
+    """Trace identity rides RequestTrace.attrs (to_dict unchanged), so
+    every existing sink/record shape carries it for free."""
+    tr = RequestTrace(3)
+    ctx = TraceContext.mint()
+    assert tr.bind(ctx) is tr
+    assert tr.ctx is not None and tr.ctx.trace_id == ctx.trace_id
+    rec = tr.to_dict()
+    assert rec["attrs"]["trace_id"] == ctx.trace_id
+    assert rec["attrs"]["span_id"] == ctx.span_id
+    # unbound traces merge to nothing, not errors
+    assert RequestTrace(4).ctx is None
+
+
+# --------------------------------------------------------------------------
+# TraceCollector: the cross-host merge
+# --------------------------------------------------------------------------
+
+def _rec(tid, sid, parent, service, unix, spans, rid=1, **attrs):
+    a = {"trace_id": tid, "span_id": sid, "parent_span_id": parent,
+         "service": service, "submitted_unix": unix, **attrs}
+    return {"id": rid, "spans": [list(s) for s in spans], "attrs": a}
+
+
+def test_collector_merges_and_repairs_clock_skew(tmp_path):
+    """Two tiers on two (simulated) hosts, the child's wall anchor 1.2s
+    BEHIND its parent: the merge re-anchors each record by its own
+    submitted_unix, then shifts the skewed child forward to its
+    parent's start — causality beats wall clocks, and the shift is
+    surfaced as reanchored_s, never hidden."""
+    router = _rec("t1", "aaaa1111", None, "router", 1000.0,
+                  [["submitted", 50.0], ["routed", 50.1],
+                   ["finished", 52.0]], router="r0")
+    serve = _rec("t1", "bbbb2222", "aaaa1111", "serve", 998.8,
+                 [["submitted", 7.0], ["admitted", 7.1],
+                  ["finished", 8.5]], replica="rep0")
+    (tmp_path / "a").mkdir(), (tmp_path / "b").mkdir()
+    (tmp_path / "a" / "requests.trace.jsonl").write_text(
+        json.dumps(router) + "\n")
+    (tmp_path / "b" / "requests.trace.jsonl").write_text(
+        json.dumps(serve) + "\n")
+    col = TraceCollector()
+    col.add_file(tmp_path / "a" / "requests.trace.jsonl")
+    col.add_file(tmp_path / "b" / "requests.trace.jsonl")
+    traces = col.merged()
+    assert set(traces) == {"t1"}
+    t = traces["t1"]
+    assert [s["span_id"] for s in t["spans"]] == ["aaaa1111", "bbbb2222"]
+    assert t["orphans"] == []
+    parent, child = t["spans"]
+    assert parent["start"] == pytest.approx(1000.0)
+    # unskewed the child would start at 998.8 < 1000.0 — shifted
+    assert child["start"] == pytest.approx(parent["start"])
+    assert child["reanchored_s"] == pytest.approx(1.2)
+    assert child["end"] - child["start"] == pytest.approx(1.5)
+    # the waterfall renders both tiers and surfaces the shift
+    text = render_waterfall(t)
+    assert "router[r0]" in text and "serve[rep0]" in text
+    assert "reanchored+1.200s" in text
+
+
+def test_collector_fences_duplicate_span_pushes():
+    """The wall-clock fence for re-pushed span identities: a sealed
+    record supersedes the door's open write-ahead record regardless of
+    push order, and among equally-rich seals the newest submitted_unix
+    wins — a recovered attempt's re-seal never loses to a stale one."""
+    open_rec = _rec("t1", "aaaa1111", None, "router", 1000.0,
+                    [["submitted", 1.0]])
+    sealed = _rec("t1", "aaaa1111", None, "router", 1000.0,
+                  [["submitted", 1.0], ["finished", 2.0]])
+    col = TraceCollector()
+    col.add_record(sealed)
+    col.add_record(open_rec)        # arrives late: still loses
+    assert col.superseded == 1
+    t = col.merged()["t1"]
+    assert len(t["spans"]) == 1 and t["spans"][0]["terminal"] == "finished"
+    # equally rich: newer wall anchor wins
+    newer = _rec("t2", "cccc3333", None, "serve", 2000.0,
+                 [["submitted", 1.0], ["finished", 2.0]], marker="new")
+    older = _rec("t2", "cccc3333", None, "serve", 1990.0,
+                 [["submitted", 1.0], ["finished", 2.0]], marker="old")
+    col2 = TraceCollector()
+    col2.add_record(older)
+    col2.add_record(newer)
+    assert col2.merged()["t2"]["spans"][0]["attrs"]["marker"] == "new"
+    col3 = TraceCollector()
+    col3.add_record(newer)
+    col3.add_record(older)          # order-independent
+    assert col3.merged()["t2"]["spans"][0]["attrs"]["marker"] == "new"
+
+
+def test_collector_tolerates_torn_lines_and_identityless(tmp_path):
+    """A crash mid-append tears one line; pre-tracing records carry no
+    trace identity. Neither hides the other requests' spans and both
+    are counted, not raised."""
+    good = _rec("t1", "aaaa1111", None, "serve", 1000.0,
+                [["submitted", 0.0], ["finished", 1.0]])
+    legacy = {"id": 9, "spans": [["submitted", 0.0], ["finished", 1.0]],
+              "attrs": {"submitted_unix": 1000.0}}
+    path = tmp_path / "requests.trace.jsonl"
+    path.write_text(json.dumps(legacy) + "\n"
+                    + '{"id": 3, "spans": [["subm'  # torn by SIGKILL
+                    + "\n" + json.dumps(good) + "\n")
+    col = TraceCollector()
+    col.add_file(path)
+    col.add_file(tmp_path / "never-written.trace.jsonl")  # no-op
+    assert col.files_read == 1 and col.skipped == 1
+    traces = col.merged()
+    assert set(traces) == {"t1"}
+    assert len(traces["t1"]["spans"]) == 1
+
+
+def test_collector_surfaces_orphans_and_coverage():
+    """A span whose parent never produced a record is an orphan —
+    surfaced, never dropped (the bench gate asserts zero of these);
+    coverage is the UNION of span intervals so overlapping legs don't
+    double count."""
+    col = TraceCollector()
+    col.add_record(_rec("t1", "aaaa1111", None, "router", 1000.0,
+                        [["submitted", 0.0], ["finished", 4.0]]))
+    col.add_record(_rec("t1", "bbbb2222", "aaaa1111", "serve", 1000.5,
+                        [["submitted", 0.0], ["finished", 2.0]]))
+    col.add_record(_rec("t1", "dddd4444", "gone0000", "serve", 1001.0,
+                        [["submitted", 0.0], ["finished", 1.0]]))
+    t = col.merged()["t1"]
+    assert t["orphans"] == ["dddd4444"]
+    assert "orphans: dddd4444" in render_waterfall(t)
+    # intervals: [1000,1004] ∪ [1000.5,1002.5] ∪ [1001,1002] = 4.0
+    assert coverage_s(t) == pytest.approx(4.0)
+    # disjoint intervals sum
+    assert coverage_s({"spans": [
+        {"start": 0.0, "end": 1.0}, {"start": 3.0, "end": 4.5},
+    ]}) == pytest.approx(2.5)
+
+
+def test_cli_trace_lists_and_renders(tmp_path, capsys):
+    """``tony-tpu trace`` end to end over real files: the bare listing
+    is slowest-first with failure/orphan flags, the id view prints the
+    waterfall, and unknown ids / empty dirs exit 1 with a reason."""
+    slow = _rec("aaaa0000aaaa0000", "aaaa1111", None, "router", 1000.0,
+                [["submitted", 0.0], ["finished", 5.0]], router="r0")
+    fast = _rec("bbbb0000bbbb0000", "bbbb1111", None, "serve", 1000.0,
+                [["submitted", 0.0], ["failed", 0.5]])
+    d = tmp_path / "tier"
+    d.mkdir()
+    (d / "requests.trace.jsonl").write_text(
+        json.dumps(slow) + "\n" + json.dumps(fast) + "\n")
+    # task traces are a different granularity: never merged in
+    (d / "tasks.trace.jsonl").write_text(json.dumps(slow) + "\n")
+    assert cli_main(["trace", "--dir", str(d)]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out[0].startswith("aaaa0000aaaa0000")     # slowest first
+    assert "FAILED" in out[1]
+    assert cli_main(["trace", "aaaa0000aaaa0000", "--dir", str(d)]) == 0
+    assert "router[r0]" in capsys.readouterr().out
+    assert cli_main(["trace", "zzzz", "--dir", str(d)]) == 1
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli_main(["trace", "--dir", str(empty)]) == 1
+
+
+# --------------------------------------------------------------------------
+# serve front door: header parse, journal lineage, response echo
+# --------------------------------------------------------------------------
+
+from tony_tpu.models import transformer  # noqa: E402
+
+TINY = transformer.TransformerConfig(
+    vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq_len=128, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init(jax.random.PRNGKey(0), TINY)
+
+
+def _prompt(n, seed=3):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, TINY.vocab_size), np.int32)
+
+
+def _http_app(params, **kw):
+    from tony_tpu.cli.serve import ServeApp, make_handler
+    from tony_tpu.models.serving import SlotServer
+
+    for k, v in TINY_KW.items():
+        kw.setdefault(k, v)
+    records = []
+    srv = SlotServer(params, TINY, trace_sink=records.append, **kw)
+    app = ServeApp(srv)
+    app.start()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(app))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return srv, app, httpd, httpd.server_address[1], records
+
+
+def test_serve_front_door_trace_contract(params):
+    """The serve door end to end: an inbound X-Tony-Trace is adopted
+    (sender's span becomes the parent, fresh span minted), echoed back
+    as X-Tony-Trace-Id on the buffered response AND as the closing
+    SSE frame's trace_id, and the sealed trace record carries the full
+    identity; a header-less request mints its own root."""
+    srv, app, httpd, port, records = _http_app(params)
+    try:
+        sender = TraceContext.mint()
+        prompt = [int(t) for t in _prompt(5, seed=11)]
+        body = json.dumps({"prompt": prompt, "max_new_tokens": 4}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: sender.to_header()})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers[TRACE_ID_RESPONSE_HEADER] == sender.trace_id
+            json.loads(r.read().decode())
+        deadline = time.monotonic() + 30
+        while not records and time.monotonic() < deadline:
+            time.sleep(0.02)
+        attrs = records[-1]["attrs"]
+        assert attrs["trace_id"] == sender.trace_id
+        assert attrs["parent_span_id"] == sender.span_id
+        assert attrs["span_id"] != sender.span_id
+        assert attrs["service"] == "serve"
+        # SSE: the closing frame carries the trace id (headers are
+        # long gone by then); malformed inbound header -> fresh root
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate?stream=true", data=body,
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: "NOT A:HEADER"})
+        frames = []
+        with urllib.request.urlopen(req, timeout=120) as r:
+            for raw in r:
+                line = raw.decode().strip()
+                if line.startswith("data: "):
+                    frames.append(json.loads(line[len("data: "):]))
+        final = frames[-1]
+        assert final["finish_reason"] == "length"
+        assert final["trace_id"] not in ("", None, sender.trace_id)
+        # /v1 buffered responses echo the id too
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": prompt, "max_tokens": 3}).encode(),
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: sender.to_header()})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers[TRACE_ID_RESPONSE_HEADER] == sender.trace_id
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
+def test_journal_preserves_trace_identity_across_recovery(
+        tmp_path, params):
+    """SIGKILL lineage: the trace context persists into the journal
+    (through compaction), and the recovered request re-binds the dead
+    attempt's EXACT span identity — the merged trace shows one span
+    with recovered_from lineage, never an orphaned child of a span
+    nobody sealed."""
+    from tony_tpu.events.journal import JOURNAL_FILE, RequestJournal
+    from tony_tpu.models.serving import Request, SlotServer
+
+    path = tmp_path / JOURNAL_FILE
+    ctx = TraceContext.from_header(TraceContext.mint().to_header())
+    srv1 = SlotServer(params, TINY, journal=RequestJournal(path),
+                      **TINY_KW)
+    req = Request(prompt=_prompt(4, seed=21), max_new_tokens=20,
+                  trace=ctx)
+    srv1.submit(req)
+    for _ in range(2):
+        srv1.step()
+    srv1.drain_completed()          # prefix journaled; then "SIGKILL"
+    j2, entries = RequestJournal.recover(path)
+    assert len(entries) == 1
+    assert entries[0].trace == ctx.as_dict(), (
+        "trace context lost by the journal round-trip/compaction")
+    sunk = []
+    srv2 = SlotServer(params, TINY, journal=j2, trace_sink=sunk.append,
+                      **TINY_KW)
+    assert srv2.recover_journal(entries) == 1
+    done = srv2.run_until_drained()
+    (comp,) = done.values()
+    attrs = comp.trace["attrs"]
+    assert attrs["recovered_from"] == req.id
+    assert attrs["trace_id"] == ctx.trace_id
+    assert attrs["span_id"] == ctx.span_id, (
+        "recovery must reuse the dead attempt's span identity")
+    assert attrs["parent_span_id"] == ctx.parent_span_id
+    srv1.shutdown()
+    srv2.shutdown()
+
+
+# --------------------------------------------------------------------------
+# router: header stamping, cross-door join, open record, leg histograms
+# --------------------------------------------------------------------------
+
+class _TraceStub:
+    """Header-recording fake replica: /generate answers one token (or
+    a prefill handoff), /kv/import answers a decode completion; every
+    POST's headers land in .post_headers by path."""
+
+    def __init__(self, role=None, handoff=None):
+        self.role = role
+        self.handoff = handoff
+        self.post_headers = []          # (path, headers-dict) pairs
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, {"healthy": True})
+                elif self.path == "/stats":
+                    payload = {"queued": 0, "active": 0, "slots": 2,
+                               "max_queue": 0, "retry_after_s": 1}
+                    if stub.role is not None:
+                        payload["role"] = stub.role
+                    self._send(200, payload)
+                else:
+                    self._send(200, {})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                json.loads(self.rfile.read(n) or b"{}")
+                path = self.path.partition("?")[0]
+                stub.post_headers.append((path, dict(self.headers)))
+                if path == "/kv/import":
+                    self._send(200, {"id": 1, "tokens": [7, 8],
+                                     "finish_reason": "length"})
+                elif stub.role == "prefill":
+                    resp = {"id": 1, "tokens": [],
+                            "finish_reason": "prefilled"}
+                    if stub.handoff is not None:
+                        resp["handoff"] = stub.handoff
+                    self._send(200, resp)
+                else:
+                    self._send(200, {"id": 1, "tokens": [5],
+                                     "finish_reason": "length"})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def headers_for(self, path):
+        return [h for p, h in self.post_headers if p == path]
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_router_stamps_header_writes_open_record_and_legs():
+    """One classic relay: the replica receives X-Tony-Trace carrying
+    the ROUTER's span (so the replica's span parents under it), a
+    request_id derives the deterministic cross-door trace_id, the
+    sink sees the open write-ahead record BEFORE the sealed terminal
+    (same span identity — a SIGKILLed door still leaves its relay
+    span), and router_leg_seconds{leg="relay"} observes the hop."""
+    from tony_tpu.router import FleetRouter
+
+    stub = _TraceStub()
+    sunk = []
+    router = FleetRouter([("r0", "127.0.0.1", stub.port)], seed=0,
+                         stats_every=1, trace_sink=sunk.append)
+    try:
+        router.health_tick()
+        resp = router.generate([1, 2, 3], max_new_tokens=1, timeout_s=5,
+                               request_id="req-42")
+        assert resp["finish_reason"] == "length"
+        (hdrs,) = stub.headers_for("/generate")
+        got = TraceContext.from_header(hdrs.get(TRACE_HEADER))
+        assert got is not None, "router did not stamp X-Tony-Trace"
+        want = TraceContext.for_request_id("req-42")
+        assert got.trace_id == want.trace_id, (
+            "request_id must derive the deterministic trace_id")
+        assert len(sunk) == 2, "expected open + sealed records"
+        opened, sealed = sunk
+        assert opened["attrs"]["span_id"] == sealed["attrs"]["span_id"]
+        assert opened["spans"][-1][0] not in ("finished", "failed")
+        assert sealed["spans"][-1][0] == "finished"
+        # the replica parents under the router's span
+        assert hdrs[TRACE_HEADER].endswith(sealed["attrs"]["span_id"])
+        assert sealed["attrs"]["service"] == "router"
+        assert sealed["attrs"]["leg_relay_s"] >= 0
+        # the merge fences the open record under the sealed one
+        col = TraceCollector()
+        for rec in sunk:
+            col.add_record(rec)
+        assert col.superseded == 1
+        text = router.prometheus_metrics()
+        assert 'router_leg_seconds_bucket{leg="relay"' in text
+        assert 'router_leg_seconds_count{leg="relay"} 1' in text
+    finally:
+        router.shutdown()
+        stub.close()
+
+
+def test_router_disagg_legs_share_one_trace():
+    """The disaggregated split: prefill POST and /kv/import handoff
+    both carry the SAME X-Tony-Trace value (one router span fathering
+    both legs), and the prefill/decode leg histograms observe — the
+    request is one story across three processes."""
+    from tony_tpu.router import FleetRouter
+
+    handoff = {"version": 1, "entry": {"id": 5, "prompt": [1, 2]}}
+    pre = _TraceStub(role="prefill", handoff=handoff)
+    dec = _TraceStub(role="decode")
+    router = FleetRouter([("pre", "127.0.0.1", pre.port),
+                          ("dec", "127.0.0.1", dec.port)],
+                         seed=0, stats_every=1)
+    try:
+        router.health_tick()
+        resp = router.generate([1, 2, 3, 4], max_new_tokens=2,
+                               timeout_s=5)
+        assert resp["tokens"] == [7, 8]
+        (pre_hdrs,) = pre.headers_for("/generate")
+        (imp_hdrs,) = dec.headers_for("/kv/import")
+        assert pre_hdrs.get(TRACE_HEADER) is not None
+        assert pre_hdrs[TRACE_HEADER] == imp_hdrs[TRACE_HEADER]
+        text = router.prometheus_metrics()
+        assert 'router_leg_seconds_count{leg="prefill"} 1' in text
+        assert 'router_leg_seconds_count{leg="decode"} 1' in text
+    finally:
+        router.shutdown()
+        pre.close()
+        dec.close()

@@ -373,7 +373,8 @@ def completion_chunk(rid, model: str, tokens, codec: TokenCodec,
 
 
 def stream_frame_fns(rid, model: str, codec: TokenCodec, chat: bool,
-                     skip: int = 0, collect: list | None = None):
+                     skip: int = 0, collect: list | None = None,
+                     trace_id: str | None = None):
     """The three byte-builders one /v1 SSE relay needs — shared by the
     serve and router front doors so the framing can't drift between
     them: ``frame(tokens)`` per delta (the first chat delta carries the
@@ -387,7 +388,11 @@ def stream_frame_fns(rid, model: str, codec: TokenCodec, chat: bool,
     tokens are withheld (the engine re-emits the teacher-forced resume
     prefix; the client saw it). ``collect`` (when given) accumulates
     every token the stream carried — resume prefix included — so the
-    caller can park it for the NEXT reconnect at disconnect."""
+    caller can park it for the NEXT reconnect at disconnect.
+    ``trace_id`` (when given) rides the CLOSING chunk only — the
+    distributed-tracing echo for streamed /v1 clients, mirroring the
+    buffered path's X-Tony-Trace-Id response header (streaming headers
+    are sent before the id is worth echoing mid-retry)."""
     from .stream import SSE_DONE, sse_frame
 
     first = {"v": True}
@@ -416,6 +421,8 @@ def stream_frame_fns(rid, model: str, codec: TokenCodec, chat: bool,
                           first=first["v"]) if chat
                else completion_chunk(rid, model, [], codec,
                                      finish_reason=reason))
+        if trace_id is not None:
+            obj["trace_id"] = trace_id
         return sse_frame(obj, event_id=f"{rid}:{seen['n']}") + SSE_DONE
 
     def err(msg):

@@ -9,6 +9,8 @@ against the local mini-cluster), NotebookSubmitter (NotebookSubmitter.java:139
     tony-tpu local    --command "python train.py" [--instances N]
     tony-tpu notebook --command "jupyter lab --port {port}"
     tony-tpu history  [--port P]      # portal over the history dir
+    tony-tpu trace    [TRACE_ID] --dir D [--dir D2 ...]   # merged
+                                      # cross-tier request waterfall
 """
 
 from __future__ import annotations
@@ -120,6 +122,62 @@ def cmd_history(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Ops view of one distributed request: sweep every ``--dir`` for
+    per-tier ``*.trace.jsonl`` files (task traces excluded — different
+    granularity), merge them by trace_id, and print the cross-tier
+    waterfall — or, with no TRACE_ID, list the merged traces slowest
+    first so the id worth looking at is one command away. Doubles as
+    the merge path's e2e harness in the tests."""
+    from pathlib import Path
+
+    from ..events.trace import (TASK_TRACE_FILE, TraceCollector,
+                                render_waterfall)
+
+    collector = TraceCollector()
+    for d in args.dir:
+        root = Path(d)
+        if root.is_file():
+            collector.add_file(root)
+            continue
+        for path in sorted(root.rglob("*.trace.jsonl")):
+            if path.name == TASK_TRACE_FILE:
+                continue
+            collector.add_file(path)
+    traces = collector.merged()
+    if collector.files_read == 0:
+        print("no trace files found under the given --dir(s)",
+              file=sys.stderr)
+        return 1
+    if args.trace_id:
+        trace = traces.get(args.trace_id)
+        if trace is None:
+            print(f"trace {args.trace_id} not found "
+                  f"({len(traces)} traces in {collector.files_read} "
+                  "files)", file=sys.stderr)
+            return 1
+        print(render_waterfall(trace))
+        return 0
+    if not traces:
+        print("no trace-context records in the given --dir(s) "
+              "(pre-tracing files merge to nothing)", file=sys.stderr)
+        return 1
+    rows = sorted(
+        traces.values(),
+        key=lambda t: max(s["end"] for s in t["spans"])
+        - min(s["start"] for s in t["spans"]),
+        reverse=True)
+    for t in rows:
+        dur = (max(s["end"] for s in t["spans"])
+               - min(s["start"] for s in t["spans"]))
+        terminals = [s["terminal"] for s in t["spans"]]
+        bad = any(x in ("failed", "shed", "expired") for x in terminals)
+        print(f"{t['trace_id']}  {dur:8.3f}s  {len(t['spans'])} spans"
+              + (f"  orphans={len(t['orphans'])}" if t["orphans"] else "")
+              + ("  FAILED" if bad else ""))
+    return 0
+
+
 _last_printed: dict[str, str] = {}
 _url_printed: set = set()
 
@@ -166,6 +224,19 @@ def main(argv=None) -> int:
     _add_common(p)
     p.add_argument("--port", type=int, default=19886)
     p.set_defaults(fn=cmd_history)
+
+    p = sub.add_parser(
+        "trace",
+        help="print one distributed request's merged cross-tier "
+             "waterfall (or list merged traces, slowest first)")
+    p.add_argument("trace_id", nargs="?", default=None,
+                   help="the X-Tony-Trace-Id a front door echoed; omit "
+                        "to list every merged trace")
+    p.add_argument("--dir", action="append", required=True,
+                   help="a tier's --trace-dir (or one *.trace.jsonl "
+                        "file), repeatable — give every tier's dir for "
+                        "a complete merge")
+    p.set_defaults(fn=cmd_trace)
 
     # `serve`/`route`/`driver` own rich argparsers of their own
     # (cli/serve.py, router.py, driver.py); hand the remaining argv

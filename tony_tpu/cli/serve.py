@@ -770,7 +770,8 @@ class ServeApp:
                      stream=None,
                      stop: list | None = None,
                      logprobs: int = 0,
-                     priority: str = "interactive"):
+                     priority: str = "interactive",
+                     trace=None):
         """Admission half of generate(): returns (request_id, event). The
         request carries ``timeout`` as its queue deadline — if it is
         still queued when the waiter would have given up, admission skips
@@ -795,6 +796,7 @@ class ServeApp:
                       deadline=time.monotonic() + timeout,
                       stop=stop, logprobs=int(logprobs or 0),
                       priority=str(priority or "interactive"),
+                      trace=trace,
                       model=getattr(engine, "model", None)
                       if model is not None else None)
         ev = threading.Event()
@@ -903,7 +905,7 @@ class ServeApp:
             return bool(callable(srv_cancel) and srv_cancel(request_id))
 
     def import_async(self, payload: dict, timeout: float = 600.0,
-                     stream=None):
+                     stream=None, trace=None):
         """Admission half of the KV-transfer decode leg (POST
         /kv/import): install a prefill replica's exported blocks into
         the matching engine and register a waiter exactly like
@@ -926,7 +928,12 @@ class ServeApp:
             if not callable(imp):
                 raise ValueError(
                     "this engine does not support KV import")
-            rid = imp(payload)      # ValueError/QueueFullError propagate
+            # keyword only when set: engines/test stubs predating the
+            # trace kwarg keep working header-less
+            if trace is not None:
+                rid = imp(payload, trace=trace)
+            else:
+                rid = imp(payload)  # ValueError/QueueFullError propagate
             ev = threading.Event()
             self._events[rid] = ev
             self._rid_engine[rid] = engine
@@ -1538,6 +1545,16 @@ def make_handler(app: ServeApp, codec=None):
             self.end_headers()
             self.wfile.write(body)
 
+        def _trace_ctx(self):
+            """This hop's distributed-trace context: adopt the inbound
+            X-Tony-Trace header (a router stamped it), else mint a root
+            — serve is a front door too (docs/observability.md
+            'Distributed tracing')."""
+            from ..observability import TRACE_HEADER, TraceContext
+
+            ctx = TraceContext.from_header(self.headers.get(TRACE_HEADER))
+            return ctx if ctx is not None else TraceContext.mint()
+
         def _client_gone(self) -> bool:
             """True when the client hung up while we wait on its
             completion — a peeked EOF on the connection. A client with
@@ -1731,8 +1748,9 @@ def make_handler(app: ServeApp, codec=None):
                     from ..api.stream import TokenStream
 
                     ts = TokenStream()
+                ctx = self._trace_ctx()
                 rid, ev = app.import_async(payload, timeout=timeout,
-                                           stream=ts)
+                                           stream=ts, trace=ctx)
             except QueueFullError as e:
                 ra = getattr(e, "retry_after_s", 0)
                 self._send(429, {"error": str(e)}, headers={
@@ -1762,7 +1780,8 @@ def make_handler(app: ServeApp, codec=None):
                 def final(reason):
                     return sse_frame(
                         {"id": rid, "finish_reason": reason,
-                         "n_tokens": seen["n"]},
+                         "n_tokens": seen["n"],
+                         "trace_id": ctx.trace_id},
                         event_id=f"{rid}:{seen['n']}")
 
                 def err(msg):
@@ -1791,9 +1810,12 @@ def make_handler(app: ServeApp, codec=None):
             except TimeoutError as e:
                 self._send(504, {"error": str(e)})
                 return
+            from ..observability import TRACE_ID_RESPONSE_HEADER
+
             body = {"id": comp.id, "tokens": comp.tokens,
                     "finish_reason": comp.finish_reason}
-            self._send(200, body)
+            self._send(200, body, headers={
+                TRACE_ID_RESPONSE_HEADER: ctx.trace_id})
 
         def _post_generate(self):
             from ..models.serving import QueueFullError
@@ -1881,6 +1903,7 @@ def make_handler(app: ServeApp, codec=None):
                             resume = prev
                             skip = min(lei[1], len(prev))
                     ts = TokenStream()
+                ctx = self._trace_ctx()
                 rid, ev = app.submit_async(
                     prompt, max_new, timeout=timeout,
                     temperature=None if temp is None else float(temp),
@@ -1888,7 +1911,7 @@ def make_handler(app: ServeApp, codec=None):
                     cache_prompt=cache_prompt,
                     resume_tokens=resume, progress_key=progress_key,
                     model=model, stream=ts, stop=stop,
-                    logprobs=logprobs, priority=priority)
+                    logprobs=logprobs, priority=priority, trace=ctx)
             except QueueFullError as e:
                 # shed: the queue is full. 429 + Retry-After is the
                 # load-balancer contract — retry elsewhere/later instead
@@ -1938,7 +1961,8 @@ def make_handler(app: ServeApp, codec=None):
                 def final(reason):
                     return sse_frame(
                         {"id": rid, "finish_reason": reason,
-                         "n_tokens": max(0, seen["n"] - skip)},
+                         "n_tokens": max(0, seen["n"] - skip),
+                         "trace_id": ctx.trace_id},
                         event_id=f"{rid}:{seen['n']}")
 
                 def err(msg):
@@ -1982,6 +2006,8 @@ def make_handler(app: ServeApp, codec=None):
                            headers={"Retry-After":
                                     str(app.retry_after_s())})
                 return
+            from ..observability import TRACE_ID_RESPONSE_HEADER
+
             body = {"id": comp.id, "tokens": comp.tokens,
                     "finish_reason": comp.finish_reason}
             if comp.logprobs is not None:
@@ -1995,7 +2021,8 @@ def make_handler(app: ServeApp, codec=None):
                     body["handoff"] = app.export_payload(comp.id)
                 except KeyError:
                     pass
-            self._send(200, body)
+            self._send(200, body, headers={
+                TRACE_ID_RESPONSE_HEADER: ctx.trace_id})
 
         def _oai_error(self, code: int, message: str, etype: str) -> None:
             self._send(code, {"error": {"message": message,
@@ -2035,6 +2062,7 @@ def make_handler(app: ServeApp, codec=None):
                         resume = prev
                         skip = min(lei[1], len(prev))
                 ts = TokenStream()
+            ctx = self._trace_ctx()
             try:
                 rid, ev = app.submit_async(
                     req["prompt_tokens"], req["max_new_tokens"],
@@ -2045,7 +2073,8 @@ def make_handler(app: ServeApp, codec=None):
                     model=req["model"], stream=ts,
                     stop=req.get("stop_sequences"),
                     logprobs=req.get("logprobs", 0),
-                    priority=req.get("priority") or "interactive")
+                    priority=req.get("priority") or "interactive",
+                    trace=ctx)
             except QueueFullError as e:
                 ra = getattr(e, "retry_after_s", 0)
                 self._send(429, {"error": {"message": str(e),
@@ -2068,7 +2097,7 @@ def make_handler(app: ServeApp, codec=None):
                 got: list = []
                 frame, final, err = oai.stream_frame_fns(
                     rid, model_name, codec, chat, skip=skip,
-                    collect=got)
+                    collect=got, trace_id=ctx.trace_id)
                 self._begin_sse()
                 self._relay_sse(
                     rid, ts, time.monotonic() + req["timeout_s"],
@@ -2103,10 +2132,13 @@ def make_handler(app: ServeApp, codec=None):
                     "type": "rate_limit_error"}},
                     headers={"Retry-After": str(app.retry_after_s())})
                 return
+            from ..observability import TRACE_ID_RESPONSE_HEADER
+
             build = oai.chat_response if chat else oai.completion_response
             self._send(200, build(comp.id, model_name, comp.tokens,
                                   comp.finish_reason, n_prompt, codec,
-                                  logprobs=comp.logprobs))
+                                  logprobs=comp.logprobs),
+                       headers={TRACE_ID_RESPONSE_HEADER: ctx.trace_id})
 
     return Handler
 

@@ -87,6 +87,11 @@ class JournalEntry:
     # request keeps its class budget/shedding behavior — every
     # pre-priority journal record reads back as interactive
     priority: str = "interactive"
+    # distributed-trace identity (observability.TraceContext.as_dict()):
+    # recovery resubmits with the SAME span identity so a SIGKILLed
+    # attempt's children are never orphaned — the cross-tier trace
+    # survives process death with the rest of the replay state
+    trace: dict | None = None
 
 
 class RequestJournal:
@@ -136,7 +141,8 @@ class RequestJournal:
                model: str | None = None,
                stop: list | None = None,
                logprobs: int = 0,
-               priority: str = "interactive") -> None:
+               priority: str = "interactive",
+               trace: dict | None = None) -> None:
         """Open an entry for a newly accepted request. ``emitted``
         pre-seeds the record for resumed requests (router failover /
         journal recovery) so a second failure replays from the full
@@ -150,7 +156,8 @@ class RequestJournal:
             temperature=temperature, top_k=top_k, cache_prompt=cache_prompt,
             seed=seed, emitted=emitted, deadline=deadline, model=model,
             stop=stop, logprobs=int(logprobs or 0),
-            priority=str(priority or "interactive"))
+            priority=str(priority or "interactive"),
+            trace=dict(trace) if trace else None)
         with self._lock:
             self._entries[rid] = entry
         self._append({"op": "submit", "id": rid, "prompt": prompt,
@@ -159,7 +166,8 @@ class RequestJournal:
                       "cache_prompt": cache_prompt, "seed": seed,
                       "model": model, "stop": stop,
                       "logprobs": int(logprobs or 0),
-                      "priority": str(priority or "interactive")})
+                      "priority": str(priority or "interactive"),
+                      "trace": entry.trace})
         if emitted:
             self._append({"op": "emit", "id": rid, "tokens": emitted})
 
@@ -217,7 +225,9 @@ class RequestJournal:
                              "seed": e.seed,
                              "model": e.model,
                              "stop": e.stop,
-                             "logprobs": e.logprobs}) + "\n")
+                             "logprobs": e.logprobs,
+                             "priority": e.priority,
+                             "trace": e.trace}) + "\n")
                         if e.emitted:
                             f.write(json.dumps(
                                 {"op": "emit", "id": e.id,
@@ -311,7 +321,10 @@ def read_journal(path: str | Path) -> list[JournalEntry]:
                         stop=rec.get("stop"),
                         logprobs=int(rec.get("logprobs", 0) or 0),
                         priority=str(rec.get("priority")
-                                     or "interactive"))
+                                     or "interactive"),
+                        trace=(rec.get("trace")
+                               if isinstance(rec.get("trace"), dict)
+                               else None))
                 elif op == "emit":
                     entry = entries.get(rid)
                     if entry is not None:

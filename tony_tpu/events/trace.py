@@ -79,3 +79,229 @@ def read_traces(path: str | Path) -> list[dict]:
             except ValueError:
                 log.warning("skipping malformed trace line in %s", path)
     return out
+
+
+# terminal span names (observability.TERMINAL_SPANS, duplicated here so
+# the merge layer stays import-light for the CLI/portal paths)
+_TERMINALS = ("finished", "cancelled", "expired", "shed", "failed")
+
+
+class TraceCollector:
+    """Merge per-tier trace files into per-trace_id span trees.
+
+    Every tier of the serving path (router doors, prefill specialists,
+    decode replicas) writes its own ``requests.trace.jsonl`` on its own
+    host. Each record is self-anchoring — monotonic span instants plus
+    an ``attrs.submitted_unix`` wall anchor — so the collector can
+    re-anchor every record onto one wall-clock timeline without any
+    cross-host clock protocol (the PR 5 clock discipline, applied at
+    merge time): ``wall(t) = submitted_unix + (t - spans[0].t)``.
+
+    Discipline applied per record:
+
+    - records without a bound trace identity (``attrs.trace_id`` /
+      ``span_id``) are ignored — pre-tracing files merge to nothing
+      rather than erroring;
+    - duplicate pushes of the SAME (trace_id, span_id) — a door's
+      write-ahead OPEN record later sealed, or a journal-recovered
+      attempt re-sealing a span the dead process already wrote — are
+      fenced at merge time: terminal beats open, more events beats
+      fewer, newer ``submitted_unix`` beats older;
+    - cross-host clock skew that makes a child START before its parent
+      is repaired topologically: the child's whole timeline (and its
+      subtree's) shifts forward to its parent's start, recorded as
+      ``reanchored_s`` — skew shifts spans, it must never reorder
+      causality;
+    - a span naming a ``parent_span_id`` absent from the merged set is
+      an ORPHAN — surfaced per trace, never silently dropped (the
+      zero-orphans bench gate reads this).
+    """
+
+    def __init__(self):
+        # (trace_id, span_id) -> winning raw record
+        self._records: dict[tuple[str, str], dict] = {}
+        self.files_read = 0
+        self.skipped = 0        # records without trace identity
+        self.superseded = 0     # duplicate span pushes fenced out
+
+    # ------------------------------------------------------------ intake
+    def add_file(self, path: str | Path) -> None:
+        """Ingest one tier's trace JSONL (torn lines already skipped by
+        ``read_traces``; a missing file is a no-op — a SIGKILLed tier
+        may never have created one)."""
+        path = Path(path)
+        if not path.exists():
+            return
+        self.files_read += 1
+        for rec in read_traces(path):
+            self.add_record(rec)
+
+    def add_record(self, rec: dict) -> None:
+        attrs = rec.get("attrs")
+        spans = rec.get("spans")
+        if not isinstance(attrs, dict) or not isinstance(spans, list) \
+                or not spans:
+            self.skipped += 1
+            return
+        tid, sid = attrs.get("trace_id"), attrs.get("span_id")
+        if not isinstance(tid, str) or not isinstance(sid, str):
+            self.skipped += 1
+            return
+        key = (tid, sid)
+        prev = self._records.get(key)
+        if prev is None:
+            self._records[key] = rec
+            return
+        self.superseded += 1
+        if self._richer(rec, prev):
+            self._records[key] = rec
+
+    @staticmethod
+    def _is_terminal(rec: dict) -> bool:
+        spans = rec.get("spans") or []
+        return bool(spans) and spans[-1][0] in _TERMINALS
+
+    @classmethod
+    def _richer(cls, a: dict, b: dict) -> bool:
+        """The merge-time wall-clock fence: does record ``a`` supersede
+        ``b`` for the same span identity?"""
+        ta, tb = cls._is_terminal(a), cls._is_terminal(b)
+        if ta != tb:
+            return ta
+        na, nb = len(a.get("spans") or ()), len(b.get("spans") or ())
+        if na != nb:
+            return na > nb
+        wa = float((a.get("attrs") or {}).get("submitted_unix") or 0)
+        wb = float((b.get("attrs") or {}).get("submitted_unix") or 0)
+        return wa > wb
+
+    # ------------------------------------------------------------- merge
+    def merged(self) -> dict:
+        """trace_id -> {"trace_id", "spans": [...], "orphans": [...]}.
+
+        Each span node::
+
+            {"span_id", "parent_span_id", "id", "service", "start",
+             "end", "terminal", "reanchored_s", "events": [[name, wall]],
+             "attrs": {...}}
+
+        Spans are wall-ordered (parents repaired first — see class
+        docstring); ``orphans`` lists span_ids whose parent never
+        produced a record."""
+        traces: dict[str, dict] = {}
+        by_trace: dict[str, list[dict]] = {}
+        for (tid, _sid), rec in self._records.items():
+            by_trace.setdefault(tid, []).append(rec)
+        for tid, recs in by_trace.items():
+            nodes = {}
+            for rec in recs:
+                node = self._node(rec)
+                nodes[node["span_id"]] = node
+            self._repair_skew(nodes)
+            orphans = sorted(
+                n["span_id"] for n in nodes.values()
+                if n["parent_span_id"] is not None
+                and n["parent_span_id"] not in nodes)
+            spans = sorted(nodes.values(),
+                           key=lambda n: (n["start"], n["span_id"]))
+            traces[tid] = {"trace_id": tid, "spans": spans,
+                           "orphans": orphans}
+        return traces
+
+    @staticmethod
+    def _node(rec: dict) -> dict:
+        attrs = dict(rec["attrs"])
+        spans = rec["spans"]
+        anchor = float(attrs.get("submitted_unix") or 0.0)
+        t0 = float(spans[0][1])
+        events = [[str(n), anchor + (float(t) - t0)] for n, t in spans]
+        terminal = (events[-1][0]
+                    if events[-1][0] in _TERMINALS else None)
+        return {"span_id": attrs.get("span_id"),
+                "parent_span_id": attrs.get("parent_span_id"),
+                "id": rec.get("id"),
+                "service": attrs.get("service"),
+                "start": events[0][1],
+                "end": events[-1][1],
+                "terminal": terminal,
+                "reanchored_s": 0.0,
+                "events": events,
+                "attrs": attrs}
+
+    @classmethod
+    def _repair_skew(cls, nodes: dict) -> None:
+        """Shift any span that STARTS before its parent forward to the
+        parent's start (subtree and all): causality is authoritative
+        over skewed wall clocks. Iterative to fixpoint over the (tiny)
+        per-trace span set; a parent cycle can't occur (span ids are
+        fresh per hop) but the pass is bounded anyway."""
+        for _ in range(len(nodes) + 1):
+            changed = False
+            for n in nodes.values():
+                p = nodes.get(n["parent_span_id"])
+                if p is None or n["start"] >= p["start"]:
+                    continue
+                shift = p["start"] - n["start"]
+                n["reanchored_s"] = round(n["reanchored_s"] + shift, 6)
+                for ev in n["events"]:
+                    ev[1] += shift
+                n["start"] += shift
+                n["end"] += shift
+                changed = True
+            if not changed:
+                return
+
+
+def coverage_s(trace: dict) -> float:
+    """Total wall seconds covered by the UNION of a merged trace's span
+    intervals — the bench gate compares this against the client-observed
+    e2e to bound the unaccounted gap (overlapping legs must not double
+    count)."""
+    ivals = sorted((s["start"], s["end"]) for s in trace["spans"])
+    total = 0.0
+    cur_a = cur_b = None
+    for a, b in ivals:
+        if cur_b is None or a > cur_b:
+            if cur_b is not None:
+                total += cur_b - cur_a
+            cur_a, cur_b = a, b
+        else:
+            cur_b = max(cur_b, b)
+    if cur_b is not None:
+        total += cur_b - cur_a
+    return total
+
+
+def render_waterfall(trace: dict, width: int = 64) -> str:
+    """Text waterfall of one merged trace: one row per span, offset and
+    scaled onto a shared timeline, with service/replica labels and the
+    span's event names. The CLI (``tony-tpu trace``) and the merge-path
+    e2e tests render through this; the portal's HTML view mirrors it."""
+    spans = trace["spans"]
+    if not spans:
+        return f"trace {trace['trace_id']}: no spans"
+    t0 = min(s["start"] for s in spans)
+    t1 = max(s["end"] for s in spans)
+    total = max(t1 - t0, 1e-9)
+    lines = [f"trace {trace['trace_id']}  "
+             f"({len(spans)} spans, {total:.3f}s)"]
+    for s in spans:
+        a = int((s["start"] - t0) / total * width)
+        b = max(a + 1, int((s["end"] - t0) / total * width))
+        bar = " " * a + "#" * (b - a)
+        svc = s.get("service") or "?"
+        who = s["attrs"].get("router") or s["attrs"].get("replica") or ""
+        label = f"{svc}" + (f"[{who}]" if who else "")
+        marks = ",".join(n for n, _ in s["events"])
+        extra = ""
+        if s["attrs"].get("recovered_from") is not None:
+            extra += " recovered"
+        if s["reanchored_s"]:
+            extra += f" reanchored+{s['reanchored_s']:.3f}s"
+        if s.get("terminal") is None:
+            extra += " UNSEALED"
+        lines.append(f"  {bar:<{width + 1}} {label:<24} "
+                     f"{s['end'] - s['start']:8.3f}s  {marks}{extra}")
+    if trace["orphans"]:
+        lines.append(f"  orphans: {', '.join(trace['orphans'])}")
+    return "\n".join(lines)

@@ -207,6 +207,14 @@ ROUTER_DISAGG_REQUESTS_TOTAL = "router_disagg_requests_total"
 ROUTER_DISAGG_HANDOFFS_TOTAL = "router_disagg_handoffs_total"
 ROUTER_DISAGG_FALLBACKS_TOTAL = "router_disagg_fallbacks_total"
 
+# distributed tracing (docs/observability.md "Distributed tracing"):
+# where router-attributed fleet time goes, one histogram per leg —
+# leg="relay" is the classic single-replica relay POST, "prefill" the
+# disagg leg-1 wall, "transfer" submit→first-relayed-frame of a
+# streamed /kv/import leg-2 (payload ship + install), "decode" the
+# rest (a buffered leg-2 books entirely as decode: no frame instants)
+ROUTER_LEG_SECONDS = "router_leg_seconds"
+
 # executor-accumulator metric names (ride update_metrics pushes the same
 # way memory_rss_mb does; surface on the driver /metrics as
 # driver_task_metric{name="max_..."} gauges and in TASK_FINISHED events)

@@ -155,6 +155,7 @@ from ..observability import (
     RequestTrace,
     ServiceRateEstimator,
     ServingTelemetry,
+    TraceContext,
 )
 from .registry import ModelEntry, ModelRegistry
 
@@ -330,6 +331,12 @@ class Request:
     # are capped by its class budget — the engine-side counterpart of
     # the driver's ResourceArbiter tiers (autoscale.py).
     priority: str = "interactive"
+    # distributed-trace identity (observability.TraceContext, or its
+    # as_dict() form): minted/adopted at the HTTP layer and attached to
+    # the lifecycle trace + journal entry at submit, so replays,
+    # journal recovery, and disagg handoffs stay in the originating
+    # trace. None = untraced (direct engine use, test stubs).
+    trace: Any = None
     id: int = field(default_factory=itertools.count().__next__)
 
 
@@ -1460,11 +1467,14 @@ KV_IMPORT_KEYS = (
 )
 
 # the journal-entry fields that ride inside payload["entry"] — exactly
-# the JournalEntry replay state minus the process-local deadline
+# the JournalEntry replay state minus the process-local deadline.
+# "trace" is the prefill leg's distributed-trace identity
+# (TraceContext.as_dict(), or null): the decode replica lands in the
+# originating trace even when the payload arrives without headers
 KV_ENTRY_KEYS = (
     "id", "prompt", "max_new_tokens", "temperature", "top_k",
     "cache_prompt", "seed", "emitted", "model", "stop", "logprobs",
-    "priority",
+    "priority", "trace",
 )
 
 
@@ -2325,6 +2335,14 @@ class SlotServer:
             request.resume_tokens = resume
         tr = RequestTrace(request.id)
         tr.mark("submitted")
+        # bind the distributed-trace identity BEFORE any early exit —
+        # a shed or resume-satisfied request must still land in its
+        # originating cross-tier trace
+        ctx = request.trace if isinstance(request.trace, TraceContext) \
+            else TraceContext.from_dict(request.trace)
+        if ctx is not None:
+            tr.bind(ctx)
+            tr.attrs["service"] = "serve"
         if resume:
             tr.attrs["resume_tokens"] = len(resume)
             # a prefix that already satisfies the request (budget
@@ -2415,7 +2433,8 @@ class SlotServer:
                 stop=[list(s) for s in request.stop]
                 if request.stop else None,
                 logprobs=request.logprobs,
-                priority=request.priority)
+                priority=request.priority,
+                trace=ctx.as_dict() if ctx is not None else None)
         self._queue.append(request)
         return request.id
 
@@ -2687,7 +2706,13 @@ class SlotServer:
                     if entry.stop else None,
                     logprobs=int(getattr(entry, "logprobs", 0) or 0),
                     priority=str(getattr(entry, "priority", None)
-                                 or "interactive"))
+                                 or "interactive"),
+                    # reuse the dead attempt's EXACT span identity: the
+                    # killed process may never have sealed its record,
+                    # so minting a child here would orphan the subtree.
+                    # If both records do land, the merge-time fence
+                    # (TraceCollector) keeps the richer one.
+                    trace=getattr(entry, "trace", None))
                 try:
                     rid = self.submit(req)
                 except ValueError as e:
@@ -3814,6 +3839,12 @@ class SlotServer:
                      if req.stop else None),
             "logprobs": int(req.logprobs or 0),
             "priority": req.priority,
+            # the prefill leg's trace identity rides the durable
+            # payload: a decode replica importing this lands in the
+            # originating distributed trace even header-less
+            "trace": (self._traces[req.id].ctx.as_dict()
+                      if req.id in self._traces
+                      and self._traces[req.id].ctx is not None else None),
         }
         self._exports[int(req.id)] = serialize_kv_blocks(
             self._kv_pool, ids, model=self.model, kv_block=B,
@@ -3837,7 +3868,7 @@ class SlotServer:
                 f"no KV export payload for request {int(request_id)}")
         return payload
 
-    def import_blocks(self, payload: dict) -> int:
+    def import_blocks(self, payload: dict, trace=None) -> int:
         """Install a prefill replica's exported blocks and resume the
         request HERE, decode-only: allocate fresh blocks from our own
         pool, write the payload in (one donated dispatch), install the
@@ -3848,14 +3879,18 @@ class SlotServer:
         (version/model/geometry/checksum — the torn-transfer contract:
         loud rejection, the caller re-prefills via journal replay) and
         QueueFullError when no slot or pool blocks are free right now.
+        ``trace`` (a TraceContext or its dict form, usually parsed from
+        the transport's X-Tony-Trace header) puts the decode leg in the
+        caller's distributed trace; absent that, the payload entry's
+        own "trace" field is used (the prefill leg becomes the parent).
         Returns the new engine-local request id."""
         try:
-            return self._import_blocks(payload)
+            return self._import_blocks(payload, trace)
         except ValueError:
             self.kv_import_rejects += 1
             raise
 
-    def _import_blocks(self, payload: dict) -> int:
+    def _import_blocks(self, payload: dict, trace=None) -> int:
         if not self._paged:
             raise ValueError(
                 "import_blocks requires paged=True (the transfer unit "
@@ -3969,6 +4004,18 @@ class SlotServer:
         # -- validated and funded: install
         tr = RequestTrace(req.id)
         tr.mark("submitted")
+        ctx = trace if isinstance(trace, TraceContext) \
+            else TraceContext.from_dict(trace)
+        if ctx is None:
+            # header-less import (e.g. a payload replayed from disk):
+            # the prefill leg's identity persisted in the entry is the
+            # parent — same trace, new span for the decode leg
+            stashed = TraceContext.from_dict(entry.get("trace"))
+            if stashed is not None:
+                ctx = stashed.child()
+        if ctx is not None:
+            tr.bind(ctx)
+            tr.attrs["service"] = "serve"
         tr.attrs["imported_blocks"] = n_payload
         if emitted:
             tr.attrs["resume_tokens"] = len(emitted)
@@ -4033,7 +4080,8 @@ class SlotServer:
                 cache_prompt=req.cache_prompt, seed=self._seed,
                 emitted=emitted, model=self.model,
                 stop=[list(s) for s in req.stop] if req.stop else None,
-                logprobs=req.logprobs, priority=req.priority)
+                logprobs=req.logprobs, priority=req.priority,
+                trace=ctx.as_dict() if ctx is not None else None)
         admit = (slot, int(body.size), req)
         if self._pipeline:
             self._pipeline[-1]["events"].append(("admit", admit))

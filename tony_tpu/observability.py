@@ -65,8 +65,10 @@ from __future__ import annotations
 
 import bisect
 import collections
+import hashlib
 import logging
 import math
+import os
 import re
 import threading
 import time
@@ -185,6 +187,105 @@ class Histogram:
         self.sum = float(state["sum"])
 
 
+# distributed-tracing header contract: a sender stamps
+# ``X-Tony-Trace: <trace_id>:<span_id>`` on every outbound hop; the
+# receiver adopts the trace_id, records the sender's span_id as its
+# parent_span_id, and mints a fresh span_id for its own work. Front
+# doors echo ``X-Tony-Trace-Id: <trace_id>`` back to the client so a
+# request can be looked up later. docs/observability.md "Distributed
+# tracing" documents the contract; the api-contract lint pins it.
+TRACE_HEADER = "X-Tony-Trace"
+TRACE_ID_RESPONSE_HEADER = "X-Tony-Trace-Id"
+
+_TRACE_TOKEN = re.compile(r"^[0-9a-f]{8,32}$")
+
+
+class TraceContext:
+    """One hop's identity inside a distributed trace.
+
+    ``trace_id`` names the whole request across tiers; ``span_id`` names
+    THIS process's work on it; ``parent_span_id`` names the span that
+    caused it (None at the root). The context travels between processes
+    as the ``X-Tony-Trace`` header (``trace_id:span_id``) and inside
+    durable payloads (journal entries, KV handoff ``entry`` dicts) as
+    ``as_dict()``. Identity is carried in ``RequestTrace.attrs`` — span
+    records stay self-describing JSONL lines that ``TraceCollector``
+    can merge by trace_id with no side tables.
+    """
+
+    __slots__ = ("trace_id", "span_id", "parent_span_id")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_span_id: str | None = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+
+    @staticmethod
+    def _new_id() -> str:
+        return os.urandom(8).hex()
+
+    @classmethod
+    def mint(cls) -> "TraceContext":
+        """A fresh root context — minted at a front door when the client
+        sent no trace header."""
+        return cls(cls._new_id(), cls._new_id(), None)
+
+    @classmethod
+    def for_request_id(cls, request_id: str) -> "TraceContext":
+        """A root context whose trace_id is DERIVED from the client's
+        idempotency key. Two doors that never exchanged a byte (the
+        cross-door failover resubmit: door0 died before responding, the
+        client re-POSTs the same ``request_id`` at door1) still land in
+        the same trace — the distributed-tracing analogue of the
+        portable ``req:<id>`` progress-key discipline."""
+        digest = hashlib.sha256(
+            b"tony-trace:" + request_id.encode("utf-8", "replace"))
+        return cls(digest.hexdigest()[:16], cls._new_id(), None)
+
+    @classmethod
+    def from_header(cls, value: str | None) -> "TraceContext | None":
+        """Parse an inbound ``X-Tony-Trace`` header into the RECEIVER's
+        context: same trace, sender's span as parent, fresh span_id.
+        Malformed or absent headers yield None (caller mints a root) —
+        a garbled proxy header must never crash the request path."""
+        if not value:
+            return None
+        trace_id, sep, span_id = value.strip().partition(":")
+        if not sep or not _TRACE_TOKEN.match(trace_id) \
+                or not _TRACE_TOKEN.match(span_id):
+            return None
+        return cls(trace_id, cls._new_id(), span_id)
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "TraceContext | None":
+        """Rehydrate a context persisted via ``as_dict()`` (journal
+        entry, KV handoff). Returns the SAME span identity — journal
+        recovery of a dead attempt deliberately reuses the dead span's
+        ids so its children are never orphaned; the merge-time fence
+        dedupes any double-written records."""
+        if not isinstance(d, dict):
+            return None
+        trace_id, span_id = d.get("trace_id"), d.get("span_id")
+        if not isinstance(trace_id, str) or not isinstance(span_id, str):
+            return None
+        parent = d.get("parent_span_id")
+        return cls(trace_id, span_id,
+                   parent if isinstance(parent, str) else None)
+
+    def child(self) -> "TraceContext":
+        """The context a downstream hop should run under: same trace,
+        this span as parent, fresh span_id."""
+        return type(self)(self.trace_id, self._new_id(), self.span_id)
+
+    def to_header(self) -> str:
+        return f"{self.trace_id}:{self.span_id}"
+
+    def as_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_span_id": self.parent_span_id}
+
+
 class RequestTrace:
     """One request's lifecycle spans: (name, t_monotonic) pairs in the
     order the HOST observed them, plus free-form ``attrs``
@@ -205,6 +306,20 @@ class RequestTrace:
 
     def mark(self, name: str, t: float | None = None) -> None:
         self.spans.append((name, time.monotonic() if t is None else t))
+
+    def bind(self, ctx: "TraceContext | None") -> "RequestTrace":
+        """Attach a distributed-trace identity. Carried in ``attrs`` (no
+        schema change to the span list) so every sealed JSONL record is
+        self-describing for cross-tier merge. No-op when ctx is None —
+        single-tier deployments keep their old trace shape."""
+        if ctx is not None:
+            self.attrs.update(ctx.as_dict())
+        return self
+
+    @property
+    def ctx(self) -> "TraceContext | None":
+        """The bound TraceContext, if any (inverse of ``bind``)."""
+        return TraceContext.from_dict(self.attrs)
 
     def t(self, name: str) -> float | None:
         for n, t in self.spans:
@@ -806,7 +921,8 @@ class PromRenderer:
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-__all__ = ["Histogram", "RequestTrace", "TaskTrace", "ServingTelemetry",
+__all__ = ["Histogram", "RequestTrace", "TaskTrace", "TraceContext",
+           "TRACE_HEADER", "TRACE_ID_RESPONSE_HEADER", "ServingTelemetry",
            "ServiceRateEstimator", "PromRenderer", "PROM_CONTENT_TYPE",
            "TELEMETRY_HISTOGRAMS", "TERMINAL_SPANS", "TASK_TERMINAL_SPANS",
            "DispatchTracker", "CompileTelemetry", "COMPILE_TELEMETRY",

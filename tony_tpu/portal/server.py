@@ -10,7 +10,10 @@ Observability additions (docs/observability.md): `/traces/<id>` renders a
 per-request timeline from the job's ``requests.trace.jsonl`` (written by
 ``serve --trace-dir``, TTL-cached like the event stream), `/tasks/<id>`
 renders the gang-launch waterfall from ``tasks.trace.jsonl`` (written by
-the driver), `/profiles/<id>` lists and serves captured jax.profiler
+the driver), `/requests/<id>` lists the job's MERGED cross-tier
+distributed traces (every tier's ``*.trace.jsonl`` joined by trace_id)
+with `/requests/<id>/<trace_id>` rendering one trace's waterfall,
+`/profiles/<id>` lists and serves captured jax.profiler
 xplane dumps (from serve's `/debug/profile` and the driver's
 profile-command path), and `/metrics` exposes the portal's own request
 counters/latency in Prometheus text format through the same renderer the
@@ -36,7 +39,8 @@ from ..events.history import (
     HistoryFilePurger,
     parse_history_file_name,
 )
-from ..events.trace import TASK_TRACE_FILE, TRACE_FILE, read_traces
+from ..events.trace import (TASK_TRACE_FILE, TRACE_FILE, TraceCollector,
+                            coverage_s, read_traces)
 from ..observability import PROM_CONTENT_TYPE, Histogram, PromRenderer
 
 log = logging.getLogger(__name__)
@@ -75,6 +79,7 @@ class HistoryIndex:
         self._events_cache = _TTLCache(ttl_s=30)
         self._trace_cache = _TTLCache(ttl_s=30)
         self._task_trace_cache = _TTLCache(ttl_s=30)
+        self._merged_cache = _TTLCache(ttl_s=30)
 
     def _job_dirs(self):
         for root in (self.intermediate, self.finished):
@@ -150,6 +155,28 @@ class HistoryIndex:
             return read_traces(path)
 
         return self._task_trace_cache.get(("tasks", app_id), load)
+
+    def merged_traces(self, app_id: str) -> dict | None:
+        """Cross-tier DISTRIBUTED traces for the job: every
+        ``*.trace.jsonl`` under the job directory (routers and replicas
+        pointed at the same ``--trace-dir`` each write their own file;
+        task traces excluded — different granularity) merged by trace_id
+        through TraceCollector. None when the job has no request-trace
+        files at all; TTL-cached like the flat trace list."""
+        def load():
+            job_dir, _ = self._find_job_dir(app_id)
+            if job_dir is None:
+                return None
+            collector = TraceCollector()
+            for path in sorted(job_dir.rglob("*.trace.jsonl")):
+                if path.name == TASK_TRACE_FILE:
+                    continue
+                collector.add_file(path)
+            if collector.files_read == 0:
+                return None
+            return collector.merged()
+
+        return self._merged_cache.get(("requests", app_id), load)
 
     def config(self, app_id: str) -> dict | None:
         for root in (self.staging,):
@@ -341,6 +368,7 @@ def _job_detail_html(app_id: str, events: list[dict]) -> str:
         f"<a href='/config/{html.escape(app_id)}'>config</a>"
         f" | <a href='/logs/{html.escape(app_id)}'>logs</a>"
         f" | <a href='/traces/{html.escape(app_id)}'>requests</a>"
+        f" | <a href='/requests/{html.escape(app_id)}'>traces</a>"
         f" | <a href='/tasks/{html.escape(app_id)}'>tasks</a>"
         f" | <a href='/profiles/{html.escape(app_id)}'>profiles</a></p>"
         "<h4>events</h4><table><tr><th>time</th><th>type</th><th>detail</th></tr>"
@@ -434,6 +462,116 @@ def _request_timeline_html(app_id: str, traces: list[dict]) -> str:
         "<th>prefix blocks</th><th>queue wait s</th><th>ttft s</th>"
         "<th>e2e s</th><th>timeline</th></tr>"
         + "".join(rows) + "</table>"
+    )
+    return _PAGE.format(body=body)
+
+
+def _requests_list_html(app_id: str, traces: dict) -> str:
+    """Distributed-trace index for one job: every merged cross-tier
+    trace, slowest first, failures flagged — the triage entry point
+    (docs/observability.md "Distributed tracing"). Each trace_id links
+    to its waterfall page."""
+    rows = []
+    items = []
+    for t in traces.values():
+        if not t["spans"]:
+            continue
+        dur = (max(s["end"] for s in t["spans"])
+               - min(s["start"] for s in t["spans"]))
+        bad = any(s["terminal"] in ("failed", "shed", "expired")
+                  for s in t["spans"])
+        items.append((dur, bad, t))
+    items.sort(key=lambda x: (-x[1], -x[0]))
+    for dur, bad, t in items:
+        tid = str(t["trace_id"])
+        services = sorted({str(s.get("service") or "?")
+                           for s in t["spans"]})
+        status = "FAILED" if bad else "ok"
+        rows.append(
+            f"<tr><td><a href='/requests/{html.escape(app_id)}/"
+            f"{html.escape(tid)}'>{html.escape(tid)}</a></td>"
+            f"<td class='{'FAILED' if bad else 'SUCCEEDED'}'>{status}</td>"
+            f"<td>{dur:.3f}</td><td>{len(t['spans'])}</td>"
+            f"<td>{len(t['orphans'])}</td>"
+            f"<td>{html.escape(', '.join(services))}</td></tr>")
+    body = (
+        f"<h3>{html.escape(app_id)} — distributed traces</h3>"
+        f"<p><a href='/'>all jobs</a> | "
+        f"<a href='/jobs/{html.escape(app_id)}'>events</a> | "
+        f"<a href='/traces/{html.escape(app_id)}'>flat timeline</a></p>"
+        f"<p>{len(rows)} merged traces — failed first, then slowest "
+        "(spans merged across every tier's trace file by trace_id).</p>"
+        "<table><tr><th>trace</th><th>status</th><th>wall s</th>"
+        "<th>spans</th><th>orphans</th><th>tiers</th></tr>"
+        + "".join(rows) + "</table>"
+    )
+    return _PAGE.format(body=body)
+
+
+def _request_waterfall_html(app_id: str, trace: dict) -> str:
+    """Cross-tier waterfall for ONE merged trace: a row per span (router
+    relay legs, prefill leg, decode/recovered attempts), bars on the
+    shared re-anchored wall timeline, segments colored by the lifecycle
+    event that ends them — the HTML twin of events.trace.
+    render_waterfall. Everything record-sourced is escaped: trace files
+    are data, and anything that can append to the job dir writes them."""
+    spans = trace["spans"]
+    tid = str(trace["trace_id"])
+    t0 = min((s["start"] for s in spans), default=0.0)
+    t_max = max((s["end"] - t0 for s in spans), default=0.0) or 1e-9
+    rows = []
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        svc = str(s.get("service") or "?")
+        who = attrs.get("router") or attrs.get("replica") or ""
+        label = svc + (f"[{who}]" if who else "")
+        notes = []
+        if attrs.get("recovered_from") is not None:
+            notes.append(f"recovered from #{attrs['recovered_from']}")
+        if s.get("reanchored_s"):
+            notes.append(f"reanchored +{s['reanchored_s']:.3f}s")
+        if s.get("terminal") is None:
+            notes.append("UNSEALED")
+        lead = 100.0 * (s["start"] - t0) / t_max
+        bar = (f"<div style='display:inline-block;height:12px;"
+               f"width:{lead:.2f}%'></div>") if lead > 0.01 else ""
+        events = s.get("events") or []
+        for (pn, pt), (nn, nt) in zip(events, events[1:]):
+            width = max(0.3, 100.0 * (nt - pt) / t_max)
+            bar += (
+                f"<div title='{html.escape(str(pn))}&rarr;"
+                f"{html.escape(str(nn))} {nt - pt:.3f}s' "
+                f"style='display:inline-block;height:12px;"
+                f"width:{width:.2f}%;background:"
+                f"{_SEG_COLORS.get(nn, '#999')}'></div>")
+        marks = ",".join(str(n) for n, _ in events)
+        rows.append(
+            f"<tr><td>{html.escape(label)}</td>"
+            f"<td>{html.escape(str(s.get('id', '?')))}</td>"
+            f"<td class='{html.escape(str(s.get('terminal')))}'>"
+            f"{html.escape(str(s.get('terminal') or 'open'))}</td>"
+            f"<td>{s['end'] - s['start']:.3f}</td>"
+            f"<td style='min-width:280px'>{bar}</td>"
+            f"<td>{html.escape(marks)}</td>"
+            f"<td>{html.escape('; '.join(notes))}</td></tr>")
+    cov = coverage_s(trace)
+    orphan_note = (
+        f"<p class='FAILED'>orphan spans (parent never wrote a record): "
+        f"{html.escape(', '.join(str(o) for o in trace['orphans']))}</p>"
+        if trace["orphans"] else "")
+    body = (
+        f"<h3>{html.escape(app_id)} — trace {html.escape(tid)}</h3>"
+        f"<p><a href='/'>all jobs</a> | "
+        f"<a href='/requests/{html.escape(app_id)}'>all traces</a> | "
+        f"<a href='/jobs/{html.escape(app_id)}'>events</a></p>"
+        f"<p>{len(spans)} spans across "
+        f"{len({str(s.get('service') or '?') for s in spans})} tier(s); "
+        f"{t_max:.3f}s wall, {cov:.3f}s covered by the span union. "
+        "Bars share one re-anchored wall timeline; a child starting "
+        "before its parent has been shifted (see the notes column).</p>"
+        "<table><tr><th>tier</th><th>request</th><th>terminal</th>"
+        "<th>span s</th><th>timeline</th><th>events</th><th>notes</th>"
+        "</tr>" + "".join(rows) + "</table>" + orphan_note
     )
     return _PAGE.format(body=body)
 
@@ -600,7 +738,7 @@ def make_handler(index: HistoryIndex, token: str = ""):
     # not grow the dict (or the /metrics cardinality) without limit.
     # One lock: ThreadingHTTPServer handlers mutate these concurrently.
     _KNOWN_ROUTES = ("index", "jobs", "config", "logs", "traces",
-                     "tasks", "profiles", "metrics")
+                     "requests", "tasks", "profiles", "metrics")
     http_requests: dict[str, int] = {}
     request_hist = Histogram()
     telemetry_lock = threading.Lock()
@@ -743,6 +881,19 @@ def make_handler(index: HistoryIndex, token: str = ""):
                         return self._json(traces)
                     return self._send(
                         200, _request_timeline_html(app_id, traces))
+                if kind == "requests":
+                    merged = index.merged_traces(app_id)
+                    if len(parts) > 2:
+                        # one merged trace's cross-tier waterfall
+                        trace = (merged or {}).get(parts[2])
+                        if want_json or trace is None:
+                            return self._json(trace)
+                        return self._send(
+                            200, _request_waterfall_html(app_id, trace))
+                    if want_json or merged is None:
+                        return self._json(merged)
+                    return self._send(
+                        200, _requests_list_html(app_id, merged))
                 if kind == "tasks":
                     traces = index.task_traces(app_id)
                     if want_json or traces is None:

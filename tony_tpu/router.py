@@ -90,9 +90,12 @@ import urllib.request
 from . import metrics as _metrics
 from .observability import (
     PROM_CONTENT_TYPE,
+    TRACE_HEADER,
+    TRACE_ID_RESPONSE_HEADER,
     Histogram,
     PromRenderer,
     RequestTrace,
+    TraceContext,
 )
 
 log = logging.getLogger(__name__)
@@ -285,6 +288,14 @@ class FleetRouter:
         self._ids = itertools.count()
         self.routing_hist = Histogram(lo=1e-6, hi=1.0)
         self.e2e_hist = Histogram()
+        # where router-attributed fleet time goes, one histogram per
+        # leg (``router_leg_seconds{leg=...}``): "relay" = the classic
+        # single-replica POST, "prefill" = disagg leg 1, "transfer" =
+        # leg-2 submit -> first relayed frame (payload ship + install),
+        # "decode" = the rest (buffered leg 2 books entirely as decode)
+        self.leg_hists = {leg: Histogram()
+                          for leg in ("prefill", "transfer", "decode",
+                                      "relay")}
         self.requests_total = 0
         self.failed_total = 0
         self.shed_total = 0           # requests the ROUTER gave up on (429)
@@ -708,19 +719,24 @@ class FleetRouter:
             return min(avail or live, key=lambda r: (r.load, r.name))
 
     def _post_import(self, rep: Replica, handoff: dict, timeout: float,
-                     on_frame=None) -> dict:
+                     on_frame=None,
+                     extra_headers: dict | None = None) -> dict:
         """POST /kv/import to one decode-capable replica: the body is
         the prefill leg's handoff payload VERBATIM (the pinned transfer
-        contract); stream selection rides the query string. Same error
-        taxonomy as _post_generate — a 400 here means the payload was
-        damaged in flight (torn transfer), which the caller maps onto
-        the replay fallback."""
+        contract); stream selection rides the query string, and
+        ``extra_headers`` (the X-Tony-Trace stamp) ride the POST — the
+        trace context can't ride the pinned body. Same error taxonomy
+        as _post_generate — a 400 here means the payload was damaged in
+        flight (torn transfer), which the caller maps onto the replay
+        fallback."""
         url = rep.base_url + "/kv/import"
         if on_frame is not None:
             url += "?stream=true"
         body = json.dumps(handoff).encode()
-        req = urllib.request.Request(
-            url, data=body, headers={"Content-Type": "application/json"})
+        headers = {"Content-Type": "application/json"}
+        if extra_headers:
+            headers.update(extra_headers)
+        req = urllib.request.Request(url, data=body, headers=headers)
         try:
             with urllib.request.urlopen(
                     req, timeout=max(0.05, timeout)) as resp:
@@ -786,6 +802,10 @@ class FleetRouter:
             log.debug("router: disagg fallback for request %d: %s",
                       rid, msg)
 
+        # every leg carries this router's trace stamp: the replicas'
+        # own spans join the request's distributed trace
+        ctx = tr.ctx
+        hdr = {TRACE_HEADER: ctx.to_header()} if ctx is not None else None
         # ---- leg 1: prefill (buffered — the handoff payload rides the
         # /generate response; streaming starts on the decode leg)
         leg1 = dict(payload)
@@ -795,8 +815,10 @@ class FleetRouter:
         with self._lock:
             pre.requests += 1
             pre.inflight += 1
+        t_leg1 = time.monotonic()
         try:
-            resp1 = self._post_generate(pre, leg1, remaining)
+            resp1 = self._post_generate(pre, leg1, remaining,
+                                        extra_headers=hdr)
         except _ReplicaShed as e:
             with self._lock:
                 pre.shed += 1
@@ -828,6 +850,11 @@ class FleetRouter:
             resp1["replica"] = pre.name
             resp1.setdefault("retries", 0)
             return resp1
+        leg_prefill = time.monotonic() - t_leg1
+        with self._lock:
+            self.leg_hists["prefill"].observe(leg_prefill)
+        tr.mark("prefill_done")
+        tr.attrs["leg_prefill_s"] = round(leg_prefill, 6)
         handoff = resp1.get("handoff")
         if not handoff:
             _fallback(f"{pre.name} prefilled but the export stash "
@@ -844,10 +871,22 @@ class FleetRouter:
         with self._lock:
             dec.requests += 1
             dec.inflight += 1
+        # leg-2 attribution: submit -> first relayed frame is the
+        # TRANSFER (payload ship + block install), the rest is DECODE.
+        # A buffered leg 2 has no frame instants — it books entirely as
+        # decode (documented on router_leg_seconds).
+        t_leg2 = time.monotonic()
+        first_frame_t = [None]
+        leg2_frame = on_frame
+        if on_frame is not None:
+            def leg2_frame(delta, _inner=on_frame):
+                if first_frame_t[0] is None:
+                    first_frame_t[0] = time.monotonic()
+                _inner(delta)
         try:
             resp2 = self._post_import(
                 dec, handoff, deadline - time.monotonic(),
-                on_frame=on_frame)
+                on_frame=leg2_frame, extra_headers=hdr)
         except _ReplicaShed as e:
             with self._lock:
                 dec.shed += 1
@@ -875,7 +914,16 @@ class FleetRouter:
         finally:
             with self._lock:
                 dec.inflight -= 1
+        t_end = time.monotonic()
+        split = first_frame_t[0] if first_frame_t[0] is not None else t_leg2
+        leg_transfer = split - t_leg2
+        leg_decode = t_end - split
+        tr.attrs["leg_transfer_s"] = round(leg_transfer, 6)
+        tr.attrs["leg_decode_s"] = round(leg_decode, 6)
         with self._lock:
+            if first_frame_t[0] is not None:
+                self.leg_hists["transfer"].observe(leg_transfer)
+            self.leg_hists["decode"].observe(leg_decode)
             self.disagg_handoffs += 1
             if key is not None:
                 ranked = self._ranked_locked(key, model)
@@ -912,7 +960,8 @@ class FleetRouter:
                  logprobs: int = 0,
                  priority: str | None = None,
                  last_event_id: str | None = None,
-                 request_id: str | None = None) -> dict:
+                 request_id: str | None = None,
+                 trace=None) -> dict:
         """Route one generation request; returns the replica's response
         dict (id/tokens/finish_reason) plus routing attrs. ``model``
         restricts routing to replicas advertising that model (their
@@ -944,7 +993,14 @@ class FleetRouter:
         prefix the dead router's attempt journaled on the owning
         replica and carries it as ``resume_tokens``, so a router death
         costs recompute of the gap, never the request (docs/serving.md
-        "Router tier HA")."""
+        "Router tier HA").
+
+        ``trace`` (an observability.TraceContext, or its dict form)
+        places this relay in a distributed trace; None mints one —
+        derived from ``request_id`` when given, so a cross-door retry
+        of the same client request lands in the SAME trace_id without
+        the doors ever exchanging a byte (docs/observability.md
+        "Distributed tracing")."""
         with self._lock:
             self._relay_inflight += 1
             if on_tokens is not None:
@@ -953,7 +1009,8 @@ class FleetRouter:
             return self._generate(prompt, max_new_tokens, timeout_s,
                                   temperature, top_k, cache_prompt,
                                   model, on_tokens, stop, logprobs,
-                                  priority, last_event_id, request_id)
+                                  priority, last_event_id, request_id,
+                                  trace)
         finally:
             with self._lock:
                 self._relay_inflight -= 1
@@ -963,10 +1020,24 @@ class FleetRouter:
     def _generate(self, prompt, max_new_tokens, timeout_s, temperature,
                   top_k, cache_prompt, model, on_tokens,
                   stop=None, logprobs=0, priority=None,
-                  last_event_id=None, request_id=None) -> dict:
+                  last_event_id=None, request_id=None,
+                  trace=None) -> dict:
         rid = next(self._ids)
         tr = RequestTrace(rid)
         tr.mark("submitted")
+        ctx = trace if isinstance(trace, TraceContext) \
+            else TraceContext.from_dict(trace)
+        if ctx is None:
+            # root of the distributed trace. A client request_id
+            # DERIVES the trace_id: a failover re-POST of the same id
+            # through another shared-nothing door lands in the same
+            # trace with zero coordination (the tracing analogue of
+            # the portable req:<id> progress key)
+            ctx = (TraceContext.for_request_id(str(request_id))
+                   if request_id is not None else TraceContext.mint())
+        tr.bind(ctx)
+        tr.attrs["service"] = "router"
+        tr.attrs["router"] = self._nonce
         key = self.route_key(prompt, model)
         with self._lock:
             self.requests_total += 1
@@ -1050,6 +1121,17 @@ class FleetRouter:
             # pass-through: the replica validates the tier name
             payload["priority"] = str(priority)
             tr.attrs["priority"] = str(priority)
+        # write-ahead OPEN record: a SIGKILLed door seals nothing, so
+        # this door's relay span would otherwise vanish from the merged
+        # trace. Identifiable by its non-terminal last span; the sealed
+        # record supersedes it at merge time (the TraceCollector fence
+        # keeps the richer record for the same span_id).
+        sink = self.trace_sink
+        if sink is not None:
+            try:
+                sink(tr.to_dict())
+            except Exception:
+                log.exception("router trace sink failed (open record)")
         # disaggregated two-leg attempt first (only when the fleet has
         # live prefill specialists; a roleless/mixed fleet skips this
         # entirely). SSE reconnects stay on the classic path — the
@@ -1146,21 +1228,23 @@ class FleetRouter:
             # router would abandon must not keep decoding downstream
             payload["timeout_s"] = max(0.05, remaining)
             collected.clear()       # each attempt streams from position 0
+            # every attempt — first post, failover resubmits with
+            # resume_tokens alike — carries this router's trace stamp
+            hdrs = {TRACE_HEADER: ctx.to_header()}
+            if last_event_id and attempts == 0:
+                # SSE reconnect pass-through: only the FIRST attempt
+                # forwards the client's header — a failover retry
+                # resumes via the router's own harvested resume_tokens
+                # instead, and sending both would double-resume
+                hdrs["Last-Event-ID"] = last_event_id
+            t_leg = time.monotonic()
             try:
                 try:
                     resp = self._post_generate(
                         rep, payload, remaining,
                         on_frame=(on_frame if on_tokens is not None
                                   else None),
-                        # SSE reconnect pass-through: only the FIRST
-                        # attempt forwards the client's header — a
-                        # failover retry resumes via the router's own
-                        # harvested resume_tokens instead, and sending
-                        # both would double-resume
-                        extra_headers=(
-                            {"Last-Event-ID": last_event_id}
-                            if last_event_id and attempts == 0
-                            else None))
+                        extra_headers=hdrs)
                 finally:
                     with self._lock:
                         rep.inflight -= 1
@@ -1279,7 +1363,10 @@ class FleetRouter:
                 self._seal(tr, "failed", error="client", retries=attempts)
                 raise RouterClientError(str(e)) from None
             else:
+                leg_relay = time.monotonic() - t_leg
+                tr.attrs["leg_relay_s"] = round(leg_relay, 6)
                 with self._lock:
+                    self.leg_hists["relay"].observe(leg_relay)
                     ranked = (self._ranked_locked(key, model)
                               if key is not None else [])
                     hit = bool(ranked and ranked[0] is rep)
@@ -1630,6 +1717,15 @@ class FleetRouter:
             r.histogram(_metrics.ROUTER_E2E_SECONDS, self.e2e_hist,
                         "request time through the router, submit to "
                         "terminal, retries included")
+            for leg, hist in sorted(self.leg_hists.items()):
+                r.histogram(
+                    _metrics.ROUTER_LEG_SECONDS, hist,
+                    "router-attributed fleet time per request leg: "
+                    "relay = classic single-replica POST, prefill = "
+                    "disagg leg 1, transfer = leg-2 submit to first "
+                    "relayed frame, decode = the rest (buffered leg 2 "
+                    "books entirely as decode)",
+                    labels={"leg": leg})
         return r.render()
 
     def healthy(self) -> bool:
@@ -2009,6 +2105,16 @@ def make_handler(router: FleetRouter, codec=None):
             except (KeyError, ValueError, TypeError) as e:
                 self._send(400, {"error": str(e)})
                 return
+            # distributed-trace context for this door: adopt the
+            # client's header if it sent one, else root it — derived
+            # from request_id when given, so a failover re-POST at
+            # another door joins the SAME trace
+            ctx = TraceContext.from_header(
+                self.headers.get(TRACE_HEADER))
+            if ctx is None:
+                ctx = (TraceContext.for_request_id(reqid)
+                       if reqid is not None else TraceContext.mint())
+            kwargs["trace"] = ctx
             if stream_on:
                 sent = {"n": 0}
 
@@ -2022,7 +2128,8 @@ def make_handler(router: FleetRouter, codec=None):
                         "finish_reason": resp.get("finish_reason"),
                         "n_tokens": sent["n"],
                         "replica": resp.get("replica"),
-                        "retries": resp.get("retries")})
+                        "retries": resp.get("retries"),
+                        "trace_id": ctx.trace_id})
 
                 def err(msg):
                     return sse_frame({"error": str(msg)})
@@ -2047,7 +2154,8 @@ def make_handler(router: FleetRouter, codec=None):
             except RouterError as e:
                 self._send(502, {"error": str(e)})
                 return
-            self._send(200, resp)
+            self._send(200, resp, headers={
+                TRACE_ID_RESPONSE_HEADER: ctx.trace_id})
 
         def _post_openai(self, chat: bool):
             """The fleet-wide OpenAI-compatible surface: same payload
@@ -2081,13 +2189,17 @@ def make_handler(router: FleetRouter, codec=None):
                 kwargs["priority"] = req["priority"]
             prompt = req["prompt_tokens"]
             rid = next(oai_ids)
+            ctx = TraceContext.from_header(
+                self.headers.get(TRACE_HEADER)) or TraceContext.mint()
+            kwargs["trace"] = ctx
             if req["stream"] and self.headers.get("Last-Event-ID"):
                 # SSE reconnect pass-through, same as /generate
                 kwargs["last_event_id"] = \
                     self.headers.get("Last-Event-ID")
             if req["stream"]:
                 frame, close, err = oai.stream_frame_fns(
-                    rid, model_name, codec, chat)
+                    rid, model_name, codec, chat,
+                    trace_id=ctx.trace_id)
                 self._route_stream(
                     prompt, kwargs, frame,
                     lambda resp: close(resp.get("finish_reason",
@@ -2125,7 +2237,8 @@ def make_handler(router: FleetRouter, codec=None):
             self._send(200, build(
                 rid, model_name, resp.get("tokens", []),
                 resp.get("finish_reason", "stop"), len(prompt), codec,
-                logprobs=resp.get("logprobs")))
+                logprobs=resp.get("logprobs")),
+                headers={TRACE_ID_RESPONSE_HEADER: ctx.trace_id})
 
     return Handler
 
