@@ -236,6 +236,21 @@ slot (relaunched with the checkpoint prestaged), and the training job
 runs to SUCCEEDED with ≤ save_interval recomputed steps per recovery
 and ZERO failed serving requests. Results land in PERF.json under
 `autoscaling`.
+
+`python bench.py --serving --slo` gates the FLEET METRICS PIPELINE +
+SLO ALERTING (docs/observability.md "Metrics pipeline & SLO
+alerting"): one driver runs 2 replicas behind a `router`-framework
+front door with a declared availability SLO; the driver-resident
+metrics hub scrapes every tier. A healthy open-loop warm-up must fire
+ZERO alerts; a replica SIGKILL under a Poisson overload burst sheds on
+the survivor and the fast burn-rate pair must fire inside its window
+(journaled); the driver is then SIGKILLed MID-INCIDENT and relaunched
+with `--recover` — the replayed metrics.tsdb.jsonl + journal-seeded
+alert state must RESUME the alert with exactly one firing transition
+in the final journal (no duplicate); the alert clears after the
+replica relaunch, and the engine's budget accounting must equal
+(failed+shed)/total computed from the router's own /metrics counters
+EXACTLY. Results land in PERF.json under `slo_alerting`.
 """
 
 from __future__ import annotations
@@ -4254,6 +4269,415 @@ def run_autoscale_bench() -> int:
     return 0
 
 
+def run_slo_bench() -> int:
+    """Fleet metrics pipeline + SLO burn-rate alerting gate (module
+    docstring; one JSON line -> PERF.json `slo_alerting`)."""
+    import signal as _signal
+    import tempfile as _tempfile
+    import threading
+    import urllib.request
+
+    sys.path.insert(0, str(REPO))
+    import numpy as np
+
+    from tony_tpu import constants as c
+    from tony_tpu.client import TonyClient
+    from tony_tpu.conf import TonyConf
+    from tony_tpu.observability import parse_prom_text
+    from tony_tpu.router import DriverDiscovery
+
+    e = dict(vocab=64, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+             slots=2, max_len=96, block_size=4, prefill_chunk=8)
+    MAX_NEW = 8
+    STEP_DELAY_MS = 100     # slow decode: the lone survivor's capacity
+    #                         sits far below the incident arrival rate
+    # availability SLO: W=120s -> fast pair (20s, 2s) @ 14.4x burn
+    # (error rate > 14.4% in BOTH trailing windows), slow pair
+    # (120s, 20s) @ 6x. The burst overloads the survivor hard enough
+    # that the fast pair fires within a few 0.5s scrape rounds; only
+    # the FAST alert's clear is gated (the slow pair needs the
+    # incident to age out of the full 120s window).
+    TARGET, WINDOW_S, SCRAPE_S = 0.99, 120.0, 0.5
+    WARMUP_REQS, WARMUP_GAP_S = 15, 0.2
+    PRESSURE_MEAN_S = 0.02      # ~50 req/s of sustained incident load
+
+    td = _tempfile.mkdtemp(prefix="tony-slo-bench-")
+    root = Path(td)
+    serve_cmd = (
+        f"{sys.executable} -m tony_tpu.cli.main serve "
+        "--port $TONY_SERVE_PORT --host 127.0.0.1 "
+        f"--vocab {e['vocab']} --d-model {e['d_model']} "
+        f"--n-layers {e['n_layers']} --n-heads {e['n_heads']} "
+        f"--d-ff {e['d_ff']} --dtype float32 --seed 0 "
+        f"--slots {e['slots']} --max-len {e['max_len']} "
+        f"--block-size {e['block_size']} "
+        f"--prefill-chunk {e['prefill_chunk']} "
+        # deep enough that the cold-start compile stall never sheds the
+        # healthy warm-up (a shed is a REAL bad event and would burn
+        # budget before the incident); the sustained incident load
+        # still fills it behind a lone survivor within a few seconds
+        "--max-queue 64 --drain-timeout-s 5")
+    route_cmd = (
+        f"{sys.executable} -m tony_tpu.cli.main route "
+        "--port $TONY_SERVE_PORT --host 127.0.0.1 "
+        "--job-dir $TONY_JOB_DIR --role replica "
+        f"--prefill-chunk {e['prefill_chunk']} "
+        "--health-interval-s 0.3 --probe-timeout-s 5.0 "
+        "--discovery-min-interval-s 0.5 --stats-every 2 "
+        "--drain-timeout-s 10")
+    conf = TonyConf({
+        "tony.staging.dir": str(root / "staging"),
+        "tony.history.location": str(root / "history"),
+        "tony.history.intermediate": str(root / "history/intermediate"),
+        "tony.history.finished": str(root / "history/finished"),
+        "tony.am.monitor-interval-ms": 100,
+        "tony.application.framework": "serving",
+        "tony.task.registration-poll-interval-ms": 100,
+        "tony.task.heartbeat-interval-ms": 250,
+        "tony.task.driver-outage-grace-ms": 60000,
+        "tony.serving.healthz-interval-ms": 200,
+        "tony.replica.instances": 2,
+        "tony.replica.command": serve_cmd,
+        "tony.replica.max-restarts": 1,
+        "tony.router.instances": 1,
+        "tony.router.command": route_cmd,
+        "tony.router.framework": "router",
+        "tony.router.max-restarts": 1,
+        # the hub scrapes the named serving role's replicas even with
+        # the autoscaler off (autoscale.enabled stays false)
+        "tony.autoscale.role": "replica",
+        "tony.slo.availability.objective": "availability",
+        "tony.slo.availability.target": TARGET,
+        "tony.slo.availability.window-s": WINDOW_S,
+        "tony.slo.scrape-interval-s": SCRAPE_S,
+        "tony.execution.env": " ".join([
+            f"PYTHONPATH={REPO}", "JAX_PLATFORMS=cpu",
+            f"{c.TEST_SERVING_STEP_DELAY_MS}={STEP_DELAY_MS}"]),
+    })
+    t_bench = time.time()
+    client = TonyClient(conf, poll_interval_s=0.2)
+    client.submit()
+    job_dir = Path(client.job_dir)
+    disco_router = DriverDiscovery(str(job_dir), role="router",
+                                   token=client.token)
+    disco_replica = DriverDiscovery(str(job_dir), role="replica",
+                                    token=client.token)
+
+    def endpoints(disco):
+        try:
+            return {tid: (host, port) for tid, host, port in disco()}
+        except Exception:
+            return {}
+
+    def get_json(url, timeout=10):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+
+    def slo_snap(want_pid=None):
+        """The live driver's /slo snapshot via driver.json; None while
+        the endpoint (or the wanted driver incarnation) isn't up."""
+        try:
+            info = json.loads((job_dir / c.DRIVER_INFO_FILE).read_text())
+            if want_pid is not None and info.get("pid") != want_pid:
+                return None
+            port = info["metrics_port"]
+            return get_json(f"http://127.0.0.1:{port}/slo", timeout=5)
+        except Exception:
+            return None
+
+    def fast_alert(snap):
+        if not snap or not snap.get("evaluated"):
+            return None
+        for a in snap["alerts"]:
+            if a["slo"] == "availability" and a["severity"] == "fast":
+                return a["firing"]
+        return None
+
+    def journal_alert_records():
+        recs = []
+        for line in (job_dir / c.DRIVER_JOURNAL_FILE).read_text(
+                ).splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("op") == "slo_alert":
+                recs.append(rec)
+        return recs
+
+    results: dict[int, str] = {}
+    marks: dict[str, float] = {}
+    rec = logf = None
+    try:
+        deadline = time.time() + 240
+        doors = reps = {}
+        while time.time() < deadline:
+            doors = endpoints(disco_router)
+            reps = endpoints(disco_replica)
+            if len(doors) == 1 and len(reps) == 2:
+                break
+            time.sleep(0.3)
+        assert len(doors) == 1, f"front door never up: {doors}"
+        assert len(reps) == 2, f"replica fleet never fully up: {reps}"
+        door_port = doors["router:0"][1]
+
+        chunk = e["prefill_chunk"]
+
+        def prompt(i):
+            # per-call generator: prompt() runs on many client threads
+            # at once and a shared numpy Generator is not thread-safe
+            return np.random.default_rng(1000 + i).integers(
+                0, e["vocab"], size=chunk + 1 + i % 3,
+                dtype=np.int32).tolist()
+
+        def call(i, tag):
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{door_port}/generate",
+                    data=json.dumps({"prompt": prompt(i),
+                                     "max_new_tokens": MAX_NEW}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    json.loads(r.read().decode())
+                results[i] = "ok"
+            except Exception:
+                # shed/failed during the incident — the SLO's bad events
+                results[i] = f"{tag}_err"
+
+        # ---- phase 1: healthy warm-up — ZERO alerts
+        t_first_request = time.time()
+        warm = [threading.Thread(target=call, args=(i, "warm"))
+                for i in range(WARMUP_REQS)]
+        for th in warm:
+            th.start()
+            time.sleep(WARMUP_GAP_S)
+        for th in warm:
+            th.join(timeout=120)
+        assert all(results[i] == "ok" for i in range(WARMUP_REQS)), (
+            f"healthy warm-up had failures: {results}")
+        deadline = time.time() + 30
+        snap = None
+        while time.time() < deadline:
+            snap = slo_snap()
+            if snap and snap.get("evaluated"):
+                break
+            time.sleep(0.3)
+        assert snap and snap.get("evaluated"), "SLO engine never evaluated"
+        assert snap["history"] == [], (
+            f"alerts fired on a HEALTHY warm-up: {snap['history']}")
+        assert all(not a["firing"] for a in snap["alerts"]), snap["alerts"]
+
+        # ---- phase 2: replica SIGKILL + SUSTAINED Poisson overload ->
+        # the survivor sheds, the fast pair must fire inside its
+        # window. The pressure keeps flowing until the recovered
+        # driver confirms the resumed alert: the fast pair's SHORT
+        # window empties ~2s after sheds stop, and a cleared alert
+        # would make the driver kill land post-incident.
+        victim_stats = get_json(
+            f"http://127.0.0.1:{reps['replica:0'][1]}/stats")
+        os.kill(victim_stats["pid"], _signal.SIGKILL)
+        marks["replica_killed"] = time.time()
+        stop_pressure = threading.Event()
+        pressure_n = {"i": WARMUP_REQS}
+        pressure_rng = np.random.default_rng(29)
+
+        def pressure():
+            # ~50 req/s against a shedding survivor (and still past the
+            # relaunched 2-replica fleet's capacity): bad events flow
+            # continuously across the replica kill, the driver kill,
+            # and the recovery
+            while not stop_pressure.is_set():
+                i = pressure_n["i"]
+                pressure_n["i"] += 1
+                threading.Thread(target=call, args=(i, "incident"),
+                                 daemon=True).start()
+                time.sleep(float(pressure_rng.exponential(
+                    PRESSURE_MEAN_S)))
+
+        pressure_t = threading.Thread(target=pressure, daemon=True)
+        pressure_t.start()
+        fired_at = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if fast_alert(slo_snap()) is True:
+                fired_at = time.time()
+                break
+            time.sleep(0.2)
+        assert fired_at is not None, (
+            "fast burn-rate alert never fired under the overload "
+            f"incident: {slo_snap()}")
+        marks["alert_fired"] = fired_at
+        firings = [r for r in journal_alert_records()
+                   if r["severity"] == "fast" and r["state"] == "firing"]
+        assert len(firings) == 1, firings
+
+        # ---- phase 3: driver SIGKILL + --recover MID-INCIDENT — the
+        # replayed tsdb + journal-seeded alert state must RESUME the
+        # firing alert without a duplicate transition
+        os.kill(client._driver_proc.pid, _signal.SIGKILL)
+        client._driver_proc.wait(timeout=10)
+        marks["driver_killed"] = time.time()
+        rec, logf = _spawn_recovered_driver(job_dir, strip_env=[])
+        resumed = None
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            resumed = fast_alert(slo_snap(want_pid=rec.pid))
+            if resumed is not None:
+                break
+            time.sleep(0.3)
+        assert resumed is True, (
+            "recovered driver did not resume the mid-incident firing "
+            f"alert: {slo_snap(want_pid=rec.pid)}")
+        marks["alert_resumed"] = time.time()
+        fast_recs = [r for r in journal_alert_records()
+                     if r["severity"] == "fast"]
+        assert [r["state"] for r in fast_recs] == ["firing"], (
+            f"duplicate/flapped firing transition across recovery: "
+            f"{fast_recs}")
+
+        # ---- phase 4: the SIGKILLed replica relaunches on its restart
+        # budget; end the incident — the alert must CLEAR and healthy
+        # service resume
+        deadline = time.time() + 120
+        relaunched = False
+        while time.time() < deadline:
+            reps = endpoints(disco_replica)
+            if len(reps) == 2:
+                try:
+                    pids = {tid: get_json(
+                        f"http://127.0.0.1:{p}/stats", timeout=5)["pid"]
+                        for tid, (_, p) in reps.items()}
+                    if pids["replica:0"] != victim_stats["pid"]:
+                        relaunched = True
+                        break
+                except Exception:
+                    pass
+            time.sleep(0.5)
+        assert relaunched, f"SIGKILLed replica never relaunched: {reps}"
+        stop_pressure.set()
+        pressure_t.join(timeout=10)
+        marks["incident_over"] = time.time()
+        cleared_at = None
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if fast_alert(slo_snap(want_pid=rec.pid)) is False:
+                cleared_at = time.time()
+                break
+            time.sleep(0.3)
+        assert cleared_at is not None, (
+            "fast alert never cleared after the incident ended: "
+            f"{slo_snap(want_pid=rec.pid)}")
+        marks["alert_cleared"] = cleared_at
+        fast_recs = [r for r in journal_alert_records()
+                     if r["severity"] == "fast"]
+        assert [r["state"] for r in fast_recs] == ["firing", "clear"], (
+            f"fast alert transition ledger wrong: {fast_recs}")
+        # healthy service restored through the relaunched fleet
+        probe_i = pressure_n["i"] + 1
+        call(probe_i, "post")
+        assert results[probe_i] == "ok", (
+            "fleet did not serve healthily after the incident")
+
+        # ---- phase 5: budget exactness — the engine's availability
+        # accounting must equal (failed+shed)/total from the router's
+        # own exposition, bit-for-bit. Valid only while ALL traffic is
+        # inside the trailing SLO window (counters born at zero).
+        assert time.time() - t_first_request < WINDOW_S - 5, (
+            f"bench overran the SLO window "
+            f"({time.time() - t_first_request:.0f}s of "
+            f"{WINDOW_S:g}s): the budget-exactness gate would see "
+            "traffic age out")
+        def router_metrics_text():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{door_port}/metrics",
+                    timeout=10) as r:
+                return r.read().decode()
+
+        def counter_triple():
+            fams = parse_prom_text(router_metrics_text())
+            return tuple(
+                sum(fams[name].values()) if name in fams else 0.0
+                for name in ("router_requests_total",
+                             "router_shed_total",
+                             "router_requests_failed_total"))
+
+        # in-flight stragglers may still land: wait for the router's
+        # counters to go static, then let the hub scrape them
+        prev = counter_triple()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            time.sleep(1.0)
+            cur = counter_triple()
+            if cur == prev:
+                break
+            prev = cur
+        time.sleep(3 * SCRAPE_S)   # let the hub land the final counters
+        requests_total, shed_total, failed_total = prev
+        snap = slo_snap(want_pid=rec.pid)
+        avail = next(s for s in snap["eval"]["slos"]
+                     if s["name"] == "availability")
+        assert abs(avail["total"] - requests_total) < 1e-9, (
+            f"engine total {avail['total']} != router "
+            f"{requests_total}")
+        assert abs(avail["bad"] - (shed_total + failed_total)) < 1e-9, (
+            f"engine bad {avail['bad']} != shed+failed "
+            f"{shed_total + failed_total}")
+        expected_rate = (shed_total + failed_total) / requests_total
+        assert abs(avail["error_rate"] - expected_rate) < 1e-9, (
+            f"budget spend {avail['error_rate']} != (failed+shed)/total "
+            f"{expected_rate}")
+    finally:
+        for proc in (rec, client._driver_proc):
+            if proc is not None and proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, _signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        if rec is not None:
+            try:
+                rec.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(rec.pid, _signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        if logf is not None:
+            logf.close()
+
+    n_err = sum(1 for v in results.values() if v != "ok")
+    out = {
+        "metric": "slo_alerting",
+        "value": round(marks["alert_fired"] - marks["replica_killed"], 1),
+        "unit": "s replica-SIGKILL -> fast burn-rate alert firing "
+                "(multi-window, journaled, resumed across a driver "
+                "SIGKILL + --recover mid-incident)",
+        "objective": "availability",
+        "target": TARGET,
+        "window_s": WINDOW_S,
+        "requests": len(results),
+        "bad_requests_client_observed": n_err,
+        "router_requests_total": requests_total,
+        "router_bad_total": shed_total + failed_total,
+        "error_rate": round(expected_rate, 6),
+        "error_budget_remaining": round(
+            avail["error_budget_remaining"], 4),
+        "budget_accounting_exact": True,
+        "warmup_alerts": 0,
+        "fast_transitions": ["firing", "clear"],
+        "duplicate_firing_transitions": 0,
+        "alert_fire_s": round(
+            marks["alert_fired"] - marks["replica_killed"], 1),
+        "alert_resume_after_recover_s": round(
+            marks["alert_resumed"] - marks["driver_killed"], 1),
+        "alert_clear_s": round(
+            marks["alert_cleared"] - marks["replica_killed"], 1),
+        "driver_killed_mid_incident": True,
+        "wall_s": round(time.time() - t_bench, 1),
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def run_driver_failover_bench() -> int:
     """Control-plane robustness gate (module docstring; one JSON line ->
     PERF.json `control_plane_robustness`): driver death must be a
@@ -4642,6 +5066,8 @@ def main() -> int:
     if "--elastic" in sys.argv:
         return run_elastic_bench()
     if "--serving" in sys.argv:
+        if "--slo" in sys.argv:
+            return run_slo_bench()
         if "--router-ha" in sys.argv:
             return run_router_ha_bench()
         if "--tracing" in sys.argv:

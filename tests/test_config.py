@@ -15,7 +15,7 @@ def test_defaults_and_constants_cross_check():
     constants = {
         v for k, v in vars(keys).items()
         if k.isupper() and isinstance(v, str) and v.startswith("tony.")
-        and k not in ("PREFIX",)
+        and not k.endswith("PREFIX")  # namespace prefixes, not concrete keys
     }
     missing_in_defaults = constants - set(defaults)
     assert not missing_in_defaults, f"constants missing defaults: {missing_in_defaults}"

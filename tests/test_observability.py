@@ -34,6 +34,7 @@ from tony_tpu.observability import (
     RequestTrace,
     ServiceRateEstimator,
     ServingTelemetry,
+    parse_prom_text,
 )
 
 TINY = transformer.TransformerConfig(
@@ -409,6 +410,11 @@ def test_metrics_endpoint_matches_stats(params):
 
         for line in text.strip().splitlines():
             assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
+        # exposition conformance: the serve payload round-trips the
+        # SHARED strict parser (the one the fleet hub scrapes with) —
+        # cumulative buckets, +Inf == _count, no duplicate series
+        fams = parse_prom_text(text, strict=True)
+        assert "serving_ttft_seconds" in fams
         # every SERVING_* series named in metrics.py is present — except
         # the speculative families, which render only for spec-enabled
         # engines (this server has no draft; their live rendering is
@@ -560,6 +566,7 @@ def test_metrics_names_rendered_and_documented():
     import tony_tpu.observability as obs
     import tony_tpu.portal.server as portal_mod
     import tony_tpu.router as router_mod
+    import tony_tpu.slo as slo_mod
 
     consts = {name: val for name, val in vars(_metrics).items()
               if name.isupper() and isinstance(val, str)}
@@ -572,8 +579,12 @@ def test_metrics_names_rendered_and_documented():
         f"metrics.py names missing from docs/observability.md "
         f"(backticked): {undocumented}")
 
+    # slo.py renders INTO the driver's exposition (SLOEngine.render_into
+    # appends the driver_slo_* families to the driver's renderer), so it
+    # counts as a renderer source for the sweep
     sources = "".join(inspect.getsource(mod) for mod in
-                      (serve_mod, driver_mod, portal_mod, router_mod))
+                      (serve_mod, driver_mod, portal_mod, router_mod,
+                       slo_mod))
     unrendered = sorted(
         f"{name} ({val})" for name, val in consts.items()
         if val.startswith(("serving_", "driver_", "router_"))
@@ -785,6 +796,21 @@ def test_metrics_names_rendered_and_documented():
         "serve /metrics lost its per-model label partition")
     assert "Per-model labels" in doc, (
         "docs/observability.md lost the per-model-labels section")
+
+    # the metrics-pipeline + SLO families are pinned EXPLICITLY the
+    # same way (ISSUE 20 lint discipline): the hub's self-telemetry,
+    # the unified scrape-failure counter, and the burn-rate/budget/
+    # alert families on driver /metrics — each must be rendered and
+    # documented; renaming either side without the other fails here
+    for fam in (_metrics.DRIVER_AUTOSCALE_SCRAPE_FAILURES_TOTAL,
+                _metrics.DRIVER_METRICSHUB_SCRAPES_TOTAL,
+                _metrics.DRIVER_METRICSHUB_SERIES,
+                _metrics.DRIVER_METRICSHUB_TARGETS,
+                _metrics.DRIVER_SLO_BURN_RATE,
+                _metrics.DRIVER_SLO_ERROR_BUDGET_REMAINING,
+                _metrics.DRIVER_SLO_ALERTS_FIRING):
+        assert fam in rendered, f"slo/hub family unrendered: {fam}"
+        assert fam in doc_names, f"slo/hub family undocumented: {fam}"
 
 
 def test_finish_reason_vocabulary_pinned():

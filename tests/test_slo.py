@@ -1,0 +1,659 @@
+"""Fleet metrics pipeline: MetricsHub TSDB + SLO burn-rate alerting
+(tony_tpu/metricshub.py, tony_tpu/slo.py — docs/observability.md
+"Metrics pipeline & SLO alerting").
+
+The contract under test: the hub retains every scraped sample in
+bounded rings (max_points AND retention_s both bind) with restart-safe
+counter-reset offsets (the generalization of bucket_delta's clamp);
+windows are queryable as increases/bucket deltas; the TSDB file
+round-trips through load() (torn lines skipped, offsets rebuilt in
+order) and compacts to the retention horizon; the SLO engine's
+multi-window pairs fire only when BOTH windows burn above threshold,
+clear after CLEAR_TICKS clean evaluations, journal every transition,
+and RESUME journal-seeded alerts across a simulated driver recovery
+without a duplicate firing transition; and every exposition surface
+(driver, router, portal, the SLO renderer itself) round-trips the
+shared strict parser. All shapes are synthetic — no model, no JAX.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tony_tpu import metrics as _metrics
+from tony_tpu.conf import TonyConf
+from tony_tpu.events.driver_journal import DriverJournal, load_state
+from tony_tpu.metricshub import TSDB_FILE, MetricsHub
+from tony_tpu.observability import PromRenderer, parse_prom_text
+from tony_tpu.slo import (
+    CLEAR_TICKS,
+    SLObjective,
+    SLOEngine,
+    good_under_threshold,
+    slo_objectives_from_conf,
+)
+
+
+def _hub(**kw):
+    kw.setdefault("retention_s", 1e9)
+    kw.setdefault("max_points", 720)
+    return MetricsHub(**kw)
+
+
+def _avail_text(req: float, failed: float, shed: float = 0.0) -> str:
+    return (f"{_metrics.ROUTER_REQUESTS_TOTAL}{{replica=\"r0\"}} {req}\n"
+            f"{_metrics.ROUTER_FAILED_TOTAL} {failed}\n"
+            f"{_metrics.ROUTER_SHED_TOTAL}{{replica=\"r0\"}} {shed}\n")
+
+
+# --------------------------------------------------------------------------
+# ring retention: max_points and retention_s both bind
+# --------------------------------------------------------------------------
+
+def test_ring_retention_bounds():
+    hub = _hub(retention_s=100.0, max_points=8)
+    for i in range(50):
+        hub.ingest("t", f"some_gauge {i}\n", now=1000.0 + i)
+    (series,) = hub._series.values()
+    assert len(series.ring) <= 8, "max_points must bound the ring"
+    assert series.latest() == 49.0
+    # retention_s prunes the old edge even under max_points
+    hub2 = _hub(retention_s=5.0, max_points=1000)
+    for i in range(50):
+        hub2.ingest("t", f"some_gauge {i}\n", now=1000.0 + i)
+    (s2,) = hub2._series.values()
+    assert all(ts >= 1049.0 - 5.0 for ts, _ in s2.ring), (
+        "points past the retention horizon must be pruned")
+    assert s2.latest() == 49.0
+
+
+def test_counter_reset_offset_at_hub_layer():
+    """The per-series monotonic offset generalizes bucket_delta's clamp:
+    a raw sample dropping below its predecessor (exporter restart) folds
+    the predecessor into the offset, so window increases across the
+    restart equal the fresh process's contribution — and the full-run
+    increase equals the sum of both processes' lifetimes."""
+    hub = _hub()
+    hub.ingest("t", "reqs_total 100\n", now=10.0)
+    hub.ingest("t", "reqs_total 150\n", now=20.0)
+    hub.ingest("t", "reqs_total 30\n", now=30.0)     # restarted at 0
+    hub.ingest("t", "reqs_total 70\n", now=40.0)
+    # window starting after the last pre-restart sample: only the fresh
+    # process's 70 (the clamp equivalence)
+    assert hub.window_increase("reqs_total", 15.0, now=40.0) == \
+        pytest.approx(70.0)
+    # window spanning the restart: 100->150 (+50) plus 0->70 (+70)
+    assert hub.window_increase("reqs_total", 25.0, now=40.0) == \
+        pytest.approx(120.0)
+    # full run: 150 from the first process + 70 from the second
+    assert hub.window_increase("reqs_total", 1e6, now=40.0) == \
+        pytest.approx(220.0)
+    # gauges do NOT get the offset — a drop is a real drop
+    hub.ingest("t", "depth_gauge 9\n", now=10.0)
+    hub.ingest("t", "depth_gauge 2\n", now=20.0)
+    assert hub.latest("depth_gauge") == 2.0
+
+
+def test_window_buckets_sum_and_model_exclusion():
+    """window_buckets merges a histogram family's cumulative buckets
+    across targets as windowed increases, skipping the {model=...}
+    partitions exactly like scrape_ttft_buckets does."""
+    text0 = ('serving_ttft_seconds_bucket{le="0.1"} 0\n'
+             'serving_ttft_seconds_bucket{le="+Inf"} 0\n')
+    text1 = ('serving_ttft_seconds_bucket{le="0.1"} 3\n'
+             'serving_ttft_seconds_bucket{le="+Inf"} 5\n'
+             'serving_ttft_seconds_bucket{model="m",le="0.1"} 100\n')
+    hub = _hub()
+    for tg in ("a", "b"):
+        hub.ingest(tg, text0, now=10.0)
+        hub.ingest(tg, text1, now=20.0)
+    got = hub.window_buckets("serving_ttft_seconds", 15.0, now=20.0)
+    assert got == {"0.1": 6.0, "+Inf": 10.0}, (
+        "summed across targets, model partition excluded")
+
+
+# --------------------------------------------------------------------------
+# TSDB persistence: round-trip, torn lines, compaction
+# --------------------------------------------------------------------------
+
+def test_tsdb_persist_load_roundtrip(tmp_path):
+    hub = _hub(persist_dir=tmp_path)
+    hub.ingest("router", _avail_text(100, 2), now=10.0)
+    hub.ingest("router", _avail_text(40, 3), now=20.0)   # reset mid-run
+    hub.stop()
+    path = tmp_path / TSDB_FILE
+    assert path.exists()
+    # torn tail + garbage line: both skipped on load
+    with open(path, "a") as f:
+        f.write("not json\n")
+        f.write('{"t": 30.0, "tg": "rout')
+    hub2 = _hub()
+    n = hub2.load(path)
+    assert n == 2
+    for name in (_metrics.ROUTER_REQUESTS_TOTAL,
+                 _metrics.ROUTER_FAILED_TOTAL):
+        assert hub2.window_increase(name, 1e6, now=20.0) == \
+            hub.window_increase(name, 1e6, now=20.0), (
+            f"replayed window must match the live hub for {name}")
+    # the reset offset rebuilt in record order: 100 + 40
+    assert hub2.window_increase(
+        _metrics.ROUTER_REQUESTS_TOTAL, 1e6, now=20.0) == \
+        pytest.approx(140.0)
+
+
+def test_tsdb_compaction_to_retention_horizon(tmp_path):
+    hub = MetricsHub(persist_dir=tmp_path, retention_s=50.0,
+                     max_points=720, max_persist_lines=10)
+    for i in range(30):
+        hub.ingest("t", f"c_total {i}\n", now=1000.0 + 10 * i)
+    hub.stop()
+    recs = [json.loads(l) for l in
+            (tmp_path / TSDB_FILE).read_text().splitlines()]
+    assert len(recs) <= 12, "compaction must bound the file"
+    # compaction lags appends by up to one fill cycle: every record is
+    # inside the horizon AS OF the newest compaction, which is at most
+    # max_persist_lines appends behind the final scrape
+    last_compact_t = 1000.0 + 10 * 25      # lines crest max at i=25
+    assert all(r["t"] >= last_compact_t - 50.0 for r in recs), (
+        "compaction keeps only records inside the retention horizon")
+
+
+# --------------------------------------------------------------------------
+# objective parsing + the good-under-threshold interpolation
+# --------------------------------------------------------------------------
+
+def test_slo_objectives_from_conf():
+    conf = TonyConf({
+        "tony.slo.avail.objective": "availability",
+        "tony.slo.avail.target": 0.999,
+        "tony.slo.avail.window-s": 120,
+        "tony.slo.ttft.objective": "ttft-p99",
+        "tony.slo.ttft.target": 0.99,
+        "tony.slo.ttft.threshold-s": 0.25,
+        "tony.slo.bogus.objective": "nonsense",       # skipped
+        "tony.slo.nothresh.objective": "tpot-p99",    # skipped: no
+        #                                               threshold-s
+        "tony.slo.badtarget.objective": "availability",
+        "tony.slo.badtarget.target": 1.5,             # skipped
+    })
+    slos = {s.name: s for s in slo_objectives_from_conf(conf)}
+    assert set(slos) == {"avail", "ttft"}
+    avail = slos["avail"]
+    assert avail.target == 0.999 and avail.window_s == 120.0
+    assert avail.pairs() == {
+        "fast": (20.0, 2.0, 14.4), "slow": (120.0, 20.0, 6.0)}
+    assert avail.windows() == [2.0, 20.0, 120.0]
+    assert slos["ttft"].threshold_s == 0.25
+
+
+def test_good_under_threshold_interpolation():
+    buckets = {"0.1": 10.0, "1.0": 20.0, "+Inf": 20.0}
+    # inside the (0.1, 1.0] bucket: linear share of its 10 counts
+    assert good_under_threshold(buckets, 0.55) == pytest.approx(
+        10.0 + 10.0 * (0.55 - 0.1) / 0.9)
+    assert good_under_threshold(buckets, 0.05) == pytest.approx(5.0)
+    # threshold past every finite bound: the honest floor (the +Inf
+    # bucket's width is unknowable)
+    assert good_under_threshold(buckets, 2.0) == 20.0
+
+
+# --------------------------------------------------------------------------
+# burn-rate window math: real rings, hand-computed ratios
+# --------------------------------------------------------------------------
+
+def test_burn_rate_windows_from_rings():
+    """Availability burn over real ingested counters: 3600 healthy
+    requests over the hour, the last 60 s all-failing. The W/60 window
+    burns at 100x, W/6 at 10x, W at ~1.7x — so NEITHER pair fires
+    (each needs BOTH its windows above threshold), which is exactly
+    the multi-window recipe's point: one hot minute does not page."""
+    hub = _hub()
+    slo = SLObjective(name="avail", objective="availability",
+                      target=0.99, window_s=3600.0)
+    for t, req, fail in ((0.0, 0, 0), (3000.0, 3000, 0),
+                         (3540.0, 3540, 0), (3600.0, 3600, 60)):
+        hub.ingest("router", _avail_text(req, fail), now=t)
+    eng = SLOEngine(hub, [slo], now_fn=lambda: 3600.0)
+    assert eng.burn_rate(slo, 60.0) == pytest.approx(100.0)
+    assert eng.burn_rate(slo, 600.0) == pytest.approx(10.0)
+    assert eng.burn_rate(slo, 3600.0) == pytest.approx(
+        (60.0 / 3600.0) / 0.01)
+    snap = eng.evaluate()
+    (s,) = snap["slos"]
+    assert s["alerts"] == {"fast": False, "slow": False}, (
+        "a single hot short window must not fire either pair")
+    assert s["error_budget_remaining"] == pytest.approx(
+        1.0 - (60.0 / 3600.0) / 0.01)
+
+
+class _ScriptedHub:
+    """Engine-facing stub: scripted (bad, total) per window — exact
+    control over each pair's two windows."""
+
+    def __init__(self):
+        self.rates: dict[float, tuple[float, float]] = {}
+
+    def window_increase(self, name, window_s, labels=None, target=None,
+                        now=None):
+        bad, total = self.rates.get(window_s, (0.0, 0.0))
+        if name == _metrics.ROUTER_REQUESTS_TOTAL:
+            return total
+        if name == _metrics.ROUTER_FAILED_TOTAL:
+            return bad
+        return 0.0
+
+    def window_buckets(self, family, window_s, now=None,
+                       exclude_labels=("model",), target=None):
+        return {}
+
+
+def _scripted_engine(**kw):
+    slo = SLObjective(name="avail", objective="availability",
+                      target=0.99, window_s=3600.0)
+    hub = _ScriptedHub()
+    eng = SLOEngine(hub, [slo], now_fn=lambda: 0.0, **kw)
+    return eng, hub, slo
+
+
+def _set_burn(hub, window_s, burn, total=1000.0):
+    # burn = (bad/total) / (1 - target), target 0.99 => bad = burn*10
+    hub.rates[window_s] = (burn * (1.0 - 0.99) * total, total)
+
+
+def test_alert_pairs_need_both_windows_and_clear_ticks():
+    eng, hub, slo = _scripted_engine()
+    # fast pair = (600, 60) @ 14.4; slow pair = (3600, 600) @ 6
+    _set_burn(hub, 60.0, 100.0)
+    _set_burn(hub, 600.0, 2.0)
+    _set_burn(hub, 3600.0, 0.5)
+    snap = eng.evaluate()
+    assert snap["slos"][0]["alerts"] == {"fast": False, "slow": False}
+
+    _set_burn(hub, 600.0, 20.0)          # both fast windows now hot
+    snap = eng.evaluate()
+    assert snap["slos"][0]["alerts"]["fast"] is True
+    assert snap["slos"][0]["alerts"]["slow"] is False, (
+        "slow pair needs the FULL window hot too")
+
+    _set_burn(hub, 3600.0, 7.0)
+    snap = eng.evaluate()
+    assert snap["slos"][0]["alerts"] == {"fast": True, "slow": True}
+
+    # recovery: the short windows drain first; clearing takes
+    # CLEAR_TICKS consecutive clean evaluations (anti-flap)
+    for w in (60.0, 600.0, 3600.0):
+        _set_burn(hub, w, 0.0)
+    for i in range(CLEAR_TICKS - 1):
+        assert eng.evaluate()["slos"][0]["alerts"]["fast"] is True, (
+            f"must stay firing through clear tick {i + 1}")
+    snap = eng.evaluate()
+    assert snap["slos"][0]["alerts"] == {"fast": False, "slow": False}
+    states = [(h["severity"], h["state"]) for h in eng.history]
+    assert states == [("fast", "firing"), ("slow", "firing"),
+                      ("fast", "clear"), ("slow", "clear")]
+
+
+# --------------------------------------------------------------------------
+# alert journal replay: a recovered driver resumes, never re-fires
+# --------------------------------------------------------------------------
+
+def test_alert_journal_replay_across_recovery(tmp_path):
+    """Driver #1 journals a fast-burn firing; driver #2 replays the
+    journal, seeds the engine, and — with the incident still hot —
+    keeps the alert FIRING with zero new transitions. The clear, when
+    it comes, is journaled exactly once."""
+    jpath = tmp_path / "driver.journal.jsonl"
+    j1 = DriverJournal(jpath)
+    j1.record("meta", app_id="slo_test", token="", session_id=1,
+              rpc_port=1, driver_generation=1)
+    eng1, hub1, _ = _scripted_engine(
+        record_fn=lambda slo, sev, state, t: j1.record(
+            "slo_alert", slo=slo, severity=sev, state=state, t=t))
+    _set_burn(hub1, 60.0, 100.0)
+    _set_burn(hub1, 600.0, 20.0)
+    eng1.evaluate()
+    assert eng1.alerts[("avail", "fast")] is True
+    j1.close()
+    raw = jpath.read_text()
+    assert raw.count('"slo_alert"') == 1
+
+    # --- driver death; recovery replays the journal
+    state = load_state(jpath)
+    assert state.slo_alerts == {
+        "avail:fast": {"state": "firing",
+                       "t": state.slo_alerts["avail:fast"]["t"]}}
+    initial = {}
+    for key, entry in state.slo_alerts.items():
+        name, _, sev = key.rpartition(":")
+        initial[(name, sev)] = entry.get("state") == "firing"
+
+    j2 = DriverJournal(jpath)
+    eng2, hub2, _ = _scripted_engine(
+        record_fn=lambda slo, sev, state, t: j2.record(
+            "slo_alert", slo=slo, severity=sev, state=state, t=t),
+        initial_alerts=initial)
+    _set_burn(hub2, 60.0, 100.0)          # incident still hot
+    _set_burn(hub2, 600.0, 20.0)
+    snap = eng2.evaluate()
+    assert snap["slos"][0]["alerts"]["fast"] is True
+    assert not eng2.history, "resumed alert must not re-transition"
+    assert jpath.read_text().count('"slo_alert"') == 1, (
+        "a resumed firing alert must not journal a duplicate firing")
+
+    # the incident ends: exactly one journaled clear
+    for w in (60.0, 600.0, 3600.0):
+        _set_burn(hub2, w, 0.0)
+    for _ in range(CLEAR_TICKS):
+        eng2.evaluate()
+    j2.close()
+    recs = [json.loads(l) for l in jpath.read_text().splitlines()
+            if '"slo_alert"' in l]
+    assert [(r["severity"], r["state"]) for r in recs] == [
+        ("fast", "firing"), ("fast", "clear")]
+    # a THIRD replay sees the cleared state
+    assert load_state(jpath).slo_alerts["avail:fast"]["state"] == "clear"
+
+
+# --------------------------------------------------------------------------
+# exposition conformance: every renderer round-trips the strict parser
+# --------------------------------------------------------------------------
+
+def test_slo_renderer_strict_roundtrip():
+    eng, hub, _ = _scripted_engine()
+    _set_burn(hub, 60.0, 100.0)
+    _set_burn(hub, 600.0, 20.0)
+    eng.evaluate()
+    r = PromRenderer()
+    eng.render_into(r)
+    fams = parse_prom_text(r.render(), strict=True)
+    assert set(fams) == {_metrics.DRIVER_SLO_BURN_RATE,
+                         _metrics.DRIVER_SLO_ERROR_BUDGET_REMAINING,
+                         _metrics.DRIVER_SLO_ALERTS_FIRING}
+    burn = fams[_metrics.DRIVER_SLO_BURN_RATE]
+    assert burn.values(slo="avail", window_s="60") == [
+        pytest.approx(100.0)]
+    firing = fams[_metrics.DRIVER_SLO_ALERTS_FIRING]
+    assert firing.values(slo="avail", severity="fast") == [1.0]
+    assert firing.values(slo="avail", severity="slow") == [0.0]
+
+
+def test_router_exposition_strict_roundtrip():
+    from tony_tpu.router import FleetRouter
+
+    router = FleetRouter([("r0", "127.0.0.1", 1)], seed=0)
+    fams = parse_prom_text(router.prometheus_metrics(), strict=True)
+    assert _metrics.ROUTER_REPLICAS_LIVE in fams
+
+
+def test_portal_exposition_and_slo_route(tmp_path):
+    """The portal round-trips its own /metrics through the strict
+    parser, and /slo/<app_id> serves the offline dashboard (JSON and
+    HTML) replayed from the job's persisted TSDB + journal."""
+    from tony_tpu.portal.server import serve_portal
+
+    app_id = "slo_app"
+    staging = tmp_path / "staging" / app_id
+    staging.mkdir(parents=True)
+    (staging / "tony-final.json").write_text(json.dumps({
+        "tony.slo.avail.objective": "availability",
+        "tony.slo.avail.target": 0.99,
+        "tony.slo.avail.window-s": 3600,
+    }))
+    hub = MetricsHub(persist_dir=staging, retention_s=1e9)
+    for t, req, fail in ((0.0, 0, 0), (3000.0, 3000, 0),
+                         (3600.0, 3600, 60)):
+        hub.ingest("router", _avail_text(req, fail), now=t)
+    hub.stop()
+    j = DriverJournal(staging / "driver.journal.jsonl")
+    j.record("meta", app_id=app_id, token="", session_id=1,
+             rpc_port=1, driver_generation=1)
+    j.record("slo_alert", slo="avail", severity="fast",
+             state="firing", t=3590.0)
+    j.close()
+
+    conf = TonyConf({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.intermediate": str(tmp_path / "hist" / "inter"),
+        "tony.history.finished": str(tmp_path / "hist" / "fin"),
+    })
+    server = serve_portal(conf, port=0, block=False)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        def get(path, accept="application/json"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                headers={"Accept": accept})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, resp.headers, resp.read().decode()
+
+        # portal self-exposition is strictly conformant
+        _, _, text = get("/metrics", accept="text/plain")
+        fams = parse_prom_text(text, strict=True)
+        assert "portal_http_requests_total" in fams
+
+        # JSON dashboard: evaluated at the LAST tsdb timestamp, alert
+        # state seeded from the journal
+        status, headers, body = get(f"/slo/{app_id}")
+        assert status == 200
+        assert headers["Content-Type"].startswith("application/json")
+        data = json.loads(body)
+        assert data["t"] == 3600.0
+        (s,) = data["eval"]["slos"]
+        assert s["error_budget_remaining"] == pytest.approx(
+            1.0 - (60.0 / 3600.0) / 0.01)
+        assert len(s["spark_burn"]) == len(s["spark_budget"]) == 32
+        assert {(a["slo"], a["severity"]): a["firing"]
+                for a in data["alerts"]}[("avail", "fast")] is True, (
+            "journal-seeded alert state must surface on the dashboard")
+
+        # HTML render carries the dashboard elements
+        _, _, html_body = get(f"/slo/{app_id}", accept="text/html")
+        assert "error budget remaining" in html_body
+        assert "avail" in html_body and "FIRING" in html_body
+
+        # unknown job 404s as JSON null
+        try:
+            get("/slo/not_a_job")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# --------------------------------------------------------------------------
+# driver integration e2e: hub scrape loop -> engine -> /slo + /metrics
+# --------------------------------------------------------------------------
+
+class _MetricsStub:
+    """A replica endpoint under test control: /stats + a slow-TTFT
+    /metrics histogram the hub scrapes (the test_autoscale
+    _StatsServer, minus the autoscaler knobs)."""
+
+    def __init__(self):
+        self.slow = 0           # cumulative observations in (1, +Inf]
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/stats":
+                    body = json.dumps({"queued": 0, "active": 0}).encode()
+                    ctype = "application/json"
+                elif self.path == "/metrics":
+                    s = outer.slow
+                    body = (
+                        f'serving_ttft_seconds_bucket{{le="0.1"}} 0\n'
+                        f'serving_ttft_seconds_bucket{{le="1.0"}} 0\n'
+                        f'serving_ttft_seconds_bucket{{le="+Inf"}} {s}\n'
+                    ).encode()
+                    ctype = "text/plain"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.port = self.httpd.server_address[1]
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _wait(pred, timeout=20, every=0.05, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(every)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_driver_hub_slo_e2e(tmp_job_dirs, tmp_path):
+    """A driver with a declared TTFT SLO: the hub's jittered loop
+    scrapes the replica's /metrics and the driver's own renderer,
+    the engine evaluates each round, an all-slow burst fires the fast
+    pair (journaled), the driver /slo HTTP route and the driver_slo_*/
+    driver_metricshub_* exposition families surface it, the unified
+    scrape-failure counter renders, the TSDB file persists — and the
+    whole driver payload round-trips the strict parser."""
+    import tony_tpu.constants as c
+    from tony_tpu.cluster.provisioner import ContainerHandle, Provisioner
+    from tony_tpu.driver import Driver
+    from tony_tpu.rpc import RpcClient
+
+    stub = _MetricsStub()
+
+    class Prov(Provisioner):
+        def launch(self, spec, index, env, log_dir):
+            handle = ContainerHandle(
+                container_id=f"stub_{index}", host="127.0.0.1",
+                role=spec.name, index=index)
+            handle.extra["stop"] = threading.Event()
+
+            def run():
+                rpc = RpcClient(env[c.ENV_DRIVER_HOST],
+                                int(env[c.ENV_DRIVER_PORT]),
+                                token=env.get(c.ENV_TOKEN, ""),
+                                role="executor")
+                rpc.call("register_worker", task_id="replica:0",
+                         host="127.0.0.1", port=23900)
+                while rpc.call("get_cluster_spec",
+                               task_id="replica:0") is None:
+                    time.sleep(0.03)
+                rpc.call("publish_ports", task_id="replica:0",
+                         ports={"serve_port": stub.port})
+                handle.extra["stop"].wait(60)
+                rpc.call("register_execution_result",
+                         task_id="replica:0", exit_code=0)
+                rpc.close()
+                if self.on_completion:
+                    self.on_completion(handle, 0)
+
+            threading.Thread(target=run, daemon=True).start()
+            return handle
+
+        def stop_container(self, handle):
+            handle.extra["stop"].set()
+
+        def stop_all(self):
+            pass
+
+    conf = TonyConf({
+        "tony.staging.dir": tmp_job_dirs["staging"],
+        "tony.history.location": tmp_job_dirs["history"],
+        "tony.history.intermediate": tmp_job_dirs["history"] + "/intermediate",
+        "tony.history.finished": tmp_job_dirs["history"] + "/finished",
+        "tony.am.monitor-interval-ms": 50,
+        "tony.task.registration-poll-interval-ms": 50,
+        "tony.replica.instances": 1,
+        "tony.replica.command": "stub",
+        "tony.application.framework": "serving",
+        # W=60 -> fast pair (10s, 1s) @ 14.4x: an all-slow burst fires
+        # within a couple of 0.2s scrape rounds
+        "tony.slo.ttft.objective": "ttft-p99",
+        "tony.slo.ttft.target": 0.99,
+        "tony.slo.ttft.window-s": 60,
+        "tony.slo.ttft.threshold-s": 0.25,
+        "tony.slo.scrape-interval-s": 0.2,
+    })
+    job_dir = tmp_path / "job_slo"
+    job_dir.mkdir(exist_ok=True)
+    conf.write_final(job_dir)
+    driver = Driver(conf, app_id="slo_e2e", job_dir=str(job_dir),
+                    token="slo-secret", provisioner=Prov())
+    driver.client_signal.set()
+    t = threading.Thread(target=driver.run, daemon=True)
+    t.start()
+    try:
+        _wait(lambda: driver._slo_engine is not None
+              and driver._slo_engine.last_eval is not None,
+              msg="first SLO evaluation")
+        port = driver.metrics_port
+        assert port, "driver metrics server must be up"
+
+        def slo_snapshot():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/slo", timeout=10) as r:
+                return json.loads(r.read())
+
+        snap = slo_snapshot()
+        assert snap["evaluated"] and snap["eval"]["slos"], snap
+        assert snap["eval"]["slos"][0]["alerts"] == {
+            "fast": False, "slow": False}, (
+            "a healthy warm-up must not fire")
+
+        # an all-slow burst, fed over several scrape rounds so both
+        # fast-pair windows see an increase
+        def burst_then_firing():
+            stub.slow += 50
+            return any(a["severity"] == "fast" and a["firing"]
+                       for a in slo_snapshot()["alerts"])
+        _wait(burst_then_firing, timeout=30, every=0.2,
+              msg="fast-burn alert")
+
+        # the transition was journaled (recovery's seed data)
+        state = load_state(job_dir / "driver.journal.jsonl")
+        assert state.slo_alerts.get("ttft:fast", {}).get(
+            "state") == "firing"
+
+        # exposition: strict round-trip + every new family present
+        hub = driver._metrics_hub
+        hub.failures["ghost"] = 1       # a failed target must surface
+        text = driver.render_metrics()
+        fams = parse_prom_text(text, strict=True)
+        firing = fams[_metrics.DRIVER_SLO_ALERTS_FIRING]
+        assert firing.values(slo="ttft", severity="fast") == [1.0]
+        assert _metrics.DRIVER_SLO_BURN_RATE in fams
+        assert _metrics.DRIVER_SLO_ERROR_BUDGET_REMAINING in fams
+        assert fams[_metrics.DRIVER_METRICSHUB_TARGETS].values()[0] >= 2, (
+            "hub must scrape the replica AND self-collect the driver")
+        assert fams[_metrics.DRIVER_METRICSHUB_SCRAPES_TOTAL].values()[0] > 0
+        scrape_fail = fams[_metrics.DRIVER_AUTOSCALE_SCRAPE_FAILURES_TOTAL]
+        assert scrape_fail.values(target="ghost") == [1.0]
+
+        # the TSDB persisted under the job dir (recovery's replay data)
+        assert (job_dir / TSDB_FILE).exists()
+    finally:
+        driver._stop_requested.set()
+        for h in list(driver._handles.values()):
+            h.extra["stop"].set()
+        t.join(timeout=20)
+        stub.close()

@@ -53,7 +53,6 @@ from __future__ import annotations
 
 import json
 import logging
-import re
 import threading
 import time
 import urllib.request
@@ -61,6 +60,7 @@ from dataclasses import dataclass
 
 from .api import TaskStatus
 from .conf import TonyConf, keys
+from .observability import parse_prom_text
 
 log = logging.getLogger(__name__)
 
@@ -71,26 +71,43 @@ log = logging.getLogger(__name__)
 TTFT_FAMILY = "serving_ttft_seconds"
 TPOT_FAMILY = "serving_tpot_seconds"
 
-_BUCKET_RE = re.compile(
-    r'^(?P<fam>[a-z0-9_]+)_bucket\{[^}]*le="(?P<le>[^"]+)"[^}]*\}\s+'
-    r'(?P<val>[0-9.eE+-]+)\s*$')
-
 
 def scrape_ttft_buckets(text: str, family: str = TTFT_FAMILY) -> dict:
-    """Parse one Prometheus exposition payload into the cumulative
-    bucket counts of ``family`` ({le-string: count}). Only the
-    UNLABELED family partition is read (per-model partitions carry a
-    ``model=`` label and would double-count)."""
-    out: dict[str, float] = {}
-    for line in text.splitlines():
-        m = _BUCKET_RE.match(line.strip())
-        if m is None or m.group("fam") != family:
+    """Cumulative bucket counts of ``family`` ({le-string: count}) from
+    one Prometheus exposition payload, via the shared parser
+    (observability.parse_prom_text). Per-model partitions carry a
+    ``model=`` label and would double-count the unlabeled process
+    aggregate, so they are excluded from the control-law sum — use
+    ``scrape_bucket_partitions`` to read them."""
+    fam = parse_prom_text(text).get(family)
+    return fam.buckets(exclude=("model",)) if fam else {}
+
+
+def _family_partitions(fam) -> dict:
+    out: dict[tuple, dict[str, float]] = {}
+    for name, labels, value in fam.samples:
+        if not name.endswith("_bucket") or "le" not in labels:
             continue
-        if 'model="' in line:
+        key = tuple(sorted((k, v) for k, v in labels.items()
+                    if k != "le"))
+        if not key:
             continue
-        out[m.group("le")] = out.get(m.group("le"), 0.0) + float(
-            m.group("val"))
+        part = out.setdefault(key, {})
+        le = labels["le"]
+        part[le] = part.get(le, 0.0) + value
     return out
+
+
+def scrape_bucket_partitions(text: str,
+                             family: str = TTFT_FAMILY) -> dict:
+    """Every LABELED partition of ``family``'s buckets:
+    ``{(("model", "m"), ...): {le: count}}``, keyed by the sorted
+    non-``le`` label items. The partitions the old private regex parser
+    silently dropped — per-model and per-role latency is visible to
+    callers (hub, portal, bench) even though the fleet control law
+    still windows the unlabeled aggregate."""
+    fam = parse_prom_text(text).get(family)
+    return _family_partitions(fam) if fam else {}
 
 
 def bucket_delta(prev: dict, cur: dict) -> dict:
@@ -166,8 +183,14 @@ class FleetWatcher:
     between ticks, merged across replicas — the fleet-wide p99 a
     client actually experienced THIS window, not since boot."""
 
-    def __init__(self, timeout_s: float = 2.0):
+    def __init__(self, timeout_s: float = 2.0, hub=None):
         self.timeout_s = timeout_s
+        # optional MetricsHub (tony_tpu/metricshub.py): when set, every
+        # /metrics fetch routes through hub.scrape() so ONE pipeline
+        # feeds the controller's windows AND the hub's retained series.
+        # The hub returns the raw exposition body, so the windowing
+        # below is byte-identical with or without it.
+        self.hub = hub
         self._prev: dict[str, dict] = {}    # replica name -> buckets
         self._prev_tpot: dict[str, dict] = {}
         # per-replica instantaneous load (queued + active) from the
@@ -179,6 +202,16 @@ class FleetWatcher:
         # per-ROUTER in-flight relay count from the newest observe() —
         # the router-tier scale-down victim picker's input
         self.last_router_loads: dict[str, int] = {}
+        # cumulative failed fetches per target URL — rendered as
+        # driver_autoscale_scrape_failures_total so a half-blind
+        # controller (replica up but /metrics refusing) is VISIBLE on
+        # the driver's own exposition instead of silently retaining a
+        # stale baseline
+        self.scrape_failures: dict[str, int] = {}
+        # newest labeled bucket partitions per replica (per-model /
+        # per-role TTFT the aggregate window deliberately excludes) —
+        # kept for the hub/portal; the control law never reads it
+        self.last_partitions: dict[str, dict] = {}
 
     def _get(self, url: str) -> str | None:
         try:
@@ -186,6 +219,24 @@ class FleetWatcher:
                 return r.read().decode()
         except Exception:
             return None
+
+    def _fetch(self, url: str) -> str | None:
+        """``_get`` plus per-target failure accounting."""
+        body = self._get(url)
+        if body is None:
+            self.scrape_failures[url] = self.scrape_failures.get(url, 0) + 1
+        return body
+
+    def _fetch_metrics(self, name: str, url: str) -> str | None:
+        """/metrics fetch: through the hub when one is attached (the
+        scrape is retained in its TSDB), direct otherwise."""
+        if self.hub is not None:
+            body = self.hub.scrape(name, url)
+            if body is None:
+                self.scrape_failures[url] = (
+                    self.scrape_failures.get(url, 0) + 1)
+            return body
+        return self._fetch(url)
 
     def observe(self, endpoints, router_stats_url: str = "",
                 router_endpoints=()) -> FleetObservation:
@@ -204,7 +255,7 @@ class FleetWatcher:
         roles: dict[str, str] = {}
         for name, host, port in endpoints:
             base = f"http://{host}:{port}"
-            st_raw = self._get(base + "/stats")
+            st_raw = self._fetch(base + "/stats")
             if st_raw is not None:
                 try:
                     st = json.loads(st_raw)
@@ -222,20 +273,31 @@ class FleetWatcher:
                         obs.queued_prefill += queued
                 except ValueError:
                     pass
-            met = self._get(base + "/metrics")
+            met = self._fetch_metrics(name, base + "/metrics")
             if met is None:
                 continue        # baseline RETAINED: the next successful
                 #                 scrape's delta covers the gap (a loaded
                 #                 replica timing out one poll mid-breach
                 #                 must not blind the TTFT window)
-            cur = scrape_ttft_buckets(met)
+            fams = parse_prom_text(met)
+            ttft_fam = fams.get(TTFT_FAMILY)
+            tpot_fam = fams.get(TPOT_FAMILY)
+            # labeled partitions (per-model/per-role) the aggregate
+            # window excludes — retained for hub/portal visibility
+            parts = {}
+            if ttft_fam is not None:
+                parts.update(_family_partitions(ttft_fam))
+            if parts:
+                self.last_partitions[name] = parts
+            cur = ttft_fam.buckets(exclude=("model",)) if ttft_fam else {}
             if cur:
                 prev = self._prev.get(name)
                 self._prev[name] = cur
                 delta = bucket_delta(prev, cur) if prev is not None else {}
                 for le, v in delta.items():
                     window[le] = window.get(le, 0.0) + v
-            cur_tpot = scrape_ttft_buckets(met, family=TPOT_FAMILY)
+            cur_tpot = (tpot_fam.buckets(exclude=("model",))
+                        if tpot_fam else {})
             if cur_tpot:
                 prev = self._prev_tpot.get(name)
                 self._prev_tpot[name] = cur_tpot
@@ -249,6 +311,7 @@ class FleetWatcher:
         for name in set(self._prev) - {n for n, _, _ in endpoints}:
             self._prev.pop(name, None)
             self._prev_tpot.pop(name, None)
+            self.last_partitions.pop(name, None)
         self.last_loads = loads
         self.last_roles = roles
         if window:
@@ -264,7 +327,7 @@ class FleetWatcher:
         active_view = 0
         saw_fleet = False
         for name, host, port in router_endpoints:
-            raw = self._get(f"http://{host}:{port}/stats")
+            raw = self._fetch(f"http://{host}:{port}/stats")
             if raw is None:
                 continue
             try:
@@ -289,7 +352,7 @@ class FleetWatcher:
         if saw_fleet and not router_stats_url:
             obs.router_queued = max(0, inflight_total - active_view)
         if router_stats_url:
-            raw = self._get(router_stats_url)
+            raw = self._fetch(router_stats_url)
             if raw is not None:
                 try:
                     st = json.loads(raw)

@@ -11,6 +11,7 @@ against the local mini-cluster), NotebookSubmitter (NotebookSubmitter.java:139
     tony-tpu history  [--port P]      # portal over the history dir
     tony-tpu trace    [TRACE_ID] --dir D [--dir D2 ...]   # merged
                                       # cross-tier request waterfall
+    tony-tpu slo      --job-dir D      # live driver SLO snapshot
 """
 
 from __future__ import annotations
@@ -122,6 +123,42 @@ def cmd_history(args) -> int:
     return 0
 
 
+def cmd_slo(args) -> int:
+    """Print a live driver's SLO snapshot: objectives, burn rates per
+    window, alert state, and error-budget remaining. Reads the driver's
+    advertised metrics endpoint out of ``<job-dir>/driver.json`` and
+    GETs ``/slo`` — the same JSON the portal dashboard renders."""
+    import json
+    import urllib.request
+    from pathlib import Path
+
+    from .. import constants as c
+
+    info_path = Path(args.job_dir) / c.DRIVER_INFO_FILE
+    try:
+        info = json.loads(info_path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"cannot read {info_path}: {e}", file=sys.stderr)
+        return 1
+    port = info.get("metrics_port")
+    if not port:
+        print("driver advertises no metrics endpoint "
+              "(metrics_port missing from driver.json)", file=sys.stderr)
+        return 1
+    url = f"http://{info.get('host', '127.0.0.1')}:{port}/slo"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout_s) as resp:
+            body = resp.read().decode("utf-8", "replace")
+    except Exception as e:  # noqa: BLE001 — one-shot CLI, report and exit
+        print(f"GET {url} failed: {e}", file=sys.stderr)
+        return 1
+    try:
+        print(json.dumps(json.loads(body), indent=2, sort_keys=True))
+    except ValueError:
+        print(body)
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Ops view of one distributed request: sweep every ``--dir`` for
     per-tier ``*.trace.jsonl`` files (task traces excluded — different
@@ -224,6 +261,15 @@ def main(argv=None) -> int:
     _add_common(p)
     p.add_argument("--port", type=int, default=19886)
     p.set_defaults(fn=cmd_history)
+
+    p = sub.add_parser(
+        "slo",
+        help="print a live driver's SLO snapshot (burn rates, alerts, "
+             "error budgets) from its /slo endpoint")
+    p.add_argument("--job-dir", required=True,
+                   help="the driver's job dir (holds driver.json)")
+    p.add_argument("--timeout-s", type=float, default=5.0)
+    p.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser(
         "trace",

@@ -253,6 +253,29 @@ AUTOSCALE_ROUTER_ROLE = "tony.autoscale.router-role"
 AUTOSCALE_ROUTER_RELAY_SLO = "tony.autoscale.router-relay-slo"
 AUTOSCALE_ROUTER_MIN = "tony.autoscale.router-min"
 
+# ------------------------------------------------------- metrics hub / SLO
+# fleet metrics pipeline (tony_tpu/metricshub.py) + SLO burn-rate
+# alerting (tony_tpu/slo.py, docs/observability.md "Metrics pipeline &
+# SLO alerting"). Objectives are DECLARATIVE, one per name:
+#
+#   tony.slo.<name>.objective    availability | ttft-p99 | tpot-p99
+#   tony.slo.<name>.target       good/total promised (e.g. 0.99)
+#   tony.slo.<name>.window-s     SLO horizon; the four alert windows
+#                                derive from it (fast W/6+W/60, slow
+#                                W+W/6) — bench/test clocks shrink it
+#   tony.slo.<name>.threshold-s  latency objectives: the "good" bound
+#   tony.slo.<name>.fast-burn    fast-pair burn threshold (14.4)
+#   tony.slo.<name>.slow-burn    slow-pair burn threshold (6.0)
+#
+# <name> may not contain dots. The keys below tune the shared pipeline:
+# the hub's own scrape cadence (used when no autoscaler tick is already
+# driving the scrapes), its ring retention horizon, and the per-series
+# point bound.
+SLO_PREFIX = "tony.slo."
+SLO_SCRAPE_INTERVAL_S = "tony.slo.scrape-interval-s"
+SLO_HUB_RETENTION_S = "tony.slo.hub-retention-s"
+SLO_HUB_MAX_POINTS = "tony.slo.hub-max-points"
+
 # ------------------------------------------------------------------- quota
 # multi-tenant arbitration (tony_tpu/autoscale.py ResourceArbiter): all
 # roles share one device/slot pool; per-role quotas cap what each may

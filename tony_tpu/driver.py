@@ -43,7 +43,11 @@ from .metrics import (
     DRIVER_AUTOSCALE_REPLICAS,
     DRIVER_AUTOSCALE_SCALE_DOWNS_TOTAL,
     DRIVER_AUTOSCALE_SCALE_UPS_TOTAL,
+    DRIVER_AUTOSCALE_SCRAPE_FAILURES_TOTAL,
     DRIVER_AUTOSCALE_TTFT_P99_S,
+    DRIVER_METRICSHUB_SCRAPES_TOTAL,
+    DRIVER_METRICSHUB_SERIES,
+    DRIVER_METRICSHUB_TARGETS,
     DRIVER_CHECKPOINT_AGE_S,
     DRIVER_GANG_LAUNCH_SECONDS,
     DRIVER_GANG_RESIZES_TOTAL,
@@ -527,6 +531,11 @@ class Driver:
         self._autoscale_runner = None
         self._controller = None
         self._recovered_scale_t: float | None = None
+        # fleet metrics pipeline + SLO engine (tony_tpu/metricshub.py,
+        # tony_tpu/slo.py) — built in _start_metricshub() during
+        # prepare(); None when neither autoscaling nor SLOs are on
+        self._metrics_hub = None
+        self._slo_engine = None
         if self._autoscale_enabled and self._autoscale_role:
             spec = self.session.role_specs.get(self._autoscale_role)
             n_min = max(0, conf.get_int(keys.AUTOSCALE_MIN, 1))
@@ -674,6 +683,7 @@ class Driver:
             for task_id in sorted(self._parked):
                 self._jrec("detach", task=task_id)
                 self._jrec("park", task=task_id)
+        self._start_metricshub()
         self._start_autoscaler()
         # seed the warm pool on THIS host for local capacity: standbys
         # prepay the jax/backend bill while the first gang launches, so
@@ -1097,6 +1107,26 @@ class Driver:
                         log.exception("metrics render failed")
                         body, code, ctype = (
                             f"error: {e}".encode(), 500, "text/plain")
+                elif route == "/slo":
+                    # the SLO engine's JSON snapshot (burn rates, alert
+                    # state, budget accounting, transition history) —
+                    # the `tony-tpu slo` CLI's and bench's read path
+                    import json as _json
+
+                    ctype = "application/json"
+                    if driver._slo_engine is None:
+                        body, code = _json.dumps(
+                            {"error": "no SLOs declared "
+                             "(tony.slo.<name>.objective)"}).encode(), 404
+                    else:
+                        try:
+                            body = _json.dumps(
+                                driver._slo_engine.snapshot()).encode()
+                            code = 200
+                        except Exception as e:
+                            log.exception("slo snapshot failed")
+                            body, code = _json.dumps(
+                                {"error": str(e)}).encode(), 500
                 elif route == "/profile":
                     # operator convenience trigger for the same command
                     # the client RPC queues: curl ':port/profile?task=
@@ -1314,6 +1344,36 @@ class Driver:
                         "newest queued-request signal the controller "
                         "observed",
                         labels={"tier": "router"})
+        # scrape-pipeline health: failed fetches per target, from the
+        # watcher's fetch path and the hub's alike — a half-blind
+        # control loop (replica up, /metrics refusing) is VISIBLE here
+        # instead of silently retaining a stale baseline
+        failures: dict[str, int] = {}
+        runner = self._autoscale_runner
+        if runner is not None and runner.watcher is not None:
+            failures.update(runner.watcher.scrape_failures)
+        hub = self._metrics_hub
+        if hub is not None:
+            for target, n in hub.failures.items():
+                failures[target] = failures.get(target, 0) + n
+        for target in sorted(failures):
+            r.counter(DRIVER_AUTOSCALE_SCRAPE_FAILURES_TOTAL,
+                      failures[target],
+                      "scrape fetches that failed, per target "
+                      "(watcher + metrics hub)",
+                      labels={"target": target})
+        if hub is not None:
+            r.counter(DRIVER_METRICSHUB_SCRAPES_TOTAL,
+                      hub.scrapes_total,
+                      "exposition payloads the metrics hub ingested")
+            r.gauge(DRIVER_METRICSHUB_SERIES, len(hub._series),
+                    "distinct series retained in the hub's rings")
+            r.gauge(DRIVER_METRICSHUB_TARGETS, len(hub.targets()),
+                    "scrape targets the hub has ever ingested")
+        if self._slo_engine is not None:
+            # driver_slo_burn_rate / _error_budget_remaining /
+            # _alerts_firing from the newest evaluation
+            self._slo_engine.render_into(r)
         counts: dict[str, int] = {}
         for t in self.session.all_tasks():
             counts[t.status.value] = counts.get(t.status.value, 0) + 1
@@ -1662,6 +1722,93 @@ class Driver:
         return bool(self._router_role and ctl is not None
                     and ctl.router_slo > 0)
 
+    def _hub_targets(self) -> list:
+        """The metrics hub's scrape-target discovery: every tier's
+        exposition surface known to the session table — the serving
+        role's replicas and the router role's front doors (their
+        published serve_port's /metrics), plus the driver's own
+        renderer IN-PROCESS (no HTTP hop for the tier hosting the
+        hub)."""
+        targets: list = [("driver", self.render_metrics)]
+        seen = {"driver"}
+        for role in (self._autoscale_role, self._router_role):
+            if not role:
+                continue
+            for name, host, port in self.serving_endpoints(role):
+                if name in seen:
+                    continue
+                seen.add(name)
+                targets.append((name, f"http://{host}:{port}/metrics"))
+        return targets
+
+    def _slo_record(self, slo: str, severity: str, state: str,
+                    t: float) -> None:
+        """Journal one alert transition (the SLO engine's record_fn) —
+        best-effort under the journal contract."""
+        self._jrec("slo_alert", slo=slo, severity=severity, state=state,
+                   t=t)
+
+    def _slo_eval(self) -> None:
+        """One SLO evaluation pass (hub scrape-round callback)."""
+        if self._slo_engine is not None:
+            try:
+                self._slo_engine.evaluate()
+            except Exception:
+                log.exception("slo evaluation failed")
+
+    def _start_metricshub(self) -> None:
+        """Build the fleet metrics hub + SLO engine (prepare(); no-op
+        when neither autoscaling nor declared SLOs need them). The hub
+        persists its rings to metrics.tsdb.jsonl in the job dir; a
+        recovered driver replays the file so alert windows and error
+        budgets keep their history, and seeds the engine's alert state
+        from the journal so a mid-incident alert RESUMES firing
+        without a duplicate transition."""
+        from .metricshub import MetricsHub
+        from .slo import SLOEngine, slo_objectives_from_conf
+
+        objectives = slo_objectives_from_conf(self.conf)
+        if not objectives and not (self._autoscale_enabled
+                                   and self._autoscale_role):
+            return
+        retention = float(
+            self.conf.get(keys.SLO_HUB_RETENTION_S, 900) or 900)
+        if objectives:
+            # the rings must hold every window the objectives burn over
+            retention = max(retention,
+                            *(s.window_s * 1.05 for s in objectives))
+        self._metrics_hub = MetricsHub(
+            persist_dir=self.job_dir, retention_s=retention,
+            max_points=self.conf.get_int(keys.SLO_HUB_MAX_POINTS, 720))
+        if self._recovered_state is not None:
+            n = self._metrics_hub.load()
+            if n:
+                log.info("metrics hub replayed %d tsdb record(s)", n)
+        if objectives:
+            initial = {}
+            if self._recovered_state is not None:
+                for key, entry in getattr(self._recovered_state,
+                                          "slo_alerts", {}).items():
+                    name, _, sev = key.rpartition(":")
+                    if name and sev:
+                        initial[(name, sev)] = (
+                            entry.get("state") == "firing")
+            self._slo_engine = SLOEngine(
+                self._metrics_hub, objectives,
+                record_fn=self._slo_record, initial_alerts=initial)
+            if initial and any(initial.values()):
+                log.info("slo engine resumed %d firing alert(s) from "
+                         "the journal",
+                         sum(1 for v in initial.values() if v))
+        # the hub's own jittered scrape loop covers what the
+        # autoscaler's watcher does not (router /metrics, the driver's
+        # own families) — and everything, when no autoscaler runs
+        self._metrics_hub.start(
+            self._hub_targets,
+            interval_s=float(
+                self.conf.get(keys.SLO_SCRAPE_INTERVAL_S, 5) or 5),
+            on_round=self._slo_eval)
+
     def _start_autoscaler(self) -> None:
         """Start the driver-resident autoscale loop (prepare(); no-op
         when disabled). The controller's cooldown clock resumes from
@@ -1690,8 +1837,14 @@ class Driver:
                 controller.router_min,
                 rspec.instances if rspec is not None else 1)
         self._controller = controller
+        # hub-backed watcher: the controller's /metrics fetches route
+        # through the hub's scrape (one pipeline feeds the control law,
+        # the SLO engine, the portal, and bench); window math is
+        # byte-identical — the hub hands back the raw exposition body
+        from .autoscale import FleetWatcher
         self._autoscale_runner = AutoscaleRunner(
             self, controller,
+            watcher=FleetWatcher(hub=self._metrics_hub),
             router_stats_url=str(
                 self.conf.get(keys.AUTOSCALE_ROUTER_STATS_URL, "") or ""))
         self._autoscale_runner.start()
@@ -3061,6 +3214,8 @@ class Driver:
         status = self.session.status
         if self._autoscale_runner is not None:
             self._autoscale_runner.shutdown()
+        if self._metrics_hub is not None:
+            self._metrics_hub.stop()
         self.provisioner.stop_all()
         # reap the warm pool AFTER the containers: an adopted child dies
         # with its executor (control-pipe EOF), and idle standbys must

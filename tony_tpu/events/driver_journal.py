@@ -52,6 +52,8 @@ Record vocabulary (one JSON object per line)::
     {"op": "donated", "task": "trainer:1"}   # drain done, slot freed
     {"op": "reclaimed", "task": "trainer:1"} # capacity returned
     {"op": "ledger", "kind": "scale_down", "task": "replica:1"}
+    {"op": "slo_alert", "slo": "availability", "severity": "fast",
+     "state": "firing"|"clear", "t": wall}   # SLO engine transitions
 
 Replay semantics worth pinning: a ``launch`` op starts a fresh attempt
 — it clears the task's registration, published ports, terminal state,
@@ -133,6 +135,11 @@ class DriverState:
     # tail): a recovered driver resumes mid-cooldown from the newest
     # decision instead of flapping
     scale_ops: list = field(default_factory=list)
+    # ---- SLO engine state (tony_tpu/slo.py) ----
+    # "slo:severity" -> newest journaled transition ({"state", "t"});
+    # a recovered driver seeds its SLO engine from this so a
+    # mid-incident alert RESUMES firing without a duplicate transition
+    slo_alerts: dict = field(default_factory=dict)
 
     def task(self, task_id: str) -> TaskRecord:
         rec = self.tasks.get(task_id)
@@ -280,6 +287,11 @@ def _apply(state: DriverState, rec: dict) -> None:
         state.donated.add(task_id)
     elif op == "reclaimed":
         state.donated.discard(str(rec["task"]))
+    elif op == "slo_alert":
+        key = f"{rec.get('slo', '')}:{rec.get('severity', '')}"
+        state.slo_alerts[key] = {
+            "state": str(rec.get("state", "clear")),
+            "t": float(rec.get("t", 0.0) or 0.0)}
     # unknown ops are skipped silently: an older driver reading a newer
     # journal must degrade, not crash
 
@@ -369,6 +381,13 @@ def rewrite_journal(path: str | Path, state: DriverState) -> None:
         # an unbounded history would re-accrete across recoveries
         for op in state.scale_ops[-64:]:
             w("scale", **op)
+        # newest transition per alert is the whole resumable state
+        for key in sorted(state.slo_alerts):
+            slo_name, _, severity = key.rpartition(":")
+            entry = state.slo_alerts[key]
+            w("slo_alert", slo=slo_name, severity=severity,
+              state=entry.get("state", "clear"),
+              t=entry.get("t", 0.0))
         for _ in range(state.recoveries):
             w("recovered", driver_generation=state.driver_generation,
               t=time.time())

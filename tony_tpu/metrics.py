@@ -155,6 +155,21 @@ DRIVER_QUOTA_POOL_FREE = "driver_quota_pool_free"
 DRIVER_QUOTA_SLOTS = "driver_quota_slots"
 DRIVER_QUOTA_DONATIONS_TOTAL = "driver_quota_donations_total"
 DRIVER_QUOTA_RECLAIMS_TOTAL = "driver_quota_reclaims_total"
+# fleet metrics pipeline + SLO engine (tony_tpu/metricshub.py +
+# tony_tpu/slo.py, docs/observability.md "Metrics pipeline & SLO
+# alerting"): failed scrapes per target {target} — from the watcher's
+# fetch path and the hub's alike, so a half-blind control loop is
+# visible — the hub's scrape/retention health, and the SLO families:
+# burn rate per {slo,window_s}, budget remaining per {slo}, and the
+# firing state per {slo,severity} burn-rate pair
+DRIVER_AUTOSCALE_SCRAPE_FAILURES_TOTAL = (
+    "driver_autoscale_scrape_failures_total")
+DRIVER_METRICSHUB_SCRAPES_TOTAL = "driver_metricshub_scrapes_total"
+DRIVER_METRICSHUB_SERIES = "driver_metricshub_series"
+DRIVER_METRICSHUB_TARGETS = "driver_metricshub_targets"
+DRIVER_SLO_BURN_RATE = "driver_slo_burn_rate"
+DRIVER_SLO_ERROR_BUDGET_REMAINING = "driver_slo_error_budget_remaining"
+DRIVER_SLO_ALERTS_FIRING = "driver_slo_alerts_firing"
 
 # fleet-router exposition families (rendered by tony_tpu/router.py's GET
 # /metrics; same one-contract rule — the metrics-name lint pins these to

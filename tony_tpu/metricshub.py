@@ -1,0 +1,455 @@
+"""Driver-resident fleet metrics hub: one scrape pipeline, a tiny TSDB.
+
+Four tiers export Prometheus text (serve replicas, fleet routers, the
+driver itself, the portal) and — before this module — three consumers
+each re-derived their own view by scraping raw endpoints: the
+autoscaler's FleetWatcher, the portal's TTL caches, and bench. The hub
+centralizes that: every ``/metrics`` surface is scraped on a jittered
+cadence through the ONE shared exposition parser
+(observability.parse_prom_text), and the samples are retained as
+windowed series in bounded ring buffers. Consumers query windows
+(``window_increase``, ``window_buckets``) instead of re-implementing
+scrape + delta + quantile a fourth time; the SLO engine
+(tony_tpu/slo.py) computes burn rates from the same rings the
+autoscaler's watcher feeds.
+
+Counter-reset handling generalizes ``bucket_delta``'s clamp
+(autoscale.bucket_delta): each cumulative series carries a monotonic
+offset — when a raw sample drops below its predecessor (the exporting
+process restarted), the predecessor's value folds into the offset, so
+the ADJUSTED series stays monotone and any window increase across the
+restart equals the fresh process's contribution, exactly what the
+clamp yields per-tick.
+
+Persistence is best-effort under the events/ torn-line discipline
+(events/trace.py): every ingested scrape appends one JSONL line of RAW
+samples to ``metrics.tsdb.jsonl`` in the job directory; a recovered
+driver replays the file through the same ingest path (rebuilding reset
+offsets in order) so alert windows and error budgets survive driver
+death. Malformed/torn lines are skipped on load; the file is compacted
+(tmp + rename) to the retention horizon when it grows past a line
+budget.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import random
+import threading
+import time
+import urllib.request
+from collections import deque
+from pathlib import Path
+
+from .observability import parse_prom_text
+
+log = logging.getLogger(__name__)
+
+# sibling of the driver journal / trace files in the job directory;
+# travels with the events when the history mover relocates the job
+TSDB_FILE = "metrics.tsdb.jsonl"
+
+# sample names with these shapes are cumulative even when the exposition
+# carried no # TYPE metadata (bare-sample test servers)
+_CUMULATIVE_SUFFIXES = ("_total", "_bucket", "_count", "_sum")
+
+
+def _le_key(le: str) -> float:
+    return math.inf if le in ("+Inf", "inf") else float(le)
+
+
+class _Series:
+    """One retained series: bounded ring of (t, adjusted_value)."""
+
+    __slots__ = ("kind", "ring", "raw_last", "offset")
+
+    def __init__(self, kind: str, max_points: int):
+        self.kind = kind                      # "counter" | "gauge"
+        self.ring: deque = deque(maxlen=max_points)
+        self.raw_last: float | None = None
+        self.offset = 0.0
+
+    def push(self, t: float, raw: float, retention_s: float) -> None:
+        if self.kind == "counter":
+            if self.raw_last is not None and raw < self.raw_last:
+                # exporter restarted: fold its previous total into the
+                # offset so the adjusted series stays monotone
+                self.offset += self.raw_last
+            self.raw_last = raw
+            value = raw + self.offset
+        else:
+            value = raw
+        self.ring.append((t, value))
+        horizon = t - retention_s
+        while self.ring and self.ring[0][0] < horizon:
+            self.ring.popleft()
+
+    def at_or_before(self, t: float) -> float | None:
+        """Adjusted value of the newest point with timestamp <= t."""
+        found = None
+        for ts, v in self.ring:
+            if ts > t:
+                break
+            found = v
+        return found
+
+    def latest(self) -> float | None:
+        return self.ring[-1][1] if self.ring else None
+
+    def increase(self, window_s: float, now: float) -> float:
+        """Adjusted increase over the trailing window. A series with no
+        point before the window start counts from zero — counters are
+        born at zero with their process, so a series younger than the
+        window contributes its whole adjusted value."""
+        if not self.ring:
+            return 0.0
+        base = self.at_or_before(now - window_s)
+        return max(0.0, self.ring[-1][1] - (base or 0.0))
+
+
+class MetricsHub:
+    """Scrape + retain + query. Thread-safe; every write path is
+    best-effort (a failed scrape or persist must never take down the
+    driver)."""
+
+    def __init__(self, persist_dir: str | os.PathLike | None = None,
+                 retention_s: float = 900.0, max_points: int = 720,
+                 timeout_s: float = 2.0, now_fn=time.time,
+                 max_persist_lines: int = 4096):
+        self.retention_s = retention_s
+        self.max_points = max_points
+        self.timeout_s = timeout_s
+        self.now_fn = now_fn
+        self.max_persist_lines = max_persist_lines
+        self._lock = threading.RLock()
+        # (target, sample_name, sorted-label-items) -> _Series
+        self._series: dict[tuple, _Series] = {}
+        self._kinds: dict[str, str] = {}      # family -> declared kind
+        self._targets: dict[str, float] = {}  # target -> last scrape t
+        # per-target failed fetches (counter; surfaced on the driver's
+        # /metrics next to the watcher's own — a half-blind pipeline is
+        # visible, not mysterious)
+        self.failures: dict[str, int] = {}
+        self.scrapes_total = 0
+        self._persist_path: Path | None = None
+        self._persist_f = None
+        self._persist_lines = 0
+        self._loading = False
+        if persist_dir is not None:
+            p = Path(persist_dir)
+            try:
+                p.mkdir(parents=True, exist_ok=True)
+                self._persist_path = p / TSDB_FILE
+            except OSError:
+                log.exception("metrics hub persist dir unavailable")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ scraping
+    def scrape(self, target: str, url: str) -> str | None:
+        """HTTP-fetch one exposition endpoint, ingest it, return the
+        raw body (None on failure — the caller's windowing treats that
+        exactly like its own fetch failing)."""
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+                body = r.read().decode()
+        except Exception:
+            with self._lock:
+                self.failures[target] = self.failures.get(target, 0) + 1
+            return None
+        self.ingest(target, body)
+        return body
+
+    def collect(self, target: str, render_fn) -> str | None:
+        """Ingest an IN-PROCESS renderer (the driver's own /metrics
+        payload — no HTTP hop for the tier that hosts the hub)."""
+        try:
+            body = render_fn()
+        except Exception:
+            with self._lock:
+                self.failures[target] = self.failures.get(target, 0) + 1
+            return None
+        self.ingest(target, body)
+        return body
+
+    def ingest(self, target: str, text: str,
+               now: float | None = None) -> None:
+        """Parse one exposition payload and push every sample into its
+        ring (lenient parse: a torn body contributes what it can)."""
+        t = self.now_fn() if now is None else now
+        try:
+            families = parse_prom_text(text)
+        except Exception:
+            with self._lock:
+                self.failures[target] = self.failures.get(target, 0) + 1
+            return
+        persisted: list[list] = []
+        with self._lock:
+            self.scrapes_total += 1
+            self._targets[target] = t
+            for fam in families.values():
+                kind = fam.kind
+                if kind != "untyped":
+                    self._kinds[fam.name] = kind
+                for name, labels, value in fam.samples:
+                    self._push(target, name, labels, value, kind, t)
+                    persisted.append([name, labels, value])
+        if not self._loading:
+            self._persist(target, t, persisted)
+
+    def _push(self, target: str, name: str, labels: dict, value: float,
+              fam_kind: str, t: float) -> None:
+        key = (target, name, tuple(sorted(labels.items())))
+        s = self._series.get(key)
+        if s is None:
+            if fam_kind in ("counter", "histogram", "summary"):
+                kind = "counter"
+            elif fam_kind == "gauge":
+                kind = "gauge"
+            else:
+                kind = ("counter" if name.endswith(_CUMULATIVE_SUFFIXES)
+                        else "gauge")
+            s = self._series[key] = _Series(kind, self.max_points)
+        s.push(t, value, self.retention_s)
+
+    # ----------------------------------------------------------- queries
+    def targets(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._targets)
+
+    def latest(self, name: str, labels: dict | None = None,
+               target: str | None = None) -> float | None:
+        """Newest adjusted value SUMMED across matching series (all
+        targets unless one is named; ``labels`` is a subset match)."""
+        total, found = 0.0, False
+        with self._lock:
+            for (tg, sn, items), s in self._series.items():
+                if sn != name or (target is not None and tg != target):
+                    continue
+                if labels and not self._match(items, labels):
+                    continue
+                v = s.latest()
+                if v is not None:
+                    total += v
+                    found = True
+        return total if found else None
+
+    def series(self, name: str, labels: dict | None = None,
+               target: str | None = None) -> list[tuple[float, float]]:
+        """Every retained point of the matching series, merged and
+        time-sorted (sparkline fodder)."""
+        out: list[tuple[float, float]] = []
+        with self._lock:
+            for (tg, sn, items), s in self._series.items():
+                if sn != name or (target is not None and tg != target):
+                    continue
+                if labels and not self._match(items, labels):
+                    continue
+                out.extend(s.ring)
+        out.sort()
+        return out
+
+    def window_increase(self, name: str, window_s: float,
+                        labels: dict | None = None,
+                        target: str | None = None,
+                        now: float | None = None) -> float:
+        """Adjusted counter increase over the trailing window, summed
+        across matching series (restart-safe: reset offsets make the
+        sum monotone per series)."""
+        t = self.now_fn() if now is None else now
+        total = 0.0
+        with self._lock:
+            for (tg, sn, items), s in self._series.items():
+                if sn != name or (target is not None and tg != target):
+                    continue
+                if labels and not self._match(items, labels):
+                    continue
+                total += s.increase(window_s, t)
+        return total
+
+    def window_buckets(self, family: str, window_s: float,
+                       now: float | None = None,
+                       exclude_labels: tuple[str, ...] = ("model",),
+                       target: str | None = None) -> dict[str, float]:
+        """``{le: increase}`` of a histogram family's cumulative
+        buckets over the trailing window, summed across targets —
+        feed it to autoscale.bucket_quantile for a windowed fleet
+        quantile, or read the sub-threshold count for a latency SLO."""
+        t = self.now_fn() if now is None else now
+        bucket_name = family + "_bucket"
+        out: dict[str, float] = {}
+        with self._lock:
+            for (tg, sn, items), s in self._series.items():
+                if sn != bucket_name:
+                    continue
+                if target is not None and tg != target:
+                    continue
+                labels = dict(items)
+                le = labels.get("le")
+                if le is None:
+                    continue
+                if any(k in labels for k in exclude_labels):
+                    continue
+                out[le] = out.get(le, 0.0) + s.increase(window_s, t)
+        return out
+
+    @staticmethod
+    def _match(items: tuple, want: dict) -> bool:
+        have = dict(items)
+        return all(have.get(k) == str(v) for k, v in want.items())
+
+    # ------------------------------------------------------- persistence
+    def _persist(self, target: str, t: float, samples: list) -> None:
+        if self._persist_path is None or not samples:
+            return
+        try:
+            line = json.dumps({"t": t, "tg": target, "s": samples})
+            with self._lock:
+                if self._persist_f is None:
+                    self._persist_f = open(self._persist_path, "a")
+                    self._persist_lines = sum(
+                        1 for _ in open(self._persist_path))
+                self._persist_f.write(line + "\n")
+                self._persist_f.flush()
+                self._persist_lines += 1
+                if self._persist_lines > self.max_persist_lines:
+                    self._compact(t)
+        except Exception:
+            log.exception("metrics hub persist failed")
+
+    def _compact(self, now: float) -> None:
+        """Rewrite the TSDB file to the retention horizon (tmp+rename,
+        same discipline as the journal compactor). Caller holds lock."""
+        path = self._persist_path
+        horizon = now - self.retention_s
+        kept = []
+        try:
+            with open(path) as f:
+                for raw in f:
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        rec = json.loads(raw)
+                    except ValueError:
+                        continue
+                    if float(rec.get("t", 0.0)) >= horizon:
+                        kept.append(raw)
+        except OSError:
+            return
+        tmp = path.with_suffix(".tmp")
+        with open(tmp, "w") as f:
+            for raw in kept:
+                f.write(raw + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        try:
+            self._persist_f.close()
+        except Exception:
+            pass
+        self._persist_f = open(path, "a")
+        self._persist_lines = len(kept)
+
+    def load(self, path: str | os.PathLike | None = None) -> int:
+        """Replay a persisted TSDB file through the normal ingest path
+        (offsets rebuild in record order, so counters that reset across
+        the gap keep their adjusted monotonicity). Torn/malformed lines
+        are skipped. Returns the number of records replayed."""
+        p = Path(path) if path is not None else self._persist_path
+        if p is None or not p.exists():
+            return 0
+        n = 0
+        self._loading = True
+        try:
+            with open(p) as f:
+                for raw in f:
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        rec = json.loads(raw)
+                        t = float(rec["t"])
+                        target = str(rec["tg"])
+                        samples = rec["s"]
+                    except (ValueError, KeyError, TypeError):
+                        continue
+                    with self._lock:
+                        self._targets[target] = t
+                        for item in samples:
+                            try:
+                                name, labels, value = item
+                                self._push(target, str(name),
+                                           dict(labels), float(value),
+                                           self._kinds.get(
+                                               self._base(str(name)),
+                                               "untyped"), t)
+                            except (ValueError, TypeError):
+                                continue
+                    n += 1
+        except OSError:
+            log.exception("metrics hub tsdb load failed")
+        finally:
+            self._loading = False
+        if n and self._persist_path is not None and p == self._persist_path:
+            with self._lock:
+                self._persist_lines = n
+        return n
+
+    @staticmethod
+    def _base(name: str) -> str:
+        for suf in ("_bucket", "_sum", "_count"):
+            if name.endswith(suf):
+                return name[:-len(suf)]
+        return name
+
+    # -------------------------------------------------- background loop
+    def start(self, discover, interval_s: float = 5.0,
+              jitter_frac: float = 0.2, on_round=None) -> None:
+        """Scrape every discovered target each round on a JITTERED
+        cadence (de-phased from the exporters' own update ticks).
+        ``discover()`` returns ``[(target, fetch)]`` where fetch is a
+        URL string or an in-process render callable; ``on_round`` runs
+        after each round (the driver hangs SLO evaluation on it)."""
+        if self._thread is not None:
+            return
+
+        def _loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    for target, fetch in list(discover() or ()):
+                        if callable(fetch):
+                            self.collect(target, fetch)
+                        else:
+                            self.scrape(target, str(fetch))
+                    if on_round is not None:
+                        on_round()
+                except Exception:
+                    log.exception("metrics hub scrape round failed")
+                delay = interval_s * (
+                    1.0 + jitter_frac * (2.0 * random.random() - 1.0))
+                if self._stop.wait(max(0.05, delay)):
+                    return
+
+        self._thread = threading.Thread(
+            target=_loop, name="metrics-hub", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        with self._lock:
+            if self._persist_f is not None:
+                try:
+                    self._persist_f.close()
+                except Exception:
+                    pass
+                self._persist_f = None
+
+
+__all__ = ["MetricsHub", "TSDB_FILE"]

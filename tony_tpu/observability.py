@@ -72,6 +72,7 @@ import os
 import re
 import threading
 import time
+from dataclasses import dataclass, field
 
 log = logging.getLogger(__name__)
 
@@ -921,9 +922,221 @@ class PromRenderer:
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+# ---------------------------------------------------------------- parsing
+#
+# The other half of the exposition contract: ONE strict parser for the
+# text format every tier renders (serve, router, driver, portal). Every
+# consumer that used to hand-roll a regex over /metrics — the
+# autoscaler's FleetWatcher, the metrics hub, bench — reads through
+# this, so a renderer bug (malformed label, broken histogram) fails the
+# conformance lint instead of silently skewing a control law. Grammar
+# per Prometheus text format 0.0.4: ``# HELP``/``# TYPE`` metadata
+# lines, then ``name{labels} value [timestamp]`` samples.
+
+_HELP_LINE_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*)(?: (.*))?$")
+_TYPE_LINE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+    r"(counter|gauge|histogram|summary|untyped)$")
+_SAMPLE_LINE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"     # metric name
+    r"(\{.*\})?"                       # optional label block
+    r"\s+(\S+)"                        # value
+    r"(?:\s+(-?[0-9]+))?\s*$")         # optional ms timestamp (ignored)
+_LABEL_PAIR_RE = re.compile(
+    r'\s*([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_LABEL_UNESCAPE_RE = re.compile(r"\\(.)")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _unescape_label_value(raw: str) -> str:
+    return _LABEL_UNESCAPE_RE.sub(
+        lambda m: {"n": "\n", "\\": "\\", '"': '"'}.get(m.group(1),
+                                                        "\\" + m.group(1)),
+        raw)
+
+
+def _parse_label_block(body: str, strict: bool, line: str) -> dict[str, str]:
+    """``body`` is the text between the braces. Strict mode demands the
+    pairs tile the block exactly (a stray token between labels is a
+    renderer bug, not noise to skip)."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        m = _LABEL_PAIR_RE.match(body, i)
+        if not m:
+            if strict:
+                raise ValueError(f"malformed label block: {line!r}")
+            # lenient: salvage whatever well-formed pairs exist
+            return {k: _unescape_label_value(v)
+                    for k, v in _LABEL_PAIR_RE.findall(body)}
+        if strict and m.group(1) in labels:
+            raise ValueError(f"duplicate label {m.group(1)!r}: {line!r}")
+        labels[m.group(1)] = _unescape_label_value(m.group(2))
+        i = m.end()
+        if i < n:
+            if body[i] != ",":
+                if strict:
+                    raise ValueError(f"malformed label block: {line!r}")
+                break
+            i += 1
+    return labels
+
+
+@dataclass
+class PromFamily:
+    """One metric family: its declared type/help plus every sample.
+    Histogram component samples (``_bucket``/``_sum``/``_count``) group
+    under the base family name; each sample keeps its full label set."""
+
+    name: str
+    kind: str = "untyped"
+    help: str = ""
+    samples: list[tuple[str, dict[str, str], float]] = field(
+        default_factory=list)
+
+    def values(self, **labels) -> list[float]:
+        """Samples whose label set contains every given pair."""
+        want = {k: str(v) for k, v in labels.items()}
+        return [v for _, ls, v in self.samples
+                if all(ls.get(k) == val for k, val in want.items())]
+
+    def buckets(self, exclude: tuple[str, ...] = ()) -> dict[str, float]:
+        """``{le: cumulative_count}`` summed across the family's
+        ``_bucket`` samples, skipping partitions that carry any label
+        named in ``exclude`` (``le`` itself never excludes)."""
+        out: dict[str, float] = {}
+        for name, labels, value in self.samples:
+            if not name.endswith("_bucket") or "le" not in labels:
+                continue
+            if any(k in labels for k in exclude):
+                continue
+            le = labels["le"]
+            out[le] = out.get(le, 0.0) + value
+        return out
+
+
+def _check_histogram_invariants(fam: PromFamily) -> None:
+    """Strict-mode conformance: per label partition the cumulative
+    buckets must be non-decreasing in ``le``, end at ``+Inf``, and agree
+    with ``_count`` when one is rendered."""
+    parts: dict[frozenset, dict[str, float]] = {}
+    counts: dict[frozenset, float] = {}
+    for name, labels, value in fam.samples:
+        if name.endswith("_bucket") and "le" in labels:
+            key = frozenset((k, v) for k, v in labels.items() if k != "le")
+            parts.setdefault(key, {})[labels["le"]] = value
+        elif name.endswith("_count"):
+            counts[frozenset(labels.items())] = value
+    for key, buckets in parts.items():
+        def _edge(le: str) -> float:
+            return math.inf if le in ("+Inf", "inf") else float(le)
+        ordered = sorted(buckets.items(), key=lambda kv: _edge(kv[0]))
+        if not ordered or _edge(ordered[-1][0]) != math.inf:
+            raise ValueError(
+                f"histogram {fam.name} partition {dict(key)} lacks +Inf")
+        prev = -math.inf
+        for _, v in ordered:
+            if v < prev:
+                raise ValueError(
+                    f"histogram {fam.name} buckets not cumulative")
+            prev = v
+        if key in counts and counts[key] != ordered[-1][1]:
+            raise ValueError(
+                f"histogram {fam.name} _count != +Inf bucket")
+
+
+def parse_prom_text(text: str,
+                    strict: bool = False) -> dict[str, PromFamily]:
+    """Parse Prometheus text exposition into ``{family: PromFamily}``.
+
+    Lenient by default (a scrape must survive a half-written body:
+    unparseable lines are skipped), strict for the conformance lint
+    (any malformed line, label block, duplicate series, or histogram
+    invariant violation raises ValueError naming the offense).
+
+    Samples WITHOUT metadata still parse — ``# TYPE``-less bucket lines
+    group into a histogram family when they carry an ``le`` label, so a
+    minimal test server serving bare samples reads the same as a full
+    renderer surface.
+    """
+    types: dict[str, str] = {}
+    helps: dict[str, str] = {}
+    raw: list[tuple[str, dict[str, str], float]] = []
+    seen_series: set[tuple[str, frozenset]] = set()
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped:
+            continue
+        if stripped.startswith("#"):
+            m = _HELP_LINE_RE.match(stripped)
+            if m:
+                helps[m.group(1)] = m.group(2) or ""
+                continue
+            m = _TYPE_LINE_RE.match(stripped)
+            if m:
+                if strict and m.group(1) in types:
+                    raise ValueError(f"duplicate TYPE for {m.group(1)}")
+                types[m.group(1)] = m.group(2)
+                continue
+            if strict and stripped.startswith(("# TYPE", "# HELP")):
+                raise ValueError(f"malformed metadata line: {line!r}")
+            continue                      # other comments are legal
+        m = _SAMPLE_LINE_RE.match(stripped)
+        if not m:
+            if strict:
+                raise ValueError(f"malformed sample line: {line!r}")
+            continue
+        name, block, value_s = m.group(1), m.group(2), m.group(3)
+        labels = (_parse_label_block(block[1:-1], strict, line)
+                  if block else {})
+        try:
+            value = float(value_s)
+        except ValueError:
+            if strict:
+                raise ValueError(f"bad sample value: {line!r}")
+            continue
+        if strict:
+            series = (name, frozenset(labels.items()))
+            if series in seen_series:
+                raise ValueError(f"duplicate series: {line!r}")
+            seen_series.add(series)
+        raw.append((name, labels, value))
+    # base names that are histograms even without metadata: any _bucket
+    # sample carrying an le label implies its base family
+    hist_bases = {n for n, k in types.items() if k in ("histogram",
+                                                       "summary")}
+    hist_bases.update(
+        n[:-len("_bucket")] for n, labels, _ in raw
+        if n.endswith("_bucket") and "le" in labels)
+    families: dict[str, PromFamily] = {}
+    for name in types:                    # declared-but-empty families
+        families[name] = PromFamily(name, types[name],
+                                    helps.get(name, ""))
+    for name, labels, value in raw:
+        base = name
+        for suf in _HIST_SUFFIXES:
+            if name.endswith(suf) and name[:-len(suf)] in hist_bases:
+                base = name[:-len(suf)]
+                break
+        fam = families.get(base)
+        if fam is None:
+            kind = types.get(base, "histogram" if base in hist_bases
+                             else "untyped")
+            fam = families[base] = PromFamily(base, kind,
+                                              helps.get(base, ""))
+        fam.samples.append((name, labels, value))
+    if strict:
+        for fam in families.values():
+            if fam.kind in ("histogram", "summary") or (
+                    fam.kind == "untyped" and fam.name in hist_bases):
+                _check_histogram_invariants(fam)
+    return families
+
+
 __all__ = ["Histogram", "RequestTrace", "TaskTrace", "TraceContext",
            "TRACE_HEADER", "TRACE_ID_RESPONSE_HEADER", "ServingTelemetry",
            "ServiceRateEstimator", "PromRenderer", "PROM_CONTENT_TYPE",
+           "PromFamily", "parse_prom_text",
            "TELEMETRY_HISTOGRAMS", "TERMINAL_SPANS", "TASK_TERMINAL_SPANS",
            "DispatchTracker", "CompileTelemetry", "COMPILE_TELEMETRY",
            "install_compile_telemetry"]

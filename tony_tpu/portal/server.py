@@ -15,9 +15,12 @@ distributed traces (every tier's ``*.trace.jsonl`` joined by trace_id)
 with `/requests/<id>/<trace_id>` rendering one trace's waterfall,
 `/profiles/<id>` lists and serves captured jax.profiler
 xplane dumps (from serve's `/debug/profile` and the driver's
-profile-command path), and `/metrics` exposes the portal's own request
-counters/latency in Prometheus text format through the same renderer the
-serve endpoint uses.
+profile-command path), `/slo/<id>` renders the job's SLO dashboard
+(burn/budget sparklines replayed offline from ``metrics.tsdb.jsonl``
+through the same MetricsHub + SLOEngine the live driver runs), and
+`/metrics` exposes the portal's own request counters/latency in
+Prometheus text format through the same renderer the serve endpoint
+uses.
 """
 
 from __future__ import annotations
@@ -80,6 +83,7 @@ class HistoryIndex:
         self._trace_cache = _TTLCache(ttl_s=30)
         self._task_trace_cache = _TTLCache(ttl_s=30)
         self._merged_cache = _TTLCache(ttl_s=30)
+        self._slo_cache = _TTLCache(ttl_s=10)
 
     def _job_dirs(self):
         for root in (self.intermediate, self.finished):
@@ -236,6 +240,85 @@ class HistoryIndex:
                             "bytes": st.st_size,
                             "mtime": int(st.st_mtime)})
         return out
+
+    def slo(self, app_id: str) -> dict | None:
+        """Offline SLO dashboard data: replay the job's persisted
+        ``metrics.tsdb.jsonl`` into a fresh MetricsHub, evaluate the
+        conf-declared objectives at the LAST retained timestamp (the
+        portal has no live clock into the job), seed alert state from
+        the driver journal's ``slo_alert`` records, and sample burn /
+        budget curves across the retained span for the sparklines.
+        None when the job never persisted a TSDB or declares no SLOs
+        — the route 404s. TTL-cached like every other replayed file."""
+        def load():
+            from .. import constants as c
+            from ..events.driver_journal import load_state
+            from ..metricshub import TSDB_FILE, MetricsHub
+            from ..slo import SLOEngine, slo_objectives_from_conf
+
+            conf_dict = self.config(app_id)
+            if conf_dict is None:
+                return None
+            job_dir, _ = self._find_job_dir(app_id)
+            roots = [self.staging / app_id]
+            if job_dir is not None:
+                roots.append(job_dir)
+            tsdb = next((r / TSDB_FILE for r in roots
+                         if (r / TSDB_FILE).exists()), None)
+            if tsdb is None:
+                return None
+            objectives = slo_objectives_from_conf(TonyConf(conf_dict))
+            if not objectives:
+                return None
+            hub = MetricsHub(persist_dir=None,
+                             retention_s=float("inf"), max_points=4096)
+            hub.load(tsdb)
+            times = list(hub.targets().values())
+            if not times:
+                return None
+            now = max(times)
+            initial: dict = {}
+            for root in roots:
+                jpath = root / c.DRIVER_JOURNAL_FILE
+                if not jpath.exists():
+                    continue
+                try:
+                    state = load_state(jpath)
+                except Exception:
+                    break
+                if state is None:
+                    break
+                for key, entry in state.slo_alerts.items():
+                    name, _, sev = key.rpartition(":")
+                    initial[(name, sev)] = entry.get("state") == "firing"
+                break
+            engine = SLOEngine(hub, objectives, now_fn=lambda: now,
+                               initial_alerts=initial)
+            snap = engine.evaluate()
+            # sparkline fodder: short-window burn + full-window budget
+            # sampled across the retained span (hub rings, same math
+            # the live engine runs)
+            first = min((s.ring[0][0]
+                         for s in hub._series.values() if s.ring),
+                        default=now)
+            n = 32
+            span = max(now - first, 1e-9)
+            ts = [first + span * i / (n - 1) for i in range(n)]
+            for s_slo, slo in zip(snap["slos"], engine.objectives):
+                short_w = slo.window_s / 60.0
+                s_slo["spark_t"] = ts
+                s_slo["spark_burn"] = [
+                    engine.burn_rate(slo, short_w, t) for t in ts]
+                budget = []
+                for t in ts:
+                    bad, total = engine._bad_total(slo, slo.window_s, t)
+                    er = bad / total if total > 0 else 0.0
+                    budget.append(1.0 - er / (1.0 - slo.target))
+                s_slo["spark_budget"] = budget
+            return {"app_id": app_id, "t": now, "eval": snap,
+                    "alerts": engine.snapshot()["alerts"]}
+
+        return self._slo_cache.get(("slo", app_id), load)
 
     def profile_file(self, app_id: str, rel: str) -> bytes | None:
         """One captured profile's bytes (the xplane proto TensorBoard's
@@ -728,6 +811,61 @@ def _profiles_html(app_id: str, profiles: list[dict]) -> str:
     return _PAGE.format(body=body)
 
 
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list[float]) -> str:
+    """Unicode block sparkline, min..max normalized (flat series
+    renders as the low block — no signal, no shape)."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi - lo <= 1e-12:
+        return _SPARK_BLOCKS[0] * len(values)
+    top = len(_SPARK_BLOCKS) - 1
+    return "".join(
+        _SPARK_BLOCKS[int((v - lo) / (hi - lo) * top + 0.5)]
+        for v in values)
+
+
+def _slo_html(app_id: str, data: dict) -> str:
+    """SLO dashboard: one card per objective — budget remaining, alert
+    state per severity, burn rates per derived window, and the burn /
+    budget sparklines over the TSDB's retained span (docs/observability.md
+    "Metrics pipeline & SLO alerting")."""
+    cards = []
+    for s in data["eval"]["slos"]:
+        budget = s["error_budget_remaining"]
+        alerts = "".join(
+            f"<td class='{'bad' if firing else 'ok'}'>{html.escape(sev)}: "
+            f"{'FIRING' if firing else 'ok'}</td>"
+            for sev, firing in sorted(s["alerts"].items()))
+        burns = "".join(
+            f"<tr><td>{html.escape(w)}s</td><td>{b:.3f}×</td></tr>"
+            for w, b in sorted(s["burn_rates"].items(),
+                               key=lambda kv: float(kv[0])))
+        cards.append(
+            f"<h3>{html.escape(s['name'])} "
+            f"<small>({html.escape(s['objective'])}, target "
+            f"{s['target']:g}, window {s['window_s']:g}s)</small></h3>"
+            f"<p>error budget remaining: <b>{budget:.1%}</b> "
+            f"(bad {s['bad']:g} / total {s['total']:g})</p>"
+            f"<table><tr>{alerts}</tr></table>"
+            f"<p>burn <code>{_sparkline(s.get('spark_burn', []))}</code>"
+            f" &nbsp; budget <code>"
+            f"{_sparkline(s.get('spark_budget', []))}</code></p>"
+            "<table><tr><th>window</th><th>burn rate</th></tr>"
+            + burns + "</table>")
+    body = (
+        f"<h3>{html.escape(app_id)} — SLOs</h3>"
+        f"<p><a href='/'>all jobs</a> | "
+        f"<a href='/jobs/{html.escape(app_id)}'>events</a></p>"
+        + "".join(cards)
+        + "<style>td.bad{color:#b00;font-weight:bold}"
+          "td.ok{color:#080}</style>")
+    return _PAGE.format(body=body)
+
+
 def make_handler(index: HistoryIndex, token: str = ""):
     import threading
 
@@ -738,7 +876,7 @@ def make_handler(index: HistoryIndex, token: str = ""):
     # not grow the dict (or the /metrics cardinality) without limit.
     # One lock: ThreadingHTTPServer handlers mutate these concurrently.
     _KNOWN_ROUTES = ("index", "jobs", "config", "logs", "traces",
-                     "requests", "tasks", "profiles", "metrics")
+                     "requests", "tasks", "profiles", "slo", "metrics")
     http_requests: dict[str, int] = {}
     request_hist = Histogram()
     telemetry_lock = threading.Lock()
@@ -921,6 +1059,11 @@ def make_handler(index: HistoryIndex, token: str = ""):
                         return self._json(profiles)
                     return self._send(
                         200, _profiles_html(app_id, profiles))
+                if kind == "slo":
+                    data = index.slo(app_id)
+                    if want_json or data is None:
+                        return self._json(data)
+                    return self._send(200, _slo_html(app_id, data))
                 if kind == "jobs":
                     events = index.events(app_id)
                     if want_json or events is None:

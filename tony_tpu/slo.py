@@ -1,0 +1,304 @@
+"""Declarative SLOs + multi-window burn-rate alerting + error budgets.
+
+Objectives are declared in conf (``tony.slo.<name>.{objective,target,
+window-s,...}`` — conf/keys.py) and evaluated against the MetricsHub's
+retained series (tony_tpu/metricshub.py): one scrape pipeline feeds the
+autoscaler's control law AND the alerting math, so the two can never
+disagree about what the fleet looked like.
+
+Three objective kinds, all normalized to a bad/total ratio per window:
+
+- ``availability`` — the fleet-router request ledger: bad = failed +
+  shed attempts, total = posted attempts (``router_requests_total`` /
+  ``router_requests_failed_total`` / ``router_shed_total`` summed over
+  per-replica partitions and front doors).
+- ``ttft-p99`` / ``tpot-p99`` — latency objectives over the serve
+  tier's histogram families: good = requests whose latency fell at or
+  under ``threshold-s`` (linear interpolation inside the winning
+  bucket, the PromQL convention), bad = the rest.
+
+Alerting follows the multi-window multi-burn-rate recipe (SRE workbook
+ch. 5): with ``W = window-s``, the FAST pair alerts when both the
+``W/6`` and ``W/60`` windows burn above ``fast-burn`` (default 14.4×
+— a page-worthy burn: at that rate the whole budget dies within
+~W/14), and the SLOW pair when both ``W`` and ``W/6`` burn above
+``slow-burn`` (default 6×). The short window makes alerts RESET
+quickly once the incident ends; the long window keeps one noisy tick
+from paging. Test/bench clocks just declare a small ``window-s`` —
+every alert window scales with it.
+
+Burn rate over a window = (bad/total) / (1 − target); the error budget
+remaining over the full horizon = 1 − (bad(W)/total(W)) / (1 − target).
+
+Firing/clear transitions are journaled through a caller-provided
+``record_fn`` (the driver writes ``{"op": "slo_alert", ...}`` under its
+journal discipline) so a recovered driver RESUMES a mid-incident alert
+instead of re-firing it; clears additionally need two consecutive clear
+evaluations (one thin post-recovery window must not bounce the state).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import re
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from . import metrics as _metrics
+from .autoscale import TPOT_FAMILY, TTFT_FAMILY
+
+log = logging.getLogger(__name__)
+
+# evaluations a FIRING alert must see the clear condition for before it
+# clears — fires fast, clears deliberately (anti-flap, and a recovered
+# driver's first thin window can't bounce a resumed alert)
+CLEAR_TICKS = 2
+
+_SLO_KEY_RE = re.compile(r"^tony\.slo\.([A-Za-z0-9_-]+)\.objective$")
+
+OBJECTIVES = ("availability", "ttft-p99", "tpot-p99")
+
+
+@dataclass
+class SLObjective:
+    """One declared objective. ``window_s`` is the SLO horizon the
+    error budget is accounted over; the four alert windows derive from
+    it (fast pair W/6 + W/60, slow pair W + W/6)."""
+
+    name: str
+    objective: str              # one of OBJECTIVES
+    target: float = 0.99        # good/total the SLO promises
+    window_s: float = 3600.0
+    threshold_s: float = 0.0    # latency objectives: the "good" bound
+    fast_burn: float = 14.4
+    slow_burn: float = 6.0
+
+    def pairs(self) -> dict[str, tuple[float, float, float]]:
+        """severity -> (long_window_s, short_window_s, burn_threshold)"""
+        w = self.window_s
+        return {"fast": (w / 6.0, w / 60.0, self.fast_burn),
+                "slow": (w, w / 6.0, self.slow_burn)}
+
+    def windows(self) -> list[float]:
+        return sorted({self.window_s, self.window_s / 6.0,
+                       self.window_s / 60.0})
+
+
+def slo_objectives_from_conf(conf) -> list[SLObjective]:
+    """Every ``tony.slo.<name>.objective`` key declares one objective;
+    the sibling keys refine it. Unknown objective kinds are skipped
+    with a log line (an older driver reading newer conf must degrade,
+    not crash)."""
+    out = []
+    for key in sorted(conf.as_dict()):
+        m = _SLO_KEY_RE.match(key)
+        if not m:
+            continue
+        name = m.group(1)
+        objective = str(conf.get(key, "")).strip()
+        if objective not in OBJECTIVES:
+            log.warning("skipping SLO %r: unknown objective %r",
+                        name, objective)
+            continue
+        base = f"tony.slo.{name}."
+        try:
+            slo = SLObjective(
+                name=name, objective=objective,
+                target=float(conf.get(base + "target", 0.99)),
+                window_s=float(conf.get(base + "window-s", 3600.0)),
+                threshold_s=float(conf.get(base + "threshold-s", 0.0)),
+                fast_burn=float(conf.get(base + "fast-burn", 14.4)),
+                slow_burn=float(conf.get(base + "slow-burn", 6.0)))
+        except (TypeError, ValueError):
+            log.warning("skipping SLO %r: malformed conf", name)
+            continue
+        if not (0.0 < slo.target < 1.0) or slo.window_s <= 0:
+            log.warning("skipping SLO %r: target/window out of range",
+                        name)
+            continue
+        if objective != "availability" and slo.threshold_s <= 0:
+            log.warning("skipping SLO %r: latency objective needs "
+                        "%sthreshold-s", name, base)
+            continue
+        out.append(slo)
+    return out
+
+
+def _le_key(le: str) -> float:
+    return math.inf if le in ("+Inf", "inf") else float(le)
+
+
+def good_under_threshold(buckets: dict[str, float],
+                         threshold_s: float) -> float:
+    """Requests at or under the threshold, from windowed cumulative
+    ``{le: count}`` buckets — linear interpolation inside the bucket
+    the threshold falls in (bucket_quantile's convention, inverted).
+    An unbounded winning bucket returns the honest floor."""
+    items = sorted(buckets.items(), key=lambda kv: _le_key(kv[0]))
+    lo, c_lo = 0.0, 0.0
+    for le, c in items:
+        hi = _le_key(le)
+        if threshold_s < hi:
+            if hi == math.inf:
+                return c_lo
+            width = hi - lo
+            if width <= 0:
+                return c
+            return c_lo + (c - c_lo) * (threshold_s - lo) / width
+        lo, c_lo = hi, c
+    return items[-1][1] if items else 0.0
+
+
+class SLOEngine:
+    """Evaluates every declared objective against the hub's windows,
+    tracks alert state with journaled transitions, and renders the
+    ``driver_slo_*`` exposition families."""
+
+    def __init__(self, hub, objectives, now_fn=time.time,
+                 record_fn=None, initial_alerts=None,
+                 history_limit: int = 256):
+        self.hub = hub
+        self.objectives = list(objectives)
+        self.now_fn = now_fn
+        # record_fn(slo_name, severity, state, t) — the driver journals
+        # each transition; best-effort by journal contract
+        self.record_fn = record_fn
+        # (slo_name, severity) -> firing? — seeded from journal replay
+        # on driver recovery so a mid-incident alert RESUMES
+        self.alerts: dict[tuple[str, str], bool] = dict(
+            initial_alerts or {})
+        self._clear_streak: dict[tuple[str, str], int] = {}
+        self.history: deque = deque(maxlen=history_limit)
+        self.last_eval: dict | None = None
+
+    # ------------------------------------------------------------ ratios
+    def _bad_total(self, slo: SLObjective, window_s: float,
+                   now: float) -> tuple[float, float]:
+        if slo.objective == "availability":
+            total = self.hub.window_increase(
+                _metrics.ROUTER_REQUESTS_TOTAL, window_s, now=now)
+            bad = (self.hub.window_increase(
+                       _metrics.ROUTER_FAILED_TOTAL, window_s, now=now)
+                   + self.hub.window_increase(
+                       _metrics.ROUTER_SHED_TOTAL, window_s, now=now))
+            return min(bad, total), total
+        family = (TTFT_FAMILY if slo.objective == "ttft-p99"
+                  else TPOT_FAMILY)
+        buckets = self.hub.window_buckets(family, window_s, now=now)
+        if not buckets:
+            return 0.0, 0.0
+        total = max(buckets.values())
+        good = good_under_threshold(buckets, slo.threshold_s)
+        return max(0.0, total - good), total
+
+    def burn_rate(self, slo: SLObjective, window_s: float,
+                  now: float | None = None) -> float:
+        """(bad/total) / (1 − target) over the trailing window; 0.0
+        with no traffic (an idle fleet burns no budget)."""
+        t = self.now_fn() if now is None else now
+        bad, total = self._bad_total(slo, window_s, t)
+        if total <= 0:
+            return 0.0
+        return (bad / total) / (1.0 - slo.target)
+
+    # -------------------------------------------------------- evaluation
+    def evaluate(self, now: float | None = None) -> dict:
+        """One evaluation pass over every objective: burn rates for
+        each derived window, alert transitions (journaled), budget
+        accounting. Returns (and caches) the snapshot the /slo routes
+        and the exposition families render from."""
+        t = self.now_fn() if now is None else now
+        snap: dict = {"t": t, "slos": []}
+        for slo in self.objectives:
+            burns = {w: self.burn_rate(slo, w, t) for w in slo.windows()}
+            alerts: dict[str, bool] = {}
+            for sev, (long_w, short_w, thr) in slo.pairs().items():
+                cond = burns[long_w] > thr and burns[short_w] > thr
+                key = (slo.name, sev)
+                was = self.alerts.get(key, False)
+                if cond:
+                    self._clear_streak[key] = 0
+                    firing = True
+                elif was:
+                    # firing -> clear needs CLEAR_TICKS consecutive
+                    # clear evaluations (fire fast, clear deliberately)
+                    streak = self._clear_streak.get(key, 0) + 1
+                    self._clear_streak[key] = streak
+                    firing = streak < CLEAR_TICKS
+                else:
+                    firing = False
+                if firing != was:
+                    self.alerts[key] = firing
+                    state = "firing" if firing else "clear"
+                    entry = {"slo": slo.name, "severity": sev,
+                             "state": state, "t": t,
+                             "burn_long": burns[long_w],
+                             "burn_short": burns[short_w]}
+                    self.history.append(entry)
+                    if self.record_fn is not None:
+                        try:
+                            self.record_fn(slo.name, sev, state, t)
+                        except Exception:
+                            log.exception("slo alert record failed")
+                alerts[sev] = self.alerts.get(key, False)
+            bad, total = self._bad_total(slo, slo.window_s, t)
+            error_rate = (bad / total) if total > 0 else 0.0
+            budget_remaining = 1.0 - error_rate / (1.0 - slo.target)
+            snap["slos"].append({
+                "name": slo.name, "objective": slo.objective,
+                "target": slo.target, "window_s": slo.window_s,
+                "threshold_s": slo.threshold_s,
+                "burn_rates": {f"{w:g}": burns[w] for w in burns},
+                "pairs": {sev: {"long_s": lw, "short_s": sw,
+                                "threshold": thr}
+                          for sev, (lw, sw, thr) in slo.pairs().items()},
+                "alerts": alerts,
+                "bad": bad, "total": total, "error_rate": error_rate,
+                "error_budget_remaining": budget_remaining,
+            })
+        self.last_eval = snap
+        return snap
+
+    # --------------------------------------------------------- surfaces
+    def render_into(self, r) -> None:
+        """Append the ``driver_slo_*`` families to a PromRenderer —
+        from the newest evaluation (render must not re-walk the rings
+        under the exposition handler's clock)."""
+        snap = self.last_eval
+        if snap is None:
+            return
+        for s in snap["slos"]:
+            for w, burn in sorted(s["burn_rates"].items(),
+                                  key=lambda kv: float(kv[0])):
+                r.gauge(_metrics.DRIVER_SLO_BURN_RATE, burn,
+                        "error-budget burn rate over the trailing "
+                        "window: (bad/total) / (1 - target)",
+                        labels={"slo": s["name"], "window_s": w})
+            r.gauge(_metrics.DRIVER_SLO_ERROR_BUDGET_REMAINING,
+                    s["error_budget_remaining"],
+                    "fraction of the SLO window's error budget left "
+                    "(negative = blown)",
+                    labels={"slo": s["name"]})
+            for sev, firing in sorted(s["alerts"].items()):
+                r.gauge(_metrics.DRIVER_SLO_ALERTS_FIRING,
+                        1 if firing else 0,
+                        "1 while the burn-rate pair for this severity "
+                        "is firing",
+                        labels={"slo": s["name"], "severity": sev})
+
+    def snapshot(self) -> dict:
+        """JSON-able state for the driver's /slo route, the portal
+        dashboard, and the CLI."""
+        return {
+            "evaluated": self.last_eval is not None,
+            "eval": self.last_eval,
+            "alerts": [{"slo": n, "severity": sev, "firing": firing}
+                       for (n, sev), firing in sorted(self.alerts.items())],
+            "history": list(self.history),
+        }
+
+
+__all__ = ["SLObjective", "SLOEngine", "slo_objectives_from_conf",
+           "good_under_threshold", "CLEAR_TICKS", "OBJECTIVES"]
